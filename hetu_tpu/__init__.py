@@ -1,0 +1,50 @@
+"""hetu_tpu — a TPU-native distributed deep-learning framework.
+
+Capability parity with AFDWang/Hetu (define-then-run dataflow graphs,
+DP/TP/PP/EP(+SP/CP) parallelism, PS-backed sparse embeddings with bounded
+staleness caches, auto-parallel search), rebuilt idiomatically on
+JAX/XLA/Pallas: the op DAG traces into a single jitted XLA program,
+collectives come from GSPMD/shard_map over a device mesh, and the hot kernels
+are Pallas.  See SURVEY.md for the reference structural map this follows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .graph import (Op, PlaceholderOp, VariableOp, find_topo_sort,
+                    graph_variables, gradients, Executor)
+from . import initializers as init
+from .ops import *  # noqa: F401,F403
+from .optim import (SGDOptimizer, MomentumOptimizer, AdaGradOptimizer,
+                    AdamOptimizer, AdamWOptimizer, AMSGradOptimizer,
+                    LambOptimizer)
+from .optim import lr_scheduler
+
+__version__ = "0.1.0"
+
+
+def placeholder_op(name, shape=None, dtype=np.float32, trainable=False):
+    """Create a fed input node (reference: gpu_ops/Variable.py)."""
+    return PlaceholderOp(name, shape=shape, dtype=dtype)
+
+
+def Variable(name, value=None, initializer=None, shape=None, trainable=True,
+             dtype=np.float32):
+    """Create a persistent (optionally trainable) tensor.
+
+    Either ``value`` (a concrete numpy array) or ``initializer`` + ``shape``
+    must be given, matching the reference's Variable signature.
+    """
+    if value is not None:
+        value = np.asarray(value)
+        initializer = init.NumpyInit(value)
+        shape = value.shape
+    assert initializer is not None and shape is not None, \
+        "Variable needs value= or (initializer=, shape=)"
+    return VariableOp(name, shape, initializer, trainable=trainable,
+                      dtype=dtype)
+
+
+# torch/tf-style aliases used across reference examples
+scalar = lambda name, value: Variable(name, value=np.asarray(value))  # noqa: E731

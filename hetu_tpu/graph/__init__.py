@@ -1,0 +1,5 @@
+from .node import (Op, PlaceholderOp, VariableOp, find_topo_sort,
+                   graph_variables, graph_placeholders)
+from .trace import TraceContext, evaluate
+from .autodiff import gradients
+from .executor import Executor, SubExecutor

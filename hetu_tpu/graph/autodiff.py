@@ -1,0 +1,94 @@
+"""Trace-time autodiff: ``gradients(loss, xs)`` as graph nodes.
+
+The reference builds the backward graph symbolically at define time with
+hand-written per-op gradient rules (/root/reference/python/hetu/gpu_ops/
+executor.py:1265 `gradients()` — reverse topo walk calling `node.gradient`).
+Here gradient nodes are thin wrappers that, when the graph is traced, rebase
+the loss subgraph on ``xs`` and call ``jax.vjp`` — so every op differentiates
+for free (including future Pallas kernels via their custom VJPs), and XLA CSE
+dedupes the re-traced forward against the primal forward.  The user-facing
+contract matches the reference: ``gradients`` returns one graph node per x,
+usable as inputs to optimizer ops or comm ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .node import Op, PlaceholderOp, VariableOp, find_topo_sort
+from .trace import TraceContext, evaluate
+
+
+class GradientsBundleOp(Op):
+    """Internal: computes all d loss / d xs in one vjp call."""
+
+    def __init__(self, loss, xs, grad_out=None):
+        self.xs = list(xs)
+        self.grad_out = grad_out
+        inputs = [loss] + self.xs + ([grad_out] if grad_out is not None else [])
+        super().__init__(*inputs, name=f"grads_of_{loss.name}")
+        self.loss = loss
+
+    # evaluated via _compute_with_env (special-cased by trace/executor)
+    def _compute_with_env(self, env, ctx: TraceContext):
+        sub_topo = find_topo_sort([self.loss])
+        x_set = set(self.xs)
+        # Rebase on true graph leaves only; everything between leaves and loss
+        # is re-traced with xs overridden (xs may be intermediate nodes, e.g.
+        # stage-boundary activations for pipeline partitioning).  Binding any
+        # already-computed interior node would cut the path from xs to loss.
+        leaves = [n for n in sub_topo
+                  if isinstance(n, (PlaceholderOp, VariableOp))
+                  and n not in x_set]
+
+        # updates from the re-trace are discarded (the primal forward already
+        # recorded them); RNG is shared so dropout masks replay identically.
+        def f(x_vals):
+            inner = TraceContext(key=ctx.key, training=ctx.training,
+                                 mesh=ctx.mesh)
+            bind = {n: env[n] for n in leaves if n in env}
+            bind.update(dict(zip(self.xs, x_vals)))
+            (loss_val,), _ = evaluate([self.loss], bind, inner)
+            return loss_val
+
+        primals = [env[x] for x in self.xs]
+        loss_val, vjp_fn = jax.vjp(f, primals)
+        if self.grad_out is not None:
+            ct = env[self.grad_out]
+        else:
+            ct = jnp.ones_like(loss_val)
+        (grads,) = vjp_fn(ct)
+        return tuple(grads)
+
+    def _compute(self, input_vals, ctx):
+        raise RuntimeError("GradientsBundleOp is evaluated with env access")
+
+
+class GradientSliceOp(Op):
+    """Selects one gradient out of a GradientsBundleOp."""
+
+    def __init__(self, bundle, idx, of):
+        super().__init__(bundle, name=f"grad_{of.name}")
+        self.idx = idx
+        self.of = of  # the x this is the gradient of
+
+    def _compute(self, input_vals, ctx):
+        return input_vals[0][self.idx]
+
+
+def gradients(loss, node_list, grad_out=None, return_all=False):
+    """Build gradient nodes of ``loss`` w.r.t. each node in ``node_list``.
+
+    API-compatible with reference executor.py:1265.  ``return_all`` returns
+    (grads, backward2forward, forward2backward) maps used by the pipeline
+    partitioner; here the maps are {x: grad_node} / {grad_node: x}.
+    """
+    node_list = list(node_list)
+    bundle = GradientsBundleOp(loss, node_list, grad_out=grad_out)
+    grads = [GradientSliceOp(bundle, i, x) for i, x in enumerate(node_list)]
+    if return_all:
+        f2b = {x: g for x, g in zip(node_list, grads)}
+        b2f = {g: x for x, g in zip(node_list, grads)}
+        return grads, b2f, f2b
+    return grads

@@ -1,0 +1,249 @@
+"""Executor: named subgraphs compiled to jitted XLA programs.
+
+Reference: /root/reference/python/hetu/gpu_ops/executor.py — `Executor` holds
+named subgraphs (train/validate/...) each run by a `SubExecutor` that topo
+sorts, infers shapes, plans memory, and dispatches kernels per node per step.
+
+TPU redesign: each named subgraph becomes ONE jitted pure function
+``(params, opt_state, feeds, key) -> (outputs, new_params, new_opt_state)``.
+XLA replaces the per-node dispatch loop, the stream/event machinery
+(executor.py:351-380, :1227-1246), the memory planner (memory_pool.py — XLA's
+buffer assignment does arena reuse), and shape inference (shapes specialize at
+trace time; a new feed shape simply triggers a retrace, mirroring the
+reference's re-plan on shape change at executor.py:938-1051).
+
+Distribution hooks: when a `mesh` (parallel/mesh.py) is attached, parameter
+and feed shardings are derived from node `dist_state` annotations and passed
+to jit as in_shardings — GSPMD then inserts the collectives the reference
+materialized by hand in its graph-rewrite pass (context.py:1469).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .node import (Op, PlaceholderOp, VariableOp, find_topo_sort,
+                   graph_variables)
+from .trace import TraceContext, evaluate
+
+
+class SubExecutor:
+    """One named subgraph compiled into a single jitted step function."""
+
+    def __init__(self, name, eval_nodes, executor):
+        self.name = name
+        self.eval_nodes = list(eval_nodes)
+        self.executor = executor
+        self.topo = find_topo_sort(self.eval_nodes)
+        self.placeholders = [n for n in self.topo
+                             if isinstance(n, PlaceholderOp)]
+        self.variables = [n for n in self.topo if isinstance(n, VariableOp)]
+        self.opt_ops = [n for n in self.topo if n.is_stateful
+                        and hasattr(n, "init_state")]
+        # train/eval mode: training iff the subgraph optimizes or explicitly
+        # differentiates, unless the subgraph name marks it as evaluation
+        # (reference: inference flag on SubExecutor, executor.py:733).
+        has_grads = any(hasattr(n, "_compute_with_env") for n in self.topo)
+        self.training = executor.config.get(
+            "training",
+            (len(self.opt_ops) > 0 or has_grads)
+            and name not in ("validate", "inference", "eval"))
+        self._jitted = None
+
+    def _build(self):
+        placeholders = self.placeholders
+        eval_nodes = self.eval_nodes
+        topo = self.topo
+        training = self.training
+        mesh = self.executor.mesh
+
+        def step_fn(params, opt_state, feeds, key):
+            ctx = TraceContext(key=key, training=training, mesh=mesh)
+            ctx.opt_state = opt_state
+            bindings = {}
+            for v in self.variables:
+                bindings[v] = params[v.name]
+            for p in placeholders:
+                bindings[p] = feeds[p.name]
+            vals, env = evaluate(eval_nodes, bindings, ctx, topo=topo)
+            new_params = dict(params)
+            for var, val in ctx.updates.items():
+                new_params[var.name] = val
+            new_opt_state = dict(opt_state)
+            new_opt_state.update(ctx.new_opt_state)
+            return vals, new_params, new_opt_state
+
+        donate = (0, 1) if self.training else ()
+        in_shardings = self.executor._input_shardings(self)
+        if in_shardings is not None:
+            self._jitted = jax.jit(step_fn, donate_argnums=donate,
+                                   in_shardings=in_shardings)
+        else:
+            self._jitted = jax.jit(step_fn, donate_argnums=donate)
+
+    def run(self, feed_dict=None, convert_to_numpy_ret_vals=False):
+        if self._jitted is None:
+            self._build()
+        ex = self.executor
+        feeds = {}
+        feed_dict = feed_dict or {}
+        for node, value in feed_dict.items():
+            name = node.name if isinstance(node, Op) else node
+            feeds[name] = value
+        missing = [p.name for p in self.placeholders if p.name not in feeds]
+        if missing:
+            raise ValueError(f"missing feeds for placeholders: {missing}")
+        # cast feeds to declared dtypes (reference DataloaderOp feeds float32)
+        for p in self.placeholders:
+            v = feeds[p.name]
+            if not isinstance(v, jax.Array):
+                feeds[p.name] = jnp.asarray(v, dtype=p.dtype)
+        key = jax.random.fold_in(ex._base_key, ex._global_step)
+        ex._global_step += 1
+        vals, new_params, new_opt_state = self._jitted(
+            ex.params, ex.opt_state, feeds, key)
+        ex.params = new_params
+        ex.opt_state = new_opt_state
+        if convert_to_numpy_ret_vals:
+            vals = [None if v is None else np.asarray(v) for v in vals]
+        return vals
+
+    def profile(self, feed_dict=None, repeats=10):
+        """Wall-clock a compiled step (reference SubExecutor.profile)."""
+        self.run(feed_dict)  # compile
+        start = time.perf_counter()
+        for _ in range(repeats):
+            out = self.run(feed_dict)
+        jax.block_until_ready([o for o in out if o is not None])
+        return (time.perf_counter() - start) / repeats
+
+
+class Executor:
+    """Multi-subgraph session (reference executor.py:430).
+
+    ``eval_node_dict`` may be a list (single anonymous subgraph) or a dict
+    {name: eval_node_list}.  ``dist_strategy`` (parallel/strategies) annotates
+    the graph with shardings before compilation; ``mesh`` selects the device
+    mesh.  ``seed`` drives variable init and per-step RNG (dropout replay on
+    checkpoint resume is preserved by saving the step counter, like the
+    reference's seed+seqnum scheme in random.py).
+    """
+
+    def __init__(self, eval_node_dict, ctx=None, seed=0, mesh=None,
+                 dist_strategy=None, comm_mode=None, **kwargs):
+        if isinstance(eval_node_dict, (list, tuple)):
+            eval_node_dict = {"default": list(eval_node_dict)}
+        self.eval_node_dict = {k: list(v) for k, v in eval_node_dict.items()}
+        self.mesh = mesh
+        self.comm_mode = comm_mode
+        self.config = kwargs
+
+        all_nodes = [n for lst in self.eval_node_dict.values() for n in lst]
+        if dist_strategy is not None:
+            dist_strategy.annotate(all_nodes)
+            if mesh is None and getattr(dist_strategy, "mesh", None) is not None:
+                self.mesh = dist_strategy.mesh
+        self.all_topo = find_topo_sort(all_nodes)
+        self.variables = [n for n in self.all_topo if isinstance(n, VariableOp)]
+
+        self._base_key = jax.random.key(seed)
+        self._global_step = 0
+        self.params = {}
+        init_key = jax.random.fold_in(self._base_key, 0x5EED)
+        for v in self.variables:
+            self.params[v.name] = self._place(
+                v, v.initializer(jax.random.fold_in(init_key, v.id),
+                                 v.shape, jnp.dtype(v.dtype)))
+
+        self.opt_state = {}
+        for n in self.all_topo:
+            if n.is_stateful and hasattr(n, "init_state"):
+                self.opt_state[n.name] = n.init_state(self.params)
+
+        self.subexecutor = {name: SubExecutor(name, nodes, self)
+                            for name, nodes in self.eval_node_dict.items()}
+
+    # -- sharding hooks (filled in by parallel layer) ----------------------
+    def _place(self, var, value):
+        if self.mesh is not None and var.dist_state is not None:
+            from ..parallel.mesh import to_named_sharding
+            return jax.device_put(value, to_named_sharding(self.mesh,
+                                                           var.dist_state))
+        return value
+
+    def _input_shardings(self, subexec):
+        if self.mesh is None:
+            return None
+        from ..parallel.mesh import to_named_sharding, replicated
+        param_sh = {}
+        for v in subexec.variables:
+            if v.dist_state is not None:
+                param_sh[v.name] = to_named_sharding(self.mesh, v.dist_state)
+            else:
+                param_sh[v.name] = replicated(self.mesh)
+        feed_sh = {}
+        for p in subexec.placeholders:
+            if p.dist_state is not None:
+                feed_sh[p.name] = to_named_sharding(self.mesh, p.dist_state)
+            else:
+                feed_sh[p.name] = replicated(self.mesh)
+        opt_sh = jax.tree_util.tree_map(
+            lambda _: replicated(self.mesh), self.opt_state)
+        # parameter-sharded optimizer slots follow their parameter
+        for opname, state in self.opt_state.items():
+            if opname in opt_sh and "slots" in state:
+                for vname in state["slots"]:
+                    if vname in param_sh:
+                        opt_sh[opname]["slots"][vname] = jax.tree_util.tree_map(
+                            lambda _: param_sh[vname], state["slots"][vname])
+        return (param_sh, opt_sh, feed_sh, replicated(self.mesh))
+
+    # -- reference-compatible API -----------------------------------------
+    def run(self, name_or_feed=None, feed_dict=None,
+            convert_to_numpy_ret_vals=False, **kwargs):
+        if isinstance(name_or_feed, str):
+            name = name_or_feed
+        else:
+            name = next(iter(self.subexecutor))
+            if feed_dict is None:
+                feed_dict = name_or_feed
+        return self.subexecutor[name].run(
+            feed_dict=feed_dict,
+            convert_to_numpy_ret_vals=convert_to_numpy_ret_vals)
+
+    # -- checkpoint (reference executor.py:558-670) ------------------------
+    def state_dict(self):
+        host = jax.tree_util.tree_map(np.asarray, self.params)
+        opt = jax.tree_util.tree_map(np.asarray, self.opt_state)
+        return {"params": host, "opt_state": opt,
+                "global_step": self._global_step,
+                "base_key": np.asarray(jax.random.key_data(self._base_key))}
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            pickle.dump(self.state_dict(), f)
+
+    def load(self, path):
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.load_state_dict(state)
+
+    def load_state_dict(self, state):
+        var_by_name = {v.name: v for v in self.variables}
+        for name, value in state["params"].items():
+            if name in var_by_name:
+                v = var_by_name[name]
+                self.params[name] = self._place(v, jnp.asarray(value))
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                                state["opt_state"])
+        self._global_step = state["global_step"]
+        self._base_key = jax.random.wrap_key_data(
+            jnp.asarray(state["base_key"]))
+
+    def get_params(self):
+        return dict(self.params)

@@ -1,0 +1,194 @@
+"""Graph node model: the define-then-run op DAG.
+
+TPU-native re-design of the reference's op/node layer
+(/root/reference/python/hetu/gpu_ops/Node.py:20 `class Op`).  The reference
+dispatches each node through ctypes into hand-written CUDA kernels; here every
+op's ``compute`` is a pure jax-traceable function, and the whole DAG is traced
+once into a single XLA program by the executor (see graph/executor.py).  That
+means:
+
+  * no per-op streams/events — XLA owns scheduling,
+  * no hand-written shape rules — shapes come from ``jax.eval_shape``,
+  * no hand-written per-op gradients — autodiff is trace-time ``jax.vjp``
+    (graph/autodiff.py), with op-level custom VJPs only for Pallas kernels.
+
+The graph API itself (placeholders, Variables, functional ``*_op``
+constructors, ``Executor``) is kept compatible in spirit with the reference so
+users of Hetu find the same surface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_node_counter = [0]
+
+
+def _next_id() -> int:
+    _node_counter[0] += 1
+    return _node_counter[0]
+
+
+class Op:
+    """A node in the dataflow graph.
+
+    Subclasses implement ``_compute(input_vals, ctx)`` as a pure jax function
+    of the input arrays.  ``ctx`` is a TraceContext (graph/trace.py) giving
+    access to per-step RNG, the training flag, and state-update recording.
+    """
+
+    __slots__ = (
+        "id", "name", "inputs", "attrs", "dist_state", "raw_ctx",
+        "_shape_cache",
+    )
+
+    def __init__(self, *inputs, name=None, **attrs):
+        self.id = _next_id()
+        self.inputs = list(inputs)
+        self.name = name or f"{type(self).__name__}_{self.id}"
+        self.attrs = attrs
+        # Sharding annotation (parallel/mesh.py DistState), set by dispatch()
+        # or by a Strategy; mirrors reference NodeStatus (context.py:248).
+        self.dist_state = None
+        # Device-group annotation for pipeline-stage placement; mirrors
+        # reference raw_ctx (Node.py / context.py DeviceGroup).
+        self.raw_ctx = None
+        self._shape_cache = None
+
+    # -- graph protocol ----------------------------------------------------
+    def _compute(self, input_vals, ctx):
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def needs_rng(self) -> bool:
+        return False
+
+    @property
+    def is_stateful(self) -> bool:
+        """True for ops that update variables (optimizer, batchnorm, assign)."""
+        return False
+
+    # -- sugar -------------------------------------------------------------
+    def __add__(self, other):
+        from ..ops.math import add_op, addbyconst_op
+        if isinstance(other, Op):
+            return add_op(self, other)
+        return addbyconst_op(self, other)
+
+    __radd__ = __add__
+
+    def __mul__(self, other):
+        from ..ops.math import mul_op, mulbyconst_op
+        if isinstance(other, Op):
+            return mul_op(self, other)
+        return mulbyconst_op(self, other)
+
+    __rmul__ = __mul__
+
+    def __sub__(self, other):
+        from ..ops.math import sub_op, addbyconst_op, mulbyconst_op
+        if isinstance(other, Op):
+            return sub_op(self, other)
+        return addbyconst_op(self, -other)
+
+    def __rsub__(self, other):
+        from ..ops.math import mulbyconst_op, addbyconst_op
+        return addbyconst_op(mulbyconst_op(self, -1.0), other)
+
+    def __neg__(self):
+        from ..ops.math import mulbyconst_op
+        return mulbyconst_op(self, -1.0)
+
+    def __truediv__(self, other):
+        from ..ops.math import div_op, mulbyconst_op
+        if isinstance(other, Op):
+            return div_op(self, other)
+        return mulbyconst_op(self, 1.0 / other)
+
+    def __matmul__(self, other):
+        from ..ops.linalg import matmul_op
+        return matmul_op(self, other)
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name} #{self.id}>"
+
+    def __hash__(self):
+        return self.id
+
+    def __eq__(self, other):
+        return self is other
+
+
+class PlaceholderOp(Op):
+    """Fed input (reference: gpu_ops/Variable.py placeholder path)."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, name, shape=None, dtype=np.float32):
+        super().__init__(name=name)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = np.dtype(dtype)
+
+    def _compute(self, input_vals, ctx):  # value comes from feed_dict
+        raise RuntimeError(f"placeholder {self.name} was not fed")
+
+
+class VariableOp(Op):
+    """Trainable / persistent state.
+
+    Reference: gpu_ops/Variable.py Variable (initializer held on node,
+    materialized by executor at construction).  Values live in the executor's
+    functional state dict, not on the node.
+    """
+
+    __slots__ = ("shape", "dtype", "initializer", "trainable")
+
+    def __init__(self, name, shape, initializer, trainable=True,
+                 dtype=np.float32):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.initializer = initializer
+        self.trainable = bool(trainable)
+
+    def _compute(self, input_vals, ctx):
+        raise RuntimeError(
+            f"variable {self.name} must be bound by the executor")
+
+
+def find_topo_sort(node_list):
+    """Post-order DFS topo sort (reference: executor.py:1515)."""
+    visited = set()
+    order = []
+
+    def dfs(node):
+        stack = [(node, False)]
+        while stack:
+            n, expanded = stack.pop()
+            if expanded:
+                order.append(n)
+                continue
+            if n.id in visited:
+                continue
+            visited.add(n.id)
+            stack.append((n, True))
+            for inp in reversed(n.inputs):
+                if inp.id not in visited:
+                    stack.append((inp, False))
+
+    for node in node_list:
+        dfs(node)
+    return order
+
+
+def graph_variables(node_list, trainable_only=False):
+    """All VariableOps reachable from node_list, in topo order."""
+    out = []
+    for n in find_topo_sort(node_list):
+        if isinstance(n, VariableOp) and (n.trainable or not trainable_only):
+            out.append(n)
+    return out
+
+
+def graph_placeholders(node_list):
+    return [n for n in find_topo_sort(node_list) if isinstance(n, PlaceholderOp)]

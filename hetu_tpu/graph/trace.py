@@ -1,0 +1,75 @@
+"""Graph tracing: evaluate an op DAG as a pure jax function.
+
+This replaces the reference's interpreted per-node dispatch loop
+(/root/reference/python/hetu/gpu_ops/executor.py:1191 `SubExecutor.compute`):
+instead of dispatching one ctypes kernel per node per step, we walk the topo
+order ONCE inside `jax.jit` tracing, so the whole step compiles to a single
+XLA program.  Python dispatch overhead disappears after the first call and XLA
+fuses across op boundaries (the reference relied on stream overlap to hide its
+per-node Python hot loop).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .node import Op, PlaceholderOp, VariableOp, find_topo_sort
+
+
+class TraceContext:
+    """Per-trace services available to op ``_compute`` implementations.
+
+    * ``rng_for(op)`` — deterministic per-op, per-step PRNG key (reference
+      keeps a seed + seqnum in python/hetu/random.py:1-43 for reproducible
+      dropout; here we fold the op id into the step key, which also makes the
+      autodiff re-trace of the forward see identical randomness).
+    * ``training`` — train/eval flag (dropout, batchnorm).
+    * ``record_update(var, value)`` — stateful ops (batchnorm running stats,
+      assign) register new values for VariableOps; the executor threads them
+      into the functional state.
+    """
+
+    def __init__(self, key=None, training=True, mesh=None):
+        self.key = key
+        self.training = training
+        self.mesh = mesh
+        self.updates = {}        # VariableOp -> new value (tracer)
+        self.opt_state = {}      # {optimizer_op_name: state pytree} (input)
+        self.new_opt_state = {}  # {optimizer_op_name: state pytree} (output)
+
+    def rng_for(self, op: Op):
+        if self.key is None:
+            raise RuntimeError(
+                f"op {op.name} needs RNG but no key was provided to the trace")
+        return jax.random.fold_in(self.key, op.id)
+
+    def record_update(self, var: VariableOp, value):
+        self.updates[var] = value
+
+
+def evaluate(eval_nodes, bindings, ctx: TraceContext, topo=None):
+    """Evaluate ``eval_nodes`` given ``bindings`` {node: value}.
+
+    ``bindings`` must cover every PlaceholderOp/VariableOp reachable; other
+    nodes may also be pre-bound (used by autodiff to rebase gradients).
+    Returns (values list, env dict).
+    """
+    env = dict(bindings)
+    if topo is None:
+        topo = find_topo_sort(eval_nodes)
+    for node in topo:
+        if node in env:
+            continue
+        if isinstance(node, (PlaceholderOp, VariableOp)):
+            raise RuntimeError(f"{node} reached trace without a binding")
+        if hasattr(node, "_compute_with_env"):
+            env[node] = node._compute_with_env(env, ctx)
+        else:
+            input_vals = [env[i] for i in node.inputs]
+            env[node] = node._compute(input_vals, ctx)
+    return [env[n] for n in eval_nodes], env
+
+
+def constant_like(shape, dtype, value=0):
+    return jnp.full(shape, value, dtype=dtype)

@@ -1,0 +1,9 @@
+from .base import SimpleOp, simple_op
+from .math import *          # noqa: F401,F403
+from .linalg import *        # noqa: F401,F403
+from .reduce import *        # noqa: F401,F403
+from .transform import *     # noqa: F401,F403
+from .nn import *            # noqa: F401,F403
+from .losses import *        # noqa: F401,F403
+from .embedding import (embedding_lookup_op, sparse_embedding_lookup_op,
+                        scatter_add_op, reduce_indexedslices, IndexedSlices)

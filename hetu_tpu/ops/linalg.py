@@ -1,0 +1,51 @@
+"""Dense linear algebra ops — the MXU workhorses.
+
+Reference kernels: src/ops/MatrixMult.cu, BatchMatrixMult.cu, Linear.cu,
+Addmm.cu, Baddbmm.cu, MatrixDot.cu, Transpose.cu, Outer.cu (cublas calls).
+On TPU these all lower to MXU matmuls via lax.dot_general; bf16 inputs with
+f32 accumulation is the default precision policy (preferred_element_type).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .base import simple_op
+
+
+def _mm(a, b, trans_A=False, trans_B=False):
+    if trans_A:
+        a = a.T
+    if trans_B:
+        b = b.T
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+def _bmm(a, b, trans_A=False, trans_B=False):
+    if trans_A:
+        a = jnp.swapaxes(a, -1, -2)
+    if trans_B:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+matmul_op = simple_op(_mm, "matmul")
+batch_matmul_op = simple_op(_bmm, "batch_matmul")
+linear_op = simple_op(
+    lambda x, w, bias, trans_A=False, trans_B=False:
+        _mm(x, w, trans_A, trans_B) + bias,
+    "linear")
+addmm_op = simple_op(
+    lambda inp, a, b, alpha=1.0, beta=1.0: beta * inp + alpha * _mm(a, b),
+    "addmm")
+baddbmm_op = simple_op(
+    lambda inp, a, b, alpha=1.0, beta=1.0: beta * inp + alpha * _bmm(a, b),
+    "baddbmm")
+matrix_dot_op = simple_op(lambda a, b: jnp.sum(a * b), "matrix_dot")
+outer_op = simple_op(lambda a, b: jnp.outer(a, b), "outer")
+dot_op = simple_op(lambda a, b: jnp.dot(a, b), "dot")
+transpose_op = simple_op(
+    lambda a, perm=None: jnp.transpose(a, axes=perm), "transpose")
+norm_op = simple_op(
+    lambda a, axis=None, p=2: jnp.linalg.norm(a, ord=p, axis=axis), "norm")

@@ -1,0 +1,90 @@
+"""Elementwise math ops.
+
+Parity with reference gpu_ops elementwise set (AddElewise, AddByConst,
+MinusElewise, MultiplyElewise, Division, Opposite, Sqrt, ReciprocalSqrt, Exp,
+Log, Pow, Abs, Sigmoid, Tanh, Relu, LeakyRelu, Gelu, Clamp, Sign, Floor,
+Ceil, Minus/Minimum/Maximum, Where, Triu/Tril, Sin, Cos, Bool ops, ...) —
+each a fused-by-XLA jnp expression rather than a CUDA kernel
+(/root/reference/src/ops/*.cu).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import simple_op
+
+add_op = simple_op(lambda a, b: a + b, "add")
+sub_op = simple_op(lambda a, b: a - b, "minus")
+mul_op = simple_op(lambda a, b: a * b, "multiply")
+div_op = simple_op(lambda a, b: a / b, "divide")
+_addbyconst = simple_op(lambda a, const=0.0: a + const, "add_byconst")
+_mulbyconst = simple_op(lambda a, const=1.0: a * const, "mul_byconst")
+_divconst = simple_op(lambda a, const=1.0: const / a, "div_const")
+
+
+def addbyconst_op(node, const=0.0, name=None):
+    return _addbyconst(node, const=const, name=name)
+
+
+def mulbyconst_op(node, const=1.0, name=None):
+    return _mulbyconst(node, const=const, name=name)
+
+
+def div_const_op(const, node, name=None):
+    return _divconst(node, const=const, name=name)
+opposite_op = simple_op(lambda a: -a, "opposite")
+sqrt_op = simple_op(jnp.sqrt, "sqrt")
+rsqrt_op = simple_op(lambda a: jax.lax.rsqrt(a), "rsqrt")
+exp_op = simple_op(jnp.exp, "exp")
+log_op = simple_op(jnp.log, "log")
+pow_op = simple_op(lambda a, exponent: jnp.power(a, exponent), "pow")
+abs_op = simple_op(jnp.abs, "abs")
+sign_op = simple_op(jnp.sign, "sign")
+floor_op = simple_op(jnp.floor, "floor")
+ceil_op = simple_op(jnp.ceil, "ceil")
+sin_op = simple_op(jnp.sin, "sin")
+cos_op = simple_op(jnp.cos, "cos")
+tanh_op = simple_op(jnp.tanh, "tanh")
+sigmoid_op = simple_op(jax.nn.sigmoid, "sigmoid")
+relu_op = simple_op(jax.nn.relu, "relu")
+leaky_relu_op = simple_op(
+    lambda a, alpha=0.01: jax.nn.leaky_relu(a, negative_slope=alpha),
+    "leaky_relu")
+gelu_op = simple_op(lambda a, approximate=True: jax.nn.gelu(a, approximate=approximate),
+                    "gelu")
+silu_op = simple_op(jax.nn.silu, "silu")
+softplus_op = simple_op(jax.nn.softplus, "softplus")
+elu_op = simple_op(lambda a, alpha=1.0: jax.nn.elu(a, alpha=alpha), "elu")
+reciprocal_op = simple_op(lambda a: 1.0 / a, "reciprocal")
+clamp_op = simple_op(lambda a, min=None, max=None: jnp.clip(a, min, max),
+                     "clamp")
+minimum_op = simple_op(jnp.minimum, "minimum")
+maximum_op = simple_op(jnp.maximum, "maximum")
+fmod_op = simple_op(jnp.fmod, "fmod")
+where_op = simple_op(lambda c, a, b: jnp.where(c, a, b), "where")
+where_const_op = simple_op(lambda c, a, const: jnp.where(c, a, const),
+                           "where_const")
+triu_op = simple_op(lambda a, diagonal=0: jnp.triu(a, k=diagonal), "triu")
+tril_op = simple_op(lambda a, diagonal=0: jnp.tril(a, k=diagonal), "tril")
+tril_lookup_op = simple_op(
+    lambda a, offset=0: jnp.tril(a, k=offset), "tril_lookup")
+cumsum_op = simple_op(lambda a, dim=0: jnp.cumsum(a, axis=dim), "cumsum")
+
+# comparison / bool
+equal_op = simple_op(lambda a, b: (a == b).astype(a.dtype), "bool_eq")
+not_equal_op = simple_op(lambda a, b: (a != b).astype(a.dtype), "bool_ne")
+greater_op = simple_op(lambda a, b: (a > b).astype(a.dtype), "bool_gt")
+less_op = simple_op(lambda a, b: (a < b).astype(a.dtype), "bool_lt")
+greater_equal_op = simple_op(lambda a, b: (a >= b).astype(a.dtype), "bool_ge")
+less_equal_op = simple_op(lambda a, b: (a <= b).astype(a.dtype), "bool_le")
+bool_op = simple_op(lambda a: (a != 0).astype(a.dtype), "bool")
+logical_not_op = simple_op(lambda a: (a == 0).astype(a.dtype), "logical_not")
+
+ns_like_set_op = simple_op(
+    lambda a, scalar=0.0: jnp.full_like(a, scalar), "full_like")
+zeroslike_op = simple_op(jnp.zeros_like, "zeros_like")
+oneslike_op = simple_op(jnp.ones_like, "ones_like")
+
+cast_op = simple_op(lambda a, dtype=jnp.float32: a.astype(dtype), "cast")

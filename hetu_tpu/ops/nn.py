@@ -1,0 +1,197 @@
+"""NN ops: conv/pool/norm/dropout/softmax.
+
+Reference kernels: src/ops/CudnnConv2d*.cu, MaxPool.cu, AvgPool.cu,
+CudnnBn.cu, LayerNorm.cu, InstanceNorm2d.cu, Dropout.cu, Softmax.cu,
+CudnnSoftmax.cu.  Layouts follow the reference (NCHW, OIHW) for API parity;
+XLA re-layouts internally for the MXU so no transposes are exposed.
+Dropout uses counter-based per-op RNG (TraceContext.rng_for) so the autodiff
+re-trace replays identical masks — the TPU analogue of the reference's
+seed+seqnum scheme (python/hetu/random.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..graph.node import Op, VariableOp
+from .base import simple_op, SimpleOp
+from .. import initializers as init
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv2d(x, w, padding=0, stride=1, dilation=1, groups=1):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilation)
+    return lax.conv_general_dilated(
+        x, w, window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dh, dw), feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+conv2d_op = simple_op(_conv2d, "conv2d")
+conv2d_add_bias_op = simple_op(
+    lambda x, w, b, padding=0, stride=1, dilation=1, groups=1:
+        _conv2d(x, w, padding, stride, dilation, groups)
+        + b.reshape(1, -1, 1, 1),
+    "conv2d_add_bias")
+
+
+def _conv2d_transpose(x, w, padding=0, stride=1):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    return lax.conv_transpose(
+        x, w, strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+        dimension_numbers=("NCHW", "IOHW", "NCHW"))
+
+
+conv2d_transpose_op = simple_op(_conv2d_transpose, "conv2d_transpose")
+
+
+def _pool(x, kernel_H, kernel_W, padding=0, stride=1, mode="max"):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    window = (1, 1, kernel_H, kernel_W)
+    strides = (1, 1, sh, sw)
+    pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    if mode == "max":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, neg, lax.max, window, strides, pads)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    # count_include_pad=True matches the reference AvgPool.cu
+    return s / (kernel_H * kernel_W)
+
+
+max_pool2d_op = simple_op(
+    lambda x, kernel_H=2, kernel_W=2, padding=0, stride=2:
+        _pool(x, kernel_H, kernel_W, padding, stride, "max"),
+    "max_pool2d")
+avg_pool2d_op = simple_op(
+    lambda x, kernel_H=2, kernel_W=2, padding=0, stride=2:
+        _pool(x, kernel_H, kernel_W, padding, stride, "avg"),
+    "avg_pool2d")
+global_avg_pool2d_op = simple_op(
+    lambda x: jnp.mean(x, axis=(2, 3)), "global_avg_pool2d")
+
+softmax_op = simple_op(
+    lambda x, dim=-1: jax.nn.softmax(x, axis=dim), "softmax")
+log_softmax_op = simple_op(
+    lambda x, dim=-1: jax.nn.log_softmax(x, axis=dim), "log_softmax")
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * scale + bias
+
+
+layer_normalization_op = simple_op(_layer_norm, "layer_normalization")
+
+
+def _rms_norm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * lax.rsqrt(var + eps) * scale
+
+
+rms_norm_op = simple_op(_rms_norm, "rms_norm")
+
+
+def _instance_norm2d(x, eps=1e-7):
+    mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+    var = jnp.var(x, axis=(2, 3), keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps)
+
+
+instance_normalization2d_op = simple_op(_instance_norm2d, "instance_norm2d")
+
+
+class BatchNormOp(Op):
+    """BatchNorm with running-stat state (reference CudnnBn.cu keeps
+    running mean/var on the op; here they are non-trainable Variables updated
+    through the trace context)."""
+
+    def __init__(self, x, scale, bias, momentum=0.1, eps=1e-5, name=None):
+        base = name or f"bn_{scale.name}"
+        c = scale.shape[0] if isinstance(scale, VariableOp) else None
+        assert c is not None, "BatchNorm scale must be a Variable"
+        self.running_mean = VariableOp(base + "_running_mean", (c,),
+                                       init.zeros(), trainable=False)
+        self.running_var = VariableOp(base + "_running_var", (c,),
+                                      init.ones(), trainable=False)
+        super().__init__(x, scale, bias, self.running_mean, self.running_var,
+                         name=base)
+        self.momentum = momentum
+        self.eps = eps
+
+    @property
+    def is_stateful(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        x, scale, bias, rmean, rvar = input_vals
+        scale = scale.reshape(1, -1, 1, 1)
+        bias = bias.reshape(1, -1, 1, 1)
+        if ctx.training:
+            mean = jnp.mean(x, axis=(0, 2, 3))
+            var = jnp.var(x, axis=(0, 2, 3))
+            m = self.momentum
+            ctx.record_update(self.running_mean, (1 - m) * rmean + m * mean)
+            ctx.record_update(self.running_var, (1 - m) * rvar + m * var)
+        else:
+            mean, var = rmean, rvar
+        mean = mean.reshape(1, -1, 1, 1)
+        var = var.reshape(1, -1, 1, 1)
+        # stop_gradient on batch stats is NOT applied: gradients flow through
+        # mean/var exactly as in cudnnBatchNormalizationBackward.
+        return (x - mean) * lax.rsqrt(var + self.eps) * scale + bias
+
+
+def batch_normalization_op(x, scale, bias, momentum=0.1, eps=1e-5, name=None):
+    return BatchNormOp(x, scale, bias, momentum=momentum, eps=eps, name=name)
+
+
+class DropoutOp(Op):
+    """Inverted dropout (reference Dropout.cu / CudnnDropout)."""
+
+    def __init__(self, x, keep_prob=0.9, name=None):
+        super().__init__(x, name=name)
+        self.keep_prob = keep_prob
+
+    @property
+    def needs_rng(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        (x,) = input_vals
+        if not ctx.training or self.keep_prob >= 1.0:
+            return x
+        mask = jax.random.bernoulli(ctx.rng_for(self), self.keep_prob,
+                                    x.shape)
+        return jnp.where(mask, x / self.keep_prob, 0.0).astype(x.dtype)
+
+
+def dropout_op(x, keep_prob=0.9, name=None):
+    return DropoutOp(x, keep_prob=keep_prob, name=name)
+
+
+def dropout2d_op(x, keep_prob=0.9, name=None):
+    """Channel-wise dropout (reference Dropout2d.cu)."""
+
+    class Dropout2dOp(DropoutOp):
+        def _compute(self, input_vals, ctx):
+            (x,) = input_vals
+            if not ctx.training or self.keep_prob >= 1.0:
+                return x
+            mask = jax.random.bernoulli(
+                ctx.rng_for(self), self.keep_prob, x.shape[:2])
+            mask = mask.reshape(x.shape[0], x.shape[1], 1, 1)
+            return jnp.where(mask, x / self.keep_prob, 0.0).astype(x.dtype)
+
+    return Dropout2dOp(x, keep_prob=keep_prob, name=name)

@@ -1,0 +1,57 @@
+"""Reduction ops (reference: src/ops/ReduceSum.cu, ReduceMean.cu,
+ReduceGeneral.cu, ReduceMin.cu, ReduceMul.cu, ReduceNorm1/2.cu, MaxOp/MinOp,
+Argmax.cu, Argmin.cu, ArgmaxPartial.cu)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import simple_op
+
+
+def _axes(axes):
+    if axes is None:
+        return None
+    if isinstance(axes, int):
+        return (axes,)
+    return tuple(axes)
+
+
+reduce_sum_op = simple_op(
+    lambda a, axes=None, keepdims=False: jnp.sum(a, axis=_axes(axes),
+                                                 keepdims=keepdims),
+    "reduce_sum")
+reduce_mean_op = simple_op(
+    lambda a, axes=None, keepdims=False: jnp.mean(a, axis=_axes(axes),
+                                                  keepdims=keepdims),
+    "reduce_mean")
+reduce_max_op = simple_op(
+    lambda a, axes=None, keepdims=False: jnp.max(a, axis=_axes(axes),
+                                                 keepdims=keepdims),
+    "reduce_max")
+reduce_min_op = simple_op(
+    lambda a, axes=None, keepdims=False: jnp.min(a, axis=_axes(axes),
+                                                 keepdims=keepdims),
+    "reduce_min")
+reduce_mul_op = simple_op(
+    lambda a, axes=None, keepdims=False: jnp.prod(a, axis=_axes(axes),
+                                                  keepdims=keepdims),
+    "reduce_mul")
+reduce_norm1_op = simple_op(
+    lambda a, axes=None, keepdims=False: jnp.sum(jnp.abs(a), axis=_axes(axes),
+                                                 keepdims=keepdims),
+    "reduce_norm1")
+reduce_norm2_op = simple_op(
+    lambda a, axes=None, keepdims=False: jnp.sqrt(
+        jnp.sum(jnp.square(a), axis=_axes(axes), keepdims=keepdims)),
+    "reduce_norm2")
+argmax_op = simple_op(
+    lambda a, dim=-1, keepdims=False: jnp.argmax(a, axis=dim,
+                                                 keepdims=keepdims),
+    "argmax")
+argmin_op = simple_op(
+    lambda a, dim=-1, keepdims=False: jnp.argmin(a, axis=dim,
+                                                 keepdims=keepdims),
+    "argmin")
+max_op = simple_op(lambda a, dim=-1: jnp.max(a, axis=dim), "max")
+min_op = simple_op(lambda a, dim=-1: jnp.min(a, axis=dim), "min")

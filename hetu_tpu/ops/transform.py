@@ -1,0 +1,96 @@
+"""Shape/layout transforms (reference: Reshape.cu, BroadcastTo/BroadcastShape,
+Concat/Concatenate.cu, Split/Slice.cu, Pad.cu, OneHot.cu, Gather.cu, Tile,
+Repeat.cu, Roll.cu, Flip? (no), Interpolate.cu, MaskedFill.cu, Arange,
+SliceAssign, DynamicStitch-style ops)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import simple_op
+
+array_reshape_op = simple_op(
+    lambda a, output_shape=None: jnp.reshape(a, output_shape), "array_reshape")
+reshape_op = array_reshape_op
+flatten_op = simple_op(lambda a: jnp.reshape(a, (a.shape[0], -1)), "flatten")
+broadcastto_op = simple_op(
+    lambda a, b: jnp.broadcast_to(a, b.shape), "broadcastto")
+broadcast_shape_op = simple_op(
+    lambda a, shape=None, add_axes=None:
+        jnp.broadcast_to(
+            jnp.expand_dims(a, tuple(add_axes)) if add_axes else a, shape),
+    "broadcast_shape")
+concat_op = simple_op(
+    lambda a, b, axis=0: jnp.concatenate([a, b], axis=axis), "concat")
+
+
+def concatenate_op(nodes, axis=0, name=None):
+    from .base import SimpleOp
+    return SimpleOp(
+        lambda *vals, axis=0: jnp.concatenate(vals, axis=axis),
+        "concatenate", *nodes, name=name, axis=axis)
+
+
+def _slice(a, begin_pos=None, output_shape=None):
+    idx = tuple(slice(b, b + s) for b, s in zip(begin_pos, output_shape))
+    return a[idx]
+
+
+slice_op = simple_op(_slice, "slice")
+
+
+def _split(a, axes=None, indices=None, splits=None):
+    """Take the ``indices``-th of ``splits`` chunks along ``axes``
+    (reference Split.cu semantics used for model parallelism)."""
+    if isinstance(axes, int):
+        axes, indices, splits = [axes], [indices], [splits]
+    for ax, ind, spl in zip(axes, indices, splits):
+        size = a.shape[ax] // spl
+        a = jax.lax.slice_in_dim(a, ind * size, (ind + 1) * size, axis=ax)
+    return a
+
+
+split_op = simple_op(_split, "split")
+pad_op = simple_op(
+    lambda a, paddings=None, mode="constant", constant_values=0:
+        jnp.pad(a, paddings, mode=mode, constant_values=constant_values)
+        if mode == "constant" else jnp.pad(a, paddings, mode=mode),
+    "pad")
+one_hot_op = simple_op(
+    lambda a, num_classes=None: jax.nn.one_hot(a.astype(jnp.int32),
+                                               num_classes, dtype=jnp.float32),
+    "one_hot")
+gather_op = simple_op(
+    lambda a, idx, dim=0: jnp.take_along_axis(
+        a, idx.astype(jnp.int32), axis=dim),
+    "gather")
+tile_op = simple_op(lambda a, reps=None: jnp.tile(a, reps), "tile")
+repeat_op = simple_op(
+    lambda a, repeats=None, dim=None: jnp.repeat(a, repeats, axis=dim),
+    "repeat")
+roll_op = simple_op(
+    lambda a, shift=None, axis=None: jnp.roll(a, shift, axis=axis), "roll")
+expand_dims_op = simple_op(
+    lambda a, axis=0: jnp.expand_dims(a, axis), "expand_dims")
+unsqueeze_op = expand_dims_op
+squeeze_op = simple_op(lambda a, axis=None: jnp.squeeze(a, axis), "squeeze")
+masked_fill_op = simple_op(
+    lambda a, mask, val=0.0: jnp.where(mask != 0, val, a), "masked_fill")
+interpolate_op = simple_op(
+    lambda a, scale_factor=2, mode="bilinear": jax.image.resize(
+        a, (a.shape[0], a.shape[1],
+            int(a.shape[2] * scale_factor), int(a.shape[3] * scale_factor)),
+        method="bilinear" if mode == "bilinear" else "nearest"),
+    "interpolate")
+slice_assign_op = simple_op(
+    lambda a, b, begin_pos=None: jax.lax.dynamic_update_slice(
+        a, b, tuple(begin_pos)),
+    "slice_assign")
+
+
+def _slice_by_matrix(a, idx0, idx1):
+    return a[idx0.astype(jnp.int32), idx1.astype(jnp.int32)]
+
+
+slice_by_matrix_op = simple_op(_slice_by_matrix, "slice_by_matrix")

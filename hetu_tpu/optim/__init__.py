@@ -1,0 +1,7 @@
+from .optimizer import (Optimizer, OptimizerOp, SGDOptimizer,
+                        MomentumOptimizer, AdaGradOptimizer, AdamOptimizer,
+                        AdamWOptimizer, AMSGradOptimizer, LambOptimizer)
+from .lr_scheduler import (LRScheduler, FixedScheduler, StepScheduler,
+                           MultiStepScheduler, ExponentialScheduler,
+                           CosineScheduler, LinearWarmupScheduler,
+                           as_schedule)

@@ -1,0 +1,99 @@
+"""Learning-rate schedules (reference: /root/reference/python/hetu/lr_scheduler.py).
+
+Schedules are pure functions of the (traced) step counter so they live inside
+the jitted training step — no host round-trip per step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def get(self, step):
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.get(step)
+
+
+class FixedScheduler(LRScheduler):
+    def __init__(self, learning_rate):
+        self.learning_rate = learning_rate
+
+    def get(self, step):
+        return jnp.asarray(self.learning_rate, dtype=jnp.float32)
+
+
+class StepScheduler(LRScheduler):
+    """lr * gamma^(step // step_size)."""
+
+    def __init__(self, learning_rate, step_size, gamma=0.1):
+        assert step_size > 0
+        self.learning_rate = learning_rate
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def get(self, step):
+        e = (step // self.step_size).astype(jnp.float32)
+        return self.learning_rate * jnp.power(self.gamma, e)
+
+
+class MultiStepScheduler(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1):
+        self.learning_rate = learning_rate
+        self.milestones = tuple(sorted(milestones))
+        self.gamma = gamma
+
+    def get(self, step):
+        ms = jnp.asarray(self.milestones)
+        n = jnp.sum(step >= ms).astype(jnp.float32)
+        return self.learning_rate * jnp.power(self.gamma, n)
+
+
+class ExponentialScheduler(LRScheduler):
+    def __init__(self, learning_rate, gamma=0.99):
+        self.learning_rate = learning_rate
+        self.gamma = gamma
+
+    def get(self, step):
+        return self.learning_rate * jnp.power(self.gamma, step.astype(jnp.float32))
+
+
+class CosineScheduler(LRScheduler):
+    def __init__(self, learning_rate, total_steps, min_lr=0.0, warmup_steps=0):
+        self.learning_rate = learning_rate
+        self.total_steps = total_steps
+        self.min_lr = min_lr
+        self.warmup_steps = warmup_steps
+
+    def get(self, step):
+        s = step.astype(jnp.float32)
+        warm = self.learning_rate * s / max(self.warmup_steps, 1)
+        t = jnp.clip((s - self.warmup_steps)
+                     / max(self.total_steps - self.warmup_steps, 1), 0.0, 1.0)
+        cos = self.min_lr + 0.5 * (self.learning_rate - self.min_lr) \
+            * (1.0 + jnp.cos(jnp.pi * t))
+        return jnp.where(s < self.warmup_steps, warm, cos)
+
+
+class LinearWarmupScheduler(LRScheduler):
+    """Linear warmup then linear decay to zero (BERT-style)."""
+
+    def __init__(self, learning_rate, warmup_steps, total_steps):
+        self.learning_rate = learning_rate
+        self.warmup_steps = warmup_steps
+        self.total_steps = total_steps
+
+    def get(self, step):
+        s = step.astype(jnp.float32)
+        warm = s / max(self.warmup_steps, 1)
+        decay = jnp.clip((self.total_steps - s)
+                         / max(self.total_steps - self.warmup_steps, 1), 0.0, 1.0)
+        return self.learning_rate * jnp.where(s < self.warmup_steps, warm, decay)
+
+
+def as_schedule(lr):
+    if isinstance(lr, LRScheduler):
+        return lr
+    return FixedScheduler(lr)

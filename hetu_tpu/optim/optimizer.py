@@ -1,0 +1,236 @@
+"""Optimizers as graph ops.
+
+Reference: /root/reference/python/hetu/optimizer.py — `Optimizer.minimize`
+builds gradient nodes and an `OptimizerOp` whose compute applies fused CUDA
+updates (src/ops/Optimizers.cu).  Here the update math is plain jnp inside the
+traced step, fused by XLA into the backward program; parameters are threaded
+functionally (old value in, new value out) with buffer donation, which is the
+TPU analogue of the reference's in-place kernels.
+
+Sparse (IndexedSlices) updates: the reference keeps sparse-aware op pairs for
+embedding grads.  Under XLA, gradient-of-gather is already a scatter-add that
+never densifies the embedding table update path when wrapped in
+``apply_sparse`` (segment-sum on unique ids); the ps/ subsystem additionally
+hosts server-side optimizer states for PS-mode tables.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.node import Op, VariableOp
+from ..graph.autodiff import gradients
+from .lr_scheduler import as_schedule
+
+
+class Optimizer:
+    """Base optimizer: subclasses define slot init + dense update rule."""
+
+    slot_names = ()
+
+    def __init__(self, learning_rate=0.01, l2reg=0.0):
+        self.lr = as_schedule(learning_rate)
+        self.l2reg = l2reg
+
+    # -- functional update rule -------------------------------------------
+    def init_slots(self, param):
+        return {name: jnp.zeros_like(param) for name in self.slot_names}
+
+    def apply_dense(self, param, grad, slots, lr, step):
+        raise NotImplementedError
+
+    def _regularized(self, param, grad):
+        if self.l2reg > 0.0:
+            return grad + self.l2reg * param
+        return grad
+
+    # -- graph construction ------------------------------------------------
+    def minimize(self, loss, var_list=None):
+        from ..graph.node import graph_variables
+        if var_list is None:
+            var_list = graph_variables([loss], trainable_only=True)
+        grads = gradients(loss, var_list)
+        return OptimizerOp(grads, var_list, self)
+
+    def apply_gradients(self, grads_and_vars):
+        grads, var_list = zip(*grads_and_vars)
+        return OptimizerOp(list(grads), list(var_list), self)
+
+
+class SGDOptimizer(Optimizer):
+    def apply_dense(self, param, grad, slots, lr, step):
+        grad = self._regularized(param, grad)
+        return param - lr * grad, slots
+
+
+class MomentumOptimizer(Optimizer):
+    slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, nesterov=False,
+                 l2reg=0.0):
+        super().__init__(learning_rate, l2reg)
+        self.momentum = momentum
+        self.nesterov = nesterov
+
+    def apply_dense(self, param, grad, slots, lr, step):
+        grad = self._regularized(param, grad)
+        v = self.momentum * slots["velocity"] - lr * grad
+        if self.nesterov:
+            new_param = param + self.momentum * v - lr * grad
+        else:
+            new_param = param + v
+        return new_param, {"velocity": v}
+
+
+class AdaGradOptimizer(Optimizer):
+    slot_names = ("accum",)
+
+    def __init__(self, learning_rate=0.01, initial_accumulator_value=0.0,
+                 eps=1e-7, l2reg=0.0):
+        super().__init__(learning_rate, l2reg)
+        self.initial_accumulator_value = initial_accumulator_value
+        self.eps = eps
+
+    def init_slots(self, param):
+        return {"accum": jnp.full_like(param, self.initial_accumulator_value)}
+
+    def apply_dense(self, param, grad, slots, lr, step):
+        grad = self._regularized(param, grad)
+        acc = slots["accum"] + grad * grad
+        new_param = param - lr * grad / (jnp.sqrt(acc) + self.eps)
+        return new_param, {"accum": acc}
+
+
+class AdamOptimizer(Optimizer):
+    slot_names = ("m", "v")
+
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999, eps=1e-7,
+                 amsgrad=False, l2reg=0.0):
+        super().__init__(learning_rate, l2reg)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.amsgrad = amsgrad
+
+    def init_slots(self, param):
+        slots = {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+        if self.amsgrad:
+            slots["vhat"] = jnp.zeros_like(param)
+        return slots
+
+    def _moments(self, grad, slots, step):
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * slots["m"] + (1.0 - self.beta1) * grad
+        v = self.beta2 * slots["v"] + (1.0 - self.beta2) * grad * grad
+        # bias correction from the step counter replaces the reference's
+        # BetatsUpdateOp running-product state (optimizer.py:434).
+        mhat = m / (1.0 - jnp.power(self.beta1, t))
+        vhat = v / (1.0 - jnp.power(self.beta2, t))
+        return m, v, mhat, vhat
+
+    def apply_dense(self, param, grad, slots, lr, step):
+        grad = self._regularized(param, grad)
+        m, v, mhat, vhat = self._moments(grad, slots, step)
+        new_slots = {"m": m, "v": v}
+        if self.amsgrad:
+            vmax = jnp.maximum(slots["vhat"], vhat)
+            new_slots["vhat"] = vmax
+            denom = jnp.sqrt(vmax) + self.eps
+        else:
+            denom = jnp.sqrt(vhat) + self.eps
+        return param - lr * mhat / denom, new_slots
+
+
+class AMSGradOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999, eps=1e-7,
+                 l2reg=0.0):
+        super().__init__(learning_rate, beta1, beta2, eps, amsgrad=True,
+                         l2reg=l2reg)
+
+
+class AdamWOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999, eps=1e-7,
+                 weight_decay=0.01):
+        super().__init__(learning_rate, beta1, beta2, eps)
+        self.weight_decay = weight_decay
+
+    def apply_dense(self, param, grad, slots, lr, step):
+        m, v, mhat, vhat = self._moments(grad, slots, step)
+        update = mhat / (jnp.sqrt(vhat) + self.eps) + self.weight_decay * param
+        return param - lr * update, {"m": m, "v": v}
+
+
+class LambOptimizer(AdamOptimizer):
+    """Layer-wise adaptive moments (reference optimizer.py:686)."""
+
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999, eps=1e-6,
+                 weight_decay=0.0):
+        super().__init__(learning_rate, beta1, beta2, eps)
+        self.weight_decay = weight_decay
+
+    def apply_dense(self, param, grad, slots, lr, step):
+        m, v, mhat, vhat = self._moments(grad, slots, step)
+        update = mhat / (jnp.sqrt(vhat) + self.eps) + self.weight_decay * param
+        w_norm = jnp.linalg.norm(param.reshape(-1))
+        u_norm = jnp.linalg.norm(update.reshape(-1))
+        trust = jnp.where(w_norm > 0,
+                          jnp.where(u_norm > 0, w_norm / u_norm, 1.0), 1.0)
+        return param - lr * trust * update, {"m": m, "v": v}
+
+
+class OptimizerOp(Op):
+    """Graph node applying the optimizer to (grad, var) pairs.
+
+    Evaluated with env access: reads current parameter values bound in the
+    trace env, reads/writes optimizer slot state via the TraceContext, records
+    new parameter values for the executor to thread out.  Evaluates to None
+    (matching reference train_op semantics).
+    """
+
+    def __init__(self, grads, var_list, optimizer, clip_global_norm=None):
+        assert len(grads) == len(var_list)
+        super().__init__(*grads, name=f"optimizer_{_opt_count()}")
+        self.var_list = list(var_list)
+        self.optimizer = optimizer
+        self.clip_global_norm = clip_global_norm
+        for v in var_list:
+            assert isinstance(v, VariableOp), f"cannot optimize {v}"
+
+    @property
+    def is_stateful(self):
+        return True
+
+    def init_state(self, params):
+        """Initial optimizer state given {var_name: value}."""
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "slots": {v.name: self.optimizer.init_slots(params[v.name])
+                      for v in self.var_list},
+        }
+
+    def _compute_with_env(self, env, ctx):
+        state = ctx.opt_state[self.name]
+        step = state["step"]
+        lr = self.optimizer.lr.get(step)
+        grads = [env[g] for g in self.inputs]
+        if self.clip_global_norm is not None:
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+            scale = jnp.minimum(1.0, self.clip_global_norm / (gnorm + 1e-6))
+            grads = [g * scale for g in grads]
+        new_slots = {}
+        for var, grad in zip(self.var_list, grads):
+            param = env[var]
+            grad = grad.astype(param.dtype)
+            new_p, ns = self.optimizer.apply_dense(
+                param, grad, state["slots"][var.name], lr, step)
+            new_slots[var.name] = ns
+            ctx.record_update(var, new_p)
+        ctx.new_opt_state[self.name] = {"step": step + 1, "slots": new_slots}
+        return None
+
+
+_opt_counter = [0]
+
+
+def _opt_count():
+    _opt_counter[0] += 1
+    return _opt_counter[0]

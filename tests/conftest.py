@@ -1,0 +1,28 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's testing approach (SURVEY.md §4): multi-node is
+simulated locally — the reference used `mpirun -np N` on one host; we use
+XLA's host-platform device partitioning, which exercises the same SPMD
+programs/collectives that run over ICI on real TPU pods.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# jax may have been pre-imported by the environment (sitecustomize registering
+# a TPU backend) before this conftest ran; force the CPU platform via config,
+# which takes effect as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,82 @@
+"""Autodiff tests (reference: tests/test_gpu_op.py gradient checks +
+executor.py gradients())."""
+
+import numpy as np
+
+import hetu_tpu as ht
+
+
+def test_gradients_matmul():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((4, 6)).astype(np.float32)
+    Wv = rng.standard_normal((6, 3)).astype(np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    w = ht.Variable("w", value=Wv)
+    y = ht.matmul_op(x, w)
+    loss = ht.reduce_sum_op(y)
+    (gw,) = ht.gradients(loss, [w])
+    ex = ht.Executor([loss, gw])
+    lv, gv = ex.run(feed_dict={x: X}, convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(lv, (X @ Wv).sum(), rtol=1e-5)
+    np.testing.assert_allclose(gv, X.T @ np.ones((4, 3), np.float32),
+                               rtol=1e-5)
+
+
+def test_gradients_chain_vs_torch():
+    import torch
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((5, 8)).astype(np.float32)
+    W1 = rng.standard_normal((8, 16)).astype(np.float32)
+    W2 = rng.standard_normal((16, 4)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(5,))
+
+    x = ht.placeholder_op("x", X.shape)
+    w1 = ht.Variable("w1", value=W1)
+    w2 = ht.Variable("w2", value=W2)
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    logits = ht.matmul_op(h, w2)
+    lab = ht.placeholder_op("lab", labels.shape, dtype=np.int32)
+    loss = ht.reduce_mean_op(ht.softmax_cross_entropy_sparse_op(logits, lab))
+    g1, g2 = ht.gradients(loss, [w1, w2])
+    ex = ht.Executor([loss, g1, g2])
+    lv, gv1, gv2 = ex.run(feed_dict={x: X, lab: labels},
+                          convert_to_numpy_ret_vals=True)
+
+    tx = torch.from_numpy(X)
+    tw1 = torch.from_numpy(W1).requires_grad_()
+    tw2 = torch.from_numpy(W2).requires_grad_()
+    tl = torch.nn.functional.cross_entropy(
+        torch.relu(tx @ tw1) @ tw2, torch.from_numpy(labels))
+    tl.backward()
+    np.testing.assert_allclose(lv, tl.item(), rtol=1e-5)
+    np.testing.assert_allclose(gv1, tw1.grad.numpy(), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gv2, tw2.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_gradient_of_intermediate_node():
+    # gradients w.r.t. an activation (pipeline stage boundary case)
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((3, 4)).astype(np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    h = ht.mulbyconst_op(x, 3.0)
+    loss = ht.reduce_sum_op(ht.mul_op(h, h))
+    (gh,) = ht.gradients(loss, [h])
+    ex = ht.Executor([gh])
+    (gv,) = ex.run(feed_dict={x: X}, convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(gv, 2 * 3.0 * X, rtol=1e-5)
+
+
+def test_dropout_grad_mask_consistency():
+    # grad must use the same dropout mask as forward (RNG replay)
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((64, 64)).astype(np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    w = ht.Variable("w", value=np.ones((64, 64), np.float32))
+    h = ht.dropout_op(ht.matmul_op(x, w), keep_prob=0.5)
+    loss = ht.reduce_sum_op(h)
+    (gw,) = ht.gradients(loss, [w])
+    ex = ht.Executor([h, gw, loss])
+    hv, gv, lv = ex.run(feed_dict={x: X}, convert_to_numpy_ret_vals=True)
+    # d loss/d w = X^T @ mask_scale; nonzero pattern of h determines mask
+    mask = (hv != 0).astype(np.float32) * 2.0
+    np.testing.assert_allclose(gv, X.T @ mask, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,176 @@
+"""Executor + optimizer integration (reference: tests/test_optimizer.py,
+mnist_mlp convergence pattern)."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.optim import lr_scheduler
+
+
+def _toy_problem(seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((64, 10)).astype(np.float32)
+    true_w = rng.standard_normal((10, 1)).astype(np.float32)
+    Y = X @ true_w + 0.01 * rng.standard_normal((64, 1)).astype(np.float32)
+    return X, Y
+
+
+@pytest.mark.parametrize("opt_cls,kwargs", [
+    (ht.SGDOptimizer, dict(learning_rate=0.1)),
+    (ht.MomentumOptimizer, dict(learning_rate=0.05)),
+    (ht.MomentumOptimizer, dict(learning_rate=0.05, nesterov=True)),
+    (ht.AdaGradOptimizer, dict(learning_rate=0.5)),
+    (ht.AdamOptimizer, dict(learning_rate=0.1)),
+    (ht.AdamWOptimizer, dict(learning_rate=0.1, weight_decay=0.001)),
+    (ht.AMSGradOptimizer, dict(learning_rate=0.1)),
+    (ht.LambOptimizer, dict(learning_rate=0.1)),
+])
+def test_optimizer_converges(opt_cls, kwargs):
+    X, Y = _toy_problem()
+    x = ht.placeholder_op("x", X.shape)
+    y_ = ht.placeholder_op("y", Y.shape)
+    w = ht.Variable("w", initializer=ht.init.zeros(), shape=(10, 1))
+    pred = ht.matmul_op(x, w)
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(
+        ht.pow_op(pred - y_, exponent=2.0), axes=1))
+    opt = opt_cls(**kwargs)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor([loss, train_op])
+    first = None
+    for i in range(200):
+        lv, _ = ex.run(feed_dict={x: X, y_: Y},
+                       convert_to_numpy_ret_vals=True)
+        if first is None:
+            first = lv
+    assert lv < first * 0.05, f"{opt_cls.__name__} failed: {first} -> {lv}"
+
+
+def test_optimizer_matches_torch_sgd_momentum():
+    import torch
+    X, Y = _toy_problem(1)
+    Wv = np.zeros((10, 1), np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    y_ = ht.placeholder_op("y", Y.shape)
+    w = ht.Variable("w", value=Wv.copy())
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(
+        ht.pow_op(ht.matmul_op(x, w) - y_, exponent=2.0), axes=1))
+    train_op = ht.MomentumOptimizer(learning_rate=0.01,
+                                    momentum=0.9).minimize(loss)
+    ex = ht.Executor([loss, train_op])
+
+    tw = torch.from_numpy(Wv.copy()).requires_grad_()
+    topt = torch.optim.SGD([tw], lr=0.01, momentum=0.9)
+    tx, ty = torch.from_numpy(X), torch.from_numpy(Y)
+    for _ in range(10):
+        ex.run(feed_dict={x: X, y_: Y})
+        topt.zero_grad()
+        tloss = ((tx @ tw - ty) ** 2).sum(1).mean()
+        tloss.backward()
+        topt.step()
+    np.testing.assert_allclose(np.asarray(ex.params["w"]),
+                               tw.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_adam_matches_torch():
+    import torch
+    X, Y = _toy_problem(2)
+    Wv = np.zeros((10, 1), np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    y_ = ht.placeholder_op("y", Y.shape)
+    w = ht.Variable("w", value=Wv.copy())
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(
+        ht.pow_op(ht.matmul_op(x, w) - y_, exponent=2.0), axes=1))
+    train_op = ht.AdamOptimizer(learning_rate=0.01, beta1=0.9, beta2=0.999,
+                                eps=1e-8).minimize(loss)
+    ex = ht.Executor([loss, train_op])
+    tw = torch.from_numpy(Wv.copy()).requires_grad_()
+    topt = torch.optim.Adam([tw], lr=0.01, betas=(0.9, 0.999), eps=1e-8)
+    tx, ty = torch.from_numpy(X), torch.from_numpy(Y)
+    for _ in range(10):
+        ex.run(feed_dict={x: X, y_: Y})
+        topt.zero_grad()
+        tloss = ((tx @ tw - ty) ** 2).sum(1).mean()
+        tloss.backward()
+        topt.step()
+    np.testing.assert_allclose(np.asarray(ex.params["w"]),
+                               tw.detach().numpy(), rtol=1e-3, atol=1e-5)
+
+
+def test_named_subgraphs_train_validate():
+    X, Y = _toy_problem(3)
+    x = ht.placeholder_op("x", X.shape)
+    y_ = ht.placeholder_op("y", Y.shape)
+    w = ht.Variable("w", initializer=ht.init.zeros(), shape=(10, 1))
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(
+        ht.pow_op(ht.matmul_op(x, w) - y_, exponent=2.0), axes=1))
+    train_op = ht.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op], "validate": [loss]})
+    l0 = ex.run("validate", feed_dict={x: X, y_: Y},
+                convert_to_numpy_ret_vals=True)[0]
+    for _ in range(50):
+        ex.run("train", feed_dict={x: X, y_: Y})
+    l1 = ex.run("validate", feed_dict={x: X, y_: Y},
+                convert_to_numpy_ret_vals=True)[0]
+    assert l1 < l0 * 0.1
+    # validate must not mutate params
+    p_before = np.asarray(ex.params["w"])
+    ex.run("validate", feed_dict={x: X, y_: Y})
+    np.testing.assert_array_equal(p_before, np.asarray(ex.params["w"]))
+
+
+def test_checkpoint_save_load(tmp_path):
+    X, Y = _toy_problem(4)
+    x = ht.placeholder_op("x", X.shape)
+    y_ = ht.placeholder_op("y", Y.shape)
+    w = ht.Variable("w", initializer=ht.init.xavier_normal(), shape=(10, 1))
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(
+        ht.pow_op(ht.matmul_op(x, w) - y_, exponent=2.0), axes=1))
+    train_op = ht.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    ex = ht.Executor([loss, train_op])
+    for _ in range(5):
+        ex.run(feed_dict={x: X, y_: Y})
+    path = tmp_path / "ckpt.pkl"
+    ex.save(str(path))
+    run1 = [ex.run(feed_dict={x: X, y_: Y},
+                   convert_to_numpy_ret_vals=True)[0] for _ in range(5)]
+
+    ex.load(str(path))
+    run2 = [ex.run(feed_dict={x: X, y_: Y},
+                   convert_to_numpy_ret_vals=True)[0] for _ in range(5)]
+    np.testing.assert_allclose(run1, run2, rtol=1e-6)
+
+
+def test_lr_scheduler_steps():
+    X, Y = _toy_problem(5)
+    x = ht.placeholder_op("x", X.shape)
+    y_ = ht.placeholder_op("y", Y.shape)
+    w = ht.Variable("w", initializer=ht.init.zeros(), shape=(10, 1))
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(
+        ht.pow_op(ht.matmul_op(x, w) - y_, exponent=2.0), axes=1))
+    sched = lr_scheduler.StepScheduler(0.1, step_size=10, gamma=0.5)
+    train_op = ht.SGDOptimizer(learning_rate=sched).minimize(loss)
+    ex = ht.Executor([loss, train_op])
+    for _ in range(30):
+        ex.run(feed_dict={x: X, y_: Y})
+    import jax.numpy as jnp
+    assert int(ex.opt_state[train_op.name]["step"]) == 30
+
+
+def test_batchnorm_state_updates():
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((8, 3, 4, 4)).astype(np.float32) * 2 + 1
+    x = ht.placeholder_op("x", X.shape)
+    scale = ht.Variable("bn_scale", value=np.ones(3, np.float32))
+    bias = ht.Variable("bn_bias", value=np.zeros(3, np.float32))
+    y = ht.batch_normalization_op(x, scale, bias)
+    loss = ht.reduce_mean_op(y)
+    train_op = ht.SGDOptimizer(learning_rate=0.0).minimize(loss)
+    ex = ht.Executor({"train": [y, train_op], "validate": [y]})
+    out_train = ex.run("train", feed_dict={x: X},
+                       convert_to_numpy_ret_vals=True)[0]
+    # training output is batch-normalized
+    np.testing.assert_allclose(out_train.mean(axis=(0, 2, 3)), 0.0, atol=1e-5)
+    rm = np.asarray(ex.params[y.running_mean.name])
+    assert np.abs(rm).sum() > 0  # running stats moved
+    np.testing.assert_allclose(rm, 0.1 * X.mean(axis=(0, 2, 3)), rtol=1e-4)

@@ -1,0 +1,218 @@
+"""Op golden tests vs numpy (reference: tests/test_gpu_op.py pattern —
+build graph, execute, assert_allclose against a numpy reference)."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+
+
+def run_graph(nodes, feeds=None):
+    ex = ht.Executor(nodes)
+    return ex.run(feed_dict=feeds or {}, convert_to_numpy_ret_vals=True)
+
+
+def feed2(shape_a=(3, 4), shape_b=(3, 4), rng=None):
+    rng = rng or np.random.default_rng(0)
+    a = ht.placeholder_op("a", shape_a)
+    b = ht.placeholder_op("b", shape_b)
+    va = rng.standard_normal(shape_a).astype(np.float32)
+    vb = rng.standard_normal(shape_b).astype(np.float32)
+    return a, b, va, vb
+
+
+def test_elementwise_binary(rng):
+    a, b, va, vb = feed2(rng=rng)
+    outs = run_graph([a + b, a - b, a * b, a / b,
+                      ht.minimum_op(a, b), ht.maximum_op(a, b)],
+                     {a: va, b: vb})
+    np.testing.assert_allclose(outs[0], va + vb, rtol=1e-6)
+    np.testing.assert_allclose(outs[1], va - vb, rtol=1e-6)
+    np.testing.assert_allclose(outs[2], va * vb, rtol=1e-6)
+    np.testing.assert_allclose(outs[3], va / vb, rtol=1e-5)
+    np.testing.assert_allclose(outs[4], np.minimum(va, vb))
+    np.testing.assert_allclose(outs[5], np.maximum(va, vb))
+
+
+def test_elementwise_unary(rng):
+    x = ht.placeholder_op("x", (5, 7))
+    vx = np.abs(rng.standard_normal((5, 7))).astype(np.float32) + 0.5
+    outs = run_graph(
+        [ht.sqrt_op(x), ht.exp_op(x), ht.log_op(x), ht.abs_op(x),
+         ht.sigmoid_op(x), ht.tanh_op(x), ht.relu_op(x),
+         ht.rsqrt_op(x), ht.opposite_op(x)],
+        {x: vx})
+    np.testing.assert_allclose(outs[0], np.sqrt(vx), rtol=1e-6)
+    np.testing.assert_allclose(outs[1], np.exp(vx), rtol=1e-6)
+    np.testing.assert_allclose(outs[2], np.log(vx), rtol=1e-6)
+    np.testing.assert_allclose(outs[3], np.abs(vx))
+    np.testing.assert_allclose(outs[4], 1 / (1 + np.exp(-vx)), rtol=1e-6)
+    np.testing.assert_allclose(outs[5], np.tanh(vx), rtol=1e-6)
+    np.testing.assert_allclose(outs[6], np.maximum(vx, 0))
+    np.testing.assert_allclose(outs[7], 1 / np.sqrt(vx), rtol=1e-5)
+    np.testing.assert_allclose(outs[8], -vx)
+
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_matmul(rng, ta, tb):
+    A = rng.standard_normal((5, 7)).astype(np.float32)
+    B = rng.standard_normal((7, 3)).astype(np.float32)
+    a = ht.placeholder_op("a", A.T.shape if ta else A.shape)
+    b = ht.placeholder_op("b", B.T.shape if tb else B.shape)
+    out = run_graph([ht.matmul_op(a, b, trans_A=ta, trans_B=tb)],
+                    {a: A.T if ta else A, b: B.T if tb else B})[0]
+    np.testing.assert_allclose(out, A @ B, rtol=1e-5)
+
+
+def test_batch_matmul(rng):
+    A = rng.standard_normal((2, 5, 7)).astype(np.float32)
+    B = rng.standard_normal((2, 7, 3)).astype(np.float32)
+    a, b = ht.placeholder_op("a", A.shape), ht.placeholder_op("b", B.shape)
+    out = run_graph([ht.batch_matmul_op(a, b)], {a: A, b: B})[0]
+    np.testing.assert_allclose(out, A @ B, rtol=1e-5)
+
+
+def test_linear_addmm(rng):
+    X = rng.standard_normal((4, 6)).astype(np.float32)
+    W = rng.standard_normal((6, 3)).astype(np.float32)
+    bias = rng.standard_normal((3,)).astype(np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    w = ht.placeholder_op("w", W.shape)
+    b = ht.placeholder_op("b", bias.shape)
+    out = run_graph([ht.linear_op(x, w, b)], {x: X, w: W, b: bias})[0]
+    np.testing.assert_allclose(out, X @ W + bias, rtol=1e-5)
+
+
+def test_reduce(rng):
+    X = rng.standard_normal((4, 6)).astype(np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    outs = run_graph(
+        [ht.reduce_sum_op(x, axes=1), ht.reduce_mean_op(x, axes=0),
+         ht.reduce_max_op(x), ht.reduce_min_op(x, axes=1, keepdims=True),
+         ht.reduce_norm2_op(x, axes=1), ht.argmax_op(x, dim=1)],
+        {x: X})
+    np.testing.assert_allclose(outs[0], X.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(outs[1], X.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(outs[2], X.max())
+    np.testing.assert_allclose(outs[3], X.min(1, keepdims=True))
+    np.testing.assert_allclose(outs[4], np.linalg.norm(X, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(outs[5], X.argmax(1))
+
+
+def test_transforms(rng):
+    X = rng.standard_normal((4, 6)).astype(np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    outs = run_graph(
+        [ht.array_reshape_op(x, output_shape=(2, 12)),
+         ht.transpose_op(x, perm=(1, 0)),
+         ht.slice_op(x, begin_pos=(1, 2), output_shape=(2, 3)),
+         ht.split_op(x, axes=1, indices=1, splits=2),
+         ht.concat_op(x, x, axis=0),
+         ht.pad_op(x, paddings=((1, 1), (0, 0))),
+         ht.tile_op(x, reps=(2, 1))],
+        {x: X})
+    np.testing.assert_allclose(outs[0], X.reshape(2, 12))
+    np.testing.assert_allclose(outs[1], X.T)
+    np.testing.assert_allclose(outs[2], X[1:3, 2:5])
+    np.testing.assert_allclose(outs[3], X[:, 3:])
+    np.testing.assert_allclose(outs[4], np.concatenate([X, X], 0))
+    np.testing.assert_allclose(outs[5], np.pad(X, ((1, 1), (0, 0))))
+    np.testing.assert_allclose(outs[6], np.tile(X, (2, 1)))
+
+
+def test_one_hot_gather(rng):
+    ids = rng.integers(0, 5, size=(6,))
+    x = ht.placeholder_op("ids", ids.shape, dtype=np.int32)
+    out = run_graph([ht.one_hot_op(x, num_classes=5)], {x: ids})[0]
+    expect = np.eye(5, dtype=np.float32)[ids]
+    np.testing.assert_allclose(out, expect)
+
+
+def test_conv2d_and_pool(rng):
+    import torch
+    import torch.nn.functional as F
+    X = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    W = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    w = ht.placeholder_op("w", W.shape)
+    outs = run_graph(
+        [ht.conv2d_op(x, w, padding=1, stride=1),
+         ht.max_pool2d_op(x, kernel_H=2, kernel_W=2, padding=0, stride=2),
+         ht.avg_pool2d_op(x, kernel_H=2, kernel_W=2, padding=0, stride=2)],
+        {x: X, w: W})
+    tx, tw = torch.from_numpy(X), torch.from_numpy(W)
+    np.testing.assert_allclose(outs[0], F.conv2d(tx, tw, padding=1).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs[1], F.max_pool2d(tx, 2).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(outs[2], F.avg_pool2d(tx, 2).numpy(), rtol=1e-6)
+
+
+def test_layer_norm_vs_torch(rng):
+    import torch
+    import torch.nn.functional as F
+    X = rng.standard_normal((4, 10)).astype(np.float32)
+    g = np.ones((10,), np.float32)
+    b = np.zeros((10,), np.float32)
+    x = ht.placeholder_op("x", X.shape)
+    scale = ht.Variable("scale", value=g)
+    bias = ht.Variable("bias", value=b)
+    out = run_graph([ht.layer_normalization_op(x, scale, bias)], {x: X})[0]
+    expect = F.layer_norm(torch.from_numpy(X), (10,)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_losses_vs_torch(rng):
+    import torch
+    import torch.nn.functional as F
+    logits = rng.standard_normal((6, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(6,))
+    onehot = np.eye(10, dtype=np.float32)[labels]
+    y = ht.placeholder_op("y", logits.shape)
+    y_ = ht.placeholder_op("y_", onehot.shape)
+    lab = ht.placeholder_op("lab", labels.shape, dtype=np.int32)
+    outs = run_graph(
+        [ht.softmax_op(y), ht.softmax_cross_entropy_op(y, y_),
+         ht.softmax_cross_entropy_sparse_op(y, lab)],
+        {y: logits, y_: onehot, lab: labels})
+    t = torch.from_numpy(logits)
+    tl = torch.from_numpy(labels)
+    np.testing.assert_allclose(outs[0], F.softmax(t, -1).numpy(), rtol=1e-5,
+                               atol=1e-6)
+    expect_ce = F.cross_entropy(t, tl, reduction="none").numpy()
+    np.testing.assert_allclose(outs[1], expect_ce, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[2], expect_ce, rtol=1e-5, atol=1e-6)
+
+
+def test_bce_with_logits_vs_torch(rng):
+    import torch
+    import torch.nn.functional as F
+    logits = rng.standard_normal((8,)).astype(np.float32)
+    targets = rng.integers(0, 2, size=(8,)).astype(np.float32)
+    y = ht.placeholder_op("y", logits.shape)
+    t = ht.placeholder_op("t", targets.shape)
+    out = run_graph([ht.binarycrossentropywithlogits_op(y, t)],
+                    {y: logits, t: targets})[0]
+    expect = F.binary_cross_entropy_with_logits(
+        torch.from_numpy(logits), torch.from_numpy(targets),
+        reduction="none").numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_lookup(rng):
+    table = rng.standard_normal((20, 4)).astype(np.float32)
+    ids = rng.integers(0, 20, size=(3, 5))
+    t = ht.placeholder_op("table", table.shape)
+    i = ht.placeholder_op("ids", ids.shape, dtype=np.int32)
+    out = run_graph([ht.embedding_lookup_op(t, i)], {t: table, i: ids})[0]
+    np.testing.assert_allclose(out, table[ids])
+
+
+def test_reduce_indexedslices():
+    import jax.numpy as jnp
+    from hetu_tpu.ops.embedding import reduce_indexedslices
+    ids = jnp.asarray([3, 1, 3, 2, 1, 3])
+    vals = jnp.asarray([[1.], [2.], [3.], [4.], [5.], [6.]])
+    uniq, summed = reduce_indexedslices(ids, vals, 6)
+    got = {int(u): float(s) for u, s in zip(uniq, summed[:, 0]) if u >= 0}
+    assert got == {1: 7.0, 2: 4.0, 3: 10.0}
