@@ -143,8 +143,20 @@ class VariableOp(Op):
 
     __slots__ = ("shape", "dtype", "initializer", "trainable")
 
+    # Executor state is keyed by variable name, so names must be unique
+    # across the process — two model instances built with default names
+    # would otherwise silently share (and clobber) parameter slots.
+    _used_names = {}
+
     def __init__(self, name, shape, initializer, trainable=True,
                  dtype=np.float32):
+        count = VariableOp._used_names.get(name)
+        if count is None:
+            VariableOp._used_names[name] = 1
+        else:
+            VariableOp._used_names[name] = count + 1
+            name = f"{name}_{count}"
+            VariableOp._used_names[name] = 1
         super().__init__(name=name)
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)

@@ -1,0 +1,6 @@
+from .base import BaseLayer, Sequence, Identity, fresh_name
+from .common import (Linear, Conv2d, BatchNorm, LayerNorm, RMSNorm, Embedding,
+                     DropOut, Relu, Gelu, Mish, MaxPool2d, AvgPool2d, Reshape,
+                     Concatenate, SumLayers)
+from .attention import MultiHeadAttention
+from .transformer import TransformerLayer, TransformerFFN

@@ -1,0 +1,53 @@
+"""Multi-head attention layer.
+
+Reference: /root/reference/python/hetu/layers/attention.py MultiHeadAttention
+(the reference flattens to [B*S, H] between every projection).  Here the
+layer keeps the [B, S, H] layout end to end — projections are 3D matmuls XLA
+maps straight onto the MXU — and the core product is a single fused-attention
+op (ops/attention.py) lowered to Pallas flash attention on TPU.
+"""
+
+from __future__ import annotations
+
+from .base import BaseLayer, fresh_name
+from .common import Linear
+from ..ops import array_reshape_op, transpose_op
+from ..ops.attention import scaled_dot_product_attention_op
+
+
+class MultiHeadAttention(BaseLayer):
+    def __init__(self, hidden_size, num_heads, sequence_length=None,
+                 dropout_rate=0.0, causal_mask=False, name=None):
+        assert hidden_size % num_heads == 0
+        name = fresh_name(name or "attn")
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.sequence_length = sequence_length
+        self.dropout_keep = 1.0 - dropout_rate
+        self.causal = causal_mask
+        self.q_proj = Linear(hidden_size, hidden_size, name=f"{name}_q")
+        self.k_proj = Linear(hidden_size, hidden_size, name=f"{name}_k")
+        self.v_proj = Linear(hidden_size, hidden_size, name=f"{name}_v")
+        self.out_proj = Linear(hidden_size, hidden_size, name=f"{name}_out")
+
+    def _split_heads(self, x, seq_len):
+        # [B, S, H] (or [B*S, H]) -> [B, heads, S, d]
+        x = array_reshape_op(
+            x, output_shape=(-1, seq_len, self.num_heads, self.head_dim))
+        return transpose_op(x, perm=(0, 2, 1, 3))
+
+    def __call__(self, query, key, value, attention_mask=None, seq_len=None):
+        """Returns [B, S, H]."""
+        seq_len = seq_len or self.sequence_length
+        assert seq_len is not None, "sequence length required"
+        q = self._split_heads(self.q_proj(query), seq_len)
+        k = self._split_heads(self.k_proj(key), seq_len)
+        v = self._split_heads(self.v_proj(value), seq_len)
+        ctx_ = scaled_dot_product_attention_op(
+            q, k, v, mask=attention_mask, causal=self.causal,
+            dropout_keep=self.dropout_keep)
+        ctx_ = transpose_op(ctx_, perm=(0, 2, 1, 3))
+        ctx_ = array_reshape_op(ctx_,
+                                output_shape=(-1, seq_len, self.hidden_size))
+        return self.out_proj(ctx_)

@@ -1,0 +1,40 @@
+"""Layer library base (reference: /root/reference/python/hetu/layers/base.py).
+
+Layers are callables that build op subgraphs; parameters are VariableOps
+created at layer construction.  Unlike flax Modules there is no separate
+param pytree — the graph owns the Variables, matching the reference design.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+_layer_counters = {}
+
+
+def fresh_name(prefix):
+    c = _layer_counters.get(prefix, 0)
+    _layer_counters[prefix] = c + 1
+    return f"{prefix}{c}" if c else prefix
+
+
+class BaseLayer:
+    def __call__(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class Sequence(BaseLayer):
+    """Sequential container (reference layers/sequence.py)."""
+
+    def __init__(self, *layers):
+        self.layers = list(layers)
+
+    def __call__(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class Identity(BaseLayer):
+    def __call__(self, x):
+        return x

@@ -1,0 +1,5 @@
+from .mlp import MLP, LeNet
+from .resnet import ResNet, resnet18, resnet34
+from .bert import BertConfig, BertModel, BertForPreTraining
+from .gpt import GPTConfig, GPTModel, GPTLMHeadModel, GPT_CONFIGS
+from .ctr import WDL, DeepFM, DCN, DLRM

@@ -1,0 +1,168 @@
+"""BERT (reference: examples/nlp/bert/hetu_bert.py — embeddings + encoder
+stack + MLM/NSP heads; the DP-8 throughput north-star model).
+
+Graph-level model: __call__ builds nodes from id placeholders.  The input
+contract matches the reference: input_ids/token_type_ids/attention_mask of
+shape [B, S]; attention_mask is converted to an additive [B,1,1,S] bias.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import Op, VariableOp
+from .. import initializers as init
+from ..layers import (Linear, LayerNorm, Embedding, TransformerLayer,
+                      fresh_name)
+from ..ops import (array_reshape_op, dropout_op, gelu_op, tanh_op,
+                   embedding_lookup_op, matmul_op, broadcastto_op,
+                   softmax_cross_entropy_sparse_op, reduce_mean_op, slice_op)
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1, seq_len=128):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.seq_len = seq_len
+
+
+class AttentionMaskOp(Op):
+    """[B, S] 0/1 mask -> additive [B, 1, 1, S] bias (reference
+    examples/nlp/bert/hetu_bert.py extended_attention_mask)."""
+
+    def _compute(self, input_vals, ctx):
+        import jax.numpy as jnp
+        (m,) = input_vals
+        return ((1.0 - m.astype(jnp.float32))
+                * -10000.0)[:, None, None, :]
+
+
+class PositionIdsOp(Op):
+    """Broadcast [S] position embedding rows over the batch of x."""
+
+    def __init__(self, table, x, seq_len):
+        super().__init__(table, x, name="position_embed")
+        self.seq_len = seq_len
+
+    def _compute(self, input_vals, ctx):
+        table, x = input_vals
+        return table[None, :self.seq_len, :]
+
+
+class BertEmbeddings:
+    def __init__(self, config, name="bert_embeddings"):
+        c = config
+        self.word = Embedding(c.vocab_size, c.hidden_size,
+                              initializer=init.normal(0.0, 0.02),
+                              name=f"{name}_word")
+        self.position = VariableOp(f"{name}_position",
+                                   (c.max_position_embeddings, c.hidden_size),
+                                   init.normal(0.0, 0.02))
+        self.token_type = Embedding(c.type_vocab_size, c.hidden_size,
+                                    initializer=init.normal(0.0, 0.02),
+                                    name=f"{name}_tok_type")
+        self.ln = LayerNorm(c.hidden_size, name=f"{name}_ln")
+        self.dropout_keep = 1.0 - c.hidden_dropout_prob
+        self.config = config
+
+    def __call__(self, input_ids, token_type_ids):
+        x = self.word(input_ids) + self.token_type(token_type_ids)
+        x = x + PositionIdsOp(self.position, x, self.config.seq_len)
+        x = self.ln(x)
+        if self.dropout_keep < 1.0:
+            x = dropout_op(x, keep_prob=self.dropout_keep)
+        return x
+
+
+class BertModel:
+    def __init__(self, config, name="bert"):
+        c = config
+        self.config = c
+        self.embeddings = BertEmbeddings(c, name=f"{name}_embeddings")
+        self.encoder = [
+            TransformerLayer(c.hidden_size, c.num_attention_heads,
+                             c.intermediate_size, seq_len=c.seq_len,
+                             dropout_rate=c.hidden_dropout_prob,
+                             attn_dropout_rate=c.attention_probs_dropout_prob,
+                             causal=False, pre_norm=False,
+                             name=f"{name}_layer{i}")
+            for i in range(c.num_hidden_layers)]
+        self.pooler = Linear(c.hidden_size, c.hidden_size,
+                             name=f"{name}_pooler")
+
+    def __call__(self, input_ids, token_type_ids, attention_mask=None):
+        mask = AttentionMaskOp(attention_mask) \
+            if attention_mask is not None else None
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask=mask, seq_len=self.config.seq_len)
+        # pooled = tanh(W @ x[:, 0])
+        pooled = tanh_op(self.pooler(FirstTokenOp(x)))
+        return x, pooled
+
+
+class FirstTokenOp(Op):
+    """[B, S, H] -> [B, H] (CLS token for the pooler)."""
+
+    def _compute(self, input_vals, ctx):
+        (x,) = input_vals
+        return x[:, 0, :]
+
+
+class BertForPreTraining:
+    """MLM + NSP heads (reference examples/nlp/bert/hetu_bert.py)."""
+
+    def __init__(self, config, name="bert"):
+        c = config
+        self.config = c
+        self.bert = BertModel(config, name=name)
+        self.mlm_transform = Linear(c.hidden_size, c.hidden_size,
+                                    name=f"{name}_mlm_transform")
+        self.mlm_ln = LayerNorm(c.hidden_size, name=f"{name}_mlm_ln")
+        # decoder shares the word-embedding table (tied weights)
+        self.mlm_bias = VariableOp(f"{name}_mlm_bias", (c.vocab_size,),
+                                   init.zeros())
+        self.nsp = Linear(c.hidden_size, 2, name=f"{name}_nsp")
+
+    def __call__(self, input_ids, token_type_ids, attention_mask):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_ln(gelu_op(self.mlm_transform(
+            array_reshape_op(seq, output_shape=(-1,
+                                                self.config.hidden_size)))))
+        logits = matmul_op(h, self.bert.embeddings.word.weight, trans_B=True)
+        logits = logits + broadcastto_op(self.mlm_bias, logits)
+        nsp_logits = self.nsp(pooled)
+        return logits, nsp_logits
+
+    def loss(self, input_ids, token_type_ids, attention_mask, mlm_labels,
+             nsp_labels):
+        """mlm_labels: [B*S] with -1 for unmasked; nsp_labels: [B]."""
+        logits, nsp_logits = self(input_ids, token_type_ids, attention_mask)
+        ce = softmax_cross_entropy_sparse_op(logits, mlm_labels,
+                                             ignored_index=-1)
+        mlm_loss = MaskedMeanOp(ce, mlm_labels)
+        nsp_loss = reduce_mean_op(softmax_cross_entropy_sparse_op(
+            nsp_logits, nsp_labels))
+        return mlm_loss + nsp_loss
+
+
+class MaskedMeanOp(Op):
+    """Mean of per-token losses over positions with label >= 0 (the
+    reference normalizes MLM loss by the masked-token count)."""
+
+    def _compute(self, input_vals, ctx):
+        import jax.numpy as jnp
+        ce, labels = input_vals
+        valid = (labels.reshape(-1) >= 0).astype(ce.dtype)
+        return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1.0)

@@ -1,0 +1,210 @@
+"""CTR models: Wide&Deep, DeepFM, DCN, DLRM.
+
+Reference: examples/ctr/models/{wdl_adult,wdl_criteo,dfm_criteo,dcn_criteo}.py
+and tools/EmbeddingMemoryCompression/methods/../models (DLRM/WDL/DCN/DeepFM).
+The embedding tables here are graph Variables (XLA gather path); swapping in
+a PS-backed CacheSparseTable (ps/cstable.py) gives the HET bounded-staleness
+path for tables that don't fit HBM.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import VariableOp, Op
+from .. import initializers as init
+from ..layers import Linear, Embedding, Sequence, fresh_name
+from ..ops import (array_reshape_op, concat_op, relu_op, sigmoid_op,
+                   embedding_lookup_op, reduce_sum_op, reduce_mean_op,
+                   binarycrossentropywithlogits_op, mul_op, matmul_op,
+                   batch_matmul_op, transpose_op)
+
+
+class SparseFeatureEmbedding:
+    """One shared table over hashed/offset sparse slots: ids [B, F] -> [B, F*D]."""
+
+    def __init__(self, num_embeddings, dim, num_fields, name="sparse_emb"):
+        self.table = VariableOp(fresh_name(name), (num_embeddings, dim),
+                                init.normal(0.0, 0.01))
+        self.dim = dim
+        self.num_fields = num_fields
+
+    def __call__(self, ids):
+        e = embedding_lookup_op(self.table, ids)  # [B, F, D]
+        return e
+
+
+class WDL:
+    """Wide & Deep (reference wdl_criteo: 13 dense + 26 sparse slots)."""
+
+    def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
+                 num_dense=13, hidden=(256, 256, 256), name="wdl"):
+        self.emb = SparseFeatureEmbedding(num_embeddings, embedding_dim,
+                                          num_sparse, name=f"{name}_emb")
+        # wide part: linear over dense features
+        self.wide = Linear(num_dense, 1, name=f"{name}_wide")
+        dims = [num_sparse * embedding_dim + num_dense] + list(hidden)
+        self.deep = []
+        for i in range(len(hidden)):
+            self.deep.append(Linear(dims[i], dims[i + 1],
+                                    name=f"{name}_deep{i}"))
+        self.out = Linear(dims[-1], 1, name=f"{name}_out")
+        self.num_sparse = num_sparse
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, dense, sparse_ids):
+        e = self.emb(sparse_ids)
+        flat = array_reshape_op(
+            e, output_shape=(-1, self.num_sparse * self.embedding_dim))
+        x = concat_op(flat, dense, axis=1)
+        for l in self.deep:
+            x = relu_op(l(x))
+        logit = self.out(x) + self.wide(dense)
+        return array_reshape_op(logit, output_shape=(-1,))
+
+    def loss(self, dense, sparse_ids, labels):
+        logit = self(dense, sparse_ids)
+        return reduce_mean_op(
+            binarycrossentropywithlogits_op(logit, labels))
+
+
+class FMSecondOrderOp(Op):
+    """0.5 * ((sum_f e)^2 - sum_f e^2) summed over dim -> [B]."""
+
+    def _compute(self, input_vals, ctx):
+        import jax.numpy as jnp
+        (e,) = input_vals  # [B, F, D]
+        s = jnp.sum(e, axis=1)
+        s2 = jnp.sum(e * e, axis=1)
+        return 0.5 * jnp.sum(s * s - s2, axis=-1)
+
+
+class DeepFM:
+    """DeepFM (reference dfm_criteo)."""
+
+    def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
+                 num_dense=13, hidden=(256, 256), name="dfm"):
+        self.emb = SparseFeatureEmbedding(num_embeddings, embedding_dim,
+                                          num_sparse, name=f"{name}_emb")
+        self.first_order = VariableOp(f"{name}_fo", (num_embeddings, 1),
+                                      init.normal(0.0, 0.01))
+        dims = [num_sparse * embedding_dim + num_dense] + list(hidden)
+        self.deep = [Linear(dims[i], dims[i + 1], name=f"{name}_deep{i}")
+                     for i in range(len(hidden))]
+        self.out = Linear(dims[-1], 1, name=f"{name}_out")
+        self.num_sparse = num_sparse
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, dense, sparse_ids):
+        e = self.emb(sparse_ids)                      # [B, F, D]
+        fo = embedding_lookup_op(self.first_order, sparse_ids)  # [B, F, 1]
+        fo = reduce_sum_op(array_reshape_op(fo, output_shape=(-1, self.num_sparse)),
+                           axes=1)                    # [B]
+        so = FMSecondOrderOp(e)                       # [B]
+        flat = array_reshape_op(
+            e, output_shape=(-1, self.num_sparse * self.embedding_dim))
+        x = concat_op(flat, dense, axis=1)
+        for l in self.deep:
+            x = relu_op(l(x))
+        deep_out = array_reshape_op(self.out(x), output_shape=(-1,))
+        return fo + so + deep_out
+
+    def loss(self, dense, sparse_ids, labels):
+        return reduce_mean_op(binarycrossentropywithlogits_op(
+            self(dense, sparse_ids), labels))
+
+
+class CrossLayerOp(Op):
+    """DCN cross: x0 * (x·w) + b + x (reference dcn_criteo cross_layer)."""
+
+    def _compute(self, input_vals, ctx):
+        import jax.numpy as jnp
+        x0, x, w, b = input_vals
+        xw = jnp.einsum("bd,d->b", x, w)
+        return x0 * xw[:, None] + b + x
+
+
+class DCN:
+    """Deep & Cross Network."""
+
+    def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
+                 num_dense=13, num_cross=3, hidden=(256, 256), name="dcn"):
+        self.emb = SparseFeatureEmbedding(num_embeddings, embedding_dim,
+                                          num_sparse, name=f"{name}_emb")
+        d = num_sparse * embedding_dim + num_dense
+        self.cross_w = [VariableOp(f"{name}_cw{i}", (d,),
+                                   init.normal(0.0, 0.01))
+                        for i in range(num_cross)]
+        self.cross_b = [VariableOp(f"{name}_cb{i}", (d,), init.zeros())
+                        for i in range(num_cross)]
+        dims = [d] + list(hidden)
+        self.deep = [Linear(dims[i], dims[i + 1], name=f"{name}_deep{i}")
+                     for i in range(len(hidden))]
+        self.out = Linear(d + dims[-1], 1, name=f"{name}_out")
+        self.num_sparse = num_sparse
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, dense, sparse_ids):
+        e = self.emb(sparse_ids)
+        flat = array_reshape_op(
+            e, output_shape=(-1, self.num_sparse * self.embedding_dim))
+        x0 = concat_op(flat, dense, axis=1)
+        x = x0
+        for w, b in zip(self.cross_w, self.cross_b):
+            x = CrossLayerOp(x0, x, w, b)
+        h = x0
+        for l in self.deep:
+            h = relu_op(l(h))
+        both = concat_op(x, h, axis=1)
+        return array_reshape_op(self.out(both), output_shape=(-1,))
+
+    def loss(self, dense, sparse_ids, labels):
+        return reduce_mean_op(binarycrossentropywithlogits_op(
+            self(dense, sparse_ids), labels))
+
+
+class DLRMInteractionOp(Op):
+    """Pairwise dot interactions (DLRM): [B,F,D] -> [B, F*(F-1)/2]."""
+
+    def _compute(self, input_vals, ctx):
+        import jax.numpy as jnp
+        (e,) = input_vals
+        z = jnp.einsum("bfd,bgd->bfg", e, e)
+        f = e.shape[1]
+        iu, ju = jnp.triu_indices(f, k=1)
+        return z[:, iu, ju]
+
+
+class DLRM:
+    def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
+                 num_dense=13, bottom=(512, 256), top=(512, 256),
+                 name="dlrm"):
+        self.emb = SparseFeatureEmbedding(num_embeddings, embedding_dim,
+                                          num_sparse, name=f"{name}_emb")
+        bd = [num_dense] + list(bottom) + [embedding_dim]
+        self.bottom = [Linear(bd[i], bd[i + 1], name=f"{name}_bot{i}")
+                       for i in range(len(bd) - 1)]
+        f = num_sparse + 1
+        td = [f * (f - 1) // 2 + embedding_dim] + list(top)
+        self.top = [Linear(td[i], td[i + 1], name=f"{name}_top{i}")
+                    for i in range(len(td) - 1)]
+        self.out = Linear(td[-1], 1, name=f"{name}_out")
+        self.num_sparse = num_sparse
+        self.embedding_dim = embedding_dim
+
+    def __call__(self, dense, sparse_ids):
+        x = dense
+        for l in self.bottom:
+            x = relu_op(l(x))
+        e = self.emb(sparse_ids)  # [B, F, D]
+        xe = array_reshape_op(x, output_shape=(-1, 1, self.embedding_dim))
+        all_e = concat_op(xe, e, axis=1)
+        inter = DLRMInteractionOp(all_e)
+        h = concat_op(inter, x, axis=1)
+        for l in self.top:
+            h = relu_op(l(h))
+        return array_reshape_op(self.out(h), output_shape=(-1,))
+
+    def loss(self, dense, sparse_ids, labels):
+        return reduce_mean_op(binarycrossentropywithlogits_op(
+            self(dense, sparse_ids), labels))

@@ -1,0 +1,37 @@
+"""MLP / simple CNN (reference: examples/cnn/models/{MLP,CNN,LeNet}.py)."""
+
+from __future__ import annotations
+
+from ..layers import Linear, Conv2d, MaxPool2d, Sequence, Relu, Reshape
+from ..ops import relu_op, array_reshape_op, flatten_op
+
+
+class MLP:
+    def __init__(self, dims=(784, 256, 256, 10), name="mlp"):
+        self.linears = [Linear(dims[i], dims[i + 1], name=f"{name}_fc{i}")
+                        for i in range(len(dims) - 1)]
+
+    def __call__(self, x):
+        for i, l in enumerate(self.linears):
+            x = l(x)
+            if i < len(self.linears) - 1:
+                x = relu_op(x)
+        return x
+
+
+class LeNet:
+    def __init__(self, num_classes=10, name="lenet"):
+        self.conv1 = Conv2d(1, 6, 5, padding=2, name=f"{name}_c1")
+        self.pool = MaxPool2d(2)
+        self.conv2 = Conv2d(6, 16, 5, name=f"{name}_c2")
+        self.fc1 = Linear(16 * 5 * 5, 120, name=f"{name}_f1")
+        self.fc2 = Linear(120, 84, name=f"{name}_f2")
+        self.fc3 = Linear(84, num_classes, name=f"{name}_f3")
+
+    def __call__(self, x):
+        x = self.pool(relu_op(self.conv1(x)))
+        x = self.pool(relu_op(self.conv2(x)))
+        x = flatten_op(x)
+        x = relu_op(self.fc1(x))
+        x = relu_op(self.fc2(x))
+        return self.fc3(x)

@@ -1,0 +1,71 @@
+"""ResNet for CIFAR (reference: examples/cnn/models/ResNet.py pattern —
+ResNet-18/34 with BasicBlock; the v0 end-to-end gate model per SURVEY §7.3).
+"""
+
+from __future__ import annotations
+
+from ..layers import (Conv2d, BatchNorm, Linear, Sequence, Identity)
+from ..ops import (relu_op, global_avg_pool2d_op, array_reshape_op,
+                   avg_pool2d_op)
+
+
+class BasicBlock:
+    expansion = 1
+
+    def __init__(self, in_planes, planes, stride=1, name="block"):
+        self.conv1 = Conv2d(in_planes, planes, 3, stride=stride, padding=1,
+                            bias=False, name=f"{name}_conv1")
+        self.bn1 = BatchNorm(planes, name=f"{name}_bn1")
+        self.conv2 = Conv2d(planes, planes, 3, stride=1, padding=1,
+                            bias=False, name=f"{name}_conv2")
+        self.bn2 = BatchNorm(planes, name=f"{name}_bn2")
+        self.shortcut = None
+        if stride != 1 or in_planes != planes * self.expansion:
+            self.sc_conv = Conv2d(in_planes, planes * self.expansion, 1,
+                                  stride=stride, bias=False,
+                                  name=f"{name}_scconv")
+            self.sc_bn = BatchNorm(planes * self.expansion,
+                                   name=f"{name}_scbn")
+            self.shortcut = lambda x: self.sc_bn(self.sc_conv(x))
+
+    def __call__(self, x):
+        out = relu_op(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        sc = self.shortcut(x) if self.shortcut else x
+        return relu_op(out + sc)
+
+
+class ResNet:
+    def __init__(self, num_blocks=(2, 2, 2, 2), num_classes=10,
+                 name="resnet"):
+        self.in_planes = 64
+        self.conv1 = Conv2d(3, 64, 3, stride=1, padding=1, bias=False,
+                            name=f"{name}_conv1")
+        self.bn1 = BatchNorm(64, name=f"{name}_bn1")
+        self.layers = []
+        for i, (planes, n, stride) in enumerate(
+                zip((64, 128, 256, 512), num_blocks, (1, 2, 2, 2))):
+            blocks = []
+            for j in range(n):
+                blocks.append(BasicBlock(self.in_planes, planes,
+                                         stride if j == 0 else 1,
+                                         name=f"{name}_l{i}b{j}"))
+                self.in_planes = planes * BasicBlock.expansion
+            self.layers.append(blocks)
+        self.fc = Linear(512, num_classes, name=f"{name}_fc")
+
+    def __call__(self, x):
+        out = relu_op(self.bn1(self.conv1(x)))
+        for blocks in self.layers:
+            for b in blocks:
+                out = b(out)
+        out = global_avg_pool2d_op(out)
+        return self.fc(out)
+
+
+def resnet18(num_classes=10):
+    return ResNet((2, 2, 2, 2), num_classes)
+
+
+def resnet34(num_classes=10):
+    return ResNet((3, 4, 6, 3), num_classes)

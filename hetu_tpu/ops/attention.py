@@ -1,0 +1,83 @@
+"""Attention ops.
+
+The reference composes attention from batched matmuls + softmax graph nodes
+(layers/attention.py); there is no fused kernel.  Here scaled-dot-product
+attention is ONE graph op so the executor can lower it to the Pallas flash
+attention kernel on TPU (ops/pallas/flash_attention.py) and fall back to a
+fusable jnp composition elsewhere — the TPU answer to cudnn-style fused MHA
+and the building block the reference lacks for long-context (ring/blockwise)
+variants.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.node import Op
+
+_FLASH_MIN_SEQ = 256  # below this the jnp path is faster (kernel overheads)
+
+
+def _use_flash(q):
+    if q.ndim != 4:
+        return False
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    return (platform in ("tpu", "axon")
+            and q.shape[-2] >= _FLASH_MIN_SEQ
+            and q.shape[-1] in (64, 128, 256))
+
+
+class ScaledDotProductAttentionOp(Op):
+    def __init__(self, q, k, v, mask=None, causal=False, scale=None,
+                 dropout_keep=1.0, name=None):
+        inputs = [q, k, v] + ([mask] if mask is not None else [])
+        super().__init__(*inputs, name=name)
+        self.has_mask = mask is not None
+        self.causal = causal
+        self.scale = scale
+        self.dropout_keep = dropout_keep
+
+    @property
+    def needs_rng(self):
+        return self.dropout_keep < 1.0
+
+    def _compute(self, input_vals, ctx):
+        q, k, v = input_vals[:3]
+        mask = input_vals[3] if self.has_mask else None
+        d = q.shape[-1]
+        scale = self.scale if self.scale is not None else 1.0 / (d ** 0.5)
+        if (self.dropout_keep >= 1.0 or not ctx.training) and _use_flash(q):
+            from .pallas.flash_attention import flash_attention
+            out = flash_attention(q, k, v, mask=mask, causal=self.causal,
+                                  scale=scale)
+            if out is not None:
+                return out
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        if self.causal:
+            s_q, s_k = scores.shape[-2], scores.shape[-1]
+            iq = jnp.arange(s_q)[:, None]
+            ik = jnp.arange(s_k)[None, :]
+            scores = jnp.where(iq >= ik - (s_k - s_q), scores, -1e9)
+        if mask is not None:
+            scores = scores + mask
+        probs = jax.nn.softmax(scores, axis=-1)
+        if self.dropout_keep < 1.0 and ctx.training:
+            keep = jax.random.bernoulli(ctx.rng_for(self), self.dropout_keep,
+                                        probs.shape)
+            probs = jnp.where(keep, probs / self.dropout_keep, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32).astype(v.dtype)
+
+
+def scaled_dot_product_attention_op(q, k, v, mask=None, causal=False,
+                                    scale=None, dropout_keep=1.0, name=None):
+    return ScaledDotProductAttentionOp(q, k, v, mask=mask, causal=causal,
+                                       scale=scale, dropout_keep=dropout_keep,
+                                       name=name)

@@ -68,7 +68,7 @@ def test_optimizer_matches_torch_sgd_momentum():
         tloss = ((tx @ tw - ty) ** 2).sum(1).mean()
         tloss.backward()
         topt.step()
-    np.testing.assert_allclose(np.asarray(ex.params["w"]),
+    np.testing.assert_allclose(np.asarray(ex.params[w.name]),
                                tw.detach().numpy(), rtol=1e-4, atol=1e-5)
 
 
@@ -93,7 +93,7 @@ def test_adam_matches_torch():
         tloss = ((tx @ tw - ty) ** 2).sum(1).mean()
         tloss.backward()
         topt.step()
-    np.testing.assert_allclose(np.asarray(ex.params["w"]),
+    np.testing.assert_allclose(np.asarray(ex.params[w.name]),
                                tw.detach().numpy(), rtol=1e-3, atol=1e-5)
 
 
@@ -114,9 +114,9 @@ def test_named_subgraphs_train_validate():
                 convert_to_numpy_ret_vals=True)[0]
     assert l1 < l0 * 0.1
     # validate must not mutate params
-    p_before = np.asarray(ex.params["w"])
+    p_before = np.asarray(ex.params[w.name])
     ex.run("validate", feed_dict={x: X, y_: Y})
-    np.testing.assert_array_equal(p_before, np.asarray(ex.params["w"]))
+    np.testing.assert_array_equal(p_before, np.asarray(ex.params[w.name]))
 
 
 def test_checkpoint_save_load(tmp_path):
