@@ -4,3 +4,4 @@ from .common import (Linear, Conv2d, BatchNorm, LayerNorm, RMSNorm, Embedding,
                      Concatenate, SumLayers)
 from .attention import MultiHeadAttention
 from .transformer import TransformerLayer, TransformerFFN
+from .moe import MoELayer, TopKGate, HashGate

@@ -1,0 +1,174 @@
+"""MoE layer with expert parallelism.
+
+Reference: /root/reference/python/hetu/layers/moe_layer.py — MoELayer:
+reshape → gate → layout_transform → alltoall → expert FFNs → alltoall →
+reverse_layout_transform (:60-88); BASE-layer variant (:90) with balance
+assignment; gates in layers/{TopGate,KTop1Gate,HashGate,SAMGate,BalanceGate}.
+
+TPU redesign: gating + dispatch are dense einsums (ops/moe.py); the expert
+dim of dispatched activations and of expert weights carries an 'ep' mesh-axis
+annotation, so GSPMD inserts the all-to-all pair the reference ran as
+explicit AllToAllOps (for multi-node topologies,
+parallel/collectives.hierarchical_all_to_all composes the DCN×ICI staging
+explicitly inside shard_map).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BaseLayer, fresh_name
+from ..graph.node import Op, VariableOp
+from .. import initializers as init
+from ..ops import array_reshape_op
+from ..ops.moe import top_k_gating, hash_gating
+
+
+class TopKGate(BaseLayer):
+    """GShard top-1/top-2 gate weights (reference TopGate.py).  Routing
+    hyper-parameters (k, capacity) live on the MoELayer, the single source
+    of truth."""
+
+    def __init__(self, hidden_size, num_experts, name=None):
+        name = fresh_name(name or "gate")
+        self.wg = VariableOp(f"{name}_w", (hidden_size, num_experts),
+                             init.xavier_uniform())
+
+
+class HashGate(BaseLayer):
+    """Deterministic id-hash gate (reference HashGate.py).  Requires token
+    ids passed to MoELayer.__call__."""
+
+    def __init__(self, num_experts, name=None):
+        self.num_experts = num_experts
+        self.wg = None
+
+
+class _MoEOp(Op):
+    """Fused gate+dispatch+experts+combine (single graph node so the EP
+    sharding annotations stay local to the op)."""
+
+    def __init__(self, x, gate, w1, b1, w2, b2, num_experts, capacity_factor,
+                 k, ep_axis=None, ids=None, name=None):
+        inputs = [x, w1, b1, w2, b2]
+        if gate.wg is not None:
+            inputs.append(gate.wg)
+        if ids is not None:
+            inputs.append(ids)
+        super().__init__(*inputs, name=name or "moe")
+        self.gate = gate
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.k = k
+        self.ep_axis = ep_axis
+        self.has_ids = ids is not None
+
+    def _compute(self, input_vals, ctx):
+        import jax
+        import jax.numpy as jnp
+        x, w1, b1, w2, b2 = input_vals[:5]
+        rest = list(input_vals[5:])
+        wg = rest.pop(0) if self.gate.wg is not None else None
+        ids = rest.pop(0) if self.has_ids else None
+
+        orig_shape = x.shape
+        h = x.shape[-1]
+        tokens = x.reshape(-1, h)
+        T = tokens.shape[0]
+        E = self.num_experts
+        C = int(np.ceil(self.capacity_factor * T * self.k / E))
+        C = max(C, 1)
+
+        if wg is not None:
+            logits = tokens @ wg
+            dispatch, combine, aux = top_k_gating(logits, self.k, C)
+        else:
+            dispatch, combine, aux = hash_gating(ids.reshape(-1), E, C,
+                                                 dtype=tokens.dtype)
+
+        expert_in = jnp.einsum("tec,th->ech", dispatch, tokens)
+        if self.ep_axis is not None and ctx.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            expert_in = jax.lax.with_sharding_constraint(
+                expert_in, NamedSharding(ctx.mesh,
+                                         P(self.ep_axis, None, None)))
+        # per-expert FFN: [E, C, H] @ [E, H, F] -> [E, C, F]
+        a = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, w1)
+                        + b1[:, None, :])
+        out = jnp.einsum("ecf,efh->ech", a, w2) + b2[:, None, :]
+        if self.ep_axis is not None and ctx.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            out = jax.lax.with_sharding_constraint(
+                out, NamedSharding(ctx.mesh, P(self.ep_axis, None, None)))
+        combined = jnp.einsum("ech,tec->th", out, combine)
+        return combined.reshape(orig_shape)
+
+
+class MoEAuxLossOp(Op):
+    def __init__(self, moe_op):
+        super().__init__(*moe_op.inputs, name=f"{moe_op.name}_aux")
+        self.moe = moe_op
+
+    def _compute(self, input_vals, ctx):
+        # recompute gating aux (cheap; CSE merges with the MoE op's gating)
+        import jax.numpy as jnp
+        x = input_vals[0]
+        if self.moe.gate.wg is None:
+            return jnp.asarray(0.0, x.dtype)
+        wg = input_vals[5]
+        tokens = x.reshape(-1, x.shape[-1])
+        T = tokens.shape[0]
+        E = self.moe.num_experts
+        import jax
+        logits = tokens @ wg
+        probs = jax.nn.softmax(logits, axis=-1)
+        mask1 = jax.nn.one_hot(jnp.argmax(logits, -1), E,
+                               dtype=probs.dtype)
+        return E * jnp.sum(jnp.mean(probs, 0) * jnp.mean(mask1, 0))
+
+
+class MoELayer(BaseLayer):
+    """Expert-parallel FFN block (drop-in for TransformerFFN)."""
+
+    def __init__(self, hidden_size, intermediate_size, num_experts, k=2,
+                 capacity_factor=1.25, gate="top", ep_axis=None, name=None):
+        name = fresh_name(name or "moe")
+        if gate == "top":
+            self.gate = TopKGate(hidden_size, num_experts, name=name)
+        elif gate == "hash":
+            self.gate = HashGate(num_experts)
+        else:
+            raise ValueError(gate)
+        self.w1 = VariableOp(f"{name}_w1",
+                             (num_experts, hidden_size, intermediate_size),
+                             init.xavier_uniform())
+        self.b1 = VariableOp(f"{name}_b1", (num_experts, intermediate_size),
+                             init.zeros())
+        self.w2 = VariableOp(f"{name}_w2",
+                             (num_experts, intermediate_size, hidden_size),
+                             init.xavier_uniform())
+        self.b2 = VariableOp(f"{name}_b2", (num_experts, hidden_size),
+                             init.zeros())
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.k = k
+        self.ep_axis = ep_axis
+        if ep_axis is not None:
+            for v in (self.w1, self.b1, self.w2, self.b2):
+                from ..parallel.mesh import DistState
+                v.dist_state = DistState({0: ep_axis})
+        self.last_op = None
+
+    def __call__(self, x, ids=None):
+        if self.gate.wg is None and ids is None:
+            raise ValueError(
+                "hash-gated MoELayer requires token ids: moe(x, ids=...)")
+        self.last_op = _MoEOp(x, self.gate, self.w1, self.b1, self.w2,
+                              self.b2, self.num_experts,
+                              self.capacity_factor, self.k,
+                              ep_axis=self.ep_axis, ids=ids)
+        return self.last_op
+
+    def aux_loss(self):
+        assert self.last_op is not None
+        return MoEAuxLossOp(self.last_op)

@@ -7,3 +7,7 @@ from .nn import *            # noqa: F401,F403
 from .losses import *        # noqa: F401,F403
 from .embedding import (embedding_lookup_op, sparse_embedding_lookup_op,
                         scatter_add_op, reduce_indexedslices, IndexedSlices)
+from .moe import (top_k_gating, hash_gating, layout_transform_op,
+                  reverse_layout_transform_op, topk_idx_op, topk_val_op,
+                  scatter1d_op, balance_assignment, sam_group_sum)
+from .attention import scaled_dot_product_attention_op

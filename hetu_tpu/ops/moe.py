@@ -1,0 +1,140 @@
+"""MoE plumbing ops.
+
+Reference kernels: src/ops/{LayoutTransform,H_A2A_LayoutTransform,TopKIdx,
+GroupTopKIdx,Scatter1D,SamMax,SamGroupSum,MinDist}.cu and graph ops
+gpu_ops/{LayoutTransform,ReverseLayoutTransform,TopKIdx,BalanceAssignment,
+Sample,Scatter1D}.py — scatter tokens into (expert, capacity) buffers before
+the all-to-all and back after.
+
+TPU redesign: dispatch is expressed densely (GShard-style one-hot
+dispatch/combine einsums) so it is MXU work with static shapes instead of
+data-dependent scatters; capacity overflow drops match the reference's
+LayoutTransform semantics.  The EP all-to-all is inserted by GSPMD from the
+expert-dim shardings (layers/moe.py), or composed explicitly with
+parallel/collectives.hierarchical_all_to_all for DCN×ICI topologies.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import simple_op
+
+
+def top_k_gating(logits, k, capacity, *, second_renorm=True,
+                 noise_rng=None, noise_eps=0.0):
+    """GShard top-k gating (k∈{1,2}).
+
+    logits: [T, E] raw gate outputs.  Returns (dispatch [T, E, C] float,
+    combine [T, E, C] float, aux_loss scalar).  Tokens beyond per-expert
+    capacity C are dropped (zero rows), as in the reference TopGate
+    (python/hetu/layers/TopGate.py GShard top-2 with capacity).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    if noise_rng is not None and noise_eps > 0:
+        logits = logits + noise_eps * jax.random.normal(noise_rng,
+                                                        logits.shape)
+    idx1 = jnp.argmax(logits, axis=-1)                       # [T]
+    mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)       # [T, E]
+    gate1 = jnp.sum(probs * mask1, axis=-1)
+
+    # load-balancing aux loss (GShard eq.4): E * mean(me * ce)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux = E * jnp.sum(me * ce)
+
+    # position of each token within its expert's queue
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1         # [T, E]
+    pos1_tok = jnp.sum(pos1, axis=-1)                        # [T]
+    keep1 = pos1_tok < capacity
+    gates = [(idx1, gate1 * keep1, pos1_tok)]
+
+    if k == 2:
+        logits2 = jnp.where(mask1 > 0, -jnp.inf, logits)
+        idx2 = jnp.argmax(logits2, axis=-1)
+        mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
+        gate2 = jnp.sum(probs * mask2, axis=-1)
+        # expert queues continue after top-1 assignments
+        used = jnp.sum(mask1, axis=0, keepdims=True)         # [1, E] counts
+        pos2 = (jnp.cumsum(mask2, axis=0) - mask2 + used) * mask2
+        pos2_tok = jnp.sum(pos2, axis=-1)
+        keep2 = pos2_tok < capacity
+        gates.append((idx2, gate2 * keep2, pos2_tok))
+        if second_renorm:
+            denom = gates[0][1] + gates[1][1] + 1e-9
+            gates = [(i, g / denom * (gates[0][1] + gates[1][1] > 0), p)
+                     for (i, g, p) in gates]
+
+    dispatch = jnp.zeros((T, E, capacity), dtype=probs.dtype)
+    combine = jnp.zeros((T, E, capacity), dtype=probs.dtype)
+    t_idx = jnp.arange(T)
+    for idx, gate, pos in gates:
+        oh = (jax.nn.one_hot(idx, E, dtype=probs.dtype)[:, :, None]
+              * jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                               dtype=probs.dtype)[:, None, :])
+        keep = (gate > 0).astype(probs.dtype)[:, None, None]
+        dispatch = dispatch + oh * keep
+        combine = combine + oh * gate[:, None, None]
+    return dispatch, combine, aux
+
+
+def hash_gating(ids, num_experts, capacity, dtype=jnp.float32):
+    """HashGate (reference layers/HashGate.py): expert = id % E, gate = 1."""
+    T = ids.shape[0]
+    idx = jnp.mod(ids.astype(jnp.int32), num_experts)
+    mask = jax.nn.one_hot(idx, num_experts, dtype=dtype)
+    pos = jnp.sum(jnp.cumsum(mask, axis=0) * mask - mask, axis=-1)
+    keep = (pos < capacity).astype(dtype)
+    oh = (mask[:, :, None]
+          * jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=dtype)
+          [:, None, :])
+    dispatch = oh * keep[:, None, None]
+    return dispatch, dispatch, jnp.asarray(0.0, dtype)
+
+
+layout_transform_op = simple_op(
+    lambda x, dispatch: jnp.einsum("tec,th->ech", dispatch, x),
+    "layout_transform")
+reverse_layout_transform_op = simple_op(
+    lambda expert_out, combine: jnp.einsum("ech,tec->th", expert_out,
+                                           combine),
+    "reverse_layout_transform")
+topk_idx_op = simple_op(
+    lambda x, k=1: jax.lax.top_k(x, k)[1], "topk_idx")
+topk_val_op = simple_op(
+    lambda x, k=1: jax.lax.top_k(x, k)[0], "topk_val")
+scatter1d_op = simple_op(
+    lambda x, idx, size=None: jnp.zeros((size,) + x.shape[1:],
+                                        x.dtype).at[idx.astype(jnp.int32)]
+    .set(x),
+    "scatter1d")
+
+
+def balance_assignment(scores, capacity=None):
+    """BASE-layer balanced assignment (reference BalanceAssignment op /
+    MinDist.cu auction).  Greedy capacity-constrained approximation with
+    static shapes: iterate experts in score order per token.
+    scores: [T, E]; returns expert index per token balancing load to T/E."""
+    T, E = scores.shape
+    cap = capacity or (T + E - 1) // E
+
+    def assign_token(carry, t):
+        load, out = carry
+        s = scores[t] - jnp.where(load >= cap, jnp.inf, 0.0)
+        e = jnp.argmax(s)
+        load = load.at[e].add(1)
+        out = out.at[t].set(e)
+        return (load, out), None
+
+    load0 = jnp.zeros((E,), jnp.int32)
+    out0 = jnp.zeros((T,), jnp.int32)
+    (_, out), _ = jax.lax.scan(assign_token, (load0, out0), jnp.arange(T))
+    return out
+
+
+def sam_group_sum(x, group_idx, num_groups):
+    """SamGroupSum.cu: segment-sum of gate scores per group."""
+    return jax.ops.segment_sum(x, group_idx.astype(jnp.int32),
+                               num_segments=num_groups)

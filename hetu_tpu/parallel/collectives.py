@@ -1,0 +1,144 @@
+"""Collective communication over mesh axes.
+
+Reference: /root/reference/src/communication/mpi_nccl_communication.cu — MPI-
+bootstrapped flat + grouped NCCL communicators with allreduce/reduce/bcast/
+allgather/reducescatter/p2p/alltoall and a hierarchical (node-leader)
+alltoall; Python face in python/hetu/communicator/mpi_nccl_comm.py.
+
+TPU equivalents are the XLA collectives over ICI/DCN, invoked inside
+`shard_map` over named mesh axes.  A "grouped communicator" is just a mesh
+sub-axis: every call below takes `axis_name` (or a tuple for multi-axis
+groups), which is the TPU analogue of `ncclGroupInit` sub-communicators
+(mpi_nccl_comm.py:157).  The hierarchical a2a (H_A2A, node-leader staging)
+becomes a two-stage all_to_all over ('dcn', 'ici') axes: stage within the
+fast axis first, then across the slow axis — same bandwidth shape as the
+reference's gather→a2a→scatter without explicit leader ranks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+# -- primitive wrappers (valid inside shard_map/pmapped code) --------------
+
+def all_reduce(x, axis_name, op="sum"):
+    """reference: _ncclAllReduce (mpi_nccl_communication.cu:137)."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(op)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    """reference: dlarrayAllGather (mpi_nccl_comm.py:307)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    """reference: dlarrayReduceScatter (mpi_nccl_comm.py:311)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis):
+    """reference: dlarrayAllToAll (mpi_nccl_comm.py:330) — NCCL send/recv
+    loop; on TPU a single ICI all_to_all."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def hierarchical_all_to_all(x, outer_axis, inner_axis, outer_size,
+                            inner_size, axis=0):
+    """Two-level a2a (reference HAllToAll: node-leader gather → inter-node
+    a2a → scatter, mpi_nccl_comm.py:334 + H_A2A_LayoutTransform.cu).
+
+    Drop-in equivalent of a flat ``all_to_all`` over the combined
+    (outer, inner) axis with flat rank = o * inner_size + i, but with the
+    traffic staged: first within the fast inner axis (ICI), then across the
+    slow outer axis (DCN).  Local stride-permutes between stages keep the
+    piece→destination mapping identical to the flat collective (verified
+    against it in tests/test_parallel.py).
+    """
+    No, Ni = outer_size, inner_size
+    x = jnp.moveaxis(x, axis, 0)
+    S = x.shape[0]
+    assert S % (No * Ni) == 0, f"axis size {S} not divisible by {No * Ni}"
+    piece = S // (No * Ni)
+    rest = x.shape[1:]
+    # group pieces by inner destination: [No_dest, Ni_dest, p] -> [Ni_dest,...]
+    x = x.reshape(No, Ni, piece, *rest)
+    x = jnp.swapaxes(x, 0, 1)
+    # stage 1 (ICI): route by inner destination
+    x = lax.all_to_all(x, inner_axis, split_axis=0, concat_axis=0,
+                       tiled=True)
+    # now [Ni_src, No_dest, p]; route by outer destination
+    x = jnp.swapaxes(x, 0, 1)
+    x = lax.all_to_all(x, outer_axis, split_axis=0, concat_axis=0,
+                       tiled=True)
+    # now [No_src, Ni_src, p] == flat source-rank order
+    x = x.reshape(S, *rest)
+    return jnp.moveaxis(x, 0, axis)
+
+
+def broadcast(x, axis_name, src=0):
+    """reference: dlarrayBroadcast (mpi_nccl_comm.py:303)."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def reduce_(x, axis_name, dst=0, op="sum"):
+    """reference: dlarrayNcclReduce (mpi_nccl_comm.py:299).  SPMD has no
+    single-owner tensors; the reduced value lands on every shard but callers
+    may mask to dst for parity semantics."""
+    return all_reduce(x, axis_name, op)
+
+
+def ppermute(x, axis_name, perm):
+    """Point-to-point ring/permute (reference PipelineSend/Recv pairs,
+    gpu_ops/PipelineSend.py — batched NCCL p2p)."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def send_next(x, axis_name, n):
+    """Rotate +1 along a ring of size n (pipeline stage handoff)."""
+    return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def send_prev(x, axis_name, n):
+    return lax.ppermute(x, axis_name, [(i, (i - 1) % n) for i in range(n)])
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+# -- host-level helpers ----------------------------------------------------
+
+def sharded_fn(mesh, in_specs, out_specs, fn):
+    """shard_map wrapper with hetu-style spec objects allowed."""
+    from .mesh import DistState
+
+    def norm(s):
+        if isinstance(s, DistState):
+            return s.to_pspec()
+        return s
+
+    return shard_map(fn, mesh=mesh,
+                     in_specs=jax.tree_util.tree_map(
+                         norm, in_specs,
+                         is_leaf=lambda x: isinstance(x, (P, DistState))),
+                     out_specs=jax.tree_util.tree_map(
+                         norm, out_specs,
+                         is_leaf=lambda x: isinstance(x, (P, DistState))))

@@ -1,0 +1,149 @@
+"""Context/sequence parallelism for long sequences.
+
+The reference has NO ring attention / Ulysses / blockwise CP (SURVEY.md §5:
+verified absent; only Megatron-style SP in Galvatron).  These are designed
+fresh for TPU:
+
+* **Ring attention** (`ring_attention`): sequence sharded over a 'cp' mesh
+  axis; Q stays local while K/V blocks rotate around the ICI ring via
+  `ppermute`, combined with online-softmax accumulation (flash-attention
+  style m/l/o running stats).  Communication fully overlaps compute on TPU
+  since XLA schedules the ppermute DMA concurrently with the matmuls.
+* **Ulysses attention** (`ulysses_attention`): all_to_all head↔sequence
+  resharding — attention itself stays local per device but over all tokens
+  of a subset of heads (DeepSpeed-Ulysses scheme), one a2a before and after.
+* **Megatron-SP** is subsumed by GSPMD: annotating activations
+  P('dp', 'tp', None) around LN/dropout gives the scatter/gather pairs
+  (tools/Hetu-Galvatron .../transformer.py sequence_parallel flag) without
+  explicit code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from jax import shard_map
+
+
+def _block_attend(q, k, v, m, l, o, q_off, k_off, scale, causal):
+    """One flash block: update running (m, l, o) with K/V block.
+
+    q: [B,H,Sq,D]; k,v: [B,H,Sk,D]; m,l: [B,H,Sq]; o: [B,H,Sq,D].
+    q_off/k_off are global sequence offsets of the local blocks.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        iq = q_off + jnp.arange(q.shape[-2])[:, None]
+        ik = k_off + jnp.arange(k.shape[-2])[None, :]
+        s = jnp.where(iq >= ik, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows (m_new = -inf): keep them at zero weight
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = alpha * l + jnp.sum(p, axis=-1)
+    o_new = alpha[..., None] * o + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def ring_attention_shard(q, k, v, axis_name, n_shards, causal=True,
+                         scale=None):
+    """Per-shard ring attention body (inside shard_map).
+
+    q,k,v: local [B, H, S/cp, D] blocks, sequence-sharded on `axis_name`.
+    Returns local attention output [B, H, S/cp, D].
+    """
+    seq_block = q.shape[-2]
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    my = lax.axis_index(axis_name)
+    q_off = my * seq_block
+
+    def _varying(x):
+        # scan carries start replicated but become shard-dependent
+        try:
+            return lax.pcast(x, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return x
+
+    m = _varying(jnp.full(q.shape[:-1], -jnp.inf, dtype=jnp.float32))
+    l = _varying(jnp.zeros(q.shape[:-1], dtype=jnp.float32))
+    o = _varying(jnp.zeros(q.shape, dtype=jnp.float32))
+
+    def step(carry, r):
+        k_blk, v_blk, m, l, o = carry
+        # K/V block currently held came from shard (my - r) mod n
+        src = jnp.mod(my - r, n_shards)
+        k_off = src * seq_block
+        m, l, o = _block_attend(q, k_blk, v_blk, m, l, o, q_off, k_off,
+                                scale, causal)
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, o), None
+
+    (k, v, m, l, o), _ = lax.scan(step, (k, v, m, l, o),
+                                  jnp.arange(n_shards))
+    l = jnp.maximum(l, 1e-20)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(mesh, q, k, v, *, axis="cp", causal=True, scale=None):
+    """Host-level: q,k,v [B, H, S, D] with S sharded over `axis`."""
+    n = mesh.shape[axis]
+    spec = P(None, None, axis, None)
+    f = shard_map(
+        functools.partial(ring_attention_shard, axis_name=axis, n_shards=n,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)
+
+
+def ulysses_attention_shard(q, k, v, axis_name, n_shards, causal=True,
+                            scale=None):
+    """Per-shard Ulysses body (inside shard_map over `axis_name`).
+
+    Local q,k,v: [B, H, S/n, D].  a2a → [B, H/n, S, D] (all tokens, head
+    subset) → plain attention → a2a back.
+    """
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    d = q.shape[-1]
+    scale_ = scale if scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale_
+    if causal:
+        S = s.shape[-1]
+        iq = jnp.arange(S)[:, None]
+        ik = jnp.arange(S)[None, :]
+        s = jnp.where(iq >= ik, s, -1e9)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32).astype(v.dtype)
+    return heads_to_seq(o)
+
+
+def ulysses_attention(mesh, q, k, v, *, axis="cp", causal=True, scale=None):
+    n = mesh.shape[axis]
+    assert q.shape[1] % n == 0, "num heads must divide cp degree"
+    spec = P(None, None, axis, None)
+    f = shard_map(
+        functools.partial(ulysses_attention_shard, axis_name=axis,
+                          n_shards=n, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)

@@ -1,0 +1,42 @@
+"""dispatch: per-node sharding annotations in the graph.
+
+Reference: /root/reference/python/hetu/gpu_ops/Dispatch.py — `ht.dispatch`
+placeholder ops mark TP split points; context.py's rewrite pass consumes
+them and emits comm ops.  TPU redesign: a DispatchOp lowers to
+``with_sharding_constraint`` inside the traced program, and GSPMD emits the
+collectives — same user-facing contract, compiler-backed lowering.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding
+
+from ..graph.node import Op
+from .mesh import DistState
+
+
+class DispatchOp(Op):
+    def __init__(self, node, state, name=None):
+        super().__init__(node, name=name or f"dispatch_{node.name}")
+        if not isinstance(state, DistState):
+            state = DistState(state)
+        self.state = state
+
+    def _compute(self, input_vals, ctx):
+        (x,) = input_vals
+        if ctx.mesh is None:
+            return x
+        sh = NamedSharding(ctx.mesh, self.state.to_pspec(x.ndim))
+        return jax.lax.with_sharding_constraint(x, sh)
+
+
+def dispatch(node, splits=None, partial=None, name=None):
+    """Annotate/reshard a node (reference ht.dispatch).
+
+    ``splits``: {tensor_dim: mesh_axis}.  Also records ``dist_state`` on the
+    produced node so strategies/executors can read it back.
+    """
+    op = DispatchOp(node, DistState(splits, partial), name=name)
+    op.dist_state = op.state
+    return op

@@ -1,0 +1,117 @@
+"""Device mesh + per-tensor layout algebra.
+
+Reference: /root/reference/python/hetu/context.py — `DeviceGroup` (:28) names
+device sets, `NodeStatus` (:248) is the layout spec: ``state`` (dim→#splits),
+``duplicate`` (#replicas), ``partial`` (#partial-sums), ``order`` (device
+ordering).  A graph-rewrite pass (:1469) compares producer/consumer states
+and materializes collectives by hand.
+
+TPU redesign: the mesh is a `jax.sharding.Mesh` over named axes (dp/tp/pp/
+sp/ep/cp...), and the layout spec `DistState` maps tensor dims to mesh axes —
+exactly GSPMD's model, so *lowering is the compiler's job*: annotate
+placeholders/variables (executor in_shardings) and constraint nodes
+(dispatch_op), and XLA inserts the all-reduce/all-gather/reduce-scatter/
+collective-permute the reference's cross_send/cross_receive emitted manually.
+``partial`` maps to psum-pending values inside shard_map blocks
+(parallel/tensor_parallel.py) where we take explicit control.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes, devices=None):
+    """Create a Mesh from {'axis': size} (insertion order = device-major
+    order, mirroring reference NodeStatus.order).
+
+    `axes` sizes must multiply to the device count used.  Example:
+      make_mesh({'dp': 2, 'tp': 4})  on 8 devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axes.keys())
+    sizes = tuple(int(s) for s in axes.values())
+    n = int(np.prod(sizes))
+    assert n <= len(devices), \
+        f"mesh {axes} needs {n} devices, have {len(devices)}"
+    dev_array = np.array(devices[:n]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def single_device_mesh():
+    return make_mesh({"dp": 1})
+
+
+class DistState:
+    """Per-tensor layout: dim -> mesh axis (or tuple of axes).
+
+    API parity with reference NodeStatus: ``splits`` plays the role of
+    ``state`` (which dims are split and how), ``partial`` marks pending
+    reductions over an axis, replication is implicit for unnamed axes
+    (reference ``duplicate``).
+    """
+
+    def __init__(self, splits=None, partial=None):
+        self.splits = dict(splits or {})   # {tensor_dim: axis or (axes...)}
+        self.partial = partial             # mesh axis name or None
+
+    def to_pspec(self, ndim=None):
+        if not self.splits:
+            return P()
+        if ndim is not None and max(self.splits) >= ndim:
+            raise ValueError(
+                f"DistState splits {self.splits} reference dim "
+                f">= tensor rank {ndim}")
+        ndim = ndim if ndim is not None else (max(self.splits) + 1)
+        spec = []
+        for d in range(ndim):
+            a = self.splits.get(d)
+            spec.append(a if (a is None or isinstance(a, str)) else tuple(a))
+        return P(*spec)
+
+    def __repr__(self):
+        return f"DistState(splits={self.splits}, partial={self.partial})"
+
+    # -- reference NodeStatus-style helpers --------------------------------
+    def combine(self, other):
+        s = dict(self.splits)
+        s.update(other.splits)
+        return DistState(s, self.partial or other.partial)
+
+    @staticmethod
+    def replicated():
+        return DistState()
+
+    @staticmethod
+    def shard(dim, axis):
+        return DistState({dim: axis})
+
+
+def to_named_sharding(mesh, state_or_spec, ndim=None):
+    if isinstance(state_or_spec, DistState):
+        spec = state_or_spec.to_pspec(ndim)
+    elif isinstance(state_or_spec, P):
+        spec = state_or_spec
+    else:
+        spec = P(*state_or_spec)
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+class DeviceGroup:
+    """Named device group (reference context.py:28).  On TPU a group is a
+    slice of the mesh; kept for API parity and for pipeline stage
+    assignment (raw_ctx annotations)."""
+
+    def __init__(self, devices_or_stage):
+        self.spec = devices_or_stage
+
+    def __repr__(self):
+        return f"DeviceGroup({self.spec})"

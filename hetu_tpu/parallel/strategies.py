@@ -1,0 +1,142 @@
+"""Parallelization strategies: annotate a graph with shardings.
+
+Reference: /root/reference/python/hetu/distributed_strategies/simple.py —
+`DataParallel` (:6), `ModelParallel4CNN` (:46), `ModelParallel4LM` (:113),
+`OneWeirdTrick4CNN` (:119), `MegatronLM` (:174); each assigns raw_ctx +
+NodeStatus to every node.  Here a Strategy assigns `dist_state` (mesh-axis
+layouts) to placeholders/variables; the executor turns them into jit
+in_shardings and GSPMD propagates through the program — replacing the
+reference's fixed-point NodeStatus inference (context.py:1008-1468) with the
+compiler's propagation pass.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..graph.node import PlaceholderOp, VariableOp, find_topo_sort
+from .mesh import DistState, make_mesh
+
+
+class Strategy:
+    """Base (reference distributed_strategies/base.py:13)."""
+
+    mesh = None
+
+    def annotate(self, eval_nodes):
+        raise NotImplementedError
+
+    # reference API name
+    def set_raw_ctxs_n_states(self, eval_nodes):
+        return self.annotate(eval_nodes)
+
+
+class DataParallel(Strategy):
+    """Batch-dim sharding over a 'dp' axis (reference simple.py:6).
+
+    Gradient all-reduce is implicit: batch-sharded loss + replicated params
+    make XLA insert the reduction the reference expressed as
+    AllReduceCommunicateOp on every grad edge (executor.py:278-283).
+    """
+
+    def __init__(self, mesh=None, ndev=None, axis="dp",
+                 shard_batch_dim=0):
+        self.mesh = mesh if mesh is not None else make_mesh(
+            {axis: ndev or _ndev()})
+        self.axis = axis
+        self.shard_batch_dim = shard_batch_dim
+
+    def annotate(self, eval_nodes):
+        for n in find_topo_sort(eval_nodes):
+            if isinstance(n, PlaceholderOp):
+                n.dist_state = DistState({self.shard_batch_dim: self.axis})
+        return self.mesh
+
+
+class FSDP(Strategy):
+    """ZeRO-3-style parameter sharding along the dp axis (Galvatron's
+    dp_type='fsdp', tools/Hetu-Galvatron/galvatron/core/parallel.py:166).
+    Params/optimizer state shard on dim 0; XLA all-gathers at use and
+    reduce-scatters grads."""
+
+    def __init__(self, mesh=None, ndev=None, axis="dp", min_size=1024):
+        self.mesh = mesh if mesh is not None else make_mesh(
+            {axis: ndev or _ndev()})
+        self.axis = axis
+        self.min_size = min_size
+
+    def annotate(self, eval_nodes):
+        import numpy as np
+        size = self.mesh.shape[self.axis]
+        for n in find_topo_sort(eval_nodes):
+            if isinstance(n, PlaceholderOp):
+                n.dist_state = DistState({0: self.axis})
+            elif isinstance(n, VariableOp) and n.trainable:
+                if (int(np.prod(n.shape)) >= self.min_size
+                        and n.shape and n.shape[0] % size == 0):
+                    n.dist_state = DistState({0: self.axis})
+        return self.mesh
+
+
+class MegatronLM(Strategy):
+    """2D dp×tp for transformer stacks (reference simple.py:174).
+
+    Column-parallel: QKV projections and FFN up-projection (output dim on
+    'tp'); row-parallel: attention output and FFN down-projection (input dim
+    on 'tp').  Name patterns follow the layer library's naming contract
+    (layers/attention.py, layers/transformer.py).  GSPMD inserts the psum
+    pairs the reference placed as AllReduce after row-parallel matmuls.
+    """
+
+    COL_W = re.compile(r"(_q|_k|_v|_in)_weight$")
+    COL_B = re.compile(r"(_q|_k|_v|_in)_bias$")
+    ROW_W = re.compile(r"_out_weight$")
+
+    def __init__(self, mesh=None, dp=1, tp=None, dp_axis="dp",
+                 tp_axis="tp"):
+        if mesh is None:
+            tp = tp or (_ndev() // dp)
+            mesh = make_mesh({dp_axis: dp, tp_axis: tp})
+        self.mesh = mesh
+        self.dp_axis, self.tp_axis = dp_axis, tp_axis
+
+    def annotate(self, eval_nodes):
+        tp_size = self.mesh.shape[self.tp_axis]
+        for n in find_topo_sort(eval_nodes):
+            if isinstance(n, PlaceholderOp):
+                n.dist_state = DistState({0: self.dp_axis})
+            elif isinstance(n, VariableOp):
+                if self.COL_W.search(n.name) and n.shape[1] % tp_size == 0:
+                    n.dist_state = DistState({1: self.tp_axis})
+                elif self.COL_B.search(n.name) and n.shape[0] % tp_size == 0:
+                    n.dist_state = DistState({0: self.tp_axis})
+                elif self.ROW_W.search(n.name) and n.shape[0] % tp_size == 0:
+                    n.dist_state = DistState({0: self.tp_axis})
+        return self.mesh
+
+
+class ModelParallel4CNN(Strategy):
+    """TP for the classifier head of CNNs (reference simple.py:46/119 —
+    'one weird trick': conv layers data-parallel, FC layers model-parallel)."""
+
+    def __init__(self, mesh=None, dp=1, tp=None):
+        if mesh is None:
+            tp = tp or (_ndev() // dp)
+            mesh = make_mesh({"dp": dp, "tp": tp})
+        self.mesh = mesh
+
+    def annotate(self, eval_nodes):
+        tp_size = self.mesh.shape["tp"]
+        for n in find_topo_sort(eval_nodes):
+            if isinstance(n, PlaceholderOp):
+                n.dist_state = DistState({0: "dp"})
+            elif isinstance(n, VariableOp):
+                if (n.name.endswith("_fc_weight")
+                        and n.shape[1] % tp_size == 0):
+                    n.dist_state = DistState({1: "tp"})
+        return self.mesh
+
+
+def _ndev():
+    import jax
+    return len(jax.devices())
