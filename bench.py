@@ -1,0 +1,92 @@
+"""Headline benchmark: BERT-base pretraining step throughput on one chip.
+
+Reproduces the reference's north-star config (BASELINE.md: examples/nlp/bert
+train_hetu_bert_base_dp.sh — per-device batch 64, seq 512, hidden 768,
+12 layers, Adam) and measures samples/sec on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against 55 samples/sec/chip — our standing estimate
+of per-A100 BERT-base seq-512 mixed-precision training throughput for the
+reference's 8×A100 DP configuration (the reference publishes no absolute
+numbers; BASELINE.md documents this).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+A100_BASELINE_SAMPLES_PER_SEC = 55.0
+
+
+def main():
+    quick = "--quick" in sys.argv
+    import jax
+    import jax.numpy as jnp
+    import hetu_tpu as ht
+    from hetu_tpu.models import BertConfig, BertForPreTraining
+
+    on_cpu = jax.default_backend() == "cpu"
+    if quick or on_cpu:
+        B, S = 8, 128
+        c = BertConfig(vocab_size=30522, hidden_size=768,
+                       num_hidden_layers=2, seq_len=S,
+                       max_position_embeddings=512)
+    else:
+        B, S = 32, 512
+        c = BertConfig(vocab_size=30522, hidden_size=768,
+                       num_hidden_layers=12, seq_len=S,
+                       max_position_embeddings=512)
+
+    rng = np.random.default_rng(0)
+    input_ids = ht.placeholder_op("input_ids", (B, S), dtype=np.int32)
+    token_type = ht.placeholder_op("token_type_ids", (B, S), dtype=np.int32)
+    attn_mask = ht.placeholder_op("attention_mask", (B, S))
+    mlm_labels = ht.placeholder_op("mlm_labels", (B * S,), dtype=np.int32)
+    nsp_labels = ht.placeholder_op("nsp_labels", (B,), dtype=np.int32)
+
+    model = BertForPreTraining(c)
+    loss = model.loss(input_ids, token_type, attn_mask, mlm_labels,
+                      nsp_labels)
+    opt = ht.AdamWOptimizer(learning_rate=1e-4, weight_decay=0.01)
+    # bf16 compute / f32 master weights: the MXU-native mixed precision
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
+                     compute_dtype=jnp.bfloat16)
+
+    ids = rng.integers(0, c.vocab_size, (B, S))
+    mlm = np.full((B * S,), -1, np.int64)
+    mask_pos = rng.random(B * S) < 0.15
+    mlm[mask_pos] = rng.integers(0, c.vocab_size, mask_pos.sum())
+    feed = {input_ids: ids,
+            token_type: rng.integers(0, 2, (B, S)),
+            attn_mask: np.ones((B, S), np.float32),
+            mlm_labels: mlm,
+            nsp_labels: rng.integers(0, 2, (B,))}
+
+    # warmup / compile
+    out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
+    assert np.isfinite(out[0]), "non-finite loss"
+
+    steps = 5 if (quick or on_cpu) else 20
+    start = time.perf_counter()
+    for _ in range(steps):
+        out = ex.run("train", feed_dict=feed)
+    jax.block_until_ready([o for o in out if o is not None])
+    elapsed = time.perf_counter() - start
+
+    samples_per_sec = steps * B / elapsed
+    print(json.dumps({
+        "metric": "bert_base_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / A100_BASELINE_SAMPLES_PER_SEC,
+                             3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

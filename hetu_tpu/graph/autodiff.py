@@ -46,7 +46,8 @@ class GradientsBundleOp(Op):
         # recorded them); RNG is shared so dropout masks replay identically.
         def f(x_vals):
             inner = TraceContext(key=ctx.key, training=ctx.training,
-                                 mesh=ctx.mesh)
+                                 mesh=ctx.mesh,
+                                 master_params=ctx.master_params)
             bind = {n: env[n] for n in leaves if n in env}
             bind.update(dict(zip(self.xs, x_vals)))
             (loss_val,), _ = evaluate([self.loss], bind, inner)

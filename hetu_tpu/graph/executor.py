@@ -61,19 +61,31 @@ class SubExecutor:
         topo = self.topo
         training = self.training
         mesh = self.executor.mesh
+        compute_dtype = self.executor.compute_dtype
+
+        def cast(x):
+            if compute_dtype is not None and jnp.issubdtype(
+                    x.dtype, jnp.floating):
+                return x.astype(compute_dtype)
+            return x
 
         def step_fn(params, opt_state, feeds, key):
-            ctx = TraceContext(key=key, training=training, mesh=mesh)
+            # mixed precision: forward/backward run in compute_dtype while
+            # optimizers update the full-precision masters (the standard
+            # TPU bf16-compute / f32-master-weights policy).
+            ctx = TraceContext(key=key, training=training, mesh=mesh,
+                               master_params=(params if compute_dtype
+                                              is not None else None))
             ctx.opt_state = opt_state
             bindings = {}
             for v in self.variables:
-                bindings[v] = params[v.name]
+                bindings[v] = cast(params[v.name])
             for p in placeholders:
-                bindings[p] = feeds[p.name]
+                bindings[p] = cast(feeds[p.name])
             vals, env = evaluate(eval_nodes, bindings, ctx, topo=topo)
             new_params = dict(params)
             for var, val in ctx.updates.items():
-                new_params[var.name] = val
+                new_params[var.name] = val.astype(params[var.name].dtype)
             new_opt_state = dict(opt_state)
             new_opt_state.update(ctx.new_opt_state)
             return vals, new_params, new_opt_state
@@ -135,12 +147,15 @@ class Executor:
     """
 
     def __init__(self, eval_node_dict, ctx=None, seed=0, mesh=None,
-                 dist_strategy=None, comm_mode=None, **kwargs):
+                 dist_strategy=None, comm_mode=None, compute_dtype=None,
+                 **kwargs):
         if isinstance(eval_node_dict, (list, tuple)):
             eval_node_dict = {"default": list(eval_node_dict)}
         self.eval_node_dict = {k: list(v) for k, v in eval_node_dict.items()}
         self.mesh = mesh
         self.comm_mode = comm_mode
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
         self.config = kwargs
 
         all_nodes = [n for lst in self.eval_node_dict.values() for n in lst]

@@ -30,13 +30,18 @@ class TraceContext:
       into the functional state.
     """
 
-    def __init__(self, key=None, training=True, mesh=None):
+    def __init__(self, key=None, training=True, mesh=None,
+                 master_params=None):
         self.key = key
         self.training = training
         self.mesh = mesh
         self.updates = {}        # VariableOp -> new value (tracer)
         self.opt_state = {}      # {optimizer_op_name: state pytree} (input)
         self.new_opt_state = {}  # {optimizer_op_name: state pytree} (output)
+        # mixed precision: full-precision {var_name: value} master copies;
+        # set when the executor casts bindings to a lower compute dtype so
+        # optimizers update the f32 masters, not the bf16 working copies.
+        self.master_params = master_params
 
     def rng_for(self, op: Op):
         if self.key is None:

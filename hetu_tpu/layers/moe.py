@@ -20,7 +20,6 @@ import numpy as np
 from .base import BaseLayer, fresh_name
 from ..graph.node import Op, VariableOp
 from .. import initializers as init
-from ..ops import array_reshape_op
 from ..ops.moe import top_k_gating, hash_gating
 
 

@@ -18,6 +18,8 @@ from .base import simple_op
 
 def _softmax_cross_entropy(y, y_, dim=-1):
     """y = logits, y_ = one-hot (or soft) targets; returns per-row loss."""
+    y = y.astype(jnp.float32)  # stable under bf16 compute policies
+    y_ = y_.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(y, axis=dim, keepdims=True)
     log_probs = y - lse
     return -jnp.sum(y_ * log_probs, axis=dim)
@@ -28,6 +30,7 @@ softmax_cross_entropy_op = simple_op(_softmax_cross_entropy,
 
 
 def _softmax_cross_entropy_sparse(y, labels, dim=-1, ignored_index=-1):
+    y = y.astype(jnp.float32)  # stable under bf16 compute policies
     lse = jax.scipy.special.logsumexp(y, axis=dim)
     labels = labels.astype(jnp.int32)
     picked = jnp.take_along_axis(
@@ -43,6 +46,7 @@ softmax_cross_entropy_sparse_op = simple_op(
 
 def _cross_entropy(y, y_, dim=-1, eps=1e-12):
     """y = probabilities (post-softmax), y_ = one-hot targets."""
+    y = y.astype(jnp.float32)
     return -jnp.sum(y_ * jnp.log(jnp.maximum(y, eps)), axis=dim)
 
 
@@ -50,6 +54,7 @@ crossentropy_op = simple_op(_cross_entropy, "crossentropy")
 
 
 def _cross_entropy_sparse(y, labels, dim=-1, ignored_index=-1, eps=1e-12):
+    y = y.astype(jnp.float32)
     labels = labels.astype(jnp.int32)
     picked = jnp.take_along_axis(
         y, jnp.expand_dims(jnp.maximum(labels, 0), dim), axis=dim
@@ -63,6 +68,7 @@ crossentropy_sparse_op = simple_op(_cross_entropy_sparse,
 
 
 def _nll_loss(log_probs, labels):
+    log_probs = log_probs.astype(jnp.float32)
     labels = labels.astype(jnp.int32)
     return -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
 
@@ -72,6 +78,8 @@ nll_loss_op = simple_op(_nll_loss, "nll_loss")
 
 def _bce_with_logits(logits, targets):
     # numerically stable: max(x,0) - x*z + log(1+exp(-|x|))
+    logits = logits.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
     return (jnp.maximum(logits, 0) - logits * targets
             + jnp.log1p(jnp.exp(-jnp.abs(logits))))
 
@@ -80,8 +88,10 @@ binarycrossentropywithlogits_op = simple_op(_bce_with_logits,
                                             "bce_with_logits")
 binary_cross_entropy_op = simple_op(
     lambda y, y_, eps=1e-12:
-        -(y_ * jnp.log(jnp.maximum(y, eps))
-          + (1 - y_) * jnp.log(jnp.maximum(1 - y, eps))),
+        -(y_.astype(jnp.float32)
+          * jnp.log(jnp.maximum(y.astype(jnp.float32), eps))
+          + (1 - y_.astype(jnp.float32))
+          * jnp.log(jnp.maximum(1 - y.astype(jnp.float32), eps))),
     "binary_cross_entropy")
 mse_loss_op = simple_op(
     lambda y, y_, reduction="mean":

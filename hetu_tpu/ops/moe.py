@@ -31,6 +31,8 @@ def top_k_gating(logits, k, capacity, *, second_renorm=True,
     capacity C are dropped (zero rows), as in the reference TopGate
     (python/hetu/layers/TopGate.py GShard top-2 with capacity).
     """
+    if k not in (1, 2):
+        raise ValueError(f"top_k_gating supports k in (1, 2), got k={k}")
     T, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
     if noise_rng is not None and noise_eps > 0:
@@ -105,11 +107,15 @@ topk_idx_op = simple_op(
     lambda x, k=1: jax.lax.top_k(x, k)[1], "topk_idx")
 topk_val_op = simple_op(
     lambda x, k=1: jax.lax.top_k(x, k)[0], "topk_val")
-scatter1d_op = simple_op(
-    lambda x, idx, size=None: jnp.zeros((size,) + x.shape[1:],
-                                        x.dtype).at[idx.astype(jnp.int32)]
-    .set(x),
-    "scatter1d")
+def _scatter1d(x, idx, size=None):
+    if size is None:
+        raise ValueError("scatter1d_op requires size= (static output length;"
+                         " XLA needs static shapes)")
+    return jnp.zeros((size,) + x.shape[1:],
+                     x.dtype).at[idx.astype(jnp.int32)].set(x)
+
+
+scatter1d_op = simple_op(_scatter1d, "scatter1d")
 
 
 def balance_assignment(scores, capacity=None):

@@ -87,17 +87,21 @@ log_softmax_op = simple_op(
 
 
 def _layer_norm(x, scale, bias, eps=1e-5):
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
-    return (x - mean) * lax.rsqrt(var + eps) * scale + bias
+    # moments in f32 (bf16 mean/variance loses too much precision)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    return (((xf - mean) * lax.rsqrt(var + eps)).astype(x.dtype)
+            * scale + bias)
 
 
 layer_normalization_op = simple_op(_layer_norm, "layer_normalization")
 
 
 def _rms_norm(x, scale, eps=1e-6):
-    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
-    return x * lax.rsqrt(var + eps) * scale
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * scale
 
 
 rms_norm_op = simple_op(_rms_norm, "rms_norm")
@@ -139,11 +143,22 @@ class BatchNormOp(Op):
         scale = scale.reshape(1, -1, 1, 1)
         bias = bias.reshape(1, -1, 1, 1)
         if ctx.training:
-            mean = jnp.mean(x, axis=(0, 2, 3))
-            var = jnp.var(x, axis=(0, 2, 3))
+            # batch stats in f32; running stats update against the f32
+            # masters (bf16 bindings would re-quantize them every step and
+            # round small momentum updates away)
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0, 2, 3))
+            var = jnp.var(xf, axis=(0, 2, 3))
             m = self.momentum
-            ctx.record_update(self.running_mean, (1 - m) * rmean + m * mean)
-            ctx.record_update(self.running_var, (1 - m) * rvar + m * var)
+            master = ctx.master_params
+            rm = (master[self.running_mean.name]
+                  if master is not None else rmean).astype(jnp.float32)
+            rv = (master[self.running_var.name]
+                  if master is not None else rvar).astype(jnp.float32)
+            ctx.record_update(self.running_mean, (1 - m) * rm + m * mean)
+            ctx.record_update(self.running_var, (1 - m) * rv + m * var)
+            mean = mean.astype(x.dtype)
+            var = var.astype(x.dtype)
         else:
             mean, var = rmean, rvar
         mean = mean.reshape(1, -1, 1, 1)

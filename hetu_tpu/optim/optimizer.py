@@ -213,12 +213,19 @@ class OptimizerOp(Op):
         lr = self.optimizer.lr.get(step)
         grads = [env[g] for g in self.inputs]
         if self.clip_global_norm is not None:
-            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+            # accumulate the norm in f32 (bf16 grads under mixed precision
+            # would underestimate it once the sum saturates the mantissa)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
             scale = jnp.minimum(1.0, self.clip_global_norm / (gnorm + 1e-6))
             grads = [g * scale for g in grads]
         new_slots = {}
+        master = ctx.master_params
         for var, grad in zip(self.var_list, grads):
-            param = env[var]
+            # mixed precision: update the full-precision master copy, not
+            # the low-precision working value bound in the trace env.
+            param = master[var.name] if (master is not None
+                                         and var.name in master) else env[var]
             grad = grad.astype(param.dtype)
             new_p, ns = self.optimizer.apply_dense(
                 param, grad, state["slots"][var.name], lr, step)

@@ -17,8 +17,6 @@ reference's gather→a2a→scatter without explicit leader ranks.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -122,6 +120,16 @@ def send_prev(x, axis_name, n):
 
 def axis_index(axis_name):
     return lax.axis_index(axis_name)
+
+
+def varying(x, axes):
+    """Mark an array as device-varying over mesh axes (scan carries that
+    start replicated but become shard-dependent need this under shard_map's
+    varying-manual-axes checks; no-op where lax.pcast is unavailable)."""
+    try:
+        return lax.pcast(x, tuple(axes), to="varying")
+    except (AttributeError, TypeError):
+        return x
 
 
 # -- host-level helpers ----------------------------------------------------

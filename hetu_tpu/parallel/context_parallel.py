@@ -29,6 +29,8 @@ from jax.sharding import PartitionSpec as P
 
 from jax import shard_map
 
+from .collectives import varying
+
 
 def _block_attend(q, k, v, m, l, o, q_off, k_off, scale, causal):
     """One flash block: update running (m, l, o) with K/V block.
@@ -67,16 +69,11 @@ def ring_attention_shard(q, k, v, axis_name, n_shards, causal=True,
     my = lax.axis_index(axis_name)
     q_off = my * seq_block
 
-    def _varying(x):
-        # scan carries start replicated but become shard-dependent
-        try:
-            return lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):
-            return x
-
-    m = _varying(jnp.full(q.shape[:-1], -jnp.inf, dtype=jnp.float32))
-    l = _varying(jnp.zeros(q.shape[:-1], dtype=jnp.float32))
-    o = _varying(jnp.zeros(q.shape, dtype=jnp.float32))
+    # scan carries start replicated but become shard-dependent
+    m = varying(jnp.full(q.shape[:-1], -jnp.inf, dtype=jnp.float32),
+                (axis_name,))
+    l = varying(jnp.zeros(q.shape[:-1], dtype=jnp.float32), (axis_name,))
+    o = varying(jnp.zeros(q.shape, dtype=jnp.float32), (axis_name,))
 
     def step(carry, r):
         k_blk, v_blk, m, l, o = carry

@@ -20,8 +20,6 @@ scans micro-batches with immediate backward via jax.vjp inside the loop.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -29,14 +27,11 @@ from jax.sharding import PartitionSpec as P
 
 from jax import shard_map
 
+from .collectives import varying
+
 
 def _varying(x, axes=("pp",)):
-    """Mark an array as device-varying over mesh axes (needed for scan
-    carries that start replicated but become shard-dependent)."""
-    try:
-        return lax.pcast(x, axes, to="varying")
-    except (AttributeError, TypeError):
-        return x
+    return varying(x, axes)
 
 
 def spmd_pipeline(stage_fn, n_stages, n_micro, *, remat=True):
@@ -94,11 +89,14 @@ class PipelineParallel:
 
     ``stage_fn(stage_params, x) -> x'`` is the repeated stage;
     ``loss_fn(last_out, targets) -> scalar`` closes the graph (computed
-    replicated after the pipeline).  ``schedule``: 'gpipe' (scan + grad, all
-    activations stashed unless remat) — the reference's
-    SubExecutor4Gpipe; 'interleaved' computes fwd+bwd per micro-batch
-    (1F1B-flush memory profile; reference SubExecutor4Pipedream with
-    pipedream_flush semantics).
+    replicated after the pipeline).  ``loss_fn`` MUST reduce by MEAN over
+    the leading micro-batch dimension it is given (any mean-style loss):
+    'interleaved' evaluates it per micro-batch and averages, so a sum-style
+    reduction would disagree with 'gpipe' by a factor of n_micro.
+    ``schedule``: 'gpipe' (scan + grad, all activations stashed unless
+    remat) — the reference's SubExecutor4Gpipe; 'interleaved' computes
+    fwd+bwd per micro-batch (1F1B-flush memory profile; reference
+    SubExecutor4Pipedream with pipedream_flush semantics).
     """
 
     def __init__(self, mesh, stage_fn, n_stages, n_micro, loss_fn,

@@ -1,0 +1,41 @@
+"""Mixed precision (bf16 compute / f32 master weights) tests."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+
+
+def _graph(batch=64):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((batch, 32)).astype(np.float32)
+    labels = (X[:, 0] > 0).astype(np.int64)
+    x = ht.placeholder_op("x", X.shape)
+    y = ht.placeholder_op("y", labels.shape, dtype=np.int32)
+    from hetu_tpu.models import MLP
+    logits = MLP(dims=(32, 64, 2))(x)
+    loss = ht.reduce_mean_op(ht.softmax_cross_entropy_sparse_op(logits, y))
+    opt = ht.AdamOptimizer(learning_rate=0.01)
+    return [loss, opt.minimize(loss)], {x: X, y: labels}
+
+
+def test_bf16_compute_trains_with_f32_masters():
+    nodes, feed = _graph()
+    ex = ht.Executor(nodes, compute_dtype=jnp.bfloat16)
+    losses = [float(ex.run(feed_dict=feed, convert_to_numpy_ret_vals=True)[0])
+              for _ in range(30)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.3 * losses[0]
+    # master params stay f32 even though compute runs bf16
+    for name, v in ex.params.items():
+        assert v.dtype == jnp.float32, name
+
+
+def test_bf16_loss_close_to_f32():
+    nodes, feed = _graph()
+    ex16 = ht.Executor(nodes, compute_dtype=jnp.bfloat16)
+    ex32 = ht.Executor(nodes)
+    l16 = float(ex16.run(feed_dict=feed, convert_to_numpy_ret_vals=True)[0])
+    l32 = float(ex32.run(feed_dict=feed, convert_to_numpy_ret_vals=True)[0])
+    assert abs(l16 - l32) < 0.02 * max(1.0, abs(l32))
