@@ -20,6 +20,7 @@ from .optim import (SGDOptimizer, MomentumOptimizer, AdaGradOptimizer,
                     AdamOptimizer, AdamWOptimizer, AMSGradOptimizer,
                     LambOptimizer)
 from .optim import lr_scheduler
+from . import ps
 
 __version__ = "0.1.0"
 

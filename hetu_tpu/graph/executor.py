@@ -53,11 +53,32 @@ class SubExecutor:
             "training",
             (len(self.opt_ops) > 0 or has_grads)
             and name not in ("validate", "inference", "eval"))
+        # PS-backed embeddings (ps/embedding.py PSRowsOp): gathered rows
+        # enter as feeds; their grads leave as hidden outputs pushed to the
+        # host store after the step (reference hybrid comm_mode, where
+        # embedding params bypass the dense path via PS push/pull).
+        self.ps_rows = [p for p in self.placeholders
+                        if hasattr(p, "ps_embedding")]
+        self._ps_grad_nodes = []
+        if self.training and self.ps_rows:
+            losses = [op.loss for op in self.opt_ops
+                      if getattr(op, "loss", None) is not None]
+            if losses:
+                from .autodiff import gradients
+                # PS rows may feed any optimized loss: differentiate their
+                # sum (total sensitivity) so no server update is dropped
+                total = losses[0]
+                for extra in losses[1:]:
+                    total = total + extra
+                self._ps_grad_nodes = gradients(total, self.ps_rows)
+        self._all_eval = self.eval_nodes + self._ps_grad_nodes
+        if self._ps_grad_nodes:
+            self.topo = find_topo_sort(self._all_eval)
         self._jitted = None
 
     def _build(self):
         placeholders = self.placeholders
-        eval_nodes = self.eval_nodes
+        eval_nodes = self._all_eval
         topo = self.topo
         training = self.training
         mesh = self.executor.mesh
@@ -107,9 +128,29 @@ class SubExecutor:
         for node, value in feed_dict.items():
             name = node.name if isinstance(node, Op) else node
             feeds[name] = value
+        # PS embeddings: gather rows on host (through the HET cache when
+        # configured) and feed them (reference SparsePull prefetch path)
+        ps_ids = {}
+        for p in self.ps_rows:
+            ids_name = p.ids_node.name
+            if ids_name not in feeds:
+                raise ValueError(
+                    f"PS embedding {p.name} needs ids feed '{ids_name}'")
+            ids_val = np.asarray(feeds[ids_name])
+            ps_ids[p.name] = ids_val
+            rows = p.ps_embedding.lookup(ids_val)
+            # shape follows the FED ids (a new batch size just retraces,
+            # per the executor's shape contract above)
+            feeds[p.name] = rows.reshape(
+                ids_val.shape + (p.ps_embedding.embedding_dim,))
         missing = [p.name for p in self.placeholders if p.name not in feeds]
         if missing:
             raise ValueError(f"missing feeds for placeholders: {missing}")
+        # drop feeds that aren't placeholders of THIS subgraph (e.g. ids
+        # consumed only by the PS lookup above): extra keys would change the
+        # jit pytree and break against in_shardings
+        names = {p.name for p in self.placeholders}
+        feeds = {k: v for k, v in feeds.items() if k in names}
         # cast feeds to declared dtypes (reference DataloaderOp feeds float32)
         for p in self.placeholders:
             v = feeds[p.name]
@@ -121,6 +162,14 @@ class SubExecutor:
             ex.params, ex.opt_state, feeds, key)
         ex.params = new_params
         ex.opt_state = new_opt_state
+        # push PS-embedding grads to the host store (server-side optimizer)
+        if self._ps_grad_nodes:
+            n_user = len(self.eval_nodes)
+            for p, gval in zip(self.ps_rows, vals[n_user:]):
+                g = np.asarray(gval, dtype=np.float32).reshape(
+                    -1, p.ps_embedding.embedding_dim)
+                p.ps_embedding.push_grad(ps_ids[p.name], g)
+            vals = vals[:n_user]
         if convert_to_numpy_ret_vals:
             vals = [None if v is None else np.asarray(v) for v in vals]
         return vals

@@ -38,9 +38,13 @@ class WDL:
     """Wide & Deep (reference wdl_criteo: 13 dense + 26 sparse slots)."""
 
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
-                 num_dense=13, hidden=(256, 256, 256), name="wdl"):
-        self.emb = SparseFeatureEmbedding(num_embeddings, embedding_dim,
-                                          num_sparse, name=f"{name}_emb")
+                 num_dense=13, hidden=(256, 256, 256), name="wdl",
+                 ps_embedding=None):
+        # ps_embedding: a ps.PSEmbedding — the HET cached-PS path for tables
+        # that don't fit HBM (reference examples/ctr hybrid_wdl: embeddings
+        # via PS + cache, dense params via the device optimizer)
+        self.emb = ps_embedding or SparseFeatureEmbedding(
+            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb")
         # wide part: linear over dense features
         self.wide = Linear(num_dense, 1, name=f"{name}_wide")
         dims = [num_sparse * embedding_dim + num_dense] + list(hidden)
@@ -83,9 +87,10 @@ class DeepFM:
     """DeepFM (reference dfm_criteo)."""
 
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
-                 num_dense=13, hidden=(256, 256), name="dfm"):
-        self.emb = SparseFeatureEmbedding(num_embeddings, embedding_dim,
-                                          num_sparse, name=f"{name}_emb")
+                 num_dense=13, hidden=(256, 256), name="dfm",
+                 ps_embedding=None):
+        self.emb = ps_embedding or SparseFeatureEmbedding(
+            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb")
         self.first_order = VariableOp(f"{name}_fo", (num_embeddings, 1),
                                       init.normal(0.0, 0.01))
         dims = [num_sparse * embedding_dim + num_dense] + list(hidden)
@@ -128,9 +133,10 @@ class DCN:
     """Deep & Cross Network."""
 
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
-                 num_dense=13, num_cross=3, hidden=(256, 256), name="dcn"):
-        self.emb = SparseFeatureEmbedding(num_embeddings, embedding_dim,
-                                          num_sparse, name=f"{name}_emb")
+                 num_dense=13, num_cross=3, hidden=(256, 256), name="dcn",
+                 ps_embedding=None):
+        self.emb = ps_embedding or SparseFeatureEmbedding(
+            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb")
         d = num_sparse * embedding_dim + num_dense
         self.cross_w = [VariableOp(f"{name}_cw{i}", (d,),
                                    init.normal(0.0, 0.01))
@@ -178,9 +184,9 @@ class DLRMInteractionOp(Op):
 class DLRM:
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, bottom=(512, 256), top=(512, 256),
-                 name="dlrm"):
-        self.emb = SparseFeatureEmbedding(num_embeddings, embedding_dim,
-                                          num_sparse, name=f"{name}_emb")
+                 name="dlrm", ps_embedding=None):
+        self.emb = ps_embedding or SparseFeatureEmbedding(
+            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb")
         bd = [num_dense] + list(bottom) + [embedding_dim]
         self.bottom = [Linear(bd[i], bd[i + 1], name=f"{name}_bot{i}")
                        for i in range(len(bd) - 1)]

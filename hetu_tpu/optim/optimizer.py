@@ -50,8 +50,12 @@ class Optimizer:
         from ..graph.node import graph_variables
         if var_list is None:
             var_list = graph_variables([loss], trainable_only=True)
-        grads = gradients(loss, var_list)
-        return OptimizerOp(grads, var_list, self)
+        # var_list may be empty (all params PS-resident); the OptimizerOp
+        # then only anchors the loss for PS-embedding grad derivation
+        grads = gradients(loss, var_list) if var_list else []
+        op = OptimizerOp(grads, var_list, self)
+        op.loss = loss  # lets the executor derive PS-embedding grads
+        return op
 
     def apply_gradients(self, grads_and_vars):
         grads, var_list = zip(*grads_and_vars)
@@ -192,6 +196,7 @@ class OptimizerOp(Op):
         self.var_list = list(var_list)
         self.optimizer = optimizer
         self.clip_global_norm = clip_global_norm
+        self.loss = None
         for v in var_list:
             assert isinstance(v, VariableOp), f"cannot optimize {v}"
 

@@ -1,0 +1,9 @@
+"""Parameter-server / embedding-store subsystem (reference: ps-lite +
+src/hetu_cache + python/hetu/cstable.py; see SURVEY.md N8/N9/P17)."""
+
+from .store import (EmbeddingTable, CacheTable, ShardedTable, SSPController)
+from .cstable import CacheSparseTable
+from .embedding import PSEmbedding, PSRowsOp
+
+__all__ = ["EmbeddingTable", "CacheTable", "ShardedTable", "SSPController",
+           "CacheSparseTable", "PSEmbedding", "PSRowsOp"]

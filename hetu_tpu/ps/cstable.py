@@ -1,0 +1,50 @@
+"""CacheSparseTable: async cached-embedding front-end.
+
+API parity with reference python/hetu/cstable.py:19 — `embedding_lookup` /
+`embedding_update` / `embedding_push_pull` return wait handles (futures) so
+host cache traffic overlaps device compute, and perf counters report
+hit/miss/transfer rates (reference cstable.py:126-187).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .store import EmbeddingTable, CacheTable
+
+
+class CacheSparseTable:
+    def __init__(self, rows, dim, cache_limit, policy="lru", pull_bound=0,
+                 push_bound=1, optimizer="sgd", lr=0.01, seed=0, **opt_kw):
+        self.table = EmbeddingTable(rows, dim, optimizer=optimizer, lr=lr,
+                                    seed=seed, **opt_kw)
+        self.cache = CacheTable(self.table, cache_limit, policy=policy,
+                                pull_bound=pull_bound, push_bound=push_bound)
+        self.rows, self.dim = rows, dim
+        # single worker thread preserves lookup/update ordering (the
+        # reference's async client pushes through one agent thread too)
+        self._pool = ThreadPoolExecutor(max_workers=1)
+
+    def embedding_lookup(self, keys):
+        """Async lookup; returns a future whose result is [n, dim] f32."""
+        keys = np.asarray(keys)
+        return self._pool.submit(self.cache.lookup, keys)
+
+    def embedding_update(self, keys, grads):
+        keys = np.asarray(keys)
+        grads = np.asarray(grads, np.float32)
+        return self._pool.submit(self.cache.update, keys, grads)
+
+    def embedding_push_pull(self, push_keys, grads, pull_keys):
+        def work():
+            self.cache.update(push_keys, grads)
+            return self.cache.lookup(pull_keys)
+        return self._pool.submit(work)
+
+    def flush(self):
+        self._pool.submit(self.cache.flush).result()
+
+    def perf(self):
+        return self.cache.stats()

@@ -1,0 +1,98 @@
+"""PS-backed embedding layers wired into the graph executor.
+
+Reference path (SURVEY.md §3.4): an EmbeddingLookUp node with a
+`cstable_policy` runs outside the dense graph — keys go to the HET cache /
+PS, gathered rows are staged H2D, and the backward IndexedSlices grad is
+pushed back to the server-side optimizer (ParameterServerCommunicate.py:40-56,
+hetu_cache client).
+
+TPU redesign: the XLA program stays static — the gathered rows enter the
+jitted step as a feed (`PSRowsOp`, a placeholder subclass), and the rows'
+gradient leaves as an extra (hidden) output that the executor pushes to the
+host store after the step.  The device program never sees the table, so
+million-row embeddings live in host RAM, exactly like the reference's PS
+workers, while XLA sees a dense [batch, dim] input.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import PlaceholderOp, Op
+from .store import EmbeddingTable, CacheTable
+
+
+class PSRowsOp(PlaceholderOp):
+    """Placeholder carrying PS-gathered embedding rows [*, dim].
+
+    The executor recognizes this subclass: it fills the feed from the
+    bound ids feed via the table/cache, and pushes d loss/d rows back."""
+
+    __slots__ = ("ps_embedding", "ids_node")
+
+    def __init__(self, name, shape, ps_embedding, ids_node):
+        super().__init__(name, shape=shape, dtype=np.float32)
+        self.ps_embedding = ps_embedding
+        self.ids_node = ids_node
+
+
+class PSEmbedding:
+    """Embedding table living in the host-side store (optionally cached).
+
+    ``optimizer``/``lr`` are the SERVER-side update rule (the device-side
+    Optimizer never sees these parameters, mirroring comm_mode='PS'/'Hybrid'
+    where embeddings bypass the dense allreduce path).
+    """
+
+    _count = [0]
+
+    def __init__(self, num_embeddings, embedding_dim, optimizer="sgd",
+                 lr=0.01, cache_limit=None, policy="lru", pull_bound=0,
+                 push_bound=1, seed=0, name=None, **opt_kw):
+        PSEmbedding._count[0] += 1
+        self.name = name or f"ps_embedding_{PSEmbedding._count[0]}"
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.table = EmbeddingTable(num_embeddings, embedding_dim,
+                                    optimizer=optimizer, lr=lr, seed=seed,
+                                    **opt_kw)
+        self.cache = (CacheTable(self.table, cache_limit, policy=policy,
+                                 pull_bound=pull_bound,
+                                 push_bound=push_bound)
+                      if cache_limit else None)
+        self._lookup_count = 0
+
+    # -- host-side data path ------------------------------------------------
+    def lookup(self, keys):
+        self._lookup_count += 1
+        if self.cache is not None:
+            return self.cache.lookup(keys)
+        return self.table.lookup(keys)
+
+    def push_grad(self, keys, grads):
+        # dedup duplicate ids (sum their grads) so each row gets ONE
+        # optimizer step per batch — reference ReduceIndexedSlice.cu
+        # (unique + segment-sum) ahead of the sparse optimizer kernels
+        keys = np.asarray(keys).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(keys.size, -1)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        summed = np.zeros((uniq.size, grads.shape[1]), np.float32)
+        np.add.at(summed, inv, grads)
+        if self.cache is not None:
+            self.cache.update(uniq, summed)
+        else:
+            self.table.push(uniq, summed)
+
+    def flush(self):
+        if self.cache is not None:
+            self.cache.flush()
+
+    def stats(self):
+        return self.cache.stats() if self.cache is not None else {}
+
+    # -- graph construction -------------------------------------------------
+    def __call__(self, ids_node):
+        assert isinstance(ids_node, Op), "pass the ids placeholder node"
+        shape = tuple(ids_node.shape) + (self.embedding_dim,)
+        return PSRowsOp(f"{self.name}_rows_{ids_node.name}", shape, self,
+                        ids_node)

@@ -1,0 +1,547 @@
+// hetu_ps: host-side sharded embedding parameter store with server-side
+// optimizers, versioned rows, bounded-staleness client caches, and SSP
+// clocks.
+//
+// TPU-native counterpart of the reference's parameter-server stack:
+//   * ps-lite KVServer + server optimizers  (ps-lite/include/ps/server/
+//     kvserver.h:19, optimizer.h:36-205, param.h:21 — versioned CacheTable
+//     rows at param.h:119)
+//   * HET client cache with pull/push staleness bounds (src/hetu_cache/
+//     include/cache.h:21-58, lru_cache.cc, lfu_cache.cc, lfuopt_cache.cc)
+//   * SSP consistency clocks (ps-lite/include/ps/psf/ssp.h:10-32)
+//
+// Design differences from the reference (not a port): there is no RPC van —
+// on TPU VMs the store lives in host RAM of each worker and is reached by
+// direct calls from the training process (DCN sharding is layered on top in
+// Python, hetu_tpu/ps/store.py). Tables are flat preallocated arrays (rows
+// are hot in the embedding workloads this serves), sharded 64-way by key for
+// lock granularity, with row versions driving both SSP and HET bounds.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 64;
+
+inline int shard_of(int64_t key) { return static_cast<int>(key & (kShards - 1)); }
+
+enum OptType { OPT_SGD = 0, OPT_MOMENTUM = 1, OPT_ADAGRAD = 2, OPT_ADAM = 3 };
+enum Policy { POLICY_LRU = 0, POLICY_LFU = 1, POLICY_LFUOPT = 2 };
+
+struct Table {
+  int64_t rows = 0, dim = 0;
+  int opt = OPT_SGD;
+  float lr = 0.01f, beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f, wd = 0.f;
+  std::vector<float> data;
+  std::vector<uint64_t> version;   // bumped on every push to a row
+  std::vector<float> slot1;        // momentum / adagrad accum / adam m
+  std::vector<float> slot2;        // adam v
+  std::vector<uint64_t> steps;     // per-row adam step counters
+  std::mutex locks[kShards];
+
+  void ensure_slots() {
+    if (opt == OPT_SGD) return;
+    if (slot1.empty()) slot1.assign(data.size(), 0.f);
+    if (opt == OPT_ADAM && slot2.empty()) slot2.assign(data.size(), 0.f);
+    if (opt == OPT_ADAM && steps.empty()) steps.assign(rows, 0);
+  }
+
+  // server-side optimizer step for one row (reference: ps-lite server
+  // optimizers optimizer.h:36-205 apply per-key updates)
+  void apply_row(int64_t row, const float* grad) {
+    float* w = data.data() + row * dim;
+    switch (opt) {
+      case OPT_SGD:
+        for (int64_t j = 0; j < dim; ++j)
+          w[j] -= lr * (grad[j] + wd * w[j]);
+        break;
+      case OPT_MOMENTUM: {
+        float* v = slot1.data() + row * dim;
+        for (int64_t j = 0; j < dim; ++j) {
+          v[j] = beta1 * v[j] - lr * (grad[j] + wd * w[j]);
+          w[j] += v[j];
+        }
+        break;
+      }
+      case OPT_ADAGRAD: {
+        float* acc = slot1.data() + row * dim;
+        for (int64_t j = 0; j < dim; ++j) {
+          float g = grad[j] + wd * w[j];
+          acc[j] += g * g;
+          w[j] -= lr * g / (std::sqrt(acc[j]) + eps);
+        }
+        break;
+      }
+      case OPT_ADAM: {
+        float* m = slot1.data() + row * dim;
+        float* v = slot2.data() + row * dim;
+        uint64_t t = ++steps[row];
+        float bc1 = 1.f - std::pow(beta1, static_cast<float>(t));
+        float bc2 = 1.f - std::pow(beta2, static_cast<float>(t));
+        for (int64_t j = 0; j < dim; ++j) {
+          float g = grad[j] + wd * w[j];
+          m[j] = beta1 * m[j] + (1.f - beta1) * g;
+          v[j] = beta2 * v[j] + (1.f - beta2) * g * g;
+          w[j] -= lr * (m[j] / bc1) / (std::sqrt(v[j] / bc2) + eps);
+        }
+        break;
+      }
+    }
+  }
+};
+
+// HET client cache: fixed-slot store of hot rows with per-row cached
+// versions; hits served while version lag <= pull_bound; local gradient
+// accumulation flushed to the table after push_bound updates per row.
+struct Cache {
+  Table* table = nullptr;
+  int64_t limit = 0, dim = 0;
+  int policy = POLICY_LRU;
+  uint64_t pull_bound = 0, push_bound = 0;
+  std::unordered_map<int64_t, int64_t> slot_of;
+  std::vector<int64_t> key_of;       // slot -> key (-1 empty)
+  std::vector<float> rows;           // limit x dim cached values
+  std::vector<float> pending;        // limit x dim accumulated grads
+  std::vector<uint32_t> pend_count;  // updates since last flush
+  std::vector<uint64_t> cached_ver;
+  std::vector<uint64_t> last_use;    // LRU tick
+  std::vector<uint64_t> freq;        // LFU counter
+  uint64_t tick = 0;
+  std::mutex mu;
+  // perf counters (reference cstable.py:126-187 records the same)
+  std::atomic<int64_t> hits{0}, misses{0}, pushes{0}, evictions{0};
+
+  int64_t pick_victim() {
+    // all slots full: evict by policy
+    int64_t best = 0;
+    for (int64_t s = 1; s < limit; ++s) {
+      bool better = false;
+      switch (policy) {
+        case POLICY_LRU: better = last_use[s] < last_use[best]; break;
+        case POLICY_LFU: better = freq[s] < freq[best]; break;
+        case POLICY_LFUOPT:  // LFU with LRU tiebreak + freq aging on evict
+          better = freq[s] < freq[best] ||
+                   (freq[s] == freq[best] && last_use[s] < last_use[best]);
+          break;
+      }
+      if (better) best = s;
+    }
+    return best;
+  }
+
+  void flush_slot(int64_t s) {
+    if (pend_count[s] == 0) return;
+    int64_t key = key_of[s];
+    auto& lock = table->locks[shard_of(key)];
+    {
+      std::lock_guard<std::mutex> g(lock);
+      table->apply_row(key, pending.data() + s * dim);
+      table->version[key] += 1;
+      // refresh local copy so subsequent reads see the applied update
+      std::memcpy(rows.data() + s * dim, table->data.data() + key * dim,
+                  sizeof(float) * dim);
+      cached_ver[s] = table->version[key];
+    }
+    std::memset(pending.data() + s * dim, 0, sizeof(float) * dim);
+    pend_count[s] = 0;
+    pushes.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // returns slot holding key, admitting (and possibly evicting) on miss
+  int64_t admit(int64_t key) {
+    auto it = slot_of.find(key);
+    if (it != slot_of.end()) return it->second;
+    int64_t s;
+    if (static_cast<int64_t>(slot_of.size()) < limit) {
+      s = static_cast<int64_t>(slot_of.size());
+    } else {
+      s = pick_victim();
+      flush_slot(s);
+      slot_of.erase(key_of[s]);
+      evictions.fetch_add(1, std::memory_order_relaxed);
+      if (policy == POLICY_LFUOPT) {  // age frequencies so old heat decays
+        for (int64_t i = 0; i < limit; ++i) freq[i] >>= 1;
+      }
+    }
+    // fetch fresh row from table
+    auto& lock = table->locks[shard_of(key)];
+    {
+      std::lock_guard<std::mutex> g(lock);
+      std::memcpy(rows.data() + s * dim, table->data.data() + key * dim,
+                  sizeof(float) * dim);
+      cached_ver[s] = table->version[key];
+    }
+    key_of[s] = key;
+    slot_of[key] = s;
+    freq[s] = 0;
+    pend_count[s] = 0;
+    std::memset(pending.data() + s * dim, 0, sizeof(float) * dim);
+    return s;
+  }
+};
+
+struct SSPClock {
+  std::vector<std::atomic<int64_t>> clocks;
+  explicit SSPClock(int n) : clocks(n) {
+    for (auto& c : clocks) c.store(0);
+  }
+};
+
+std::mutex g_registry_mu;
+std::unordered_map<int64_t, Table*> g_tables;
+std::unordered_map<int64_t, Cache*> g_caches;
+std::unordered_map<int64_t, SSPClock*> g_clocks;
+int64_t g_next_handle = 1;
+
+template <typename M, typename T>
+int64_t register_handle(M& map, T* obj) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  int64_t h = g_next_handle++;
+  map[h] = obj;
+  return h;
+}
+
+Table* table_of(int64_t h) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_tables.find(h);
+  return it == g_tables.end() ? nullptr : it->second;
+}
+
+Cache* cache_of(int64_t h) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_caches.find(h);
+  return it == g_caches.end() ? nullptr : it->second;
+}
+
+// chunked multithreading for big batches (lookup/push are memory-bound)
+void parallel_for(int64_t n, int64_t grain,
+                  const std::function<void(int64_t, int64_t)>& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (n < grain * 2 || hw <= 1) {
+    fn(0, n);
+    return;
+  }
+  int64_t nthreads = std::min<int64_t>(hw, (n + grain - 1) / grain);
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int64_t t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(fn, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ps_table_create(int64_t rows, int64_t dim, int opt_type, float lr,
+                        float beta1, float beta2, float eps, float wd) {
+  auto* t = new Table();
+  t->rows = rows;
+  t->dim = dim;
+  t->opt = opt_type;
+  t->lr = lr;
+  t->beta1 = beta1;
+  t->beta2 = beta2;
+  t->eps = eps;
+  t->wd = wd;
+  t->data.assign(static_cast<size_t>(rows) * dim, 0.f);
+  t->version.assign(rows, 0);
+  t->ensure_slots();
+  return register_handle(g_tables, t);
+}
+
+void ps_table_destroy(int64_t h) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_tables.find(h);
+  if (it != g_tables.end()) {
+    delete it->second;
+    g_tables.erase(it);
+  }
+}
+
+int64_t ps_table_rows(int64_t h) { Table* t = table_of(h); return t ? t->rows : -1; }
+int64_t ps_table_dim(int64_t h) { Table* t = table_of(h); return t ? t->dim : -1; }
+
+// uniform(-scale, scale) init, seeded (reference: init_on_ps initializers)
+void ps_table_init_uniform(int64_t h, uint64_t seed, float scale) {
+  Table* t = table_of(h);
+  if (!t) return;
+  parallel_for(t->rows, 1 << 14, [&](int64_t lo, int64_t hi) {
+    std::mt19937_64 gen(seed + static_cast<uint64_t>(lo));
+    std::uniform_real_distribution<float> dist(-scale, scale);
+    for (int64_t r = lo; r < hi; ++r)
+      for (int64_t j = 0; j < t->dim; ++j) t->data[r * t->dim + j] = dist(gen);
+  });
+}
+
+void ps_table_set_rows(int64_t h, const int64_t* keys, int64_t n,
+                       const float* vals) {
+  Table* t = table_of(h);
+  if (!t) return;
+  parallel_for(n, 1 << 12, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t k = keys[i];
+      if (k < 0 || k >= t->rows) continue;
+      std::lock_guard<std::mutex> g(t->locks[shard_of(k)]);
+      std::memcpy(t->data.data() + k * t->dim, vals + i * t->dim,
+                  sizeof(float) * t->dim);
+      t->version[k] += 1;
+    }
+  });
+}
+
+void ps_table_lookup(int64_t h, const int64_t* keys, int64_t n, float* out) {
+  Table* t = table_of(h);
+  if (!t) return;
+  parallel_for(n, 1 << 12, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t k = keys[i];
+      if (k < 0 || k >= t->rows) {  // pad ids read as zero rows
+        std::memset(out + i * t->dim, 0, sizeof(float) * t->dim);
+        continue;
+      }
+      std::lock_guard<std::mutex> g(t->locks[shard_of(k)]);
+      std::memcpy(out + i * t->dim, t->data.data() + k * t->dim,
+                  sizeof(float) * t->dim);
+    }
+  });
+}
+
+void ps_table_versions(int64_t h, const int64_t* keys, int64_t n,
+                       uint64_t* out) {
+  Table* t = table_of(h);
+  if (!t) return;
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = (keys[i] >= 0 && keys[i] < t->rows) ? t->version[keys[i]] : 0;
+}
+
+// push gradients; server-side optimizer applies them (DensePush/SparsePush
+// semantics: duplicate keys in one batch apply sequentially)
+void ps_table_push(int64_t h, const int64_t* keys, const float* grads,
+                   int64_t n) {
+  Table* t = table_of(h);
+  if (!t) return;
+  parallel_for(n, 1 << 12, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t k = keys[i];
+      // skip padded slots from fixed-size dedup buffers + out-of-range ids
+      if (k < 0 || k >= t->rows) continue;
+      std::lock_guard<std::mutex> g(t->locks[shard_of(k)]);
+      t->apply_row(k, grads + i * t->dim);
+      t->version[k] += 1;
+    }
+  });
+}
+
+int ps_table_save(int64_t h, const char* path) {
+  Table* t = table_of(h);
+  if (!t) return -1;
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  std::fwrite(&t->rows, sizeof(int64_t), 1, f);
+  std::fwrite(&t->dim, sizeof(int64_t), 1, f);
+  std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
+  std::fwrite(t->version.data(), sizeof(uint64_t), t->version.size(), f);
+  if (!t->slot1.empty())
+    std::fwrite(t->slot1.data(), sizeof(float), t->slot1.size(), f);
+  if (!t->slot2.empty())
+    std::fwrite(t->slot2.data(), sizeof(float), t->slot2.size(), f);
+  if (!t->steps.empty())
+    std::fwrite(t->steps.data(), sizeof(uint64_t), t->steps.size(), f);
+  std::fclose(f);
+  return 0;
+}
+
+int ps_table_load(int64_t h, const char* path) {
+  Table* t = table_of(h);
+  if (!t) return -1;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t rows = 0, dim = 0;
+  if (std::fread(&rows, sizeof(int64_t), 1, f) != 1 ||
+      std::fread(&dim, sizeof(int64_t), 1, f) != 1 || rows != t->rows ||
+      dim != t->dim) {
+    std::fclose(f);
+    return -2;
+  }
+  bool ok = std::fread(t->data.data(), sizeof(float), t->data.size(), f) ==
+            t->data.size();
+  ok = ok && std::fread(t->version.data(), sizeof(uint64_t),
+                        t->version.size(), f) == t->version.size();
+  if (ok && !t->slot1.empty())
+    ok = std::fread(t->slot1.data(), sizeof(float), t->slot1.size(), f) ==
+         t->slot1.size();
+  if (ok && !t->slot2.empty())
+    ok = std::fread(t->slot2.data(), sizeof(float), t->slot2.size(), f) ==
+         t->slot2.size();
+  if (ok && !t->steps.empty())
+    ok = std::fread(t->steps.data(), sizeof(uint64_t), t->steps.size(), f) ==
+         t->steps.size();
+  std::fclose(f);
+  return ok ? 0 : -3;  // -3: truncated/short file
+}
+
+// ---- HET client cache -----------------------------------------------------
+
+int64_t ps_cache_create(int64_t table_h, int64_t limit, int policy,
+                        int64_t pull_bound, int64_t push_bound) {
+  Table* t = table_of(table_h);
+  if (!t) return -1;
+  auto* c = new Cache();
+  c->table = t;
+  c->limit = limit;
+  c->dim = t->dim;
+  c->policy = policy;
+  c->pull_bound = static_cast<uint64_t>(pull_bound);
+  c->push_bound = static_cast<uint64_t>(push_bound);
+  c->key_of.assign(limit, -1);
+  c->rows.assign(static_cast<size_t>(limit) * t->dim, 0.f);
+  c->pending.assign(static_cast<size_t>(limit) * t->dim, 0.f);
+  c->pend_count.assign(limit, 0);
+  c->cached_ver.assign(limit, 0);
+  c->last_use.assign(limit, 0);
+  c->freq.assign(limit, 0);
+  return register_handle(g_caches, c);
+}
+
+void ps_cache_destroy(int64_t h) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_caches.find(h);
+  if (it != g_caches.end()) {
+    delete it->second;
+    g_caches.erase(it);
+  }
+}
+
+// batched lookup through the cache (reference cache.h:54 batchedLookup):
+// hit if present AND version lag <= pull_bound; else refetch.
+void ps_cache_lookup(int64_t h, const int64_t* keys, int64_t n, float* out) {
+  Cache* c = cache_of(h);
+  if (!c) return;
+  std::lock_guard<std::mutex> g(c->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = keys[i];
+    if (key < 0 || key >= c->table->rows) {  // padding / out-of-range
+      std::memset(out + i * c->dim, 0, sizeof(float) * c->dim);
+      continue;
+    }
+    auto it = c->slot_of.find(key);
+    bool hit = false;
+    int64_t s = -1;
+    if (it != c->slot_of.end()) {
+      s = it->second;
+      uint64_t cur = c->table->version[key];  // racy read is fine: bound check
+      hit = (cur - c->cached_ver[s]) <= c->pull_bound;
+    }
+    if (hit) {
+      c->hits.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      c->misses.fetch_add(1, std::memory_order_relaxed);
+      if (s >= 0) {  // stale: refetch in place
+        auto& lock = c->table->locks[shard_of(key)];
+        std::lock_guard<std::mutex> tg(lock);
+        std::memcpy(c->rows.data() + s * c->dim,
+                    c->table->data.data() + key * c->dim,
+                    sizeof(float) * c->dim);
+        c->cached_ver[s] = c->table->version[key];
+      } else {
+        s = c->admit(key);
+      }
+    }
+    c->last_use[s] = ++c->tick;
+    c->freq[s] += 1;
+    std::memcpy(out + i * c->dim, c->rows.data() + s * c->dim,
+                sizeof(float) * c->dim);
+  }
+}
+
+// buffered sparse update: accumulate grads locally; flush a row to the
+// server optimizer once it has seen push_bound updates (reference
+// cache.h:25 push_bound_ write buffering)
+void ps_cache_update(int64_t h, const int64_t* keys, const float* grads,
+                     int64_t n) {
+  Cache* c = cache_of(h);
+  if (!c) return;
+  std::lock_guard<std::mutex> g(c->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = keys[i];
+    if (key < 0 || key >= c->table->rows) continue;
+    int64_t s = c->admit(key);
+    float* p = c->pending.data() + s * c->dim;
+    const float* gr = grads + i * c->dim;
+    for (int64_t j = 0; j < c->dim; ++j) p[j] += gr[j];
+    c->pend_count[s] += 1;
+    c->last_use[s] = ++c->tick;
+    if (c->pend_count[s] >= c->push_bound) c->flush_slot(s);
+  }
+}
+
+void ps_cache_flush(int64_t h) {
+  Cache* c = cache_of(h);
+  if (!c) return;
+  std::lock_guard<std::mutex> g(c->mu);
+  for (int64_t s = 0; s < c->limit; ++s)
+    if (c->key_of[s] >= 0) c->flush_slot(s);
+}
+
+void ps_cache_stats(int64_t h, int64_t* hits, int64_t* misses,
+                    int64_t* pushes, int64_t* evictions) {
+  Cache* c = cache_of(h);
+  if (!c) return;
+  *hits = c->hits.load();
+  *misses = c->misses.load();
+  *pushes = c->pushes.load();
+  *evictions = c->evictions.load();
+}
+
+// ---- SSP clocks -----------------------------------------------------------
+
+int64_t ssp_create(int nworkers) {
+  return register_handle(g_clocks, new SSPClock(nworkers));
+}
+
+void ssp_destroy(int64_t h) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_clocks.find(h);
+  if (it != g_clocks.end()) {
+    delete it->second;
+    g_clocks.erase(it);
+  }
+}
+
+void ssp_tick(int64_t h, int worker) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_clocks.find(h);
+  if (it != g_clocks.end()) it->second->clocks[worker].fetch_add(1);
+}
+
+int64_t ssp_clock(int64_t h, int worker) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_clocks.find(h);
+  return it == g_clocks.end() ? -1 : it->second->clocks[worker].load();
+}
+
+int64_t ssp_min(int64_t h) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_clocks.find(h);
+  if (it == g_clocks.end()) return -1;
+  int64_t m = INT64_MAX;
+  for (auto& c : it->second->clocks) m = std::min(m, c.load());
+  return m;
+}
+
+}  // extern "C"

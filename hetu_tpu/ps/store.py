@@ -1,0 +1,213 @@
+"""Python face of the native embedding store.
+
+Reference mapping:
+  * `EmbeddingTable`  ≈ ps-lite server Param/CacheTable rows with server-side
+    optimizers (ps-lite/include/ps/server/param.h:21, optimizer.h:36-205)
+  * `CacheTable`      ≈ HET client cache (src/hetu_cache/include/cache.h:21)
+  * `SSPController`   ≈ SSP clock RPCs (ps-lite/include/ps/psf/ssp.h:10-32)
+
+Multi-worker sharding: the reference shards keys across PS server processes
+reached over ZMQ.  On TPU VMs every host holds a shard of each table in RAM;
+`ShardedTable` routes keys by hash.  In this single-host build the shards are
+in-process (the DCN RPC transport is the launcher's concern); the key-routing
+math is identical either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .build import load
+
+_OPT_TYPES = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3}
+_POLICIES = {"lru": 0, "lfu": 1, "lfuopt": 2}
+
+
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class EmbeddingTable:
+    """Host-RAM embedding table with a server-side optimizer."""
+
+    def __init__(self, rows, dim, optimizer="sgd", lr=0.01, beta1=0.9,
+                 beta2=0.999, eps=1e-8, weight_decay=0.0, seed=0,
+                 init_scale=None):
+        self._lib = load()
+        self.rows, self.dim = int(rows), int(dim)
+        self.optimizer = optimizer
+        self.handle = self._lib.ps_table_create(
+            self.rows, self.dim, _OPT_TYPES[optimizer], lr, beta1, beta2,
+            eps, weight_decay)
+        if init_scale is None:
+            init_scale = 1.0 / np.sqrt(dim)
+        if init_scale:
+            self._lib.ps_table_init_uniform(self.handle, seed,
+                                            float(init_scale))
+
+    def lookup(self, keys):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        out = np.empty((keys.size, self.dim), np.float32)
+        self._lib.ps_table_lookup(self.handle, _i64p(keys), keys.size,
+                                  _f32p(out))
+        return out
+
+    def push(self, keys, grads):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(keys.size, self.dim))
+        self._lib.ps_table_push(self.handle, _i64p(keys), _f32p(grads),
+                                keys.size)
+
+    def set_rows(self, keys, values):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        values = np.ascontiguousarray(
+            np.asarray(values, np.float32).reshape(keys.size, self.dim))
+        self._lib.ps_table_set_rows(self.handle, _i64p(keys), keys.size,
+                                    _f32p(values))
+
+    def versions(self, keys):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        out = np.empty(keys.size, np.uint64)
+        self._lib.ps_table_versions(
+            self.handle, _i64p(keys), keys.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        return out
+
+    def to_numpy(self):
+        return self.lookup(np.arange(self.rows))
+
+    # SaveParam / LoadParam RPC analogue (reference executor.py:589-591)
+    def save(self, path):
+        rc = self._lib.ps_table_save(self.handle, str(path).encode())
+        if rc != 0:
+            raise IOError(f"ps_table_save({path}) -> {rc}")
+
+    def load(self, path):
+        rc = self._lib.ps_table_load(self.handle, str(path).encode())
+        if rc != 0:
+            raise IOError(f"ps_table_load({path}) -> {rc}")
+
+    def __del__(self):
+        try:
+            self._lib.ps_table_destroy(self.handle)
+        except Exception:
+            pass
+
+
+class CacheTable:
+    """Bounded-staleness client cache over an EmbeddingTable (HET)."""
+
+    def __init__(self, table: EmbeddingTable, limit, policy="lru",
+                 pull_bound=0, push_bound=1):
+        self._lib = load()
+        self.table = table
+        self.dim = table.dim
+        self.policy = policy
+        self.handle = self._lib.ps_cache_create(
+            table.handle, int(limit), _POLICIES[policy], int(pull_bound),
+            int(push_bound))
+        assert self.handle > 0
+
+    def lookup(self, keys):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        out = np.empty((keys.size, self.dim), np.float32)
+        self._lib.ps_cache_lookup(self.handle, _i64p(keys), keys.size,
+                                  _f32p(out))
+        return out
+
+    def update(self, keys, grads):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(keys.size, self.dim))
+        self._lib.ps_cache_update(self.handle, _i64p(keys), _f32p(grads),
+                                  keys.size)
+
+    def flush(self):
+        self._lib.ps_cache_flush(self.handle)
+
+    def stats(self):
+        vals = [ctypes.c_int64() for _ in range(4)]
+        self._lib.ps_cache_stats(self.handle, *[ctypes.byref(v)
+                                                for v in vals])
+        hits, misses, pushes, evictions = [v.value for v in vals]
+        total = max(hits + misses, 1)
+        return {"hits": hits, "misses": misses, "pushes": pushes,
+                "evictions": evictions, "hit_rate": hits / total}
+
+    def __del__(self):
+        try:
+            self._lib.ps_cache_destroy(self.handle)
+        except Exception:
+            pass
+
+
+class ShardedTable:
+    """Key-hash sharding over N EmbeddingTables (the multi-host layout:
+    shard s on worker s; here in-process).  Routing: shard = key % nshards,
+    local key = key // nshards (matches the reference's server key
+    partitioner semantics without its ranges)."""
+
+    def __init__(self, rows, dim, nshards=1, **kw):
+        self.nshards = nshards
+        self.rows, self.dim = int(rows), int(dim)
+        per = (rows + nshards - 1) // nshards
+        seed = kw.pop("seed", 0)
+        self.shards = [EmbeddingTable(per, dim, seed=seed + s, **kw)
+                       for s in range(nshards)]
+
+    def lookup(self, keys):
+        keys = np.asarray(keys).reshape(-1).astype(np.int64)
+        out = np.empty((keys.size, self.dim), np.float32)
+        for s in range(self.nshards):
+            m = (keys % self.nshards) == s
+            if m.any():
+                out[m] = self.shards[s].lookup(keys[m] // self.nshards)
+        return out
+
+    def push(self, keys, grads):
+        keys = np.asarray(keys).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads, np.float32).reshape(keys.size, self.dim)
+        for s in range(self.nshards):
+            m = (keys % self.nshards) == s
+            if m.any():
+                self.shards[s].push(keys[m] // self.nshards, grads[m])
+
+
+class SSPController:
+    """Stale-synchronous-parallel clocks (reference psf/ssp.h): a worker may
+    advance to step c only while c - min(all clocks) <= staleness."""
+
+    def __init__(self, nworkers, staleness=0):
+        self._lib = load()
+        self.nworkers = nworkers
+        self.staleness = staleness
+        self.handle = self._lib.ssp_create(nworkers)
+
+    def tick(self, worker):
+        self._lib.ssp_tick(self.handle, worker)
+
+    def clock(self, worker):
+        return self._lib.ssp_clock(self.handle, worker)
+
+    def can_advance(self, worker):
+        return (self.clock(worker) - self._lib.ssp_min(self.handle)
+                <= self.staleness)
+
+    def __del__(self):
+        try:
+            self._lib.ssp_destroy(self.handle)
+        except Exception:
+            pass

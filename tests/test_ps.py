@@ -1,0 +1,321 @@
+"""PS / embedding-store subsystem tests.
+
+Mirrors the reference's PS test approach (tests/pstests/test_apis.py —
+InitTensor/Push/Pull/SparsePull numerics against a ground-truth array;
+tests/hetu_cache/hetu_cache_test.py — randomized cache lookup/update
+stress), single-process (the reference spawned scheduler+server+worker
+processes; our store is in-process host RAM by design).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.ps import (EmbeddingTable, CacheTable, ShardedTable,
+                         CacheSparseTable, SSPController, PSEmbedding)
+
+
+def test_table_set_lookup_roundtrip(rng):
+    t = EmbeddingTable(64, 4, init_scale=0.0)
+    vals = rng.standard_normal((10, 4)).astype(np.float32)
+    keys = rng.choice(64, 10, replace=False)
+    t.set_rows(keys, vals)
+    np.testing.assert_allclose(t.lookup(keys), vals)
+
+
+@pytest.mark.parametrize("optname", ["sgd", "momentum", "adagrad", "adam"])
+def test_server_optimizers_match_numpy(optname, rng):
+    """Server-side update == the framework's own dense optimizer math."""
+    dim, steps = 8, 5
+    t = EmbeddingTable(4, dim, optimizer=optname, lr=0.1, init_scale=0.0)
+    w0 = rng.standard_normal((1, dim)).astype(np.float32)
+    t.set_rows([2], w0)
+    grads = rng.standard_normal((steps, dim)).astype(np.float32)
+
+    # numpy reference
+    w = w0[0].copy()
+    m = np.zeros(dim, np.float32)
+    v = np.zeros(dim, np.float32)
+    for i, g in enumerate(grads):
+        if optname == "sgd":
+            w -= 0.1 * g
+        elif optname == "momentum":
+            m = 0.9 * m - 0.1 * g
+            w += m
+        elif optname == "adagrad":
+            v += g * g
+            w -= 0.1 * g / (np.sqrt(v) + 1e-8)
+        elif optname == "adam":
+            tstep = i + 1
+            m = 0.9 * m + 0.1 * g
+            v = 0.999 * v + 0.001 * g * g
+            mhat = m / (1 - 0.9 ** tstep)
+            vhat = v / (1 - 0.999 ** tstep)
+            w -= 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+        t.push([2], g[None])
+    np.testing.assert_allclose(t.lookup([2])[0], w, rtol=1e-5, atol=1e-6)
+
+
+def test_push_negative_keys_ignored():
+    t = EmbeddingTable(8, 2, lr=1.0, init_scale=0.0)
+    t.push([-1, 3], np.ones((2, 2), np.float32))
+    assert np.allclose(t.lookup([3]), -1.0)
+    assert np.allclose(t.lookup([0]), 0.0)
+
+
+def test_table_save_load(tmp_path, rng):
+    t = EmbeddingTable(32, 4, optimizer="adagrad", seed=1)
+    t.push(rng.integers(0, 32, 20),
+           rng.standard_normal((20, 4)).astype(np.float32))
+    snap = t.to_numpy()
+    p = str(tmp_path / "emb.bin")
+    t.save(p)
+    t2 = EmbeddingTable(32, 4, optimizer="adagrad", init_scale=0.0)
+    t2.load(p)
+    np.testing.assert_allclose(t2.to_numpy(), snap)
+
+
+def test_cache_hit_miss_and_staleness():
+    t = EmbeddingTable(16, 2, lr=1.0, init_scale=0.0)
+    c = CacheTable(t, limit=8, policy="lru", pull_bound=0, push_bound=10)
+    c.lookup([1])            # miss, admits
+    c.lookup([1])            # hit (version unchanged)
+    st = c.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    # external write bumps version → next lookup must refetch (pull_bound=0)
+    t.set_rows([1], np.full((1, 2), 7.0, np.float32))
+    out = c.lookup([1])
+    assert np.allclose(out, 7.0)
+    assert c.stats()["misses"] == 2
+
+
+def test_cache_pull_bound_allows_bounded_staleness():
+    t = EmbeddingTable(16, 2, lr=1.0, init_scale=0.0)
+    c = CacheTable(t, limit=8, policy="lru", pull_bound=2, push_bound=10)
+    c.lookup([1])
+    t.set_rows([1], np.full((1, 2), 7.0, np.float32))  # version lag 1 <= 2
+    out = c.lookup([1])
+    assert np.allclose(out, 0.0)  # served stale, within bound
+    t.set_rows([1], np.full((1, 2), 8.0, np.float32))
+    t.set_rows([1], np.full((1, 2), 9.0, np.float32))  # lag 3 > 2
+    out = c.lookup([1])
+    assert np.allclose(out, 9.0)
+
+
+def test_cache_eviction_lru_flushes_dirty():
+    t = EmbeddingTable(16, 2, lr=1.0, init_scale=0.0)
+    c = CacheTable(t, limit=2, policy="lru", pull_bound=0, push_bound=100)
+    c.update([0], np.ones((1, 2), np.float32))  # dirty, buffered
+    c.lookup([1])
+    c.lookup([2])  # evicts key 0 (LRU) → must flush its pending grad
+    assert np.allclose(t.lookup([0]), -1.0)
+    assert c.stats()["evictions"] == 1
+
+
+def test_cache_policies_admit_and_serve(rng):
+    for policy in ("lru", "lfu", "lfuopt"):
+        t = EmbeddingTable(64, 4, init_scale=0.1, seed=3)
+        c = CacheTable(t, limit=16, policy=policy, pull_bound=0,
+                       push_bound=1)
+        keys = rng.integers(0, 64, 200)
+        out = c.lookup(keys)
+        np.testing.assert_allclose(out, t.lookup(keys), rtol=1e-6)
+
+
+def test_cache_randomized_against_table(rng):
+    """Randomized stress: with pull_bound=0/push_bound=1 the cached view
+    must match a cache-less table exactly (reference hetu_cache_test)."""
+    t1 = EmbeddingTable(128, 4, optimizer="sgd", lr=0.1, seed=5)
+    t2 = EmbeddingTable(128, 4, optimizer="sgd", lr=0.1, seed=5)
+    c = CacheTable(t1, limit=32, policy="lru", pull_bound=0, push_bound=1)
+    for _ in range(20):
+        keys = rng.integers(0, 128, 16)
+        np.testing.assert_allclose(c.lookup(keys), t2.lookup(keys),
+                                   rtol=1e-5, atol=1e-6)
+        g = rng.standard_normal((16, 4)).astype(np.float32)
+        # dedup like PSEmbedding.push_grad so both sides see one update/key
+        uniq, inv = np.unique(keys, return_inverse=True)
+        summed = np.zeros((uniq.size, 4), np.float32)
+        np.add.at(summed, inv, g)
+        c.update(uniq, summed)
+        t2.push(uniq, summed)
+
+
+def test_sharded_table_routes_all_keys(rng):
+    st = ShardedTable(100, 4, nshards=4, init_scale=0.0)
+    keys = rng.integers(0, 100, 32)
+    st.push(keys, np.ones((32, 4), np.float32))
+    out = st.lookup(np.arange(100))
+    touched = np.unique(keys)
+    assert (out[touched] != 0).any()
+
+
+def test_cache_sparse_table_async_api():
+    cst = CacheSparseTable(64, 4, cache_limit=16, policy="lfuopt",
+                           optimizer="sgd", lr=0.5, seed=2)
+    fut = cst.embedding_lookup([1, 2, 3])
+    rows = fut.result()
+    assert rows.shape == (3, 4)
+    cst.embedding_update([1], np.ones((1, 4), np.float32)).result()
+    cst.flush()
+    perf = cst.perf()
+    assert perf["pushes"] >= 1
+
+
+def test_out_of_range_keys_are_safe():
+    """Out-of-range ids (routine in unhashed CTR data) must not corrupt
+    memory: lookups read zeros, pushes are dropped."""
+    t = EmbeddingTable(8, 2, lr=1.0, init_scale=0.0)
+    out = t.lookup([-5, 3, 8, 100])
+    assert np.allclose(out[[0, 2, 3]], 0.0)
+    t.push([100, -1], np.ones((2, 2), np.float32))
+    c = CacheTable(t, limit=4)
+    out = c.lookup([100, -1, 2])
+    assert np.allclose(out, 0.0)
+    c.update([100], np.ones((1, 2), np.float32))
+    np.testing.assert_allclose(t.to_numpy(), 0.0)
+
+
+def test_adam_save_load_preserves_step_counters(tmp_path, rng):
+    """Restored Adam tables must keep per-row bias-correction steps."""
+    t = EmbeddingTable(8, 4, optimizer="adam", lr=0.1, init_scale=0.0)
+    g = rng.standard_normal((1, 4)).astype(np.float32)
+    for _ in range(10):
+        t.push([2], g)
+    p = str(tmp_path / "adam.bin")
+    t.save(p)
+    t2 = EmbeddingTable(8, 4, optimizer="adam", lr=0.1, init_scale=0.0)
+    t2.load(p)
+    g2 = rng.standard_normal((1, 4)).astype(np.float32)
+    t.push([2], g2)
+    t2.push([2], g2)
+    np.testing.assert_allclose(t.lookup([2]), t2.lookup([2]), rtol=1e-6)
+
+
+def test_sharded_table_seed_respected():
+    a = ShardedTable(64, 4, nshards=4, seed=7)
+    b = ShardedTable(64, 4, nshards=4, seed=7)
+    c = ShardedTable(64, 4, nshards=4, seed=99)
+    np.testing.assert_allclose(a.lookup(np.arange(64)),
+                               b.lookup(np.arange(64)))
+    assert not np.allclose(a.lookup(np.arange(64)),
+                           c.lookup(np.arange(64)))
+
+
+def test_ps_embedding_with_dp_strategy(rng):
+    """PS rows + data-parallel sharding: the ids feed is consumed host-side
+    only and must not leak into the jitted pytree (in_shardings match)."""
+    from hetu_tpu.parallel import DataParallel
+    B, D, vocab = 16, 4, 100
+    ids = ht.placeholder_op("dp_ids", (B,), dtype=np.int64)
+    y = ht.placeholder_op("dp_y", (B, D))
+    emb = PSEmbedding(vocab, D, optimizer="sgd", lr=0.5)
+    loss = ht.mse_loss_op(emb(ids), y)
+    train = ht.SGDOptimizer(0.1).minimize(loss)
+    ex = ht.Executor([loss, train], dist_strategy=DataParallel(ndev=8))
+    feed = {ids: rng.integers(0, vocab, (B,)),
+            y: rng.standard_normal((B, D)).astype(np.float32)}
+    ls = [float(ex.run(feed_dict=feed, convert_to_numpy_ret_vals=True)[0])
+          for _ in range(10)]
+    assert np.isfinite(ls).all() and ls[-1] < ls[0]
+
+
+def test_ps_embedding_dynamic_batch(rng):
+    """A smaller final batch must retrace, not crash on a fixed reshape."""
+    D, vocab = 4, 50
+    ids = ht.placeholder_op("dyn_ids", (16,), dtype=np.int64)
+    y = ht.placeholder_op("dyn_y", (16, D))
+    emb = PSEmbedding(vocab, D, optimizer="sgd", lr=0.5)
+    loss = ht.mse_loss_op(emb(ids), y)
+    ex = ht.Executor([loss, ht.SGDOptimizer(0.1).minimize(loss)])
+    for b in (16, 7):
+        feed = {ids: rng.integers(0, vocab, (b,)),
+                y: rng.standard_normal((b, D)).astype(np.float32)}
+        v = ex.run(feed_dict=feed, convert_to_numpy_ret_vals=True)[0]
+        assert np.isfinite(v)
+
+
+def test_ssp_clocks():
+    s = SSPController(3, staleness=1)
+    assert s.can_advance(0)
+    s.tick(0)
+    s.tick(0)  # worker 0 at 2, min 0 → lag 2 > 1
+    assert not s.can_advance(0)
+    s.tick(1)
+    s.tick(2)
+    s.tick(1)
+    s.tick(2)  # min now 2
+    assert s.can_advance(0)
+
+
+def test_ps_embedding_end_to_end_training(rng):
+    """PS-resident embedding + device MLP trains jointly: device params via
+    the graph optimizer, embedding rows via the server-side optimizer."""
+    B, D, vocab = 32, 8, 500
+    ids_v = rng.integers(0, vocab, (B,))
+    y_v = (ids_v % 2).astype(np.int64)
+
+    ids = ht.placeholder_op("ps_ids", (B,), dtype=np.int64)
+    y = ht.placeholder_op("ps_y", (B,), dtype=np.int32)
+    emb = PSEmbedding(vocab, D, optimizer="adagrad", lr=0.5,
+                      cache_limit=128, policy="lru", push_bound=1)
+    rows = emb(ids)
+    from hetu_tpu.models import MLP
+    logits = MLP(dims=(D, 16, 2), name="psmlp")(rows)
+    loss = ht.reduce_mean_op(ht.softmax_cross_entropy_sparse_op(logits, y))
+    train = ht.AdamOptimizer(0.01).minimize(loss)
+    ex = ht.Executor([loss, train])
+    feed = {ids: ids_v, y: y_v}
+    losses = [float(ex.run(feed_dict=feed,
+                           convert_to_numpy_ret_vals=True)[0])
+              for _ in range(60)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.3 * losses[0], losses
+    assert emb.stats()["hit_rate"] > 0.5
+
+
+def test_wdl_with_ps_cache_trains(rng):
+    """Wide&Deep with the HET cached-PS embedding path (hybrid mode: dense
+    params on device, embedding rows server-side) — north-star config #3."""
+    from hetu_tpu.models import WDL
+    from hetu_tpu.ps import PSEmbedding
+    B, F, Dn = 32, 26, 13
+    vocab = 10000
+    dense_v = rng.standard_normal((B, Dn)).astype(np.float32)
+    ids_v = rng.integers(0, vocab, (B, F))
+    labels_v = rng.integers(0, 2, (B,)).astype(np.float32)
+    dense = ht.placeholder_op("wdl_dense", dense_v.shape)
+    ids = ht.placeholder_op("wdl_ids", ids_v.shape, dtype=np.int64)
+    labels = ht.placeholder_op("wdl_y", labels_v.shape)
+    emb = PSEmbedding(vocab, 16, optimizer="adagrad", lr=0.05,
+                      cache_limit=2048, policy="lfu", push_bound=1)
+    model = WDL(vocab, embedding_dim=16, num_sparse=F, num_dense=Dn,
+                ps_embedding=emb)
+    loss = model.loss(dense, ids, labels)
+    train = ht.AdamOptimizer(1e-3).minimize(loss)
+    ex = ht.Executor([loss, train])
+    feed = {dense: dense_v, ids: ids_v, labels: labels_v}
+    losses = [float(ex.run(feed_dict=feed,
+                           convert_to_numpy_ret_vals=True)[0])
+              for _ in range(40)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_ps_embedding_grads_deduped(rng):
+    """Duplicate ids in one batch must produce ONE summed update per row."""
+    B, D, vocab = 8, 4, 16
+    ids_v = np.zeros((B,), np.int64)  # all the same id
+    emb = PSEmbedding(vocab, D, optimizer="sgd", lr=1.0, init_scale=0.0)
+    ids = ht.placeholder_op("dup_ids", (B,), dtype=np.int64)
+    rows = emb(ids)
+    loss = ht.reduce_sum_op(rows)
+    train = ht.SGDOptimizer(0.1).minimize(loss)
+    ex = ht.Executor([loss, train])
+    ex.run(feed_dict={ids: ids_v})
+    # d loss/d row = 1 per occurrence → summed grad = B; sgd lr=1 → w = -B
+    np.testing.assert_allclose(emb.table.lookup([0])[0], -float(B),
+                               rtol=1e-6)
