@@ -21,6 +21,9 @@ from .optim import (SGDOptimizer, MomentumOptimizer, AdaGradOptimizer,
                     LambOptimizer)
 from .optim import lr_scheduler
 from . import ps
+from . import metrics
+from .dataloader import Dataloader, DataloaderOp, dataloader_op
+from .logger import HetuLogger, WandbLogger
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,137 @@
+"""Data pipeline: prefetching, DP-aware batch feeding as graph nodes.
+
+Reference: /root/reference/python/hetu/dataloader.py — `Dataloader` (:125)
+slices the dataset by dp_rank/dp_nrank and prefetches batches through
+multiprocess queues; `DataloaderOp` (:289) is a graph node whose value the
+executor pulls per step (per named subgraph: 'default'/'train'/'validate').
+
+TPU redesign: feeding is host-side (no kernels involved), so the pipeline is
+a background *thread* + bounded queue per dataloader — processes buy nothing
+here because batch assembly is numpy slicing (GIL-releasing) and the XLA
+step fully overlaps it; the queue depth plays the role of the reference's
+batch_num prefetch window.  `DataloaderOp` follows the executor's
+placeholder-autofill protocol (same hook as ps/embedding.PSRowsOp): the
+executor asks the node for the next batch instead of requiring a feed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .graph.node import PlaceholderOp
+
+
+class Dataloader:
+    """Batched, optionally shuffled, DP-sliced iterator with prefetch.
+
+    ``raw_data``: numpy array [N, ...].  ``dp_rank``/``dp_nrank`` shard the
+    dataset like the reference (each data-parallel worker sees its slice).
+    ``drop_last`` keeps shapes static for XLA (the reference re-plans on
+    shape change; we default to dropping the ragged tail and only retrace
+    when the user opts into it).
+    """
+
+    def __init__(self, raw_data, batch_size, shuffle=False, drop_last=True,
+                 dp_rank=0, dp_nrank=1, seed=0, prefetch=2, name="data"):
+        data = np.asarray(raw_data)
+        if dp_nrank > 1:
+            # contiguous equal shards; tail dropped so every rank agrees
+            per = data.shape[0] // dp_nrank
+            data = data[dp_rank * per:(dp_rank + 1) * per]
+        self.data = data
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.name = name
+        self._rng = np.random.default_rng(seed + dp_rank)
+        self._queue = queue.Queue(maxsize=prefetch)
+        self._epoch_order = None
+        self._cursor = 0
+        self._thread = None
+        self._stop = threading.Event()
+
+    @property
+    def num_batches(self):
+        n = self.data.shape[0]
+        return n // self.batch_size if self.drop_last else \
+            (n + self.batch_size - 1) // self.batch_size
+
+    # reference API names ---------------------------------------------------
+    def get_batch_num(self, name=None):
+        return self.num_batches
+
+    def _producer(self):
+        while not self._stop.is_set():
+            order = (self._rng.permutation(self.data.shape[0])
+                     if self.shuffle else np.arange(self.data.shape[0]))
+            for i in range(self.num_batches):
+                if self._stop.is_set():
+                    return
+                sel = order[i * self.batch_size:(i + 1) * self.batch_size]
+                batch = self.data[sel]
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._producer,
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def next_batch(self):
+        self.start()
+        return self._queue.get()
+
+    def stop(self):
+        self._stop.set()
+
+    def __iter__(self):
+        """Single-epoch iteration without the prefetch thread (eval loops)."""
+        order = (self._rng.permutation(self.data.shape[0])
+                 if self.shuffle else np.arange(self.data.shape[0]))
+        for i in range(self.num_batches):
+            sel = order[i * self.batch_size:(i + 1) * self.batch_size]
+            yield self.data[sel]
+
+
+class DataloaderOp(PlaceholderOp):
+    """Graph node auto-fed from a Dataloader (reference DataloaderOp :289).
+
+    ``dataloaders``: either one Dataloader or {subgraph_name: Dataloader}
+    (the reference keys batch streams by named subexecutor: train/validate).
+    The executor recognizes the ``auto_feed`` hook and pulls the next batch
+    when the user did not feed the node explicitly.
+    """
+
+    __slots__ = ("dataloaders",)
+
+    def __init__(self, dataloaders, dtype=np.float32, name=None):
+        if not isinstance(dataloaders, dict):
+            dataloaders = {"default": dataloaders}
+        self.dataloaders = dataloaders
+        some = next(iter(dataloaders.values()))
+        shape = (some.batch_size,) + some.data.shape[1:]
+        super().__init__(name or f"dataloader_{some.name}", shape=shape,
+                         dtype=dtype)
+
+    def auto_feed(self, subgraph_name):
+        dl = self.dataloaders.get(subgraph_name)
+        if dl is None:
+            dl = self.dataloaders.get("default")
+        if dl is None:
+            raise ValueError(
+                f"DataloaderOp {self.name} has no stream for subgraph "
+                f"'{subgraph_name}' (streams: {list(self.dataloaders)})")
+        return dl.next_batch()
+
+
+def dataloader_op(dataloaders, dtype=np.float32, name=None):
+    return DataloaderOp(dataloaders, dtype=dtype, name=name)

@@ -128,6 +128,11 @@ class SubExecutor:
         for node, value in feed_dict.items():
             name = node.name if isinstance(node, Op) else node
             feeds[name] = value
+        # dataloader nodes: pull the next prefetched batch for any node the
+        # user didn't feed explicitly (reference DataloaderOp streams)
+        for p in self.placeholders:
+            if p.name not in feeds and hasattr(p, "auto_feed"):
+                feeds[p.name] = p.auto_feed(self.name)
         # PS embeddings: gather rows on host (through the HET cache when
         # configured) and feed them (reference SparsePull prefetch path)
         ps_ids = {}

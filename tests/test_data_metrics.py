@@ -1,0 +1,142 @@
+"""Dataloader / metrics / logger / tokenizer tests (reference test model:
+tests/test_dataloader-style batch correctness + metric numerics)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import metrics
+from hetu_tpu.dataloader import Dataloader, DataloaderOp
+from hetu_tpu.tokenizers import BertTokenizer
+
+
+# ---------------- dataloader ----------------
+
+def test_dataloader_batches_cover_data():
+    data = np.arange(100).reshape(100, 1)
+    dl = Dataloader(data, batch_size=10, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 10
+    np.testing.assert_array_equal(np.concatenate(batches), data)
+
+
+def test_dataloader_drop_last():
+    dl = Dataloader(np.arange(25), batch_size=10)
+    assert dl.num_batches == 2
+    dl2 = Dataloader(np.arange(25), batch_size=10, drop_last=False)
+    assert dl2.num_batches == 3
+
+
+def test_dataloader_dp_slicing():
+    data = np.arange(100)
+    shards = [Dataloader(data, 10, dp_rank=r, dp_nrank=4).data
+              for r in range(4)]
+    assert all(s.size == 25 for s in shards)
+    np.testing.assert_array_equal(np.concatenate(shards), data)
+
+
+def test_dataloader_prefetch_thread():
+    dl = Dataloader(np.arange(40), batch_size=10, shuffle=True, seed=1)
+    seen = [dl.next_batch() for _ in range(8)]  # wraps epochs
+    assert all(b.shape == (10,) for b in seen)
+    dl.stop()
+
+
+def test_dataloader_op_feeds_executor():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 8)).astype(np.float32)
+    xdl = Dataloader(X, batch_size=16, shuffle=False)
+    x = DataloaderOp(xdl)
+    loss = ht.reduce_mean_op(x * x)
+    ex = ht.Executor({"default": [loss]}, training=False)
+    vals = [float(ex.run(convert_to_numpy_ret_vals=True)[0])
+            for _ in range(4)]
+    expect = [float(np.mean(X[i * 16:(i + 1) * 16] ** 2)) for i in range(4)]
+    np.testing.assert_allclose(vals, expect, rtol=1e-5)
+    xdl.stop()
+
+
+# ---------------- metrics ----------------
+
+def test_accuracy():
+    logits = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    assert metrics.accuracy(logits, [1, 0, 0]) == pytest.approx(2 / 3)
+
+
+def test_auc_matches_definition():
+    scores = np.array([0.1, 0.4, 0.35, 0.8])
+    labels = np.array([0, 0, 1, 1])
+    # pairs: (0.35 vs 0.1)=1, (0.35 vs 0.4)=0, (0.8 vs 0.1)=1, (0.8 vs 0.4)=1
+    assert metrics.auc(scores, labels) == pytest.approx(0.75)
+
+
+def test_auc_with_ties():
+    scores = np.array([0.5, 0.5, 0.5, 0.5])
+    labels = np.array([0, 1, 0, 1])
+    assert metrics.auc(scores, labels) == pytest.approx(0.5)
+
+
+def test_precision_recall_f1():
+    p, r, f1 = metrics.precision_recall_f1([1, 1, 0, 1], [1, 0, 0, 1])
+    assert p == pytest.approx(2 / 3)
+    assert r == pytest.approx(1.0)
+    assert f1 == pytest.approx(0.8)
+
+
+def test_rmse_mae_ndcg():
+    assert metrics.rmse([1, 2], [1, 4]) == pytest.approx(np.sqrt(2))
+    assert metrics.mae([1, 2], [1, 4]) == pytest.approx(1.0)
+    assert metrics.ndcg_at_k([3, 2, 1], [1, 0, 0], k=3) == pytest.approx(1.0)
+
+
+# ---------------- logger ----------------
+
+def test_logger_jsonl(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    lg = ht.HetuLogger(path=path, print_interval=2, printer=None)
+    lg.log(loss=1.0)
+    lg.log(loss=3.0)   # interval flush: mean 2.0
+    lg.close()
+    recs = [json.loads(l) for l in open(path)]
+    assert recs[0]["loss"] == pytest.approx(2.0)
+
+
+# ---------------- tokenizer ----------------
+
+def _toy_tokenizer():
+    words = ["the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+             "lazy", "dog", "un", "##want", "##ed", ",", "."]
+    return BertTokenizer.from_vocab_list(words, max_len=16)
+
+
+def test_wordpiece_greedy_longest_match():
+    tok = _toy_tokenizer()
+    assert tok.tokenize("unwanted") == ["un", "##want", "##ed"]
+    assert tok.tokenize("jumps") == ["jump", "##s"]
+    assert tok.tokenize("The quick, brown fox.") == \
+        ["the", "quick", ",", "brown", "fox", "."]
+
+
+def test_unknown_word_maps_to_unk():
+    tok = _toy_tokenizer()
+    assert tok.tokenize("zzz") == ["[UNK]"]
+
+
+def test_encode_pair_and_decode():
+    tok = _toy_tokenizer()
+    ids, types, mask = tok.encode("the quick fox", "lazy dog", max_len=12)
+    assert len(ids) == len(types) == len(mask) == 12
+    assert tok.inv_vocab[ids[0]] == "[CLS]"
+    assert sum(mask) == 3 + 1 + 2 + 2  # cls + 3 toks + sep + 2 toks + sep
+    assert types[:5] == [0] * 5
+    assert 1 in types
+    assert "quick" in tok.decode(ids)
+
+
+def test_encode_truncates_longest_first():
+    tok = _toy_tokenizer()
+    ids, _, mask = tok.encode("the quick brown fox over lazy",
+                              "dog", max_len=8)
+    assert len(ids) == 8 and sum(mask) == 8

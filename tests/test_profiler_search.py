@@ -1,0 +1,147 @@
+"""Profiler / simulator / auto-parallel search tests."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.profiler import (HetuProfiler, HetuSimulator, shape_map,
+                               estimate_flops, CommProfiler)
+from hetu_tpu.parallel import make_mesh
+from hetu_tpu.parallel.search import (OptCNNSearch, FlexFlowSearch,
+                                      GPipeSearch, PipeDreamSearch,
+                                      PipeOptSearch, partition_stages,
+                                      backbone_nodes, candidate_choices,
+                                      GraphCost, LayoutChoice)
+
+
+def _mlp_loss(batch=32, din=64, dh=128, classes=4):
+    x = ht.placeholder_op("px", (batch, din))
+    y = ht.placeholder_op("py", (batch,), dtype=np.int32)
+    from hetu_tpu.models import MLP
+    logits = MLP(dims=(din, dh, classes), name="profmlp")(x)
+    loss = ht.reduce_mean_op(ht.softmax_cross_entropy_sparse_op(logits, y))
+    return loss, x, y
+
+
+def test_shape_map_infers_all_dense_nodes():
+    loss, x, y = _mlp_loss()
+    shapes = shape_map([loss])
+    assert shapes[loss].shape == ()
+    matmuls = [n for n in backbone_nodes([loss])]
+    assert len(matmuls) == 2
+    assert shapes[matmuls[0]].shape == (32, 128)
+
+
+def test_estimate_flops_matmul():
+    loss, *_ = _mlp_loss()
+    shapes = shape_map([loss])
+    mm = backbone_nodes([loss])[0]
+    # [32,64]@[64,128] → 2*32*128*64
+    assert estimate_flops(mm, shapes) == pytest.approx(2 * 32 * 128 * 64)
+
+
+def test_profiler_times_ops():
+    loss, *_ = _mlp_loss()
+    prof = HetuProfiler([loss])
+    times = prof.profile_all(repeats=2)
+    assert times, "no ops timed"
+    assert all(t > 0 for t in times.values())
+
+
+def test_simulator_cache_roundtrip(tmp_path):
+    loss, *_ = _mlp_loss()
+    sim = HetuSimulator(cache_path=str(tmp_path / "times.json"))
+    cache = sim.record([loss], repeats=1)
+    assert cache
+    sim2 = HetuSimulator(cache_path=str(tmp_path / "times.json"))
+    assert sim2._cache == {k: pytest.approx(v) for k, v in cache.items()}
+
+
+def test_collective_model_scales():
+    sim = HetuSimulator()
+    t2 = sim.collective_time(1 << 20, 2)
+    t8 = sim.collective_time(1 << 20, 8)
+    assert 0 < t2 < t8
+    assert sim.collective_time(1 << 20, 1) == 0.0
+    assert (sim.collective_time(1 << 20, 8, over="dcn")
+            > sim.collective_time(1 << 20, 8, over="ici"))
+
+
+def test_comm_profiler_measures():
+    mesh = make_mesh({"x": 8})
+    t = CommProfiler(mesh).bench_collective("psum", nbytes=1 << 16,
+                                            axis="x", repeats=2)
+    assert t > 0
+
+
+def test_candidate_choices_divisibility():
+    loss, *_ = _mlp_loss(batch=32)
+    shapes = shape_map([loss])
+    mm = backbone_nodes([loss])[0]
+    cands = candidate_choices(mm, shapes, ndev=8)
+    assert LayoutChoice(1, 1) in cands
+    assert LayoutChoice(dp=8) in cands
+    assert any(c.tp > 1 for c in cands)
+    for c in cands:
+        assert 32 % c.dp == 0
+
+
+def test_graph_cost_prefers_sharding():
+    loss, *_ = _mlp_loss(batch=64, din=256, dh=1024)
+    cost = GraphCost([loss], ndev=8)
+    chain = cost.backbone
+    rep = {n: LayoutChoice() for n in chain}
+    dp8 = {n: LayoutChoice(dp=8) for n in chain}
+    assert cost.total(dp8) < cost.total(rep)
+
+
+def test_optcnn_search_returns_runnable_strategy():
+    loss, x, y = _mlp_loss(batch=64, din=64, dh=512)
+    strat = OptCNNSearch(ndev=8).search([loss])
+    # the searched strategy must actually train on the mesh
+    opt = ht.SGDOptimizer(0.1)
+    train = opt.minimize(loss)
+    ex = ht.Executor([loss, train], dist_strategy=strat)
+    rng = np.random.default_rng(0)
+    feed = {x: rng.standard_normal((64, 64)).astype(np.float32),
+            y: rng.integers(0, 4, (64,))}
+    ls = [float(ex.run(feed_dict=feed, convert_to_numpy_ret_vals=True)[0])
+          for _ in range(5)]
+    assert np.isfinite(ls).all() and ls[-1] < ls[0]
+
+
+def test_flexflow_search_no_worse_than_replicated():
+    loss, *_ = _mlp_loss(batch=64, din=128, dh=512)
+    cost = GraphCost([loss], ndev=8)
+    ff = FlexFlowSearch(ndev=8, iters=100, seed=1)
+    strat = ff.search([loss])
+    assert strat.mesh is not None
+    rep_cost = cost.total({n: LayoutChoice() for n in cost.backbone})
+    found_cost = cost.total(strat.assignment)
+    assert found_cost <= rep_cost + 1e-9
+
+
+def test_partition_stages_balances():
+    times = [1.0] * 8
+    bounds = partition_stages(times, 4)
+    assert bounds == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    times = [4.0, 1.0, 1.0, 1.0, 1.0]
+    bounds = partition_stages(times, 2)
+    assert bounds[0] == (0, 1)  # heavy layer isolated
+
+
+def test_gpipe_vs_pipedream_and_pipeopt():
+    times = [1.0] * 12
+    g_bounds, g_t = GPipeSearch(4, 8).search(times)
+    assert len(g_bounds) == 4 and g_t == pytest.approx((8 + 3) * 3.0 / 8)
+    pd_bounds, pd_t = PipeDreamSearch(4, 8).search(
+        times, act_bytes_per_layer=1 << 20, mem_cap=1 << 30)
+    assert pd_t == pytest.approx(g_t)
+    # infeasible memory cap is flagged
+    _, bad = PipeDreamSearch(4, 8).search(times,
+                                          act_bytes_per_layer=1 << 30,
+                                          mem_cap=1 << 20)
+    assert bad == float("inf")
+    best = PipeOptSearch(ndev=8).search(times)
+    assert best["pp"] * best["dp"] <= 8
+    assert best["time"] > 0
