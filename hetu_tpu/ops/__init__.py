@@ -11,3 +11,10 @@ from .moe import (top_k_gating, hash_gating, layout_transform_op,
                   reverse_layout_transform_op, topk_idx_op, topk_val_op,
                   scatter1d_op, balance_assignment, sam_group_sum)
 from .attention import scaled_dot_product_attention_op
+from .quantize import (rounding_to_int, dequantize, signed_quantize,
+                       signed_dequantize, quantized_embedding_lookup,
+                       quantized_embedding_lookup_per_row, fake_quantize,
+                       lsq_round, binary_step, prune_low_magnitude,
+                       prune_mask, prune_threshold, fake_quantize_op,
+                       lsq_round_op, binary_step_op, prune_low_magnitude_op,
+                       dequantize_op, quantized_embedding_lookup_op)

@@ -1,0 +1,273 @@
+"""Quantization / compression ops.
+
+Reference kernels: src/ops/Quantize.cu (DLGpuRoundingToInt /
+DLGpuDequantize), src/ops/SignedQuantize.cu, src/ops/QuantizeEmbedding.cu
+(embedding_prepack / quantized_embedding_lookup), src/ops/PruneMask.cu +
+python/hetu/gpu_ops/Prune.py (PruneLowMagnitudeOp threshold search),
+src/ops/OptEmbedBinaryStep.cu, and the ALPT LSQ rounding pair
+(python/hetu/gpu_ops/QuantizeALPTEmb.py).
+
+TPU redesign: quantized storage is a jnp integer array; rounding and
+dequantize are jnp compositions XLA fuses into the surrounding graph.
+Training-time quantizers are fake-quant functions with straight-through /
+LSQ custom VJPs (the reference splits these into separate fwd/bwd kernels
+wired by hand-written gradient() rules).  The reference's host-side binary
+search for the prune threshold (Prune.py:28-45, 100 sync'd kernel launches)
+becomes a single on-device quantile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import simple_op
+from ..graph.node import Op
+
+
+# ---------------------------------------------------------------------------
+# plain (inference / storage) quantization — pure functions
+# ---------------------------------------------------------------------------
+
+def qinfo(digit, signed=False):
+    """(dtype, qmin, qmax) for a bit width. digit ∈ {8, 16}."""
+    if digit == 8:
+        return (jnp.int8, -128, 127) if signed else (jnp.uint8, 0, 255)
+    if digit == 16:
+        return (jnp.int16, -(1 << 15), (1 << 15) - 1) if signed \
+            else (jnp.uint16, 0, (1 << 16) - 1)
+    raise ValueError(f"unsupported quantization width {digit}")
+
+
+def _round(q, stochastic, key):
+    if stochastic:
+        if key is None:
+            raise ValueError("stochastic rounding needs a PRNG key")
+        return jnp.floor(q + jax.random.uniform(key, jnp.shape(q)))
+    return jnp.round(q)
+
+
+def rounding_to_int(x, scale, minele, digit, stochastic=False, key=None):
+    """float → uint{8,16} codes: q = round((x - minele)/scale).
+
+    Reference: rounding_kernel src/ops/Quantize.cu:6-20 (fixed_rounding /
+    stochastic_rounding in gpu_functions.cuh).
+    """
+    dtype, qmin, qmax = qinfo(digit)
+    q = _round((x - minele) / scale, stochastic, key)
+    return jnp.clip(q, qmin, qmax).astype(dtype)
+
+
+def dequantize(q, scale, minele):
+    """uint codes → float: q*scale + minele (src/ops/Quantize.cu:64-72)."""
+    return q.astype(jnp.float32) * scale + minele
+
+
+def signed_quantize(x, scale, digit, stochastic=False, key=None):
+    """Symmetric int{8,16} codes q = round(x/scale) (SignedQuantize.cu)."""
+    dtype, qmin, qmax = qinfo(digit, signed=True)
+    q = _round(x / scale, stochastic, key)
+    return jnp.clip(q, qmin, qmax).astype(dtype)
+
+
+def signed_dequantize(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+def quantized_embedding_lookup(qtable, ids, scale, minele):
+    """Lookup rows of a uint-coded table and dequantize (reference
+    unified_quantized_embedding_lookup, QuantizeEmbedding.cu)."""
+    return dequantize(jnp.take(qtable, ids, axis=0), scale, minele)
+
+
+def quantized_embedding_lookup_per_row(qtable, ids, qparams):
+    """Per-row (scale, zero_point) variant: qparams is (rows, 2)
+    (reference quantized_embedding_lookup + embedding_prepack)."""
+    rows = jnp.take(qtable, ids, axis=0).astype(jnp.float32)
+    sp = jnp.take(qparams, ids, axis=0)
+    return rows * sp[..., :1] + sp[..., 1:2]
+
+
+# ---------------------------------------------------------------------------
+# training-time quantizers (custom VJPs)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fake_quantize(x, scale, digit, signed):
+    """Quantize-dequantize with straight-through gradient (in-range pass,
+    out-of-range zero).  Forward matches rounding_to_int∘dequantize."""
+    _, qmin, qmax = qinfo(digit, signed)
+    q = jnp.clip(jnp.round(x / scale), qmin, qmax)
+    return q * scale
+
+
+def _fq_fwd(x, scale, digit, signed):
+    _, qmin, qmax = qinfo(digit, signed)
+    r = x / scale
+    in_range = (r >= qmin) & (r <= qmax)
+    q = jnp.clip(jnp.round(r), qmin, qmax)
+    return q * scale, in_range
+
+
+def _fq_bwd(digit, signed, in_range, g):
+    return (jnp.where(in_range, g, 0.0), None)
+
+
+fake_quantize.defvjp(_fq_fwd, _fq_bwd)
+
+
+_LSQ_EPS = 1e-9
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def lsq_round(x, scale, digit, signed):
+    """LSQ (learned-step-size) quantize-dequantize.
+
+    Reference: lsq_rounding / lsq_rounding_gradient kernels
+    (src/ops/SignedQuantize.cu:251-312) used by ALPT
+    (python/hetu/gpu_ops/QuantizeALPTEmb.py).  Gradient w.r.t. x is
+    straight-through inside the clip range; gradient w.r.t. the (learnable)
+    scale is (q - x/s) in range and the clip boundary outside — the LSQ rule.
+
+    Unlike the reference (which leaves stabilization to its ALPT scheduler),
+    the scale gradient carries the LSQ paper's 1/sqrt(N·Qp) normalization and
+    the forward uses |s|+eps, so the op trains stably under a plain SGD/Adam
+    step without a bespoke scale-update schedule.
+    """
+    _, qmin, qmax = qinfo(digit, signed)
+    s = jnp.abs(scale) + _LSQ_EPS
+    q = jnp.clip(jnp.round(x / s), qmin, qmax)
+    return q * s
+
+
+def _lsq_fwd(x, scale, digit, signed):
+    _, qmin, qmax = qinfo(digit, signed)
+    s = jnp.abs(scale) + _LSQ_EPS
+    r = x / s
+    q = jnp.clip(jnp.round(r), qmin, qmax)
+    return q * s, (r, q, scale)
+
+
+def _lsq_bwd(digit, signed, res, g):
+    _, qmin, qmax = qinfo(digit, signed)
+    r, q, scale = res
+    scale_shape = jnp.shape(scale)
+    gx = jnp.where((r >= qmin) & (r <= qmax), g, 0.0)
+    # d(out)/d(s_eff) = q - r in range; qmin/qmax at the boundaries.
+    ds_el = jnp.where(r <= qmin, float(qmin),
+                      jnp.where(r >= qmax, float(qmax), q - r)) * g
+    # LSQ grad scale: 1/sqrt(#elements-per-scale × Qp)
+    n_per_scale = r.size / max(1, int(np.prod(scale_shape)) if scale_shape
+                               else 1)
+    gscale = 1.0 / np.sqrt(n_per_scale * max(qmax, 1))
+    ds_el = ds_el * gscale
+    # reduce to the scale's shape: broadcasting right-aligns, so pad the
+    # scale shape with leading 1s against ds_el and sum the broadcast axes
+    if scale_shape == ():
+        gs = jnp.sum(ds_el)
+    else:
+        padded = (1,) * (ds_el.ndim - len(scale_shape)) + tuple(scale_shape)
+        axes = tuple(i for i in range(ds_el.ndim) if padded[i] == 1)
+        gs = jnp.sum(ds_el, axis=axes, keepdims=True).reshape(scale_shape)
+    gs = gs * jnp.sign(scale)  # chain through s_eff = |s| + eps
+    return (gx, gs)
+
+
+lsq_round.defvjp(_lsq_fwd, _lsq_bwd)
+
+
+@jax.custom_vjp
+def binary_step(x):
+    """1[x > 0] with the OptEmbed surrogate derivative
+    (src/ops/OptEmbedBinaryStep.cu: 2-4|x| for |x|≤0.4, 0.4 for |x|≤1, 0)."""
+    return (x > 0).astype(x.dtype)
+
+
+def _bs_fwd(x):
+    return binary_step(x), x
+
+
+def _bs_bwd(x, g):
+    a = jnp.abs(x)
+    d = jnp.where(a > 1.0, 0.0, jnp.where(a > 0.4, 0.4, 2.0 - 4.0 * a))
+    return (g * d,)
+
+
+binary_step.defvjp(_bs_fwd, _bs_bwd)
+
+
+# ---------------------------------------------------------------------------
+# magnitude pruning
+# ---------------------------------------------------------------------------
+
+def prune_threshold(x, rate):
+    """|x| value below which a `rate` fraction of entries fall.
+
+    Replaces the reference's 100-iteration host/device binary search
+    (Prune.py:28-45) with one on-device quantile.
+    """
+    return jnp.quantile(jnp.abs(x).reshape(-1), rate)
+
+
+def prune_low_magnitude(x, rate):
+    """Zero the lowest-magnitude `rate` fraction of x (DeepLight-style)."""
+    thr = prune_threshold(x, rate)
+    return jnp.where(jnp.abs(x) < thr, 0.0, x)
+
+
+def prune_mask(x, rate):
+    thr = prune_threshold(x, rate)
+    return (jnp.abs(x) >= thr).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# graph-node constructors
+# ---------------------------------------------------------------------------
+
+fake_quantize_op = simple_op(
+    lambda x, s, digit=8, signed=True: fake_quantize(x, s, digit, signed),
+    "fake_quantize")
+lsq_round_op = simple_op(
+    lambda x, s, digit=8, signed=True: lsq_round(x, s, digit, signed),
+    "lsq_round")
+binary_step_op = simple_op(lambda x: binary_step(x), "binary_step")
+prune_low_magnitude_op = simple_op(
+    lambda x, rate=0.0: prune_low_magnitude(x, rate), "prune_low_magnitude")
+dequantize_op = simple_op(
+    lambda q, scale=1.0, minele=0.0: dequantize(q, scale, minele),
+    "dequantize")
+
+
+class QuantizedEmbeddingLookupOp(Op):
+    """Lookup into a uint-coded embedding table (unified scale/zero or
+    per-row qparams).  Reference: QuantizeEmbedding.py
+    UnifiedQuantizedEmbeddingLookUpOp / QuantizedEmbeddingLookUpOp."""
+
+    __slots__ = ("op_kind",)
+
+    def __init__(self, qtable, ids, qparams=None, scale=None, minele=None,
+                 name=None):
+        if qparams is None and (scale is None or minele is None):
+            raise ValueError(
+                "quantized_embedding_lookup: pass either per-row qparams or "
+                "unified scale= and minele=")
+        inputs = (qtable, ids) if qparams is None else (qtable, ids, qparams)
+        super().__init__(*inputs, name=name, scale=scale, minele=minele)
+        self.op_kind = "quantized_embedding_lookup"
+
+    def _compute(self, input_vals, ctx):
+        if len(input_vals) == 2:
+            qtable, ids = input_vals
+            return quantized_embedding_lookup(
+                qtable, ids, self.attrs["scale"], self.attrs["minele"])
+        qtable, ids, qparams = input_vals
+        return quantized_embedding_lookup_per_row(qtable, ids, qparams)
+
+
+def quantized_embedding_lookup_op(qtable, ids, qparams=None, scale=None,
+                                  minele=None, name=None):
+    return QuantizedEmbeddingLookupOp(qtable, ids, qparams=qparams,
+                                      scale=scale, minele=minele, name=name)

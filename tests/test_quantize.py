@@ -1,0 +1,142 @@
+"""Golden tests for quantization/compression ops vs numpy
+(reference test style: tests/test_gpu_op.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.ops import quantize as Q
+
+
+def test_rounding_dequantize_roundtrip(rng):
+    x = rng.uniform(-1, 1, (64, 16)).astype(np.float32)
+    scale, minele = 2.0 / 255, -1.0
+    q = np.asarray(Q.rounding_to_int(x, scale, minele, 8))
+    assert q.dtype == np.uint8
+    np.testing.assert_allclose(
+        np.asarray(Q.dequantize(jnp.asarray(q), scale, minele)), x,
+        atol=scale / 2 + 1e-6)
+    # 16-bit is tighter
+    s16 = 2.0 / 65535
+    q16 = np.asarray(Q.rounding_to_int(x, s16, -1.0, 16))
+    assert q16.dtype == np.uint16
+    np.testing.assert_allclose(
+        np.asarray(Q.dequantize(jnp.asarray(q16), s16, -1.0)), x,
+        atol=s16 / 2 + 1e-6)
+
+
+def test_stochastic_rounding_unbiased(rng):
+    x = np.full((20000,), 0.3, np.float32)
+    q = Q.rounding_to_int(x, 1.0, 0.0, 8, stochastic=True,
+                          key=jax.random.key(0))
+    # E[q] = 0.3 → mean of codes ≈ 0.3
+    assert abs(float(jnp.mean(q.astype(jnp.float32))) - 0.3) < 0.02
+
+
+def test_signed_quantize(rng):
+    x = rng.standard_normal((32, 8)).astype(np.float32)
+    s = 0.05
+    q = np.asarray(Q.signed_quantize(x, s, 8))
+    assert q.dtype == np.int8
+    np.testing.assert_allclose(np.asarray(Q.signed_dequantize(jnp.asarray(q), s)),
+                               np.clip(np.round(x / s), -128, 127) * s,
+                               rtol=1e-6)
+
+
+def test_quantized_embedding_lookup(rng):
+    table = rng.uniform(-1, 1, (50, 8)).astype(np.float32)
+    scale, minele = 2.0 / 255, -1.0
+    qtable = Q.rounding_to_int(table, scale, minele, 8)
+    ids = rng.integers(0, 50, (4, 6))
+    out = np.asarray(Q.quantized_embedding_lookup(qtable, ids, scale, minele))
+    np.testing.assert_allclose(out, table[ids], atol=scale / 2 + 1e-6)
+
+
+def test_quantized_embedding_per_row(rng):
+    table = rng.uniform(-1, 1, (20, 4)).astype(np.float32)
+    # per-row scale/zero from min/max
+    mins, maxs = table.min(1), table.max(1)
+    scales = (maxs - mins) / 255
+    qparams = np.stack([scales, mins], 1).astype(np.float32)
+    q = np.round((table - mins[:, None]) / scales[:, None]).astype(np.uint8)
+    ids = rng.integers(0, 20, (7,))
+    out = np.asarray(Q.quantized_embedding_lookup_per_row(
+        jnp.asarray(q), ids, jnp.asarray(qparams)))
+    np.testing.assert_allclose(out, table[ids], atol=scales.max() / 2 + 1e-5)
+
+
+def test_fake_quantize_ste_grad():
+    x = jnp.array([0.26, -0.98, 12.0, -12.0])  # last two out of int8 range
+    s = jnp.float32(0.05)
+    y, vjp = jax.vjp(lambda v: Q.fake_quantize(v, s, 8, True), x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.clip(np.round(np.asarray(x) / 0.05), -128, 127) * 0.05,
+        rtol=1e-6)
+    gx, = vjp(jnp.ones_like(x))
+    np.testing.assert_allclose(np.asarray(gx), [1, 1, 0, 0])
+
+
+def test_lsq_scale_gradient():
+    x = jnp.array([0.26, 12.0, -12.0])
+    s = jnp.float32(0.05)
+    y, vjp = jax.vjp(lambda xx, ss: Q.lsq_round(xx, ss, 8, True), x, s)
+    gx, gs = vjp(jnp.ones_like(y))
+    # in-range: ds = q - x/s = round(5.2)-5.2 = -0.2; clipped: +127 / -128;
+    # LSQ grad-scale 1/sqrt(N*Qp) applied on top.
+    gscale = 1.0 / np.sqrt(3 * 127)
+    np.testing.assert_allclose(float(gs), ((-0.2) + 127 - 128) * gscale,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx), [1, 0, 0])
+
+
+def test_binary_step_surrogate():
+    x = jnp.array([-0.1, 0.2, 0.7, 1.5, -2.0])
+    y = Q.binary_step(x)
+    np.testing.assert_allclose(np.asarray(y), [0, 1, 1, 1, 0])
+    g = jax.grad(lambda v: jnp.sum(Q.binary_step(v)))(x)
+    np.testing.assert_allclose(np.asarray(g),
+                               [2 - 0.4, 2 - 0.8, 0.4, 0.0, 0.0], rtol=1e-6)
+
+
+def test_prune_low_magnitude(rng):
+    x = rng.standard_normal((40, 25)).astype(np.float32)
+    out = np.asarray(Q.prune_low_magnitude(x, 0.3))
+    sparsity = np.mean(out == 0)
+    assert abs(sparsity - 0.3) < 0.02
+    kept = out != 0
+    np.testing.assert_allclose(out[kept], x[kept])
+    assert np.abs(x[~kept]).max() <= np.abs(x[kept]).min() + 1e-6
+
+
+def test_quantize_graph_ops(rng):
+    x = ht.placeholder_op("x", (8, 8))
+    s = ht.placeholder_op("s", ())
+    vx = rng.standard_normal((8, 8)).astype(np.float32)
+    ex = ht.Executor([ht.fake_quantize_op(x, s, digit=8, signed=True)])
+    out = ex.run(feed_dict={x: vx, s: np.float32(0.05)},
+                 convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(
+        out, np.clip(np.round(vx / 0.05), -128, 127) * 0.05, rtol=1e-5)
+
+
+def test_lsq_per_channel_scale():
+    # trailing-axis broadcast: x (32, 8), per-channel scale (8,)
+    key = jax.random.key(1)
+    x = jax.random.normal(key, (32, 8)) * 0.3
+    s = jnp.full((8,), 0.05)
+
+    def f(xx, ss):
+        return jnp.sum(Q.lsq_round(xx, ss, 8, True) * jnp.arange(8.0))
+
+    gx, gs = jax.grad(f, argnums=(0, 1))(x, s)
+    assert gs.shape == (8,)
+    # analytic LSQ surrogate: gs[c] = sum_rows (q - r) * w_c * gscale
+    xr = np.asarray(x)
+    r = xr / 0.05
+    q = np.clip(np.round(r), -128, 127)
+    gscale = 1.0 / np.sqrt((x.size / 8) * 127)
+    expected = ((q - r) * np.arange(8.0)).sum(0) * gscale
+    np.testing.assert_allclose(np.asarray(gs), expected, atol=1e-4)
+    # and gx reduces over nothing (same shape as x), STE in range
+    assert gx.shape == x.shape
