@@ -52,6 +52,11 @@ class Dataloader:
         self._cursor = 0
         self._thread = None
         self._stop = threading.Event()
+        if self.num_batches == 0:
+            raise ValueError(
+                f"dataloader '{name}': shard of {data.shape[0]} rows "
+                f"(dp_rank {dp_rank}/{dp_nrank}) yields no "
+                f"batches of size {batch_size}")
 
     @property
     def num_batches(self):

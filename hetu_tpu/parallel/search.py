@@ -19,8 +19,8 @@ import math
 
 import numpy as np
 
-from ..graph.node import Op, PlaceholderOp, VariableOp, find_topo_sort
-from ..profiler import (HetuSimulator, shape_map, estimate_flops,
+from ..graph.node import PlaceholderOp, VariableOp, find_topo_sort
+from ..profiler import (HetuSimulator, shape_map,
                         tensor_bytes, op_kind)
 from .mesh import DistState, make_mesh
 from .strategies import Strategy
@@ -105,6 +105,11 @@ class GraphCost:
         self.sim = simulator or HetuSimulator()
         self.shapes = shape_map(self.eval_nodes, feed_shapes)
         self.backbone = backbone_nodes(self.eval_nodes)
+        bb = set(self.backbone)
+        self._rest = [n for n in find_topo_sort(self.eval_nodes)
+                      if n not in bb
+                      and not isinstance(n, (PlaceholderOp, VariableOp))]
+        self._rest_time = {}  # dp degree -> summed non-backbone time
 
     def node_cost(self, node, choice):
         t = self.sim.op_time(node, self.shapes,
@@ -136,12 +141,11 @@ class GraphCost:
             prev = c
         # non-backbone ops run data-parallel at the dominant dp degree
         dp = max((c.dp for c in assignment.values()), default=1)
-        for node in find_topo_sort(self.eval_nodes):
-            if node in self.backbone or isinstance(
-                    node, (PlaceholderOp, VariableOp)):
-                continue
-            t += self.sim.op_time(node, self.shapes, shard_factor=dp)
-        return t
+        if dp not in self._rest_time:
+            self._rest_time[dp] = sum(
+                self.sim.op_time(n, self.shapes, shard_factor=dp)
+                for n in self._rest)
+        return t + self._rest_time[dp]
 
 
 class SearchedStrategy(Strategy):
@@ -262,16 +266,23 @@ class FlexFlowSearch:
                     best, best_assign = t, dict(assign)
             else:
                 assign[n] = old
-        # project to a single mesh: adopt the majority (dp, tp) grid
-        grids = {}
-        for c in best_assign.values():
-            grids[(c.dp, c.tp)] = grids.get((c.dp, c.tp), 0) + 1
-        dp, tp = max(grids, key=grids.get)
-        for n in chain:
-            match = [c for c in cands[n] if (c.dp, c.tp) == (dp, tp)] or \
-                [c for c in cands[n] if (c.dp, c.tp) == (dp, 1)] or \
-                [LayoutChoice()]
-            best_assign[n] = match[0]
+        # project to a single mesh: try every grid the chain visited,
+        # re-score each projected assignment, keep the cheapest (the MCMC
+        # best's cost is meaningless once nodes are forced onto one grid)
+        grids = {(c.dp, c.tp) for c in best_assign.values()}
+        grids.add((max(c.dp for c in assign.values()), 1))  # pure-DP anchor
+        proj_best = (float("inf"), None)
+        for dp, tp in grids:
+            proj = {}
+            for n in chain:
+                match = [c for c in cands[n] if (c.dp, c.tp) == (dp, tp)] or \
+                    [c for c in cands[n] if (c.dp, c.tp) == (dp, 1)] or \
+                    [LayoutChoice()]
+                proj[n] = match[0]
+            t = cost.total(proj)
+            if t < proj_best[0]:
+                proj_best = (t, proj)
+        best_assign = proj_best[1]
         return SearchedStrategy(best_assign,
                                 _assignment_mesh(best_assign, ndev))
 
@@ -328,9 +339,12 @@ class GPipeSearch:
     def search(self, layer_times, boundary_bytes=None):
         bounds = partition_stages(layer_times, self.n_stages,
                                   boundary_bytes, self.sim)
+        # partition_stages clamps to len(layer_times); the bubble term must
+        # use the stage count actually realized
+        s = len(bounds)
         prefix = np.concatenate([[0.0], np.cumsum(layer_times)])
         max_stage = max(prefix[j] - prefix[i] for i, j in bounds)
-        t = (self.n_micro + self.n_stages - 1) * max_stage / self.n_micro
+        t = (self.n_micro + s - 1) * max_stage / self.n_micro
         return bounds, float(t)
 
 
@@ -345,7 +359,7 @@ class PipeDreamSearch(GPipeSearch):
         bounds, t = super().search(layer_times, boundary_bytes)
         if mem_cap and act_bytes_per_layer:
             for idx, (i, j) in enumerate(bounds):
-                in_flight = self.n_stages - idx
+                in_flight = len(bounds) - idx
                 need = (j - i) * act_bytes_per_layer * in_flight
                 if need > mem_cap:
                     t = float("inf")  # infeasible under the cap
@@ -370,11 +384,12 @@ class PipeOptSearch:
             for m in self.micro_candidates:
                 bounds, t = GPipeSearch(pp, m, self.sim).search(
                     layer_times, boundary_bytes)
+                real_pp = len(bounds)  # partition may clamp pp to #layers
                 # dp replicas scale throughput linearly
-                dp = self.ndev // pp
+                dp = self.ndev // real_pp
                 eff = t / max(dp, 1)
                 if best is None or eff < best["time"]:
-                    best = {"pp": pp, "dp": dp, "n_micro": m,
+                    best = {"pp": real_pp, "dp": dp, "n_micro": m,
                             "bounds": bounds, "time": eff}
             pp *= 2
         return best

@@ -17,7 +17,7 @@ def load_vocab(vocab_file):
     vocab = collections.OrderedDict()
     with open(vocab_file, encoding="utf-8") as f:
         for i, line in enumerate(f):
-            tok = line.rstrip("\n")
+            tok = line.strip()
             if tok:
                 vocab[tok] = i
     return vocab
