@@ -1,0 +1,21 @@
+"""Galvatron-equivalent per-layer hybrid-parallel layer (reference:
+tools/Hetu-Galvatron — search in csrc/dp_core.cpp + galvatron/core, runtime
+in galvatron/core/{parallel,pipeline,comm_groups}.py), re-designed for TPU
+meshes: per-layer (tp, DDP|FSDP, checkpoint) strategies expressed as
+PartitionSpecs on a binary-factorized mesh inside one SPMD program."""
+
+from .build import dp_core, dp_core_numpy
+from .config import HybridParallelConfig, layer_mesh_axes, tp_dp_axes
+from .search import (CostModel, GalvatronSearch, LayerProfile, Strategy,
+                     load_profile, profile_layers_analytic, save_profile,
+                     strategy_space)
+from .runtime import (HybridParallelModel, LayerShardings,
+                      TransformerHPLayer, build_mesh)
+
+__all__ = [
+    "dp_core", "dp_core_numpy", "HybridParallelConfig", "layer_mesh_axes",
+    "tp_dp_axes", "CostModel", "GalvatronSearch", "LayerProfile", "Strategy",
+    "load_profile", "profile_layers_analytic", "save_profile",
+    "strategy_space", "HybridParallelModel", "LayerShardings",
+    "TransformerHPLayer", "build_mesh",
+]

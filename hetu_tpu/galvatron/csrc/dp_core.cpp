@@ -1,0 +1,113 @@
+// Native dynamic-programming core for per-layer hybrid-parallel strategy
+// search (Galvatron-style).
+//
+// Reference behavior: tools/Hetu-Galvatron/csrc/dp_core.cpp:22
+// `dynamic_programming_core` — a knapsack-style DP over
+// (layer, memory-budget, strategy) minimizing estimated iteration time, with
+// a per-layer intra-strategy cost, a strategy-transition (resharding) cost
+// between adjacent layers, and integer per-layer memory consumption capping
+// the budget.  The reference binds it with pybind11; pybind11 is not in this
+// image, so this implementation exposes a plain C ABI loaded via ctypes
+// (hetu_tpu/galvatron/build.py).  Code is original; only the DP recurrence
+// semantics are kept for parity.
+//
+//   f[v][s]    = best total time for the processed prefix of layers, ending
+//                in strategy s with v memory units consumed so far available
+//   mark[i][v][s] = argmin predecessor strategy for backtracking
+//
+// Returns 0 on success (-1 if no feasible assignment fits max_mem); the
+// chosen strategy per layer is written into res[], the optimal cost into
+// *cost_out, and the leftover memory into *mem_left_out.
+
+#include <algorithm>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+namespace {
+constexpr double kInf = std::numeric_limits<double>::infinity();
+}
+
+extern "C" {
+
+// layer_num   L
+// max_mem     V   (integer memory budget, discretized units)
+// strategy_num S
+// mem_cost    [L*S]   int32  per-layer memory units under each strategy
+// intra_cost  [L*S]   double per-layer compute(+comm) time under strategy
+// inter_cost  [L*S*S] double transition cost layer i-1 (strategy si) -> layer i (strategy s)
+//                     (inter_cost[i*S*S + si*S + s]; row i=0 is ignored)
+// res         [L]     int32  out: chosen strategy per layer
+int galvatron_dp_core(int64_t layer_num, int64_t max_mem, int64_t strategy_num,
+                      const int32_t* mem_cost, const double* intra_cost,
+                      const double* inter_cost, int32_t* res,
+                      double* cost_out, int64_t* mem_left_out) {
+  const int64_t L = layer_num, V = max_mem, S = strategy_num;
+  if (L <= 0 || V <= 0 || S <= 0) return -1;
+
+  // f is rolled over layers: f[v][s].  mark keeps the full history.
+  std::vector<double> f(static_cast<size_t>(V) * S, 0.0);
+  std::vector<int32_t> mark(static_cast<size_t>(L) * V * S, -1);
+
+  for (int64_t i = 0; i < L; ++i) {
+    // descending v so f[v - m] still holds layer i-1 values (rolling array)
+    for (int64_t v = V - 1; v >= 0; --v) {
+      for (int64_t s = 0; s < S; ++s) {
+        const int32_t m = mem_cost[i * S + s];
+        double* fvs = &f[v * S + s];
+        if (v < m) {
+          *fvs = kInf;
+          continue;
+        }
+        const double* prev = &f[(v - m) * S];
+        double best = kInf;
+        int32_t best_si = -1;
+        if (i == 0) {
+          // no predecessor layer: f starts at 0, no transition cost
+          best = prev[s];
+          best_si = static_cast<int32_t>(s);
+        } else {
+          for (int64_t si = 0; si < S; ++si) {
+            const double cand = prev[si] + inter_cost[i * S * S + si * S + s];
+            if (cand < best) {
+              best = cand;
+              best_si = static_cast<int32_t>(si);
+            }
+          }
+        }
+        if (best_si >= 0 && best < kInf) {
+          *fvs = best + intra_cost[i * S + s];
+          mark[(i * V + v) * S + s] = best_si;
+        } else {
+          *fvs = kInf;
+        }
+      }
+    }
+  }
+
+  // pick the best terminal strategy at full budget
+  const double* last = &f[(V - 1) * S];
+  int64_t cur = std::min_element(last, last + S) - last;
+  double total = last[cur];
+  if (!(total < kInf)) {
+    *cost_out = kInf;
+    *mem_left_out = -1;
+    return -1;
+  }
+
+  int64_t v = V - 1;
+  res[L - 1] = static_cast<int32_t>(cur);
+  for (int64_t i = L - 1; i > 0; --i) {
+    const int32_t prev_s = mark[(i * V + v) * S + cur];
+    v -= mem_cost[i * S + cur];
+    cur = prev_s;
+    res[i - 1] = static_cast<int32_t>(cur);
+  }
+  v -= mem_cost[0 * S + cur];
+
+  *cost_out = total;
+  *mem_left_out = v;
+  return 0;
+}
+
+}  // extern "C"

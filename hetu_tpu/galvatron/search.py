@@ -1,0 +1,283 @@
+"""Galvatron-style per-layer hybrid-parallel strategy search.
+
+Reference: tools/Hetu-Galvatron — the search core is a DP over
+(layer, memory budget, strategy) minimizing estimated iteration time
+(csrc/dp_core.cpp:22), fed by profiled per-layer compute times and a
+hardware bandwidth profile (core/profiler.py:8, configs/
+computation_profiling_*.json).  The outer loop enumerates pipeline degrees
+and micro-batch counts; the inner DP picks per-layer (tp size, DDP|FSDP,
+checkpoint) under the per-device memory budget.
+
+This module keeps the profile→search→JSON-config contract, with costs
+re-derived for a TPU mesh: TP comm = 2 allreduces of activations per layer
+over the tp axes (ICI), FSDP adds a param all-gather per layer, DP grad
+sync = one reduce-scatter+all-gather of params per step amortized over
+layers, transition cost between adjacent layers with different layouts =
+activation resharding bytes / ICI bandwidth.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+
+import numpy as np
+
+from .build import dp_core, dp_core_numpy
+from .config import HybridParallelConfig
+
+
+class LayerProfile:
+    """Per-layer measurements driving the cost model.
+
+    compute_ms : forward time of the full (unsharded) layer for ONE sample
+                 (profiled time / profiled batch size — profiler contract).
+    param_bytes: total parameter bytes of the layer.
+    act_bytes  : activation bytes entering/leaving the layer per sample.
+    """
+
+    def __init__(self, compute_ms, param_bytes, act_bytes):
+        self.compute_ms = float(compute_ms)
+        self.param_bytes = float(param_bytes)
+        self.act_bytes = float(act_bytes)
+
+    def to_json(self):
+        return {"compute_ms": self.compute_ms, "param_bytes": self.param_bytes,
+                "act_bytes": self.act_bytes}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(d["compute_ms"], d["param_bytes"], d["act_bytes"])
+
+
+def save_profile(path, layers, ici_gbps=100.0, dcn_gbps=10.0):
+    """computation_profiling_*.json equivalent."""
+    with open(path, "w") as fh:
+        json.dump({"layers": [l.to_json() for l in layers],
+                   "ici_gbps": ici_gbps, "dcn_gbps": dcn_gbps}, fh, indent=2)
+
+
+def load_profile(path):
+    with open(path) as fh:
+        d = json.load(fh)
+    return ([LayerProfile.from_json(l) for l in d["layers"]],
+            d.get("ici_gbps", 100.0), d.get("dcn_gbps", 10.0))
+
+
+class Strategy:
+    """One inner-DP strategy: (tp, dp_type, ckpt) on a per-stage submesh of
+    ``per_stage`` devices (dp degree = per_stage // tp)."""
+
+    __slots__ = ("tp", "dp_type", "ckpt")
+
+    def __init__(self, tp, dp_type, ckpt):
+        self.tp, self.dp_type, self.ckpt = tp, dp_type, ckpt
+
+    def __repr__(self):
+        kind = "fsdp" if self.dp_type else "ddp"
+        return f"(tp={self.tp},{kind},ckpt={self.ckpt})"
+
+
+def strategy_space(per_stage, with_ckpt=True):
+    out = []
+    tp = 1
+    while tp <= per_stage:
+        dp = per_stage // tp
+        dp_types = [0, 1] if dp > 1 else [0]
+        ckpts = [0, 1] if with_ckpt else [0]
+        for dt, ck in itertools.product(dp_types, ckpts):
+            out.append(Strategy(tp, dt, ck))
+        tp *= 2
+    return out
+
+
+class CostModel:
+    """Estimated per-layer per-MICRO-BATCH time (ms) and per-device memory
+    (bytes) under a strategy.
+
+    ``micro_bsz`` is the GLOBAL micro-batch size (one pipeline chunk across
+    all dp replicas); the model divides by dp for the per-device share, so
+    strategies with different dp degrees are compared at equal per-step
+    work.  bwd compute is modeled as 2x fwd; checkpointing adds one extra
+    fwd.  Bytes-over-ICI use ring-collective cost (n-1)/n * bytes.
+    Per-step costs (DP grad sync) are amortized over ``chunks``
+    micro-batches; per-micro costs (TP activation allreduce, FSDP param
+    gathers) are not.
+    """
+
+    def __init__(self, layers, per_stage, micro_bsz, chunks=1,
+                 ici_gbps=100.0, fsdp_overlap=0.5):
+        self.layers = layers
+        self.per_stage = per_stage
+        self.micro_bsz = micro_bsz
+        self.chunks = max(1, chunks)
+        self.ici = ici_gbps * 1e9 / 1e3      # bytes per ms
+        self.fsdp_overlap = fsdp_overlap     # fraction of FSDP gather hidden
+
+    def _coll_ms(self, nbytes, n):
+        if n <= 1:
+            return 0.0
+        return (nbytes * (n - 1) / n) / self.ici
+
+    def _local_bsz(self, st):
+        dp = self.per_stage // st.tp
+        return self.micro_bsz / dp
+
+    def intra_ms(self, i, st):
+        L = self.layers[i]
+        dp = self.per_stage // st.tp
+        lb = self._local_bsz(st)
+        fwd = L.compute_ms * lb / st.tp
+        bwd = 2.0 * fwd
+        recompute = fwd if st.ckpt else 0.0
+        act = L.act_bytes * lb
+        # Megatron TP: allreduce activations in fwd + bwd (2 each)
+        tp_comm = 4.0 * self._coll_ms(act, st.tp)
+        # DP grad sync once per step: reduce-scatter + all-gather of this
+        # layer's param shard, amortized over the micro-batches
+        dp_comm = 2.0 * self._coll_ms(L.param_bytes / st.tp, dp) / self.chunks
+        # FSDP: params re-gathered per micro-batch in fwd and bwd
+        fsdp_comm = (2.0 * self._coll_ms(L.param_bytes / st.tp, dp)
+                     * (1.0 - self.fsdp_overlap)) if st.dp_type else 0.0
+        return fwd + bwd + recompute + tp_comm + dp_comm + fsdp_comm
+
+    def inter_ms(self, i, prev_st, st):
+        """Activation-resharding cost entering layer i (reference
+        relocate_activations / redistribution groups)."""
+        if prev_st.tp == st.tp:
+            return 0.0
+        L = self.layers[i]
+        # bytes held per device under the coarser of the two layouts cross
+        # ICI once (all-gather + re-slice)
+        lb = min(self._local_bsz(prev_st), self._local_bsz(st))
+        n = max(prev_st.tp, st.tp)
+        return self._coll_ms(L.act_bytes * lb, n)
+
+    def mem_bytes(self, i, st, n_micro_live=1):
+        L = self.layers[i]
+        dp = self.per_stage // st.tp
+        lb = self._local_bsz(st)
+        param_shard = L.param_bytes / st.tp / (dp if st.dp_type else 1)
+        # params + grads + adam moments (m, v) in f32 masters ≈ 4x params
+        state = 4.0 * param_shard
+        act = (L.act_bytes * lb / st.tp) * n_micro_live
+        if st.ckpt:
+            act = L.act_bytes * lb / st.tp * 0.2  # stage-boundary acts only
+        return state + act
+
+
+class GalvatronSearch:
+    """Outer loop over pp_deg (and chunks), inner native DP per layer.
+
+    mem_budget_bytes is the per-device HBM budget.  Returns the best
+    HybridParallelConfig.
+    """
+
+    def __init__(self, world, mem_budget_bytes, micro_bsz=1,
+                 ici_gbps=100.0, mem_units=64, use_native=True,
+                 pp_candidates=None, chunks_candidates=(1, 2, 4, 8)):
+        self.world = world
+        self.budget = float(mem_budget_bytes)
+        self.micro_bsz = micro_bsz
+        self.ici_gbps = ici_gbps
+        self.mem_units = mem_units          # DP memory discretization
+        self.use_native = use_native
+        self.pp_candidates = pp_candidates
+        self.chunks_candidates = chunks_candidates
+
+    def _pp_list(self, n_layers):
+        if self.pp_candidates is not None:
+            return self.pp_candidates
+        out, pp = [], 1
+        while pp <= min(self.world, n_layers):
+            out.append(pp)
+            pp *= 2
+        return out
+
+    def search(self, layers, global_bsz=None):
+        global_bsz = global_bsz or self.micro_bsz * max(self.chunks_candidates)
+        best = (float("inf"), None)
+        n_layers = len(layers)
+        for pp in self._pp_list(n_layers):
+            per_stage = self.world // pp
+            if per_stage == 0 or per_stage * pp != self.world:
+                continue
+            if per_stage & (per_stage - 1):
+                continue
+            space = strategy_space(per_stage)
+            for chunks in self.chunks_candidates:
+                if global_bsz % chunks:
+                    continue
+                cost, cfg = self._search_inner(layers, pp, per_stage, space,
+                                               chunks, global_bsz)
+                if cost < best[0]:
+                    best = (cost, cfg)
+        return best[1]
+
+    def _search_inner(self, layers, pp, per_stage, space, chunks, global_bsz):
+        """Inner DP, run per pipeline stage so mem_budget_bytes is enforced
+        per DEVICE (each device holds exactly one stage's layers).
+
+        Step-time model: per-micro stage times t_s from the DP; a flush
+        schedule (GPipe/1F1B) costs  chunks * max_s(t_s) + sum_{s != argmax}
+        t_s  — steady state is bound by the slowest stage, the rest is
+        fill/drain.  The cost tables depend on chunks (micro-batch size and
+        grad-sync amortization), so they are rebuilt per (pp, chunks).
+        """
+        micro_bsz = global_bsz // chunks
+        if micro_bsz == 0:
+            return float("inf"), None
+        model = CostModel(layers, per_stage, micro_bsz, chunks=chunks,
+                          ici_gbps=self.ici_gbps)
+        L, S = len(layers), len(space)
+        unit = self.budget / self.mem_units
+        # gpipe keeps ~chunks micro-batch activations live; 1f1b keeps ≤ pp
+        n_live = min(chunks, pp) if pp > 1 else 1
+        # uniform stage division (reference default pp_divide)
+        avg = L // pp
+        division = [avg] * (pp - 1) + [L - avg * (pp - 1)]
+        run = dp_core if self.use_native else dp_core_numpy
+        assignment, stage_times = [], []
+        lo = 0
+        for stage_len in division:
+            hi = lo + stage_len
+            mem = np.zeros((stage_len, S), dtype=np.int32)
+            intra = np.zeros((stage_len, S))
+            inter = np.zeros((stage_len, S, S))
+            for j, i in enumerate(range(lo, hi)):
+                for s, st in enumerate(space):
+                    mem[j, s] = max(1, int(np.ceil(
+                        model.mem_bytes(i, st, n_live) / unit)))
+                    intra[j, s] = model.intra_ms(i, st)
+                    for sp, stp in enumerate(space):
+                        inter[j, sp, s] = model.inter_ms(i, stp, st)
+            cost, stage_assign, _ = run(mem, intra, inter, self.mem_units)
+            if stage_assign is None:
+                return float("inf"), None
+            assignment += stage_assign
+            stage_times.append(cost)
+            lo = hi
+        slowest = max(stage_times)
+        total = chunks * slowest + (sum(stage_times) - slowest)
+        cfg = HybridParallelConfig(
+            pp_deg=pp,
+            tp_sizes=[space[s].tp for s in assignment],
+            dp_types=[space[s].dp_type for s in assignment],
+            checkpoint_flags=[space[s].ckpt for s in assignment],
+            pp_division=division,
+            global_bsz=global_bsz, chunks=chunks, world=self.world,
+            pipeline_type="pipedream_flush" if pp > 1 else "gpipe")
+        return total, cfg
+
+
+def profile_layers_analytic(n_layers, hidden, seq, ffn_mult=4, dtype_bytes=2,
+                            chip_tflops=200.0):
+    """Analytic LayerProfile for a transformer layer (used when no measured
+    profile exists; the profiler contract replaces this with real timings)."""
+    param_bytes = (4 * hidden * hidden + 2 * ffn_mult * hidden * hidden) * 4
+    flops = 2 * (4 * hidden * hidden + 2 * ffn_mult * hidden * hidden) * seq \
+        + 4 * seq * seq * hidden
+    compute_ms = flops / (chip_tflops * 1e12) * 1e3
+    act_bytes = seq * hidden * dtype_bytes
+    return [LayerProfile(compute_ms, param_bytes, act_bytes)
+            for _ in range(n_layers)]

@@ -1,0 +1,57 @@
+"""Shared build-and-load helper for in-tree native (C++) components.
+
+The reference ships prebuilt .so files loaded via ctypes (libps.so at
+executor.py:100-137, libc_runtime_api.so in _base.py); here each native
+component compiles from source on first use so the repo stays
+self-contained.  Used by hetu_tpu/ps (embedding store) and
+hetu_tpu/galvatron (DP search core).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+
+class NativeLib:
+    """Lazily compiled + loaded shared library.
+
+    declare(lib) is called once after load to set restype/argtypes.
+    """
+
+    def __init__(self, src, lib_path, declare=None, extra_flags=()):
+        self.src = src
+        self.lib_path = lib_path
+        self.declare = declare
+        self.extra_flags = list(extra_flags)
+        self._lock = threading.Lock()
+        self._lib = None
+
+    def _needs_build(self):
+        return (not os.path.exists(self.lib_path)
+                or os.path.getmtime(self.lib_path) < os.path.getmtime(self.src))
+
+    def build(self):
+        cmd = (["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+                "-fPIC"] + self.extra_flags
+               + ["-o", self.lib_path, self.src])
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"building {os.path.basename(self.lib_path)} failed:\n"
+                f"{proc.stderr}")
+        return self.lib_path
+
+    def load(self):
+        with self._lock:
+            if self._lib is not None:
+                return self._lib
+            if self._needs_build():
+                self.build()
+            lib = ctypes.CDLL(self.lib_path)
+            if self.declare is not None:
+                self.declare(lib)
+            self._lib = lib
+            return lib

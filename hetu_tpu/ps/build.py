@@ -9,41 +9,13 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
+
+from ..native_build import NativeLib
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "native", "hetu_ps.cpp")
-_LIB = os.path.join(_HERE, "native", "libhetu_ps.so")
-
-_lock = threading.Lock()
-_lib = None
 
 
-def _needs_build():
-    return (not os.path.exists(_LIB)
-            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
-
-
-def build():
-    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           "-pthread", "-o", _LIB, _SRC]
-    proc = subprocess.run(cmd, capture_output=True, text=True)
-    if proc.returncode != 0:
-        raise RuntimeError(
-            f"building libhetu_ps.so failed:\n{proc.stderr}")
-    return _LIB
-
-
-def load():
-    """Compile (if needed) and load the native library, declaring arg types."""
-    global _lib
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if _needs_build():
-            build()
-        lib = ctypes.CDLL(_LIB)
+def _declare(lib):
         i64, f32p, i64p, u64p = (ctypes.c_int64,
                                  ctypes.POINTER(ctypes.c_float),
                                  ctypes.POINTER(ctypes.c_int64),
@@ -80,5 +52,17 @@ def load():
         lib.ssp_clock.argtypes = [i64, ctypes.c_int]
         lib.ssp_min.restype = i64
         lib.ssp_min.argtypes = [i64]
-        _lib = lib
-        return _lib
+
+
+_native = NativeLib(os.path.join(_HERE, "native", "hetu_ps.cpp"),
+                    os.path.join(_HERE, "native", "libhetu_ps.so"),
+                    declare=_declare, extra_flags=["-pthread"])
+
+
+def build():
+    return _native.build()
+
+
+def load():
+    """Compile (if needed) and load the native library, declaring arg types."""
+    return _native.load()

@@ -1,0 +1,195 @@
+"""Tests for the Galvatron-equivalent per-layer hybrid-parallel layer.
+
+Reference behaviors covered (tools/Hetu-Galvatron):
+  - csrc/dp_core.cpp dynamic_programming_core — native DP vs numpy oracle,
+    memory feasibility, transition costs steering assignments
+  - hybrid_parallel_config.py JSON schema round-trip
+  - core/parallel.py per-layer TP/DP(FSDP) wrapping + relocation — here:
+    per-layer PartitionSpecs on a binary mesh; numerics vs a plain
+    single-device forward
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.galvatron import (GalvatronSearch, HybridParallelConfig,
+                                HybridParallelModel, LayerProfile,
+                                TransformerHPLayer, dp_core, dp_core_numpy,
+                                profile_layers_analytic, strategy_space,
+                                tp_dp_axes, layer_mesh_axes)
+
+
+class TestDPCore:
+    def _rand_problem(self, rng, L=6, S=4, V=40):
+        mem = rng.integers(1, 8, size=(L, S)).astype(np.int32)
+        intra = rng.uniform(1.0, 10.0, size=(L, S))
+        inter = rng.uniform(0.0, 2.0, size=(L, S, S))
+        return mem, intra, inter, V
+
+    def test_native_matches_numpy_oracle(self, rng):
+        for _ in range(5):
+            mem, intra, inter, V = self._rand_problem(rng)
+            c1, r1, _ = dp_core(mem, intra, inter, V)
+            c2, r2, _ = dp_core_numpy(mem, intra, inter, V)
+            assert c1 == pytest.approx(c2)
+            # costs of returned assignments must match (ties may differ)
+            assert r1 is not None and r2 is not None
+
+    def test_picks_cheapest_when_memory_allows(self):
+        L, S = 3, 2
+        mem = np.ones((L, S), dtype=np.int32)
+        intra = np.array([[5.0, 1.0]] * L)
+        inter = np.zeros((L, S, S))
+        cost, res, _ = dp_core(mem, intra, inter, 100)
+        assert res == [1, 1, 1] and cost == pytest.approx(3.0)
+
+    def test_memory_forces_mixed_assignment(self):
+        # strategy 0: cheap but heavy; strategy 1: slow but light
+        L = 4
+        mem = np.array([[10, 1]] * L, dtype=np.int32)
+        intra = np.array([[1.0, 5.0]] * L)
+        inter = np.zeros((L, 2, 2))
+        # DP budget index starts at max_mem-1 (reference semantics), so
+        # pass 23 for an effective capacity of 22 = 2*10 + 2*1
+        cost, res, _ = dp_core(mem, intra, inter, 23)
+        assert res is not None
+        assert sum(1 for s in res if s == 0) == 2  # only 2 heavy layers fit
+        assert cost == pytest.approx(2 * 1.0 + 2 * 5.0)
+
+    def test_infeasible_returns_inf(self):
+        mem = np.full((2, 2), 50, dtype=np.int32)
+        cost, res, left = dp_core(mem, np.ones((2, 2)), np.zeros((2, 2, 2)), 10)
+        assert cost == float("inf") and res is None
+
+    def test_transition_cost_prefers_uniform(self):
+        # alternating cheap strategies but huge transition cost => uniform
+        L, S = 4, 2
+        mem = np.ones((L, S), dtype=np.int32)
+        intra = np.array([[1.0, 1.1]] * L)
+        inter = np.zeros((L, S, S))
+        inter[:, 0, 1] = inter[:, 1, 0] = 100.0
+        cost, res, _ = dp_core(mem, intra, inter, 100)
+        assert len(set(res)) == 1
+
+
+class TestConfig:
+    def test_json_roundtrip(self, tmp_path):
+        cfg = HybridParallelConfig(
+            pp_deg=2, tp_sizes=[2, 2, 4, 4], dp_types=[0, 0, 1, 1],
+            checkpoint_flags=[0, 1, 0, 1], global_bsz=32, chunks=4, world=16)
+        p = tmp_path / "cfg.json"
+        cfg.save(p)
+        loaded = HybridParallelConfig.load(p)
+        assert loaded.tp_sizes == cfg.tp_sizes
+        assert loaded.dp_types == cfg.dp_types
+        assert loaded.pp_division == cfg.pp_division
+        assert loaded.pp_ranks() == [0, 0, 1, 1]
+        raw = json.loads(p.read_text())
+        assert raw["tp_sizes_enc"] == "2,2,4,4"  # reference string encoding
+
+    def test_axes_split(self):
+        k, axes = layer_mesh_axes(world=8, pp_deg=1)
+        assert k == 3 and axes == ("m0", "m1", "m2")
+        dp_axes, tp_axes = tp_dp_axes(k, axes, tp_size=2, consecutive=1)
+        assert tp_axes == ("m2",) and dp_axes == ("m0", "m1")
+        dp_axes, tp_axes = tp_dp_axes(k, axes, tp_size=4, consecutive=0)
+        assert tp_axes == ("m0", "m1") and dp_axes == ("m2",)
+
+    def test_validation(self):
+        with pytest.raises(AssertionError):
+            HybridParallelConfig(pp_deg=1, tp_sizes=[3], dp_types=[0])
+        with pytest.raises(AssertionError):
+            HybridParallelConfig(pp_deg=1, tp_sizes=[16], dp_types=[0],
+                                 world=8)
+
+
+class TestSearch:
+    def test_search_returns_feasible_config(self):
+        layers = profile_layers_analytic(8, hidden=1024, seq=512)
+        eng = GalvatronSearch(world=8, mem_budget_bytes=2 << 30,
+                              micro_bsz=4, chunks_candidates=(1, 4))
+        cfg = eng.search(layers, global_bsz=32)
+        assert cfg is not None
+        cfg.validate()
+        assert cfg.n_layers == 8
+
+    def test_tight_memory_prefers_sharded_strategies(self):
+        # activation-heavy layers: TP's activation allreduces cost more than
+        # DDP's grad sync, so with loose memory plain DP wins; with a tight
+        # budget the 1.6GB/layer optimizer state forces fsdp and/or tp
+        layers = [LayerProfile(compute_ms=1.0, param_bytes=4e8, act_bytes=5e7)
+                  for _ in range(4)]
+        loose = GalvatronSearch(world=8, mem_budget_bytes=64 << 30,
+                                micro_bsz=64, pp_candidates=[1],
+                                chunks_candidates=(1,))
+        tight = GalvatronSearch(world=8, mem_budget_bytes=4 << 30,
+                                micro_bsz=64, pp_candidates=[1],
+                                chunks_candidates=(1,))
+        cfg_loose = loose.search(layers)
+        cfg_tight = tight.search(layers)
+        assert cfg_loose is not None and cfg_tight is not None
+        # loose budget: nothing forces optimizer-state sharding
+        assert sum(cfg_loose.dp_types) == 0 and set(cfg_loose.tp_sizes) == {1}
+        # tight budget: 4 layers x ~2GB (optimizer state + acts) cannot fit
+        # unsharded in 4GB — the search must pick fsdp and/or tp>1
+        assert sum(cfg_tight.dp_types) > 0 or any(
+            t > 1 for t in cfg_tight.tp_sizes)
+
+    def test_strategy_space(self):
+        space = strategy_space(8)
+        reprs = {repr(s) for s in space}
+        assert "(tp=8,ddp,ckpt=0)" in reprs      # dp=1 → no fsdp variant
+        assert "(tp=1,fsdp,ckpt=1)" in reprs
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestRuntime:
+    def _make(self, tp_sizes, dp_types, chunks=1, ckpt=None):
+        n = len(tp_sizes)
+        specs = [TransformerHPLayer(hidden=32, heads=4) for _ in range(n)]
+        cfg = HybridParallelConfig(
+            pp_deg=1, tp_sizes=tp_sizes, dp_types=dp_types,
+            checkpoint_flags=ckpt, chunks=chunks, world=8)
+        return HybridParallelModel(specs, cfg)
+
+    def test_forward_matches_unsharded(self):
+        model = self._make([1, 2, 4], [0, 1, 0])
+        params = model.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 32))
+        out = jax.jit(model.apply)(params, x)
+        # plain single-device reference: same math, no shardings
+        host = [jax.tree_util.tree_map(np.asarray, p) for p in params]
+        ref = np.asarray(x)
+        for spec, sh, p in zip(model.specs, model.shardings, host):
+            ref = np.asarray(spec.apply(
+                {k: jnp.asarray(v) for k, v in p.items()}, jnp.asarray(ref),
+                sh))
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-4)
+
+    def test_train_step_decreases_loss(self):
+        model = self._make([2, 2], [1, 1], chunks=2, ckpt=[1, 1])
+        params = model.init_params(jax.random.PRNGKey(0))
+        step, opt_init = model.make_train_step(lr=0.05)
+        opt_state = opt_init(params)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 32))
+        tgt = jax.random.normal(jax.random.PRNGKey(2), (8, 4, 32)) * 0.1
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, x, tgt)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_param_shardings_applied(self):
+        model = self._make([4, 1], [0, 1])
+        params = model.init_params(jax.random.PRNGKey(0))
+        # layer 0: wqkv column-sharded over 2 tp axes (4-way)
+        sh0 = params[0]["wqkv"].sharding.spec
+        assert sh0[1] is not None
+        # layer 1: tp=1 + fsdp → w sharded over dp axes on a dim
+        sh1 = params[1]["wqkv"].sharding.spec
+        assert any(s is not None for s in sh1)
