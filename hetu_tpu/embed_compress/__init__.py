@@ -1,0 +1,150 @@
+"""Embedding memory compression (VLDB'24 suite, TPU-native).
+
+Equivalent of the reference's tools/EmbeddingMemoryCompression: ~17
+compression methods as interchangeable embedding layers (layers.py), sizing
+/ stage-transition planning (planner.py), and hash ops (hashing.py).
+``make_compressed_embedding`` is the method registry the reference exposes
+through run_compressed.py's --method flag.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .hashing import (mod_hash_op, div_hash_op, mod_hash_negative_op,
+                      compo_hash_op, learn_hash_op, robe_hash_op,
+                      robe_sign_op, make_robe_random_numbers,
+                      primes_at_least)
+from .layers import (CompressedEmbedding, HashEmbedding,
+                     CompositionalEmbedding, TensorTrainEmbedding,
+                     RobeEmbedding, DeepHashEmbedding, AdaptiveEmbedding,
+                     MDEmbedding, AutoDimEmbedding, AutoDimRetrainEmbedding,
+                     OptEmbedding, OptEmbeddingAfterRowPruning,
+                     PEPEmbedding, PEPRetrainEmbedding, DeepLightEmbedding,
+                     AutoSrhEmbedding, QuantizedEmbedding, ALPTEmbedding,
+                     DPQEmbedding, MGQEmbedding, DedupEmbedding,
+                     BatchNorm1d, lookup_or_zero_op)
+from . import planner
+from .planner import (hash_rows, qr_sizes, tt_decomp_dims, tt_decomp_rows,
+                      tt_rank, robe_size, dhe_mlp_dim, md_dims, adapt_remap,
+                      adapt_sizes, autosrh_group_indices, autodim_choose,
+                      pep_export_mask, optembed_row_prune,
+                      evolutionary_dim_search, dedup_build)
+
+METHODS = ("full", "hash", "compo", "tt", "robe", "dhe", "adapt", "md",
+           "autodim", "optembed", "pep", "deeplight", "autosrh", "quantize",
+           "alpt", "dpq", "mgqe")
+
+
+def make_compressed_embedding(method, num_embeddings, embedding_dim,
+                              compress_rate=0.5, batch_size=None,
+                              num_slot=None, frequencies=None, rng=None,
+                              name=None, **kwargs):
+    """Build a compression layer from a target compress_rate.
+
+    Mirrors the scheduler sizing of the reference's --method registry
+    (methods/scheduler/__init__.py).  ``frequencies`` (id counts) is required
+    for adapt/mgqe/autosrh; ``batch_size``+``num_slot`` for
+    autodim/optembed/dpq/mgqe.
+    """
+    rng = rng or np.random.default_rng(0)
+    name = name or f"{method}_emb"
+    if method == "full":
+        return CompressedEmbedding(num_embeddings, embedding_dim, name=name)
+    if method == "hash":
+        return HashEmbedding(hash_rows(num_embeddings, compress_rate),
+                             embedding_dim, name=name)
+    if method == "compo":
+        nq, nr = qr_sizes(num_embeddings, compress_rate)
+        return CompositionalEmbedding(nq, nr, embedding_dim,
+                                      kwargs.get("aggregator", "mul"),
+                                      name=name)
+    if method == "tt":
+        rows = tt_decomp_rows(num_embeddings)
+        dims = tt_decomp_dims(embedding_dim)
+        rank = tt_rank(num_embeddings, embedding_dim, compress_rate, rows,
+                       dims)
+        return TensorTrainEmbedding(rows, dims, rank, name=name)
+    if method == "robe":
+        Z = kwargs.get("Z", min(8, embedding_dim))
+        return RobeEmbedding(robe_size(num_embeddings, embedding_dim,
+                                       compress_rate),
+                             embedding_dim, Z, rng,
+                             nslot=num_slot or 1, name=name)
+    if method == "dhe":
+        num_hash = kwargs.get("num_hash", 64)
+        nbuckets = kwargs.get("num_buckets", 1000000)
+        mlp = dhe_mlp_dim(num_embeddings, embedding_dim, compress_rate,
+                          num_hash)
+        return DeepHashEmbedding(embedding_dim, mlp, nbuckets, num_hash,
+                                 rng, dist=kwargs.get("dist", "uniform"),
+                                 name=name)
+    if method == "adapt":
+        assert frequencies is not None, "adapt needs id frequencies"
+        top = kwargs.get("top_percent", compress_rate / 2)
+        remap, nfreq = adapt_remap(frequencies, top)
+        nrare = adapt_sizes(num_embeddings, compress_rate, nfreq)
+        return AdaptiveEmbedding(nfreq, nrare, remap, embedding_dim,
+                                 name=name)
+    if method == "md":
+        cdim = max(1, int(embedding_dim * compress_rate))
+        return MDEmbedding(num_embeddings, cdim, embedding_dim, name=name)
+    if method == "autodim":
+        assert batch_size and num_slot
+        cands = kwargs.get("dim_candidates",
+                           [d for d in (2, 4, 8, 16, 32, 64)
+                            if d <= embedding_dim])
+        return AutoDimEmbedding(num_embeddings, cands, num_slot, batch_size,
+                                name=name)
+    if method == "optembed":
+        assert batch_size and num_slot
+        return OptEmbedding(num_embeddings, embedding_dim, num_slot,
+                            batch_size, name=name)
+    if method == "pep":
+        return PEPEmbedding(num_embeddings, embedding_dim,
+                            kwargs.get("threshold_type", "feature"),
+                            kwargs.get("threshold_init", -15.0), name=name)
+    if method == "deeplight":
+        return DeepLightEmbedding(num_embeddings, embedding_dim,
+                                  prune_rate=1.0 - compress_rate,
+                                  name=name)
+    if method == "autosrh":
+        assert frequencies is not None, "autosrh needs id frequencies"
+        nsplit = kwargs.get("nsplit", 10)
+        groups = autosrh_group_indices(frequencies, nsplit)
+        return AutoSrhEmbedding(num_embeddings, embedding_dim, nsplit,
+                                groups, name=name)
+    if method == "quantize":
+        return QuantizedEmbedding(num_embeddings, embedding_dim,
+                                  kwargs.get("digit", 8),
+                                  scale=kwargs.get("scale", 0.01),
+                                  use_qparam=kwargs.get("use_qparam", False),
+                                  name=name)
+    if method == "alpt":
+        return ALPTEmbedding(num_embeddings, embedding_dim,
+                             kwargs.get("digit", 8),
+                             kwargs.get("init_scale", 0.01), name=name)
+    if method == "dpq":
+        assert batch_size
+        return DPQEmbedding(num_embeddings, embedding_dim,
+                            kwargs.get("num_choices", 32),
+                            kwargs.get("num_parts", 4), batch_size,
+                            share_weights=kwargs.get("share_weights", False),
+                            mode=kwargs.get("mode", "vq"), name=name)
+    if method == "mgqe":
+        assert batch_size and frequencies is not None
+        # MGQEmbedding's mask is an indicator (nonzero = high-frequency id
+        # gets the full codebook); threshold raw counts at the top-percent
+        # quantile, as the reference scheduler does before constructing the
+        # layer (scheduler/mgqe.py)
+        counts = np.asarray(frequencies)
+        top = kwargs.get("top_percent", 0.1)
+        cut = np.quantile(counts, 1.0 - top)
+        indicator = (counts >= cut).astype(np.int32)
+        return MGQEmbedding(num_embeddings, embedding_dim,
+                            kwargs.get("high_num_choices", 32),
+                            kwargs.get("low_num_choices", 8),
+                            kwargs.get("num_parts", 4), indicator,
+                            batch_size, name=name)
+    raise ValueError(f"unknown compression method {method!r}; "
+                     f"choose from {METHODS}")

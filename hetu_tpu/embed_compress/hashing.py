@@ -1,0 +1,151 @@
+"""Hash ops for compressed embeddings.
+
+TPU-native equivalents of the reference hash kernels in
+src/ops/CompressedEmbedding.cu (robe_hash_kernel :3, robe_sign_kernel :27,
+mod_hash_kernel :50, mod_hash_negative_kernel :58, div_hash_kernel :72,
+compo_hash_kernel :80, learn_hash_kernel :93) and their graph ops in
+python/hetu/gpu_ops/CompressedEmbedding.py.  Each is a pure jnp int
+composition that XLA fuses straight into the surrounding gather.
+
+Arithmetic note: the reference computes the universal hashes in int64.  JAX
+on TPU defaults to 32-bit ints, so our hashes are DEFINED over int32
+wraparound arithmetic ((a*x + b) mod 2^32 mod P mod M) — deterministic,
+well-mixed, and fast on the VPU, but numerically different from the CUDA
+kernels.  `%` follows Python sign semantics, so results are non-negative.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.base import simple_op
+
+
+def _mod_hash(x, nembed=None):
+    return (x.astype(jnp.int32) % jnp.int32(nembed)).astype(jnp.int32)
+
+
+def _div_hash(x, nembed=None):
+    return (x.astype(jnp.int32) // jnp.int32(nembed)).astype(jnp.int32)
+
+
+def _mod_hash_negative(x, nembed=None):
+    """Adaptive-embedding rare path: remapped ids are stored as -(i+1) for
+    rare id i; map those into [0, nembed) and keep frequent ids negative so
+    the (zero-padding) lookup ignores them."""
+    prev = -(x.astype(jnp.int32) + 1)
+    return jnp.where(prev >= 0, prev % jnp.int32(nembed), prev)
+
+
+def _compo_hash(x, ntable=None, nembed=None):
+    """Decompose each id into ``ntable`` base-``nembed`` digits -> [..., ntable]."""
+    x = x.astype(jnp.int32)
+    digits = []
+    for _ in range(ntable):
+        digits.append(x % jnp.int32(nembed))
+        x = x // jnp.int32(nembed)
+    return jnp.stack(digits, axis=-1)
+
+
+def _learn_hash(x, slope, bias, prime, nbucket=None, dist="uniform",
+                eps=1e-12):
+    """DHE (KDD'21) k universal hashes + distribution transform.
+
+    h_i(x) = ((x * slope_i + bias_i) mod prime_i) mod nbucket, scaled to
+    [0, 1]; 'uniform' maps to [-1, 1], 'normal' applies Box-Muller to
+    consecutive pairs (reference learn_hash_kernel semantics).
+    Returns [..., num_hash] float32.
+    """
+    x = x.astype(jnp.int32)[..., None]
+    res = x * slope.astype(jnp.int32) + bias.astype(jnp.int32)
+    res = res % prime.astype(jnp.int32) % jnp.int32(nbucket)
+    pos = res.astype(jnp.float32) / float(nbucket - 1)
+    if dist == "uniform":
+        return pos * 2.0 - 1.0
+    # Box-Muller over (even, odd) pairs
+    p0, p1 = pos[..., 0::2], pos[..., 1::2]
+    lcontent = jnp.sqrt(-2.0 * jnp.log(jnp.maximum(p0, eps)))
+    out0 = lcontent * jnp.cos(jnp.pi * 2.0 * p1)
+    out1 = lcontent * jnp.sin(jnp.pi * 2.0 * p1)
+    return jnp.stack([out0, out1], axis=-1).reshape(pos.shape)
+
+
+def _slot_ids(x, nslot):
+    flat = (jnp.arange(int(np.prod(x.shape)), dtype=jnp.int32)
+            % jnp.int32(nslot))
+    return flat.reshape(x.shape)[..., None]
+
+
+def _robe_hash(x, random_numbers, robe_size=None, dim=None, Z=None,
+               use_slot_coef=True, nslot=1):
+    """ROBE-Z (MLSys'22) position hash: (Ah*e + Bh*x + Ch*c + Dh) mod P mod M.
+
+    ``random_numbers`` = [P, Dh, Ch, Bh, Ah, Dg, Cg, Bg, Ag] (index 0 is the
+    large prime, as in the reference's 10-number array).  x: [...] int ids ->
+    [..., dim] int32 indices into the 1-D ROBE array.
+
+    Convention note: following the reference kernel exactly
+    (robe_hash_kernel: c = ind % npart, e = (ind % dim) / npart with
+    npart = dim/Z), ``Z`` is the number of hashed chunks per row and the
+    contiguous run length in the array is dim/Z — i.e. the reference treats
+    Z as chunk COUNT, not the ROBE-Z paper's chunk size.  We match the
+    reference.
+    """
+    rn = random_numbers.astype(jnp.int32)
+    ids = x.astype(jnp.int32)[..., None]
+    j = jnp.arange(dim, dtype=jnp.int32)
+    npart = dim // Z
+    c = j % npart                 # offset within a chunk
+    e = j // npart                # chunk id within the row
+    result = rn[3] * ids + rn[1] + c + rn[2] * e
+    if use_slot_coef:
+        result = result + rn[4] * _slot_ids(x, nslot)
+    return (result % rn[0] % jnp.int32(robe_size)).astype(jnp.int32)
+
+
+def _robe_sign(x, random_numbers, dim=None, use_slot_coef=True, nslot=1):
+    """ROBE sign hash: ((Ag*e + Bg*x + Cg*i + Dg) mod P mod 2)*2 - 1."""
+    rn = random_numbers.astype(jnp.int32)
+    ids = x.astype(jnp.int32)[..., None]
+    j = jnp.arange(dim, dtype=jnp.int32)
+    result = rn[7] * ids + rn[5] + rn[6] * j
+    if use_slot_coef:
+        result = result + rn[8] * _slot_ids(x, nslot)
+    return (2 * (result % rn[0] % 2) - 1).astype(jnp.float32)
+
+
+mod_hash_op = simple_op(_mod_hash, "mod_hash")
+div_hash_op = simple_op(_div_hash, "div_hash")
+mod_hash_negative_op = simple_op(_mod_hash_negative, "mod_hash_negative")
+compo_hash_op = simple_op(_compo_hash, "compo_hash")
+learn_hash_op = simple_op(_learn_hash, "learn_hash")
+robe_hash_op = simple_op(_robe_hash, "robe_hash")
+robe_sign_op = simple_op(_robe_sign, "robe_sign")
+
+
+def make_robe_random_numbers(rng, prime=2038074743):
+    """[P] + 9 uniform draws in [1, P) (reference robe.py layer init)."""
+    return np.concatenate([
+        np.array([prime], dtype=np.int64),
+        rng.integers(1, prime, size=(9,)),
+    ]).astype(np.int32)
+
+
+def primes_at_least(n, count):
+    """First ``count`` primes >= n (replacement for the reference's vendored
+    primes.npy table, layers/dhe.py)."""
+    out = []
+    cand = max(int(n), 2)
+    while len(out) < count:
+        is_p = True
+        i = 2
+        while i * i <= cand:
+            if cand % i == 0:
+                is_p = False
+                break
+            i += 1
+        if is_p:
+            out.append(cand)
+        cand += 1
+    return np.asarray(out, dtype=np.int32)

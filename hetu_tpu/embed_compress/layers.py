@@ -1,0 +1,1123 @@
+"""Compressed-embedding layer zoo.
+
+TPU-native re-implementations of the VLDB'24 EmbeddingMemoryCompression
+method layers (reference tools/EmbeddingMemoryCompression/methods/layers/*,
+one class per method).  Each layer maps an int id tensor ``x`` (any shape,
+typically [B, F]) to embeddings [*, x.shape, D] as graph ops, so every method
+slots into the CTR models (models/ctr.py) interchangeably.  The heavy lifting
+is gathers + small matmuls — both MXU/HBM-friendly; all hashing fuses into
+the gather (embed_compress/hashing.py).
+
+Methods (reference layer file in parens):
+  * HashEmbedding           (hash.py)      — mod-hash shared table
+  * CompositionalEmbedding  (compo.py)     — quotient-remainder two tables
+  * TensorTrainEmbedding    (tensortrain.py) — TT-Rec 3-core chain
+  * RobeEmbedding           (robe.py)      — ROBE-Z 1-D array + sign hash
+  * DeepHashEmbedding       (dhe.py)       — DHE hash-encoding + MLP decoder
+  * AdaptiveEmbedding       (adapt.py)     — AdaEmbed frequent/rare split
+  * MDEmbedding             (mde.py)       — mixed-dimension + projection
+  * AutoDimEmbedding        (autodim.py)   — dim-candidate gumbel search
+  * OptEmbedding            (optembed.py)  — learnable row/dim masks
+  * PEPEmbedding            (pep.py)       — soft-threshold pruning
+  * DeepLightEmbedding      (deeplight.py) — magnitude pruning schedule
+  * AutoSrhEmbedding        (autosrh.py)   — group-alpha dimension scaling
+  * QuantizedEmbedding      (quantize.py)  — int8/16 fake-quantized lookup
+  * ALPTEmbedding           (alpt.py)      — learned per-row scale (LSQ)
+  * DPQEmbedding            (dpq.py)       — product quantization (vq/sx)
+  * MGQEmbedding            (mgqe.py)      — frequency-tiered DPQ
+  * DedupEmbedding          (deduplication.py) — block dedup remap
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..graph.node import Op, VariableOp
+from .. import initializers as init
+from ..layers import Linear, Sequence, Mish, fresh_name
+from ..ops import (embedding_lookup_op, array_reshape_op, add_op, mul_op,
+                   sub_op, batch_matmul_op, matmul_op, transpose_op,
+                   concat_op, sigmoid_op, relu_op, sign_op, abs_op,
+                   reduce_sum_op, reduce_mean_op, reduce_norm1_op,
+                   log_softmax_op, softmax_op, one_hot_op, concatenate_op,
+                   broadcastto_op, broadcast_shape_op, argmax_op,
+                   linear_op, mulbyconst_op, binary_step_op,
+                   stop_gradient_op, reshape_to_op, argmax_partial_op,
+                   expand_dims_op)
+from ..ops.base import simple_op, SimpleOp
+from .hashing import (mod_hash_op, div_hash_op, mod_hash_negative_op,
+                      learn_hash_op, robe_hash_op, robe_sign_op,
+                      make_robe_random_numbers, primes_at_least)
+
+
+def constant_var(name, value, dtype=np.float32, trainable=False):
+    """Non-trainable valued Variable (reference placeholder_op(value=...))."""
+    value = np.asarray(value, dtype=dtype)
+    return VariableOp(fresh_name(name), value.shape, init.NumpyInit(value),
+                      trainable=trainable, dtype=dtype)
+
+
+def _lookup_or_zero(table, ids):
+    """Gather returning zeros for out-of-range ids (the reference
+    EmbeddingLookup.cu zero-fills out-of-bound indices; jnp.take clamps,
+    so mask explicitly)."""
+    ids = ids.astype(jnp.int32)
+    ok = (ids >= 0) & (ids < table.shape[0])
+    rows = jnp.take(table, jnp.clip(ids, 0, table.shape[0] - 1), axis=0)
+    return jnp.where(ok[..., None], rows, 0).astype(table.dtype)
+
+
+lookup_or_zero_op = simple_op(_lookup_or_zero, "lookup_or_zero")
+
+
+class CompressedEmbedding:
+    """Base: plain full table (compress_rate=1 fallback)."""
+
+    num_embeddings: int
+    embedding_dim: int
+
+    def __init__(self, num_embeddings, embedding_dim, initializer=None,
+                 name="embedding"):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.name = fresh_name(name)
+        if initializer is None:
+            initializer = init.xavier_normal()
+        self.initializer = initializer
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+
+    def __call__(self, x):
+        return embedding_lookup_op(self.embedding_table, x)
+
+    def extra_loss(self):
+        """Auxiliary loss term (e.g. DPQ regularizer); None if none."""
+        return None
+
+
+class HashEmbedding(CompressedEmbedding):
+    """The hashing trick: ids share rows of a smaller table."""
+
+    def __call__(self, x):
+        return embedding_lookup_op(
+            self.embedding_table, mod_hash_op(x, nembed=self.num_embeddings))
+
+
+class CompositionalEmbedding:
+    """Quotient-remainder compositional hashing (KDD'20 / QREmbeddingBag)."""
+
+    def __init__(self, num_quotient, num_remainder, embedding_dim,
+                 aggregator="mul", initializer=None, name="compo_emb"):
+        assert aggregator[:3] in ("sum", "mul")
+        self.aggregator = aggregator[:3]
+        self.num_quotient = num_quotient
+        self.num_remainder = num_remainder
+        self.embedding_dim = embedding_dim
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.qemb = VariableOp(f"{self.name}_q",
+                               (num_quotient, embedding_dim), initializer)
+        self.remb = VariableOp(f"{self.name}_r",
+                               (num_remainder, embedding_dim), initializer)
+
+    def __call__(self, x):
+        q = embedding_lookup_op(self.qemb,
+                                div_hash_op(x, nembed=self.num_remainder))
+        r = embedding_lookup_op(self.remb,
+                                mod_hash_op(x, nembed=self.num_remainder))
+        return add_op(q, r) if self.aggregator == "sum" else mul_op(q, r)
+
+    def extra_loss(self):
+        return None
+
+
+class TensorTrainEmbedding:
+    """TT-Rec: the table as a 3-core tensor-train; a row materializes as a
+    chain of two small matmuls (batched on MXU)."""
+
+    def __init__(self, decomp_nemb, decomp_ndim, rank, name="tt_emb"):
+        self.num_tables = len(decomp_nemb)
+        assert len(decomp_ndim) == self.num_tables
+        self.decomp_nemb = list(decomp_nemb)
+        self.decomp_ndim = list(decomp_ndim)
+        self.ranks = [1] + [rank] * (self.num_tables - 1) + [1]
+        self.embedding_dim = int(np.prod(decomp_ndim))
+        self.name = fresh_name(name)
+        std = 1.0 / ((np.sqrt(1 / 3 * np.prod(decomp_nemb))) ** (1 / 3))
+        ttcore_init = init.truncated_normal(0.0, std)
+        self.tt_cores = []
+        for i in range(self.num_tables):
+            ncol = self.ranks[i] * self.decomp_ndim[i] * self.ranks[i + 1]
+            self.tt_cores.append(VariableOp(
+                f"{self.name}_core{i}", (self.decomp_nemb[i], ncol),
+                ttcore_init))
+
+    def __call__(self, x):
+        indices = x
+        accum = None
+        accum_dim = 1
+        for i in range(self.num_tables):
+            if i == self.num_tables - 1:
+                cur_ind = indices
+            else:
+                cur_ind = mod_hash_op(indices, nembed=self.decomp_nemb[i])
+                indices = div_hash_op(indices, nembed=self.decomp_nemb[i])
+            part = embedding_lookup_op(self.tt_cores[i], cur_ind)
+            if i == 0:
+                accum = part
+            else:
+                accum = array_reshape_op(
+                    accum, output_shape=(-1, accum_dim, self.ranks[i]))
+                part = array_reshape_op(
+                    part, output_shape=(-1, self.ranks[i],
+                           self.decomp_ndim[i] * self.ranks[i + 1]))
+                accum = batch_matmul_op(accum, part)
+            accum_dim *= self.decomp_ndim[i]
+        return array_reshape_op(accum, output_shape=(-1, accum_dim))
+
+    def extra_loss(self):
+        return None
+
+
+class RobeEmbedding:
+    """ROBE-Z: all embeddings live in one 1-D parameter array; each output
+    element is array[hash(id, pos)] * sign(id, pos)."""
+
+    def __init__(self, robe_array_size, embedding_dim, Z, rng,
+                 use_slot_coef=True, nslot=1, initializer=None,
+                 name="robe_emb"):
+        assert Z <= embedding_dim and embedding_dim % Z == 0
+        self.robe_array_size = robe_array_size
+        self.embedding_dim = embedding_dim
+        self.Z = Z
+        self.use_slot_coef = use_slot_coef
+        self.nslot = nslot
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_array", (robe_array_size, 1), initializer)
+        self.random_numbers = constant_var(
+            f"{self.name}_rand", make_robe_random_numbers(rng),
+            dtype=np.int32)
+
+    def __call__(self, x):
+        idx = robe_hash_op(x, self.random_numbers,
+                           robe_size=self.robe_array_size,
+                           dim=self.embedding_dim, Z=self.Z,
+                           use_slot_coef=self.use_slot_coef,
+                           nslot=self.nslot)
+        signs = robe_sign_op(x, self.random_numbers,
+                             dim=self.embedding_dim,
+                             use_slot_coef=self.use_slot_coef,
+                             nslot=self.nslot)
+        rows = embedding_lookup_op(self.embedding_table, idx)
+        return mul_op(reshape_to_op(rows, signs), signs)
+
+    def extra_loss(self):
+        return None
+
+
+class BatchNorm1d:
+    """BatchNorm over the leading axes of a [..., C] tensor with running
+    stats; the compression layers (DHE/AutoDim/DPQ) normalize 2-D/3-D
+    activations, which the 4-D conv BatchNorm (ops/nn.py) doesn't cover."""
+
+    def __init__(self, num_features, scale=True, bias=True, momentum=0.1,
+                 eps=1e-5, name=None):
+        name = fresh_name(name or "bn1d")
+        self.scale = (VariableOp(f"{name}_scale", (num_features,),
+                                 init.ones()) if scale else None)
+        self.bias = (VariableOp(f"{name}_bias", (num_features,),
+                                init.zeros()) if bias else None)
+        self.running_mean = VariableOp(f"{name}_running_mean",
+                                       (num_features,), init.zeros(),
+                                       trainable=False)
+        self.running_var = VariableOp(f"{name}_running_var",
+                                      (num_features,), init.ones(),
+                                      trainable=False)
+        self.momentum, self.eps = momentum, eps
+
+    def __call__(self, x):
+        return _BatchNorm1dOp(self, x)
+
+
+class _BatchNorm1dOp(Op):
+    def __init__(self, layer, x):
+        self.layer = layer
+        inputs = [x, layer.running_mean, layer.running_var]
+        if layer.scale is not None:
+            inputs.append(layer.scale)
+        if layer.bias is not None:
+            inputs.append(layer.bias)
+        super().__init__(*inputs, name=f"{layer.running_mean.name}_apply")
+
+    @property
+    def is_stateful(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        lay = self.layer
+        x, rmean, rvar = input_vals[:3]
+        rest = list(input_vals[3:])
+        scale = rest.pop(0) if lay.scale is not None else None
+        bias = rest.pop(0) if lay.bias is not None else None
+        axes = tuple(range(x.ndim - 1))
+        if ctx.training:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            m = lay.momentum
+            master = ctx.master_params
+            rm = (master[lay.running_mean.name] if master is not None
+                  else rmean).astype(jnp.float32)
+            rv = (master[lay.running_var.name] if master is not None
+                  else rvar).astype(jnp.float32)
+            ctx.record_update(lay.running_mean, (1 - m) * rm + m * mean)
+            ctx.record_update(lay.running_var, (1 - m) * rv + m * var)
+            mean, var = mean.astype(x.dtype), var.astype(x.dtype)
+        else:
+            mean, var = rmean, rvar
+        out = (x - mean) * jax.lax.rsqrt(var + lay.eps)
+        if scale is not None:
+            out = out * scale
+        if bias is not None:
+            out = out + bias
+        return out
+
+
+class DeepHashEmbedding:
+    """DHE (KDD'21): k universal hashes of the id are the 'encoding'; a deep
+    MLP (Mish + BatchNorm) decodes it to the embedding.  Parameter count is
+    independent of vocabulary size."""
+
+    def __init__(self, embedding_dim, mlp_dim, num_buckets, num_hash, rng,
+                 dist="uniform", initializer=None, name="dhe_emb"):
+        assert dist in ("uniform", "normal")
+        assert num_hash % 2 == 0
+        self.distribution = dist
+        self.embedding_dim = embedding_dim
+        self.num_buckets = num_buckets
+        self.num_hash = num_hash
+        self.mlp_dim = mlp_dim
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        primes = primes_at_least(num_buckets, max(num_hash * 4, 64))
+        self.slopes = constant_var(
+            f"{self.name}_slopes",
+            rng.integers(1, num_buckets, size=(num_hash,)), np.int32)
+        self.biases = constant_var(
+            f"{self.name}_biases",
+            rng.integers(1, num_buckets, size=(num_hash,)), np.int32)
+        self.primes = constant_var(
+            f"{self.name}_primes", rng.choice(primes, size=(num_hash,)),
+            np.int32)
+        layers = [Linear(num_hash, mlp_dim, initializer=initializer,
+                         name=f"{self.name}_l1"),
+                  BatchNorm1d(mlp_dim, name=f"{self.name}_bn1"), Mish()]
+        for i in range(4):
+            layers += [Linear(mlp_dim, mlp_dim, initializer=initializer,
+                              name=f"{self.name}_l{i + 2}"),
+                       BatchNorm1d(mlp_dim, name=f"{self.name}_bn{i + 2}"),
+                       Mish()]
+        layers.append(Linear(mlp_dim, embedding_dim,
+                             initializer=initializer,
+                             name=f"{self.name}_l6"))
+        self.layers = Sequence(*layers)
+
+    def __call__(self, x):
+        enc = learn_hash_op(x, self.slopes, self.biases, self.primes,
+                            nbucket=self.num_buckets,
+                            dist=self.distribution)
+        enc = array_reshape_op(enc, output_shape=(-1, self.num_hash))
+        return self.layers(enc)
+
+    def extra_loss(self):
+        return None
+
+
+class AdaptiveEmbedding:
+    """AdaEmbed-style frequent/rare split: frequent ids get private rows,
+    rare ids share a small mod-hashed table; remap is precomputed from id
+    frequencies (planner.adapt_remap)."""
+
+    def __init__(self, num_freq_emb, num_rare_emb, remap_indices,
+                 embedding_dim, initializer=None, name="adapt_emb"):
+        self.num_freq_emb = num_freq_emb
+        self.num_rare_emb = num_rare_emb
+        self.embedding_dim = embedding_dim
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.freq_emb = VariableOp(f"{self.name}_freq",
+                                   (num_freq_emb, embedding_dim),
+                                   initializer)
+        self.rare_emb = VariableOp(f"{self.name}_rare",
+                                   (num_rare_emb, embedding_dim),
+                                   initializer)
+        self.remap_indices = constant_var(
+            f"{self.name}_remap", np.asarray(remap_indices).reshape(-1),
+            np.int32)
+
+    def __call__(self, x):
+        remap = embedding_lookup_op(self.remap_indices, x)
+        high = lookup_or_zero_op(self.freq_emb, remap)
+        low_inds = mod_hash_negative_op(remap, nembed=self.num_rare_emb)
+        low = lookup_or_zero_op(self.rare_emb, low_inds)
+        return add_op(high, low)
+
+    def extra_loss(self):
+        return None
+
+
+class MDEmbedding:
+    """Mixed-dimension: store at a (popularity-chosen) smaller dim, project
+    up to the model dim (reference mde.py)."""
+
+    def __init__(self, num_embeddings, compressed_dim, embedding_dim,
+                 initializer=None, name="md_emb"):
+        self.num_embeddings = num_embeddings
+        self.compressed_dim = compressed_dim
+        self.embedding_dim = embedding_dim
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, compressed_dim),
+            initializer)
+        self.projection = None
+        if compressed_dim < embedding_dim:
+            self.projection = VariableOp(
+                f"{self.name}_proj", (compressed_dim, embedding_dim),
+                initializer)
+
+    def __call__(self, x):
+        res = embedding_lookup_op(self.embedding_table, x)
+        if self.projection is not None:
+            flat = array_reshape_op(res, output_shape=(-1, self.compressed_dim))
+            res = matmul_op(flat, self.projection)
+        return res
+
+    def extra_loss(self):
+        return None
+
+
+class GumbelSampleOp(Op):
+    """Standard Gumbel(0,1) noise of a given shape (reference
+    gpu_ops/Sample.py gumbel_sample_op)."""
+
+    def __init__(self, shape, name=None):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+
+    @property
+    def needs_rng(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        u = jax.random.uniform(ctx.rng_for(self), self.shape,
+                               minval=1e-20, maxval=1.0)
+        return -jnp.log(-jnp.log(u))
+
+
+class StepCounterOp(Op):
+    """Reads and post-increments a step Variable — the graph analogue of the
+    reference's `const_updater(n_iter)` closures (AutoDim temperature,
+    DeepLight schedule)."""
+
+    def __init__(self, var):
+        super().__init__(var, name=f"{var.name}_tick")
+        self.var = var
+
+    @property
+    def is_stateful(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        (step,) = input_vals
+        if ctx.training:
+            master = ctx.master_params
+            cur = (master[self.var.name] if master is not None
+                   else step).astype(jnp.float32)
+            ctx.record_update(self.var, cur + 1.0)
+        return step
+
+
+class AutoDimEmbedding:
+    """AutoDim (NAS over embedding dims): one candidate table per dim, each
+    projected to max_dim + BN; a gumbel-softmax over per-slot alphas mixes
+    candidates.  After search, `planner.autodim_choose` reads the alphas and
+    the table is rebuilt as AutoDimRetrainEmbedding."""
+
+    def __init__(self, num_embeddings, dim_candidates, num_slot, batch_size,
+                 initializer=None, name="autodim_emb"):
+        self.num_embeddings = num_embeddings
+        self.num_slot = num_slot
+        self.batch_size = batch_size
+        self.dim_candidates = sorted(dim_candidates)
+        self.num_cands = len(self.dim_candidates)
+        self.max_dim = self.dim_candidates[-1]
+        self.embedding_dim = self.max_dim
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        # reference: temperature = 1/max(0.01, 1 - decay*step)
+        self.temperature_decay = 0.00005 / 2000 * batch_size
+        self.step = VariableOp(f"{self.name}_step", (), init.zeros(),
+                               trainable=False)
+        self.bn_layers = {d: BatchNorm1d(self.max_dim, scale=False,
+                                         bias=False,
+                                         name=f"{self.name}_bn{d}")
+                          for d in self.dim_candidates}
+        self.embedding_tables = {d: VariableOp(f"{self.name}_t{d}",
+                                               (num_embeddings, d),
+                                               initializer)
+                                 for d in self.dim_candidates}
+        self.weights = {d: VariableOp(f"{self.name}_w{d}",
+                                      (num_slot, d, self.max_dim),
+                                      initializer)
+                        for d in self.dim_candidates}
+        self.biases = {d: VariableOp(f"{self.name}_b{d}",
+                                     (num_slot, 1, self.max_dim),
+                                     init.zeros())
+                       for d in self.dim_candidates}
+        self.alpha = VariableOp(f"{self.name}_alpha",
+                                (num_slot, self.num_cands), initializer)
+
+    def __call__(self, x):
+        middles = []
+        for d in self.dim_candidates:
+            cur = embedding_lookup_op(self.embedding_tables[d], x)
+            # (bs, nslot, d) -> (nslot, bs, d)
+            cur = transpose_op(cur, perm=(1, 0, 2))
+            cur = batch_matmul_op(cur, self.weights[d])
+            cur = add_op(cur, broadcastto_op(self.biases[d], cur))
+            cur = transpose_op(cur, perm=(1, 0, 2))
+            cur = array_reshape_op(cur, output_shape=(-1, self.max_dim))
+            cur = self.bn_layers[d](cur)
+            cur = array_reshape_op(
+                cur, output_shape=(-1, self.num_slot, self.max_dim, 1))
+            middles.append(cur)
+        log_alpha = log_softmax_op(self.alpha)
+        noise = add_op(log_alpha,
+                       GumbelSampleOp((self.num_slot, self.num_cands)))
+        w = _TemperatureScaleOp(noise, StepCounterOp(self.step),
+                                self.temperature_decay)
+        p = softmax_op(w)
+        p = array_reshape_op(p, output_shape=(1, self.num_slot, self.num_cands, 1))
+        p = broadcast_shape_op(
+            p, shape=(self.batch_size, self.num_slot, self.num_cands, 1))
+        stacked = concatenate_op(middles, axis=3)
+        out = batch_matmul_op(
+            array_reshape_op(stacked,
+                             output_shape=(-1, self.max_dim, self.num_cands)),
+            array_reshape_op(p, output_shape=(-1, self.num_cands, 1)))
+        return array_reshape_op(
+            out, output_shape=(self.batch_size, self.num_slot, self.max_dim))
+
+    def extra_loss(self):
+        return None
+
+
+class _TemperatureScaleOp(SimpleOp):
+    """noise / temperature(step) with temperature = max(0.01, 1-decay*t)."""
+
+    def __init__(self, noise, step, decay):
+        super().__init__(
+            lambda n, s, decay=decay: n / jnp.maximum(0.01, 1.0 - decay * s),
+            "temperature_scale", noise, step)
+
+
+class AutoDimRetrainEmbedding:
+    """Post-search AutoDim: per-slot compressed table + linear projection."""
+
+    def __init__(self, num_embeddings, compressed_dim, embedding_dim,
+                 initializer=None, name="autodim_retrain"):
+        self.num_embeddings = num_embeddings
+        self.compressed_dim = compressed_dim
+        self.embedding_dim = embedding_dim
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, compressed_dim),
+            initializer)
+        self.weight = VariableOp(f"{self.name}_w",
+                                 (compressed_dim, embedding_dim),
+                                 initializer)
+        self.bias = VariableOp(f"{self.name}_b", (embedding_dim,),
+                               init.zeros())
+
+    def __call__(self, x):
+        res = embedding_lookup_op(self.embedding_table, x)
+        flat = array_reshape_op(res, output_shape=(-1, self.compressed_dim))
+        return linear_op(flat, self.weight, self.bias)
+
+    def extra_loss(self):
+        return None
+
+
+class RandintSampleOp(Op):
+    """Uniform int sample in [low, high) (reference gpu_ops/Sample.py)."""
+
+    def __init__(self, shape, low, high, name=None):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+        self.low, self.high = low, high
+
+    @property
+    def needs_rng(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        return jax.random.randint(ctx.rng_for(self), self.shape, self.low,
+                                  self.high, dtype=jnp.int32)
+
+
+class OptEmbedding:
+    """OptEmbed supernet: feature mask = binary_step(|row|_1 - threshold)
+    (learnable row pruning, STE) × random dim-truncation field masks."""
+
+    def __init__(self, num_embeddings, embedding_dim, num_slot, batch_size,
+                 initializer=None, name="optembed"):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.num_slot = num_slot
+        self.batch_size = batch_size
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+        self.threshold = VariableOp(f"{self.name}_threshold",
+                                    (num_slot, 1), init.zeros())
+        self.potential_field_masks = constant_var(
+            f"{self.name}_pmask", self._potential_field_masks(),
+            np.float32)
+
+    def _potential_field_masks(self):
+        # row i = [1]*（i+1) + [0]*(D-i-1): truncate-to-dim masks
+        d = self.embedding_dim
+        return np.tril(np.ones((d, d), np.float32))
+
+    def _feature_mask(self, xv):
+        norm = reduce_norm1_op(xv, axes=2, keepdims=True)
+        th = broadcastto_op(self.threshold, norm)
+        return binary_step_op(sub_op(norm, th))
+
+    def __call__(self, x):
+        xv = embedding_lookup_op(self.embedding_table, x)  # (bs, slot, D)
+        mask_f = broadcastto_op(self._feature_mask(xv), xv)
+        dims = RandintSampleOp((self.batch_size, self.num_slot), 0,
+                               self.embedding_dim)
+        mask_e = embedding_lookup_op(self.potential_field_masks, dims)
+        return mul_op(mask_f, mul_op(mask_e, xv))
+
+    def make_inference(self, x):
+        xv = embedding_lookup_op(self.embedding_table, x)
+        mask_f = broadcastto_op(self._feature_mask(xv), xv)
+        return mul_op(mask_f, xv)
+
+    def extra_loss(self):
+        return None
+
+
+class OptEmbeddingAfterRowPruning:
+    """OptEmbed retrain: surviving rows remapped into a dense table, fixed
+    per-field dim choice from the evolutionary search."""
+
+    def __init__(self, num_embeddings, remap_indices, candidate_dims,
+                 embedding_dim, num_slot, batch_size, initializer=None,
+                 name="optembed_retrain"):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.num_slot = num_slot
+        self.batch_size = batch_size
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+        self.remap_indices = constant_var(
+            f"{self.name}_remap", np.asarray(remap_indices).reshape(-1),
+            np.int32)
+        d = embedding_dim
+        self.potential_field_masks = constant_var(
+            f"{self.name}_pmask", np.tril(np.ones((d, d), np.float32)),
+            np.float32)
+        self.candidate = constant_var(
+            f"{self.name}_candidate",
+            np.asarray(candidate_dims).reshape(-1), np.int32)
+
+    def __call__(self, x):
+        new_ids = embedding_lookup_op(self.remap_indices, x)
+        xe = lookup_or_zero_op(self.embedding_table, new_ids)
+        mask_e = embedding_lookup_op(self.potential_field_masks,
+                                     self.candidate)  # (nslot, D)
+        mask_e = broadcast_shape_op(
+            expand_dims_op(mask_e, axis=0),
+            shape=(self.batch_size, self.num_slot, self.embedding_dim))
+        return mul_op(mask_e, xe)
+
+    def extra_loss(self):
+        return None
+
+
+class PEPEmbedding:
+    """PEP: soft-threshold reparameterization — emb = sign(w) *
+    relu(|w| - sigmoid(threshold)), threshold learnable per
+    global/dimension/feature/feature_dimension granularity."""
+
+    def __init__(self, num_embeddings, embedding_dim, threshold_type,
+                 threshold_init, initializer=None, name="pep_emb"):
+        assert threshold_type in ("dimension", "feature", "global",
+                                  "feature_dimension")
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.threshold_type = threshold_type
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+        th_shape = {"feature_dimension": (num_embeddings, embedding_dim),
+                    "dimension": (embedding_dim,),
+                    "feature": (num_embeddings, 1),
+                    "global": (1,)}[threshold_type]
+        self.threshold = VariableOp(f"{self.name}_threshold", th_shape,
+                                    init.constant(threshold_init))
+
+    def __call__(self, x):
+        raw = embedding_lookup_op(self.embedding_table, x)
+        if self.threshold_type.startswith("feature"):
+            th = embedding_lookup_op(self.threshold, x)
+        else:
+            th = self.threshold
+        th = sigmoid_op(th)
+        if self.threshold_type != "feature_dimension":
+            th = broadcastto_op(th, raw)
+        return mul_op(sign_op(raw), relu_op(sub_op(abs_op(raw), th)))
+
+    def extra_loss(self):
+        return None
+
+
+class PEPRetrainEmbedding:
+    """PEP retrain: fixed binary mask from the search phase."""
+
+    def __init__(self, num_embeddings, embedding_dim, mask,
+                 initializer=None, name="pep_retrain"):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+        self.mask = constant_var(f"{self.name}_mask",
+                                 np.asarray(mask, np.float32), np.float32)
+
+    def __call__(self, x):
+        lookups = embedding_lookup_op(self.embedding_table, x)
+        masks = embedding_lookup_op(self.mask, x)
+        return mul_op(lookups, masks)
+
+    def extra_loss(self):
+        return None
+
+
+class DeepLightEmbedding:
+    """DeepLight: plain lookup; a pruning schedule zeroes the smallest
+    |w| fraction of the table in-place as training proceeds (stateful op,
+    reference make_prune_op / PruneMask.cu)."""
+
+    def __init__(self, num_embeddings, embedding_dim, prune_rate,
+                 batch_num=1000, initializer=None, name="deeplight"):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.prune_rate = prune_rate
+        self.batch_num = batch_num
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+        self.step = VariableOp(f"{self.name}_step", (), init.zeros(),
+                               trainable=False)
+
+    def __call__(self, x):
+        return embedding_lookup_op(self.embedding_table, x)
+
+    def make_prune_op(self, after=None):
+        """Stateful node: every 10 steps (and every epoch boundary, i.e.
+        ``batch_num`` steps), prune the table to the scheduled adaptive
+        sparsity rate = prune_rate * (1 - 0.99^(step/100)).
+
+        Pass the optimizer node as ``after`` so the prune composes with —
+        instead of clobbering — the same step's gradient update."""
+        return _DeepLightPruneOp(self, after)
+
+    def extra_loss(self):
+        return None
+
+
+class _DeepLightPruneOp(Op):
+    def __init__(self, layer, after=None):
+        inputs = [layer.embedding_table, layer.step]
+        if after is not None:
+            inputs.append(after)   # topo-order after the optimizer node
+        super().__init__(*inputs, name=f"{layer.name}_prune")
+        self.layer = layer
+
+    @property
+    def is_stateful(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        table, step = input_vals[:2]
+        lay = self.layer
+        if not ctx.training:
+            return step
+        master = ctx.master_params
+        cur_step = (master[lay.step.name] if master is not None
+                    else step).astype(jnp.float32)
+        # compose with this step's pending optimizer update (last-write-wins
+        # dict: reading the pending value instead of the stale binding keeps
+        # the gradient step alive)
+        cur_table = ctx.updates.get(lay.embedding_table)
+        if cur_table is None:
+            cur_table = (master[lay.embedding_table.name]
+                         if master is not None else table)
+        rate = lay.prune_rate * (1.0 - 0.99 ** (cur_step / 100.0))
+        apply_now = ((jnp.mod(cur_step, 10.0) == 0)
+                     | (jnp.mod(cur_step, float(lay.batch_num)) == 0))
+
+        def prune(tbl):
+            absval = jnp.abs(tbl)
+            th = jnp.quantile(absval.reshape(-1), jnp.clip(rate, 0.0, 1.0))
+            return jnp.where(absval > th, tbl, 0.0)
+
+        # lax.cond so the O(N*D log) quantile sort only runs on prune steps
+        pruned = jax.lax.cond(apply_now, prune, lambda t: t, cur_table)
+        ctx.record_update(lay.embedding_table,
+                          pruned.astype(cur_table.dtype))
+        ctx.record_update(lay.step, cur_step + 1.0)
+        return step
+
+
+class AutoSrhEmbedding:
+    """AutoSrh: per-(frequency-group, dimension) trainable salience alphas
+    scale the embedding; after search, alphas are thresholded to a mask."""
+
+    def __init__(self, num_embeddings, embedding_dim, nsplit, group_indices,
+                 initializer=None, name="autosrh"):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.nsplit = nsplit
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+        self.group_indices = constant_var(
+            f"{self.name}_groupind", np.asarray(group_indices).reshape(-1),
+            np.int32)
+        self.alpha = VariableOp(f"{self.name}_alpha",
+                                (nsplit, embedding_dim), init.ones())
+
+    def __call__(self, x):
+        emb = embedding_lookup_op(self.embedding_table, x)
+        gidx = embedding_lookup_op(self.group_indices, x)
+        alphas = embedding_lookup_op(self.alpha, gidx)
+        return mul_op(emb, reshape_to_op(alphas, emb))
+
+    def extra_loss(self):
+        return None
+
+
+class QuantizedEmbedding:
+    """Fixed-point table: rows are fake-quantized to `digit` bits on lookup
+    (uniform scale/middle, or per-row min/max qparams).  Gradients flow
+    straight-through (reference QuantizeEmbedding.cu)."""
+
+    def __init__(self, num_embeddings, embedding_dim, digit, scale=0.01,
+                 middle=0.0, use_qparam=False, initializer=None,
+                 name="quant_emb"):
+        assert digit in (8, 16)
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.digit = digit
+        self.scale, self.middle = scale, middle
+        self.use_qparam = use_qparam
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+
+    def __call__(self, x):
+        rows = embedding_lookup_op(self.embedding_table, x)
+        return _FakeQuantRowsOp(rows, self.digit, self.scale, self.middle,
+                                self.use_qparam)
+
+    def extra_loss(self):
+        return None
+
+
+class _FakeQuantRowsOp(SimpleOp):
+    """round((rows - middle)/scale) clamped to digit range, dequantized; STE
+    through the rounding.  With use_qparam, scale/middle are per-row
+    min/max-derived (reference qparams path)."""
+
+    def __init__(self, rows, digit, scale, middle, use_qparam):
+        def impl(r, digit=digit, scale=scale, middle=middle,
+                 use_qparam=use_qparam):
+            qmin = -(1 << (digit - 1))
+            qmax = (1 << (digit - 1)) - 1
+            if use_qparam:
+                rmin = jnp.min(r, axis=-1, keepdims=True)
+                rmax = jnp.max(r, axis=-1, keepdims=True)
+                scale_ = jnp.maximum((rmax - rmin) / (qmax - qmin), 1e-8)
+                middle_ = (rmax + rmin) / 2
+            else:
+                scale_, middle_ = scale, middle
+            q = jnp.clip(jnp.round((r - middle_) / scale_), qmin, qmax)
+            deq = q * scale_ + middle_
+            return r + jax.lax.stop_gradient(deq - r)   # STE
+        super().__init__(impl, "fake_quant_rows", rows)
+
+
+class ALPTEmbedding:
+    """ALPT: per-row learnable quantization scale trained jointly with the
+    table via the LSQ straight-through estimator (ops/quantize.py lsq_round)."""
+
+    def __init__(self, num_embeddings, embedding_dim, digit, init_scale,
+                 initializer=None, name="alpt_emb"):
+        assert digit in (8, 16)
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.digit = digit
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", (num_embeddings, embedding_dim),
+            initializer)
+        self.scale = VariableOp(f"{self.name}_scale", (num_embeddings, 1),
+                                init.constant(init_scale))
+
+    def __call__(self, x):
+        rows = embedding_lookup_op(self.embedding_table, x)
+        scales = embedding_lookup_op(self.scale, x)
+        return _LSQRowsOp(rows, scales, self.digit)
+
+    def extra_loss(self):
+        return None
+
+
+class _LSQRowsOp(SimpleOp):
+    def __init__(self, rows, scales, digit):
+        from ..ops.quantize import lsq_round
+
+        def impl(r, s, digit=digit):
+            return lsq_round(r, s, digit, True)
+        super().__init__(impl, "lsq_rows", rows, scales)
+
+
+class DPQEmbedding:
+    """Differentiable product quantization: rows split into `num_parts`
+    sub-vectors, each snapped to the nearest of `num_choices` codewords
+    ('vq': euclidean + STE; 'sx': softmax relaxation).  The int codebook (for
+    post-training inference) is maintained by a stateful scatter."""
+
+    def __init__(self, num_embeddings, embedding_dim, num_choices, num_parts,
+                 batch_size, share_weights=False, mode="vq",
+                 initializer=None, name="dpq_emb"):
+        assert mode in ("vq", "sx")
+        assert embedding_dim % num_parts == 0
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.num_choices = num_choices
+        self.num_parts = num_parts
+        self.batch_size = batch_size
+        self.share_weights = share_weights
+        self.mode = mode
+        self.part_embedding_dim = embedding_dim // num_parts
+        self.name = fresh_name(name)
+        initializer = initializer or init.xavier_normal()
+        self.embedding_table = VariableOp(
+            f"{self.name}_query", (num_embeddings, embedding_dim),
+            initializer)
+        kshape = ((num_choices, self.part_embedding_dim) if share_weights
+                  else (num_parts * num_choices, self.part_embedding_dim))
+        self.key_matrix = VariableOp(f"{self.name}_key", kshape, initializer)
+        self.value_matrix = (self.key_matrix if mode == "vq"
+                             else VariableOp(f"{self.name}_value", kshape,
+                                             initializer))
+        self.bn_layer = BatchNorm1d(num_choices, scale=False, bias=False,
+                                    name=f"{self.name}_bn")
+        self.codebooks = VariableOp(f"{self.name}_codebook",
+                                    (num_embeddings, num_parts),
+                                    init.zeros(), trainable=False,
+                                    dtype=np.int32)
+        self.reg = None
+
+    def _codes(self, x, resp):
+        return argmax_op(resp, dim=2)
+
+    def __call__(self, x):
+        lookups = embedding_lookup_op(self.embedding_table, x)
+        inputs = array_reshape_op(
+            lookups, output_shape=(-1, self.num_parts, self.part_embedding_dim))
+        q = array_reshape_op(
+            lookups, output_shape=(-1, self.num_parts, 1, self.part_embedding_dim))
+        keys = array_reshape_op(
+            self.key_matrix,
+            output_shape=(-1, self.num_choices, self.part_embedding_dim))
+        if self.mode == "vq":
+            resp = _NegSqDistOp(q, keys)
+        else:
+            resp = _DotRespOp(q, keys)
+        resp = self.bn_layer(resp)          # (N, nparts, nchoices)
+        codes = self._codes(x, resp)        # (N, nparts) int
+        # stateful scatter; trainers add this node to the eval list so the
+        # trained codes persist (reference adds layer.codebook_update)
+        self.codebook_update = _CodebookUpdateOp(self.codebooks, x, codes)
+        if self.mode == "vq":
+            lookup_codes = codes
+            if not self.share_weights:
+                lookup_codes = _AddPartOffsetsOp(codes, self.num_choices)
+            outputs = embedding_lookup_op(self.value_matrix, lookup_codes)
+            final = add_op(stop_gradient_op(sub_op(outputs, inputs)),
+                           inputs)
+            reg = sub_op(outputs, stop_gradient_op(inputs))
+            self.reg = reduce_mean_op(mul_op(reg, reg), axes=(0, 1, 2))
+        else:
+            prob = softmax_op(resp)
+            hard = one_hot_op(codes, num_classes=self.num_choices)
+            # straight-through softmax: hard in fwd, soft in bwd
+            st = add_op(stop_gradient_op(sub_op(hard, prob)), prob)
+            vals = array_reshape_op(
+                self.value_matrix,
+                output_shape=(-1, self.num_choices, self.part_embedding_dim))
+            outputs = _MixCodewordsOp(st, vals)
+            final = outputs
+            self.reg = None
+        return array_reshape_op(final, output_shape=(-1, self.embedding_dim))
+
+    def extra_loss(self):
+        return self.reg
+
+
+class _NegSqDistOp(SimpleOp):
+    """-(||q - k||^2) responses: q (N,P,1,D), keys (P|1,C,D) -> (N,P,C)."""
+
+    def __init__(self, q, keys):
+        def impl(qv, kv):
+            diff = qv - kv[None]
+            return -jnp.sum(jnp.square(diff), axis=3)
+        super().__init__(impl, "neg_sqdist", q, keys)
+
+
+class _DotRespOp(SimpleOp):
+    def __init__(self, q, keys):
+        def impl(qv, kv):
+            return jnp.sum(qv * kv[None], axis=3)
+        super().__init__(impl, "dot_resp", q, keys)
+
+
+class _AddPartOffsetsOp(SimpleOp):
+    """codes[..., p] += p * num_choices (the reference's dbase tile)."""
+
+    def __init__(self, codes, num_choices):
+        def impl(c, num_choices=num_choices):
+            off = jnp.arange(c.shape[-1], dtype=c.dtype) * num_choices
+            return c + off
+        super().__init__(impl, "add_part_offsets", codes)
+
+
+class _MixCodewordsOp(SimpleOp):
+    """(N,P,C) soft-assign × (P|1,C,D) codewords -> (N,P,D)."""
+
+    def __init__(self, st, vals):
+        def impl(s, v):
+            if v.shape[0] == 1:
+                v = jnp.broadcast_to(v, (s.shape[1],) + v.shape[1:])
+            return jnp.einsum("npc,pcd->npd", s, v)
+        super().__init__(impl, "mix_codewords", st, vals)
+
+
+class _CodebookUpdateOp(Op):
+    """codebooks[x] = codes (reference sparse_set_op): stateful scatter so
+    the trained codes survive for switch-to-inference."""
+
+    def __init__(self, codebooks_var, x, codes):
+        super().__init__(codebooks_var, x, codes,
+                         name=f"{codebooks_var.name}_set")
+        self.var = codebooks_var
+
+    @property
+    def is_stateful(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        book, ids, codes = input_vals
+        if ctx.training:
+            master = ctx.master_params
+            cur = (master[self.var.name] if master is not None else book)
+            flat_ids = ids.reshape(-1).astype(jnp.int32)
+            flat_codes = codes.reshape(flat_ids.shape[0], -1)
+            ctx.record_update(
+                self.var,
+                cur.at[flat_ids].set(flat_codes.astype(cur.dtype)))
+        return codes
+
+
+class MGQEmbedding(DPQEmbedding):
+    """MGQE: DPQ where low-frequency ids may only use the first
+    `low_num_choices` codewords (frequency-tiered codebook capacity)."""
+
+    def __init__(self, num_embeddings, embedding_dim, high_num_choices,
+                 low_num_choices, num_parts, frequency, batch_size,
+                 initializer=None, name="mgqe_emb"):
+        super().__init__(num_embeddings, embedding_dim, high_num_choices,
+                         num_parts, batch_size, share_weights=False,
+                         mode="vq", initializer=initializer, name=name)
+        self.low_num_choices = low_num_choices
+        self.frequency = constant_var(
+            f"{self.name}_frequency", np.asarray(frequency).reshape(-1),
+            np.int32)
+
+    def _codes(self, x, resp):
+        mask = embedding_lookup_op(self.frequency, x)
+        flat_mask = array_reshape_op(mask, output_shape=(-1,))
+        return argmax_partial_op(resp, flat_mask,
+                                 topk=self.low_num_choices, dim=2)
+
+
+class DedupEmbedding:
+    """Deduplicated table: rows grouped into blocks of `nemb_per_block`;
+    near-duplicate blocks share storage via a remap (built offline by
+    planner.dedup_build from a trained table)."""
+
+    def __init__(self, emb, remap_indices, nemb_per_block, trainable=True,
+                 name="dedup_emb"):
+        emb = np.asarray(emb, np.float32)
+        self.num_blocks = emb.shape[0]
+        self.embedding_dim = emb.shape[1]
+        self.nemb_per_block = nemb_per_block
+        self.name = fresh_name(name)
+        self.embedding_table = VariableOp(
+            f"{self.name}_table", emb.shape, init.NumpyInit(emb),
+            trainable=trainable)
+        self.remap_indices = constant_var(
+            f"{self.name}_remap", np.asarray(remap_indices).reshape(-1),
+            np.int32)
+
+    def __call__(self, x):
+        block = embedding_lookup_op(
+            self.remap_indices,
+            div_hash_op(x, nembed=self.nemb_per_block))
+        real = add_op(mulbyconst_op(block, self.nemb_per_block),
+                      mod_hash_op(x, nembed=self.nemb_per_block))
+        return embedding_lookup_op(self.embedding_table, real)
+
+    def extra_loss(self):
+        return None

@@ -1,0 +1,264 @@
+"""Compression planning: memory-budget sizing + stage-transition exports.
+
+The reference wraps each method in an `EmbeddingTrainer` scheduler
+(tools/EmbeddingMemoryCompression/methods/scheduler/*) that (a) solves the
+method's hyper-parameters from a target ``compress_rate`` and (b) converts
+search-phase state into retrain-phase layers.  Here those two jobs are plain
+numpy functions, decoupled from the training loop.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def binary_search(lo, hi, evaluate, tol=1e-3, iters=200):
+    """Find x in [lo, hi] with evaluate(x) ~ 0 (evaluate monotone increasing);
+    returns (lo, hi) bracket (reference scheduler/base.py binary_search)."""
+    elo, ehi = evaluate(lo), evaluate(hi)
+    if elo >= 0:
+        return lo, lo
+    if ehi <= 0:
+        return hi, hi
+    for _ in range(iters):
+        if hi - lo < tol:
+            break
+        mid = (lo + hi) / 2
+        if evaluate(mid) < 0:
+            lo = mid
+        else:
+            hi = mid
+    return lo, hi
+
+
+# -- sizing ---------------------------------------------------------------
+
+def hash_rows(num_embed, compress_rate):
+    """HashEmb: rows of the shared table (scheduler/hash.py)."""
+    return math.ceil(num_embed * compress_rate)
+
+
+def qr_sizes(num_embed, compress_rate):
+    """Compositional QR: (num_quotient, num_remainder) such that
+    Q + R ~ num_embed * rate with R the collision divisor
+    (scheduler/compo.py: memory(x) = ceil(n/x) + x)."""
+    target = num_embed * compress_rate
+
+    # memory(x) = ceil(n/x) + x decreases on [1, sqrt(n)], so
+    # target - memory(x) is increasing there
+    def evaluate(x):
+        return target - (math.ceil(num_embed / x) + x)
+
+    lo, _ = binary_search(1, math.sqrt(num_embed) + 1, evaluate)
+    collision = max(1, math.ceil(lo))
+    return math.ceil(num_embed / collision), collision
+
+
+def tt_decomp_dims(embedding_dim):
+    """Factor the embedding dim into 3 near-equal factors; powers of two get
+    the reference's halving scheme (scheduler/tensortrain.py:_get_decomp_dim)."""
+    d = embedding_dim
+    if d & (d - 1) == 0:
+        assert d >= 8
+        decomp = [2, 2, 2]
+        idx = 2
+        d //= 8
+        while d != 1:
+            decomp[idx] *= 2
+            d //= 2
+            idx = (idx - 1) % 3
+        return decomp
+    n1 = math.ceil(d ** (1 / 3))
+    while d % n1 != 0:
+        n1 -= 1
+    rest = d // n1
+    n2 = math.ceil(rest ** 0.5)
+    while rest % n2 != 0:
+        n2 -= 1
+    return sorted([n1, n2, rest // n2])
+
+
+def tt_decomp_rows(num_embed):
+    """3-way row decomposition (largest last, reference _get_decomp_emb)."""
+    n1 = math.ceil(num_embed ** (1 / 3))
+    n2 = math.ceil((num_embed / n1) ** 0.5)
+    n3 = math.ceil(num_embed / n1 / n2)
+    return [n3, n2, n1]
+
+
+def tt_rank(num_embed, embedding_dim, compress_rate,
+            decomp_rows=None, decomp_dims=None):
+    """Largest rank whose TT memory fits num_embed*dim*rate."""
+    rows = decomp_rows or tt_decomp_rows(num_embed)
+    dims = decomp_dims or tt_decomp_dims(embedding_dim)
+    target = num_embed * embedding_dim * compress_rate
+
+    def memory(r):
+        return (rows[0] * dims[0] + rows[1] * dims[1] * r
+                + rows[2] * dims[2]) * r
+
+    lo, _ = binary_search(0, 1000, lambda r: memory(r) - target)
+    rank = max(1, math.floor(lo))
+    if memory(rank) > target and rank > 1:
+        rank -= 1
+    return rank
+
+
+def robe_size(num_embed, embedding_dim, compress_rate):
+    return math.ceil(num_embed * embedding_dim * compress_rate)
+
+
+def dhe_mlp_dim(num_embed, embedding_dim, compress_rate, num_hash):
+    """Solve the MLP width m from the memory budget: params(m) =
+    num_hash*m + 4*m^2 + m*dim + biases/BN ~ 4m^2 + (num_hash+dim+11)m
+    (5 hidden layers as in layers/dhe.py)."""
+    budget = num_embed * embedding_dim * compress_rate
+    a, b, c = 4.0, num_hash + embedding_dim + 11.0, -float(budget)
+    m = (-b + math.sqrt(b * b - 4 * a * c)) / (2 * a)
+    return max(8, int(m))
+
+
+def md_solver(num_embed_fields, embedding_dim, alpha, round_dim=True):
+    """Mixed-dim rule d_f = lamb * n_f^-alpha with the largest field pinned
+    to embedding_dim (reference scheduler/md.py:_md_solver)."""
+    n = np.asarray(sorted(num_embed_fields), dtype=np.float64)
+    lamb = embedding_dim * (n[0] ** alpha)
+    d = lamb * (n ** -alpha)
+    if round_dim:
+        d = 2 ** np.round(np.log2(d))
+    d = np.clip(d, 1, embedding_dim).astype(np.int64)
+    order = np.argsort(np.argsort(num_embed_fields))
+    return d[order]  # back to input field order
+
+
+def md_dims(num_embed_fields, embedding_dim, compress_rate, round_dim=True):
+    """Binary-search alpha to hit the compress_rate (scheduler/md.py)."""
+    num_embed = sum(num_embed_fields)
+    target = num_embed * embedding_dim * compress_rate
+
+    def memory(alpha):
+        dims = md_solver(num_embed_fields, embedding_dim, alpha, round_dim)
+        return sum(ne * nd + nd * embedding_dim * (nd != embedding_dim)
+                   for ne, nd in zip(num_embed_fields, dims))
+
+    lo, hi = binary_search(0.0, 1.0, lambda a: target - memory(a))
+    dims = md_solver(num_embed_fields, embedding_dim, lo, round_dim)
+    if memory(lo) > target * (1 + 1e-3):
+        dims = md_solver(num_embed_fields, embedding_dim, hi, round_dim)
+    return list(dims)
+
+
+def adapt_remap(frequencies, top_percent):
+    """AdaEmbed remap from id frequency counts: top ids (by count) get dense
+    indices 0..nfreq-1; the rest get -(rank+1) (consumed by
+    mod_hash_negative).  Returns (remap[int32], nfreq)."""
+    freq = np.asarray(frequencies)
+    nemb = freq.shape[0]
+    nfreq = math.ceil(nemb * top_percent)
+    order = np.argsort(-freq, kind="stable")
+    remap = np.empty((nemb,), np.int32)
+    remap[order[:nfreq]] = np.arange(nfreq, dtype=np.int32)
+    nrare_ids = nemb - nfreq
+    remap[order[nfreq:]] = -(np.arange(nrare_ids, dtype=np.int32) + 1)
+    return remap, nfreq
+
+
+def adapt_sizes(num_embed, compress_rate, nfreq):
+    """nrare rows from the leftover budget (scheduler/adapt.py)."""
+    nrare = math.ceil(num_embed * compress_rate) - nfreq
+    assert nrare > 0, "top_percent must be < compress_rate"
+    return nrare
+
+
+def autosrh_group_indices(frequencies, nsplit):
+    """Group ids into nsplit frequency tiers (equal-size by rank)."""
+    freq = np.asarray(frequencies)
+    order = np.argsort(-freq, kind="stable")
+    group = np.empty(freq.shape[0], np.int32)
+    per = math.ceil(freq.shape[0] / nsplit)
+    for g in range(nsplit):
+        group[order[g * per:(g + 1) * per]] = g
+    return group
+
+
+# -- stage-transition exports --------------------------------------------
+
+def autodim_choose(alpha, dim_candidates):
+    """Per-slot dim choice = argmax alpha (scheduler/autodim.py retrain)."""
+    cands = sorted(dim_candidates)
+    return [cands[i] for i in np.argmax(np.asarray(alpha), axis=1)]
+
+
+def pep_export_mask(table, threshold, threshold_type):
+    """Binary mask |w| > sigmoid(th) for PEPRetrainEmbedding."""
+    table = np.asarray(table)
+    th = 1.0 / (1.0 + np.exp(-np.asarray(threshold, np.float64)))
+    if threshold_type == "dimension":
+        th = th.reshape(1, -1)
+    elif threshold_type == "global":
+        th = th.reshape(1, 1)
+    return (np.abs(table) > th).astype(np.float32)
+
+
+def optembed_row_prune(table, threshold, field_of_row):
+    """Rows surviving |row|_1 > sigmoid-free threshold of their field;
+    returns (remap[-1 for pruned], kept_rows index array)."""
+    table = np.asarray(table)
+    th = np.asarray(threshold).reshape(-1)[np.asarray(field_of_row)]
+    keep = np.abs(table).sum(1) > th
+    remap = np.full((table.shape[0],), -1, np.int32)
+    remap[keep] = np.arange(int(keep.sum()), dtype=np.int32)
+    return remap, np.nonzero(keep)[0]
+
+
+def evolutionary_dim_search(fitness, num_slot, embedding_dim, rng,
+                            population=20, generations=10, keep=5,
+                            mutate_prob=0.1):
+    """OptEmbed-style evolutionary search over per-field dim *candidates*:
+    maximize ``fitness(candidates)``.  A candidate c in [0, embedding_dim)
+    keeps dims 0..c — the exact index space the OptEmbedding supernet
+    samples (RandintSampleOp low=0, high=D) and that
+    OptEmbeddingAfterRowPruning consumes as mask-table rows."""
+    pop = [rng.integers(0, embedding_dim, size=(num_slot,))
+           for _ in range(population)]
+    scored = [(fitness(p), p) for p in pop]
+    for _ in range(generations):
+        scored.sort(key=lambda t: -t[0])
+        parents = [p for _, p in scored[:keep]]
+        children = []
+        while len(children) < population - keep:
+            a, b = (parents[rng.integers(len(parents))] for _ in range(2))
+            cross = np.where(rng.random(num_slot) < 0.5, a, b)
+            mut = rng.random(num_slot) < mutate_prob
+            cross = np.where(mut, rng.integers(0, embedding_dim,
+                                               size=(num_slot,)), cross)
+            children.append(cross)
+        scored = scored[:keep] + [(fitness(c), c) for c in children]
+    scored.sort(key=lambda t: -t[0])
+    return scored[0][1]
+
+
+def dedup_build(table, nemb_per_block, grid):
+    """Block-level dedup of a trained table: consecutive groups of
+    ``nemb_per_block`` rows form a block; blocks equal after rounding to
+    ``grid`` share storage.  Returns (unique_block_rows, remap) for
+    DedupEmbedding (reference scheduler/deduplication.py uses an LSH match;
+    the rounding grid plays the similarity-threshold role)."""
+    table = np.asarray(table, np.float32)
+    nemb, dim = table.shape
+    nblocks = math.ceil(nemb / nemb_per_block)
+    pad = nblocks * nemb_per_block - nemb
+    if pad:
+        table = np.concatenate([table, np.zeros((pad, dim), np.float32)])
+    blocks = table.reshape(nblocks, nemb_per_block * dim)
+    keys = np.round(blocks / grid).astype(np.int64)
+    _, first, inverse = np.unique(keys, axis=0, return_index=True,
+                                  return_inverse=True)
+    uniq_rows = np.concatenate(
+        [blocks[i].reshape(nemb_per_block, dim) for i in first])
+    # remap old block id -> position of its representative block
+    remap = np.empty(nblocks, np.int32)
+    remap[:] = inverse
+    return uniq_rows, remap

@@ -76,6 +76,9 @@ def dp_core_numpy(mem_cost, intra_cost, inter_cost, max_mem):
     inter = np.asarray(inter_cost, dtype=np.float64)
     L, S = mem_cost.shape
     V = int(max_mem)
+    # two buffers, not a rolling array: mem_cost 0 would alias the row
+    # being written (same fix as dp_core.cpp)
+    f_prev = np.zeros((V, S))
     f = np.zeros((V, S))
     mark = -np.ones((L, V, S), dtype=np.int64)
     for i in range(L):
@@ -86,9 +89,9 @@ def dp_core_numpy(mem_cost, intra_cost, inter_cost, max_mem):
                     f[v, s] = np.inf
                     continue
                 if i == 0:
-                    best, best_si = f[v - m, s], s
+                    best, best_si = f_prev[v - m, s], s
                 else:
-                    cands = f[v - m, :] + inter[i, :, s]
+                    cands = f_prev[v - m, :] + inter[i, :, s]
                     best_si = int(np.argmin(cands))
                     best = cands[best_si]
                 if np.isfinite(best):
@@ -96,6 +99,8 @@ def dp_core_numpy(mem_cost, intra_cost, inter_cost, max_mem):
                     mark[i, v, s] = best_si
                 else:
                     f[v, s] = np.inf
+        f_prev, f = f, f_prev
+    f_prev, f = f, f_prev  # undo the last swap: f holds layer L-1
     cur = int(np.argmin(f[V - 1]))
     total = f[V - 1, cur]
     if not np.isfinite(total):

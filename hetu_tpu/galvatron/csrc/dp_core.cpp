@@ -45,12 +45,13 @@ int galvatron_dp_core(int64_t layer_num, int64_t max_mem, int64_t strategy_num,
   const int64_t L = layer_num, V = max_mem, S = strategy_num;
   if (L <= 0 || V <= 0 || S <= 0) return -1;
 
-  // f is rolled over layers: f[v][s].  mark keeps the full history.
+  // two explicit buffers (layer i-1 / layer i): a rolling array would alias
+  // the row being written whenever a strategy's mem_cost is 0
+  std::vector<double> f_prev(static_cast<size_t>(V) * S, 0.0);
   std::vector<double> f(static_cast<size_t>(V) * S, 0.0);
   std::vector<int32_t> mark(static_cast<size_t>(L) * V * S, -1);
 
   for (int64_t i = 0; i < L; ++i) {
-    // descending v so f[v - m] still holds layer i-1 values (rolling array)
     for (int64_t v = V - 1; v >= 0; --v) {
       for (int64_t s = 0; s < S; ++s) {
         const int32_t m = mem_cost[i * S + s];
@@ -59,7 +60,7 @@ int galvatron_dp_core(int64_t layer_num, int64_t max_mem, int64_t strategy_num,
           *fvs = kInf;
           continue;
         }
-        const double* prev = &f[(v - m) * S];
+        const double* prev = &f_prev[(v - m) * S];
         double best = kInf;
         int32_t best_si = -1;
         if (i == 0) {
@@ -83,7 +84,9 @@ int galvatron_dp_core(int64_t layer_num, int64_t max_mem, int64_t strategy_num,
         }
       }
     }
+    std::swap(f_prev, f);
   }
+  std::swap(f_prev, f);  // undo the last swap: f holds layer L-1
 
   // pick the best terminal strategy at full budget
   const double* last = &f[(V - 1) * S];

@@ -162,7 +162,9 @@ class CostModel:
         state = 4.0 * param_shard
         act = (L.act_bytes * lb / st.tp) * n_micro_live
         if st.ckpt:
-            act = L.act_bytes * lb / st.tp * 0.2  # stage-boundary acts only
+            # only stage-boundary activations survive, but still one copy
+            # per in-flight micro-batch
+            act = L.act_bytes * lb / st.tp * 0.2 * n_micro_live
         return state + act
 
 

@@ -94,3 +94,25 @@ def _slice_by_matrix(a, idx0, idx1):
 
 
 slice_by_matrix_op = simple_op(_slice_by_matrix, "slice_by_matrix")
+# reshape a to b's shape (reference gpu_ops/Reshape.py reshape_to_op)
+reshape_to_op = simple_op(lambda a, b: jnp.reshape(a, b.shape), "reshape_to")
+stop_gradient_op = simple_op(jax.lax.stop_gradient, "stop_gradient")
+
+
+def _argmax_partial(a, mask, topk=None, dim=-1):
+    """Argmax over ``dim``, restricted to the first ``topk`` entries where
+    ``mask`` (broadcast over leading dims) is 0 (reference ArgmaxPartial.cu:
+    low-frequency rows only see the first ``topk`` codewords)."""
+    if topk is None:
+        raise ValueError("argmax_partial requires topk= (the restricted "
+                         "range for mask==0 rows)")
+    dim = dim % a.ndim
+    idx = jnp.arange(a.shape[dim])
+    idx = idx.reshape((1,) * dim + (-1,) + (1,) * (a.ndim - dim - 1))
+    mask = mask.reshape(mask.shape + (1,) * (a.ndim - mask.ndim))
+    allowed = (mask != 0) | (idx < topk)
+    neg = jnp.finfo(a.dtype).min
+    return jnp.argmax(jnp.where(allowed, a, neg), axis=dim)
+
+
+argmax_partial_op = simple_op(_argmax_partial, "argmax_partial")

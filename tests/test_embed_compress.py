@@ -1,0 +1,309 @@
+"""Embedding-compression suite tests.
+
+Golden numerics for the hash ops vs numpy (reference style:
+tests/test_gpu_op.py) and forward/train smoke for every method layer wired
+into a tiny CTR head (reference: run_compressed.py over DLRM/WDL).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+from hetu_tpu import embed_compress as ec
+from hetu_tpu.embed_compress import planner
+from hetu_tpu.embed_compress.hashing import (_mod_hash, _div_hash,
+                                             _mod_hash_negative,
+                                             _compo_hash, _learn_hash,
+                                             _robe_hash, _robe_sign,
+                                             make_robe_random_numbers)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+# -- hash op golden tests -------------------------------------------------
+
+def test_mod_div_hash(rng):
+    x = rng.integers(0, 10000, (4, 7)).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(_mod_hash(jnp.asarray(x), 37)),
+                                  x % 37)
+    np.testing.assert_array_equal(np.asarray(_div_hash(jnp.asarray(x), 37)),
+                                  x // 37)
+
+
+def test_mod_hash_negative(rng):
+    x = np.array([0, 5, -1, -8, -100], np.int32)
+    out = np.asarray(_mod_hash_negative(jnp.asarray(x), 7))
+    prev = -(x + 1)
+    expect = np.where(prev >= 0, prev % 7, prev)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_compo_hash(rng):
+    x = rng.integers(0, 1000, (13,)).astype(np.int32)
+    out = np.asarray(_compo_hash(jnp.asarray(x), ntable=3, nembed=11))
+    assert out.shape == (13, 3)
+    recon = out[:, 0] + out[:, 1] * 11 + out[:, 2] * 121
+    np.testing.assert_array_equal(recon, np.minimum(x, 11 ** 3 - 1) % 11 ** 3)
+
+
+def test_learn_hash_uniform_range(rng):
+    x = rng.integers(0, 100000, (64,)).astype(np.int32)
+    slope = rng.integers(1, 1000, (8,)).astype(np.int32)
+    bias = rng.integers(1, 1000, (8,)).astype(np.int32)
+    prime = ec.primes_at_least(1000, 32)[:8]
+    out = np.asarray(_learn_hash(jnp.asarray(x), jnp.asarray(slope),
+                                 jnp.asarray(bias), jnp.asarray(prime),
+                                 nbucket=1000, dist="uniform"))
+    assert out.shape == (64, 8)
+    assert out.min() >= -1.0 and out.max() <= 1.0
+    # int32 wraparound semantics match numpy int32
+    expect = ((x[:, None].astype(np.int32) * slope + bias) % prime % 1000)
+    expect = expect.astype(np.float32) / 999 * 2 - 1
+    np.testing.assert_allclose(out, expect, atol=1e-6)
+
+
+def test_learn_hash_normal_stats(rng):
+    x = rng.integers(0, 1 << 30, (4096,)).astype(np.int32)
+    slope = rng.integers(1, 100000, (16,)).astype(np.int32)
+    bias = rng.integers(1, 100000, (16,)).astype(np.int32)
+    prime = ec.primes_at_least(100003, 64)[:16]
+    out = np.asarray(_learn_hash(jnp.asarray(x), jnp.asarray(slope),
+                                 jnp.asarray(bias), jnp.asarray(prime),
+                                 nbucket=100000, dist="normal"))
+    # Box-Muller output should be ~standard normal
+    assert abs(out.mean()) < 0.05
+    assert abs(out.std() - 1.0) < 0.05
+
+
+def test_robe_hash_bounds_and_determinism(rng):
+    rn = make_robe_random_numbers(rng)
+    x = rng.integers(0, 100000, (5, 3)).astype(np.int32)
+    idx = np.asarray(_robe_hash(jnp.asarray(x), jnp.asarray(rn),
+                                robe_size=997, dim=8, Z=4, nslot=3))
+    assert idx.shape == (5, 3, 8)
+    assert idx.min() >= 0 and idx.max() < 997
+    idx2 = np.asarray(_robe_hash(jnp.asarray(x), jnp.asarray(rn),
+                                 robe_size=997, dim=8, Z=4, nslot=3))
+    np.testing.assert_array_equal(idx, idx2)
+    sg = np.asarray(_robe_sign(jnp.asarray(x), jnp.asarray(rn), dim=8,
+                               nslot=3))
+    assert set(np.unique(sg)) <= {-1.0, 1.0}
+
+
+# -- planner --------------------------------------------------------------
+
+def test_planner_budgets():
+    nemb, dim, rate = 100000, 16, 0.1
+    assert planner.hash_rows(nemb, rate) == 10000
+    nq, nr = planner.qr_sizes(nemb, rate)
+    assert nq + nr <= nemb * rate * 1.1
+    rows = planner.tt_decomp_rows(nemb)
+    dims = planner.tt_decomp_dims(dim)
+    assert np.prod(dims) == dim and np.prod(rows) >= nemb
+    rank = planner.tt_rank(nemb, dim, rate)
+    mem = (rows[0] * dims[0] + rows[1] * dims[1] * rank
+           + rows[2] * dims[2]) * rank
+    assert mem <= nemb * dim * rate
+    m = planner.dhe_mlp_dim(nemb, dim, rate, 64)
+    assert 4 * m * m + (64 + dim + 11) * m <= nemb * dim * rate * 1.2
+
+
+def test_planner_md_dims():
+    fields = [100, 10000, 1000000]
+    dims = planner.md_dims(fields, 32, 0.25, round_dim=True)
+    assert len(dims) == 3
+    assert dims[0] >= dims[1] >= dims[2]  # rarer field -> bigger dim
+    assert all(1 <= d <= 32 for d in dims)
+
+
+def test_planner_adapt_remap(rng):
+    freq = rng.integers(0, 1000, (50,))
+    remap, nfreq = planner.adapt_remap(freq, 0.2)
+    assert nfreq == 10
+    assert (remap >= 0).sum() == nfreq
+    # most frequent id gets slot 0
+    assert remap[np.argmax(freq)] == 0
+    neg = remap[remap < 0]
+    assert len(np.unique(neg)) == len(neg)
+
+
+def test_planner_pep_optembed_exports(rng):
+    table = rng.standard_normal((20, 8)).astype(np.float32)
+    mask = planner.pep_export_mask(table, np.full((20, 1), -2.0), "feature")
+    assert mask.shape == (20, 8) and set(np.unique(mask)) <= {0.0, 1.0}
+    field_of_row = np.repeat(np.arange(4), 5)
+    remap, kept = planner.optembed_row_prune(table, np.full(4, 1.0),
+                                             field_of_row)
+    assert (remap[kept] >= 0).all()
+    assert remap.max() + 1 == len(kept)
+
+
+def test_planner_dedup(rng):
+    base = rng.standard_normal((4, 8)).astype(np.float32)
+    # 8 blocks of 2 rows; blocks 0-3 duplicate blocks 4-7
+    table = np.concatenate([base, base + 1e-6, base, base + 1e-6])
+    uniq, remap = planner.dedup_build(table, 2, grid=0.01)
+    assert remap.shape == (8,)
+    assert uniq.shape[0] < table.shape[0]
+    # remapped rows reconstruct the original table (within the grid)
+    rebuilt = uniq.reshape(-1, 2, 8)[remap].reshape(-1, 8)
+    np.testing.assert_allclose(rebuilt, table, atol=2e-2)
+    # and the layer serves them through the graph
+    lay = ec.DedupEmbedding(uniq, remap, 2)
+    ids = ht.placeholder_op("dedup_ids", (6,), dtype=np.int32)
+    ex = ht.Executor([lay(ids)])
+    ids_v = rng.integers(0, 16, (6,))
+    (out,) = ex.run(feed_dict={ids: ids_v}, convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(out, rebuilt[ids_v], atol=1e-6)
+
+
+# -- layer forward + training smoke --------------------------------------
+
+NEMB, DIM, NSLOT, BS = 200, 16, 4, 8
+
+
+def _make_layer(method, rng):
+    freq = rng.integers(0, 100, (NEMB,))
+    return ec.make_compressed_embedding(
+        method, NEMB, DIM, compress_rate=0.5, batch_size=BS,
+        num_slot=NSLOT, frequencies=freq, rng=rng,
+        num_buckets=10007, num_hash=8, dim_candidates=[4, 8, 16])
+
+
+@pytest.mark.parametrize("method", [m for m in ec.METHODS
+                                    if m not in ("autodim", "optembed")])
+def test_method_forward_and_train(method, rng):
+    layer = _make_layer(method, rng)
+    ids = ht.placeholder_op("ids", (BS, NSLOT), dtype=np.int32)
+    labels = ht.placeholder_op("labels", (BS,))
+    emb = layer(ids)
+    flat = ht.array_reshape_op(emb, output_shape=(BS, NSLOT * DIM))
+    w = ht.Variable("w_" + method, shape=(NSLOT * DIM, 1),
+                    initializer=ht.init.xavier_normal())
+    logits = ht.array_reshape_op(ht.matmul_op(flat, w), output_shape=(BS,))
+    loss = ht.reduce_mean_op(
+        ht.binarycrossentropywithlogits_op(logits, labels))
+    extra = layer.extra_loss()
+    if extra is not None:
+        loss = loss + 0.1 * extra
+    train_nodes = [loss, ht.SGDOptimizer(learning_rate=0.05).minimize(loss)]
+    if hasattr(layer, "codebook_update"):
+        train_nodes.append(layer.codebook_update)
+    if isinstance(layer, ec.DeepLightEmbedding):
+        train_nodes.append(layer.make_prune_op(after=train_nodes[1]))
+    ex = ht.Executor({"train": train_nodes})
+    ids_v = rng.integers(0, NEMB, (BS, NSLOT))
+    y = rng.integers(0, 2, (BS,)).astype(np.float32)
+    losses = []
+    for _ in range(8):
+        out = ex.run("train", feed_dict={ids: ids_v, labels: y},
+                     convert_to_numpy_ret_vals=True)
+        losses.append(float(out[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"{method}: no learning {losses}"
+
+
+@pytest.mark.parametrize("method", ["autodim", "optembed"])
+def test_searchable_method_forward(method, rng):
+    """AutoDim/OptEmbed need fixed batch shape (bs, nslot)."""
+    layer = _make_layer(method, rng)
+    ids = ht.placeholder_op("ids", (BS * NSLOT // NSLOT, NSLOT),
+                            dtype=np.int32)
+    labels = ht.placeholder_op("labels", (BS,))
+    emb = layer(ids)  # (BS, NSLOT, maxdim)
+    d = layer.embedding_dim
+    flat = ht.array_reshape_op(emb, output_shape=(BS, NSLOT * d))
+    w = ht.Variable("w_" + method, shape=(NSLOT * d, 1),
+                    initializer=ht.init.xavier_normal())
+    logits = ht.array_reshape_op(ht.matmul_op(flat, w), output_shape=(BS,))
+    loss = ht.reduce_mean_op(
+        ht.binarycrossentropywithlogits_op(logits, labels))
+    ex = ht.Executor(
+        {"train": [loss,
+                   ht.SGDOptimizer(learning_rate=0.05).minimize(loss)]})
+    ids_v = rng.integers(0, NEMB, (BS, NSLOT))
+    y = rng.integers(0, 2, (BS,)).astype(np.float32)
+    for _ in range(3):
+        out = ex.run("train", feed_dict={ids: ids_v, labels: y},
+                     convert_to_numpy_ret_vals=True)
+        assert np.isfinite(out[0])
+
+
+def test_deeplight_prune_composes_with_optimizer(rng):
+    """The prune op must not clobber the same step's gradient update."""
+    layer = ec.DeepLightEmbedding(NEMB, DIM, prune_rate=0.5)
+    ids = ht.placeholder_op("dl_ids", (BS, NSLOT), dtype=np.int32)
+    labels = ht.placeholder_op("dl_labels", (BS,))
+    emb = layer(ids)
+    flat = ht.array_reshape_op(emb, output_shape=(BS, NSLOT * DIM))
+    w = ht.Variable("dl_w", shape=(NSLOT * DIM, 1),
+                    initializer=ht.init.xavier_normal())
+    logits = ht.array_reshape_op(ht.matmul_op(flat, w), output_shape=(BS,))
+    loss = ht.reduce_mean_op(
+        ht.binarycrossentropywithlogits_op(logits, labels))
+    train_op = ht.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op,
+                                layer.make_prune_op(after=train_op)]})
+    table0 = np.asarray(ex.params[layer.embedding_table.name]).copy()
+    ids_v = rng.integers(0, NEMB, (BS, NSLOT))
+    y = rng.integers(0, 2, (BS,)).astype(np.float32)
+    for _ in range(5):
+        ex.run("train", feed_dict={ids: ids_v, labels: y})
+    table1 = np.asarray(ex.get_params()[layer.embedding_table.name])
+    touched = np.unique(ids_v)
+    diff = np.abs(table1[touched] - table0[touched]).max()
+    assert diff > 1e-4, "embedding rows frozen: prune clobbered the update"
+
+
+def test_autodim_export(rng):
+    alpha = rng.standard_normal((NSLOT, 3))
+    dims = planner.autodim_choose(alpha, [4, 8, 16])
+    assert len(dims) == NSLOT and set(dims) <= {4, 8, 16}
+    lay = ec.AutoDimRetrainEmbedding(NEMB, 8, DIM)
+    ids = ht.placeholder_op("ids2", (BS, NSLOT), dtype=np.int32)
+    out = lay(ids)
+    ex = ht.Executor([out])
+    (v,) = ex.run(feed_dict={ids: rng.integers(0, NEMB, (BS, NSLOT))},
+                  convert_to_numpy_ret_vals=True)
+    assert v.shape == (BS * NSLOT, DIM)
+
+
+def test_optembed_retrain_and_evolution(rng):
+    table = rng.standard_normal((NEMB, DIM)).astype(np.float32)
+    field_of_row = np.repeat(np.arange(NSLOT), NEMB // NSLOT)
+    remap, kept = planner.optembed_row_prune(table, np.full(NSLOT, 8.0),
+                                             field_of_row)
+    # candidate index i keeps dims 0..i, so DIM-2 masks off the last dim
+    lay = ec.OptEmbeddingAfterRowPruning(len(kept), remap, [DIM - 2] * NSLOT,
+                                         DIM, NSLOT, BS)
+    ids = ht.placeholder_op("ids3", (BS, NSLOT), dtype=np.int32)
+    ex = ht.Executor([lay(ids)])
+    (v,) = ex.run(feed_dict={ids: rng.integers(0, NEMB, (BS, NSLOT))},
+                  convert_to_numpy_ret_vals=True)
+    assert v.shape == (BS, NSLOT, DIM)
+    # last dim masked off for candidate DIM-1
+    np.testing.assert_allclose(v[..., -1], 0.0)
+    best = planner.evolutionary_dim_search(
+        lambda dims: -float(np.sum(dims)), NSLOT, DIM, rng,
+        population=6, generations=3, keep=2)
+    assert best.shape == (NSLOT,)
+
+
+def test_pep_export_roundtrip(rng):
+    lay = ec.PEPEmbedding(NEMB, DIM, "feature", -12.0)
+    ids = ht.placeholder_op("ids4", (BS, NSLOT), dtype=np.int32)
+    ex = ht.Executor([lay(ids)])
+    table = ex.params[lay.embedding_table.name]
+    th = ex.params[lay.threshold.name]
+    mask = planner.pep_export_mask(np.asarray(table), np.asarray(th),
+                                   "feature")
+    re = ec.PEPRetrainEmbedding(NEMB, DIM, mask)
+    ids5 = ht.placeholder_op("ids5", (BS, NSLOT), dtype=np.int32)
+    ex2 = ht.Executor([re(ids5)])
+    (v,) = ex2.run(feed_dict={ids5: rng.integers(0, NEMB, (BS, NSLOT))},
+                   convert_to_numpy_ret_vals=True)
+    assert v.shape == (BS, NSLOT, DIM)
