@@ -24,6 +24,10 @@ from . import ps
 from . import metrics
 from .dataloader import Dataloader, DataloaderOp, dataloader_op
 from .logger import HetuLogger, WandbLogger
+from . import embed_compress
+from . import onnx
+from . import graphboard
+from .launcher import DistConfig, launch, launch_local, initialize_from_env
 
 __version__ = "0.1.0"
 

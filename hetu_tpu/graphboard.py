@@ -1,0 +1,108 @@
+"""Graph visualizer (reference: python/graphboard/graph2fig.py + index.html
+— dumps the op DAG to a figure served by a small page).
+
+Here the DAG renders to (a) Graphviz DOT text and (b) a dependency-free
+standalone HTML file with an inline SVG (nodes positioned by topo depth), so
+`dump_html` works with zero extra packages on a TPU VM.
+"""
+
+from __future__ import annotations
+
+import html
+
+from .graph.node import Op, PlaceholderOp, VariableOp, find_topo_sort
+
+
+def _dot_escape(s):
+    return s.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _node_label(n):
+    kind = getattr(n, "op_kind", type(n).__name__)
+    return _dot_escape(f"{n.name}") + "\\n" + _dot_escape(f"[{kind}]")
+
+
+def _node_color(n):
+    if isinstance(n, PlaceholderOp):
+        return "#8ecae6"          # inputs: blue
+    if isinstance(n, VariableOp):
+        return "#ffb703" if n.trainable else "#e9c46a"   # params: orange
+    if getattr(n, "is_stateful", False):
+        return "#e76f51"          # stateful: red
+    return "#d8e2dc"
+
+
+def graph_to_dot(eval_nodes, name="hetu_graph"):
+    """DAG -> Graphviz DOT text."""
+    topo = find_topo_sort(list(eval_nodes))
+    lines = [f"digraph {name} {{", "  rankdir=TB;",
+             "  node [shape=box, style=filled, fontsize=10];"]
+    for n in topo:
+        lines.append(
+            f'  n{n.id} [label="{_node_label(n)}", '
+            f'fillcolor="{_node_color(n)}"];')
+    for n in topo:
+        for i in n.inputs:
+            lines.append(f"  n{i.id} -> n{n.id};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def _layout(topo):
+    """Topo-depth layered layout: (x, y) per node id."""
+    depth = {}
+    for n in topo:
+        depth[n.id] = (max((depth[i.id] for i in n.inputs), default=-1) + 1)
+    buckets = {}
+    for n in topo:
+        buckets.setdefault(depth[n.id], []).append(n)
+    pos = {}
+    for d, nodes in buckets.items():
+        for i, n in enumerate(nodes):
+            pos[n.id] = (60 + i * 170, 50 + d * 90)
+    return pos
+
+
+def graph_to_svg(eval_nodes):
+    topo = find_topo_sort(list(eval_nodes))
+    if not topo:
+        return ('<svg xmlns="http://www.w3.org/2000/svg" width="200" '
+                'height="40"><text x="10" y="25">(empty graph)</text></svg>')
+    pos = _layout(topo)
+    w = max(x for x, _ in pos.values()) + 180
+    h = max(y for _, y in pos.values()) + 90
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" '
+             f'height="{h}" font-family="monospace" font-size="10">']
+    for n in topo:
+        x1, y1 = pos[n.id]
+        for i in n.inputs:
+            x0, y0 = pos[i.id]
+            parts.append(
+                f'<line x1="{x0 + 75}" y1="{y0 + 36}" x2="{x1 + 75}" '
+                f'y2="{y1}" stroke="#888" stroke-width="1"/>')
+    for n in topo:
+        x, y = pos[n.id]
+        kind = getattr(n, "op_kind", type(n).__name__)
+        parts.append(
+            f'<rect x="{x}" y="{y}" width="150" height="36" rx="5" '
+            f'fill="{_node_color(n)}" stroke="#333"/>'
+            f'<text x="{x + 75}" y="{y + 15}" text-anchor="middle">'
+            f'{html.escape(n.name[:22])}</text>'
+            f'<text x="{x + 75}" y="{y + 29}" text-anchor="middle" '
+            f'fill="#555">{html.escape(kind[:22])}</text>')
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def dump_html(eval_nodes, path, title="hetu_tpu graph"):
+    """Write a self-contained DAG page (reference graphboard/index.html)."""
+    svg = graph_to_svg(eval_nodes)
+    dot = graph_to_dot(eval_nodes)
+    doc = (f"<!doctype html><html><head><meta charset='utf-8'>"
+           f"<title>{html.escape(title)}</title></head><body>"
+           f"<h2>{html.escape(title)}</h2>{svg}"
+           f"<h3>DOT source</h3><pre>{html.escape(dot)}</pre>"
+           f"</body></html>")
+    with open(path, "w") as f:
+        f.write(doc)
+    return path

@@ -1,0 +1,18 @@
+"""ONNX bridge (reference: python/hetu/onnx/ — hetu2onnx.py, onnx2hetu.py,
+onnx_opset/; see SURVEY.md P20).
+
+* `hetu2onnx(eval_nodes, params)` — graph + trained weights -> OnnxModel
+* `onnx2hetu(model)`              — OnnxModel -> (placeholders, outputs)
+* `save_model` / `load_model`     — portable zip (works without `onnx`)
+* `to_onnx_proto`/`from_onnx_proto` — real protobufs when `onnx` is present
+  (`HAS_ONNX` flags availability; the build image does not ship it)
+"""
+
+from .ir import OnnxModel, NodeIR, TensorInfo, save_model, load_model
+from .export import hetu2onnx
+from .import_ import onnx2hetu
+from .proto import HAS_ONNX, to_onnx_proto, from_onnx_proto
+
+__all__ = ["OnnxModel", "NodeIR", "TensorInfo", "save_model", "load_model",
+           "hetu2onnx", "onnx2hetu", "HAS_ONNX", "to_onnx_proto",
+           "from_onnx_proto"]

@@ -1,0 +1,311 @@
+"""hetu_tpu graph -> OnnxModel (reference: python/hetu/onnx/hetu2onnx.py).
+
+Each graph Op kind has a converter emitting ONNX-shaped NodeIR(s).  Variable
+values come from an Executor's params (or any {name: array} dict), so the
+exported file carries trained weights like the reference's bridge.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import Op, PlaceholderOp, VariableOp, find_topo_sort
+from ..ops.base import SimpleOp
+from ..ops.nn import BatchNormOp, DropoutOp
+from .ir import OnnxModel, NodeIR, TensorInfo
+
+_EXPORTERS = {}
+
+
+def exporter(*kinds):
+    def deco(fn):
+        for k in kinds:
+            _EXPORTERS[k] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    def __init__(self, model):
+        self.model = model
+        self._n = 0
+
+    def aux(self, hint):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def const(self, hint, value):
+        name = self.aux(hint)
+        self.model.add_initializer(name, value)
+        return name
+
+
+def _in(node, i):
+    return node.inputs[i].name
+
+
+def _simple(onnx_type, **fixed):
+    def fn(node, ctx):
+        return [NodeIR(onnx_type, [i.name for i in node.inputs],
+                       [node.name], dict(fixed), name=node.name)]
+    return fn
+
+
+for kind, typ in [
+        ("add", "Add"), ("minus", "Sub"), ("multiply", "Mul"),
+        ("divide", "Div"), ("matmul", "MatMul"), ("batch_matmul", "MatMul"),
+        ("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
+        ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"),
+        ("abs", "Abs"), ("sign", "Sign"), ("floor", "Floor"),
+        ("ceil", "Ceil"), ("softplus", "Softplus"),
+        ("opposite", "Neg"), ("reciprocal", "Reciprocal"),
+        ("maximum", "Max"), ("minimum", "Min"), ("where", "Where"),
+        ("embedding_lookup", "Gather"), ("flatten", "Flatten"),
+        ("bool_eq", "Equal"), ("bool_gt", "Greater"), ("bool_lt", "Less"),
+        ("stop_gradient", "Identity"), ("zeros_like", "Identity")]:
+    _EXPORTERS[kind] = _simple(typ)
+
+
+@exporter("gelu")
+def _gelu(node, ctx):
+    # Gelu is a standard op from opset 20 (model.opset is 20)
+    approx = "tanh" if node.attrs.get("approximate", True) else "none"
+    return [NodeIR("Gelu", [_in(node, 0)], [node.name],
+                   {"approximate": approx}, name=node.name)]
+
+
+@exporter("silu")
+def _silu(node, ctx):
+    # silu(x) = x * sigmoid(x); no standard SiLU op -> decompose
+    sig = ctx.aux(f"{node.name}_sig")
+    return [NodeIR("Sigmoid", [_in(node, 0)], [sig],
+                   name=f"{node.name}_sigmoid"),
+            NodeIR("Mul", [_in(node, 0), sig], [node.name],
+                   name=node.name)]
+
+
+@exporter("add_byconst", "mul_byconst")
+def _byconst(node, ctx):
+    typ = "Add" if node.op_kind == "add_byconst" else "Mul"
+    c = ctx.const(f"{node.name}_const",
+                  np.asarray(node.attrs["const"], np.float32))
+    return [NodeIR(typ, [_in(node, 0), c], [node.name], name=node.name)]
+
+
+@exporter("pow")
+def _pow(node, ctx):
+    c = ctx.const(f"{node.name}_exp",
+                  np.asarray(node.attrs["exponent"], np.float32))
+    return [NodeIR("Pow", [_in(node, 0), c], [node.name], name=node.name)]
+
+
+@exporter("linear")
+def _linear(node, ctx):
+    # Gemm(A, B, C): alpha*A@B + beta*C with transA/transB
+    return [NodeIR("Gemm", [i.name for i in node.inputs], [node.name],
+                   {"alpha": 1.0, "beta": 1.0,
+                    "transA": int(bool(node.attrs.get("trans_A", False))),
+                    "transB": int(bool(node.attrs.get("trans_B", False)))},
+                   name=node.name)]
+
+
+@exporter("softmax")
+def _softmax(node, ctx):
+    return [NodeIR("Softmax", [_in(node, 0)], [node.name],
+                   {"axis": node.attrs.get("dim", -1)}, name=node.name)]
+
+
+@exporter("log_softmax")
+def _log_softmax(node, ctx):
+    return [NodeIR("LogSoftmax", [_in(node, 0)], [node.name],
+                   {"axis": node.attrs.get("dim", -1)}, name=node.name)]
+
+
+@exporter("array_reshape")
+def _reshape(node, ctx):
+    shape = ctx.const(f"{node.name}_shape",
+                      np.asarray(node.attrs["output_shape"], np.int64))
+    return [NodeIR("Reshape", [_in(node, 0), shape], [node.name],
+                   name=node.name)]
+
+
+@exporter("transpose")
+def _transpose(node, ctx):
+    return [NodeIR("Transpose", [_in(node, 0)], [node.name],
+                   {"perm": list(node.attrs.get("perm"))}, name=node.name)]
+
+
+@exporter("concat", "concatenate")
+def _concat(node, ctx):
+    return [NodeIR("Concat", [i.name for i in node.inputs], [node.name],
+                   {"axis": node.attrs.get("axis", 0)}, name=node.name)]
+
+
+@exporter("expand_dims")
+def _unsqueeze(node, ctx):
+    ax = node.attrs.get("axis", 0)
+    axes = ctx.const(f"{node.name}_axes",
+                     np.asarray([ax] if np.isscalar(ax) else list(ax),
+                                np.int64))
+    return [NodeIR("Unsqueeze", [_in(node, 0), axes], [node.name],
+                   name=node.name)]
+
+
+@exporter("squeeze")
+def _squeeze(node, ctx):
+    ax = node.attrs.get("axis")
+    ins = [_in(node, 0)]
+    if ax is not None:
+        ins.append(ctx.const(
+            f"{node.name}_axes",
+            np.asarray([ax] if np.isscalar(ax) else list(ax), np.int64)))
+    return [NodeIR("Squeeze", ins, [node.name], name=node.name)]
+
+
+def _pair(v):
+    return (v, v) if np.isscalar(v) else tuple(v)
+
+
+@exporter("conv2d", "conv2d_add_bias")
+def _conv(node, ctx):
+    p = _pair(node.attrs.get("padding", 0))
+    s = _pair(node.attrs.get("stride", 1))
+    return [NodeIR("Conv", [i.name for i in node.inputs], [node.name],
+                   {"pads": [p[0], p[1], p[0], p[1]],
+                    "strides": list(s),
+                    "group": node.attrs.get("groups", 1)},
+                   name=node.name)]
+
+
+@exporter("max_pool2d", "avg_pool2d")
+def _pool(node, ctx):
+    typ = "MaxPool" if node.op_kind == "max_pool2d" else "AveragePool"
+    p = _pair(node.attrs.get("padding", 0))
+    s = _pair(node.attrs.get("stride", 1))
+    k = (node.attrs["kernel_H"], node.attrs["kernel_W"])
+    return [NodeIR(typ, [_in(node, 0)], [node.name],
+                   {"kernel_shape": list(k), "pads": [p[0], p[1], p[0], p[1]],
+                    "strides": list(s)}, name=node.name)]
+
+
+@exporter("global_avg_pool2d")
+def _gap(node, ctx):
+    return [NodeIR("GlobalAveragePool", [_in(node, 0)], [node.name],
+                   name=node.name)]
+
+
+@exporter("layer_normalization")
+def _ln(node, ctx):
+    return [NodeIR("LayerNormalization", [i.name for i in node.inputs],
+                   [node.name], {"epsilon": node.attrs.get("eps", 1e-5),
+                                 "axis": -1}, name=node.name)]
+
+
+@exporter("reduce_mean", "reduce_sum", "reduce_max", "reduce_min")
+def _reduce(node, ctx):
+    typ = {"reduce_mean": "ReduceMean", "reduce_sum": "ReduceSum",
+           "reduce_max": "ReduceMax", "reduce_min": "ReduceMin"}[node.op_kind]
+    axes = node.attrs.get("axes")
+    attrs = {"keepdims": int(bool(node.attrs.get("keepdims", False)))}
+    ins = [_in(node, 0)]
+    if axes is not None:
+        # opset >= 18: axes are a tensor input for all Reduce* ops
+        ins.append(ctx.const(
+            f"{node.name}_axes",
+            np.asarray([axes] if np.isscalar(axes) else list(axes),
+                       np.int64)))
+    return [NodeIR(typ, ins, [node.name], attrs, name=node.name)]
+
+
+@exporter("cast")
+def _cast(node, ctx):
+    return [NodeIR("Cast", [_in(node, 0)], [node.name],
+                   {"to": str(np.dtype(node.attrs.get("dtype", "float32")))},
+                   name=node.name)]
+
+
+@exporter("clamp")
+def _clip(node, ctx):
+    ins = [_in(node, 0)]
+    for key in ("min", "max"):
+        v = node.attrs.get(key)
+        ins.append(ctx.const(f"{node.name}_{key}",
+                             np.asarray(v, np.float32))
+                   if v is not None else "")
+    return [NodeIR("Clip", ins, [node.name], name=node.name)]
+
+
+@exporter("one_hot")
+def _one_hot(node, ctx):
+    depth = ctx.const(f"{node.name}_depth",
+                      np.asarray(node.attrs["num_classes"], np.int64))
+    values = ctx.const(f"{node.name}_values",
+                       np.asarray([0.0, 1.0], np.float32))
+    return [NodeIR("OneHot", [_in(node, 0), depth, values], [node.name],
+                   {"axis": -1}, name=node.name)]
+
+
+@exporter("tile")
+def _tile(node, ctx):
+    reps = ctx.const(f"{node.name}_reps",
+                     np.asarray(node.attrs["reps"], np.int64))
+    return [NodeIR("Tile", [_in(node, 0), reps], [node.name],
+                   name=node.name)]
+
+
+def _export_batchnorm(node, ctx):
+    return [NodeIR("BatchNormalization", [i.name for i in node.inputs],
+                   [node.name],
+                   {"epsilon": node.eps, "momentum": 1.0 - node.momentum},
+                   name=node.name)]
+
+
+def _export_dropout(node, ctx):
+    ratio = ctx.const(f"{node.name}_ratio",
+                      np.asarray(1.0 - node.keep_prob, np.float32))
+    return [NodeIR("Dropout", [_in(node, 0), ratio], [node.name],
+                   name=node.name)]
+
+
+_NP2ONNX_DTYPE = {"float32": "float32", "float64": "float64",
+                  "int32": "int32", "int64": "int64"}
+
+
+def hetu2onnx(eval_nodes, params, name="hetu_tpu_graph"):
+    """Export the graph reaching ``eval_nodes`` to an OnnxModel.
+
+    ``params``: {variable_name: array} (e.g. `Executor.params`) supplying
+    initializer values.  Placeholders become graph inputs; ``eval_nodes``
+    become graph outputs.
+    """
+    from ..graph.executor import Executor  # noqa: F401 (doc only)
+    model = OnnxModel(name=name)
+    ctx = _Ctx(model)
+    topo = find_topo_sort(list(eval_nodes))
+    for node in topo:
+        if isinstance(node, PlaceholderOp):
+            model.inputs.append(TensorInfo(
+                node.name, tuple(node.shape or ()),
+                _NP2ONNX_DTYPE.get(str(node.dtype), "float32")))
+        elif isinstance(node, VariableOp):
+            if node.name not in params:
+                raise KeyError(f"no value for variable {node.name}; pass "
+                               f"Executor.params")
+            model.add_initializer(node.name, np.asarray(params[node.name]))
+        elif isinstance(node, BatchNormOp):
+            model.nodes.extend(_export_batchnorm(node, ctx))
+        elif isinstance(node, DropoutOp):
+            model.nodes.extend(_export_dropout(node, ctx))
+        elif isinstance(node, SimpleOp):
+            fn = _EXPORTERS.get(node.op_kind)
+            if fn is None:
+                raise NotImplementedError(
+                    f"no ONNX exporter for op kind {node.op_kind!r} "
+                    f"(node {node.name})")
+            model.nodes.extend(fn(node, ctx))
+        else:
+            raise NotImplementedError(
+                f"no ONNX exporter for {type(node).__name__} ({node.name})")
+    for node in eval_nodes:
+        model.outputs.append(TensorInfo(node.name, ()))
+    return model

@@ -1,0 +1,282 @@
+"""OnnxModel -> hetu_tpu graph (reference: python/hetu/onnx/onnx2hetu.py).
+
+Rebuilds placeholders for graph inputs, Variables for initializers, and our
+graph ops for each node.  Tensor inputs that exist only to carry static
+config (Reshape shape, Clip bounds, ...) are folded back into op attrs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import PlaceholderOp, VariableOp
+from .. import initializers as init
+from .. import ops as O
+from .ir import OnnxModel
+
+_IMPORTERS = {}
+
+
+def importer(*types):
+    def deco(fn):
+        for t in types:
+            _IMPORTERS[t] = fn
+        return fn
+    return deco
+
+
+class _Env:
+    """Resolution scope: name -> graph Op; folds initializer constants."""
+
+    def __init__(self, model):
+        self.model = model
+        self.nodes = {}
+
+    def is_const(self, name):
+        return name in self.model.initializers
+
+    def const(self, name):
+        return np.asarray(self.model.initializers[name])
+
+    def op(self, name):
+        if name not in self.nodes:
+            if self.is_const(name):
+                arr = self.const(name)
+                self.nodes[name] = VariableOp(
+                    name, arr.shape, init.NumpyInit(arr),
+                    trainable=np.issubdtype(arr.dtype, np.floating),
+                    dtype=arr.dtype)
+            else:
+                raise KeyError(f"tensor {name!r} undefined at use site")
+        return self.nodes[name]
+
+
+_BINOPS = {"Add": O.add_op, "Sub": O.sub_op, "Mul": O.mul_op,
+           "Div": O.div_op, "MatMul": O.matmul_op, "Max": O.maximum_op,
+           "Min": O.minimum_op, "Equal": O.equal_op,
+           "Greater": O.greater_op, "Less": O.less_op}
+_UNARY = {"Relu": O.relu_op, "Sigmoid": O.sigmoid_op, "Tanh": O.tanh_op,
+          "Exp": O.exp_op, "Log": O.log_op, "Sqrt": O.sqrt_op,
+          "Abs": O.abs_op, "Sign": O.sign_op, "Floor": O.floor_op,
+          "Ceil": O.ceil_op, "Softplus": O.softplus_op,
+          "Neg": O.opposite_op, "Reciprocal": O.reciprocal_op,
+          "Flatten": O.flatten_op,
+          "Identity": lambda x: x, "GlobalAveragePool": O.global_avg_pool2d_op}
+
+
+@importer(*_BINOPS)
+def _binop(node, env):
+    a, b = node.inputs[:2]
+    # constant operand from a byconst export: fold scalars back
+    if env.is_const(b) and env.const(b).ndim == 0 \
+            and node.op_type in ("Add", "Mul"):
+        c = float(env.const(b))
+        return (O.addbyconst_op(env.op(a), const=c)
+                if node.op_type == "Add"
+                else O.mulbyconst_op(env.op(a), const=c))
+    return _BINOPS[node.op_type](env.op(a), env.op(b))
+
+
+@importer(*_UNARY)
+def _unary(node, env):
+    return _UNARY[node.op_type](env.op(node.inputs[0]))
+
+
+@importer("Gelu")
+def _gelu(node, env):
+    return O.gelu_op(env.op(node.inputs[0]),
+                     approximate=node.attrs.get("approximate",
+                                                "tanh") == "tanh")
+
+
+@importer("Pow")
+def _pow(node, env):
+    return O.pow_op(env.op(node.inputs[0]),
+                    exponent=float(env.const(node.inputs[1])))
+
+
+@importer("Gemm")
+def _gemm(node, env):
+    x, w = env.op(node.inputs[0]), env.op(node.inputs[1])
+    bias = env.op(node.inputs[2]) if len(node.inputs) > 2 else None
+    ta = bool(node.attrs.get("transA", 0))
+    tb = bool(node.attrs.get("transB", 0))
+    if bias is None:
+        return O.matmul_op(x, w, trans_A=ta, trans_B=tb)
+    return O.linear_op(x, w, bias, trans_A=ta, trans_B=tb)
+
+
+@importer("Gather")
+def _gather(node, env):
+    return O.embedding_lookup_op(env.op(node.inputs[0]),
+                                 env.op(node.inputs[1]))
+
+
+@importer("Softmax")
+def _softmax(node, env):
+    return O.softmax_op(env.op(node.inputs[0]),
+                        dim=node.attrs.get("axis", -1))
+
+
+@importer("LogSoftmax")
+def _log_softmax(node, env):
+    return O.log_softmax_op(env.op(node.inputs[0]),
+                            dim=node.attrs.get("axis", -1))
+
+
+@importer("Reshape")
+def _reshape(node, env):
+    shape = tuple(int(v) for v in env.const(node.inputs[1]))
+    return O.array_reshape_op(env.op(node.inputs[0]), output_shape=shape)
+
+
+@importer("Transpose")
+def _transpose(node, env):
+    return O.transpose_op(env.op(node.inputs[0]),
+                          perm=tuple(node.attrs["perm"]))
+
+
+@importer("Concat")
+def _concat(node, env):
+    return O.concatenate_op([env.op(i) for i in node.inputs],
+                            axis=node.attrs.get("axis", 0))
+
+
+@importer("Unsqueeze")
+def _unsqueeze(node, env):
+    axes = [int(v) for v in env.const(node.inputs[1])]
+    out = env.op(node.inputs[0])
+    for ax in axes:
+        out = O.expand_dims_op(out, axis=ax)
+    return out
+
+
+@importer("Squeeze")
+def _squeeze(node, env):
+    if len(node.inputs) > 1 and node.inputs[1]:
+        axes = tuple(int(v) for v in env.const(node.inputs[1]))
+        ax = axes[0] if len(axes) == 1 else axes
+        return O.squeeze_op(env.op(node.inputs[0]), axis=ax)
+    return O.squeeze_op(env.op(node.inputs[0]))
+
+
+@importer("Conv")
+def _conv(node, env):
+    pads = list(node.attrs.get("pads", [0, 0, 0, 0]))
+    strides = list(node.attrs.get("strides", [1, 1]))
+    if pads[:2] != pads[2:]:
+        raise NotImplementedError(
+            f"asymmetric Conv pads {pads} unsupported ({node.name})")
+    x, w = env.op(node.inputs[0]), env.op(node.inputs[1])
+    kw = dict(padding=tuple(pads[:2]), stride=tuple(strides),
+              groups=node.attrs.get("group", 1))
+    if len(node.inputs) > 2:
+        return O.conv2d_add_bias_op(x, w, env.op(node.inputs[2]), **kw)
+    return O.conv2d_op(x, w, **kw)
+
+
+@importer("MaxPool", "AveragePool")
+def _pool(node, env):
+    k = node.attrs["kernel_shape"]
+    pads = list(node.attrs.get("pads", [0, 0, 0, 0]))
+    strides = list(node.attrs.get("strides", [1, 1]))
+    if pads[:2] != pads[2:]:
+        raise NotImplementedError(
+            f"asymmetric pool pads {pads} unsupported ({node.name})")
+    ctor = O.max_pool2d_op if node.op_type == "MaxPool" else O.avg_pool2d_op
+    return ctor(env.op(node.inputs[0]), kernel_H=k[0], kernel_W=k[1],
+                padding=tuple(pads[:2]), stride=tuple(strides))
+
+
+@importer("BatchNormalization")
+def _bn(node, env):
+    x, scale, bias, rmean, rvar = (env.op(i) for i in node.inputs[:5])
+    # our BatchNormOp creates running-stat vars itself; rebind them to the
+    # imported values by constructing then overwriting the initializers
+    op = O.batch_normalization_op(
+        x, scale, bias, momentum=1.0 - node.attrs.get("momentum", 0.9),
+        eps=node.attrs.get("epsilon", 1e-5))
+    if isinstance(rmean, VariableOp):
+        op.running_mean.initializer = rmean.initializer
+    if isinstance(rvar, VariableOp):
+        op.running_var.initializer = rvar.initializer
+    return op
+
+
+@importer("LayerNormalization")
+def _ln(node, env):
+    return O.layer_normalization_op(
+        env.op(node.inputs[0]), env.op(node.inputs[1]),
+        env.op(node.inputs[2]), eps=node.attrs.get("epsilon", 1e-5))
+
+
+@importer("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin")
+def _reduce(node, env):
+    ctor = {"ReduceMean": O.reduce_mean_op, "ReduceSum": O.reduce_sum_op,
+            "ReduceMax": O.reduce_max_op,
+            "ReduceMin": O.reduce_min_op}[node.op_type]
+    if len(node.inputs) > 1 and node.inputs[1]:
+        axes = tuple(int(v) for v in env.const(node.inputs[1]))
+    else:
+        axes = node.attrs.get("axes")   # pre-opset-18 models
+        axes = tuple(axes) if axes is not None else None
+    return ctor(env.op(node.inputs[0]), axes=axes,
+                keepdims=bool(node.attrs.get("keepdims", 0)))
+
+
+@importer("Cast")
+def _cast(node, env):
+    return O.cast_op(env.op(node.inputs[0]),
+                     dtype=np.dtype(node.attrs["to"]))
+
+
+@importer("Clip")
+def _clip(node, env):
+    lo = (float(env.const(node.inputs[1]))
+          if len(node.inputs) > 1 and node.inputs[1] else None)
+    hi = (float(env.const(node.inputs[2]))
+          if len(node.inputs) > 2 and node.inputs[2] else None)
+    return O.clamp_op(env.op(node.inputs[0]), min=lo, max=hi)
+
+
+@importer("OneHot")
+def _one_hot(node, env):
+    depth = int(env.const(node.inputs[1]))
+    return O.one_hot_op(env.op(node.inputs[0]), num_classes=depth)
+
+
+@importer("Tile")
+def _tile(node, env):
+    return O.tile_op(env.op(node.inputs[0]),
+                     reps=tuple(int(v) for v in env.const(node.inputs[1])))
+
+
+@importer("Dropout")
+def _dropout(node, env):
+    ratio = (float(env.const(node.inputs[1]))
+             if len(node.inputs) > 1 else 0.5)
+    return O.dropout_op(env.op(node.inputs[0]), keep_prob=1.0 - ratio)
+
+
+@importer("Where")
+def _where(node, env):
+    return O.where_op(*(env.op(i) for i in node.inputs))
+
+
+def onnx2hetu(model: OnnxModel):
+    """Returns (placeholders {name: PlaceholderOp}, outputs [Op])."""
+    env = _Env(model)
+    placeholders = {}
+    for t in model.inputs:
+        ph = PlaceholderOp(t.name, t.shape or None, dtype=np.dtype(t.dtype))
+        env.nodes[t.name] = ph
+        placeholders[t.name] = ph
+    for node in model.nodes:
+        fn = _IMPORTERS.get(node.op_type)
+        if fn is None:
+            raise NotImplementedError(
+                f"no importer for ONNX op {node.op_type!r} ({node.name})")
+        out = fn(node, env)
+        env.nodes[node.outputs[0]] = out
+    outputs = [env.op(t.name) for t in model.outputs]
+    return placeholders, outputs

@@ -1,0 +1,122 @@
+"""Portable graph IR for the ONNX bridge.
+
+The reference bridge (python/hetu/onnx/hetu2onnx.py, onnx2hetu.py,
+onnx_opset/) converts between its op DAG and onnx protobufs directly.  Here
+conversion goes through a small neutral IR — `OnnxModel`, a list of ONNX-
+shaped nodes plus initializers — so the bridge works (export, import,
+save/load, round-trip tests) even when the `onnx` package is absent; when it
+is importable, proto.py converts OnnxModel <-> onnx.ModelProto losslessly.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class NodeIR:
+    """One ONNX graph node: op_type + named edges + attributes."""
+    op_type: str
+    inputs: list
+    outputs: list
+    attrs: dict = field(default_factory=dict)
+    name: str = ""
+
+
+@dataclass
+class TensorInfo:
+    name: str
+    shape: tuple
+    dtype: str = "float32"
+
+
+@dataclass
+class OnnxModel:
+    name: str = "hetu_tpu_graph"
+    nodes: list = field(default_factory=list)            # [NodeIR]
+    initializers: dict = field(default_factory=dict)     # name -> np.ndarray
+    inputs: list = field(default_factory=list)           # [TensorInfo]
+    outputs: list = field(default_factory=list)          # [TensorInfo]
+    opset: int = 20   # Gelu needs >= 20; Reduce* axes-as-input needs >= 18
+
+    def add_initializer(self, name, value):
+        self.initializers[name] = np.asarray(value)
+        return name
+
+    def summary(self):
+        ops = {}
+        for n in self.nodes:
+            ops[n.op_type] = ops.get(n.op_type, 0) + 1
+        return {"name": self.name, "num_nodes": len(self.nodes),
+                "num_initializers": len(self.initializers),
+                "inputs": [t.name for t in self.inputs],
+                "outputs": [t.name for t in self.outputs], "op_counts": ops}
+
+
+def _attrs_to_json(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {"__nd__": True, "data": v.tolist(),
+                      "dtype": str(v.dtype)}
+        elif isinstance(v, tuple):
+            out[k] = {"__tuple__": True, "data": list(v)}
+        else:
+            out[k] = v
+    return out
+
+
+def _attrs_from_json(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, dict) and v.get("__nd__"):
+            out[k] = np.asarray(v["data"], dtype=v["dtype"])
+        elif isinstance(v, dict) and v.get("__tuple__"):
+            out[k] = tuple(v["data"])
+        else:
+            out[k] = v
+    return out
+
+
+def save_model(model: OnnxModel, path: str):
+    """Serialize to a zip: graph.json + one .npy per initializer."""
+    header = {
+        "name": model.name, "opset": model.opset,
+        "nodes": [{"op_type": n.op_type, "inputs": n.inputs,
+                   "outputs": n.outputs, "attrs": _attrs_to_json(n.attrs),
+                   "name": n.name} for n in model.nodes],
+        "inputs": [{"name": t.name, "shape": list(t.shape),
+                    "dtype": t.dtype} for t in model.inputs],
+        "outputs": [{"name": t.name, "shape": list(t.shape),
+                     "dtype": t.dtype} for t in model.outputs],
+        "initializer_names": list(model.initializers),
+    }
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("graph.json", json.dumps(header))
+        for name, arr in model.initializers.items():
+            buf = io.BytesIO()
+            np.save(buf, np.asarray(arr))
+            z.writestr(f"init/{name}.npy", buf.getvalue())
+
+
+def load_model(path: str) -> OnnxModel:
+    with zipfile.ZipFile(path, "r") as z:
+        header = json.loads(z.read("graph.json"))
+        inits = {}
+        for name in header["initializer_names"]:
+            inits[name] = np.load(io.BytesIO(z.read(f"init/{name}.npy")))
+    return OnnxModel(
+        name=header["name"], opset=header["opset"],
+        nodes=[NodeIR(d["op_type"], d["inputs"], d["outputs"],
+                      _attrs_from_json(d["attrs"]), d["name"])
+               for d in header["nodes"]],
+        initializers=inits,
+        inputs=[TensorInfo(d["name"], tuple(d["shape"]), d["dtype"])
+                for d in header["inputs"]],
+        outputs=[TensorInfo(d["name"], tuple(d["shape"]), d["dtype"])
+                 for d in header["outputs"]])

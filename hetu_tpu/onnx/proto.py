@@ -1,0 +1,91 @@
+"""OnnxModel <-> onnx.ModelProto, gated on the `onnx` package.
+
+The build environment does not ship `onnx`; everything else in the bridge
+(export, import, save/load, round-trips) works without it through the
+neutral IR (ir.py).  When `onnx` is importable these two functions produce /
+consume real protobufs for interop with other frameworks (the reference's
+tests round-trip through tensorflow, tests/onnx/).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .ir import OnnxModel, NodeIR, TensorInfo
+
+try:
+    import onnx  # noqa: F401
+    from onnx import helper, numpy_helper, TensorProto
+    HAS_ONNX = True
+except ImportError:  # pragma: no cover - onnx not in the build image
+    HAS_ONNX = False
+
+_DTYPE2PROTO = {"float32": 1, "float64": 11, "int32": 6, "int64": 7}
+_PROTO2DTYPE = {v: k for k, v in _DTYPE2PROTO.items()}
+
+
+def _require():
+    if not HAS_ONNX:
+        raise ImportError(
+            "the `onnx` package is not installed; use ir.save_model / "
+            "ir.load_model for the portable zip format instead")
+
+
+def to_onnx_proto(model: OnnxModel):
+    """OnnxModel -> onnx.ModelProto (requires the onnx package)."""
+    _require()
+    nodes = []
+    for n in model.nodes:
+        attrs = {}
+        for k, v in n.attrs.items():
+            if k == "to":  # Cast dtype: translate to TensorProto enum
+                v = _DTYPE2PROTO[str(np.dtype(v))]
+            if isinstance(v, tuple):
+                v = list(v)
+            attrs[k] = v
+        nodes.append(helper.make_node(n.op_type, n.inputs, n.outputs,
+                                      name=n.name, **attrs))
+    inputs = [helper.make_tensor_value_info(
+        t.name, _DTYPE2PROTO.get(t.dtype, 1), list(t.shape) or None)
+        for t in model.inputs]
+    outputs = [helper.make_tensor_value_info(
+        t.name, _DTYPE2PROTO.get(t.dtype, 1), None) for t in model.outputs]
+    inits = [numpy_helper.from_array(np.asarray(v), name=k)
+             for k, v in model.initializers.items()]
+    graph = helper.make_graph(nodes, model.name, inputs, outputs, inits)
+    proto = helper.make_model(
+        graph, opset_imports=[helper.make_opsetid("", model.opset)])
+    return proto
+
+
+def from_onnx_proto(proto) -> OnnxModel:
+    """onnx.ModelProto -> OnnxModel (requires the onnx package)."""
+    _require()
+    g = proto.graph
+    model = OnnxModel(name=g.name)
+    if proto.opset_import:
+        model.opset = proto.opset_import[0].version
+    for init in g.initializer:
+        model.initializers[init.name] = numpy_helper.to_array(init)
+    init_names = set(model.initializers)
+    for vi in g.input:
+        if vi.name in init_names:
+            continue
+        shape = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+        model.inputs.append(TensorInfo(
+            vi.name, shape,
+            _PROTO2DTYPE.get(vi.type.tensor_type.elem_type, "float32")))
+    for vi in g.output:
+        model.outputs.append(TensorInfo(vi.name, ()))
+    for n in g.node:
+        attrs = {}
+        for a in n.attribute:
+            v = helper.get_attribute_value(a)
+            if n.op_type == "Cast" and a.name == "to":
+                v = _PROTO2DTYPE[v]
+            if isinstance(v, bytes):
+                v = v.decode()
+            attrs[a.name] = v
+        model.nodes.append(NodeIR(n.op_type, list(n.input), list(n.output),
+                                  attrs, n.name))
+    return model

@@ -4,6 +4,10 @@ src/hetu_cache + python/hetu/cstable.py; see SURVEY.md N8/N9/P17)."""
 from .store import (EmbeddingTable, CacheTable, ShardedTable, SSPController)
 from .cstable import CacheSparseTable
 from .embedding import PSEmbedding, PSRowsOp
+from .preduce import (PReduceScheduler, PartialReduce, partner_mask,
+                      masked_mean_allreduce)
 
 __all__ = ["EmbeddingTable", "CacheTable", "ShardedTable", "SSPController",
-           "CacheSparseTable", "PSEmbedding", "PSRowsOp"]
+           "CacheSparseTable", "PSEmbedding", "PSRowsOp",
+           "PReduceScheduler", "PartialReduce", "partner_mask",
+           "masked_mean_allreduce"]

@@ -52,6 +52,13 @@ def _declare(lib):
         lib.ssp_clock.argtypes = [i64, ctypes.c_int]
         lib.ssp_min.restype = i64
         lib.ssp_min.argtypes = [i64]
+        lib.preduce_create.restype = i64
+        lib.preduce_create.argtypes = []
+        lib.preduce_destroy.argtypes = [i64]
+        lib.preduce_get_partner.restype = ctypes.c_int
+        lib.preduce_get_partner.argtypes = [
+            i64, i64, ctypes.c_int, ctypes.c_int, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_int)]
 
 
 _native = NativeLib(os.path.join(_HERE, "native", "hetu_ps.cpp"),

@@ -19,11 +19,14 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <thread>
@@ -198,10 +201,70 @@ struct SSPClock {
   }
 };
 
+// Partial-reduce matchmaking (reference ps-lite/src/preduce_handler.cc,
+// psf/preduce.h kPReduceGetPartner): workers arriving at a reduce key wait
+// until either `target` workers showed up or the first arrival's wait_time
+// expired, then all receive the same sorted member list.  One stat per
+// reduce key (a pipeline stage uses a unique key).
+struct PReduceStat {
+  std::mutex mtx;
+  std::condition_variable cv;
+  std::vector<int> ready;
+  std::chrono::system_clock::time_point wake_time;
+  int critical = 0;  // members still copying out the current decision
+};
+
+struct PReduceScheduler {
+  std::mutex map_mtx;
+  std::unordered_map<int64_t, std::unique_ptr<PReduceStat>> stats;
+
+  // blocks; returns group size, member ranks (sorted) in out
+  int get_partner(int64_t key, int rank, int target, float wait_ms,
+                  int* out) {
+    PReduceStat* st;
+    {
+      std::lock_guard<std::mutex> g(map_mtx);
+      auto& slot = stats[key];
+      if (!slot) slot.reset(new PReduceStat());
+      st = slot.get();
+    }
+    std::unique_lock<std::mutex> lock(st->mtx);
+    // a previous decision is still being read out: wait for it to clear
+    while (st->critical) st->cv.wait(lock);
+    if (st->ready.empty()) {
+      st->wake_time = std::chrono::system_clock::now() +
+                      std::chrono::microseconds(
+                          static_cast<int64_t>(wait_ms * 1000));
+    }
+    st->ready.push_back(rank);
+    if (static_cast<int>(st->ready.size()) >= target) {
+      st->cv.notify_all();
+    } else {
+      while (static_cast<int>(st->ready.size()) < target && !st->critical &&
+             st->cv.wait_until(lock, st->wake_time) !=
+                 std::cv_status::timeout) {
+      }
+    }
+    if (!st->critical) {  // first thread awake freezes the decision
+      st->critical = static_cast<int>(st->ready.size());
+      std::sort(st->ready.begin(), st->ready.end());
+      st->cv.notify_all();
+    }
+    int n = static_cast<int>(st->ready.size());
+    std::copy(st->ready.begin(), st->ready.end(), out);
+    if (--st->critical == 0) {
+      st->ready.clear();
+      st->cv.notify_all();
+    }
+    return n;
+  }
+};
+
 std::mutex g_registry_mu;
 std::unordered_map<int64_t, Table*> g_tables;
 std::unordered_map<int64_t, Cache*> g_caches;
 std::unordered_map<int64_t, SSPClock*> g_clocks;
+std::unordered_map<int64_t, PReduceScheduler*> g_preduces;
 int64_t g_next_handle = 1;
 
 template <typename M, typename T>
@@ -542,6 +605,37 @@ int64_t ssp_min(int64_t h) {
   int64_t m = INT64_MAX;
   for (auto& c : it->second->clocks) m = std::min(m, c.load());
   return m;
+}
+
+// ---- partial-reduce matchmaking -------------------------------------------
+
+int64_t preduce_create() {
+  return register_handle(g_preduces, new PReduceScheduler());
+}
+
+void preduce_destroy(int64_t h) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_preduces.find(h);
+  if (it != g_preduces.end()) {
+    delete it->second;
+    g_preduces.erase(it);
+  }
+}
+
+// Blocks until `target` workers joined `key` or the first arrival's
+// wait_ms elapsed; writes the sorted member ranks to out and returns the
+// group size (ctypes releases the GIL, so Python worker threads block here
+// concurrently like the reference's PS RPC threads).
+int preduce_get_partner(int64_t h, int64_t key, int rank, int target,
+                        float wait_ms, int* out) {
+  PReduceScheduler* s;
+  {
+    std::lock_guard<std::mutex> g(g_registry_mu);
+    auto it = g_preduces.find(h);
+    if (it == g_preduces.end()) return -1;
+    s = it->second;
+  }
+  return s->get_partner(key, rank, target, wait_ms, out);
 }
 
 }  // extern "C"

@@ -1,0 +1,85 @@
+"""GNN / DistGCN-1.5D tests (reference: tests/test_DistGCN — parallel vs
+single-device GCN propagation equivalence)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as Pspec
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models.gnn import (normalized_adjacency, DistGCN15D,
+                                 distgcn_15d_op, _gcn_conv)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _random_graph(rng, n, e):
+    src = rng.integers(0, n, (e,)).astype(np.int32)
+    dst = rng.integers(0, n, (e,)).astype(np.int32)
+    return src, dst
+
+
+def test_gcn_conv_matches_dense(rng):
+    n, e, fin, fout = 24, 100, 8, 4
+    src, dst = _random_graph(rng, n, e)
+    h = rng.standard_normal((n, fin)).astype(np.float32)
+    w = rng.standard_normal((fin, fout)).astype(np.float32)
+    ew = rng.random(e).astype(np.float32)
+    out = np.asarray(_gcn_conv(jnp.asarray(h), jnp.asarray(w), src=src,
+                               dst=dst, edge_weight=jnp.asarray(ew)))
+    a = np.zeros((n, n), np.float32)
+    np.add.at(a, (dst, src), ew)
+    np.testing.assert_allclose(out, a @ (h @ w), rtol=1e-4, atol=1e-4)
+
+
+def test_normalized_adjacency_props(rng):
+    src, dst = _random_graph(rng, 10, 30)
+    a = normalized_adjacency(src, dst, 10)
+    assert a.shape == (10, 10)
+    assert (np.diag(a) > 0).all()          # self loops
+    np.testing.assert_allclose(a, a.T, rtol=1e-5)  # symmetric normalization
+
+
+@pytest.mark.parametrize("block,rep", [(4, 2), (8, 1), (2, 4)])
+def test_distgcn_15d_matches_single_device(rng, block, rep):
+    n, fin, fout = 32, 16, 8
+    src, dst = _random_graph(rng, n, 200)
+    a = normalized_adjacency(src, dst, n)
+    h = rng.standard_normal((n, fin)).astype(np.float32)
+    w = rng.standard_normal((fin, fout)).astype(np.float32)
+
+    devs = np.array(jax.devices()[:block * rep]).reshape(block, rep)
+    mesh = Mesh(devs, ("block", "rep"))
+    layer = DistGCN15D(mesh)
+    out = np.asarray(layer(jnp.asarray(a), jnp.asarray(h), jnp.asarray(w)))
+    np.testing.assert_allclose(out, a @ (h @ w), rtol=1e-4, atol=1e-4)
+
+
+def test_distgcn_op_in_graph_training(rng):
+    """2-layer GCN on a toy graph learns a node-classification target."""
+    n, fin, hid, ncls = 20, 6, 16, 3
+    src, dst = _random_graph(rng, n, 60)
+    feats = ht.placeholder_op("feats", (n, fin))
+    labels = ht.placeholder_op("labels", (n,), dtype=np.int32)
+    src_v = ht.Variable("src", value=src.reshape(-1), trainable=False)
+    dst_v = ht.Variable("dst", value=dst.reshape(-1), trainable=False)
+    w1 = ht.Variable("w1", shape=(fin, hid),
+                     initializer=ht.init.xavier_normal())
+    w2 = ht.Variable("w2", shape=(hid, ncls),
+                     initializer=ht.init.xavier_normal())
+    z1 = ht.relu_op(distgcn_15d_op(feats, w1, src_v, dst_v, num_nodes=n))
+    z2 = distgcn_15d_op(z1, w2, src_v, dst_v, num_nodes=n)
+    loss = ht.reduce_mean_op(
+        ht.softmax_cross_entropy_sparse_op(z2, labels))
+    ex = ht.Executor({"train": [loss,
+                                ht.AdamOptimizer(0.05).minimize(loss)]})
+    f = rng.standard_normal((n, fin)).astype(np.float32)
+    y = rng.integers(0, ncls, (n,))
+    losses = [float(ex.run("train", feed_dict={feats: f, labels: y},
+                           convert_to_numpy_ret_vals=True)[0])
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]

@@ -1,0 +1,99 @@
+"""ONNX bridge round-trip tests (reference: tests/onnx/ — per-model
+hetu->onnx->hetu equivalence checks; here through the neutral IR since the
+`onnx` package is absent in the build image)."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import onnx as hx
+from hetu_tpu.layers import Linear, Conv2d, BatchNorm, Sequence, Relu
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _roundtrip(eval_nodes, ex, feeds, rng, tmp_path=None):
+    """Export -> (optionally save/load) -> import -> compare outputs."""
+    model = hx.hetu2onnx(eval_nodes, ex.params)
+    if tmp_path is not None:
+        p = str(tmp_path / "model.onnx.zip")
+        hx.save_model(model, p)
+        model = hx.load_model(p)
+    placeholders, outs = hx.onnx2hetu(model)
+    ex2 = ht.Executor(outs)
+    feed2 = {placeholders[k.name]: v for k, v in feeds.items()}
+    want = ex.run(feed_dict=feeds, convert_to_numpy_ret_vals=True)
+    got = ex2.run(feed_dict=feed2, convert_to_numpy_ret_vals=True)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+    return model
+
+
+def test_mlp_roundtrip(rng, tmp_path):
+    x = ht.placeholder_op("x", (4, 10))
+    mlp = Sequence(Linear(10, 32), Relu(), Linear(32, 3))
+    out = ht.softmax_op(mlp(x))
+    ex = ht.Executor([out])
+    model = _roundtrip([out], ex, {x: rng.standard_normal((4, 10))}, rng,
+                       tmp_path)
+    counts = model.summary()["op_counts"]
+    assert counts.get("Gemm") == 2 and counts.get("Softmax") == 1
+
+
+def test_cnn_bn_roundtrip(rng):
+    x = ht.placeholder_op("img", (2, 3, 8, 8))
+    conv = Conv2d(3, 4, 3, padding=1)
+    bn = BatchNorm(4)
+    y = ht.max_pool2d_op(ht.relu_op(bn(conv(x))), kernel_H=2, kernel_W=2,
+                         stride=2)
+    out = ht.reduce_mean_op(y, axes=(2, 3))
+    ex = ht.Executor([out])   # inference graph: BN uses running stats
+    _roundtrip([out], ex, {x: rng.standard_normal((2, 3, 8, 8))}, rng)
+
+
+def test_embedding_reshape_roundtrip(rng):
+    ids = ht.placeholder_op("ids", (4, 6), dtype=np.int32)
+    table = ht.Variable("emb_table", shape=(50, 8),
+                        initializer=ht.init.normal(0.0, 0.1))
+    e = ht.embedding_lookup_op(table, ids)
+    out = ht.reduce_sum_op(
+        ht.array_reshape_op(e, output_shape=(4, 48)), axes=1)
+    ex = ht.Executor([out])
+    _roundtrip([out], ex, {ids: rng.integers(0, 50, (4, 6))}, rng)
+
+
+def test_elementwise_and_consts_roundtrip(rng):
+    x = ht.placeholder_op("x2", (3, 5))
+    out = ht.tanh_op(x * 2.0 + 1.5)
+    out = ht.clamp_op(out, min=-0.9, max=0.9)
+    out = ht.pow_op(out, exponent=2.0)
+    ex = ht.Executor([out])
+    _roundtrip([out], ex, {x: rng.standard_normal((3, 5))}, rng)
+
+
+def test_transpose_concat_roundtrip(rng):
+    a = ht.placeholder_op("a", (2, 3))
+    b = ht.placeholder_op("b", (2, 3))
+    cat = ht.concatenate_op([a, b], axis=1)
+    out = ht.transpose_op(cat, perm=(1, 0))
+    ex = ht.Executor([out])
+    _roundtrip([out], ex, {a: rng.standard_normal((2, 3)),
+                           b: rng.standard_normal((2, 3))}, rng)
+
+
+def test_unsupported_op_raises():
+    x = ht.placeholder_op("x3", (4, 4))
+    out = ht.binary_step_op(x)   # no ONNX equivalent registered
+    ex = ht.Executor([out])
+    with pytest.raises(NotImplementedError, match="binary_step"):
+        hx.hetu2onnx([out], ex.params)
+
+
+def test_proto_gated():
+    assert isinstance(hx.HAS_ONNX, bool)
+    if not hx.HAS_ONNX:
+        with pytest.raises(ImportError, match="onnx"):
+            hx.to_onnx_proto(hx.OnnxModel())
