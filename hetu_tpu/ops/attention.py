@@ -52,10 +52,16 @@ class ScaledDotProductAttentionOp(Op):
         mask = input_vals[3] if self.has_mask else None
         d = q.shape[-1]
         scale = self.scale if self.scale is not None else 1.0 / (d ** 0.5)
-        if (self.dropout_keep >= 1.0 or not ctx.training) and _use_flash(q):
+        if _use_flash(q):
             from .pallas.flash_attention import flash_attention
+            keep = self.dropout_keep if ctx.training else 1.0
+            seed = None
+            if keep < 1.0:
+                seed = jax.random.bits(ctx.rng_for(self), (1,),
+                                       "uint32").astype(jnp.int32)
             out = flash_attention(q, k, v, mask=mask, causal=self.causal,
-                                  scale=scale)
+                                  scale=scale, dropout_keep=keep,
+                                  seed=seed)
             if out is not None:
                 return out
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,

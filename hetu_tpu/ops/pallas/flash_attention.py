@@ -1,6 +1,429 @@
-"""Pallas flash attention (TPU).  Placeholder fallback until the kernel
-lands: returning None makes callers take the jnp path."""
+"""Pallas TPU flash attention (FlashAttention-2 style, fwd + bwd kernels).
+
+The reference composes attention from batched matmuls + a full [B,H,S,S]
+softmax (layers/attention.py) — O(S^2) HBM traffic, which OOMs BERT-base at
+per-chip batch 64.  This kernel keeps the score tile in VMEM with online
+softmax, so HBM traffic stays O(S·d):
+
+  forward : grid (B*H, S/block_q); the kv loop runs inside the kernel with
+            running (m, l, acc) carries; saves the logsumexp for backward.
+  backward: two kernels — dQ over q blocks, dK/dV over kv blocks — that
+            recompute P tiles from (Q, K, lse) instead of storing them
+            (the standard flash backward: dS = P∘(dO·Vᵀ − D),
+            D = rowsum(dO∘O)).
+  dropout : applied to the probability tiles in-kernel with the TPU PRNG,
+            reseeded per (seed, bh, q-block, kv-block) tile so the backward
+            kernels replay the identical mask; l accumulates un-dropped
+            sums so O = dropout(softmax(S))·V exactly.
+
+Supported: additive key mask [B, 1, 1, S] (BERT padding masks), causal,
+d ∈ {64, 128, 256}, seq a multiple of the 256 block.  Returns None for
+unsupported shapes so callers fall back to the jnp composition
+(ops/attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK_Q = 256
+_BLOCK_K = 256
+_NEG_INF = -1e30
 
 
-def flash_attention(q, k, v, mask=None, causal=False, scale=None):
-    return None
+def _interpret():
+    # CPU has no Mosaic backend; interpret mode keeps the kernels testable
+    # on the virtual-device mesh (tests/conftest.py)
+    return jax.default_backend() == "cpu"
+
+
+def _supported(q, k, v, mask):
+    if q.ndim != 4 or k.shape != q.shape or v.shape != q.shape:
+        return False
+    b, h, s, d = q.shape
+    if d not in (64, 128, 256):
+        return False
+    if s % _BLOCK_Q or s % _BLOCK_K:
+        return False
+    if mask is not None and tuple(mask.shape) != (b, 1, 1, s):
+        return False
+    return True
+
+
+def _keep_threshold(keep_prob):
+    # uint32 threshold: bits < threshold  <=>  keep (prob ~ keep_prob)
+    return np.uint32(min(int(keep_prob * 4294967296.0), 4294967295))
+
+
+def _tile_index(bh, qi, j, nq, nk):
+    """Unique int32 per (batch*head, q-block, kv-block) tile — Mosaic's
+    prng_seed accepts at most two scalars, so fold the coordinates."""
+    return (bh * nq + qi) * nk + j
+
+
+def _tile_keep(shape, seed_ref, tile, keep_prob):
+    """The deterministic keep mask for one prob tile.  ALL kernels (fwd,
+    dq, dkv) must obtain masks through this single helper — the backward
+    replays the forward's masks purely by reseeding with the same tile
+    index."""
+    pltpu.prng_seed(seed_ref[0], tile)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return bits < _keep_threshold(keep_prob)
+
+
+def _drop_tile(p, seed_ref, tile, keep_prob):
+    keep = _tile_keep(p.shape, seed_ref, tile, keep_prob)
+    return jnp.where(keep, p / keep_prob, 0.0)
+
+
+# -- forward ---------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref, *,
+                scale, causal, block_k, seq_len, keep_prob):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    nk = seq_len // block_k
+    if causal:
+        # kv blocks strictly above the diagonal contribute nothing
+        nk = jax.lax.min(nk, ((qi + 1) * bq + block_k - 1) // block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            s = s + mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            col = (j * block_k
+                   + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(row >= col, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        # l accumulates UN-dropped sums: O = dropout(P_normalized) @ V
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        if keep_prob < 1.0:
+            nq, nk_tot = seq_len // bq, seq_len // block_k
+            p = _drop_tile(p, seed_ref,
+                           _tile_index(bh, qi, j, nq, nk_tot), keep_prob)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _make_kern(base, has_mask, has_seed, n_out, **consts):
+    """Adapts a kernel with optional (mask_ref, seed_ref) slots to the
+    positional ref list pallas_call passes."""
+
+    def kern(*refs):
+        n_in = len(refs) - n_out
+        ins = list(refs[:n_in])
+        outs = list(refs[n_in:])
+        seed_ref = ins.pop() if has_seed else None
+        mask_ref = ins.pop() if has_mask else None
+        base(*ins, mask_ref, seed_ref, *outs, **consts)
+
+    return kern
+
+
+def _fwd(q, k, v, mask, causal, scale, keep_prob=1.0, seed=None,
+         block_q=_BLOCK_Q, block_k=_BLOCK_K):
+    b, h, s, d = q.shape
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+    ]
+    args = [qf, kf, vf]
+    if mask is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, s), lambda bh, i, h=h: (bh // h, 0, 0)))
+        args.append(mask.reshape(b, 1, s).astype(jnp.float32))
+    if keep_prob < 1.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed.reshape(1).astype(jnp.int32))
+    kern = _make_kern(_fwd_kernel, mask is not None, keep_prob < 1.0, 2,
+                      scale=scale, causal=causal, block_k=block_k,
+                      seq_len=s, keep_prob=keep_prob)
+    o, lse = pl.pallas_call(
+        kern,
+        interpret=_interpret(),
+        grid=(b * h, s // block_q),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
+        ])(*args)
+    return o.reshape(b, h, s, d), lse
+
+
+# -- backward --------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
+                   seed_ref, dq_ref, *, scale, causal, block_k, seq_len,
+                   keep_prob):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    dsum = dsum_ref[0, 0]
+    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, acc):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if mask_ref is not None:
+            s = s + mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            col = (j * block_k
+                   + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(row >= col, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if keep_prob < 1.0:  # replay the fwd tile mask on dP
+            nq, nk_tot = seq_len // bq, seq_len // block_k
+            dp = _drop_tile(dp, seed_ref,
+                            _tile_index(bh, qi, j, nq, nk_tot), keep_prob)
+        ds = p * (dp - dsum[:, None])
+        return acc + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    nk = seq_len // block_k
+    if causal:
+        # above-diagonal kv tiles are fully masked (p == 0): skip them
+        nk = jax.lax.min(nk, ((qi + 1) * bq + block_k - 1) // block_k)
+    acc = jax.lax.fori_loop(0, nk, body, acc0)
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
+                    seed_ref, dk_ref, dv_ref, *, scale, causal, block_q,
+                    seq_len, keep_prob):
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    bk = k_ref.shape[1]
+    d = k_ref.shape[2]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    col = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    mblk = (mask_ref[0, 0, pl.ds(ki * bk, bk)][None, :]
+            if mask_ref is not None else None)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        dsum = dsum_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(qb, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if mblk is not None:
+            s = s + mblk
+        if causal:
+            rr = (i * block_q
+                  + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
+            s = jnp.where(rr >= col, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if keep_prob < 1.0:
+            # fwd seeded by tile (bh, q-block=i, kv-block=ki)
+            nq, nk_tot = seq_len // block_q, seq_len // bk
+            keep = _tile_keep(p.shape, seed_ref,
+                              _tile_index(bh, i, ki, nq, nk_tot),
+                              keep_prob)
+            p_dropped = jnp.where(keep, p / keep_prob, 0.0)
+        else:
+            keep = None
+            p_dropped = p
+        dv_new = dv + jax.lax.dot_general(
+            p_dropped, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if keep is not None:
+            dp = jnp.where(keep, dp / keep_prob, 0.0)
+        ds = p * (dp - dsum[:, None])
+        dk_new = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    i_start = 0
+    if causal:
+        # q tiles strictly above the diagonal see none of this kv block
+        i_start = (ki * bk) // block_q
+    dk, dv = jax.lax.fori_loop(i_start, seq_len // block_q, body,
+                               (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, mask, o, lse, dout, causal, scale, keep_prob, seed,
+              block_q=_BLOCK_Q, block_k=_BLOCK_K):
+    b, h, s, d = q.shape
+    qf, kf, vf = (t.reshape(b * h, s, d) for t in (q, k, v))
+    dof = dout.reshape(b * h, s, d)
+    dsum = jnp.sum(dof.astype(jnp.float32)
+                   * o.reshape(b * h, s, d).astype(jnp.float32),
+                   axis=-1)[:, None, :]                      # (BH, 1, S)
+    args = [qf, kf, vf, dof, lse, dsum]
+    base_specs = [
+        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),   # q (full)
+        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),   # k
+        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),   # v
+        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),   # do
+        pl.BlockSpec((1, 1, s), lambda bh, i: (bh, 0, 0)),   # lse
+        pl.BlockSpec((1, 1, s), lambda bh, i: (bh, 0, 0)),   # dsum
+    ]
+    extra_args, extra_specs = [], []
+    if mask is not None:
+        extra_args.append(mask.reshape(b, 1, s).astype(jnp.float32))
+        extra_specs.append(pl.BlockSpec(
+            (1, 1, s), lambda bh, i, h=h: (bh // h, 0, 0)))
+    if keep_prob < 1.0:
+        extra_args.append(seed.reshape(1).astype(jnp.int32))
+        extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+
+    dq_specs = list(base_specs)
+    dq_specs[0] = pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0))
+    dq_specs[3] = pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0))
+    dq_specs[4] = pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i))
+    dq_specs[5] = pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i))
+
+    dq_kern = _make_kern(_bwd_dq_kernel, mask is not None, keep_prob < 1.0,
+                         1, scale=scale, causal=causal, block_k=block_k,
+                         seq_len=s, keep_prob=keep_prob)
+    dq = pl.pallas_call(
+        dq_kern, interpret=_interpret(), grid=(b * h, s // block_q),
+        in_specs=dq_specs + extra_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+    )(*args, *extra_args)
+
+    dkv_specs = list(base_specs)
+    dkv_specs[1] = pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0))
+    dkv_specs[2] = pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0))
+    dkv_kern = _make_kern(_bwd_dkv_kernel, mask is not None,
+                          keep_prob < 1.0, 2, scale=scale, causal=causal,
+                          block_q=block_q, seq_len=s, keep_prob=keep_prob)
+    dk, dv = pl.pallas_call(
+        dkv_kern, interpret=_interpret(), grid=(b * h, s // block_k),
+        in_specs=dkv_specs + extra_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ])(*args, *extra_args)
+
+    shape = (b, h, s, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
+# -- custom-vjp wrappers ---------------------------------------------------
+# two variants (with/without mask) keep the signatures positional; the
+# dropout seed is a traced uint32 tensor with zero cotangent.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_nomask(q, k, v, seed, causal, scale, keep_prob):
+    return _fwd(q, k, v, None, causal, scale, keep_prob, seed)[0]
+
+
+def _flash_nomask_fwd(q, k, v, seed, causal, scale, keep_prob):
+    o, lse = _fwd(q, k, v, None, causal, scale, keep_prob, seed)
+    return o, (q, k, v, seed, o, lse)
+
+
+def _flash_nomask_bwd(causal, scale, keep_prob, res, g):
+    q, k, v, seed, o, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, None, o, lse, g, causal, scale,
+                           keep_prob, seed)
+    return dq, dk, dv, jnp.zeros_like(seed)
+
+
+_flash_nomask.defvjp(_flash_nomask_fwd, _flash_nomask_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_mask(q, k, v, mask, seed, causal, scale, keep_prob):
+    return _fwd(q, k, v, mask, causal, scale, keep_prob, seed)[0]
+
+
+def _flash_mask_fwd(q, k, v, mask, seed, causal, scale, keep_prob):
+    o, lse = _fwd(q, k, v, mask, causal, scale, keep_prob, seed)
+    return o, (q, k, v, mask, seed, o, lse)
+
+
+def _flash_mask_bwd(causal, scale, keep_prob, res, g):
+    q, k, v, mask, seed, o, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, mask, o, lse, g, causal, scale,
+                           keep_prob, seed)
+    # The additive mask is treated as NON-differentiable data (our graphs
+    # build it from placeholder attention masks).  A learned attention bias
+    # must use the jnp fallback path, which differentiates the bias.
+    return dq, dk, dv, jnp.zeros_like(mask), jnp.zeros_like(seed)
+
+
+_flash_mask.defvjp(_flash_mask_fwd, _flash_mask_bwd)
+
+
+def flash_attention(q, k, v, mask=None, causal=False, scale=None,
+                    dropout_keep=1.0, seed=None):
+    """Fused attention; returns None when shapes are unsupported so the
+    caller falls back to the jnp composition (ops/attention.py).
+
+    ``dropout_keep`` < 1 applies attention-prob dropout in-kernel (TPU
+    PRNG); ``seed`` must then be an int32/uint32 scalar array.
+    """
+    if not _supported(q, k, v, mask):
+        return None
+    if dropout_keep < 1.0 and _interpret():
+        return None  # TPU PRNG primitives only under Mosaic
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if dropout_keep >= 1.0:
+        seed = jnp.zeros((1,), jnp.int32)
+    if mask is None:
+        return _flash_nomask(q, k, v, seed, causal, float(scale),
+                             float(dropout_keep))
+    return _flash_mask(q, k, v, mask, seed, causal, float(scale),
+                       float(dropout_keep))
