@@ -1,0 +1,52 @@
+"""Per-layer hybrid-parallel strategy search (reference:
+tools/Hetu-Galvatron — profile, search, emit the layer config).
+
+Profiles a transformer-ish layer stack analytically, runs the native DP
+core over (tp size, DDP-vs-FSDP, activation ckpt) per layer x pipeline
+degree, and prints the chosen per-layer strategy JSON.
+Usage: python examples/auto_parallel/galvatron_search.py --world 8
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import json
+
+from hetu_tpu.galvatron import (LayerProfile, GalvatronSearch)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--hidden", type=int, default=2560)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--mem-gb", type=float, default=16.0)
+    ap.add_argument("--micro-bsz", type=int, default=2)
+    ap.add_argument("--out", default=None, help="write config JSON here")
+    args = ap.parse_args()
+
+    h, s = args.hidden, args.seq_len
+    per_layer_params = 12 * h * h
+    act_bytes = 10 * s * h * 2          # bf16 activations per sample
+    compute_ms = 2.0                     # per-layer fwd estimate
+    layers = [LayerProfile(compute_ms, per_layer_params * 4, act_bytes)
+              for _ in range(args.layers)]
+
+    search = GalvatronSearch(args.world, args.mem_gb * (1 << 30),
+                             micro_bsz=args.micro_bsz)
+    cfg = search.search(layers)
+    out = cfg.to_json()
+    print(json.dumps(out, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""CTR models on synthetic Criteo-shaped data (reference: examples/ctr —
+wdl_criteo, dfm_criteo, dcn_criteo; 13 dense + 26 sparse features).
+
+--ps puts the embedding table behind the HET-cached parameter store
+(ps/cstable.py) instead of an in-graph Variable — the path for tables that
+don't fit HBM.  Usage: python examples/ctr/train_ctr.py --model wdl
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.models import WDL, DeepFM, DCN, DLRM
+
+MODELS = {"wdl": WDL, "deepfm": DeepFM, "dcn": DCN, "dlrm": DLRM}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="wdl", choices=list(MODELS))
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-embeddings", type=int, default=100000)
+    ap.add_argument("--embedding-dim", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--ps", action="store_true",
+                    help="host-RAM PS embedding table (server-side SGD)")
+    ap.add_argument("--cache", type=int, default=0,
+                    help="HET cache rows (with --ps): bounded-staleness "
+                         "client cache")
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    B, F = args.batch_size, 26
+    dense = ht.placeholder_op("dense", (B, 13))
+    sparse = ht.placeholder_op("sparse", (B, F), dtype=np.int32)
+    labels = ht.placeholder_op("labels", (B,))
+
+    ps_emb = None
+    if args.ps:
+        from hetu_tpu.ps import PSEmbedding
+        ps_emb = PSEmbedding(args.num_embeddings, args.embedding_dim,
+                             optimizer="sgd", lr=args.lr,
+                             cache_limit=args.cache or None)
+    model = MODELS[args.model](args.num_embeddings,
+                               embedding_dim=args.embedding_dim,
+                               ps_embedding=ps_emb)
+    loss = model.loss(dense, sparse, labels)
+    opt = ht.AdamOptimizer(learning_rate=args.lr)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]})
+
+    for step in range(args.steps):
+        feed = {dense: rng.standard_normal((B, 13)).astype(np.float32),
+                sparse: rng.integers(0, args.num_embeddings, (B, F)),
+                labels: rng.integers(0, 2, (B,)).astype(np.float32)}
+        out = ex.run("train", feed_dict=feed,
+                     convert_to_numpy_ret_vals=True)
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step:4d}  logloss {out[0]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""BERT-base pretraining, MLM + NSP (reference: examples/nlp/bert).
+
+Synthetic token streams by default (the reference's data prep pipelines
+produce the same [B,S] int tensors).  bf16 compute + f32 masters; attention
+runs through the Pallas flash kernel on TPU.
+Usage: python examples/nlp/train_bert.py [--layers 12 --steps 30]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+from hetu_tpu.models import BertConfig, BertForPreTraining
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    B, S = args.batch_size, args.seq_len
+    c = BertConfig(vocab_size=30522, hidden_size=768,
+                   num_hidden_layers=args.layers, seq_len=S,
+                   max_position_embeddings=max(512, S))
+
+    input_ids = ht.placeholder_op("input_ids", (B, S), dtype=np.int32)
+    token_type = ht.placeholder_op("token_type_ids", (B, S),
+                                   dtype=np.int32)
+    attn_mask = ht.placeholder_op("attention_mask", (B, S))
+    mlm_labels = ht.placeholder_op("mlm_labels", (B * S,), dtype=np.int32)
+    nsp_labels = ht.placeholder_op("nsp_labels", (B,), dtype=np.int32)
+
+    model = BertForPreTraining(c)
+    loss = model.loss(input_ids, token_type, attn_mask, mlm_labels,
+                      nsp_labels)
+    opt = ht.AdamWOptimizer(learning_rate=args.lr, weight_decay=0.01)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
+                     compute_dtype=jnp.bfloat16)
+
+    for step in range(args.steps):
+        ids = rng.integers(0, c.vocab_size, (B, S))
+        mlm = np.full((B * S,), -1, np.int64)
+        pos = rng.random(B * S) < 0.15
+        mlm[pos] = rng.integers(0, c.vocab_size, pos.sum())
+        feed = {input_ids: ids,
+                token_type: rng.integers(0, 2, (B, S)),
+                attn_mask: np.ones((B, S), np.float32),
+                mlm_labels: mlm,
+                nsp_labels: rng.integers(0, 2, (B,))}
+        out = ex.run("train", feed_dict=feed,
+                     convert_to_numpy_ret_vals=True)
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step:4d}  loss {out[0]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -418,6 +418,10 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None,
         return None
     if dropout_keep < 1.0 and _interpret():
         return None  # TPU PRNG primitives only under Mosaic
+    if dropout_keep < 1.0 and seed is None:
+        raise ValueError(
+            "flash_attention: dropout_keep < 1 requires seed= (an int32 "
+            "scalar array; the per-tile dropout masks derive from it)")
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
     if dropout_keep >= 1.0:

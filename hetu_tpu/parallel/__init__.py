@@ -4,6 +4,7 @@ from .dispatch import dispatch, DispatchOp
 from .strategies import (Strategy, DataParallel, FSDP, MegatronLM,
                          ModelParallel4CNN)
 from .pipeline import PipelineParallel, spmd_pipeline
+from .hetpipe import HetPipeTrainer, DenseParamStore
 from .context_parallel import (ring_attention, ulysses_attention,
                                ring_attention_shard, ulysses_attention_shard)
 from . import collectives

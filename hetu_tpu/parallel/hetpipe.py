@@ -1,0 +1,179 @@
+"""HetPipe: pipeline parallelism + parameter-server weight sync.
+
+Reference: pipedream_subexecutor.py:78-88 — with ``pipeline='hetpipe'`` each
+worker replica runs the pipeline schedule locally, ACCUMULATES grads and
+pushes them to the parameter server (server-side optimizer applies the
+update, BSP/SSP-gated); with the preduce flavor, grads are instead averaged
+over whichever worker replicas show up within the matchmaking window
+(preduce.py, ps-lite preduce_handler.cc).
+
+TPU mapping: the pipeline itself is the shard_map spmd pipeline
+(parallel/pipeline.py) over a 'pp' mesh axis; the PS plane is the host-side
+native store (ps/store.py).  Worker replicas on other TPU-VM hosts reach
+the same store over DCN — in-process they are threads (launcher.launch_local),
+which is also how the tests exercise the consistency protocols.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ps.store import EmbeddingTable, SSPController
+from ..ps.preduce import PReduceScheduler
+
+
+class DenseParamStore:
+    """PS-resident dense parameters (reference PSFunc DensePush/DDPushPull):
+    one table per pytree leaf, one row per leading index, server-side
+    optimizer applies pushed gradients."""
+
+    def __init__(self, params, optimizer="sgd", lr=0.01, **opt_kwargs):
+        self.treedef = jax.tree_util.tree_structure(params)
+        leaves = jax.tree_util.tree_leaves(params)
+        self.shapes = [l.shape for l in leaves]
+        self.tables = []
+        for leaf in leaves:
+            arr = np.asarray(leaf, np.float32).reshape(leaf.shape[0], -1) \
+                if leaf.ndim > 1 else np.asarray(leaf,
+                                                 np.float32).reshape(1, -1)
+            t = EmbeddingTable(arr.shape[0], arr.shape[1],
+                               optimizer=optimizer, lr=lr, init_scale=0,
+                               **opt_kwargs)
+            t.set_rows(np.arange(arr.shape[0]), arr)
+            self.tables.append(t)
+
+    def _rows(self, leaf_idx):
+        return np.arange(self.tables[leaf_idx].rows)
+
+    def push_grads(self, grads):
+        for i, g in enumerate(jax.tree_util.tree_leaves(grads)):
+            g = np.asarray(g, np.float32)
+            g = g.reshape(g.shape[0], -1) if g.ndim > 1 else g.reshape(1, -1)
+            self.tables[i].push(self._rows(i), g)
+
+    def pull(self):
+        leaves = []
+        for i, shape in enumerate(self.shapes):
+            arr = self.tables[i].lookup(self._rows(i)).reshape(shape)
+            leaves.append(jnp.asarray(arr))
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+class _ThreadReducer:
+    """In-process grad averaging for preduce groups (the thread analogue of
+    the lazily-built NCCL subgroups; real multi-host replicas average over
+    the dp mesh axis with ps.preduce.masked_mean_allreduce instead)."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._rounds = {}
+
+    def reduce(self, round_id, rank, partner, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        tree = jax.tree_util.tree_structure(grads)
+        with self._lock:
+            slot = self._rounds.setdefault(round_id, {"reads": 0})
+            slot[rank] = [np.asarray(l, np.float32) for l in leaves]
+            self._lock.notify_all()
+            while not all(r in slot for r in partner):
+                self._lock.wait()
+            acc = [np.mean([slot[r][i] for r in partner], axis=0)
+                   for i in range(len(leaves))]
+            slot["reads"] += 1
+            if slot["reads"] == len(partner):
+                del self._rounds[round_id]
+        return jax.tree_util.tree_unflatten(
+            tree, [jnp.asarray(a) for a in acc])
+
+
+class HetPipeTrainer:
+    """Drives one worker replica's pipeline + weight synchronization.
+
+    mode='hetpipe': grads pushed to the PS (server-side optimizer), fresh
+    weights pulled back, SSP clocks bound the fastest-slowest spread
+    (reference executor.py:226 + _compute_ssp; staleness=0 is BSP).
+    mode='preduce': grads averaged over the workers that arrive within
+    ``wait_time`` ms, then applied locally (straggler mitigation).
+    """
+
+    def __init__(self, pipeline, init_params, nworkers, mode="hetpipe",
+                 optimizer="sgd", lr=0.01, staleness=1, wait_time=100.0,
+                 scheduler=None, ssp_timeout=120.0, **opt_kwargs):
+        assert mode in ("hetpipe", "preduce")
+        self.pipeline = pipeline
+        self.nworkers = nworkers
+        self.mode = mode
+        self.lr = lr
+        self.wait_time = wait_time
+        self.ssp_timeout = ssp_timeout
+        # jit once: pipeline.grads builds fresh shard_map closures per call,
+        # so an unjitted loop would retrace + recompile every step
+        self._grads = jax.jit(pipeline.grads)
+        if mode == "hetpipe":
+            self.store = DenseParamStore(init_params, optimizer=optimizer,
+                                         lr=lr, **opt_kwargs)
+            self.ssp = SSPController(nworkers, staleness=staleness)
+        else:
+            if optimizer != "sgd" or opt_kwargs:
+                raise ValueError(
+                    "mode='preduce' applies a LOCAL sgd step after the "
+                    "group average; server-side optimizers only exist in "
+                    "mode='hetpipe'")
+            self.scheduler = scheduler or PReduceScheduler(nworkers)
+            self.reducer = _ThreadReducer()
+        self._round = [0] * nworkers
+        # workers that finished or died: excluded from the SSP min so the
+        # survivors don't spin forever on a frozen clock
+        self._inactive = set()
+
+    def mark_done(self, rank):
+        """Call when a worker finishes (or from an except block when it
+        dies) so SSP-gated peers stop waiting on its clock."""
+        self._inactive.add(rank)
+
+    def _ssp_can_advance(self, rank):
+        active = [w for w in range(self.nworkers)
+                  if w not in self._inactive]
+        if not active:
+            return True
+        lo = min(self.ssp.clock(w) for w in active)
+        return self.ssp.clock(rank) - lo <= self.ssp.staleness
+
+    def step(self, rank, params, xs, targets):
+        """One training round for worker ``rank``; returns (loss, params)."""
+        try:
+            loss, grads = self._grads(params, xs, targets)
+        except Exception:
+            self.mark_done(rank)   # unblock SSP-gated peers
+            raise
+        if self.mode == "hetpipe":
+            self.store.push_grads(grads)
+            self.ssp.tick(rank)
+            # SSP gate: block while more than `staleness` ahead of the
+            # slowest ACTIVE worker (reference psf/ssp.h kSSPSync), with a
+            # deadline so a silently-dead peer surfaces as an error
+            deadline = time.monotonic() + self.ssp_timeout
+            while not self._ssp_can_advance(rank):
+                if time.monotonic() > deadline:
+                    self.mark_done(rank)
+                    raise RuntimeError(
+                        f"SSP wait exceeded {self.ssp_timeout}s: a peer "
+                        f"stopped ticking (clocks="
+                        f"{[self.ssp.clock(w) for w in range(self.nworkers)]}"
+                        f"); call mark_done(rank) for finished workers")
+                time.sleep(0.001)
+            new_params = self.store.pull()
+        else:
+            rid = self._round[rank]
+            self._round[rank] += 1
+            partner = self.scheduler.get_partner(
+                rid, rank, self.nworkers, self.wait_time)
+            mean_g = self.reducer.reduce(rid, rank, partner, grads)
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - self.lr * g, params, mean_g)
+        return float(loss), new_params

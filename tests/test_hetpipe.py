@@ -1,0 +1,137 @@
+"""HetPipe (pipeline + PS) and preduce-pipeline tests (reference:
+pipedream_subexecutor.py:78-88 hetpipe/preduce modes)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hetu_tpu.parallel import make_mesh, PipelineParallel
+from hetu_tpu.parallel.hetpipe import (HetPipeTrainer, DenseParamStore,
+                                       _ThreadReducer)
+from hetu_tpu.launcher import launch_local
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_problem(seed, n_stages=2, n_micro=2, mb=8, d=8):
+    rng = np.random.default_rng(seed)
+    mesh = make_mesh({"pp": n_stages})
+    params = {"w": jnp.asarray(rng.standard_normal((n_stages, d, d)) * 0.3,
+                               jnp.float32),
+              "b": jnp.zeros((n_stages, d), jnp.float32)}
+    xs = jnp.asarray(rng.standard_normal((n_micro, mb, d)), jnp.float32)
+    targets = jnp.asarray(rng.standard_normal((n_micro, mb, d)) * 0.1,
+                          jnp.float32)
+
+    def loss_fn(outs, t):
+        return jnp.mean(jnp.square(outs - t))
+
+    pipe = PipelineParallel(mesh, _stage_fn, n_stages, n_micro, loss_fn)
+    return pipe, params, xs, targets
+
+
+def test_dense_param_store_roundtrip():
+    params = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+              "b": jnp.ones((4,), jnp.float32)}
+    store = DenseParamStore(params, optimizer="sgd", lr=0.5)
+    pulled = store.pull()
+    np.testing.assert_allclose(np.asarray(pulled["w"]),
+                               np.asarray(params["w"]))
+    grads = {"w": jnp.ones((3, 4)), "b": jnp.full((4,), 2.0)}
+    store.push_grads(grads)
+    pulled = store.pull()
+    np.testing.assert_allclose(np.asarray(pulled["w"]),
+                               np.asarray(params["w"]) - 0.5)
+    np.testing.assert_allclose(np.asarray(pulled["b"]), 0.0)
+
+
+def test_hetpipe_two_workers_train():
+    pipe, params, xs, targets = _make_problem(0)
+    trainer = HetPipeTrainer(pipe, params, nworkers=2, mode="hetpipe",
+                             lr=0.2, staleness=2)
+    losses = {0: [], 1: []}
+
+    def worker(rank, nranks):
+        p = trainer.store.pull()
+        for _ in range(15):
+            l, p = trainer.step(rank, p, xs, targets)
+            losses[rank].append(l)
+        trainer.mark_done(rank)
+        return losses[rank]
+
+    launch_local(worker, 2)
+    for r in (0, 1):
+        assert losses[r][-1] < losses[r][0] * 0.7, losses[r]
+    # SSP clocks within the staleness bound at the end
+    spread = abs(trainer.ssp.clock(0) - trainer.ssp.clock(1))
+    assert spread <= trainer.ssp.staleness + 1
+
+
+def test_preduce_pipeline_two_workers_train():
+    pipe, params, xs, targets = _make_problem(1)
+    trainer = HetPipeTrainer(pipe, params, nworkers=2, mode="preduce",
+                             lr=0.3, wait_time=200.0)
+    out = {}
+
+    def worker(rank, nranks):
+        p = params
+        ls = []
+        for _ in range(15):
+            l, p = trainer.step(rank, p, xs, targets)
+            ls.append(l)
+        out[rank] = (ls, p)
+        return ls
+
+    launch_local(worker, 2)
+    for r in (0, 1):
+        ls, _ = out[r]
+        assert ls[-1] < ls[0] * 0.7, ls
+    # both workers joined every reduce round -> identical final params
+    np.testing.assert_allclose(np.asarray(out[0][1]["w"]),
+                               np.asarray(out[1][1]["w"]), rtol=1e-6)
+
+
+def test_ssp_gate_does_not_hang_on_dead_peer():
+    """A peer that stops ticking must surface as an error, not a hang."""
+    pipe, params, xs, targets = _make_problem(2)
+    trainer = HetPipeTrainer(pipe, params, nworkers=2, mode="hetpipe",
+                             lr=0.1, staleness=1, ssp_timeout=1.0)
+    p = trainer.store.pull()
+    l, p = trainer.step(0, p, xs, targets)   # worker 1 never shows up
+    with pytest.raises(RuntimeError, match="SSP wait"):
+        trainer.step(0, p, xs, targets)
+    # after marking the dead peer done, training resumes
+    trainer._inactive.clear()
+    trainer.mark_done(1)
+    l2, _ = trainer.step(0, p, xs, targets)
+    assert np.isfinite(l2)
+
+
+def test_preduce_rejects_server_optimizer_args():
+    pipe, params, xs, targets = _make_problem(3)
+    with pytest.raises(ValueError, match="preduce"):
+        HetPipeTrainer(pipe, params, nworkers=2, mode="preduce",
+                       optimizer="adam")
+
+
+def test_thread_reducer_means():
+    red = _ThreadReducer()
+    import threading
+    results = {}
+
+    def w(rank, val):
+        g = {"x": jnp.full((2,), float(val))}
+        results[rank] = red.reduce(0, rank, (0, 1), g)
+
+    ts = [threading.Thread(target=w, args=(r, v))
+          for r, v in [(0, 1.0), (1, 3.0)]]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    np.testing.assert_allclose(np.asarray(results[0]["x"]), 2.0)
+    np.testing.assert_allclose(np.asarray(results[1]["x"]), 2.0)
+    assert red._rounds == {}   # cleaned up
