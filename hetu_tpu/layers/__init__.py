@@ -4,4 +4,5 @@ from .common import (Linear, Conv2d, BatchNorm, LayerNorm, RMSNorm, Embedding,
                      Concatenate, SumLayers)
 from .attention import MultiHeadAttention
 from .transformer import TransformerLayer, TransformerFFN
-from .moe import MoELayer, TopKGate, HashGate
+from .moe import (MoELayer, TopKGate, HashGate, KTop1Gate, SAMGate,
+                  BalanceGate)

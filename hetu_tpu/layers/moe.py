@@ -20,7 +20,18 @@ import numpy as np
 from .base import BaseLayer, fresh_name
 from ..graph.node import Op, VariableOp
 from .. import initializers as init
-from ..ops.moe import top_k_gating, hash_gating
+from ..ops.moe import (top_k_gating, hash_gating, ktop1_gating, sam_gating,
+                       base_balance_gating)
+
+
+def _orthogonal_rows(rng, rows, cols, gain=0.1):
+    """Orthogonal centroid init (reference BalanceGate.generate_orthogonal)."""
+    flat = rng.normal(0, 1, (max(rows, cols), min(rows, cols)))
+    q, r = np.linalg.qr(flat)
+    q = q * np.sign(np.diag(r))
+    if rows < cols:
+        q = q.T
+    return (q[:rows, :cols] * gain).astype(np.float32)
 
 
 class TopKGate(BaseLayer):
@@ -33,6 +44,9 @@ class TopKGate(BaseLayer):
         self.wg = VariableOp(f"{name}_w", (hidden_size, num_experts),
                              init.xavier_uniform())
 
+    def gating(self, tokens, wg, ids, k, capacity):
+        return top_k_gating(tokens @ wg, k, capacity)
+
 
 class HashGate(BaseLayer):
     """Deterministic id-hash gate (reference HashGate.py).  Requires token
@@ -41,6 +55,54 @@ class HashGate(BaseLayer):
     def __init__(self, num_experts, name=None):
         self.num_experts = num_experts
         self.wg = None
+
+    def gating(self, tokens, wg, ids, k, capacity):
+        return hash_gating(ids.reshape(-1), self.num_experts, capacity,
+                           dtype=tokens.dtype)
+
+
+class KTop1Gate(BaseLayer):
+    """k-prototype top-1 gate (reference KTop1Gate.py): experts split into
+    k prototypes; each token routes top-1 within every prototype."""
+
+    def __init__(self, hidden_size, num_experts, name=None):
+        name = fresh_name(name or "ktop1_gate")
+        self.wg = VariableOp(f"{name}_w", (hidden_size, num_experts),
+                             init.xavier_uniform())
+
+    def gating(self, tokens, wg, ids, k, capacity):
+        return ktop1_gating(tokens @ wg, k, capacity)
+
+
+class SAMGate(BaseLayer):
+    """Switch-and-mix locality gate (reference SAMGate.py): pick the
+    expert GROUP (host) with the largest mass, then top-k inside it."""
+
+    def __init__(self, hidden_size, num_experts, num_groups, name=None):
+        name = fresh_name(name or "sam_gate")
+        assert num_experts % num_groups == 0
+        self.num_groups = num_groups
+        self.wg = VariableOp(f"{name}_w", (hidden_size, num_experts),
+                             init.xavier_uniform())
+
+    def gating(self, tokens, wg, ids, k, capacity):
+        return sam_gating(tokens @ wg, k, capacity, self.num_groups)
+
+
+class BalanceGate(BaseLayer):
+    """BASE-layer gate (reference BalanceGate.py): balanced assignment
+    against fixed orthogonal expert centroids, sigmoid combine."""
+
+    def __init__(self, hidden_size, num_experts, seed=0, name=None):
+        name = fresh_name(name or "balance_gate")
+        cent = _orthogonal_rows(np.random.default_rng(seed), num_experts,
+                                hidden_size)
+        # wg = centroids^T so scores = tokens @ wg, like the other gates
+        self.wg = VariableOp(f"{name}_centroids", (hidden_size, num_experts),
+                             init.NumpyInit(cent.T.copy()), trainable=False)
+
+    def gating(self, tokens, wg, ids, k, capacity):
+        return base_balance_gating(tokens @ wg, capacity)
 
 
 class _MoEOp(Op):
@@ -62,28 +124,31 @@ class _MoEOp(Op):
         self.ep_axis = ep_axis
         self.has_ids = ids is not None
 
-    def _compute(self, input_vals, ctx):
-        import jax
-        import jax.numpy as jnp
+    def _unpack(self, input_vals):
+        """Input layout shared with MoEAuxLossOp (same inputs list)."""
         x, w1, b1, w2, b2 = input_vals[:5]
         rest = list(input_vals[5:])
         wg = rest.pop(0) if self.gate.wg is not None else None
         ids = rest.pop(0) if self.has_ids else None
+        return x, w1, b1, w2, b2, wg, ids
+
+    def _capacity(self, T):
+        return max(int(np.ceil(self.capacity_factor * T * self.k
+                               / self.num_experts)), 1)
+
+    def _compute(self, input_vals, ctx):
+        import jax
+        import jax.numpy as jnp
+        x, w1, b1, w2, b2, wg, ids = self._unpack(input_vals)
 
         orig_shape = x.shape
         h = x.shape[-1]
         tokens = x.reshape(-1, h)
         T = tokens.shape[0]
-        E = self.num_experts
-        C = int(np.ceil(self.capacity_factor * T * self.k / E))
-        C = max(C, 1)
+        C = self._capacity(T)
 
-        if wg is not None:
-            logits = tokens @ wg
-            dispatch, combine, aux = top_k_gating(logits, self.k, C)
-        else:
-            dispatch, combine, aux = hash_gating(ids.reshape(-1), E, C,
-                                                 dtype=tokens.dtype)
+        dispatch, combine, aux = self.gate.gating(tokens, wg, ids,
+                                                  self.k, C)
 
         expert_in = jnp.einsum("tec,th->ech", dispatch, tokens)
         if self.ep_axis is not None and ctx.mesh is not None:
@@ -111,31 +176,33 @@ class MoEAuxLossOp(Op):
     def _compute(self, input_vals, ctx):
         # recompute gating aux (cheap; CSE merges with the MoE op's gating)
         import jax.numpy as jnp
-        x = input_vals[0]
-        if self.moe.gate.wg is None:
-            return jnp.asarray(0.0, x.dtype)
-        wg = input_vals[5]
+        x, _, _, _, _, wg, ids = self.moe._unpack(input_vals)
         tokens = x.reshape(-1, x.shape[-1])
-        T = tokens.shape[0]
-        E = self.moe.num_experts
-        import jax
-        logits = tokens @ wg
-        probs = jax.nn.softmax(logits, axis=-1)
-        mask1 = jax.nn.one_hot(jnp.argmax(logits, -1), E,
-                               dtype=probs.dtype)
-        return E * jnp.sum(jnp.mean(probs, 0) * jnp.mean(mask1, 0))
+        _, _, aux = self.moe.gate.gating(
+            tokens, wg, ids, self.moe.k, self.moe._capacity(tokens.shape[0]))
+        return jnp.asarray(aux, x.dtype)
 
 
 class MoELayer(BaseLayer):
     """Expert-parallel FFN block (drop-in for TransformerFFN)."""
 
     def __init__(self, hidden_size, intermediate_size, num_experts, k=2,
-                 capacity_factor=1.25, gate="top", ep_axis=None, name=None):
+                 capacity_factor=1.25, gate="top", ep_axis=None,
+                 num_groups=None, name=None):
         name = fresh_name(name or "moe")
-        if gate == "top":
+        if isinstance(gate, BaseLayer):
+            self.gate = gate                      # caller-built gate
+        elif gate == "top":
             self.gate = TopKGate(hidden_size, num_experts, name=name)
         elif gate == "hash":
             self.gate = HashGate(num_experts)
+        elif gate == "ktop1":
+            self.gate = KTop1Gate(hidden_size, num_experts, name=name)
+        elif gate == "sam":
+            self.gate = SAMGate(hidden_size, num_experts,
+                                num_groups or 2, name=name)
+        elif gate == "balance":
+            self.gate = BalanceGate(hidden_size, num_experts, name=name)
         else:
             raise ValueError(gate)
         self.w1 = VariableOp(f"{name}_w1",

@@ -47,38 +47,22 @@ def top_k_gating(logits, k, capacity, *, second_renorm=True,
     ce = jnp.mean(mask1, axis=0)
     aux = E * jnp.sum(me * ce)
 
-    # position of each token within its expert's queue
-    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1         # [T, E]
-    pos1_tok = jnp.sum(pos1, axis=-1)                        # [T]
-    keep1 = pos1_tok < capacity
-    gates = [(idx1, gate1 * keep1, pos1_tok)]
-
+    masks_gates = [(mask1, gate1)]
     if k == 2:
         logits2 = jnp.where(mask1 > 0, -jnp.inf, logits)
-        idx2 = jnp.argmax(logits2, axis=-1)
-        mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
-        gate2 = jnp.sum(probs * mask2, axis=-1)
-        # expert queues continue after top-1 assignments
-        used = jnp.sum(mask1, axis=0, keepdims=True)         # [1, E] counts
-        pos2 = (jnp.cumsum(mask2, axis=0) - mask2 + used) * mask2
-        pos2_tok = jnp.sum(pos2, axis=-1)
-        keep2 = pos2_tok < capacity
-        gates.append((idx2, gate2 * keep2, pos2_tok))
-        if second_renorm:
-            denom = gates[0][1] + gates[1][1] + 1e-9
-            gates = [(i, g / denom * (gates[0][1] + gates[1][1] > 0), p)
-                     for (i, g, p) in gates]
-
-    dispatch = jnp.zeros((T, E, capacity), dtype=probs.dtype)
-    combine = jnp.zeros((T, E, capacity), dtype=probs.dtype)
-    t_idx = jnp.arange(T)
-    for idx, gate, pos in gates:
-        oh = (jax.nn.one_hot(idx, E, dtype=probs.dtype)[:, :, None]
-              * jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                               dtype=probs.dtype)[:, None, :])
-        keep = (gate > 0).astype(probs.dtype)[:, None, None]
-        dispatch = dispatch + oh * keep
-        combine = combine + oh * gate[:, None, None]
+        mask2 = jax.nn.one_hot(jnp.argmax(logits2, axis=-1), E,
+                               dtype=probs.dtype)
+        masks_gates.append((mask2, jnp.sum(probs * mask2, axis=-1)))
+    choices = _choices_with_positions(masks_gates)
+    # zero dropped gates BEFORE renorm so kept mass renormalizes to 1
+    choices = [(i, g * (p < capacity), p) for (i, g, p) in choices]
+    if k == 2 and second_renorm:
+        total = choices[0][1] + choices[1][1]
+        denom = total + 1e-9
+        choices = [(i, g / denom * (total > 0), p)
+                   for (i, g, p) in choices]
+    dispatch, combine = _accumulate_dispatch(T, E, capacity, choices,
+                                             probs.dtype)
     return dispatch, combine, aux
 
 
@@ -87,12 +71,9 @@ def hash_gating(ids, num_experts, capacity, dtype=jnp.float32):
     T = ids.shape[0]
     idx = jnp.mod(ids.astype(jnp.int32), num_experts)
     mask = jax.nn.one_hot(idx, num_experts, dtype=dtype)
-    pos = jnp.sum(jnp.cumsum(mask, axis=0) * mask - mask, axis=-1)
-    keep = (pos < capacity).astype(dtype)
-    oh = (mask[:, :, None]
-          * jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=dtype)
-          [:, None, :])
-    dispatch = oh * keep[:, None, None]
+    choices = _choices_with_positions([(mask, jnp.ones((T,), dtype))])
+    dispatch, _ = _accumulate_dispatch(T, num_experts, capacity, choices,
+                                       dtype)
     return dispatch, dispatch, jnp.asarray(0.0, dtype)
 
 
@@ -116,6 +97,123 @@ def _scatter1d(x, idx, size=None):
 
 
 scatter1d_op = simple_op(_scatter1d, "scatter1d")
+
+
+def _positions_in_queue(mask):
+    """Per-token position within its expert's arrival queue; mask [T, E]."""
+    return jnp.sum(jnp.cumsum(mask, axis=0) * mask - mask, axis=-1)
+
+
+def _choices_with_positions(masks_gates):
+    """[(mask [T,E], gate [T])] -> [(expert_idx, gate, pos)] with positions
+    drawn from per-expert queues SHARED across choices: a later choice
+    queues behind every earlier choice's tokens, so two choices can never
+    collide in the same (expert, capacity-slot)."""
+    used = None
+    out = []
+    for mask, gate in masks_gates:
+        pos = _positions_in_queue(mask)
+        if used is not None:
+            pos = pos + jnp.sum(mask * used, axis=-1)
+        out.append((jnp.argmax(mask, axis=-1), gate, pos))
+        counts = jnp.sum(mask, axis=0, keepdims=True)
+        used = counts if used is None else used + counts
+    return out
+
+
+def _accumulate_dispatch(T, E, C, choices, dtype):
+    """choices: [(expert_idx [T], gate [T], pos [T])] -> dispatch/combine
+    [T, E, C] (zero rows for capacity-dropped tokens)."""
+    dispatch = jnp.zeros((T, E, C), dtype=dtype)
+    combine = jnp.zeros((T, E, C), dtype=dtype)
+    for idx, gate, pos in choices:
+        keep = (pos < C).astype(dtype)
+        oh = (jax.nn.one_hot(idx, E, dtype=dtype)[:, :, None]
+              * jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=dtype)
+              [:, None, :])
+        oh = oh * keep[:, None, None]
+        dispatch = dispatch + oh * (gate > 0).astype(dtype)[:, None, None]
+        combine = combine + oh * gate[:, None, None]
+    return dispatch, combine
+
+
+def ktop1_gating(logits, k, capacity):
+    """KTop1 gate (reference layers/KTop1Gate.py): experts split into k
+    prototypes of E/k; each token routes top-1 WITHIN every prototype
+    (k assignments total), with an independent balance loss per prototype.
+    """
+    T, E = logits.shape
+    assert E % k == 0, "KTop1 needs num_experts divisible by k"
+    Ep = E // k
+    sub = logits.reshape(T, k, Ep)
+    probs = jax.nn.softmax(sub, axis=-1)         # softmax per prototype
+    aux = 0.0
+    masks_gates = []
+    for i in range(k):
+        idx_local = jnp.argmax(sub[:, i], axis=-1)
+        mask_local = jax.nn.one_hot(idx_local, Ep, dtype=probs.dtype)
+        gate = jnp.sum(probs[:, i] * mask_local, axis=-1)
+        aux = aux + Ep * jnp.sum(jnp.mean(probs[:, i], axis=0)
+                                 * jnp.mean(mask_local, axis=0))
+        mask = jax.nn.one_hot(i * Ep + idx_local, E, dtype=probs.dtype)
+        masks_gates.append((mask, gate))
+    choices = _choices_with_positions(masks_gates)
+    dispatch, combine = _accumulate_dispatch(T, E, capacity, choices,
+                                             probs.dtype)
+    return dispatch, combine, aux
+
+
+def sam_gating(logits, k, capacity, num_groups):
+    """SAM gate (reference layers/SAMGate.py): experts form ``num_groups``
+    locality groups (one per host in the reference); each token picks the
+    group with the largest probability mass, then its top-k experts INSIDE
+    that group — keeping all its expert traffic on one host.  Aux = GShard
+    balance loss + an alignment term rewarding the chosen group's mass
+    (adaptation of SamMax.cu's alignment objective).
+    """
+    T, E = logits.shape
+    assert E % num_groups == 0
+    Eg = E // num_groups
+    assert k <= Eg, (f"SAM routes within one group of {Eg} experts; "
+                     f"k={k} would exhaust it")
+    probs = jax.nn.softmax(logits, axis=-1)
+    gmass = sam_group_sum(probs.T, jnp.repeat(jnp.arange(num_groups), Eg),
+                          num_groups).T                    # [T, G]
+    top_group = jnp.argmax(gmass, axis=-1)                 # [T]
+    in_group = (jnp.repeat(jnp.arange(num_groups), Eg)[None, :]
+                == top_group[:, None])
+    masked = jnp.where(in_group, logits, -jnp.inf)
+    masks_gates = []
+    remaining = masked
+    first_mask = None
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        if first_mask is None:
+            first_mask = mask
+        masks_gates.append((mask, jnp.sum(probs * mask, axis=-1)))
+        remaining = jnp.where(mask > 0, -jnp.inf, remaining)
+    choices = _choices_with_positions(masks_gates)
+    dispatch, combine = _accumulate_dispatch(T, E, capacity, choices,
+                                             probs.dtype)
+    balance = E * jnp.sum(jnp.mean(probs, axis=0)
+                          * jnp.mean(first_mask, axis=0))
+    alignment = jnp.mean(1.0 - jnp.max(gmass, axis=-1))
+    return dispatch, combine, balance + alignment
+
+
+def base_balance_gating(scores, capacity):
+    """BASE-layer gate (reference BalanceGate.py + BalanceAssignment op):
+    capacity-constrained assignment balances load exactly; combine weight
+    is sigmoid(token · centroid) as in the BASE layer."""
+    T, E = scores.shape
+    idx = balance_assignment(scores, capacity)
+    gate = jax.nn.sigmoid(scores[jnp.arange(T), idx])
+    mask = jax.nn.one_hot(idx, E, dtype=scores.dtype)
+    pos = _positions_in_queue(mask)
+    dispatch, combine = _accumulate_dispatch(
+        T, E, capacity, [(idx, gate, pos)], scores.dtype)
+    return dispatch, combine, jnp.asarray(0.0, scores.dtype)
 
 
 def balance_assignment(scores, capacity=None):

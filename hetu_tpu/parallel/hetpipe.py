@@ -76,8 +76,12 @@ class _ThreadReducer:
     def reduce(self, round_id, rank, partner, grads):
         leaves = jax.tree_util.tree_leaves(grads)
         tree = jax.tree_util.tree_structure(grads)
+        # key by (round, group): matchmaking can split one round into
+        # disjoint groups (a straggler missing the window forms its own),
+        # and the groups must not share a slot
+        key = (round_id, tuple(partner))
         with self._lock:
-            slot = self._rounds.setdefault(round_id, {"reads": 0})
+            slot = self._rounds.setdefault(key, {"reads": 0})
             slot[rank] = [np.asarray(l, np.float32) for l in leaves]
             self._lock.notify_all()
             while not all(r in slot for r in partner):
@@ -86,7 +90,7 @@ class _ThreadReducer:
                    for i in range(len(leaves))]
             slot["reads"] += 1
             if slot["reads"] == len(partner):
-                del self._rounds[round_id]
+                del self._rounds[key]
         return jax.tree_util.tree_unflatten(
             tree, [jnp.asarray(a) for a in acc])
 

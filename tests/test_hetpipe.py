@@ -117,6 +117,32 @@ def test_preduce_rejects_server_optimizer_args():
                        optimizer="adam")
 
 
+def test_thread_reducer_disjoint_groups_same_round():
+    """A straggler forming its own singleton group in the same round must
+    not corrupt/delete the other group's slot (regression: per-group key)."""
+    import threading
+    red = _ThreadReducer()
+    results = {}
+
+    def w(rank, partner, val):
+        g = {"x": jnp.full((2,), float(val))}
+        results[rank] = red.reduce(0, rank, partner, g)
+
+    # straggler (rank 2) reduces alone FIRST, then the (0,1) group
+    w(2, (2,), 7.0)
+    ts = [threading.Thread(target=w, args=(r, (0, 1), v))
+          for r, v in [(0, 1.0), (1, 3.0)]]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in ts), "reducer deadlocked"
+    np.testing.assert_allclose(np.asarray(results[2]["x"]), 7.0)
+    np.testing.assert_allclose(np.asarray(results[0]["x"]), 2.0)
+    np.testing.assert_allclose(np.asarray(results[1]["x"]), 2.0)
+    assert red._rounds == {}
+
+
 def test_thread_reducer_means():
     red = _ThreadReducer()
     import threading

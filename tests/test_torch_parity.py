@@ -1,0 +1,188 @@
+"""Loss/output parity vs torch & huggingface (the reference's de-facto
+integration methodology, SURVEY.md §4: every major example has a
+pytorch/tf companion checked for loss-curve parity)."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _t2n(t):
+    return t.detach().cpu().numpy()
+
+
+def test_transformer_layer_matches_torch(rng):
+    """Our post-LN block vs torch.nn.TransformerEncoderLayer with copied
+    weights (eval mode, gelu, no dropout)."""
+    from hetu_tpu.layers.transformer import TransformerLayer
+    from hetu_tpu.ops import gelu_op
+
+    B, S, H, heads, FF = 2, 16, 32, 4, 64
+    tl = torch.nn.TransformerEncoderLayer(
+        H, heads, dim_feedforward=FF, dropout=0.0, activation="gelu",
+        batch_first=True, norm_first=False)
+    tl.eval()
+
+    layer = TransformerLayer(
+        H, heads, FF, seq_len=S, dropout_rate=0.0, attn_dropout_rate=0.0,
+        causal=False, pre_norm=False, name="parity_layer",
+        activation=lambda x: gelu_op(x, approximate=False))
+    x = ht.placeholder_op("tp_x", (B, S, H))
+    out = layer(x)
+    ex = ht.Executor([out])
+
+    # --- copy torch weights into executor params (torch Linear stores
+    # (out, in); our linear computes x @ w so transpose) ---
+    import jax.numpy as jnp
+    w_in = _t2n(tl.self_attn.in_proj_weight)      # (3H, H)
+    b_in = _t2n(tl.self_attn.in_proj_bias)
+    p = ex.params
+
+    def put(name, value):
+        assert name in p, name
+        assert p[name].shape == value.shape, \
+            (name, p[name].shape, value.shape)
+        p[name] = jnp.asarray(value)
+    for i, proj in enumerate(("q", "k", "v")):
+        put(f"parity_layer_attn_{proj}_weight",
+            w_in[i * H:(i + 1) * H].T.copy())
+        put(f"parity_layer_attn_{proj}_bias", b_in[i * H:(i + 1) * H])
+    put("parity_layer_attn_out_weight", _t2n(tl.self_attn.out_proj.weight).T.copy())
+    put("parity_layer_attn_out_bias", _t2n(tl.self_attn.out_proj.bias))
+    put("parity_layer_ffn_in_weight", _t2n(tl.linear1.weight).T.copy())
+    put("parity_layer_ffn_in_bias", _t2n(tl.linear1.bias))
+    put("parity_layer_ffn_out_weight", _t2n(tl.linear2.weight).T.copy())
+    put("parity_layer_ffn_out_bias", _t2n(tl.linear2.bias))
+    put("parity_layer_ln1_scale", _t2n(tl.norm1.weight))
+    put("parity_layer_ln1_bias", _t2n(tl.norm1.bias))
+    put("parity_layer_ln2_scale", _t2n(tl.norm2.weight))
+    put("parity_layer_ln2_bias", _t2n(tl.norm2.bias))
+
+    X = rng.standard_normal((B, S, H)).astype(np.float32)
+    (got,) = ex.run(feed_dict={x: X}, convert_to_numpy_ret_vals=True)
+    want = _t2n(tl(torch.from_numpy(X)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_tiny_bert_matches_huggingface(rng):
+    """Full BertModel forward vs transformers.BertModel, copied weights.
+
+    hidden_act='gelu_new' in HF == our tanh-approximated gelu.
+    """
+    transformers = pytest.importorskip("transformers")
+    import jax.numpy as jnp
+    from hetu_tpu.models import BertConfig, BertModel
+
+    B, S = 2, 16
+    hf_cfg = transformers.BertConfig(
+        vocab_size=100, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu_new")
+    hf = transformers.BertModel(hf_cfg)
+    hf.eval()
+
+    c = BertConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=64,
+                   max_position_embeddings=32, type_vocab_size=2,
+                   hidden_dropout_prob=0.0,
+                   attention_probs_dropout_prob=0.0, seq_len=S)
+    name = "hfparity"
+    model = BertModel(c, name=name)
+    ids = ht.placeholder_op("hf_ids", (B, S), dtype=np.int32)
+    tok = ht.placeholder_op("hf_tok", (B, S), dtype=np.int32)
+    am = ht.placeholder_op("hf_am", (B, S))
+    seq_out, pooled = model(ids, tok, attention_mask=am)
+    ex = ht.Executor([seq_out, pooled])
+
+    p = ex.params
+
+    def put(nm, value):
+        assert nm in p, nm
+        assert p[nm].shape == tuple(value.shape), (nm, p[nm].shape,
+                                                   value.shape)
+        p[nm] = jnp.asarray(value)
+
+    sd = {k: _t2n(v) for k, v in hf.state_dict().items()}
+    e = f"{name}_embeddings"
+    put(f"{e}_word_table", sd["embeddings.word_embeddings.weight"])
+    put(f"{e}_position", sd["embeddings.position_embeddings.weight"])
+    put(f"{e}_tok_type_table", sd["embeddings.token_type_embeddings.weight"])
+    put(f"{e}_ln_scale", sd["embeddings.LayerNorm.weight"])
+    put(f"{e}_ln_bias", sd["embeddings.LayerNorm.bias"])
+    for i in range(c.num_hidden_layers):
+        hfp = f"encoder.layer.{i}."
+        our = f"{name}_layer{i}"
+        for proj, hname in (("q", "attention.self.query"),
+                            ("k", "attention.self.key"),
+                            ("v", "attention.self.value"),
+                            ("out", "attention.output.dense")):
+            put(f"{our}_attn_{proj}_weight", sd[hfp + hname + ".weight"].T)
+            put(f"{our}_attn_{proj}_bias", sd[hfp + hname + ".bias"])
+        put(f"{our}_ln1_scale", sd[hfp + "attention.output.LayerNorm.weight"])
+        put(f"{our}_ln1_bias", sd[hfp + "attention.output.LayerNorm.bias"])
+        put(f"{our}_ffn_in_weight", sd[hfp + "intermediate.dense.weight"].T)
+        put(f"{our}_ffn_in_bias", sd[hfp + "intermediate.dense.bias"])
+        put(f"{our}_ffn_out_weight", sd[hfp + "output.dense.weight"].T)
+        put(f"{our}_ffn_out_bias", sd[hfp + "output.dense.bias"])
+        put(f"{our}_ln2_scale", sd[hfp + "output.LayerNorm.weight"])
+        put(f"{our}_ln2_bias", sd[hfp + "output.LayerNorm.bias"])
+    put(f"{name}_pooler_weight", sd["pooler.dense.weight"].T)
+    put(f"{name}_pooler_bias", sd["pooler.dense.bias"])
+
+    ids_v = rng.integers(0, 100, (B, S))
+    tok_v = rng.integers(0, 2, (B, S))
+    mask_v = np.ones((B, S), np.float32)
+    mask_v[0, S // 2:] = 0.0   # real padding in one row
+    got_seq, got_pool = ex.run(
+        feed_dict={ids: ids_v, tok: tok_v, am: mask_v},
+        convert_to_numpy_ret_vals=True)
+
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids_v),
+                 token_type_ids=torch.from_numpy(tok_v),
+                 attention_mask=torch.from_numpy(mask_v))
+    np.testing.assert_allclose(got_seq, _t2n(out.last_hidden_state),
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(got_pool, _t2n(out.pooler_output),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_adam_training_curve_matches_torch(rng):
+    """10 Adam steps on the same tiny regression problem from identical
+    init: loss sequences must track (reference loss-parity harness)."""
+    X = rng.standard_normal((32, 8)).astype(np.float32)
+    Y = rng.standard_normal((32, 1)).astype(np.float32)
+    W0 = rng.standard_normal((8, 1)).astype(np.float32) * 0.3
+
+    # ours
+    x = ht.placeholder_op("ad_x", X.shape)
+    y = ht.placeholder_op("ad_y", Y.shape)
+    w = ht.Variable("ad_w", value=W0.copy())
+    loss = ht.mse_loss_op(ht.matmul_op(x, w), y)
+    ex = ht.Executor([loss, ht.AdamOptimizer(0.05).minimize(loss)])
+    ours = [float(ex.run(feed_dict={x: X, y: Y},
+                         convert_to_numpy_ret_vals=True)[0])
+            for _ in range(10)]
+
+    # torch
+    wt = torch.nn.Parameter(torch.from_numpy(W0.copy()))
+    opt = torch.optim.Adam([wt], lr=0.05)
+    theirs = []
+    for _ in range(10):
+        opt.zero_grad()
+        li = torch.nn.functional.mse_loss(torch.from_numpy(X) @ wt,
+                                          torch.from_numpy(Y))
+        li.backward()
+        opt.step()
+        theirs.append(float(li))
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
