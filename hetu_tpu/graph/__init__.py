@@ -3,3 +3,4 @@ from .node import (Op, PlaceholderOp, VariableOp, find_topo_sort,
 from .trace import TraceContext, evaluate
 from .autodiff import gradients
 from .executor import Executor, SubExecutor
+from .checkpoint import save_sharded, load_sharded

@@ -52,6 +52,8 @@ class HashGate(BaseLayer):
     """Deterministic id-hash gate (reference HashGate.py).  Requires token
     ids passed to MoELayer.__call__."""
 
+    has_aux = False   # routing is deterministic: no balance loss
+
     def __init__(self, num_experts, name=None):
         self.num_experts = num_experts
         self.wg = None
@@ -92,6 +94,8 @@ class SAMGate(BaseLayer):
 class BalanceGate(BaseLayer):
     """BASE-layer gate (reference BalanceGate.py): balanced assignment
     against fixed orthogonal expert centroids, sigmoid combine."""
+
+    has_aux = False   # assignment is balanced by construction
 
     def __init__(self, hidden_size, num_experts, seed=0, name=None):
         name = fresh_name(name or "balance_gate")
@@ -174,9 +178,14 @@ class MoEAuxLossOp(Op):
         self.moe = moe_op
 
     def _compute(self, input_vals, ctx):
-        # recompute gating aux (cheap; CSE merges with the MoE op's gating)
+        # recompute gating aux (CSE merges with the MoE op's gating when
+        # jitted together)
         import jax.numpy as jnp
         x, _, _, _, _, wg, ids = self.moe._unpack(input_vals)
+        if not getattr(self.moe.gate, "has_aux", True):
+            # hash/balance gates have identically-zero aux: skip the
+            # dispatch recompute entirely
+            return jnp.asarray(0.0, x.dtype)
         tokens = x.reshape(-1, x.shape[-1])
         _, _, aux = self.moe.gate.gating(
             tokens, wg, ids, self.moe.k, self.moe._capacity(tokens.shape[0]))

@@ -8,6 +8,7 @@ from .hetpipe import HetPipeTrainer, DenseParamStore
 from .context_parallel import (ring_attention, ulysses_attention,
                                ring_attention_shard, ulysses_attention_shard)
 from . import collectives
+from . import debug
 from .search import (OptCNNSearch, FlexFlowSearch, GPipeSearch,
                      PipeDreamSearch, PipeOptSearch, SearchedStrategy,
                      partition_stages)

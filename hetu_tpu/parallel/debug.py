@@ -1,0 +1,77 @@
+"""Distributed debugging: replica-consistency / collective-desync checks.
+
+The reference has no sanitizers (SURVEY.md §5: stream-event discipline +
+NCCL group calls are trusted); desync between data-parallel replicas (from
+non-deterministic host input, stray RNG, or a missed grad sync) shows up
+only as silent divergence.  These utilities make that failure loud:
+
+  * `replica_divergence(arr)` — host-side: max |shard - shard0| across the
+    addressable copies of a replicated jax.Array.
+  * `check_params_replicated(executor)` — sweep every parameter.
+  * `equal_across(x, axis)` — in-program (shard_map): max deviation of x
+    from the mesh-axis mean; jit-friendly, psum-based, usable as an
+    assertion signal every N steps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def replica_divergence(arr):
+    """Max abs difference across the addressable replicas of ``arr``.
+
+    0.0 for a consistent replicated array; for a sharded-only array the
+    comparison covers replicas within each shard index (none → 0.0).
+    """
+    arr = jax.device_put(arr) if not hasattr(arr, "addressable_shards") \
+        else arr
+    by_index = {}
+    for s in arr.addressable_shards:
+        by_index.setdefault(tuple((sl.start, sl.stop)
+                                  for sl in s.index), []).append(
+            np.asarray(s.data))
+    worst = 0.0
+    for copies in by_index.values():
+        base = copies[0]
+        for other in copies[1:]:
+            worst = max(worst, float(np.max(np.abs(base - other))))
+    return worst
+
+
+def check_params_replicated(executor, tol=0.0):
+    """Verify every executor parameter's replicas agree (a diverged DP
+    replica means a missed grad sync or nondeterministic input).  Returns
+    {name: divergence} for offenders; empty dict == consistent."""
+    bad = {}
+    for name, value in executor.params.items():
+        d = replica_divergence(value)
+        if d > tol:
+            bad[name] = d
+    return bad
+
+
+def equal_across(x, axis_name):
+    """Inside shard_map: max |x - mean_over_axis(x)| (0 ⇔ all members
+    identical).  Use as a cheap desync canary on grads/params:
+
+        dev = equal_across(grads_leaf, 'dp')
+        # host side: assert float(dev) < 1e-6
+    """
+    n = lax.psum(jnp.ones((), x.dtype), axis_name)
+    mean = lax.psum(x, axis_name) / n
+    return lax.pmax(jnp.max(jnp.abs(x - mean)), axis_name)
+
+
+def fingerprint(tree):
+    """Order-stable scalar fingerprint of a pytree: sum of float64 sums,
+    accumulated on the host (jax defaults to 32-bit; f32 sums over
+    millions of weights wash out exactly the small divergences this exists
+    to catch).  Compare across hosts/steps to detect desync cheaply."""
+    total = np.float64(0.0)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += np.asarray(leaf, dtype=np.float64).sum()
+    return float(total)
