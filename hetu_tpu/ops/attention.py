@@ -28,9 +28,12 @@ def _use_flash(q):
         platform = jax.devices()[0].platform
     except Exception:
         return False
+    # cheap pre-filter only; pallas.flash_attention._supported is the
+    # authoritative gate (it additionally requires seq % 256 == 0 and
+    # returns None on rejection, which we handle below)
     return (platform in ("tpu", "axon")
             and q.shape[-2] >= _FLASH_MIN_SEQ
-            and q.shape[-1] in (64, 128, 256))
+            and 32 <= q.shape[-1] <= 512 and q.shape[-1] % 8 == 0)
 
 
 class ScaledDotProductAttentionOp(Op):

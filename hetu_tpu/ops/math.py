@@ -88,3 +88,6 @@ zeroslike_op = simple_op(jnp.zeros_like, "zeros_like")
 oneslike_op = simple_op(jnp.ones_like, "ones_like")
 
 cast_op = simple_op(lambda a, dtype=jnp.float32: a.astype(dtype), "cast")
+# const^x elementwise (reference ConstPow.py)
+const_pow_op = simple_op(
+    lambda a, const=2.0: jnp.power(const, a), "const_pow")

@@ -210,3 +210,57 @@ def dropout2d_op(x, keep_prob=0.9, name=None):
             return jnp.where(mask, x / self.keep_prob, 0.0).astype(x.dtype)
 
     return Dropout2dOp(x, keep_prob=keep_prob, name=name)
+
+
+class RandomSampleOp(Op):
+    """Source RNG ops (reference gpu_ops/Rand.py, Sample.py,
+    src/ops/Initializers.cu): uniform / normal / gumbel draws as graph
+    nodes, keyed by the trace's per-op counter-based RNG so autodiff
+    re-traces see identical draws."""
+
+    def __init__(self, shape, dist="normal", low=0.0, high=1.0, mean=0.0,
+                 stddev=1.0, dtype=jnp.float32, name=None):
+        assert dist in ("normal", "uniform", "gumbel", "randint")
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+        self.dist = dist
+        self.low, self.high = low, high
+        self.mean, self.stddev = mean, stddev
+        self.dtype = dtype
+
+    @property
+    def needs_rng(self):
+        return True
+
+    def _compute(self, input_vals, ctx):
+        key = ctx.rng_for(self)
+        if self.dist == "normal":
+            return (self.mean + self.stddev
+                    * jax.random.normal(key, self.shape, self.dtype))
+        if self.dist == "uniform":
+            return jax.random.uniform(key, self.shape, self.dtype,
+                                      self.low, self.high)
+        if self.dist == "randint":
+            dt = (jnp.int32 if self.dtype in (jnp.float32, None)
+                  else self.dtype)
+            return jax.random.randint(key, self.shape, int(self.low),
+                                      int(self.high), dt)
+        u = jax.random.uniform(key, self.shape, self.dtype, 1e-20, 1.0)
+        return -jnp.log(-jnp.log(u))
+
+
+def random_normal_op(shape, mean=0.0, stddev=1.0, name=None):
+    return RandomSampleOp(shape, "normal", mean=mean, stddev=stddev,
+                          name=name)
+
+
+def random_uniform_op(shape, low=0.0, high=1.0, name=None):
+    return RandomSampleOp(shape, "uniform", low=low, high=high, name=name)
+
+
+def gumbel_sample_op(shape, name=None):
+    return RandomSampleOp(shape, "gumbel", name=name)
+
+
+def randint_sample_op(shape, low, high, name=None):
+    return RandomSampleOp(shape, "randint", low=low, high=high, name=name)

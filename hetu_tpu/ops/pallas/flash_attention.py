@@ -17,9 +17,10 @@ softmax, so HBM traffic stays O(S·d):
             sums so O = dropout(softmax(S))·V exactly.
 
 Supported: additive key mask [B, 1, 1, S] (BERT padding masks), causal,
-d ∈ {64, 128, 256}, seq a multiple of the 256 block.  Returns None for
-unsupported shapes so callers fall back to the jnp composition
-(ops/attention.py).
+8-aligned head dims in [32, 512] (64/128/256 tile the MXU exactly; others
+like GPT-2.7B's d=80 pad lanes but still beat the O(S^2) path), seq a
+multiple of the 256 block.  Returns None for unsupported shapes so callers
+fall back to the jnp composition (ops/attention.py).
 """
 
 from __future__ import annotations
@@ -47,7 +48,10 @@ def _supported(q, k, v, mask):
     if q.ndim != 4 or k.shape != q.shape or v.shape != q.shape:
         return False
     b, h, s, d = q.shape
-    if d not in (64, 128, 256):
+    # head dim is always the FULL last block dim, so Mosaic only needs it
+    # 8-aligned; 64/128/256 tile the MXU perfectly, others (80, 96, ...)
+    # pad lanes but still beat the O(S^2) jnp path at long seq
+    if d % 8 or d < 32 or d > 512:
         return False
     if s % _BLOCK_Q or s % _BLOCK_K:
         return False

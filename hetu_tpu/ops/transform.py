@@ -94,6 +94,40 @@ def _slice_by_matrix(a, idx0, idx1):
 
 
 slice_by_matrix_op = simple_op(_slice_by_matrix, "slice_by_matrix")
+argsort_op = simple_op(
+    lambda a, dim=-1, descending=False:
+        jnp.argsort(a, axis=dim, descending=descending),
+    "argsort")
+
+
+def _sparse_set(table, ids, values):
+    """table[ids] = values (reference SparseSet.py / gpu sparse_set)."""
+    ids = ids.reshape(-1).astype(jnp.int32)
+    vals = values.reshape((ids.shape[0],) + table.shape[1:])
+    return table.at[ids].set(vals.astype(table.dtype))
+
+
+sparse_set_op = simple_op(_sparse_set, "sparse_set")
+
+
+def _unique(a, size=None, fill_value=-1):
+    """Static-size unique (reference UniqueIndices.cu); pads with
+    fill_value.  `size` is required under jit (static shapes)."""
+    if size is None:
+        raise ValueError("unique_op requires size= (static output length)")
+    return jnp.unique(a.reshape(-1), size=size, fill_value=fill_value)
+
+
+unique_op = simple_op(_unique, "unique")
+# source ops (no tensor inputs; reference Arange.py, Full.py)
+arange_op = simple_op(
+    lambda start=0, stop=None, step=1, dtype=jnp.float32:
+        jnp.arange(start, stop, step, dtype=dtype),
+    "arange")
+full_op = simple_op(
+    lambda shape=None, fill_value=0.0, dtype=jnp.float32:
+        jnp.full(shape, fill_value, dtype=dtype),
+    "full")
 # reshape a to b's shape (reference gpu_ops/Reshape.py reshape_to_op)
 reshape_to_op = simple_op(lambda a, b: jnp.reshape(a, b.shape), "reshape_to")
 stop_gradient_op = simple_op(jax.lax.stop_gradient, "stop_gradient")

@@ -61,9 +61,12 @@ def equal_across(x, axis_name):
         dev = equal_across(grads_leaf, 'dp')
         # host side: assert float(dev) < 1e-6
     """
-    n = lax.psum(jnp.ones((), x.dtype), axis_name)
-    mean = lax.psum(x, axis_name) / n
-    return lax.pmax(jnp.max(jnp.abs(x - mean)), axis_name)
+    # upcast: in bf16, divergences below ~8e-3 relative would round to
+    # zero in the psum — the exact signal this canary exists to catch
+    xf = x.astype(jnp.float32)
+    n = lax.psum(jnp.ones((), jnp.float32), axis_name)
+    mean = lax.psum(xf, axis_name) / n
+    return lax.pmax(jnp.max(jnp.abs(xf - mean)), axis_name)
 
 
 def fingerprint(tree):

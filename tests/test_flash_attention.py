@@ -76,9 +76,13 @@ def test_unsupported_shapes_fall_back(rng):
     # seq not a block multiple -> None (caller takes the jnp path)
     q = jnp.zeros((1, 2, 100, 64))
     assert flash_attention(q, q, q) is None
-    # head dim not MXU-friendly
-    q = jnp.zeros((1, 2, 256, 48))
+    # head dim not 8-aligned
+    q = jnp.zeros((1, 2, 256, 44))
     assert flash_attention(q, q, q) is None
+    # 8-aligned but non-power-of-two head dims ARE supported (e.g. GPT-2.7B
+    # uses d=80); on CPU this runs in interpret mode
+    q = jnp.zeros((1, 2, 256, 80))
+    assert flash_attention(q, q, q) is not None
     # full [B,1,S,S] masks unsupported
     q = jnp.zeros((1, 2, 256, 64))
     m = jnp.zeros((1, 1, 256, 256))

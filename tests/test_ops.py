@@ -216,3 +216,61 @@ def test_reduce_indexedslices():
     uniq, summed = reduce_indexedslices(ids, vals, 6)
     got = {int(u): float(s) for u, s in zip(uniq, summed[:, 0]) if u >= 0}
     assert got == {1: 7.0, 2: 4.0, 3: 10.0}
+
+
+# -- ops added for full reference coverage (Arange/Argsort/SparseSet/...) --
+
+def test_argsort_sparse_set_unique(rng):
+    a = rng.standard_normal((4, 6)).astype(np.float32)
+    x = ht.placeholder_op("aux_x", a.shape)
+    outs = [ht.argsort_op(x, dim=1),
+            ht.argsort_op(x, dim=1, descending=True)]
+    ex = ht.Executor(outs)
+    asc, desc = ex.run(feed_dict={x: a}, convert_to_numpy_ret_vals=True)
+    np.testing.assert_array_equal(asc, np.argsort(a, axis=1))
+    np.testing.assert_array_equal(desc, np.argsort(-a, axis=1))
+
+    table = rng.standard_normal((8, 3)).astype(np.float32)
+    t = ht.placeholder_op("aux_t", table.shape)
+    ids = ht.placeholder_op("aux_i", (2,), dtype=np.int32)
+    vals = ht.placeholder_op("aux_v", (2, 3))
+    ex2 = ht.Executor([ht.sparse_set_op(t, ids, vals)])
+    ids_v = np.array([1, 5])
+    vals_v = np.ones((2, 3), np.float32)
+    (out,) = ex2.run(feed_dict={t: table, ids: ids_v, vals: vals_v},
+                     convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(out[[1, 5]], 1.0)
+    np.testing.assert_allclose(out[[0, 2]], table[[0, 2]])
+
+    u = ht.placeholder_op("aux_u", (6,), dtype=np.int32)
+    ex3 = ht.Executor([ht.unique_op(u, size=6)])
+    (uu,) = ex3.run(feed_dict={u: np.array([3, 1, 3, 2, 1, 9])},
+                    convert_to_numpy_ret_vals=True)
+    assert set(uu.tolist()) >= {1, 2, 3, 9}
+
+
+def test_source_ops_and_constpow(rng):
+    x = ht.placeholder_op("cp_x", (3,))
+    outs = [ht.arange_op(start=0, stop=5, dtype=np.int32),
+            ht.full_op(shape=(2, 2), fill_value=7.0),
+            ht.const_pow_op(x, const=2.0)]
+    ex = ht.Executor(outs)
+    ar, fl, cp = ex.run(feed_dict={x: np.array([0.0, 1.0, 3.0],
+                                               np.float32)},
+                        convert_to_numpy_ret_vals=True)
+    np.testing.assert_array_equal(ar, np.arange(5))
+    np.testing.assert_allclose(fl, 7.0)
+    np.testing.assert_allclose(cp, [1.0, 2.0, 8.0])
+
+
+def test_random_sample_ops(rng):
+    outs = [ht.random_normal_op((2000,), mean=1.0, stddev=2.0),
+            ht.random_uniform_op((2000,), low=-1.0, high=1.0),
+            ht.gumbel_sample_op((2000,)),
+            ht.randint_sample_op((2000,), 0, 10)]
+    ex = ht.Executor(outs)
+    n, u, g, ri = ex.run(feed_dict={}, convert_to_numpy_ret_vals=True)
+    assert abs(n.mean() - 1.0) < 0.2 and abs(n.std() - 2.0) < 0.2
+    assert u.min() >= -1.0 and u.max() <= 1.0 and abs(u.mean()) < 0.1
+    assert abs(g.mean() - 0.5772) < 0.15          # Euler-Mascheroni
+    assert ri.min() >= 0 and ri.max() <= 9
