@@ -148,3 +148,7 @@ def make_compressed_embedding(method, num_embeddings, embedding_dim,
                             batch_size, name=name)
     raise ValueError(f"unknown compression method {method!r}; "
                      f"choose from {METHODS}")
+
+
+from .multi_field import MultiFieldCompressedEmbedding  # noqa: E402 (needs
+# make_compressed_embedding above)

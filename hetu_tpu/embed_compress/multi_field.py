@@ -1,0 +1,102 @@
+"""Per-field ("use_multi") compression.
+
+The reference schedulers' use_multi mode (scheduler/base.py:51,
+scheduler/hash.py etc.) builds ONE embedding per sparse field: fields with
+more rows than a threshold get the compressed variant, small fields keep a
+plain table — compression where it pays, exactness where it's cheap.  The
+memory budget solvers (planner.py) already understand per-field sizes
+(qr_sizes/tt_rank multi_evaluate); this module assembles the layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import make_compressed_embedding
+from .layers import CompressedEmbedding
+from ..graph.node import VariableOp
+from ..ops import concatenate_op, array_reshape_op, split_op
+
+
+def param_elements(obj, _seen=None):
+    """Total stored elements across every Variable reachable from a layer
+    (recursive attribute walk) — the unit the compress-rate budget is
+    denominated in.  Counts non-trainable state too (remaps, codebooks)."""
+    _seen = _seen if _seen is not None else set()
+    if id(obj) in _seen:
+        return 0
+    _seen.add(id(obj))
+    if isinstance(obj, VariableOp):
+        total = 1
+        for s in obj.shape:
+            total *= int(s)
+        return total
+    if isinstance(obj, (list, tuple)):
+        return sum(param_elements(v, _seen) for v in obj)
+    if isinstance(obj, dict):
+        return sum(param_elements(v, _seen) for v in obj.values())
+    if hasattr(obj, "__dict__"):
+        return sum(param_elements(v, _seen)
+                   for v in vars(obj).values())
+    return 0
+
+
+class MultiFieldCompressedEmbedding:
+    """One (possibly compressed) embedding per field; ids [B, F] ->
+    [B, F, D].
+
+    ``num_embed_separate``: rows per field (reference
+    dataset.num_embed_separate — Criteo's 26 sparse fields range from 10s
+    to millions of ids).  Fields with rows > ``threshold`` use ``method``
+    at ``compress_rate``; the rest keep full tables.  Per-field id spaces
+    are LOCAL (0..rows_f), as in the reference's separate_fields mode.
+    """
+
+    def __init__(self, method, num_embed_separate, embedding_dim,
+                 compress_rate=0.25, threshold=10000, batch_size=None,
+                 frequencies_separate=None, rng=None, name="multi_emb",
+                 **kwargs):
+        self.num_embed_separate = list(num_embed_separate)
+        self.num_fields = len(self.num_embed_separate)
+        self.embedding_dim = embedding_dim
+        self.fields = []
+        rng = rng or np.random.default_rng(0)
+        for f, rows in enumerate(self.num_embed_separate):
+            freq = (frequencies_separate[f]
+                    if frequencies_separate is not None else None)
+            if rows > threshold:
+                layer = make_compressed_embedding(
+                    method, rows, embedding_dim,
+                    compress_rate=compress_rate, batch_size=batch_size,
+                    num_slot=1, frequencies=freq, rng=rng,
+                    name=f"{name}_f{f}_{method}", **kwargs)
+            else:
+                layer = CompressedEmbedding(rows, embedding_dim,
+                                            name=f"{name}_f{f}_full")
+            self.fields.append(layer)
+
+    def memory_elements(self):
+        """Actual stored elements per field (method-agnostic: counts every
+        Variable the field's layer holds, incl. MLP decoders and
+        codebooks) — compare against rows * embedding_dim."""
+        return [param_elements(layer) for layer in self.fields]
+
+    def __call__(self, ids):
+        """ids [B, F] (field-local) -> [B, F, D]."""
+        outs = []
+        for f, layer in enumerate(self.fields):
+            col = split_op(ids, axes=1, indices=f, splits=self.num_fields)
+            e = layer(col)                       # [B, 1, D] or [B*1, D]
+            outs.append(array_reshape_op(
+                e, output_shape=(-1, 1, self.embedding_dim)))
+        return concatenate_op(outs, axis=1)
+
+    def extra_loss(self):
+        terms = [f.extra_loss() for f in self.fields]
+        terms = [t for t in terms if t is not None]
+        if not terms:
+            return None
+        total = terms[0]
+        for t in terms[1:]:
+            total = total + t
+        return total

@@ -307,3 +307,37 @@ def test_pep_export_roundtrip(rng):
     (v,) = ex2.run(feed_dict={ids5: rng.integers(0, NEMB, (BS, NSLOT))},
                    convert_to_numpy_ret_vals=True)
     assert v.shape == (BS, NSLOT, DIM)
+
+
+def test_multi_field_compression(rng):
+    """Per-field (use_multi) mode: big fields compressed, small kept full;
+    trains end-to-end (reference scheduler use_multi path)."""
+    from hetu_tpu.embed_compress import MultiFieldCompressedEmbedding
+    rows = [50, 20000, 120, 45000]     # two small, two big
+    D, B = 8, 16
+    layer = MultiFieldCompressedEmbedding(
+        "hash", rows, D, compress_rate=0.1, threshold=10000,
+        batch_size=B, rng=rng)
+    mem = layer.memory_elements()
+    D_ = 8
+    assert mem[0] == 50 * D_ and mem[2] == 120 * D_   # small fields full
+    # big fields compressed to ~10% of rows*D
+    assert mem[1] <= 20000 * D_ * 0.1 + D_
+    assert mem[3] <= 45000 * D_ * 0.1 + D_
+    ids = ht.placeholder_op("mf_ids", (B, 4), dtype=np.int32)
+    labels = ht.placeholder_op("mf_y", (B,))
+    emb = layer(ids)
+    flat = ht.array_reshape_op(emb, output_shape=(B, 4 * D))
+    w = ht.Variable("mf_w", shape=(4 * D, 1),
+                    initializer=ht.init.xavier_normal())
+    logits = ht.array_reshape_op(ht.matmul_op(flat, w), output_shape=(B,))
+    loss = ht.reduce_mean_op(
+        ht.binarycrossentropywithlogits_op(logits, labels))
+    ex = ht.Executor({"train": [loss,
+                                ht.SGDOptimizer(0.1).minimize(loss)]})
+    ids_v = np.stack([rng.integers(0, r, (B,)) for r in rows], axis=1)
+    y = rng.integers(0, 2, (B,)).astype(np.float32)
+    ls = [float(ex.run("train", feed_dict={ids: ids_v, labels: y},
+                       convert_to_numpy_ret_vals=True)[0])
+          for _ in range(8)]
+    assert ls[-1] < ls[0]
