@@ -5,3 +5,4 @@ from .gpt import GPTConfig, GPTModel, GPTLMHeadModel, GPT_CONFIGS
 from .ctr import WDL, DeepFM, DCN, DLRM
 from .gnn import (DistGCN15D, GCNLayerOp, distgcn_15d_op, gcn_conv_op,
                   normalized_adjacency)
+from .hf_import import load_hf_bert_weights, load_hf_gpt2_weights

@@ -1,0 +1,118 @@
+"""Import huggingface BERT weights into a hetu_tpu BertModel.
+
+The reference's migration story for pretrained weights is its ONNX bridge
+plus per-example conversion scripts (examples/nlp/bert load paths); for
+modern checkpoints the lingua franca is huggingface.  This mapping is
+validated bit-tight (5e-4) by tests/test_torch_parity.py.
+
+Usage:
+    model = BertModel(cfg, name="bert")
+    ex = ht.Executor([...])
+    load_hf_bert_weights(ex, model, hf_state_dict, name="bert")
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _put(params, name, value):
+    if name not in params:
+        raise KeyError(f"no variable {name!r} in executor params")
+    value = np.asarray(value)
+    if tuple(params[name].shape) != tuple(value.shape):
+        raise ValueError(f"{name}: shape {params[name].shape} vs "
+                         f"checkpoint {value.shape}")
+    params[name] = jnp.asarray(value, dtype=params[name].dtype)
+
+
+def load_hf_bert_weights(executor, model, state_dict, name="bert"):
+    """Copy a transformers.BertModel state_dict into the executor.
+
+    ``state_dict`` values may be torch tensors or numpy arrays.  torch
+    Linear stores (out, in); our linear computes x @ w, so weights are
+    transposed on the way in.
+    """
+    sd = {}
+    for k, v in state_dict.items():
+        sd[k] = v.detach().cpu().numpy() if hasattr(v, "detach") else \
+            np.asarray(v)
+    p = executor.params
+    e = f"{name}_embeddings"
+    _put(p, f"{e}_word_table", sd["embeddings.word_embeddings.weight"])
+    _put(p, f"{e}_position", sd["embeddings.position_embeddings.weight"])
+    _put(p, f"{e}_tok_type_table",
+         sd["embeddings.token_type_embeddings.weight"])
+    _put(p, f"{e}_ln_scale", sd["embeddings.LayerNorm.weight"])
+    _put(p, f"{e}_ln_bias", sd["embeddings.LayerNorm.bias"])
+    for i in range(model.config.num_hidden_layers):
+        hf = f"encoder.layer.{i}."
+        our = f"{name}_layer{i}"
+        for proj, hname in (("q", "attention.self.query"),
+                            ("k", "attention.self.key"),
+                            ("v", "attention.self.value"),
+                            ("out", "attention.output.dense")):
+            _put(p, f"{our}_attn_{proj}_weight",
+                 sd[hf + hname + ".weight"].T)
+            _put(p, f"{our}_attn_{proj}_bias", sd[hf + hname + ".bias"])
+        _put(p, f"{our}_ln1_scale",
+             sd[hf + "attention.output.LayerNorm.weight"])
+        _put(p, f"{our}_ln1_bias",
+             sd[hf + "attention.output.LayerNorm.bias"])
+        _put(p, f"{our}_ffn_in_weight",
+             sd[hf + "intermediate.dense.weight"].T)
+        _put(p, f"{our}_ffn_in_bias", sd[hf + "intermediate.dense.bias"])
+        _put(p, f"{our}_ffn_out_weight", sd[hf + "output.dense.weight"].T)
+        _put(p, f"{our}_ffn_out_bias", sd[hf + "output.dense.bias"])
+        _put(p, f"{our}_ln2_scale", sd[hf + "output.LayerNorm.weight"])
+        _put(p, f"{our}_ln2_bias", sd[hf + "output.LayerNorm.bias"])
+    if "pooler.dense.weight" in sd:
+        _put(p, f"{name}_pooler_weight", sd["pooler.dense.weight"].T)
+        _put(p, f"{name}_pooler_bias", sd["pooler.dense.bias"])
+    else:
+        import warnings
+        warnings.warn(
+            f"checkpoint has no pooler weights; {name}'s pooler stays "
+            f"randomly initialized (checkpoint saved with "
+            f"add_pooling_layer=False?)", stacklevel=2)
+    return executor
+
+
+def load_hf_gpt2_weights(executor, model, state_dict, name="gpt"):
+    """Copy a transformers.GPT2Model state_dict into a GPTModel.
+
+    GPT-2 convs (Conv1D) already store (in, out) — no transpose.  Works
+    when the architectures align (pre-LN blocks, learned positions).
+    """
+    sd = {}
+    for k, v in state_dict.items():
+        sd[k] = v.detach().cpu().numpy() if hasattr(v, "detach") else \
+            np.asarray(v)
+    p = executor.params
+    H = model.config.hidden_size
+    _put(p, f"{name}_wte_table", sd["wte.weight"])
+    # our learned positions cover seq_len rows; HF ships max_positions
+    _put(p, f"{name}_wpe", sd["wpe.weight"][:model.config.seq_len])
+    for i in range(model.config.num_layers):
+        hf = f"h.{i}."
+        our = f"{name}_h{i}"
+        qkv_w = sd[hf + "attn.c_attn.weight"]          # (H, 3H)
+        qkv_b = sd[hf + "attn.c_attn.bias"]
+        for j, proj in enumerate(("q", "k", "v")):
+            _put(p, f"{our}_attn_{proj}_weight",
+                 qkv_w[:, j * H:(j + 1) * H])
+            _put(p, f"{our}_attn_{proj}_bias", qkv_b[j * H:(j + 1) * H])
+        _put(p, f"{our}_attn_out_weight", sd[hf + "attn.c_proj.weight"])
+        _put(p, f"{our}_attn_out_bias", sd[hf + "attn.c_proj.bias"])
+        _put(p, f"{our}_ln1_scale", sd[hf + "ln_1.weight"])
+        _put(p, f"{our}_ln1_bias", sd[hf + "ln_1.bias"])
+        _put(p, f"{our}_ffn_in_weight", sd[hf + "mlp.c_fc.weight"])
+        _put(p, f"{our}_ffn_in_bias", sd[hf + "mlp.c_fc.bias"])
+        _put(p, f"{our}_ffn_out_weight", sd[hf + "mlp.c_proj.weight"])
+        _put(p, f"{our}_ffn_out_bias", sd[hf + "mlp.c_proj.bias"])
+        _put(p, f"{our}_ln2_scale", sd[hf + "ln_2.weight"])
+        _put(p, f"{our}_ln2_bias", sd[hf + "ln_2.bias"])
+    _put(p, f"{name}_ln_f_scale", sd["ln_f.weight"])
+    _put(p, f"{name}_ln_f_bias", sd["ln_f.bias"])
+    return executor

@@ -104,40 +104,8 @@ def test_tiny_bert_matches_huggingface(rng):
     seq_out, pooled = model(ids, tok, attention_mask=am)
     ex = ht.Executor([seq_out, pooled])
 
-    p = ex.params
-
-    def put(nm, value):
-        assert nm in p, nm
-        assert p[nm].shape == tuple(value.shape), (nm, p[nm].shape,
-                                                   value.shape)
-        p[nm] = jnp.asarray(value)
-
-    sd = {k: _t2n(v) for k, v in hf.state_dict().items()}
-    e = f"{name}_embeddings"
-    put(f"{e}_word_table", sd["embeddings.word_embeddings.weight"])
-    put(f"{e}_position", sd["embeddings.position_embeddings.weight"])
-    put(f"{e}_tok_type_table", sd["embeddings.token_type_embeddings.weight"])
-    put(f"{e}_ln_scale", sd["embeddings.LayerNorm.weight"])
-    put(f"{e}_ln_bias", sd["embeddings.LayerNorm.bias"])
-    for i in range(c.num_hidden_layers):
-        hfp = f"encoder.layer.{i}."
-        our = f"{name}_layer{i}"
-        for proj, hname in (("q", "attention.self.query"),
-                            ("k", "attention.self.key"),
-                            ("v", "attention.self.value"),
-                            ("out", "attention.output.dense")):
-            put(f"{our}_attn_{proj}_weight", sd[hfp + hname + ".weight"].T)
-            put(f"{our}_attn_{proj}_bias", sd[hfp + hname + ".bias"])
-        put(f"{our}_ln1_scale", sd[hfp + "attention.output.LayerNorm.weight"])
-        put(f"{our}_ln1_bias", sd[hfp + "attention.output.LayerNorm.bias"])
-        put(f"{our}_ffn_in_weight", sd[hfp + "intermediate.dense.weight"].T)
-        put(f"{our}_ffn_in_bias", sd[hfp + "intermediate.dense.bias"])
-        put(f"{our}_ffn_out_weight", sd[hfp + "output.dense.weight"].T)
-        put(f"{our}_ffn_out_bias", sd[hfp + "output.dense.bias"])
-        put(f"{our}_ln2_scale", sd[hfp + "output.LayerNorm.weight"])
-        put(f"{our}_ln2_bias", sd[hfp + "output.LayerNorm.bias"])
-    put(f"{name}_pooler_weight", sd["pooler.dense.weight"].T)
-    put(f"{name}_pooler_bias", sd["pooler.dense.bias"])
+    from hetu_tpu.models.hf_import import load_hf_bert_weights
+    load_hf_bert_weights(ex, model, hf.state_dict(), name=name)
 
     ids_v = rng.integers(0, 100, (B, S))
     tok_v = rng.integers(0, 2, (B, S))
@@ -186,3 +154,32 @@ def test_adam_training_curve_matches_torch(rng):
         opt.step()
         theirs.append(float(li))
     np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_tiny_gpt2_matches_huggingface(rng):
+    """GPTModel forward vs transformers.GPT2Model with imported weights."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import GPTConfig, GPTModel
+    from hetu_tpu.models.hf_import import load_hf_gpt2_weights
+
+    B, S = 2, 16
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=100, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        activation_function="gelu_new")
+    hf = transformers.GPT2Model(hf_cfg)
+    hf.eval()
+
+    c = GPTConfig(vocab_size=100, hidden_size=32, num_layers=2,
+                  num_heads=4, seq_len=S, dropout_prob=0.0)
+    model = GPTModel(c, name="gpt2parity")
+    ids = ht.placeholder_op("g2_ids", (B, S), dtype=np.int32)
+    out = model(ids)
+    ex = ht.Executor([out])
+    load_hf_gpt2_weights(ex, model, hf.state_dict(), name="gpt2parity")
+
+    ids_v = rng.integers(0, 100, (B, S))
+    (got,) = ex.run(feed_dict={ids: ids_v}, convert_to_numpy_ret_vals=True)
+    with torch.no_grad():
+        want = hf(input_ids=torch.from_numpy(ids_v)).last_hidden_state
+    np.testing.assert_allclose(got, _t2n(want), rtol=1e-3, atol=1e-3)
