@@ -188,6 +188,45 @@ class SubExecutor:
         jax.block_until_ready([o for o in out if o is not None])
         return (time.perf_counter() - start) / repeats
 
+    def cost_analysis(self, feed_dict=None):
+        """XLA's static cost model for the compiled step (flops, HBM
+        bytes accessed, ...) — the single-program analogue of the
+        reference's per-op timer_subexecutor breakdown: XLA has already
+        fused across op boundaries, so costs are whole-program.
+
+        Pure analysis: no step executes, no state mutates.  Feed shapes
+        come from ``feed_dict`` values when given, else from the
+        placeholders' declared shapes.
+        """
+        if self._jitted is None:
+            self._build()
+        ex = self.executor
+
+        def abstract(a):
+            return jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+
+        fed = {}
+        if feed_dict:
+            for node, value in feed_dict.items():
+                name = node.name if isinstance(node, Op) else node
+                fed[name] = value
+        feeds = {}
+        for p in self.placeholders:
+            if p.name in fed:
+                feeds[p.name] = jax.ShapeDtypeStruct(
+                    jnp.shape(fed[p.name]), p.dtype)
+            else:
+                assert p.shape is not None, \
+                    f"cost_analysis needs a feed or declared shape for " \
+                    f"{p.name}"
+                feeds[p.name] = jax.ShapeDtypeStruct(tuple(p.shape),
+                                                     p.dtype)
+        args = (jax.tree_util.tree_map(abstract, ex.params),
+                jax.tree_util.tree_map(abstract, ex.opt_state),
+                feeds,
+                jax.ShapeDtypeStruct((), ex._base_key.dtype))
+        return self._jitted.lower(*args).compile().cost_analysis()
+
 
 class Executor:
     """Multi-subgraph session (reference executor.py:430).

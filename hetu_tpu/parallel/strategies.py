@@ -30,6 +30,51 @@ class Strategy:
     def set_raw_ctxs_n_states(self, eval_nodes):
         return self.annotate(eval_nodes)
 
+    # -- config persistence (reference Strategy.save_json base.py:183) ----
+    def config(self):
+        """JSON-able constructor config (mesh stored as axis sizes).
+
+        Raises for strategies carrying non-scalar state (e.g. a searched
+        per-node assignment) — those need their own serializers rather
+        than silent data loss.
+        """
+        out = {"strategy": type(self).__name__}
+        for k, v in vars(self).items():
+            if k == "mesh":
+                out["mesh_axes"] = (dict(v.shape) if v is not None
+                                    else None)
+            elif isinstance(v, (int, float, str, bool, type(None))):
+                out[k] = v
+            else:
+                raise TypeError(
+                    f"{type(self).__name__}.{k} ({type(v).__name__}) is "
+                    f"not JSON-persistable; this strategy needs a custom "
+                    f"serializer")
+        return out
+
+    def save_json(self, path):
+        import json
+        with open(path, "w") as f:
+            json.dump(self.config(), f, indent=2)
+
+    @staticmethod
+    def load_json(path):
+        """Rebuild a strategy from a saved config (simple strategies)."""
+        import json
+        from . import strategies as S
+        with open(path) as f:
+            cfg = json.load(f)
+        name = cfg.pop("strategy")
+        cls = getattr(S, name, None)
+        if cls is None or not (isinstance(cls, type)
+                               and issubclass(cls, Strategy)):
+            raise ValueError(f"{name!r} is not a Strategy in "
+                             f"parallel.strategies")
+        mesh_axes = cfg.pop("mesh_axes", None)
+        if mesh_axes:
+            cfg["mesh"] = make_mesh(mesh_axes)
+        return cls(**cfg)
+
 
 class DataParallel(Strategy):
     """Batch-dim sharding over a 'dp' axis (reference simple.py:6).

@@ -174,3 +174,29 @@ def test_batchnorm_state_updates():
     rm = np.asarray(ex.params[y.running_mean.name])
     assert np.abs(rm).sum() > 0  # running stats moved
     np.testing.assert_allclose(rm, 0.1 * X.mean(axis=(0, 2, 3)), rtol=1e-4)
+
+
+def test_cost_analysis_reports_flops():
+    X = np.random.default_rng(0).standard_normal((32, 16)).astype(np.float32)
+    x = ht.placeholder_op("ca_x", X.shape)
+    w = ht.Variable("ca_w", shape=(16, 8), initializer=ht.init.zeros())
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w))
+    ex = ht.Executor({"train": [loss,
+                                ht.SGDOptimizer(0.1).minimize(loss)]})
+    step_before = ex._global_step
+    w0 = np.asarray(ex.params["ca_w"]).copy()
+    # pure analysis: works before any run, mutates nothing
+    cost = ex.subexecutor["train"].cost_analysis(feed_dict={x: X})
+    assert cost and float(cost.get("flops", 0)) > 0
+    assert ex._global_step == step_before
+    np.testing.assert_array_equal(np.asarray(ex.params["ca_w"]), w0)
+
+
+def test_strategy_json_roundtrip(tmp_path):
+    from hetu_tpu.parallel import DataParallel, MegatronLM, Strategy
+    for s in (DataParallel(ndev=8), MegatronLM(dp=2, tp=4)):
+        p = str(tmp_path / f"{type(s).__name__}.json")
+        s.save_json(p)
+        s2 = Strategy.load_json(p)
+        assert type(s2) is type(s)
+        assert dict(s2.mesh.shape) == dict(s.mesh.shape)
