@@ -21,7 +21,8 @@ from .base import BaseLayer, fresh_name
 from ..graph.node import Op, VariableOp
 from .. import initializers as init
 from ..ops.moe import (top_k_gating, hash_gating, ktop1_gating, sam_gating,
-                       base_balance_gating)
+                       base_balance_gating, top_k_balance_aux,
+                       ktop1_balance_aux, sam_balance_aux)
 
 
 def _orthogonal_rows(rng, rows, cols, gain=0.1):
@@ -46,6 +47,9 @@ class TopKGate(BaseLayer):
 
     def gating(self, tokens, wg, ids, k, capacity):
         return top_k_gating(tokens @ wg, k, capacity)
+
+    def aux(self, tokens, wg, ids, k):
+        return top_k_balance_aux(tokens @ wg)
 
 
 class HashGate(BaseLayer):
@@ -75,6 +79,9 @@ class KTop1Gate(BaseLayer):
     def gating(self, tokens, wg, ids, k, capacity):
         return ktop1_gating(tokens @ wg, k, capacity)
 
+    def aux(self, tokens, wg, ids, k):
+        return ktop1_balance_aux(tokens @ wg, k)
+
 
 class SAMGate(BaseLayer):
     """Switch-and-mix locality gate (reference SAMGate.py): pick the
@@ -89,6 +96,9 @@ class SAMGate(BaseLayer):
 
     def gating(self, tokens, wg, ids, k, capacity):
         return sam_gating(tokens @ wg, k, capacity, self.num_groups)
+
+    def aux(self, tokens, wg, ids, k):
+        return sam_balance_aux(tokens @ wg, self.num_groups)
 
 
 class BalanceGate(BaseLayer):
@@ -178,8 +188,10 @@ class MoEAuxLossOp(Op):
         self.moe = moe_op
 
     def _compute(self, input_vals, ctx):
-        # recompute gating aux (CSE merges with the MoE op's gating when
-        # jitted together)
+        # aux-only gate path: O(T·E) logits work, never the [T,E,C]
+        # dispatch/combine tensors — an aux evaluated in a separate
+        # subexecutor from the MoE op must not pay the full dispatch
+        # recompute (in the same jitted program, CSE merges it anyway)
         import jax.numpy as jnp
         x, _, _, _, _, wg, ids = self.moe._unpack(input_vals)
         if not getattr(self.moe.gate, "has_aux", True):
@@ -187,8 +199,16 @@ class MoEAuxLossOp(Op):
             # dispatch recompute entirely
             return jnp.asarray(0.0, x.dtype)
         tokens = x.reshape(-1, x.shape[-1])
-        _, _, aux = self.moe.gate.gating(
-            tokens, wg, ids, self.moe.k, self.moe._capacity(tokens.shape[0]))
+        aux_fn = getattr(self.moe.gate, "aux", None)
+        if aux_fn is not None:
+            aux = aux_fn(tokens, wg, ids, self.moe.k)
+        else:
+            # caller-built gate without the aux-only fast path: fall back
+            # to full gating (CSE removes the cost when jitted with the
+            # MoE op)
+            _, _, aux = self.moe.gate.gating(
+                tokens, wg, ids, self.moe.k,
+                self.moe._capacity(tokens.shape[0]))
         return jnp.asarray(aux, x.dtype)
 
 

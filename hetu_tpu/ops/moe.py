@@ -66,6 +66,50 @@ def top_k_gating(logits, k, capacity, *, second_renorm=True,
     return dispatch, combine, aux
 
 
+def top_k_balance_aux(logits):
+    """Just the GShard balance loss of ``top_k_gating`` — O(T·E), no
+    [T,E,C] dispatch/combine tensors (for aux evaluated in a separate
+    program from the MoE op, where CSE can't merge the gating)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    mask1 = jax.nn.one_hot(jnp.argmax(logits, axis=-1), E,
+                           dtype=probs.dtype)
+    return E * jnp.sum(jnp.mean(probs, axis=0) * jnp.mean(mask1, axis=0))
+
+
+def ktop1_balance_aux(logits, k):
+    """Just the per-prototype balance loss of ``ktop1_gating``."""
+    T, E = logits.shape
+    Ep = E // k
+    sub = logits.reshape(T, k, Ep)
+    probs = jax.nn.softmax(sub, axis=-1)
+    aux = 0.0
+    for i in range(k):
+        mask_local = jax.nn.one_hot(jnp.argmax(sub[:, i], axis=-1), Ep,
+                                    dtype=probs.dtype)
+        aux = aux + Ep * jnp.sum(jnp.mean(probs[:, i], axis=0)
+                                 * jnp.mean(mask_local, axis=0))
+    return aux
+
+
+def sam_balance_aux(logits, num_groups):
+    """Just the balance + group-alignment terms of ``sam_gating``."""
+    T, E = logits.shape
+    Eg = E // num_groups
+    probs = jax.nn.softmax(logits, axis=-1)
+    gidx = jnp.repeat(jnp.arange(num_groups), Eg)
+    gmass = sam_group_sum(probs.T, gidx, num_groups).T
+    top_group = jnp.argmax(gmass, axis=-1)
+    in_group = gidx[None, :] == top_group[:, None]
+    first_mask = jax.nn.one_hot(
+        jnp.argmax(jnp.where(in_group, logits, -jnp.inf), axis=-1), E,
+        dtype=probs.dtype)
+    balance = E * jnp.sum(jnp.mean(probs, axis=0)
+                          * jnp.mean(first_mask, axis=0))
+    alignment = jnp.mean(1.0 - jnp.max(gmass, axis=-1))
+    return balance + alignment
+
+
 def hash_gating(ids, num_experts, capacity, dtype=jnp.float32):
     """HashGate (reference layers/HashGate.py): expert = id % E, gate = 1."""
     T = ids.shape[0]

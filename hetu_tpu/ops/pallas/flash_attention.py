@@ -134,7 +134,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref, *,
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    # fully-masked rows (l == 0, every key at -inf): output is 0; store
+    # lse = +large so the backward's p = exp(s - lse) underflows to 0 —
+    # storing m (≈ -1e30) instead would give p = exp(0) = 1 everywhere
+    # and garbage dq/dk/dv for the row
+    lse = jnp.where(l == 0.0, -_NEG_INF, m + jnp.log(l_safe))
+    lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
 def _make_kern(base, has_mask, has_seed, n_out, **consts):

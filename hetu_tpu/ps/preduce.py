@@ -18,6 +18,8 @@ at full bandwidth, and non-members simply contribute zeros.
 from __future__ import annotations
 
 import ctypes
+import threading
+import time
 
 import numpy as np
 import jax.numpy as jnp
@@ -74,12 +76,73 @@ def masked_mean_allreduce(x, mask, axis_name="dp"):
     `mask` is [axis_size] data, so the same XLA program serves any group;
     equivalent to the reference's per-group ncclAvg without per-group
     communicator construction.
+
+    CONTRACT: exactly ONE group reduces per collective, and every rank on
+    the axis must pass the SAME canonical mask (non-members execute the
+    psum with the group's mask and discard the result).  If the
+    matchmaker split a round into disjoint groups, agree on one first —
+    ``PartialReduce.get_round_mask`` does the agreement.  As a safety
+    net the denominator is the psum of the per-rank membership bits (not
+    the host-side ``sum(mask)``), so numerator and denominator always
+    count the same set of contributors: masks that disagree across ranks
+    degrade to a well-defined mean over the union of self-declared
+    members instead of silently mixing one group's sum with another
+    group's count.
     """
     idx = lax.axis_index(axis_name)
-    mine = mask[idx]
+    mine = mask[idx].astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                            else jnp.float32)
     total = lax.psum(x * mine.astype(x.dtype), axis_name)
-    count = jnp.maximum(jnp.sum(mask), 1.0).astype(x.dtype)
-    return total / count
+    count = jnp.maximum(lax.psum(mine, axis_name), 1.0)
+    return total / count.astype(total.dtype)
+
+
+class _MaskAgreement:
+    """Per-round canonical-group agreement for the SPMD masked psum.
+
+    The matchmaker can split one round into disjoint groups (a straggler
+    missing the window forms its own), but the compiled program runs ONE
+    psum over the full axis per round — so all ranks must reduce with one
+    agreed mask.  Every rank reports its matched group; once all have
+    arrived, the canonical group is the one containing the lowest rank
+    (deterministic on every caller).  Members of other groups simply miss
+    the round, exactly like a straggler in the reference's NCCL-subgroup
+    design (preduce_handler.cc).
+    """
+
+    def __init__(self, nworkers):
+        self.nworkers = nworkers
+        self._cv = threading.Condition()
+        self._rounds = {}
+
+    def agree(self, round_id, rank, partner, timeout=60.0):
+        with self._cv:
+            slot = self._rounds.setdefault(round_id,
+                                           {"groups": {}, "reads": 0})
+            slot["groups"][rank] = tuple(sorted(partner))
+            self._cv.notify_all()
+            deadline = time.monotonic() + timeout
+            while len(slot["groups"]) < self.nworkers:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # withdraw our report so a retry of this round starts
+                    # clean instead of desyncing from still-waiting peers
+                    slot["groups"].pop(rank, None)
+                    if not slot["groups"]:
+                        self._rounds.pop(round_id, None)
+                    self._cv.notify_all()
+                    raise RuntimeError(
+                        f"preduce mask agreement round {round_id}: only "
+                        f"{sorted(slot['groups'])} of {self.nworkers} ranks "
+                        "arrived — every rank on the axis must call "
+                        "get_round_mask (non-members too: they execute the "
+                        "collective and discard the result)")
+                self._cv.wait(remaining)
+            canonical = slot["groups"][min(slot["groups"])]
+            slot["reads"] += 1
+            if slot["reads"] == self.nworkers:
+                del self._rounds[round_id]
+            return canonical
 
 
 class PartialReduce:
@@ -94,12 +157,39 @@ class PartialReduce:
         self._reduce_key = reduce_key
         self.nworkers = nworkers
         self.scheduler = scheduler or PReduceScheduler(nworkers)
+        self._agree = _MaskAgreement(nworkers)
+        self._round = [0] * nworkers
+        self._round_lock = threading.Lock()
 
     def get_partner(self, rank, max_worker=-1, wait_time=1.0):
         return self.scheduler.get_partner(self._reduce_key, rank,
                                           max_worker, wait_time)
 
+    def get_round_mask(self, rank, max_worker=-1, wait_time=1.0):
+        """Matchmake, then agree on the round's single canonical mask.
+
+        Returns ``(mask, group, is_member)``: ``mask`` is identical on
+        every rank (the `masked_mean_allreduce` contract); ranks whose
+        matched group lost the agreement get ``is_member=False`` — they
+        still execute the collective and discard its result.
+        """
+        partner = self.get_partner(rank, max_worker, wait_time)
+        with self._round_lock:
+            rid = self._round[rank]
+        # advance the round counter only on success: a rank whose
+        # agreement timed out retries the SAME round id, staying in sync
+        # with peers still waiting on it
+        group = self._agree.agree(rid, rank, partner)
+        with self._round_lock:
+            self._round[rank] = rid + 1
+        return partner_mask(group, self.nworkers), group, rank in group
+
     def preduce(self, x, partner, axis_name="dp"):
-        """Inside shard_map: average x over `partner` members."""
+        """Inside shard_map: average x over `partner` members.
+
+        ``partner`` must be the round's CANONICAL group — the same tuple
+        on every rank of the axis (see get_round_mask / the
+        masked_mean_allreduce contract).
+        """
         return masked_mean_allreduce(
             x, jnp.asarray(partner_mask(partner, self.nworkers)), axis_name)

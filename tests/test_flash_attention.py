@@ -72,6 +72,45 @@ def test_gradients_match_reference(rng, causal):
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_fully_masked_rows_zero_output_and_grads(rng):
+    """Batch elements whose additive mask is -inf for EVERY key: forward
+    output is 0 and backward must produce 0 (not exp(0)=1 garbage) for
+    those rows — regression for the l==0 lse encoding."""
+    q, k, v = _qkv(rng, B=2)
+    B, S = 2, q.shape[2]
+    mask = jnp.zeros((B, 1, 1, S), jnp.float32)
+    mask = mask.at[1].set(-jnp.inf)        # batch 1 entirely masked
+
+    out = flash_attention(q, k, v, mask=mask)
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out[1]), 0.0, atol=1e-6)
+    # batch 0 unaffected
+    want0 = ref_attn(q[:1], k[:1], v[:1])
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(want0[0]),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask=mask) ** 2)
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        arr = np.asarray(g)
+        assert np.isfinite(arr).all()
+        np.testing.assert_allclose(arr[1], 0.0, atol=1e-6)
+
+    def ref_loss(q, k, v):
+        # reference path restricted to the live batch for grad parity
+        return jnp.sum(ref_attn(q, k, v) ** 2)
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q[:1], k[:1], v[:1])
+    np.testing.assert_allclose(np.asarray(dq[0]), np.asarray(rq[0]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dk[0]), np.asarray(rk[0]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dv[0]), np.asarray(rv[0]),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_unsupported_shapes_fall_back(rng):
     # seq not a block multiple -> None (caller takes the jnp path)
     q = jnp.zeros((1, 2, 100, 64))

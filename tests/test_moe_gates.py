@@ -81,6 +81,26 @@ def test_base_balance_gating(rng):
     assert float(aux) == 0.0
 
 
+def test_aux_only_matches_full_gating(rng):
+    """The O(T·E) aux-only paths must equal the aux returned by the full
+    gating (the MoEAuxLossOp uses them to avoid recomputing the [T,E,C]
+    dispatch/combine tensors in a separate subexecutor)."""
+    import jax.numpy as jnp
+    from hetu_tpu.ops.moe import (top_k_gating, ktop1_gating, sam_gating,
+                                  top_k_balance_aux, ktop1_balance_aux,
+                                  sam_balance_aux)
+    logits = jnp.asarray(rng.standard_normal((64, 8)), jnp.float32)
+    _, _, aux = top_k_gating(logits, 2, 16)
+    np.testing.assert_allclose(float(top_k_balance_aux(logits)), float(aux),
+                               rtol=1e-6)
+    _, _, aux = ktop1_gating(logits, 2, 16)
+    np.testing.assert_allclose(float(ktop1_balance_aux(logits, 2)),
+                               float(aux), rtol=1e-6)
+    _, _, aux = sam_gating(logits, 2, 16, 2)
+    np.testing.assert_allclose(float(sam_balance_aux(logits, 2)),
+                               float(aux), rtol=1e-6)
+
+
 @pytest.mark.parametrize("gate,kw", [
     ("ktop1", {}), ("sam", {"num_groups": 2}), ("balance", {})])
 def test_moe_layer_trains_with_gate(gate, kw, rng):
