@@ -13,7 +13,7 @@ from __future__ import annotations
 import numpy as np
 
 from .graph import (Op, PlaceholderOp, VariableOp, find_topo_sort,
-                    graph_variables, gradients, Executor)
+                    graph_variables, gradients, Executor, stage)
 from . import initializers as init
 from .ops import *  # noqa: F401,F403
 from .optim import (SGDOptimizer, MomentumOptimizer, AdaGradOptimizer,

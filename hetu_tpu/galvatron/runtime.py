@@ -51,11 +51,17 @@ def build_mesh(config: HybridParallelConfig, devices=None):
 
 
 class LayerShardings:
-    """PartitionSpecs for one layer under its searched strategy."""
+    """PartitionSpecs for one layer under its searched strategy.
+
+    ``mesh`` is the layer's execution mesh: the full (pp-less) mesh when
+    pp_deg==1, or the layer's stage submesh (axes m0..mk-1) when the
+    model is pipelined — per-layer TP×DP lives INSIDE a stage, exactly
+    like the reference's per-layer groups within a pp rank range
+    (comm_groups.py gen_tp_group_dist)."""
 
     def __init__(self, mesh, config, layer_idx):
-        world = config.world or mesh.devices.size
-        k, maxes = layer_mesh_axes(world, config.pp_deg)
+        maxes = tuple(n for n in mesh.axis_names if n != "pp")
+        k = len(maxes)
         tp = config.tp_sizes[layer_idx]
         consec = config.tp_consecutive[layer_idx]
         self.dp_axes, self.tp_axes = tp_dp_axes(k, maxes, tp, consec)
@@ -162,13 +168,18 @@ class TransformerHPLayer:
 class HybridParallelModel:
     """Applies a searched HybridParallelConfig to a stack of HP layers.
 
-    Layers run inside one jitted step; per-layer shardings do the work the
-    reference does with per-layer process groups.  pp_deg>1 stages are run
-    as sequential segments of the same program (stage s's layers constrained
-    onto pp-axis slice s would idle other stages; on TPU the profitable
-    schedule is the spmd pipeline of parallel/pipeline.py, used when stages
-    are homogeneous — otherwise layers run unstaged, which is numerically
-    identical).
+    pp_deg==1: all layers run inside one jitted step; per-layer shardings
+    do the work the reference does with per-layer process groups.
+
+    pp_deg>1: the searched ``pp_division`` is HONORED — layers partition
+    into stages, each stage compiles its own forward and rematerializing
+    backward over its pp-slice submesh (per-layer TP×DP/FSDP shardings
+    intact inside the stage), and a host scheduler drives the GPipe flush
+    schedule over ``chunks`` micro-batches, transferring boundary
+    activations/cotangents between stage device sets (the reference's
+    pipeline/pipeline.py:133/343 batched-p2p schedules).  JAX async
+    dispatch overlaps stage programs — chunk m can be in stage 1 while
+    chunk m+1 runs stage 0.
     """
 
     def __init__(self, layer_specs, config: HybridParallelConfig,
@@ -177,9 +188,28 @@ class HybridParallelModel:
         self.specs = layer_specs
         self.config = config
         self.mesh = build_mesh(config, devices)
-        self.shardings = [LayerShardings(self.mesh, config, i)
+        self.pp = config.pp_deg
+        if self.pp > 1:
+            rest = self.mesh.axis_names[1:]
+            self.stage_meshes = [Mesh(self.mesh.devices[s], rest)
+                                 for s in range(self.pp)]
+            ranks = config.pp_ranks()
+            self.stage_layers = [[i for i, r in enumerate(ranks) if r == s]
+                                 for s in range(self.pp)]
+            for s, idxs in enumerate(self.stage_layers):
+                if not idxs:
+                    raise ValueError(
+                        f"pp_division {config.pp_division} leaves stage "
+                        f"{s} empty — config cannot be honored")
+            layer_mesh = lambda i: self.stage_meshes[ranks[i]]
+        else:
+            self.stage_meshes = [self.mesh]
+            self.stage_layers = [list(range(config.n_layers))]
+            layer_mesh = lambda i: self.mesh
+        self.shardings = [LayerShardings(layer_mesh(i), config, i)
                           for i in range(config.n_layers)]
         self.loss_fn = loss_fn or (lambda out, tgt: jnp.mean((out - tgt) ** 2))
+        self._stage_fwd = None
 
     def init_params(self, key):
         keys = jax.random.split(key, len(self.specs))
@@ -187,25 +217,73 @@ class HybridParallelModel:
         for spec, sh, k in zip(self.specs, self.shardings, keys):
             p = spec.init(k)
             pspecs = spec.param_specs(sh)
-            p = {n: jax.device_put(v, NamedSharding(self.mesh, pspecs[n]))
+            p = {n: jax.device_put(v, NamedSharding(sh.mesh, pspecs[n]))
                  for n, v in p.items()}
             params.append(p)
         return params
 
-    def apply(self, params, x):
-        for spec, sh, p in zip(self.specs, self.shardings, params):
+    def _apply_range(self, idxs, stage_params, x):
+        for j, i in enumerate(idxs):
+            spec, sh = self.specs[i], self.shardings[i]
             body = lambda p_, x_, spec_=spec, sh_=sh: spec_.apply(p_, x_, sh_)
             if sh.ckpt:
                 body = jax.checkpoint(body)
-            x = body(p, x)
+            x = body(stage_params[j], x)
+        return x
+
+    def apply(self, params, x):
+        if self.pp == 1:
+            return self._apply_range(self.stage_layers[0], params, x)
+        for s, idxs in enumerate(self.stage_layers):
+            x = self._to_stage(x, s)
+            x = self._apply_range(idxs, [params[i] for i in idxs], x)
         return x
 
     def loss(self, params, x, tgt):
         return self.loss_fn(self.apply(params, x), tgt)
 
+    # -- pipelined execution (pp_deg > 1) ---------------------------------
+    def _to_stage(self, x, s):
+        sh = self.shardings[self.stage_layers[s][0]]
+        return jax.device_put(x, NamedSharding(
+            self.stage_meshes[s], sh.act_spec(x.ndim)))
+
+    def _build_stage_programs(self):
+        self._stage_fwd, self._stage_bwd, self._stage_last_bwd = [], [], []
+        for s, idxs in enumerate(self.stage_layers):
+            last = s == self.pp - 1
+
+            def fwd(sp, x, idxs=idxs):
+                return self._apply_range(idxs, sp, x)
+
+            self._stage_fwd.append(jax.jit(fwd))
+
+            def bwd(sp, x, ct, idxs=idxs):
+                _, vjp_fn = jax.vjp(
+                    lambda p_, x_: self._apply_range(idxs, p_, x_), sp, x)
+                return vjp_fn(ct)
+
+            self._stage_bwd.append(jax.jit(bwd))
+            if last:
+                def last_bwd(sp, x, tgt, scale, idxs=idxs):
+                    def f(p_, x_):
+                        return self.loss_fn(
+                            self._apply_range(idxs, p_, x_), tgt)
+                    loss, vjp_fn = jax.vjp(f, sp, x)
+                    gp, gx = vjp_fn(scale)
+                    return loss, gp, gx
+
+                self._stage_last_bwd = jax.jit(last_bwd)
+
     def grads(self, params, x, tgt):
-        """Gradients with micro-batch accumulation over config.chunks."""
+        """(loss, grads) with micro-batch accumulation over config.chunks;
+        pipelined across stages when pp_deg > 1."""
         chunks = max(1, self.config.chunks)
+        if self.pp == 1:
+            return self._grads_unstaged(params, x, tgt, chunks)
+        return self._grads_pipelined(params, x, tgt, chunks)
+
+    def _grads_unstaged(self, params, x, tgt, chunks):
         if chunks == 1:
             return jax.value_and_grad(self.loss)(params, x, tgt)
         b = x.shape[0]
@@ -224,22 +302,102 @@ class HybridParallelModel:
         inv = 1.0 / chunks
         return tl * inv, jax.tree_util.tree_map(lambda g: g * inv, tg)
 
+    def _grads_pipelined(self, params, x, tgt, chunks):
+        if self._stage_fwd is None:
+            self._build_stage_programs()
+        b = x.shape[0]
+        assert b % chunks == 0, f"batch {b} not divisible by chunks {chunks}"
+        mb = b // chunks
+        xs = [x[m * mb:(m + 1) * mb] for m in range(chunks)]
+        ts = [tgt[m * mb:(m + 1) * mb] for m in range(chunks)]
+        sparams = [[params[i] for i in idxs] for idxs in self.stage_layers]
+
+        # forward wavefront: stash only boundary activations (stage inputs);
+        # intra-stage activations recompute in the vjp backward (remat)
+        stage_in = [[None] * self.pp for _ in range(chunks)]
+        order = sorted(((m, s) for m in range(chunks)
+                        for s in range(self.pp)),
+                       key=lambda t: (t[0] + t[1], t[1]))
+        for m, s in order:
+            src = xs[m] if s == 0 else stage_in[m][s]
+            xin = self._to_stage(src, s)   # ICI transfer between stages
+            stage_in[m][s] = xin
+            if s < self.pp - 1:
+                stage_in[m][s + 1] = self._stage_fwd[s](sparams[s], xin)
+
+        # backward: last stage seeds with d(mean over chunks)/dloss
+        scale = jnp.asarray(1.0 / chunks, x.dtype)
+        grad_acc = [None] * self.pp
+        losses = []
+        for m in reversed(range(chunks)):
+            tgt_m = self._to_stage(ts[m], self.pp - 1) \
+                if ts[m].ndim else ts[m]
+            loss_m, gp, ct = self._stage_last_bwd(
+                sparams[-1], stage_in[m][self.pp - 1], tgt_m, scale)
+            losses.append(loss_m)
+            grad_acc[-1] = gp if grad_acc[-1] is None else \
+                jax.tree_util.tree_map(jnp.add, grad_acc[-1], gp)
+            for s in reversed(range(self.pp - 1)):
+                ct = self._to_stage(ct, s)
+                gp, ct = self._stage_bwd[s](sparams[s], stage_in[m][s], ct)
+                grad_acc[s] = gp if grad_acc[s] is None else \
+                    jax.tree_util.tree_map(jnp.add, grad_acc[s], gp)
+
+        loss = sum(float(l) for l in losses) / chunks
+        grads = [None] * self.config.n_layers
+        for s, idxs in enumerate(self.stage_layers):
+            for j, i in enumerate(idxs):
+                grads[i] = grad_acc[s][j]
+        return jnp.asarray(loss), grads
+
     def make_train_step(self, optimizer=None, lr=1e-3):
-        """Returns (step_fn, opt_state_init).  step_fn is jitted over the
-        mesh; sgd fallback if optax-style optimizer not given."""
+        """Returns (step_fn, opt_state_init).
+
+        pp_deg==1: step_fn is one jitted program.  pp_deg>1: step_fn is a
+        host-orchestrated pipeline step (per-stage programs overlap via
+        async dispatch); updates apply per stage on its submesh."""
         if optimizer is None:
-            def step(params, opt_state, x, tgt):
-                loss, g = self.grads(params, x, tgt)
+            def apply_updates(params, opt_state, g):
                 new = jax.tree_util.tree_map(lambda p, gg: p - lr * gg,
                                              params, g)
-                return new, opt_state, loss
+                return new, opt_state
             init = lambda params: ()
         else:
+            import optax
+
+            def apply_updates(params, opt_state, g):
+                updates, opt_state = optimizer.update(g, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state
+            init = optimizer.init
+
+        if self.pp == 1:
             def step(params, opt_state, x, tgt):
                 loss, g = self.grads(params, x, tgt)
-                updates, opt_state = optimizer.update(g, opt_state, params)
-                import optax
-                params = optax.apply_updates(params, updates)
+                params, opt_state = apply_updates(params, opt_state, g)
                 return params, opt_state, loss
-            init = optimizer.init
-        return jax.jit(step, donate_argnums=(0, 1)), init
+            return jax.jit(step, donate_argnums=(0, 1)), init
+
+        # pipelined: per-stage jitted update keeps each stage's params on
+        # its own submesh (grads already live there); donate params AND
+        # slots so old/new optimizer state never coexist in HBM
+        stage_update = jax.jit(apply_updates, donate_argnums=(0, 1))
+
+        def step(params, opt_state, x, tgt):
+            loss, g = self.grads(params, x, tgt)
+            new_params = list(params)
+            new_opt = list(opt_state) if isinstance(opt_state, list) \
+                else [opt_state] * self.pp
+            for s, idxs in enumerate(self.stage_layers):
+                sp = [params[i] for i in idxs]
+                sg = [g[i] for i in idxs]
+                np_, no_ = stage_update(sp, new_opt[s], sg)
+                for j, i in enumerate(idxs):
+                    new_params[i] = np_[j]
+                new_opt[s] = no_
+            return new_params, new_opt, loss
+
+        def init_pp(params):
+            return [init([params[i] for i in idxs])
+                    for idxs in self.stage_layers]
+
+        return step, init_pp

@@ -1,5 +1,6 @@
 from .node import (Op, PlaceholderOp, VariableOp, find_topo_sort,
-                   graph_variables, graph_placeholders)
+                   graph_variables, graph_placeholders, stage,
+                   current_stage)
 from .trace import TraceContext, evaluate
 from .autodiff import gradients
 from .executor import Executor, SubExecutor

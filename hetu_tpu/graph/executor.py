@@ -273,8 +273,16 @@ class Executor:
             if n.is_stateful and hasattr(n, "init_state"):
                 self.opt_state[n.name] = n.init_state(self.params)
 
-        self.subexecutor = {name: SubExecutor(name, nodes, self)
-                            for name, nodes in self.eval_node_dict.items()}
+        if "pipeline" in self.config:
+            # graph-driven pipeline over inhomogeneous stages (raw_ctx /
+            # `with ht.stage(i)` annotations), reference context.py:1430
+            from ..parallel.graph_pipeline import PipelineSubExecutor
+            self.subexecutor = {
+                name: PipelineSubExecutor(name, nodes, self)
+                for name, nodes in self.eval_node_dict.items()}
+        else:
+            self.subexecutor = {name: SubExecutor(name, nodes, self)
+                                for name, nodes in self.eval_node_dict.items()}
 
     # -- sharding hooks (filled in by parallel layer) ----------------------
     def _place(self, var, value):

@@ -29,6 +29,45 @@ def _next_id() -> int:
     return _node_counter[0]
 
 
+import threading as _threading
+
+_stage_tls = _threading.local()
+
+
+def _stage_stack():
+    # thread-local: launcher.launch_local builds graphs on worker threads
+    # concurrently; a shared stack would cross-assign their stages
+    stack = getattr(_stage_tls, "stack", None)
+    if stack is None:
+        stack = _stage_tls.stack = [None]
+    return stack
+
+
+class stage:
+    """Pipeline-stage scope: ops created inside get ``raw_ctx = idx``.
+
+    Mirrors the reference's ``with ht.context(ctx)`` device-group scoping
+    (context.py:830) that drives pipeline stage inference
+    (executor.py:1430); here the annotation is consumed by
+    parallel/graph_pipeline.py.  Nests: the innermost scope wins.
+    """
+
+    def __init__(self, idx):
+        self.idx = int(idx)
+
+    def __enter__(self):
+        _stage_stack().append(self.idx)
+        return self
+
+    def __exit__(self, *exc):
+        _stage_stack().pop()
+        return False
+
+
+def current_stage():
+    return _stage_stack()[-1]
+
+
 class Op:
     """A node in the dataflow graph.
 
@@ -51,8 +90,9 @@ class Op:
         # or by a Strategy; mirrors reference NodeStatus (context.py:248).
         self.dist_state = None
         # Device-group annotation for pipeline-stage placement; mirrors
-        # reference raw_ctx (Node.py / context.py DeviceGroup).
-        self.raw_ctx = None
+        # reference raw_ctx (Node.py / context.py DeviceGroup).  Picked up
+        # from an enclosing `with stage(i):` scope.
+        self.raw_ctx = _stage_stack()[-1]
         self._shape_cache = None
 
     # -- graph protocol ----------------------------------------------------

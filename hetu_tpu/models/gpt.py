@@ -8,7 +8,11 @@ or the shard_map fast path in parallel/tensor_parallel.py used by bench.
 
 from __future__ import annotations
 
-from ..graph.node import Op, VariableOp
+from contextlib import nullcontext
+
+import numpy as np
+
+from ..graph.node import Op, VariableOp, stage
 from .. import initializers as init
 from ..layers import Embedding, LayerNorm, TransformerLayer
 from ..ops import (array_reshape_op, matmul_op, reduce_mean_op,
@@ -40,9 +44,16 @@ GPT_CONFIGS = {
 
 
 class GPTModel:
-    def __init__(self, config, name="gpt"):
+    """``pipeline_stages=k`` wraps construction in `ht.stage` scopes —
+    embedding on stage 0, the layer stack split evenly, final LN (and the
+    LM head built on top) on the last stage — so the model trains under
+    the graph pipeline executor (parallel/graph_pipeline.py; reference
+    raw_ctx staging, context.py:1430)."""
+
+    def __init__(self, config, name="gpt", pipeline_stages=None):
         c = config
         self.config = c
+        self.pipeline_stages = pipeline_stages
         self.wte = Embedding(c.vocab_size, c.hidden_size,
                              initializer=init.normal(0.0, 0.02),
                              name=f"{name}_wte")
@@ -58,20 +69,38 @@ class GPTModel:
             for i in range(c.num_layers)]
         self.ln_f = LayerNorm(c.hidden_size, name=f"{name}_ln_f")
 
+    def _scope(self, layer_idx=None):
+        S = self.pipeline_stages
+        if not S:
+            return nullcontext()
+        if layer_idx is None:
+            return stage(0)
+        # balanced split of the layer stack over stages
+        bounds = np.array_split(np.arange(len(self.layers)), S)
+        for s, chunk in enumerate(bounds):
+            if layer_idx in chunk:
+                return stage(s)
+        return stage(S - 1)
+
     def __call__(self, input_ids):
         c = self.config
-        x = self.wte(input_ids)
-        x = x + PositionIdsOp(self.wpe, x, c.seq_len)
-        if c.dropout_prob > 0:
-            x = dropout_op(x, keep_prob=1.0 - c.dropout_prob)
-        for layer in self.layers:
-            x = layer(x, seq_len=c.seq_len)
-        return self.ln_f(x)
+        with self._scope():
+            x = self.wte(input_ids)
+            x = x + PositionIdsOp(self.wpe, x, c.seq_len)
+            if c.dropout_prob > 0:
+                x = dropout_op(x, keep_prob=1.0 - c.dropout_prob)
+        for i, layer in enumerate(self.layers):
+            with self._scope(i):
+                x = layer(x, seq_len=c.seq_len)
+        with (stage(self.pipeline_stages - 1) if self.pipeline_stages
+              else nullcontext()):
+            return self.ln_f(x)
 
 
 class GPTLMHeadModel:
-    def __init__(self, config, name="gpt"):
-        self.transformer = GPTModel(config, name=name)
+    def __init__(self, config, name="gpt", pipeline_stages=None):
+        self.transformer = GPTModel(config, name=name,
+                                    pipeline_stages=pipeline_stages)
         self.config = config
 
     def __call__(self, input_ids):

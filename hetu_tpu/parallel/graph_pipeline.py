@@ -1,0 +1,536 @@
+"""Graph-driven pipeline parallelism over inhomogeneous stages.
+
+Reference: the reference infers pipeline stages from per-node ``raw_ctx``
+device groups (context.py:1430-1492 `get_pipeline_stage_info`), partitions
+the graph into per-rank subgraphs, and drives them with host-side
+schedulers — `SubExecutor4Gpipe` (gpipe_subexecutor.py:7, all-forward then
+all-backward with per-micro-batch tensor maps) and `SubExecutor4Pipedream`
+(pipedream_subexecutor.py:25, 1F1B) exchanging activations over NCCL P2P
+(PipelineSend/Receive ops).
+
+TPU redesign: each stage compiles to TWO jitted programs — a forward
+(stage subgraph evaluated through graph/trace.py on the stage's devices)
+and a rematerializing backward (``jax.vjp`` of the stage forward, so only
+O(boundary) activations are stashed between fwd and bwd — the flush
+schedules' weight-stashing is unnecessary because parameters don't change
+mid-flush).  The host scheduler plays the reference's role: it slices the
+batch into micro-batches, issues stage programs in GPipe or 1F1B order,
+moves boundary activations/cotangents between stage device sets with
+``jax.device_put`` (the ICI transfer that PipelineSend/Recv did over
+NCCL), accumulates gradients across micro-batches (and across stages for
+variables shared between stages, e.g. a tied LM head), and applies the
+optimizer per stage.  JAX's async dispatch overlaps the stage programs:
+issuing fwd(m=1, s=0) returns before fwd(m=0, s=1) finishes, so stages
+genuinely run concurrently on their own devices.
+
+Unlike parallel/pipeline.py (one SPMD program, homogeneous stacked
+stages), stages here are arbitrary per-stage subgraphs — embedding stage,
+N transformer stages, LM-head stage — driven from ``with ht.stage(i):``
+annotations through the normal Executor API.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..graph.node import Op, PlaceholderOp, VariableOp, find_topo_sort
+from ..graph.trace import TraceContext, evaluate
+
+
+def _stage_of_annotation(raw_ctx):
+    if raw_ctx is None:
+        return None
+    if isinstance(raw_ctx, (int, np.integer)):
+        return int(raw_ctx)
+    spec = getattr(raw_ctx, "spec", None)  # DeviceGroup(stage_idx)
+    if isinstance(spec, (int, np.integer)):
+        return int(spec)
+    raise ValueError(f"unsupported raw_ctx for pipeline staging: {raw_ctx!r}")
+
+
+def assign_stages(topo):
+    """Infer a stage for every node from ``raw_ctx`` annotations.
+
+    Interior ops: the annotation if present, else the max of the input
+    stages (activations flow forward; reference stage inference walks
+    raw_ctx the same direction).  Stages must be non-decreasing along
+    edges.  Leaves (placeholders/variables) are not assigned — they are
+    bound into every stage that consumes them.
+    """
+    stage_of = {}
+    for n in topo:
+        if isinstance(n, (PlaceholderOp, VariableOp)):
+            continue
+        from_inputs = max((stage_of[i] for i in n.inputs if i in stage_of),
+                          default=0)
+        s = _stage_of_annotation(n.raw_ctx)
+        if s is None:
+            s = from_inputs
+        elif s < from_inputs:
+            raise ValueError(
+                f"op {n.name} annotated stage {s} but consumes a stage-"
+                f"{from_inputs} activation; stages must be non-decreasing "
+                "along dataflow edges")
+        stage_of[n] = s
+    return stage_of
+
+
+class _StagePrograms:
+    """The partitioned subgraph + compiled programs of one stage."""
+
+    def __init__(self, idx):
+        self.idx = idx
+        self.topo = []            # stage-s ops, global topo order
+        self.variables = []       # VariableOps bound into this stage
+        self.placeholders = []    # PlaceholderOps fed into this stage
+        self.acts_in = []         # earlier-stage op outputs consumed here
+        self.acts_out = []        # op outputs consumed by later stages
+        self.evals = []           # user eval nodes computed here
+        self.loss = None          # the differentiated loss, if it lives here
+        self.fwd = None
+        self.bwd = None
+        self.update = None
+        self.opt_vars = []        # optimized variables homed on this stage
+        self.device_put = None    # place an array onto this stage
+
+
+class PipelineSubExecutor:
+    """Executor subgraph run under an inhomogeneous-stage pipeline.
+
+    Drop-in for graph/executor.SubExecutor when the Executor is built with
+    ``pipeline=`` config: same ``run(feed_dict)`` contract, same shared
+    ``executor.params`` / ``executor.opt_state`` stores.
+
+    Config (Executor kwargs):
+      pipeline   : 'gpipe' | '1f1b'   (issue order; numerics identical)
+      num_micro  : micro-batches per step (all feeds split on axis 0)
+      num_stages : stage count; default = max annotation + 1, or the
+                   mesh's 'pp' axis size when a mesh is attached
+    """
+
+    def __init__(self, name, eval_nodes, executor):
+        from ..optim.optimizer import OptimizerOp
+        self.name = name
+        self.executor = executor
+        self.eval_nodes = list(eval_nodes)
+        self.schedule = executor.config.get("pipeline", "gpipe")
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline schedule {self.schedule!r}")
+        self.n_micro = int(executor.config.get("num_micro", 1))
+
+        self.opt_ops = [n for n in self.eval_nodes
+                        if isinstance(n, OptimizerOp)]
+        if len(self.opt_ops) > 1:
+            raise ValueError("pipeline supports one OptimizerOp per "
+                             "subgraph")
+        self.user_outputs = [n for n in self.eval_nodes
+                             if not isinstance(n, OptimizerOp)]
+        self.opt_op = self.opt_ops[0] if self.opt_ops else None
+        self.training = self.opt_op is not None
+
+        roots = list(self.user_outputs)
+        self.loss = None
+        if self.opt_op is not None:
+            self.loss = self.opt_op.loss
+            if self.loss is None:
+                raise ValueError(
+                    "pipeline training needs OptimizerOp.loss (build the "
+                    "train op with opt.minimize(loss))")
+            if self.loss not in roots:
+                roots.append(self.loss)
+        self.topo = find_topo_sort(roots)
+        self.placeholders = [n for n in self.topo
+                             if isinstance(n, PlaceholderOp)]
+        self.variables = [n for n in self.topo if isinstance(n, VariableOp)]
+        if any(hasattr(p, "ps_embedding") for p in self.placeholders):
+            raise NotImplementedError(
+                "PS-backed embeddings under the pipeline executor")
+
+        stage_of = assign_stages(self.topo)
+        n_stages = executor.config.get("num_stages")
+        if n_stages is None:
+            if executor.mesh is not None and "pp" in executor.mesh.axis_names:
+                n_stages = executor.mesh.shape["pp"]
+            else:
+                n_stages = max(stage_of.values(), default=0) + 1
+        self.n_stages = int(n_stages)
+        bad = {n.name: s for n, s in stage_of.items()
+               if s >= self.n_stages}
+        if bad:
+            raise ValueError(
+                f"ops annotated beyond num_stages={self.n_stages}: {bad}")
+        self._partition(stage_of)
+        self._plan_devices()
+        self._built = False
+
+    # -- graph partitioning ------------------------------------------------
+    def _partition(self, stage_of):
+        consumers = defaultdict(list)
+        for n in self.topo:
+            for i in n.inputs:
+                consumers[i].append(n)
+        self.stages = [_StagePrograms(s) for s in range(self.n_stages)]
+        for n in self.topo:
+            if isinstance(n, (PlaceholderOp, VariableOp)):
+                seen = set()
+                for c in consumers[n]:
+                    s = stage_of[c]
+                    if s in seen:
+                        continue
+                    seen.add(s)
+                    st = self.stages[s]
+                    if isinstance(n, VariableOp):
+                        st.variables.append(n)
+                    else:
+                        st.placeholders.append(n)
+                continue
+            s = stage_of[n]
+            st = self.stages[s]
+            st.topo.append(n)
+            later = sorted({stage_of[c] for c in consumers[n]
+                            if stage_of[c] > s})
+            if later:
+                st.acts_out.append(n)
+                seen = set()
+                for c in consumers[n]:
+                    cs = stage_of[c]
+                    if cs > s and cs not in seen:
+                        seen.add(cs)
+                        self.stages[cs].acts_in.append(n)
+            if n in self.user_outputs:
+                st.evals.append(n)
+            if n is self.loss:
+                st.loss = n
+        # optimized variables are HOMED on their first consuming stage
+        # (updates run there; stages sharing the variable send its grad)
+        if self.opt_op is not None:
+            homed = set()
+            for st in self.stages:
+                for v in st.variables:
+                    if v in self.opt_op.var_list and v not in homed:
+                        homed.add(v)
+                        st.opt_vars.append(v)
+            missing = [v.name for v in self.opt_op.var_list
+                       if v not in homed]
+            if missing:
+                raise ValueError(
+                    f"optimized variables unused by the graph: {missing}")
+
+    # -- device planning ---------------------------------------------------
+    def _plan_devices(self):
+        """Per-stage placement: the mesh's pp-slice s (with any remaining
+        axes as an intra-stage submesh), else device s of the default
+        backend, else no placement (single-device/CPU tests)."""
+        mesh = self.executor.mesh
+        self._stage_meshes = [None] * self.n_stages
+        if mesh is not None and "pp" in mesh.axis_names:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            assert mesh.axis_names[0] == "pp", \
+                "pipeline mesh must have 'pp' as its leading axis"
+            assert mesh.shape["pp"] >= self.n_stages
+            rest = mesh.axis_names[1:]
+            for st in self.stages:
+                block = mesh.devices[st.idx]
+                if rest:
+                    sub = Mesh(block, rest)
+                    self._stage_meshes[st.idx] = sub
+                    sh = NamedSharding(sub, PartitionSpec())
+                    st.device_put = (
+                        lambda x, sh=sh: jax.device_put(x, sh))
+                else:
+                    dev = block.item() if hasattr(block, "item") else block
+                    st.device_put = (
+                        lambda x, dev=dev: jax.device_put(x, dev))
+        else:
+            devs = jax.devices()
+            for st in self.stages:
+                dev = devs[st.idx % len(devs)]
+                st.device_put = lambda x, dev=dev: jax.device_put(x, dev)
+
+    # -- program construction ----------------------------------------------
+    def _cast(self, x):
+        cd = self.executor.compute_dtype
+        if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(cd)
+        return x
+
+    def _make_fwd(self, st):
+        out_nodes = list(st.acts_out)
+        for n in st.evals:
+            if n not in out_nodes:
+                out_nodes.append(n)
+        if st.loss is not None and st.loss not in out_nodes:
+            out_nodes.append(st.loss)
+        training = self.training
+        mesh = self._stage_meshes[st.idx]
+
+        def fwd(params, feeds, in_acts, key):
+            ctx = TraceContext(key=key, training=training, mesh=mesh)
+            bindings = {}
+            for v in st.variables:
+                bindings[v] = self._cast(params[v.name])
+            for p in st.placeholders:
+                bindings[p] = feeds[p.name]
+            for u in st.acts_in:
+                bindings[u] = in_acts[u.name]
+            vals, _ = evaluate(out_nodes, bindings, ctx, topo=st.topo)
+            if ctx.updates:
+                raise NotImplementedError(
+                    "stateful ops (batchnorm/assign) inside a pipeline "
+                    "stage are not supported yet")
+            return {n.name: v for n, v in zip(out_nodes, vals)}
+
+        return jax.jit(fwd), out_nodes
+
+    def _make_bwd(self, st):
+        """Rematerializing backward: jax.vjp over (params, diff acts_in)
+        of the stage's differentiable outputs."""
+        diff_outs = list(st.acts_out)
+        if st.loss is not None and st.loss not in diff_outs:
+            diff_outs.append(st.loss)
+        diff_vars = [v for v in st.variables
+                     if self.opt_op is not None
+                     and v in self.opt_op.var_list]
+        training = self.training
+        mesh = self._stage_meshes[st.idx]
+
+        def bwd(params, feeds, in_acts, cts, key):
+            def f(var_vals, act_vals):
+                ctx = TraceContext(key=key, training=training, mesh=mesh)
+                bindings = {}
+                for v in st.variables:
+                    bindings[v] = self._cast(params[v.name])
+                for v, val in zip(diff_vars, var_vals):
+                    bindings[v] = self._cast(val)
+                for p in st.placeholders:
+                    bindings[p] = feeds[p.name]
+                bindings.update(dict(zip(st.acts_in, act_vals)))
+                vals, _ = evaluate(diff_outs, bindings, ctx, topo=st.topo)
+                return tuple(vals)
+
+            primb_vars = tuple(params[v.name] for v in diff_vars)
+            prim_acts = tuple(in_acts[u.name] for u in st.acts_in)
+            _, vjp_fn = jax.vjp(f, primb_vars, prim_acts)
+            ct_vals = tuple(cts[n.name] for n in diff_outs)
+            gvars, gacts = vjp_fn(ct_vals)
+            return ({v.name: g for v, g in zip(diff_vars, gvars)},
+                    {u.name: g for u, g in zip(st.acts_in, gacts)})
+
+        return jax.jit(bwd), diff_outs, diff_vars
+
+    def _make_update(self, st):
+        if not st.opt_vars:
+            return None
+        opt = self.opt_op.optimizer
+        names = [v.name for v in st.opt_vars]
+
+        def update(params, slots, grads, step, scale):
+            lr = opt.lr.get(step)
+            new_params, new_slots = {}, {}
+            for name in names:
+                g = grads[name].astype(params[name].dtype) * scale
+                p, ns = opt.apply_dense(params[name], g, slots[name], lr,
+                                        step)
+                new_params[name] = p
+                new_slots[name] = ns
+            return new_params, new_slots
+
+        return jax.jit(update, donate_argnums=(0, 1))
+
+    def _build(self):
+        ex = self.executor
+        for st in self.stages:
+            st.fwd, st.out_nodes = self._make_fwd(st)
+            if self.training:
+                st.bwd, st.diff_outs, st.diff_vars = self._make_bwd(st)
+                st.update = self._make_update(st)
+        # home each parameter onto the first stage that consumes it
+        placed = set()
+        for st in self.stages:
+            for v in st.variables:
+                if v.name not in placed:
+                    placed.add(v.name)
+                    ex.params[v.name] = st.device_put(ex.params[v.name])
+        self._built = True
+
+    # -- the schedule ------------------------------------------------------
+    def _split_feeds(self, feed_dict):
+        feeds = {}
+        feed_dict = feed_dict or {}
+        for node, value in feed_dict.items():
+            name = node.name if isinstance(node, Op) else node
+            feeds[name] = value
+        for p in self.placeholders:
+            if p.name not in feeds and hasattr(p, "auto_feed"):
+                feeds[p.name] = p.auto_feed(self.name)
+        missing = [p.name for p in self.placeholders if p.name not in feeds]
+        if missing:
+            raise ValueError(f"missing feeds for placeholders: {missing}")
+        m = self.n_micro
+        per_micro = [dict() for _ in range(m)]
+        for p in self.placeholders:
+            v = np.asarray(feeds[p.name])
+            if v.shape and v.shape[0] % m == 0:
+                chunks = np.split(v, m, axis=0)
+            else:
+                raise ValueError(
+                    f"feed {p.name} (shape {v.shape}) not splittable into "
+                    f"{m} micro-batches along axis 0")
+            for i in range(m):
+                per_micro[i][p.name] = self._cast(
+                    jnp.asarray(chunks[i], dtype=p.dtype))
+        return per_micro
+
+    def run(self, feed_dict=None, convert_to_numpy_ret_vals=False):
+        if not self._built:
+            self._build()
+        ex = self.executor
+        m = self.n_micro
+        per_micro = self._split_feeds(feed_dict)
+        base = jax.random.fold_in(ex._base_key, ex._global_step)
+        ex._global_step += 1
+        keys = [jax.random.fold_in(base, i) for i in range(m)]
+
+        stage_feeds = [[{p.name: st.device_put(per_micro[i][p.name])
+                         for p in st.placeholders}
+                        for st in self.stages] for i in range(m)]
+        params = ex.params
+
+        # ---- forward ---------------------------------------------------
+        acts = [dict() for _ in range(m)]      # micro -> {name: value}
+        evals = [dict() for _ in range(m)]     # micro -> {name: value}
+        # wavefront issue order: (micro+stage) diagonal — stage s of micro
+        # i is issued right after its dependencies, and JAX async dispatch
+        # overlaps the stage programs across their device sets (the role
+        # of the reference's per-rank schedulers + NCCL group batching)
+        order = sorted(((i, s) for i in range(m)
+                        for s in range(self.n_stages)),
+                       key=lambda t: (t[0] + t[1], t[1]))
+        for i, s in order:
+            st = self.stages[s]
+            # device_put is a no-op for home params and an ICI transfer
+            # for variables shared across stages (e.g. tied LM head)
+            pview = {v.name: st.device_put(params[v.name])
+                     for v in st.variables}
+            ins = {u.name: st.device_put(acts[i][u.name])
+                   for u in st.acts_in}
+            outs = st.fwd(pview, stage_feeds[i][s], ins, keys[i])
+            for n in st.out_nodes:
+                if n in st.acts_out:
+                    acts[i][n.name] = outs[n.name]
+                if n in st.evals or n is st.loss:
+                    evals[i][n.name] = outs[n.name]
+
+        # ---- backward + accumulate ------------------------------------
+        if self.training:
+            grad_acc = {}                       # var name -> value
+            cts = [defaultdict(list) for _ in range(m)]
+            loss_ct = 1.0 / m                   # step loss = mean of micros
+            for i in reversed(range(m)):
+                for s in reversed(range(self.n_stages)):
+                    st = self.stages[s]
+                    if not st.diff_vars and not st.acts_in:
+                        continue
+                    pview = {v.name: st.device_put(params[v.name])
+                             for v in st.variables}
+                    ins = {u.name: st.device_put(acts[i][u.name])
+                           for u in st.acts_in}
+                    ct_in = {}
+                    for n in st.diff_outs:
+                        if n is st.loss and n not in st.acts_out:
+                            ct_in[n.name] = jnp.asarray(
+                                loss_ct, evals[i][n.name].dtype)
+                        else:
+                            pend = cts[i].pop(n.name, None)
+                            ct_in[n.name] = (
+                                self._accum(pend, st.device_put)
+                                if pend else
+                                st.device_put(
+                                    jnp.zeros_like(acts[i][n.name])))
+                            if n is st.loss:
+                                ct_in[n.name] = ct_in[n.name] + jnp.asarray(
+                                    loss_ct, ct_in[n.name].dtype)
+                    gvars, gacts = st.bwd(pview, stage_feeds[i][s], ins,
+                                          ct_in, keys[i])
+                    for name, g in gvars.items():
+                        grad_acc.setdefault(name, []).append(g)
+                    for name, g in gacts.items():
+                        cts[i][name].append(g)
+
+            # ---- optimizer update per stage ----------------------------
+            opt_state = ex.opt_state[self.opt_op.name]
+            step = opt_state["step"]
+            scale = jnp.asarray(1.0)
+            if self.opt_op.clip_global_norm is not None:
+                sq = 0.0
+                for name, gs in grad_acc.items():
+                    g = self._accum(gs, self._home_put(name))
+                    grad_acc[name] = [g]
+                    sq += float(np.sum(np.square(
+                        np.asarray(g, dtype=np.float32))))
+                gnorm = float(np.sqrt(sq))
+                scale = jnp.asarray(min(
+                    1.0, self.opt_op.clip_global_norm / (gnorm + 1e-6)))
+            new_slots = dict(opt_state["slots"])
+            for st in self.stages:
+                if st.update is None:
+                    continue
+                pview = {v.name: params[v.name] for v in st.opt_vars}
+                sview = {v.name: opt_state["slots"][v.name]
+                         for v in st.opt_vars}
+                gview = {v.name: self._accum(grad_acc[v.name],
+                                             st.device_put)
+                         for v in st.opt_vars}
+                newp, news = st.update(pview, sview, gview,
+                                       st.device_put(step),
+                                       st.device_put(scale))
+                params.update(newp)
+                new_slots.update(news)
+            ex.opt_state[self.opt_op.name] = {
+                "step": step + 1, "slots": new_slots}
+
+        # ---- outputs ---------------------------------------------------
+        vals = []
+        for n in self.eval_nodes:
+            if n is self.opt_op:
+                vals.append(None)
+                continue
+            per = [evals[i][n.name] for i in range(m)]
+            if per[0].ndim == 0:
+                v = np.mean([np.asarray(x, np.float32) for x in per])
+                v = jnp.asarray(v, per[0].dtype)
+            else:
+                v = jnp.concatenate(
+                    [jnp.asarray(np.asarray(x)) for x in per], axis=0)
+            vals.append(np.asarray(v) if convert_to_numpy_ret_vals else v)
+        return vals
+
+    def _home_put(self, var_name):
+        for st in self.stages:
+            for v in st.opt_vars:
+                if v.name == var_name:
+                    return st.device_put
+        return self.stages[0].device_put
+
+    @staticmethod
+    def _accum(parts, device_put):
+        """Sum contributions that may live on different stage devices."""
+        if len(parts) == 1:
+            return device_put(parts[0])
+        total = device_put(parts[0])
+        for p in parts[1:]:
+            total = total + device_put(p)
+        return total
+
+    def profile(self, feed_dict=None, repeats=10):
+        import time
+        self.run(feed_dict)
+        start = time.perf_counter()
+        for _ in range(repeats):
+            out = self.run(feed_dict)
+        jax.block_until_ready([o for o in out if o is not None])
+        return (time.perf_counter() - start) / repeats

@@ -1,0 +1,142 @@
+"""Graph-driven inhomogeneous-stage pipeline tests.
+
+Reference behavior being matched: pipeline stages inferred from per-node
+device-group annotations (context.py:1430), arbitrary per-stage subgraphs
+(gpipe_subexecutor.py:7), loss parity vs single-device execution (the
+reference's examples/runner/parallel test harness approach)."""
+
+import numpy as np
+import jax
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel, MLP
+from hetu_tpu.parallel import make_mesh
+from hetu_tpu.parallel.graph_pipeline import assign_stages
+from hetu_tpu.graph.node import find_topo_sort
+
+
+def _mlp_graph(stages):
+    """4-block MLP with explicit per-block stage scopes."""
+    x = ht.placeholder_op("px", (16, 8))
+    y = ht.placeholder_op("py", (16, 8))
+    h = x
+    ws = []
+    for s in range(stages):
+        with ht.stage(s):
+            w = ht.VariableOp(f"pw{s}", (8, 8), ht.init.xavier_uniform())
+            b = ht.VariableOp(f"pb{s}", (8,), ht.init.zeros())
+            ws.append((w, b))
+            h = ht.relu_op(ht.matmul_op(h, w) + ht.broadcastto_op(b, h))
+    loss = ht.mse_loss_op(h, y)
+    return x, y, loss
+
+
+def test_stage_scope_sets_raw_ctx():
+    with ht.stage(2):
+        a = ht.placeholder_op("sx", (2, 2))
+        b = a + 1.0
+    c = b * 2.0
+    assert b.raw_ctx == 2
+    assert c.raw_ctx is None  # outside the scope
+
+
+def test_assign_stages_propagates_and_validates():
+    x, y, loss = _mlp_graph(3)
+    topo = find_topo_sort([loss])
+    st = assign_stages(topo)
+    # loss ops inherit the last annotated stage
+    assert st[loss] == 2
+    # monotonicity violation raises
+    with ht.stage(1):
+        a = ht.placeholder_op("mx", (2, 2))
+        h = a + 1.0
+    with ht.stage(0):
+        bad = h * 2.0
+    with pytest.raises(ValueError, match="non-decreasing"):
+        assign_stages(find_topo_sort([bad]))
+
+
+@pytest.mark.parametrize("n_micro", [1, 4])
+def test_mlp_pipeline_matches_single_device(rng, n_micro):
+    x, y, loss = _mlp_graph(4)
+    X = rng.standard_normal((16, 8)).astype(np.float32)
+    Y = rng.standard_normal((16, 8)).astype(np.float32)
+
+    opt1 = ht.AdamOptimizer(1e-2)
+    ex_ref = ht.Executor({"train": [loss, opt1.minimize(loss)]}, seed=3)
+    opt2 = ht.AdamOptimizer(1e-2)
+    mesh = make_mesh({"pp": 4})
+    ex_pp = ht.Executor({"train": [loss, opt2.minimize(loss)]}, seed=3,
+                        mesh=mesh, pipeline="gpipe", num_micro=n_micro)
+
+    for step in range(4):
+        l_ref = ex_ref.run("train", feed_dict={x: X, y: Y},
+                           convert_to_numpy_ret_vals=True)[0]
+        l_pp = ex_pp.run("train", feed_dict={x: X, y: Y},
+                         convert_to_numpy_ret_vals=True)[0]
+        np.testing.assert_allclose(l_pp, l_ref, rtol=2e-5, atol=2e-6)
+
+    for name in ex_ref.params:
+        np.testing.assert_allclose(np.asarray(ex_pp.params[name]),
+                                   np.asarray(ex_ref.params[name]),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_gpt_pipeline_embedding_head_parity(rng):
+    """The VERDICT done-criterion: GPT with embedding + tied LM head
+    trained under pp=4 from the graph API, loss parity vs single-device."""
+    B, S = 8, 16
+    c = GPTConfig(vocab_size=97, hidden_size=32, num_layers=4, num_heads=4,
+                  seq_len=S, dropout_prob=0.0)
+    ids = ht.placeholder_op("gp_ids", (B, S), dtype=np.int32)
+    labels = ht.placeholder_op("gp_labels", (B, S), dtype=np.int32)
+    loss = GPTLMHeadModel(c, name="gppp", pipeline_stages=4).loss(ids,
+                                                                  labels)
+
+    ids_v = rng.integers(0, c.vocab_size, (B, S))
+    lab_v = np.roll(ids_v, -1, axis=1)
+    feed = {ids: ids_v, labels: lab_v}
+
+    opt1 = ht.AdamOptimizer(1e-3)
+    ex_ref = ht.Executor({"train": [loss, opt1.minimize(loss)]}, seed=7)
+    opt2 = ht.AdamOptimizer(1e-3)
+    ex_pp = ht.Executor({"train": [loss, opt2.minimize(loss)]}, seed=7,
+                        mesh=make_mesh({"pp": 4}), pipeline="gpipe",
+                        num_micro=4)
+
+    # the tied embedding/head weight really is shared across stages
+    sub = ex_pp.subexecutor["train"]
+    wte_stages = [st.idx for st in sub.stages
+                  if any(v.name.endswith("wte_table")
+                         for v in st.variables)]
+    assert len(wte_stages) == 2, wte_stages
+
+    losses_ref, losses_pp = [], []
+    for step in range(3):
+        losses_ref.append(ex_ref.run("train", feed_dict=feed,
+                                     convert_to_numpy_ret_vals=True)[0])
+        losses_pp.append(ex_pp.run("train", feed_dict=feed,
+                                   convert_to_numpy_ret_vals=True)[0])
+    np.testing.assert_allclose(losses_pp, losses_ref, rtol=1e-4)
+    # training works: loss decreased
+    assert losses_pp[-1] < losses_pp[0]
+    for name in ex_ref.params:
+        np.testing.assert_allclose(np.asarray(ex_pp.params[name]),
+                                   np.asarray(ex_ref.params[name]),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_pipeline_inference_subgraph(rng):
+    """Forward-only (no optimizer) subgraph under the pipeline executor."""
+    x, y, loss = _mlp_graph(2)
+    ex = ht.Executor({"eval": [loss]}, seed=1, mesh=make_mesh({"pp": 2}),
+                     pipeline="gpipe", num_micro=2)
+    X = rng.standard_normal((16, 8)).astype(np.float32)
+    Y = rng.standard_normal((16, 8)).astype(np.float32)
+    out = ex.run("eval", feed_dict={x: X, y: Y},
+                 convert_to_numpy_ret_vals=True)[0]
+    ex_ref = ht.Executor({"eval": [loss]}, seed=1)
+    ref = ex_ref.run("eval", feed_dict={x: X, y: Y},
+                     convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(out, ref, rtol=2e-5)
