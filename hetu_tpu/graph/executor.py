@@ -74,7 +74,17 @@ class SubExecutor:
         self._all_eval = self.eval_nodes + self._ps_grad_nodes
         if self._ps_grad_nodes:
             self.topo = find_topo_sort(self._all_eval)
+        self._ps_pending = []
         self._jitted = None
+
+    def ps_synchronize(self):
+        """Wait for all in-flight PS pushes (call before reading tables
+        directly or checkpointing the host store)."""
+        for f in self._ps_pending:
+            f.result()
+        self._ps_pending.clear()
+        for p in self.ps_rows:
+            p.ps_embedding.synchronize()
 
     def _build(self):
         placeholders = self.placeholders
@@ -133,21 +143,45 @@ class SubExecutor:
         for p in self.placeholders:
             if p.name not in feeds and hasattr(p, "auto_feed"):
                 feeds[p.name] = p.auto_feed(self.name)
-        # PS embeddings: gather rows on host (through the HET cache when
-        # configured) and feed them (reference SparsePull prefetch path)
+        # PS embeddings: issue ASYNC row gathers through each table's
+        # worker thread (ordered after the previous step's async grad
+        # push), then resolve after the rest of feed prep — so host
+        # store/cache traffic overlaps the still-running previous device
+        # step (reference SparsePull prefetch path,
+        # ParameterServerCommunicate.py:40-56 + executor.py:1541-1567)
         ps_ids = {}
+        ps_futs = {}
         for p in self.ps_rows:
             ids_name = p.ids_node.name
             if ids_name not in feeds:
                 raise ValueError(
                     f"PS embedding {p.name} needs ids feed '{ids_name}'")
             ids_val = np.asarray(feeds[ids_name])
-            ps_ids[p.name] = ids_val
-            rows = p.ps_embedding.lookup(ids_val)
-            # shape follows the FED ids (a new batch size just retraces,
-            # per the executor's shape contract above)
-            feeds[p.name] = rows.reshape(
-                ids_val.shape + (p.ps_embedding.embedding_dim,))
+            if p.inv_node is not None:
+                # unique-feed: gather only the batch's unique rows (bucket-
+                # padded with -1, which the store reads as zeros and drops
+                # on push) and feed the gather indices alongside
+                from ..ps.embedding import _bucket
+                uniq, inv = np.unique(ids_val, return_inverse=True)
+                keys = np.full(_bucket(uniq.size), -1, np.int64)
+                keys[:uniq.size] = uniq
+                feeds[p.inv_node.name] = inv.reshape(
+                    ids_val.shape).astype(np.int32)
+                ps_ids[p.name] = keys
+                ps_futs[p.name] = p.ps_embedding.lookup_async(keys)
+            else:
+                ps_ids[p.name] = ids_val
+                ps_futs[p.name] = p.ps_embedding.lookup_async(ids_val)
+        for p in self.ps_rows:
+            rows = ps_futs[p.name].result()
+            if p.inv_node is not None:
+                feeds[p.name] = rows
+            else:
+                ids_val = ps_ids[p.name]
+                # shape follows the FED ids (a new batch size just
+                # retraces, per the executor's shape contract above)
+                feeds[p.name] = rows.reshape(
+                    ids_val.shape + (p.ps_embedding.embedding_dim,))
         missing = [p.name for p in self.placeholders if p.name not in feeds]
         if missing:
             raise ValueError(f"missing feeds for placeholders: {missing}")
@@ -167,13 +201,32 @@ class SubExecutor:
             ex.params, ex.opt_state, feeds, key)
         ex.params = new_params
         ex.opt_state = new_opt_state
-        # push PS-embedding grads to the host store (server-side optimizer)
+        # push PS-embedding grads ASYNC: the device array goes straight to
+        # the table's worker thread, which blocks on the device→host copy
+        # there — run() returns without waiting for the step, so the push
+        # (and the next step's lookups, queued behind it) hide under
+        # device compute.  push-then-lookup ordering per table keeps the
+        # consistency mode intact; pull_bound/push_bound staleness applies
+        # as before inside the cache.
         if self._ps_grad_nodes:
             n_user = len(self.eval_nodes)
             for p, gval in zip(self.ps_rows, vals[n_user:]):
-                g = np.asarray(gval, dtype=np.float32).reshape(
-                    -1, p.ps_embedding.embedding_dim)
-                p.ps_embedding.push_grad(ps_ids[p.name], g)
+                # start the device→host copy NOW, non-blocking; by the
+                # time the table worker materializes the array the bytes
+                # are (mostly) already on the host — critical when the
+                # device link has high round-trip latency
+                try:
+                    gval.copy_to_host_async()
+                except AttributeError:
+                    pass
+                fut = p.ps_embedding.push_grad_async(
+                    ps_ids[p.name], gval, deduped=p.inv_node is not None)
+                self._ps_pending.append(fut)
+            # surface worker-thread errors, keep the list bounded
+            done = [f for f in self._ps_pending if f.done()]
+            for f in done:
+                f.result()
+                self._ps_pending.remove(f)
             vals = vals[:n_user]
         if convert_to_numpy_ret_vals:
             vals = [None if v is None else np.asarray(v) for v in vals]
@@ -331,6 +384,13 @@ class Executor:
         return self.subexecutor[name].run(
             feed_dict=feed_dict,
             convert_to_numpy_ret_vals=convert_to_numpy_ret_vals)
+
+    def ps_synchronize(self):
+        """Drain in-flight PS embedding traffic across all subgraphs
+        (reference worker barriers before SaveParam, executor.py:589)."""
+        for sub in self.subexecutor.values():
+            if hasattr(sub, "ps_synchronize"):
+                sub.ps_synchronize()
 
     # -- checkpoint (reference executor.py:558-670) ------------------------
     def state_dict(self):

@@ -106,10 +106,18 @@ class PipelineSubExecutor:
     ``executor.params`` / ``executor.opt_state`` stores.
 
     Config (Executor kwargs):
-      pipeline   : 'gpipe' | '1f1b'   (issue order; numerics identical)
-      num_micro  : micro-batches per step (all feeds split on axis 0)
+      pipeline   : 'gpipe' (all forwards, then all backwards — stashes
+                   every micro's boundary activations) | '1f1b'
+                   (pipedream-flush: each micro's backward issues as soon
+                   as its forward drains, so ~n_stages micros of boundary
+                   activations live instead of num_micro; numerics
+                   identical)
+      num_micro  : micro-batches per step (feeds split on axis 0; list
+                   exceptions in non_batch_feeds)
       num_stages : stage count; default = max annotation + 1, or the
                    mesh's 'pp' axis size when a mesh is attached
+      non_batch_feeds : placeholder names fed WHOLE to every micro-batch
+                   (e.g. an [S, S] attention mask)
     """
 
     def __init__(self, name, eval_nodes, executor):
@@ -371,19 +379,80 @@ class PipelineSubExecutor:
         if missing:
             raise ValueError(f"missing feeds for placeholders: {missing}")
         m = self.n_micro
+        # feeds named in config 'non_batch_feeds' (e.g. a [S, S] attention
+        # mask whose leading dim is NOT the batch) are replicated to every
+        # micro-batch instead of split
+        non_batch = set(self.executor.config.get("non_batch_feeds", ()))
         per_micro = [dict() for _ in range(m)]
         for p in self.placeholders:
             v = np.asarray(feeds[p.name])
-            if v.shape and v.shape[0] % m == 0:
+            if p.name in non_batch or not v.shape:
+                whole = self._cast(jnp.asarray(v, dtype=p.dtype))
+                for i in range(m):
+                    per_micro[i][p.name] = whole
+                continue
+            if v.shape[0] % m == 0:
                 chunks = np.split(v, m, axis=0)
             else:
                 raise ValueError(
                     f"feed {p.name} (shape {v.shape}) not splittable into "
-                    f"{m} micro-batches along axis 0")
+                    f"{m} micro-batches along axis 0; list it in "
+                    "non_batch_feeds if it should be fed whole")
             for i in range(m):
                 per_micro[i][p.name] = self._cast(
                     jnp.asarray(chunks[i], dtype=p.dtype))
         return per_micro
+
+    def _stage_pviews(self, params):
+        """Per-stage parameter views, built ONCE per pass: device_put is a
+        no-op for home params and an ICI transfer for variables shared
+        across stages (e.g. a tied LM head) — hoisting it out of the
+        micro loop issues that transfer once per stage, not per micro."""
+        return [{v.name: st.device_put(params[v.name])
+                 for v in st.variables} for st in self.stages]
+
+    def _fwd_micro(self, i, s, pviews, stage_feeds, acts, evals, keys):
+        st = self.stages[s]
+        ins = {u.name: st.device_put(acts[i][u.name])
+               for u in st.acts_in}
+        outs = st.fwd(pviews[s], stage_feeds[i][s], ins, keys[i])
+        for n in st.out_nodes:
+            if n in st.acts_out:
+                acts[i][n.name] = outs[n.name]
+            if n in st.evals or n is st.loss:
+                evals[i][n.name] = outs[n.name]
+
+    def _bwd_micro(self, i, pviews, stage_feeds, acts, evals, keys,
+                   grad_acc, loss_ct):
+        """Issue micro ``i``'s backward chain (last stage → first) and
+        release its boundary activations."""
+        cts = defaultdict(list)
+        for s in reversed(range(self.n_stages)):
+            st = self.stages[s]
+            if not st.diff_vars and not st.acts_in:
+                continue
+            ins = {u.name: st.device_put(acts[i][u.name])
+                   for u in st.acts_in}
+            ct_in = {}
+            for n in st.diff_outs:
+                if n is st.loss and n not in st.acts_out:
+                    ct_in[n.name] = jnp.asarray(
+                        loss_ct, evals[i][n.name].dtype)
+                else:
+                    pend = cts.pop(n.name, None)
+                    ct_in[n.name] = (
+                        self._accum(pend, st.device_put) if pend else
+                        st.device_put(jnp.zeros_like(acts[i][n.name])))
+                    if n is st.loss:
+                        ct_in[n.name] = ct_in[n.name] + jnp.asarray(
+                            loss_ct, ct_in[n.name].dtype)
+            gvars, gacts = st.bwd(pviews[s], stage_feeds[i][s], ins,
+                                  ct_in, keys[i])
+            for name, g in gvars.items():
+                grad_acc.setdefault(name, []).append(g)
+            for name, g in gacts.items():
+                cts[name].append(g)
+        acts[i].clear()   # boundary activations of micro i are consumed
 
     def run(self, feed_dict=None, convert_to_numpy_ret_vals=False):
         if not self._built:
@@ -399,69 +468,38 @@ class PipelineSubExecutor:
                          for p in st.placeholders}
                         for st in self.stages] for i in range(m)]
         params = ex.params
+        pviews = self._stage_pviews(params)
 
-        # ---- forward ---------------------------------------------------
         acts = [dict() for _ in range(m)]      # micro -> {name: value}
         evals = [dict() for _ in range(m)]     # micro -> {name: value}
+        grad_acc = {}                          # var name -> [values]
+        loss_ct = 1.0 / m                      # step loss = mean of micros
+
         # wavefront issue order: (micro+stage) diagonal — stage s of micro
         # i is issued right after its dependencies, and JAX async dispatch
         # overlaps the stage programs across their device sets (the role
-        # of the reference's per-rank schedulers + NCCL group batching)
+        # of the reference's per-rank schedulers + NCCL group batching).
+        # schedule='1f1b' (pipedream-flush, pipedream_subexecutor.py:25)
+        # additionally issues each micro's FULL backward chain as soon as
+        # its forward leaves the last stage, releasing that micro's
+        # boundary activations — at most ~n_stages micros live at once
+        # instead of all n_micro (gpipe_subexecutor.py:7 stashes all).
         order = sorted(((i, s) for i in range(m)
                         for s in range(self.n_stages)),
                        key=lambda t: (t[0] + t[1], t[1]))
         for i, s in order:
-            st = self.stages[s]
-            # device_put is a no-op for home params and an ICI transfer
-            # for variables shared across stages (e.g. tied LM head)
-            pview = {v.name: st.device_put(params[v.name])
-                     for v in st.variables}
-            ins = {u.name: st.device_put(acts[i][u.name])
-                   for u in st.acts_in}
-            outs = st.fwd(pview, stage_feeds[i][s], ins, keys[i])
-            for n in st.out_nodes:
-                if n in st.acts_out:
-                    acts[i][n.name] = outs[n.name]
-                if n in st.evals or n is st.loss:
-                    evals[i][n.name] = outs[n.name]
-
-        # ---- backward + accumulate ------------------------------------
-        if self.training:
-            grad_acc = {}                       # var name -> value
-            cts = [defaultdict(list) for _ in range(m)]
-            loss_ct = 1.0 / m                   # step loss = mean of micros
+            self._fwd_micro(i, s, pviews, stage_feeds, acts, evals, keys)
+            if (self.training and self.schedule == "1f1b"
+                    and s == self.n_stages - 1):
+                self._bwd_micro(i, pviews, stage_feeds, acts, evals, keys,
+                                grad_acc, loss_ct)
+        if self.training and self.schedule == "gpipe":
             for i in reversed(range(m)):
-                for s in reversed(range(self.n_stages)):
-                    st = self.stages[s]
-                    if not st.diff_vars and not st.acts_in:
-                        continue
-                    pview = {v.name: st.device_put(params[v.name])
-                             for v in st.variables}
-                    ins = {u.name: st.device_put(acts[i][u.name])
-                           for u in st.acts_in}
-                    ct_in = {}
-                    for n in st.diff_outs:
-                        if n is st.loss and n not in st.acts_out:
-                            ct_in[n.name] = jnp.asarray(
-                                loss_ct, evals[i][n.name].dtype)
-                        else:
-                            pend = cts[i].pop(n.name, None)
-                            ct_in[n.name] = (
-                                self._accum(pend, st.device_put)
-                                if pend else
-                                st.device_put(
-                                    jnp.zeros_like(acts[i][n.name])))
-                            if n is st.loss:
-                                ct_in[n.name] = ct_in[n.name] + jnp.asarray(
-                                    loss_ct, ct_in[n.name].dtype)
-                    gvars, gacts = st.bwd(pview, stage_feeds[i][s], ins,
-                                          ct_in, keys[i])
-                    for name, g in gvars.items():
-                        grad_acc.setdefault(name, []).append(g)
-                    for name, g in gacts.items():
-                        cts[i][name].append(g)
+                self._bwd_micro(i, pviews, stage_feeds, acts, evals, keys,
+                                grad_acc, loss_ct)
 
-            # ---- optimizer update per stage ----------------------------
+        # ---- optimizer update per stage --------------------------------
+        if self.training:
             opt_state = ex.opt_state[self.opt_op.name]
             step = opt_state["step"]
             scale = jnp.asarray(1.0)
@@ -499,13 +537,15 @@ class PipelineSubExecutor:
             if n is self.opt_op:
                 vals.append(None)
                 continue
+            # all micro values of one node come from the SAME stage (and
+            # device), so aggregation runs on-device — no host bounce
             per = [evals[i][n.name] for i in range(m)]
             if per[0].ndim == 0:
-                v = np.mean([np.asarray(x, np.float32) for x in per])
-                v = jnp.asarray(v, per[0].dtype)
+                v = jnp.mean(jnp.stack(
+                    [x.astype(jnp.float32) for x in per])).astype(
+                        per[0].dtype)
             else:
-                v = jnp.concatenate(
-                    [jnp.asarray(np.asarray(x)) for x in per], axis=0)
+                v = jnp.concatenate(per, axis=0)
             vals.append(np.asarray(v) if convert_to_numpy_ret_vals else v)
         return vals
 

@@ -136,17 +136,27 @@ class MegatronLM(Strategy):
     COL_W = re.compile(r"(_q|_k|_v|_in)_weight$")
     COL_B = re.compile(r"(_q|_k|_v|_in)_bias$")
     ROW_W = re.compile(r"_out_weight$")
+    # embedding tables (layers/common.py Embedding -> '<name>_table'):
+    # vocab-parallel dim-0 sharding; a table also used as a tied LM head
+    # (h @ table^T) then yields vocab-sharded logits, and the sparse CE's
+    # reductions stay sharded under GSPMD.  Reference: megatron
+    # VocabParallelEmbedding + sharded LM head
+    # (core/tensor_parallel/transformer.py).
+    EMB_W = re.compile(r"_table$")
 
     def __init__(self, mesh=None, dp=1, tp=None, dp_axis="dp",
-                 tp_axis="tp"):
+                 tp_axis="tp", shard_embeddings=True):
         if mesh is None:
             tp = tp or (_ndev() // dp)
             mesh = make_mesh({dp_axis: dp, tp_axis: tp})
         self.mesh = mesh
         self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.shard_embeddings = shard_embeddings
 
     def annotate(self, eval_nodes):
         tp_size = self.mesh.shape[self.tp_axis]
+        matched = 0
+        skipped = []
         for n in find_topo_sort(eval_nodes):
             if isinstance(n, PlaceholderOp):
                 n.dist_state = DistState({0: self.dp_axis})
@@ -157,6 +167,30 @@ class MegatronLM(Strategy):
                     n.dist_state = DistState({0: self.tp_axis})
                 elif self.ROW_W.search(n.name) and n.shape[0] % tp_size == 0:
                     n.dist_state = DistState({0: self.tp_axis})
+                elif (self.shard_embeddings and self.EMB_W.search(n.name)
+                      and n.shape[0] % tp_size == 0):
+                    n.dist_state = DistState({0: self.tp_axis})
+                else:
+                    if (self.COL_W.search(n.name)
+                            or self.COL_B.search(n.name)
+                            or self.ROW_W.search(n.name)
+                            or self.EMB_W.search(n.name)):
+                        skipped.append(n.name)  # matched name, bad divisor
+                    continue
+                matched += 1
+        if tp_size > 1 and matched == 0:
+            # the naming contract silently matching NOTHING means every
+            # parameter stays replicated — plain DP at tp memory cost
+            import warnings
+            warnings.warn(
+                "MegatronLM.annotate: no variable matched the naming "
+                "contract (_q/_k/_v/_in/_out weights, *_table embeddings)"
+                + (f"; name-matched but not divisible by tp={tp_size}: "
+                   f"{skipped}" if skipped else "")
+                + " — all parameters remain replicated. Check layer "
+                "names or pass shard rules explicitly.",
+                stacklevel=2)
+        self.matched_variables = matched
         return self.mesh
 
 

@@ -16,6 +16,8 @@ workers, while XLA sees a dense [batch, dim] input.
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
+
 import numpy as np
 
 from ..graph.node import PlaceholderOp, Op
@@ -23,17 +25,33 @@ from .store import EmbeddingTable, CacheTable
 
 
 class PSRowsOp(PlaceholderOp):
-    """Placeholder carrying PS-gathered embedding rows [*, dim].
+    """Placeholder carrying PS-gathered embedding rows.
 
     The executor recognizes this subclass: it fills the feed from the
-    bound ids feed via the table/cache, and pushes d loss/d rows back."""
+    bound ids feed via the table/cache, and pushes d loss/d rows back.
+    With ``inv_node`` set (unique-feed mode) the rows are the batch's
+    UNIQUE rows [U, dim] (U bucketed for static shapes) and ``inv_node``
+    carries the gather indices — an order-of-magnitude less host↔device
+    traffic than dense [batch, field, dim] rows, with the duplicate-id
+    grad reduction done on device (gather's VJP = segment-sum; reference
+    UniqueIndices.cu + ReduceIndexedSlice.cu)."""
 
-    __slots__ = ("ps_embedding", "ids_node")
+    __slots__ = ("ps_embedding", "ids_node", "inv_node")
 
-    def __init__(self, name, shape, ps_embedding, ids_node):
+    def __init__(self, name, shape, ps_embedding, ids_node, inv_node=None):
         super().__init__(name, shape=shape, dtype=np.float32)
         self.ps_embedding = ps_embedding
         self.ids_node = ids_node
+        self.inv_node = inv_node
+
+
+def _bucket(n, floor=512):
+    """Static-shape bucket for a unique-id count: next power of two (min
+    ``floor``) so XLA compiles a handful of variants, not one per batch."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
 
 
 class PSEmbedding:
@@ -48,11 +66,14 @@ class PSEmbedding:
 
     def __init__(self, num_embeddings, embedding_dim, optimizer="sgd",
                  lr=0.01, cache_limit=None, policy="lru", pull_bound=0,
-                 push_bound=1, seed=0, name=None, **opt_kw):
+                 push_bound=1, seed=0, name=None, unique_feed=True,
+                 stale_reads=False, **opt_kw):
         PSEmbedding._count[0] += 1
         self.name = name or f"ps_embedding_{PSEmbedding._count[0]}"
         self.num_embeddings = int(num_embeddings)
         self.embedding_dim = int(embedding_dim)
+        self.unique_feed = bool(unique_feed)
+        self.stale_reads = bool(stale_reads)
         self.table = EmbeddingTable(num_embeddings, embedding_dim,
                                     optimizer=optimizer, lr=lr, seed=seed,
                                     **opt_kw)
@@ -61,29 +82,83 @@ class PSEmbedding:
                                  push_bound=push_bound)
                       if cache_limit else None)
         self._lookup_count = 0
+        # ONE worker thread orders all store traffic (push N before
+        # lookup N+1, so overlap never weakens the consistency mode) —
+        # the reference's async client also funnels through one agent
+        # thread (hetu_client.cc).  Executor-visible futures let host
+        # cache traffic hide under device compute
+        # (ParameterServerCommunicate.py:40-56 prefetch contract).
+        self._worker = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"{self.name}_ps")
+        # stale_reads (HET ASP mode): lookups run on their own reader
+        # thread, CONCURRENT with in-flight pushes, so the step pipeline
+        # never stalls on the previous step's grad round trip.  Staleness
+        # is bounded by the pushes in flight (≤1 step under the executor)
+        # plus the cache's pull_bound versioning; the native store's lock
+        # shards make concurrent read/write safe.  Reference:
+        # _compute_asp_prefetch (ParameterServerCommunicate.py:40-56).
+        self._reader = (ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"{self.name}_ps_rd")
+            if stale_reads else None)
 
     # -- host-side data path ------------------------------------------------
-    def lookup(self, keys):
+    def _lookup_sync(self, keys):
         self._lookup_count += 1
         if self.cache is not None:
             return self.cache.lookup(keys)
         return self.table.lookup(keys)
 
-    def push_grad(self, keys, grads):
-        # dedup duplicate ids (sum their grads) so each row gets ONE
-        # optimizer step per batch — reference ReduceIndexedSlice.cu
-        # (unique + segment-sum) ahead of the sparse optimizer kernels
+    def _push_sync(self, keys, grads, deduped=False):
         keys = np.asarray(keys).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(keys.size, -1)
-        uniq, inv = np.unique(keys, return_inverse=True)
-        summed = np.zeros((uniq.size, grads.shape[1]), np.float32)
-        np.add.at(summed, inv, grads)
+        if not deduped:
+            # dedup duplicate ids (sum their grads) so each row gets ONE
+            # optimizer step per batch — reference ReduceIndexedSlice.cu
+            # (unique + segment-sum) ahead of the sparse optimizer
+            # kernels.  The unique-feed executor path already deduped on
+            # device (gather VJP = segment-sum) and skips this.
+            uniq, inv = np.unique(keys, return_inverse=True)
+            summed = np.zeros((uniq.size, grads.shape[1]), np.float32)
+            np.add.at(summed, inv, grads)
+            keys, grads = uniq, summed
         if self.cache is not None:
-            self.cache.update(uniq, summed)
+            self.cache.update(keys, grads)
         else:
-            self.table.push(uniq, summed)
+            self.table.push(keys, grads)
+
+    def lookup(self, keys):
+        """Row gather, ordered after every previously issued push."""
+        return self.lookup_async(np.asarray(keys)).result()
+
+    def push_grad(self, keys, grads, deduped=False):
+        self.push_grad_async(keys, grads, deduped).result()
+
+    def lookup_async(self, keys):
+        """Future of the row gather.  Ordered after pending pushes (BSP),
+        unless ``stale_reads`` routes it to the concurrent reader."""
+        keys = np.asarray(keys)
+        pool = self._reader if self._reader is not None else self._worker
+        return pool.submit(self._lookup_sync, keys)
+
+    def push_grad_async(self, keys, grads, deduped=False):
+        """Future of the grad push.  ``grads`` may be a DEVICE array: the
+        worker converts it, so the device→host sync happens off the
+        critical path (the executor's step N push overlaps its step N+1
+        dispatch).  ``deduped=True`` skips the host-side duplicate-id
+        reduction (keys already unique, e.g. from the unique-feed path)."""
+        keys = np.asarray(keys)
+        return self._worker.submit(
+            lambda: self._push_sync(keys, np.asarray(grads, np.float32),
+                                    deduped))
+
+    def synchronize(self):
+        """Drain the worker queue (all issued lookups/pushes applied)."""
+        self._worker.submit(lambda: None).result()
+        if self._reader is not None:
+            self._reader.submit(lambda: None).result()
 
     def flush(self):
+        self.synchronize()
         if self.cache is not None:
             self.cache.flush()
 
@@ -93,6 +168,17 @@ class PSEmbedding:
     # -- graph construction -------------------------------------------------
     def __call__(self, ids_node):
         assert isinstance(ids_node, Op), "pass the ids placeholder node"
-        shape = tuple(ids_node.shape) + (self.embedding_dim,)
-        return PSRowsOp(f"{self.name}_rows_{ids_node.name}", shape, self,
-                        ids_node)
+        if not self.unique_feed:
+            shape = tuple(ids_node.shape) + (self.embedding_dim,)
+            return PSRowsOp(f"{self.name}_rows_{ids_node.name}", shape,
+                            self, ids_node)
+        # unique-feed mode: host feeds [U, dim] unique rows + [batch...]
+        # gather indices; the graph gathers on device and the rows' VJP
+        # (a segment-sum scatter) dedups duplicate-id grads on device
+        from ..ops.embedding import embedding_lookup_op
+        inv = PlaceholderOp(f"{self.name}_uinv_{ids_node.name}",
+                            shape=tuple(ids_node.shape), dtype=np.int32)
+        rows = PSRowsOp(f"{self.name}_urows_{ids_node.name}",
+                        (None, self.embedding_dim), self, ids_node,
+                        inv_node=inv)
+        return embedding_lookup_op(rows, inv)

@@ -127,6 +127,51 @@ def test_gpt_pipeline_embedding_head_parity(rng):
                                    rtol=5e-3, atol=5e-4)
 
 
+def test_1f1b_matches_gpipe_numerics(rng):
+    x, y, loss = _mlp_graph(4)
+    X = rng.standard_normal((16, 8)).astype(np.float32)
+    Y = rng.standard_normal((16, 8)).astype(np.float32)
+    results = {}
+    for sched in ("gpipe", "1f1b"):
+        opt = ht.AdamOptimizer(1e-2)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=3,
+                         mesh=make_mesh({"pp": 4}), pipeline=sched,
+                         num_micro=4)
+        results[sched] = [
+            ex.run("train", feed_dict={x: X, y: Y},
+                   convert_to_numpy_ret_vals=True)[0]
+            for _ in range(3)]
+    np.testing.assert_allclose(results["1f1b"], results["gpipe"],
+                               rtol=1e-6)
+
+
+def test_non_batch_feeds_fed_whole(rng):
+    """A feed whose leading dim is NOT the batch (an [S,S]-style matrix)
+    must reach every micro-batch whole when listed in non_batch_feeds."""
+    x = ht.placeholder_op("nb_x", (8, 4))
+    w = ht.placeholder_op("nb_w", (4, 4))  # weight-like, not batch-dim
+    y = ht.placeholder_op("nb_y", (8, 4))
+    with ht.stage(0):
+        h = ht.matmul_op(x, w)
+    with ht.stage(1):
+        v = ht.VariableOp("nb_v", (4, 4), ht.init.xavier_uniform())
+        loss = ht.mse_loss_op(ht.matmul_op(h, v), y)
+    X = rng.standard_normal((8, 4)).astype(np.float32)
+    W = rng.standard_normal((4, 4)).astype(np.float32)
+    Y = rng.standard_normal((8, 4)).astype(np.float32)
+    opt = ht.AdamOptimizer(1e-2)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0,
+                     mesh=make_mesh({"pp": 2}), pipeline="gpipe",
+                     num_micro=2, non_batch_feeds=["nb_w"])
+    opt2 = ht.AdamOptimizer(1e-2)
+    ex_ref = ht.Executor({"train": [loss, opt2.minimize(loss)]}, seed=0)
+    l_pp = ex.run("train", feed_dict={x: X, w: W, y: Y},
+                  convert_to_numpy_ret_vals=True)[0]
+    l_ref = ex_ref.run("train", feed_dict={x: X, w: W, y: Y},
+                       convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(l_pp, l_ref, rtol=2e-5)
+
+
 def test_pipeline_inference_subgraph(rng):
     """Forward-only (no optimizer) subgraph under the pipeline executor."""
     x, y, loss = _mlp_graph(2)

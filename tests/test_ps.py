@@ -305,6 +305,56 @@ def test_wdl_with_ps_cache_trains(rng):
     assert losses[-1] < losses[0]
 
 
+def test_ps_async_overlap_preserves_trajectory(rng):
+    """The async push/lookup pipeline must be semantically invisible:
+    per-table ordering (push N before lookup N+1) makes the overlapped
+    trajectory identical to a fully-synchronized one."""
+    B, D, vocab = 16, 8, 64
+    ids_v = rng.integers(0, vocab, (B,))
+    y_v = rng.standard_normal((B, D)).astype(np.float32)
+
+    def run(sync_every_step):
+        emb = PSEmbedding(vocab, D, optimizer="sgd", lr=0.5, seed=11)
+        ids = ht.placeholder_op(f"ov_ids_{sync_every_step}", (B,),
+                                dtype=np.int64)
+        y = ht.placeholder_op(f"ov_y_{sync_every_step}", (B, D))
+        loss = ht.mse_loss_op(emb(ids), y)
+        ex = ht.Executor([loss, ht.SGDOptimizer(0.1).minimize(loss)])
+        out = []
+        for _ in range(6):
+            out.append(float(ex.run(feed_dict={ids: ids_v, y: y_v},
+                                    convert_to_numpy_ret_vals=True)[0]))
+            if sync_every_step:
+                ex.ps_synchronize()
+        ex.ps_synchronize()
+        return out
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+def test_ps_stale_reads_bounded_and_converges(rng):
+    """HET ASP mode (stale_reads=True): lookups run concurrent with
+    pushes, staleness bounded by in-flight pushes — after synchronize()
+    every push is visible, and training still converges."""
+    B, D, vocab = 16, 8, 64
+    ids_v = rng.integers(0, vocab, (B,))
+    y_v = np.zeros((B, D), np.float32)
+    emb = PSEmbedding(vocab, D, optimizer="sgd", lr=0.5, seed=3,
+                      stale_reads=True)
+    ids = ht.placeholder_op("st_ids", (B,), dtype=np.int64)
+    y = ht.placeholder_op("st_y", (B, D))
+    loss = ht.mse_loss_op(emb(ids), y)
+    ex = ht.Executor([loss, ht.SGDOptimizer(0.1).minimize(loss)])
+    losses = [float(ex.run(feed_dict={ids: ids_v, y: y_v},
+                           convert_to_numpy_ret_vals=True)[0])
+              for _ in range(40)]
+    assert losses[-1] < 0.6 * losses[0], losses
+    # bounded staleness: after drain, a fresh lookup reflects ALL pushes
+    ex.ps_synchronize()
+    rows = emb.lookup(ids_v)
+    assert float(np.abs(rows).mean()) < float(np.sqrt(1.0 / D))
+
+
 def test_ps_embedding_grads_deduped(rng):
     """Duplicate ids in one batch must produce ONE summed update per row."""
     B, D, vocab = 8, 4, 16
@@ -316,6 +366,7 @@ def test_ps_embedding_grads_deduped(rng):
     train = ht.SGDOptimizer(0.1).minimize(loss)
     ex = ht.Executor([loss, train])
     ex.run(feed_dict={ids: ids_v})
+    ex.ps_synchronize()   # grads push async; drain before raw table reads
     # d loss/d row = 1 per occurrence → summed grad = B; sgd lr=1 → w = -B
     np.testing.assert_allclose(emb.table.lookup([0])[0], -float(B),
                                rtol=1e-6)
