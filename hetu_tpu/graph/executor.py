@@ -80,11 +80,18 @@ class SubExecutor:
     def ps_synchronize(self):
         """Wait for all in-flight PS pushes (call before reading tables
         directly or checkpointing the host store)."""
+        first_error = None
         for f in self._ps_pending:
-            f.result()
+            try:
+                f.result()
+            except Exception as e:   # drain everything, report once
+                if first_error is None:
+                    first_error = e
         self._ps_pending.clear()
         for p in self.ps_rows:
             p.ps_embedding.synchronize()
+        if first_error is not None:
+            raise first_error
 
     def _build(self):
         placeholders = self.placeholders

@@ -4,15 +4,20 @@ onnx_opset/; see SURVEY.md P20).
 * `hetu2onnx(eval_nodes, params)` — graph + trained weights -> OnnxModel
 * `onnx2hetu(model)`              — OnnxModel -> (placeholders, outputs)
 * `save_model` / `load_model`     — portable zip (works without `onnx`)
-* `to_onnx_proto`/`from_onnx_proto` — real protobufs when `onnx` is present
-  (`HAS_ONNX` flags availability; the build image does not ship it)
+* `save_onnx` / `load_onnx`       — REAL `.onnx` protobuf files via the
+  pure-Python wire codec (wire.py); no `onnx` package needed
+* `serialize_model`/`deserialize_model` — the same, to/from bytes
+* `to_onnx_proto`/`from_onnx_proto` — onnx-package objects when available
 """
 
 from .ir import OnnxModel, NodeIR, TensorInfo, save_model, load_model
 from .export import hetu2onnx
 from .import_ import onnx2hetu
-from .proto import HAS_ONNX, to_onnx_proto, from_onnx_proto
+from .proto import (HAS_ONNX, to_onnx_proto, from_onnx_proto,
+                    serialize_model, deserialize_model, save_onnx,
+                    load_onnx)
 
 __all__ = ["OnnxModel", "NodeIR", "TensorInfo", "save_model", "load_model",
            "hetu2onnx", "onnx2hetu", "HAS_ONNX", "to_onnx_proto",
-           "from_onnx_proto"]
+           "from_onnx_proto", "serialize_model", "deserialize_model",
+           "save_onnx", "load_onnx"]

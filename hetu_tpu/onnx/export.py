@@ -12,6 +12,7 @@ import numpy as np
 from ..graph.node import Op, PlaceholderOp, VariableOp, find_topo_sort
 from ..ops.base import SimpleOp
 from ..ops.nn import BatchNormOp, DropoutOp
+from ..ops.attention import ScaledDotProductAttentionOp
 from .ir import OnnxModel, NodeIR, TensorInfo
 
 _EXPORTERS = {}
@@ -26,8 +27,9 @@ def exporter(*kinds):
 
 
 class _Ctx:
-    def __init__(self, model):
+    def __init__(self, model, shapes=None):
         self.model = model
+        self.shapes = shapes or {}     # Op -> inferred shape tuple
         self._n = 0
 
     def aux(self, hint):
@@ -53,7 +55,7 @@ def _simple(onnx_type, **fixed):
 
 for kind, typ in [
         ("add", "Add"), ("minus", "Sub"), ("multiply", "Mul"),
-        ("divide", "Div"), ("matmul", "MatMul"), ("batch_matmul", "MatMul"),
+        ("divide", "Div"),
         ("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
         ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"),
         ("abs", "Abs"), ("sign", "Sign"), ("floor", "Floor"),
@@ -64,6 +66,29 @@ for kind, typ in [
         ("bool_eq", "Equal"), ("bool_gt", "Greater"), ("bool_lt", "Less"),
         ("stop_gradient", "Identity"), ("zeros_like", "Identity")]:
     _EXPORTERS[kind] = _simple(typ)
+
+
+@exporter("matmul", "batch_matmul")
+def _matmul(node, ctx):
+    """MatMul honoring trans_A/trans_B attrs (the tied LM head uses
+    h @ table^T): emit explicit Transpose nodes on the transposed side."""
+    names = [node.inputs[0].name, node.inputs[1].name]
+    out = []
+    for slot, key in ((0, "trans_A"), (1, "trans_B")):
+        if node.attrs.get(key):
+            shp = ctx.shapes.get(node.inputs[slot])
+            if shp is None:
+                raise NotImplementedError(
+                    f"matmul export for {node.name} with {key} needs "
+                    "inferable shapes (declare placeholder shapes)")
+            ndim = len(shp)
+            perm = tuple(range(ndim - 2)) + (ndim - 1, ndim - 2)
+            t = ctx.aux(f"{node.name}_t{slot}")
+            out.append(NodeIR("Transpose", [names[slot]], [t],
+                              {"perm": perm}))
+            names[slot] = t
+    out.append(NodeIR("MatMul", names, [node.name], name=node.name))
+    return out
 
 
 @exporter("gelu")
@@ -267,6 +292,99 @@ def _export_dropout(node, ctx):
                    name=node.name)]
 
 
+def _export_sdpa(node, ctx):
+    """ScaledDotProductAttentionOp -> Transpose/MatMul/Mul/Add/Softmax/
+    MatMul decomposition (inference export: attention dropout off), the
+    same lowering the reference's bridge applies to its attention layers."""
+    q, k, v = node.inputs[:3]
+    qshape = ctx.shapes.get(q)
+    if qshape is None:
+        raise NotImplementedError(
+            f"attention export for {node.name} needs inferable shapes "
+            "(declare placeholder shapes)")
+    d = qshape[-1]
+    scale = node.scale if node.scale is not None else 1.0 / float(np.sqrt(d))
+    out = []
+    kt = ctx.aux(f"{node.name}_kT")
+    out.append(NodeIR("Transpose", [k.name], [kt], {"perm": (0, 1, 3, 2)}))
+    scores = ctx.aux(f"{node.name}_scores")
+    out.append(NodeIR("MatMul", [q.name, kt], [scores]))
+    cur = ctx.aux(f"{node.name}_scaled")
+    out.append(NodeIR("Mul", [scores,
+                              ctx.const(f"{node.name}_scale",
+                                        np.asarray(scale, np.float32))],
+                      [cur]))
+    if node.causal:
+        s_q = qshape[-2]
+        s_k = ctx.shapes.get(k, qshape)[-2]
+        causal = np.where(
+            np.arange(s_q)[:, None] >= np.arange(s_k)[None, :] - (s_k - s_q),
+            0.0, -1e9).astype(np.float32)[None, None]
+        nxt = ctx.aux(f"{node.name}_causal")
+        out.append(NodeIR("Add", [cur, ctx.const(f"{node.name}_cmask",
+                                                 causal)], [nxt]))
+        cur = nxt
+    if node.has_mask:
+        nxt = ctx.aux(f"{node.name}_masked")
+        out.append(NodeIR("Add", [cur, node.inputs[3].name], [nxt]))
+        cur = nxt
+    probs = ctx.aux(f"{node.name}_probs")
+    out.append(NodeIR("Softmax", [cur], [probs], {"axis": -1}))
+    out.append(NodeIR("MatMul", [probs, v.name], [node.name],
+                      name=node.name))
+    return out
+
+
+def _export_position_ids(node, ctx):
+    """models.bert.PositionIdsOp: table[None, :S, :] as Slice+Unsqueeze."""
+    starts = ctx.const(f"{node.name}_s0", np.asarray([0], np.int64))
+    ends = ctx.const(f"{node.name}_s1",
+                     np.asarray([node.seq_len], np.int64))
+    axes0 = ctx.const(f"{node.name}_ax", np.asarray([0], np.int64))
+    sliced = ctx.aux(f"{node.name}_rows")
+    return [
+        NodeIR("Slice", [_in(node, 0), starts, ends, axes0], [sliced]),
+        NodeIR("Unsqueeze", [sliced, axes0], [node.name], name=node.name),
+    ]
+
+
+def _infer_shapes(eval_nodes, params):
+    """Abstractly evaluate the graph to get every node's shape (the role
+    of the reference's per-op infer_shape pass, Node.py:130).  Returns {}
+    when placeholders lack declared shapes."""
+    import jax
+    import jax.numpy as jnp
+    from ..graph.trace import TraceContext, evaluate
+
+    topo = find_topo_sort(list(eval_nodes))
+    phs = [n for n in topo if isinstance(n, PlaceholderOp)]
+    vars_ = [n for n in topo if isinstance(n, VariableOp)]
+    if any(p.shape is None for p in phs):
+        return {}
+    interior = [n for n in topo
+                if not isinstance(n, (PlaceholderOp, VariableOp))]
+
+    def f(feed_vals):
+        ctx = TraceContext(key=jax.random.key(0), training=False)
+        bindings = dict(zip(phs, feed_vals))
+        for vr in vars_:
+            bindings[vr] = jnp.zeros(np.shape(params[vr.name]),
+                                     np.asarray(params[vr.name]).dtype)
+        _, env = evaluate(eval_nodes, bindings, ctx)
+        return [env[n] for n in interior]
+
+    feed_structs = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype)
+                    for p in phs]
+    try:
+        outs = jax.eval_shape(f, feed_structs)
+    except Exception:
+        return {}
+    shapes = {n: tuple(o.shape) for n, o in zip(interior, outs)}
+    shapes.update({p: tuple(p.shape) for p in phs})
+    shapes.update({vr: tuple(np.shape(params[vr.name])) for vr in vars_})
+    return shapes
+
+
 _NP2ONNX_DTYPE = {"float32": "float32", "float64": "float64",
                   "int32": "int32", "int64": "int64"}
 
@@ -280,7 +398,7 @@ def hetu2onnx(eval_nodes, params, name="hetu_tpu_graph"):
     """
     from ..graph.executor import Executor  # noqa: F401 (doc only)
     model = OnnxModel(name=name)
-    ctx = _Ctx(model)
+    ctx = _Ctx(model, shapes=_infer_shapes(eval_nodes, params))
     topo = find_topo_sort(list(eval_nodes))
     for node in topo:
         if isinstance(node, PlaceholderOp):
@@ -296,6 +414,10 @@ def hetu2onnx(eval_nodes, params, name="hetu_tpu_graph"):
             model.nodes.extend(_export_batchnorm(node, ctx))
         elif isinstance(node, DropoutOp):
             model.nodes.extend(_export_dropout(node, ctx))
+        elif isinstance(node, ScaledDotProductAttentionOp):
+            model.nodes.extend(_export_sdpa(node, ctx))
+        elif type(node).__name__ == "PositionIdsOp":
+            model.nodes.extend(_export_position_ids(node, ctx))
         elif isinstance(node, SimpleOp):
             fn = _EXPORTERS.get(node.op_kind)
             if fn is None:

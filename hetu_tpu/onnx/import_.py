@@ -151,6 +151,35 @@ def _unsqueeze(node, env):
     return out
 
 
+@importer("Slice")
+def _slice_imp(node, env):
+    """Static Slice (starts/ends/axes from initializers), the form our
+    exporter and most inference exporters emit."""
+    import jax
+
+    starts = [int(v) for v in env.const(node.inputs[1])]
+    ends = [int(v) for v in env.const(node.inputs[2])]
+    axes = ([int(v) for v in env.const(node.inputs[3])]
+            if len(node.inputs) > 3 else list(range(len(starts))))
+    if len(node.inputs) > 4:
+        steps = [int(v) for v in env.const(node.inputs[4])]
+        if any(s != 1 for s in steps):
+            raise NotImplementedError("Slice with step != 1")
+    x = env.op(node.inputs[0])
+
+    def body(a, starts=tuple(starts), ends=tuple(ends), axes=tuple(axes)):
+        idx = [slice(None)] * a.ndim
+        for st, en, ax in zip(starts, ends, axes):
+            dim = a.shape[ax]
+            en_c = min(en, dim) if en >= 0 else en + dim
+            st_c = st if st >= 0 else st + dim
+            idx[ax] = slice(st_c, en_c)
+        return a[tuple(idx)]
+
+    from ..ops.base import simple_op
+    return simple_op(body, "slice_static")(x)
+
+
 @importer("Squeeze")
 def _squeeze(node, env):
     if len(node.inputs) > 1 and node.inputs[1]:

@@ -1,10 +1,14 @@
-"""OnnxModel <-> onnx.ModelProto, gated on the `onnx` package.
+"""Real ONNX protobuf serialization for OnnxModel.
 
-The build environment does not ship `onnx`; everything else in the bridge
-(export, import, save/load, round-trips) works without it through the
-neutral IR (ir.py).  When `onnx` is importable these two functions produce /
-consume real protobufs for interop with other frameworks (the reference's
-tests round-trip through tensorflow, tests/onnx/).
+`serialize_model` / `deserialize_model` produce and consume genuine
+`ModelProto` bytes via the pure-Python wire codec (wire.py) — no `onnx`
+package needed, so `.onnx` files interoperate with other frameworks the
+way the reference's bridge did through tensorflow (tests/onnx/,
+python/hetu/onnx/hetu2onnx.py).
+
+When the `onnx` package IS importable, `to_onnx_proto`/`from_onnx_proto`
+additionally convert to its in-memory objects (handy for checker/runtime
+use); they are optional — the byte path stands alone.
 """
 
 from __future__ import annotations
@@ -12,80 +16,99 @@ from __future__ import annotations
 import numpy as np
 
 from .ir import OnnxModel, NodeIR, TensorInfo
+from . import wire
 
 try:
-    import onnx  # noqa: F401
-    from onnx import helper, numpy_helper, TensorProto
+    import onnx
     HAS_ONNX = True
 except ImportError:  # pragma: no cover - onnx not in the build image
     HAS_ONNX = False
 
-_DTYPE2PROTO = {"float32": 1, "float64": 11, "int32": 6, "int64": 7}
-_PROTO2DTYPE = {v: k for k, v in _DTYPE2PROTO.items()}
+_DTYPE2PROTO = wire.DTYPE_TO_ONNX
+_PROTO2DTYPE = wire.ONNX_TO_DTYPE
 
+
+def _encode_attrs(node: NodeIR):
+    out = {}
+    for k, v in node.attrs.items():
+        if k == "to":  # Cast dtype: translate to TensorProto enum
+            v = _DTYPE2PROTO[str(np.dtype(v))]
+        out[k] = v
+    return out
+
+
+def _decode_attrs(op_type, attrs):
+    out = {}
+    for k, v in attrs.items():
+        if op_type == "Cast" and k == "to":
+            v = _PROTO2DTYPE[int(v)]
+        out[k] = v
+    return out
+
+
+def serialize_model(model: OnnxModel, producer="hetu_tpu") -> bytes:
+    """OnnxModel -> ONNX ModelProto bytes (pure Python, no onnx pkg)."""
+    encoded = OnnxModel(name=model.name, opset=model.opset,
+                        initializers=model.initializers,
+                        inputs=model.inputs, outputs=model.outputs,
+                        nodes=[NodeIR(n.op_type, n.inputs, n.outputs,
+                                      _encode_attrs(n), n.name)
+                               for n in model.nodes])
+    return wire.enc_model(encoded, producer=producer)
+
+
+def deserialize_model(data: bytes) -> OnnxModel:
+    """ONNX ModelProto bytes -> OnnxModel (pure Python, no onnx pkg)."""
+    (name, nodes, inits, inputs, outputs), opset = wire.dec_model(data)
+    model = OnnxModel(name=name or "onnx_graph", opset=opset)
+    model.initializers = inits
+    init_names = set(inits)
+    for vname, elem, shape in inputs:
+        if vname in init_names:
+            continue
+        model.inputs.append(TensorInfo(
+            vname, shape, _PROTO2DTYPE.get(elem, "float32")))
+    for vname, elem, shape in outputs:
+        model.outputs.append(TensorInfo(
+            vname, (), _PROTO2DTYPE.get(elem, "float32")))
+    for op_type, n_in, n_out, attrs, nname in nodes:
+        model.nodes.append(NodeIR(op_type, n_in, n_out,
+                                  _decode_attrs(op_type, attrs), nname))
+    return model
+
+
+def save_onnx(model: OnnxModel, path, producer="hetu_tpu"):
+    """Write a real `.onnx` protobuf file."""
+    with open(path, "wb") as f:
+        f.write(serialize_model(model, producer=producer))
+
+
+def load_onnx(path) -> OnnxModel:
+    """Read a real `.onnx` protobuf file (any producer)."""
+    with open(path, "rb") as f:
+        return deserialize_model(f.read())
+
+
+# -- optional onnx-package object converters -------------------------------
 
 def _require():
     if not HAS_ONNX:
         raise ImportError(
-            "the `onnx` package is not installed; use ir.save_model / "
-            "ir.load_model for the portable zip format instead")
+            "the `onnx` package is not installed; serialize_model/"
+            "deserialize_model (pure-Python protobuf) cover files, and "
+            "ir.save_model/load_model cover the portable zip format")
 
 
 def to_onnx_proto(model: OnnxModel):
-    """OnnxModel -> onnx.ModelProto (requires the onnx package)."""
+    """OnnxModel -> onnx.ModelProto object (requires the onnx package).
+    Parses the pure-Python bytes, so both paths stay consistent."""
     _require()
-    nodes = []
-    for n in model.nodes:
-        attrs = {}
-        for k, v in n.attrs.items():
-            if k == "to":  # Cast dtype: translate to TensorProto enum
-                v = _DTYPE2PROTO[str(np.dtype(v))]
-            if isinstance(v, tuple):
-                v = list(v)
-            attrs[k] = v
-        nodes.append(helper.make_node(n.op_type, n.inputs, n.outputs,
-                                      name=n.name, **attrs))
-    inputs = [helper.make_tensor_value_info(
-        t.name, _DTYPE2PROTO.get(t.dtype, 1), list(t.shape) or None)
-        for t in model.inputs]
-    outputs = [helper.make_tensor_value_info(
-        t.name, _DTYPE2PROTO.get(t.dtype, 1), None) for t in model.outputs]
-    inits = [numpy_helper.from_array(np.asarray(v), name=k)
-             for k, v in model.initializers.items()]
-    graph = helper.make_graph(nodes, model.name, inputs, outputs, inits)
-    proto = helper.make_model(
-        graph, opset_imports=[helper.make_opsetid("", model.opset)])
+    proto = onnx.ModelProto()
+    proto.ParseFromString(serialize_model(model))
     return proto
 
 
 def from_onnx_proto(proto) -> OnnxModel:
-    """onnx.ModelProto -> OnnxModel (requires the onnx package)."""
+    """onnx.ModelProto object -> OnnxModel (requires the onnx package)."""
     _require()
-    g = proto.graph
-    model = OnnxModel(name=g.name)
-    if proto.opset_import:
-        model.opset = proto.opset_import[0].version
-    for init in g.initializer:
-        model.initializers[init.name] = numpy_helper.to_array(init)
-    init_names = set(model.initializers)
-    for vi in g.input:
-        if vi.name in init_names:
-            continue
-        shape = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
-        model.inputs.append(TensorInfo(
-            vi.name, shape,
-            _PROTO2DTYPE.get(vi.type.tensor_type.elem_type, "float32")))
-    for vi in g.output:
-        model.outputs.append(TensorInfo(vi.name, ()))
-    for n in g.node:
-        attrs = {}
-        for a in n.attribute:
-            v = helper.get_attribute_value(a)
-            if n.op_type == "Cast" and a.name == "to":
-                v = _PROTO2DTYPE[v]
-            if isinstance(v, bytes):
-                v = v.decode()
-            attrs[a.name] = v
-        model.nodes.append(NodeIR(n.op_type, list(n.input), list(n.output),
-                                  attrs, n.name))
-    return model
+    return deserialize_model(proto.SerializeToString())

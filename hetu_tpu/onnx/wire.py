@@ -1,0 +1,378 @@
+"""Pure-Python protobuf wire codec for the ONNX schema subset.
+
+The build image does not ship the `onnx` package, but real interop needs
+real protobuf bytes (the reference round-trips hetu↔onnx↔tensorflow,
+tests/onnx/).  Protobuf's wire format is tiny — varint keys, three wire
+types — so this module encodes/decodes ONNX `ModelProto` directly from
+the public onnx.proto3 field numbers, producing files any ONNX runtime
+can read and reading files any exporter produced (for the ops the bridge
+supports).
+
+Schema subset (field numbers from onnx/onnx.proto, public):
+  ModelProto      : ir_version=1, producer_name=2, producer_version=3,
+                    domain=4, model_version=5, doc_string=6, graph=7,
+                    opset_import=8
+  GraphProto      : node=1, name=2, initializer=5, doc_string=10,
+                    input=11, output=12, value_info=13
+  NodeProto       : input=1, output=2, name=3, op_type=4, attribute=5,
+                    doc_string=6, domain=7
+  AttributeProto  : name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+                    strings=9, type=20
+  TensorProto     : dims=1, data_type=2, name=8, raw_data=9
+  ValueInfoProto  : name=1, type=2
+  TypeProto       : tensor_type=1 {elem_type=1, shape=2}
+  TensorShapeProto: dim=1 {dim_value=1, dim_param=2}
+  OperatorSetId   : domain=1, version=2
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# -- wire primitives -------------------------------------------------------
+
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _enc_varint(v):
+    v &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _enc_key(field, wtype):
+    return _enc_varint((field << 3) | wtype)
+
+
+def _enc_int(field, v):
+    if v is None:
+        return b""
+    return _enc_key(field, _VARINT) + _enc_varint(int(v))
+
+
+def _enc_bytes(field, data):
+    return _enc_key(field, _LEN) + _enc_varint(len(data)) + data
+
+
+def _enc_str(field, s):
+    return _enc_bytes(field, s.encode("utf-8")) if s else b""
+
+
+def _enc_float(field, v):
+    return _enc_key(field, _I32) + np.float32(v).tobytes()
+
+
+def _dec_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed(v):
+    """int64 two's-complement reinterpretation of a decoded varint."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def iter_fields(buf):
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    LEN fields yield memoryview payloads; varints yield ints."""
+    buf = memoryview(buf)
+    pos, end = 0, len(buf)
+    while pos < end:
+        key, pos = _dec_varint(buf, pos)
+        field, wtype = key >> 3, key & 7
+        if wtype == _VARINT:
+            v, pos = _dec_varint(buf, pos)
+        elif wtype == _I64:
+            v, pos = bytes(buf[pos:pos + 8]), pos + 8
+        elif wtype == _LEN:
+            n, pos = _dec_varint(buf, pos)
+            v, pos = buf[pos:pos + n], pos + n
+        elif wtype == _I32:
+            v, pos = bytes(buf[pos:pos + 4]), pos + 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+        yield field, wtype, v
+
+
+# -- ONNX dtype enum -------------------------------------------------------
+
+DTYPE_TO_ONNX = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+}
+ONNX_TO_DTYPE = {v: k for k, v in DTYPE_TO_ONNX.items()}
+
+
+# -- encoders --------------------------------------------------------------
+
+def enc_tensor(name, arr):
+    arr = np.asarray(arr)
+    dt = DTYPE_TO_ONNX[str(arr.dtype)]
+    out = b"".join(_enc_int(1, d) for d in arr.shape)
+    out += _enc_int(2, dt)
+    out += _enc_str(8, name)
+    raw = arr.astype(arr.dtype.newbyteorder("<")).tobytes()
+    out += _enc_bytes(9, raw)
+    return out
+
+
+def enc_attribute(name, value):
+    out = _enc_str(1, name)
+    if isinstance(value, np.ndarray):
+        out += _enc_bytes(5, enc_tensor("", value)) + _enc_int(20, 4)
+    elif isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        out += _enc_int(3, int(value)) + _enc_int(20, 2)
+    elif isinstance(value, (float, np.floating)):
+        out += _enc_float(2, value) + _enc_int(20, 1)
+    elif isinstance(value, str):
+        out += _enc_bytes(4, value.encode()) + _enc_int(20, 3)
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, str) for v in value):
+            out += b"".join(_enc_bytes(9, v.encode()) for v in value)
+            out += _enc_int(20, 8)
+        elif any(isinstance(v, (float, np.floating)) for v in value):
+            out += b"".join(_enc_key(7, _I32) + np.float32(v).tobytes()
+                            for v in value)
+            out += _enc_int(20, 6)
+        else:
+            out += b"".join(_enc_int(8, int(v)) for v in value)
+            out += _enc_int(20, 7)
+    else:
+        raise TypeError(f"attribute {name}: unsupported {type(value)}")
+    return out
+
+
+def enc_node(op_type, inputs, outputs, attrs, name=""):
+    out = b"".join(_enc_str(1, i) for i in inputs)
+    out += b"".join(_enc_str(2, o) for o in outputs)
+    out += _enc_str(3, name) + _enc_str(4, op_type)
+    out += b"".join(_enc_bytes(5, enc_attribute(k, v))
+                    for k, v in attrs.items())
+    return out
+
+
+def enc_value_info(name, elem_type, shape):
+    shape_msg = b""
+    if shape:
+        for d in shape:
+            if d is None or (isinstance(d, int) and d < 0):
+                dim = _enc_str(2, "N")
+            else:
+                dim = _enc_int(1, int(d))
+            shape_msg += _enc_bytes(1, dim)
+    tensor_type = _enc_int(1, elem_type)
+    if shape_msg or shape == ():
+        tensor_type += _enc_bytes(2, shape_msg)
+    type_proto = _enc_bytes(1, tensor_type)
+    return _enc_str(1, name) + _enc_bytes(2, type_proto)
+
+
+def enc_graph(model):
+    out = b""
+    for n in model.nodes:
+        attrs = {k: (tuple(v) if isinstance(v, list) else v)
+                 for k, v in n.attrs.items()}
+        out += _enc_bytes(1, enc_node(n.op_type, n.inputs, n.outputs,
+                                      attrs, n.name))
+    out += _enc_str(2, model.name)
+    for name, arr in model.initializers.items():
+        out += _enc_bytes(5, enc_tensor(name, arr))
+    for t in model.inputs:
+        out += _enc_bytes(11, enc_value_info(
+            t.name, DTYPE_TO_ONNX.get(t.dtype, 1), tuple(t.shape)))
+    for t in model.outputs:
+        out += _enc_bytes(12, enc_value_info(
+            t.name, DTYPE_TO_ONNX.get(t.dtype, 1), None))
+    return out
+
+
+def enc_model(model, producer="hetu_tpu"):
+    out = _enc_int(1, 10)                      # ir_version 10 (onnx 1.16)
+    out += _enc_str(2, producer)
+    out += _enc_bytes(7, enc_graph(model))
+    opset = _enc_str(1, "") + _enc_int(2, model.opset)
+    out += _enc_bytes(8, opset)
+    return out
+
+
+# -- decoders --------------------------------------------------------------
+
+def dec_tensor(buf):
+    dims, dt, name, raw = [], 1, "", b""
+    data_fields = {}
+    for field, wtype, v in iter_fields(buf):
+        if field == 1:
+            dims.append(_signed(v))
+        elif field == 2:
+            dt = v
+        elif field == 8:
+            name = bytes(v).decode()
+        elif field == 9:
+            raw = bytes(v)
+        elif field in (4, 5, 7, 10):
+            data_fields.setdefault(field, []).append((wtype, v))
+    dtype = np.dtype(ONNX_TO_DTYPE.get(dt, "float32"))
+    if raw:
+        arr = np.frombuffer(raw, dtype=dtype.newbyteorder("<"))
+        arr = arr.astype(dtype).reshape(dims)
+    elif data_fields:
+        # packed or repeated typed data (other exporters may use these)
+        field, entries = next(iter(data_fields.items()))
+        vals = []
+        kind = {4: np.float32, 5: np.int32, 7: np.int64,
+                10: np.float64}[field]
+        for wtype, v in entries:
+            if wtype == _LEN:                      # packed
+                if kind in (np.float32,):
+                    vals.extend(np.frombuffer(bytes(v), "<f4"))
+                elif kind is np.float64:
+                    vals.extend(np.frombuffer(bytes(v), "<f8"))
+                else:
+                    mv, pos = memoryview(v), 0
+                    while pos < len(mv):
+                        x, pos = _dec_varint(mv, pos)
+                        vals.append(_signed(x))
+            elif wtype == _I32:
+                vals.append(np.frombuffer(v, "<f4")[0])
+            elif wtype == _I64:
+                vals.append(np.frombuffer(v, "<f8")[0])
+            else:
+                vals.append(_signed(v))
+        arr = np.asarray(vals, kind).astype(dtype).reshape(dims)
+    else:
+        arr = np.zeros(dims, dtype)
+    return name, arr
+
+
+def dec_attribute(buf):
+    name, atype = "", None
+    f = i = s = t = None
+    floats, ints, strings = [], [], []
+    for field, wtype, v in iter_fields(buf):
+        if field == 1:
+            name = bytes(v).decode()
+        elif field == 2:
+            f = float(np.frombuffer(v, "<f4")[0])
+        elif field == 3:
+            i = _signed(v)
+        elif field == 4:
+            s = bytes(v).decode()
+        elif field == 5:
+            t = dec_tensor(v)[1]
+        elif field == 7:
+            if wtype == _LEN:
+                floats.extend(float(x)
+                              for x in np.frombuffer(bytes(v), "<f4"))
+            else:
+                floats.append(float(np.frombuffer(v, "<f4")[0]))
+        elif field == 8:
+            if wtype == _LEN:
+                mv, pos = memoryview(v), 0
+                while pos < len(mv):
+                    x, pos = _dec_varint(mv, pos)
+                    ints.append(_signed(x))
+            else:
+                ints.append(_signed(v))
+        elif field == 9:
+            strings.append(bytes(v).decode())
+        elif field == 20:
+            atype = v
+    by_type = {1: f, 2: i, 3: s, 4: t, 6: tuple(floats), 7: tuple(ints),
+               8: tuple(strings)}
+    if atype in by_type and by_type[atype] is not None:
+        return name, by_type[atype]
+    for v in (t, s, f, i):
+        if v is not None:
+            return name, v
+    if floats:
+        return name, tuple(floats)
+    if strings:
+        return name, tuple(strings)
+    return name, tuple(ints)
+
+
+def dec_node(buf):
+    inputs, outputs, attrs = [], [], {}
+    name = op_type = ""
+    for field, wtype, v in iter_fields(buf):
+        if field == 1:
+            inputs.append(bytes(v).decode())
+        elif field == 2:
+            outputs.append(bytes(v).decode())
+        elif field == 3:
+            name = bytes(v).decode()
+        elif field == 4:
+            op_type = bytes(v).decode()
+        elif field == 5:
+            k, val = dec_attribute(v)
+            attrs[k] = val
+    return op_type, inputs, outputs, attrs, name
+
+
+def dec_value_info(buf):
+    name, elem_type, shape = "", 1, []
+    for field, wtype, v in iter_fields(buf):
+        if field == 1:
+            name = bytes(v).decode()
+        elif field == 2:
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 1:                         # tensor_type
+                    for f3, _, v3 in iter_fields(v2):
+                        if f3 == 1:
+                            elem_type = v3
+                        elif f3 == 2:               # shape
+                            for f4, _, v4 in iter_fields(v3):
+                                if f4 == 1:         # dim
+                                    dv = None       # dim_param -> dynamic
+                                    for f5, _, v5 in iter_fields(v4):
+                                        if f5 == 1:
+                                            dv = _signed(v5)
+                                    shape.append(dv)
+    return name, elem_type, tuple(shape)
+
+
+def dec_graph(buf):
+    nodes, inits, inputs, outputs = [], {}, [], []
+    name = ""
+    for field, wtype, v in iter_fields(buf):
+        if field == 1:
+            nodes.append(dec_node(v))
+        elif field == 2:
+            name = bytes(v).decode()
+        elif field == 5:
+            n, arr = dec_tensor(v)
+            inits[n] = arr
+        elif field == 11:
+            inputs.append(dec_value_info(v))
+        elif field == 12:
+            outputs.append(dec_value_info(v))
+    return name, nodes, inits, inputs, outputs
+
+
+def dec_model(buf):
+    graph = None
+    opset = 20
+    for field, wtype, v in iter_fields(buf):
+        if field == 7:
+            graph = v
+        elif field == 8:
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 2:
+                    opset = _signed(v2)
+    if graph is None:
+        raise ValueError("ModelProto has no graph")
+    return dec_graph(graph), opset

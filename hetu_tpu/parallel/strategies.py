@@ -174,7 +174,8 @@ class MegatronLM(Strategy):
                     if (self.COL_W.search(n.name)
                             or self.COL_B.search(n.name)
                             or self.ROW_W.search(n.name)
-                            or self.EMB_W.search(n.name)):
+                            or (self.shard_embeddings
+                                and self.EMB_W.search(n.name))):
                         skipped.append(n.name)  # matched name, bad divisor
                     continue
                 matched += 1
