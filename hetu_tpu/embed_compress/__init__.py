@@ -23,7 +23,7 @@ from .layers import (CompressedEmbedding, HashEmbedding,
                      PEPEmbedding, PEPRetrainEmbedding, DeepLightEmbedding,
                      AutoSrhEmbedding, QuantizedEmbedding, ALPTEmbedding,
                      DPQEmbedding, MGQEmbedding, DedupEmbedding,
-                     BatchNorm1d, lookup_or_zero_op)
+                     SparseEmbedding, BatchNorm1d, lookup_or_zero_op)
 from . import planner
 from .planner import (hash_rows, qr_sizes, tt_decomp_dims, tt_decomp_rows,
                       tt_rank, robe_size, dhe_mlp_dim, md_dims, adapt_remap,
@@ -34,6 +34,10 @@ from .planner import (hash_rows, qr_sizes, tt_decomp_dims, tt_decomp_rows,
 METHODS = ("full", "hash", "compo", "tt", "robe", "dhe", "adapt", "md",
            "autodim", "optembed", "pep", "deeplight", "autosrh", "quantize",
            "alpt", "dpq", "mgqe")
+# beyond the single-table constructors: "mixdim" (multi-field MD-solver
+# dims — MixedDimEmbedding) and "sparse" (padded-ELL inference form of a
+# pruned table — SparseEmbedding.from_dense / make_inference on
+# DeepLight/PEPRetrain), completing the reference's 19-method zoo.
 
 
 def make_compressed_embedding(method, num_embeddings, embedding_dim,
@@ -150,5 +154,5 @@ def make_compressed_embedding(method, num_embeddings, embedding_dim,
                      f"choose from {METHODS}")
 
 
-from .multi_field import MultiFieldCompressedEmbedding  # noqa: E402 (needs
-# make_compressed_embedding above)
+from .multi_field import (MultiFieldCompressedEmbedding,  # noqa: E402
+                          MixedDimEmbedding)  # (need the registry above)

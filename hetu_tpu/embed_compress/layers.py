@@ -720,6 +720,17 @@ class PEPRetrainEmbedding:
         masks = embedding_lookup_op(self.mask, x)
         return mul_op(lookups, masks)
 
+    def make_inference(self, table_value, mask_value=None):
+        """Trained table -> SparseEmbedding (reference layers/sparse.py
+        via scheduler switchinference)."""
+        table = np.asarray(table_value, np.float32)
+        mask = (np.asarray(mask_value, np.float32) if mask_value is not None
+                else None)
+        if mask is not None:
+            table = table * mask
+        return SparseEmbedding.from_dense(table,
+                                          name=f"{self.name}_sparse")
+
     def extra_loss(self):
         return None
 
@@ -754,6 +765,12 @@ class DeepLightEmbedding:
         Pass the optimizer node as ``after`` so the prune composes with —
         instead of clobbering — the same step's gradient update."""
         return _DeepLightPruneOp(self, after)
+
+    def make_inference(self, table_value):
+        """Pruned trained table -> SparseEmbedding (padded-ELL), the
+        deployment form of the reference's sparse.py/switchinference."""
+        return SparseEmbedding.from_dense(np.asarray(table_value),
+                                          name=f"{self.name}_sparse")
 
     def extra_loss(self):
         return None
@@ -1090,6 +1107,65 @@ class MGQEmbedding(DPQEmbedding):
         flat_mask = array_reshape_op(mask, output_shape=(-1,))
         return argmax_partial_op(resp, flat_mask,
                                  topk=self.low_num_choices, dim=2)
+
+
+_ell_to_dense_op = simple_op(
+    lambda v, c, dim=None: jnp.einsum(
+        "...k,...kd->...d", v,
+        jax.nn.one_hot(c, dim, dtype=v.dtype)),
+    "ell_to_dense")
+
+
+class SparseEmbedding:
+    """Inference-only pruned embedding in padded-ELL form.
+
+    Reference layers/sparse.py serves pruned tables (DeepLight/PEP) from
+    a CSR `ND_Sparse_Array` through SparseEmbeddingLookup.cu.  CSR's
+    per-row ragged extents are hostile to XLA's static shapes, so the
+    TPU form is ELL: ``values``/``cols`` [N, K] with K = max nonzeros
+    per row (zero-padded).  Lookup is two gathers + a one-hot einsum —
+    static shapes, MXU work, fuses — and storage is 2·N·K vs N·D
+    elements (wins when the table is < 50% dense).
+    """
+
+    def __init__(self, values, cols, embedding_dim, name="sparse_emb"):
+        values = np.asarray(values, np.float32)
+        cols = np.asarray(cols, np.int32)
+        assert values.shape == cols.shape and values.ndim == 2
+        self.num_embeddings = values.shape[0]
+        self.max_nnz = values.shape[1]
+        self.embedding_dim = embedding_dim
+        self.name = fresh_name(name)
+        self.values = constant_var(f"{self.name}_vals", values)
+        self.cols = constant_var(f"{self.name}_cols", cols, np.int32)
+
+    @classmethod
+    def from_dense(cls, table, name="sparse_emb", tol=0.0):
+        """Convert a (pruned) dense [N, D] table; |w| <= tol drops."""
+        table = np.asarray(table, np.float32)
+        n, d = table.shape
+        keep = np.abs(table) > tol
+        k = max(1, int(keep.sum(axis=1).max()))
+        # vectorized ELL packing (tables are multi-million-row): stable
+        # argsort floats kept entries to the front of each row
+        order = np.argsort(~keep, axis=1, kind="stable")[:, :k]
+        packed_keep = np.take_along_axis(keep, order, axis=1)
+        values = np.where(packed_keep,
+                          np.take_along_axis(table, order, axis=1),
+                          0.0).astype(np.float32)
+        cols = np.where(packed_keep, order, 0).astype(np.int32)
+        return cls(values, cols, d, name=name)
+
+    def __call__(self, x):
+        v = embedding_lookup_op(self.values, x)     # [..., K]
+        c = embedding_lookup_op(self.cols, x)       # [..., K]
+        return _ell_to_dense_op(v, c, dim=self.embedding_dim)
+
+    def memory_elements(self):
+        return 2 * self.num_embeddings * self.max_nnz
+
+    def extra_loss(self):
+        return None
 
 
 class DedupEmbedding:

@@ -100,3 +100,43 @@ class MultiFieldCompressedEmbedding:
         for t in terms[1:]:
             total = total + t
         return total
+
+
+class MixedDimEmbedding:
+    """Mixed-dimension embedding across fields (reference
+    scheduler/md.py MDETrainer in separate-fields mode): the MD solver
+    assigns each field a dimension d_f ∝ n_f^-alpha (popular/small
+    fields keep large dims, huge sparse fields shrink), binary-searching
+    alpha to hit ``compress_rate``; each field is an MDEmbedding storing
+    at d_f and projecting up to the model dim."""
+
+    def __init__(self, num_embed_separate, embedding_dim,
+                 compress_rate=0.125, round_dim=True, name="mixdim"):
+        from .layers import MDEmbedding
+        from .planner import md_dims
+        self.num_embed_separate = list(num_embed_separate)
+        self.num_fields = len(self.num_embed_separate)
+        self.embedding_dim = embedding_dim
+        self.dims = md_dims(self.num_embed_separate, embedding_dim,
+                            compress_rate, round_dim=round_dim)
+        self.fields = [
+            MDEmbedding(rows, int(d), embedding_dim,
+                        name=f"{name}_f{f}")
+            for f, (rows, d) in enumerate(zip(self.num_embed_separate,
+                                              self.dims))]
+
+    def memory_elements(self):
+        return [param_elements(layer) for layer in self.fields]
+
+    def __call__(self, ids):
+        """ids [B, F] (field-local) -> [B, F, D]."""
+        outs = []
+        for f, layer in enumerate(self.fields):
+            col = split_op(ids, axes=1, indices=f, splits=self.num_fields)
+            e = layer(col)
+            outs.append(array_reshape_op(
+                e, output_shape=(-1, 1, self.embedding_dim)))
+        return concatenate_op(outs, axis=1)
+
+    def extra_loss(self):
+        return None

@@ -122,8 +122,20 @@ class DistConfig:
 
 def initialize_from_env():
     """Call inside a launched worker: wires jax.distributed from the env
-    set by `DistConfig.process_env` (no-op when single-process)."""
+    set by `DistConfig.process_env` (no-op when single-process).
+
+    ``HETU_PLATFORM`` (e.g. 'cpu') forces the jax platform first, tearing
+    down any backend a sitecustomize pre-initialized — required because
+    jax.distributed.initialize must run before backend bring-up."""
     import jax
+    platform = os.environ.get("HETU_PLATFORM")
+    if platform:
+        try:
+            from jax.extend import backend as _backend
+            _backend.clear_backends()
+        except Exception:
+            pass
+        jax.config.update("jax_platforms", platform)
     coord = os.environ.get("HETU_COORDINATOR")
     n = int(os.environ.get("HETU_NUM_PROCESSES", "1"))
     if coord and n > 1:

@@ -211,12 +211,27 @@ def enc_model(model, producer="hetu_tpu"):
 
 # -- decoders --------------------------------------------------------------
 
+def _varints(mv):
+    """All varints in a packed LEN payload."""
+    out, pos = [], 0
+    mv = memoryview(mv)
+    while pos < len(mv):
+        x, pos = _dec_varint(mv, pos)
+        out.append(_signed(x))
+    return out
+
+
 def dec_tensor(buf):
     dims, dt, name, raw = [], 1, "", b""
     data_fields = {}
     for field, wtype, v in iter_fields(buf):
         if field == 1:
-            dims.append(_signed(v))
+            # proto3 packs repeated scalars by default (external files);
+            # our encoder emits them unpacked — accept both
+            if wtype == _LEN:
+                dims.extend(_varints(v))
+            else:
+                dims.append(_signed(v))
         elif field == 2:
             dt = v
         elif field == 8:
@@ -242,10 +257,7 @@ def dec_tensor(buf):
                 elif kind is np.float64:
                     vals.extend(np.frombuffer(bytes(v), "<f8"))
                 else:
-                    mv, pos = memoryview(v), 0
-                    while pos < len(mv):
-                        x, pos = _dec_varint(mv, pos)
-                        vals.append(_signed(x))
+                    vals.extend(_varints(v))
             elif wtype == _I32:
                 vals.append(np.frombuffer(v, "<f4")[0])
             elif wtype == _I64:
@@ -281,18 +293,21 @@ def dec_attribute(buf):
                 floats.append(float(np.frombuffer(v, "<f4")[0]))
         elif field == 8:
             if wtype == _LEN:
-                mv, pos = memoryview(v), 0
-                while pos < len(mv):
-                    x, pos = _dec_varint(mv, pos)
-                    ints.append(_signed(x))
+                ints.extend(_varints(v))
             else:
                 ints.append(_signed(v))
         elif field == 9:
             strings.append(bytes(v).decode())
         elif field == 20:
             atype = v
-    by_type = {1: f, 2: i, 3: s, 4: t, 6: tuple(floats), 7: tuple(ints),
-               8: tuple(strings)}
+    # proto3 omits zero scalars on the wire: when the declared type says
+    # scalar but no value field arrived, the value IS the proto default
+    # (0 / 0.0 / "") — not an empty tuple
+    by_type = {1: f if f is not None else 0.0,
+               2: i if i is not None else 0,
+               3: s if s is not None else "",
+               4: t,
+               6: tuple(floats), 7: tuple(ints), 8: tuple(strings)}
     if atype in by_type and by_type[atype] is not None:
         return name, by_type[atype]
     for v in (t, s, f, i):
@@ -370,9 +385,16 @@ def dec_model(buf):
         if field == 7:
             graph = v
         elif field == 8:
+            domain, version = "", None
             for f2, _, v2 in iter_fields(v):
-                if f2 == 2:
-                    opset = _signed(v2)
+                if f2 == 1:
+                    domain = bytes(v2).decode()
+                elif f2 == 2:
+                    version = _signed(v2)
+            # only the default ai.onnx domain sets the model opset —
+            # com.microsoft etc. entries must not clobber it
+            if version is not None and domain in ("", "ai.onnx"):
+                opset = version
     if graph is None:
         raise ValueError("ModelProto has no graph")
     return dec_graph(graph), opset

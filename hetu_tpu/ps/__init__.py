@@ -6,8 +6,9 @@ from .cstable import CacheSparseTable
 from .embedding import PSEmbedding, PSRowsOp
 from .preduce import (PReduceScheduler, PartialReduce, partner_mask,
                       masked_mean_allreduce)
+from .rpc import PSServer, RemoteTable
 
 __all__ = ["EmbeddingTable", "CacheTable", "ShardedTable", "SSPController",
            "CacheSparseTable", "PSEmbedding", "PSRowsOp",
            "PReduceScheduler", "PartialReduce", "partner_mask",
-           "masked_mean_allreduce"]
+           "masked_mean_allreduce", "PSServer", "RemoteTable"]

@@ -1,0 +1,227 @@
+"""DCN transport for the PS store: TCP RPC server + remote-table client.
+
+Reference: ps-lite's van layer (src/van.cc, zmq_van.h) carries typed
+PSFunc requests (DensePush/Pull, SparsePush/Pull, ...) between worker
+and server processes over ZMQ; runner.py/launcher.py bring the server
+processes up.  On TPU-VM clusters the same role is a host-side TCP
+service over DCN in front of the native store (ps/native/hetu_ps.cpp):
+
+  * ``PSServer``     — serves one EmbeddingTable shard to any number of
+                       worker processes (threaded; the native store's
+                       lock shards handle concurrency).
+  * ``RemoteTable``  — client with the EmbeddingTable interface
+                       (lookup/push/set_rows/versions/save/load), so a
+                       ``ShardedTable`` can mix local and remote shards
+                       transparently.
+  * ``python -m hetu_tpu.ps.rpc`` — standalone server process, the
+                       'server' role of the reference's heturun bring-up
+                       (runner.py:150).
+
+Wire format (trusted-cluster, no pickle): one u32 little-endian JSON
+header length, the JSON header ({"verb", "sizes", ...}), then the raw
+little-endian array payloads back to back.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock, header, *arrays):
+    payloads = [np.ascontiguousarray(a).tobytes() for a in arrays]
+    header = dict(header)
+    header["sizes"] = [len(p) for p in payloads]
+    hb = json.dumps(header).encode()
+    sock.sendall(struct.pack("<I", len(hb)) + hb + b"".join(payloads))
+
+
+def recv_msg(sock):
+    (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen))
+    payloads = [_recv_exact(sock, n) for n in header.get("sizes", ())]
+    return header, payloads
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        table = self.server.table
+        while True:
+            try:
+                header, payloads = recv_msg(self.request)
+            except (ConnectionError, struct.error):
+                return
+            verb = header["verb"]
+            if verb == "lookup":
+                keys = np.frombuffer(payloads[0], "<i8")
+                out = table.lookup(keys)
+                send_msg(self.request, {"verb": "ok"},
+                         out.astype("<f4"))
+            elif verb == "push":
+                keys = np.frombuffer(payloads[0], "<i8")
+                grads = np.frombuffer(payloads[1], "<f4").reshape(
+                    keys.size, table.dim)
+                table.push(keys, grads)
+                send_msg(self.request, {"verb": "ok"})
+            elif verb == "set_rows":
+                keys = np.frombuffer(payloads[0], "<i8")
+                vals = np.frombuffer(payloads[1], "<f4").reshape(
+                    keys.size, table.dim)
+                table.set_rows(keys, vals)
+                send_msg(self.request, {"verb": "ok"})
+            elif verb == "versions":
+                keys = np.frombuffer(payloads[0], "<i8")
+                send_msg(self.request, {"verb": "ok"},
+                         table.versions(keys).astype("<u8"))
+            elif verb == "meta":
+                send_msg(self.request, {"verb": "ok", "rows": table.rows,
+                                        "dim": table.dim})
+            elif verb == "save":
+                table.save(header["path"])
+                send_msg(self.request, {"verb": "ok"})
+            elif verb == "load":
+                table.load(header["path"])
+                send_msg(self.request, {"verb": "ok"})
+            elif verb == "shutdown":
+                send_msg(self.request, {"verb": "ok"})
+                self.server._shutdown_requested.set()
+                return
+            else:
+                send_msg(self.request, {"verb": "error",
+                                        "message": f"bad verb {verb}"})
+
+
+class PSServer:
+    """Serves one EmbeddingTable shard over TCP (reference kvserver.h)."""
+
+    def __init__(self, table, host="127.0.0.1", port=0):
+        self.table = table
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Srv((host, port), _Handler)
+        self._srv.table = table
+        self._srv._shutdown_requested = threading.Event()
+        self.host, self.port = self._srv.server_address
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        """Blocking serve; returns after a client sends 'shutdown'."""
+        waiter = threading.Thread(target=self._wait_shutdown, daemon=True)
+        waiter.start()
+        self._srv.serve_forever()
+
+    def _wait_shutdown(self):
+        self._srv._shutdown_requested.wait()
+        self._srv.shutdown()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class RemoteTable:
+    """EmbeddingTable-interface client for a PSServer shard."""
+
+    def __init__(self, host, port, timeout=30.0):
+        self._addr = (host, int(port))
+        self._sock = socket.create_connection(self._addr, timeout=timeout)
+        self._lock = threading.Lock()
+        meta = self._call({"verb": "meta"})[0]
+        self.rows, self.dim = meta["rows"], meta["dim"]
+
+    def _call(self, header, *arrays):
+        with self._lock:
+            send_msg(self._sock, header, *arrays)
+            reply, payloads = recv_msg(self._sock)
+        if reply.get("verb") != "ok":
+            raise RuntimeError(f"PS RPC failed: {reply}")
+        return reply, payloads
+
+    def lookup(self, keys):
+        keys = np.asarray(keys).reshape(-1).astype("<i8")
+        _, payloads = self._call({"verb": "lookup"}, keys)
+        return np.frombuffer(payloads[0], "<f4").reshape(
+            keys.size, self.dim).copy()
+
+    def push(self, keys, grads):
+        keys = np.asarray(keys).reshape(-1).astype("<i8")
+        grads = np.asarray(grads, "<f4").reshape(keys.size, self.dim)
+        self._call({"verb": "push"}, keys, grads)
+
+    def set_rows(self, keys, values):
+        keys = np.asarray(keys).reshape(-1).astype("<i8")
+        values = np.asarray(values, "<f4").reshape(keys.size, self.dim)
+        self._call({"verb": "set_rows"}, keys, values)
+
+    def versions(self, keys):
+        keys = np.asarray(keys).reshape(-1).astype("<i8")
+        _, payloads = self._call({"verb": "versions"}, keys)
+        return np.frombuffer(payloads[0], "<u8").copy()
+
+    def save(self, path):
+        self._call({"verb": "save", "path": str(path)})
+
+    def load(self, path):
+        self._call({"verb": "load", "path": str(path)})
+
+    def shutdown_server(self):
+        self._call({"verb": "shutdown"})
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def main(argv=None):
+    """Standalone PS server process (the reference's server role)."""
+    import argparse
+    from .store import EmbeddingTable
+
+    ap = argparse.ArgumentParser(prog="hetu_tpu.ps.rpc")
+    ap.add_argument("--rows", type=int, required=True)
+    ap.add_argument("--dim", type=int, required=True)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--init-scale", type=float, default=None)
+    ns = ap.parse_args(argv)
+    table = EmbeddingTable(ns.rows, ns.dim, optimizer=ns.optimizer,
+                           lr=ns.lr, seed=ns.seed,
+                           init_scale=ns.init_scale)
+    server = PSServer(table, host=ns.host, port=ns.port)
+    # parseable bring-up line for launchers (reference DMLC env handshake)
+    print(f"PS_SERVER_READY {server.host} {server.port}", flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,9 +8,10 @@ Reference mapping:
 
 Multi-worker sharding: the reference shards keys across PS server processes
 reached over ZMQ.  On TPU VMs every host holds a shard of each table in RAM;
-`ShardedTable` routes keys by hash.  In this single-host build the shards are
-in-process (the DCN RPC transport is the launcher's concern); the key-routing
-math is identical either way.
+`ShardedTable` routes keys by hash over shards that may be in-process
+EmbeddingTables or `rpc.RemoteTable` clients reaching PSServer processes
+over DCN (ps/rpc.py is the van-layer equivalent; tests/test_rpc_launch.py
+exercises real server processes).
 """
 
 from __future__ import annotations
@@ -160,7 +161,15 @@ class ShardedTable:
     local key = key // nshards (matches the reference's server key
     partitioner semantics without its ranges)."""
 
-    def __init__(self, rows, dim, nshards=1, **kw):
+    def __init__(self, rows, dim, nshards=1, tables=None, **kw):
+        if tables is not None:
+            # pre-built shards — local EmbeddingTables and/or rpc.RemoteTable
+            # clients reaching server processes over DCN (the reference's
+            # multi-host server layout, ps-lite postoffice key ranges)
+            self.shards = list(tables)
+            self.nshards = len(self.shards)
+            self.rows, self.dim = int(rows), int(dim)
+            return
         self.nshards = nshards
         self.rows, self.dim = int(rows), int(dim)
         per = (rows + nshards - 1) // nshards

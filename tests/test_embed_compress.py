@@ -341,3 +341,78 @@ def test_multi_field_compression(rng):
                        convert_to_numpy_ret_vals=True)[0])
           for _ in range(8)]
     assert ls[-1] < ls[0]
+
+
+def test_mixdim_solver_and_training(rng):
+    """mixdim (reference scheduler/md.py MDETrainer, separate fields):
+    per-field dims fall with field size, total memory near the target,
+    and the layer trains end-to-end."""
+    from hetu_tpu.embed_compress import MixedDimEmbedding
+    rows = [60, 30000, 150, 80000]
+    D, B = 16, 8
+    layer = MixedDimEmbedding(rows, D, compress_rate=0.2)
+    # monotone: bigger fields get smaller (or equal) dims
+    by_rows = sorted(zip(rows, layer.dims))
+    dims_sorted = [d for _, d in by_rows]
+    assert all(a >= b for a, b in zip(dims_sorted, dims_sorted[1:]))
+    assert max(layer.dims) <= D
+    total = sum(sum(m) if isinstance(m, (list, tuple)) else m
+                for m in layer.memory_elements())
+    assert total <= sum(rows) * D * 0.25   # near the 0.2 target
+
+    ids = ht.placeholder_op("mx_ids", (B, 4), dtype=np.int32)
+    labels = ht.placeholder_op("mx_y", (B,))
+    emb = layer(ids)
+    flat = ht.array_reshape_op(emb, output_shape=(B, 4 * D))
+    w = ht.Variable("mx_w", shape=(4 * D, 1),
+                    initializer=ht.init.xavier_normal())
+    logits = ht.array_reshape_op(ht.matmul_op(flat, w), output_shape=(B,))
+    loss = ht.reduce_mean_op(
+        ht.binarycrossentropywithlogits_op(logits, labels))
+    ex = ht.Executor({"train": [loss,
+                                ht.SGDOptimizer(0.1).minimize(loss)]})
+    ids_v = np.stack([rng.integers(0, r, (B,)) for r in rows], axis=1)
+    y = rng.integers(0, 2, (B,)).astype(np.float32)
+    ls = [float(ex.run("train", feed_dict={ids: ids_v, labels: y},
+                       convert_to_numpy_ret_vals=True)[0])
+          for _ in range(8)]
+    assert ls[-1] < ls[0]
+
+
+def test_sparse_embedding_matches_pruned_dense(rng):
+    """sparse (reference layers/sparse.py inference form): padded-ELL
+    lookup reproduces the pruned dense table exactly with less storage."""
+    from hetu_tpu.embed_compress import SparseEmbedding
+    N, D, B = 40, 16, 12
+    table = rng.standard_normal((N, D)).astype(np.float32)
+    table[np.abs(table) < 1.5] = 0.0   # ~87% pruned (DeepLight regime)
+    layer = SparseEmbedding.from_dense(table)
+    assert layer.memory_elements() < N * D    # actually smaller
+    ids = ht.placeholder_op("sp_ids", (B,), dtype=np.int32)
+    ex = ht.Executor([layer(ids)])
+    ids_v = rng.integers(0, N, (B,))
+    (out,) = ex.run(feed_dict={ids: ids_v}, convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(out, table[ids_v], rtol=1e-6)
+
+
+def test_deeplight_make_inference_sparse(rng):
+    """Train DeepLight with pruning, convert to the sparse inference
+    form, outputs match the final (pruned) dense table."""
+    NEMB, DIM, B = 64, 8, 16
+    layer = ec.DeepLightEmbedding(NEMB, DIM, prune_rate=0.5, batch_num=10)
+    ids = ht.placeholder_op("dl2_ids", (B,), dtype=np.int32)
+    y = ht.placeholder_op("dl2_y", (B, DIM))
+    loss = ht.mse_loss_op(layer(ids), y)
+    opt = ht.SGDOptimizer(0.05).minimize(loss)
+    ex = ht.Executor({"train": [loss, opt, layer.make_prune_op(after=opt)]})
+    for _ in range(12):
+        ex.run("train", feed_dict={ids: rng.integers(0, NEMB, (B,)),
+                                   y: rng.standard_normal((B, DIM))})
+    table = np.asarray(ex.params[layer.embedding_table.name])
+    sp = layer.make_inference(table)
+    ids2 = ht.placeholder_op("dl2_ids2", (B,), dtype=np.int32)
+    ex2 = ht.Executor([sp(ids2)])
+    ids_v = rng.integers(0, NEMB, (B,))
+    (out,) = ex2.run(feed_dict={ids2: ids_v},
+                     convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(out, table[ids_v], rtol=1e-6, atol=1e-7)

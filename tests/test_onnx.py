@@ -177,6 +177,34 @@ def test_wire_attribute_kinds_roundtrip():
             assert back == v, (k, back, v)
 
 
+def test_wire_decodes_proto3_packed_and_default_fields():
+    """External proto3 serializers pack repeated scalars and OMIT zero
+    scalars; the decoder must read both forms."""
+    from hetu_tpu.onnx import wire
+    # packed dims: field 1, LEN, varints 2 and 3
+    packed_dims = (wire._enc_key(1, 2) + wire._enc_varint(2)
+                   + wire._enc_varint(2) + wire._enc_varint(3))
+    tensor = (packed_dims + wire._enc_int(2, 1)
+              + wire._enc_bytes(9, np.zeros(6, "<f4").tobytes()))
+    name, arr = wire.dec_tensor(tensor)
+    assert arr.shape == (2, 3)
+    # omitted zero scalar: attr {name: 'axis', type: INT} with no i field
+    attr = wire._enc_str(1, "axis") + wire._enc_int(20, 2)
+    name, val = wire.dec_attribute(attr)
+    assert name == "axis" and val == 0
+    attr_f = wire._enc_str(1, "eps") + wire._enc_int(20, 1)
+    assert wire.dec_attribute(attr_f) == ("eps", 0.0)
+    # non-default opset domains must not clobber the ai.onnx opset
+    opset_ms = wire._enc_bytes(8, wire._enc_str(1, "com.microsoft")
+                               + wire._enc_int(2, 1))
+    opset_onnx = wire._enc_bytes(8, wire._enc_str(1, "")
+                                 + wire._enc_int(2, 17))
+    from hetu_tpu.onnx.ir import OnnxModel
+    body = wire._enc_bytes(7, wire.enc_graph(OnnxModel()))
+    _, opset = wire.dec_model(body + opset_onnx + opset_ms)
+    assert opset == 17
+
+
 def test_wire_dynamic_dims_roundtrip():
     """dim_param (symbolic batch) dims decode as None, not 0."""
     from hetu_tpu.onnx import wire

@@ -1,0 +1,149 @@
+"""Multi-process PS transport + launcher smoke tests.
+
+Reference behaviors matched: ps-lite van RPC between worker and server
+PROCESSES (src/van.cc, zmq_van.h) with server-side optimizers; heturun's
+multi-process bring-up (runner.py:150, tests/pstests/test_apis.py spawns
+scheduler+server+worker and checks push/pull numerics)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import (EmbeddingTable, ShardedTable, PSServer,
+                         RemoteTable)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _spawn_server(rows, dim, lr=1.0):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hetu_tpu.ps.rpc", "--rows", str(rows),
+         "--dim", str(dim), "--port", "0", "--optimizer", "sgd",
+         "--lr", str(lr), "--init-scale", "0"],
+        cwd=REPO, stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    m = re.match(r"PS_SERVER_READY (\S+) (\d+)", line)
+    assert m, f"server failed to start: {line!r}"
+    return proc, m.group(1), int(m.group(2))
+
+
+def test_remote_table_matches_local_oracle(rng):
+    """Push/lookup through a real server PROCESS equals the in-process
+    table math (reference test_apis.py ground-truth check)."""
+    rows, dim = 64, 8
+    proc, host, port = _spawn_server(rows, dim, lr=1.0)
+    try:
+        remote = RemoteTable(host, port)
+        assert (remote.rows, remote.dim) == (rows, dim)
+        oracle = EmbeddingTable(rows, dim, optimizer="sgd", lr=1.0,
+                                init_scale=0)
+
+        keys = rng.integers(0, rows, (32,))
+        vals = rng.standard_normal((32, dim)).astype(np.float32)
+        remote.set_rows(keys, vals)
+        oracle.set_rows(keys, vals)
+        np.testing.assert_allclose(remote.lookup(keys),
+                                   oracle.lookup(keys), rtol=1e-6)
+
+        grads = rng.standard_normal((32, dim)).astype(np.float32)
+        remote.push(keys, grads)
+        oracle.push(keys, grads)
+        np.testing.assert_allclose(remote.lookup(np.arange(rows)),
+                                   oracle.lookup(np.arange(rows)),
+                                   rtol=1e-6)
+        # versions advanced identically
+        np.testing.assert_array_equal(remote.versions(keys),
+                                      oracle.versions(keys))
+        remote.shutdown_server()
+        remote.close()
+        assert proc.wait(timeout=10) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_sharded_table_mixes_local_and_remote(rng):
+    """A ShardedTable routing over one LOCAL and one REMOTE (separate
+    process) shard behaves exactly like an all-local one."""
+    rows, dim = 96, 4
+    per = rows // 2
+    proc, host, port = _spawn_server(per, dim, lr=1.0)
+    try:
+        remote = RemoteTable(host, port)
+        local = EmbeddingTable(per, dim, optimizer="sgd", lr=1.0,
+                               init_scale=0)
+        mixed = ShardedTable(rows, dim, tables=[local, remote])
+        ref = ShardedTable(rows, dim, nshards=2, optimizer="sgd", lr=1.0,
+                           init_scale=0)
+
+        keys = rng.integers(0, rows, (40,))
+        grads = rng.standard_normal((40, dim)).astype(np.float32)
+        mixed.push(keys, grads)
+        ref.push(keys, grads)
+        all_keys = np.arange(rows)
+        np.testing.assert_allclose(mixed.lookup(all_keys),
+                                   ref.lookup(all_keys), rtol=1e-6)
+        remote.shutdown_server()
+        remote.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.timeout(300)
+def test_launcher_spawns_two_jax_distributed_workers(rng, tmp_path):
+    """VERDICT #10 done-criterion: launcher spawns 2 real processes that
+    initialize jax.distributed (CPU backend), run a cross-process
+    collective, and share ONE PS table served by a third process."""
+    from hetu_tpu.launcher import DistConfig
+
+    dim = 4
+    proc, host, port = _spawn_server(32, dim, lr=1.0)
+    script = os.path.join(REPO, "examples", "parallel",
+                          "distributed_smoke.py")
+    config = DistConfig(num_local_workers=2, port=13137)
+    workers = []
+    try:
+        for pid in range(2):
+            env = dict(os.environ)
+            env.update(config.process_env(pid))
+            # HETU_PLATFORM: initialize_from_env tears down any pre-
+            # initialized (sitecustomize) backend and forces CPU so
+            # jax.distributed can engage
+            env["HETU_PLATFORM"] = "cpu"
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)   # single CPU device per process
+            workers.append(subprocess.Popen(
+                [sys.executable, script, f"{host}:{port}", str(tmp_path)],
+                cwd=REPO, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        for w in workers:
+            out, _ = w.communicate(timeout=240)
+            assert w.returncode == 0, f"worker failed:\n{out}"
+
+        results = []
+        for pid in range(2):
+            with open(tmp_path / f"worker_{pid}.json") as f:
+                results.append(json.load(f))
+        for r in results:
+            assert r["nproc"] == 2
+            assert r["gathered"] == [0, 1]
+        # both workers' pushes landed in the shared server-side table:
+        # sgd lr=1, grads 1.0 and 2.0 on key 7 -> row value -3.0
+        remote = RemoteTable(host, port)
+        assert float(remote.lookup([7])[0, 0]) == pytest.approx(-3.0)
+        remote.shutdown_server()
+        remote.close()
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+        if proc.poll() is None:
+            proc.kill()
