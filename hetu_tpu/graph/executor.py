@@ -131,8 +131,19 @@ class SubExecutor:
         donate = (0, 1) if self.training else ()
         in_shardings = self.executor._input_shardings(self)
         if in_shardings is not None:
+            # pin updated params/opt-state to their INPUT shardings: with
+            # interior reshard constraints in the program, GSPMD may
+            # otherwise emit new param values in a different layout,
+            # which would mismatch the next call's in_shardings (and
+            # defeat donation aliasing).  Eval outputs gather replicated
+            # (reference reduceMean/gatherPredict, executor.py:680).
+            from ..parallel.mesh import replicated
+            param_sh, opt_sh, _, _ = in_shardings
+            out_shardings = (replicated(self.executor.mesh),
+                             param_sh, opt_sh)
             self._jitted = jax.jit(step_fn, donate_argnums=donate,
-                                   in_shardings=in_shardings)
+                                   in_shardings=in_shardings,
+                                   out_shardings=out_shardings)
         else:
             self._jitted = jax.jit(step_fn, donate_argnums=donate)
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding
 
 from .node import Op, PlaceholderOp, VariableOp, find_topo_sort
 
@@ -73,6 +74,15 @@ def evaluate(eval_nodes, bindings, ctx: TraceContext, topo=None):
         else:
             input_vals = [env[i] for i in node.inputs]
             env[node] = node._compute(input_vals, ctx)
+        # interior sharding annotations (set by a Strategy or ht.dispatch)
+        # lower to with_sharding_constraint — the per-node reshard points
+        # the reference's rewrite pass materialized as comm ops
+        # (context.py:1469); GSPMD emits the collectives.
+        if (node.dist_state is not None and ctx.mesh is not None
+                and hasattr(env[node], "ndim")):
+            sh = NamedSharding(ctx.mesh,
+                               node.dist_state.to_pspec(env[node].ndim))
+            env[node] = jax.lax.with_sharding_constraint(env[node], sh)
     return [env[n] for n in eval_nodes], env
 
 

@@ -111,6 +111,17 @@ class GraphCost:
                       and not isinstance(n, (PlaceholderOp, VariableOp))]
         self._rest_time = {}  # dp degree -> summed non-backbone time
 
+    def maybe_record(self, measure, feed_shapes=None):
+        """Profile each distinct op once into the simulator's cache (the
+        reference's profiling-backed simulate, base.py:663); roofline
+        estimates back-fill anything that fails to profile."""
+        if not measure:
+            return
+        try:
+            self.sim.record(self.eval_nodes, feed_shapes)
+        except Exception:
+            pass
+
     def node_cost(self, node, choice):
         t = self.sim.op_time(node, self.shapes,
                              shard_factor=choice.shard_factor)
@@ -183,18 +194,122 @@ def _assignment_mesh(assignment, ndev):
     return make_mesh(axes)
 
 
+class HeterogeneousStrategy(Strategy):
+    """Per-node layouts on ONE binary-factored mesh (m0..m{k-1}, 2^k
+    devices).  A node with (dp, tp) shards its batch dim over the first
+    log2(dp) axes and its weight/output feature dim over the next
+    log2(tp) axes; differently-laid-out neighbors meet at
+    with_sharding_constraint reshard points (graph/trace.py lowers
+    interior dist_state annotations), where GSPMD inserts the
+    collectives the reference emitted as cross_send/cross_receive
+    (context.py:1658).  This keeps FlexFlow's per-node heterogeneity —
+    the point of the MCMC — instead of projecting onto one grid.
+    """
+
+    def __init__(self, assignment, ndev, shapes=None, ndims=None):
+        self.assignment = dict(assignment)
+        k = int(math.log2(ndev)) if ndev > 1 else 0
+        assert 2 ** k == ndev, f"heterogeneous mesh needs 2^k devices, " \
+                               f"got {ndev}"
+        self.k = k
+        self.axes = tuple(f"m{i}" for i in range(k))
+        self.mesh = make_mesh({a: 2 for a in self.axes}) if k else \
+            make_mesh({"m0": 1})
+        self._shapes = shapes or {}
+        self._ndims = ndims or {}   # node name -> output ndim (persisted)
+
+    def _split(self, choice):
+        a = int(math.log2(choice.dp)) if choice.dp > 1 else 0
+        b = int(math.log2(choice.tp)) if choice.tp > 1 else 0
+        assert a + b <= self.k
+        dp_axes = self.axes[:a]
+        tp_axes = self.axes[a:a + b]
+        return dp_axes, tp_axes
+
+    @staticmethod
+    def _axis_entry(axes):
+        if not axes:
+            return None
+        return axes[0] if len(axes) == 1 else tuple(axes)
+
+    def annotate(self, eval_nodes):
+        first_dp = None
+        for node, choice in self.assignment.items():
+            dp_axes, tp_axes = self._split(choice)
+            if first_dp is None:
+                first_dp = dp_axes
+            splits = {}
+            if dp_axes:
+                splits[0] = self._axis_entry(dp_axes)
+            out = self._shapes.get(node)
+            if out is not None:
+                ndim = len(out.shape)
+            else:
+                ndim = self._ndims.get(node.name, 2)
+            if tp_axes and ndim >= 2:
+                splits[ndim - 1] = self._axis_entry(tp_axes)
+            node.dist_state = DistState(splits) if splits else None
+            w = _weight_of(node)
+            if w is not None and tp_axes:
+                w.dist_state = DistState(
+                    {len(w.shape) - 1: self._axis_entry(tp_axes)})
+        # batch-bearing placeholders follow the first backbone node's dp
+        if first_dp:
+            for n in find_topo_sort(eval_nodes):
+                if isinstance(n, PlaceholderOp) and n.dist_state is None:
+                    if n.shape and len(n.shape) >= 1:
+                        n.dist_state = DistState(
+                            {0: self._axis_entry(first_dp)})
+        return self.mesh
+
+    # -- persistence (reference Strategy.save_json base.py:183) -----------
+    def config(self):
+        def ndim_of(n):
+            out = self._shapes.get(n)
+            if out is not None:
+                return len(out.shape)
+            return self._ndims.get(n.name, 2)
+
+        return {"strategy": "HeterogeneousStrategy", "ndev": 2 ** self.k,
+                "assignment": {n.name: [c.dp, c.tp, ndim_of(n)]
+                               for n, c in self.assignment.items()}}
+
+    @classmethod
+    def from_config(cls, cfg, eval_nodes):
+        """Rebuild against a (re-constructed) graph: nodes resolved by
+        name, so the same model-building code must have produced them.
+        Output ranks travel in the config, so restored strategies place
+        tp splits on the same (last) axis the search scored."""
+        by_name = {n.name: n for n in find_topo_sort(eval_nodes)}
+        assignment, ndims = {}, {}
+        for name, entry in cfg["assignment"].items():
+            dp, tp = entry[0], entry[1]
+            node = by_name.get(name)
+            if node is None:
+                raise KeyError(
+                    f"searched node {name!r} absent from the graph — "
+                    "was the model rebuilt with different names?")
+            assignment[node] = LayoutChoice(dp=dp, tp=tp,
+                                            tp_dim=1 if tp > 1 else None)
+            if len(entry) > 2:
+                ndims[name] = int(entry[2])
+        return cls(assignment, cfg["ndev"], ndims=ndims)
+
+
 class OptCNNSearch:
     """DP over the backbone chain (reference optcnn.py:9): state = layout of
     the current backbone node; edge = reshard cost between layouts."""
 
-    def __init__(self, ndev=None, simulator=None):
+    def __init__(self, ndev=None, simulator=None, measure=True):
         self.ndev = ndev
         self.sim = simulator
+        self.measure = measure
 
     def search(self, eval_nodes, feed_shapes=None):
         import jax
         ndev = self.ndev or len(jax.devices())
         cost = GraphCost(eval_nodes, ndev, self.sim, feed_shapes)
+        cost.maybe_record(self.measure, feed_shapes)
         chain = cost.backbone
         if not chain:
             return SearchedStrategy({}, make_mesh({"dp": 1}))
@@ -226,20 +341,31 @@ class OptCNNSearch:
 
 class FlexFlowSearch:
     """MCMC over per-node layouts (reference flexflow.py:12 — random
-    proposals accepted by simulated delta with temperature)."""
+    proposals accepted by simulated delta with temperature).
+
+    ``measure=True`` (default) profiles each distinct op once and feeds
+    the simulator MEASURED times (disk-cached), the reference's
+    profiling-backed simulate (base.py:663); roofline estimates only
+    back-fill ops that fail to profile.  ``project=True`` collapses the
+    result onto one (dp, tp) grid (the round-1 behavior); the default
+    keeps per-node heterogeneity via HeterogeneousStrategy.
+    """
 
     def __init__(self, ndev=None, simulator=None, iters=200, temp=1e-4,
-                 seed=0):
+                 seed=0, measure=True, project=False):
         self.ndev = ndev
         self.sim = simulator
         self.iters = iters
         self.temp = temp
+        self.measure = measure
+        self.project = project
         self.rng = np.random.default_rng(seed)
 
     def search(self, eval_nodes, feed_shapes=None):
         import jax
         ndev = self.ndev or len(jax.devices())
         cost = GraphCost(eval_nodes, ndev, self.sim, feed_shapes)
+        cost.maybe_record(self.measure, feed_shapes)
         chain = cost.backbone
         if not chain:
             return SearchedStrategy({}, make_mesh({"dp": 1}))
@@ -266,9 +392,18 @@ class FlexFlowSearch:
                     best, best_assign = t, dict(assign)
             else:
                 assign[n] = old
-        # project to a single mesh: try every grid the chain visited,
-        # re-score each projected assignment, keep the cheapest (the MCMC
-        # best's cost is meaningless once nodes are forced onto one grid)
+        if not self.project:
+            # keep the heterogeneous per-node result — restrict choices to
+            # power-of-two shard counts the binary mesh can express
+            k = int(math.log2(ndev)) if ndev > 1 else 0
+            hetero = {n: c for n, c in best_assign.items()
+                      if (c.dp & (c.dp - 1)) == 0
+                      and (c.tp & (c.tp - 1)) == 0
+                      and c.dp * c.tp <= 2 ** k}
+            return HeterogeneousStrategy(hetero, 2 ** k,
+                                         shapes=cost.shapes)
+        # legacy projection: try every grid the chain visited, re-score
+        # each projected assignment, keep the cheapest
         grids = {(c.dp, c.tp) for c in best_assign.values()}
         grids.add((max(c.dp for c in assign.values()), 1))  # pure-DP anchor
         proj_best = (float("inf"), None)

@@ -66,44 +66,56 @@ class _Handler(socketserver.BaseRequestHandler):
                 header, payloads = recv_msg(self.request)
             except (ConnectionError, struct.error):
                 return
-            verb = header["verb"]
-            if verb == "lookup":
-                keys = np.frombuffer(payloads[0], "<i8")
-                out = table.lookup(keys)
-                send_msg(self.request, {"verb": "ok"},
-                         out.astype("<f4"))
-            elif verb == "push":
-                keys = np.frombuffer(payloads[0], "<i8")
-                grads = np.frombuffer(payloads[1], "<f4").reshape(
-                    keys.size, table.dim)
-                table.push(keys, grads)
-                send_msg(self.request, {"verb": "ok"})
-            elif verb == "set_rows":
-                keys = np.frombuffer(payloads[0], "<i8")
-                vals = np.frombuffer(payloads[1], "<f4").reshape(
-                    keys.size, table.dim)
-                table.set_rows(keys, vals)
-                send_msg(self.request, {"verb": "ok"})
-            elif verb == "versions":
-                keys = np.frombuffer(payloads[0], "<i8")
-                send_msg(self.request, {"verb": "ok"},
-                         table.versions(keys).astype("<u8"))
-            elif verb == "meta":
-                send_msg(self.request, {"verb": "ok", "rows": table.rows,
-                                        "dim": table.dim})
-            elif verb == "save":
-                table.save(header["path"])
-                send_msg(self.request, {"verb": "ok"})
-            elif verb == "load":
-                table.load(header["path"])
-                send_msg(self.request, {"verb": "ok"})
-            elif verb == "shutdown":
-                send_msg(self.request, {"verb": "ok"})
-                self.server._shutdown_requested.set()
-                return
-            else:
-                send_msg(self.request, {"verb": "error",
-                                        "message": f"bad verb {verb}"})
+            try:
+                self._dispatch(table, header, payloads)
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                # keep the connection alive and report the REAL error, so
+                # one bad request (save path, malformed push) doesn't
+                # brick the shard for the rest of training
+                try:
+                    send_msg(self.request,
+                             {"verb": "error",
+                              "message": f"{type(e).__name__}: {e}"})
+                except OSError:
+                    return
+
+    def _dispatch(self, table, header, payloads):
+        verb = header["verb"]
+        if verb == "lookup":
+            keys = np.frombuffer(payloads[0], "<i8")
+            send_msg(self.request, {"verb": "ok"},
+                     table.lookup(keys).astype("<f4"))
+        elif verb == "push":
+            keys = np.frombuffer(payloads[0], "<i8")
+            grads = np.frombuffer(payloads[1], "<f4").reshape(
+                keys.size, table.dim)
+            table.push(keys, grads)
+            send_msg(self.request, {"verb": "ok"})
+        elif verb == "set_rows":
+            keys = np.frombuffer(payloads[0], "<i8")
+            vals = np.frombuffer(payloads[1], "<f4").reshape(
+                keys.size, table.dim)
+            table.set_rows(keys, vals)
+            send_msg(self.request, {"verb": "ok"})
+        elif verb == "versions":
+            keys = np.frombuffer(payloads[0], "<i8")
+            send_msg(self.request, {"verb": "ok"},
+                     table.versions(keys).astype("<u8"))
+        elif verb == "meta":
+            send_msg(self.request, {"verb": "ok", "rows": table.rows,
+                                    "dim": table.dim})
+        elif verb == "save":
+            table.save(header["path"])
+            send_msg(self.request, {"verb": "ok"})
+        elif verb == "load":
+            table.load(header["path"])
+            send_msg(self.request, {"verb": "ok"})
+        elif verb == "shutdown":
+            send_msg(self.request, {"verb": "ok"})
+            self.server._shutdown_requested.set()
+        else:
+            send_msg(self.request, {"verb": "error",
+                                    "message": f"bad verb {verb}"})
 
 
 class PSServer:

@@ -166,9 +166,23 @@ class ShardedTable:
             # pre-built shards — local EmbeddingTables and/or rpc.RemoteTable
             # clients reaching server processes over DCN (the reference's
             # multi-host server layout, ps-lite postoffice key ranges)
+            if kw:
+                raise TypeError(
+                    f"table kwargs {sorted(kw)} are ignored with tables= "
+                    "(build the shards with those options instead)")
             self.shards = list(tables)
             self.nshards = len(self.shards)
             self.rows, self.dim = int(rows), int(dim)
+            per = (self.rows + self.nshards - 1) // self.nshards
+            for s, t in enumerate(self.shards):
+                if t.dim != self.dim:
+                    raise ValueError(f"shard {s} dim {t.dim} != {self.dim}")
+                if t.rows < per:
+                    # undersized shards would make the native store treat
+                    # tail keys as pads: pushes silently dropped
+                    raise ValueError(
+                        f"shard {s} has {t.rows} rows < {per} needed for "
+                        f"{self.rows} rows over {self.nshards} shards")
             return
         self.nshards = nshards
         self.rows, self.dim = int(rows), int(dim)

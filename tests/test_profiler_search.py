@@ -145,3 +145,84 @@ def test_gpipe_vs_pipedream_and_pipeopt():
     best = PipeOptSearch(ndev=8).search(times)
     assert best["pp"] * best["dp"] <= 8
     assert best["time"] > 0
+
+
+def test_search_recovers_tp_when_dp_cannot_scale():
+    """Synthetic graph where DP-only is provably worse: batch 2 on 8
+    devices caps dp at 2 (6 idle under pure DP), while the dominant
+    matmuls have wide, tp-splittable weights.  The searcher must assign
+    tp > 1 to the big layers (VERDICT #7 done-criterion)."""
+    x = ht.placeholder_op("ks_x", (2, 1024))
+    y = ht.placeholder_op("ks_y", (2,), dtype=np.int32)
+    w1 = ht.Variable("ks_w1", shape=(1024, 8192),
+                     initializer=ht.init.xavier_normal())
+    w2 = ht.Variable("ks_w2", shape=(8192, 4096),
+                     initializer=ht.init.xavier_normal())
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    logits = ht.matmul_op(h, w2)
+    loss = ht.reduce_mean_op(
+        ht.softmax_cross_entropy_sparse_op(logits, y))
+
+    ff = FlexFlowSearch(ndev=8, iters=300, seed=0, measure=False)
+    strat = ff.search([loss])
+    choices = list(strat.assignment.values())
+    assert any(c.tp > 1 for c in choices), choices
+    # and pure DP cannot exceed the batch
+    assert all(c.dp <= 2 for c in choices), choices
+
+
+def test_heterogeneous_strategy_trains_with_reshard_points(rng):
+    """Two backbone nodes with DIFFERENT layouts on one binary mesh:
+    interior dist_state annotations lower to with_sharding_constraint
+    reshard points, and training matches the replicated run."""
+    from hetu_tpu.parallel.search import (HeterogeneousStrategy,
+                                          LayoutChoice, backbone_nodes)
+
+    B = 8
+    x = ht.placeholder_op("ht_x", (B, 64))
+    y = ht.placeholder_op("ht_y", (B, 16))
+    w1 = ht.Variable("ht_w1", shape=(64, 128),
+                     initializer=ht.init.xavier_normal())
+    w2 = ht.Variable("ht_w2", shape=(128, 16),
+                     initializer=ht.init.xavier_normal())
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    out = ht.matmul_op(h, w2)
+    loss = ht.mse_loss_op(out, y)
+
+    X = rng.standard_normal((B, 64)).astype(np.float32)
+    Y = rng.standard_normal((B, 16)).astype(np.float32)
+    opt_r = ht.SGDOptimizer(0.1)
+    ex_ref = ht.Executor([loss, opt_r.minimize(loss)], seed=2)
+    l_ref = [ex_ref.run(feed_dict={x: X, y: Y},
+                        convert_to_numpy_ret_vals=True)[0]
+             for _ in range(4)]
+
+    bb = backbone_nodes([loss])
+    assert len(bb) == 2
+    # node 0: dp=2 x tp=4 (column-parallel); node 1: dp=8 pure data
+    assignment = {bb[0]: LayoutChoice(dp=2, tp=4, tp_dim=1),
+                  bb[1]: LayoutChoice(dp=8)}
+    strat = HeterogeneousStrategy(assignment, ndev=8)
+    opt_h = ht.SGDOptimizer(0.1)
+    ex_h = ht.Executor([loss, opt_h.minimize(loss)], seed=2,
+                       dist_strategy=strat)
+    # weights really placed per-layout: w1 feature-dim sharded
+    assert ex_h.params["ht_w1"].sharding.spec[1] is not None
+    l_h = [ex_h.run(feed_dict={x: X, y: Y},
+                    convert_to_numpy_ret_vals=True)[0]
+           for _ in range(4)]
+    np.testing.assert_allclose(l_h, l_ref, rtol=2e-5, atol=1e-6)
+
+
+def test_measured_times_feed_search(tmp_path):
+    """measure=True profiles ops once and the simulator serves MEASURED
+    times afterwards (the reference's profiling-backed simulate)."""
+    from hetu_tpu.profiler import HetuSimulator
+    loss, x, y = _mlp_loss(batch=32, din=32, dh=64)
+    sim = HetuSimulator(cache_path=str(tmp_path / "times.json"))
+    assert not sim._cache
+    OptCNNSearch(ndev=8, simulator=sim, measure=True).search([loss])
+    assert sim._cache, "search did not record measured op times"
+    import json
+    with open(tmp_path / "times.json") as f:
+        assert json.load(f)  # persisted for the next search
