@@ -1,50 +1,72 @@
-"""Headline benchmark: BERT-base pretraining step throughput on one chip.
+"""Driver benchmark: three north-star metrics vs MEASURED same-chip baselines.
 
-Reproduces the reference's north-star config (BASELINE.md: examples/nlp/bert
-train_hetu_bert_base_dp.sh — per-device batch 64, seq 512, hidden 768,
-12 layers, Adam) and measures samples/sec on the local accelerator.
+BASELINE.md's contract (the reference publishes almost no absolute numbers):
+measure the same workload shapes through stock flax/optax — the trusted TPU
+idiom MaxText builds on — on the SAME chip, and report `vs_baseline` against
+that (VERDICT round-1 item 6).  The three metrics mirror the reference's own
+benchmark configs (BASELINE.json):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  1. BERT-base pretraining samples/sec/chip (examples/nlp/bert headline:
+     per-device batch 64, seq 512, Adam, dropout on) — headline metric.
+  2. GPT-2.7B-shape transformer-layer forward ms (Galvatron computation
+     profile: hidden 2560, 32 heads, seq 2048, bsz 2, bf16).  The reference
+     repo DOES publish this one: layertype_0 = 2.0645 ms on A100-40GB
+     (tools/Hetu-Galvatron/.../computation_profiling_bf16_hidden2560_...json)
+     — reported alongside the same-chip flax baseline.
+  3. Wide&Deep Criteo-shaped steps/sec, in-graph embedding path
+     (examples/ctr wdl_criteo: 26 sparse + 13 dense, 337k rows).
 
-``vs_baseline`` compares against 55 samples/sec/chip — our standing estimate
-of per-A100 BERT-base seq-512 mixed-precision training throughput for the
-reference's 8×A100 DP configuration (the reference publishes no absolute
-numbers; BASELINE.md documents this).
+Prints ONE JSON line: the headline metric plus an `extra_metrics` list, every
+`vs_baseline` a ratio > 1 iff we beat the measured flax number.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-A100_BASELINE_SAMPLES_PER_SEC = 55.0
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_A100_GPT_LAYER_MS = 2.0645  # published in the reference repo
 
 
-def main():
-    quick = "--quick" in sys.argv
+def _timeit(fn, reps):
+    """Time reps calls of fn; fn must return something SMALL (a scalar or
+    loss list).  np.asarray forces real materialization — through the dev
+    tunnel, block_until_ready alone has been observed returning before
+    pure pallas outputs finish (0.02 ms "timings")."""
+    import jax
+
+    def sync(out):
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+
+    out = fn()
+    sync(out)
+    start = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    sync(out)
+    return (time.perf_counter() - start) / reps, out
+
+
+def bench_bert(quick):
+    """Ours: graph-API BERT-base, bf16 compute + f32 masters, Pallas flash
+    attention, AdamW — the reference headline config."""
     import jax
     import jax.numpy as jnp
     import hetu_tpu as ht
     from hetu_tpu.models import BertConfig, BertForPreTraining
 
-    on_cpu = jax.default_backend() == "cpu"
-    if quick or on_cpu:
-        B, S = 8, 128
-        c = BertConfig(vocab_size=30522, hidden_size=768,
-                       num_hidden_layers=2, seq_len=S,
-                       max_position_embeddings=512)
+    if quick:
+        B, S, L, steps = 8, 128, 2, 5
     else:
-        # the reference's headline config exactly (per-device batch 64,
-        # seq 512); fits in HBM since attention runs through the Pallas
-        # flash kernel (no S^2 score tensors)
-        B, S = 64, 512
-        c = BertConfig(vocab_size=30522, hidden_size=768,
-                       num_hidden_layers=12, seq_len=S,
-                       max_position_embeddings=512)
-
+        B, S, L, steps = 64, 512, 12, 20
+    c = BertConfig(vocab_size=30522, hidden_size=768, num_hidden_layers=L,
+                   seq_len=S, max_position_embeddings=512)
     rng = np.random.default_rng(0)
     input_ids = ht.placeholder_op("input_ids", (B, S), dtype=np.int32)
     token_type = ht.placeholder_op("token_type_ids", (B, S), dtype=np.int32)
@@ -56,39 +78,172 @@ def main():
     loss = model.loss(input_ids, token_type, attn_mask, mlm_labels,
                       nsp_labels)
     opt = ht.AdamWOptimizer(learning_rate=1e-4, weight_decay=0.01)
-    # bf16 compute / f32 master weights: the MXU-native mixed precision
+    # rbg: TPU-native RNG for dropout (the flax baseline gets it too)
     ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
-                     compute_dtype=jnp.bfloat16)
+                     compute_dtype=jnp.bfloat16,
+                     rng_impl=None if quick else "rbg")
 
     ids = rng.integers(0, c.vocab_size, (B, S))
     mlm = np.full((B * S,), -1, np.int64)
     mask_pos = rng.random(B * S) < 0.15
     mlm[mask_pos] = rng.integers(0, c.vocab_size, mask_pos.sum())
-    feed = {input_ids: ids,
-            token_type: rng.integers(0, 2, (B, S)),
-            attn_mask: np.ones((B, S), np.float32),
-            mlm_labels: mlm,
-            nsp_labels: rng.integers(0, 2, (B,))}
+    # device-resident feeds: the baseline's data also lives on device, and
+    # through the dev tunnel a per-step host->device upload would time the
+    # link, not the chip (a real input pipeline prefetches to device)
+    feed = {input_ids: jnp.asarray(ids, jnp.int32),
+            token_type: jnp.asarray(rng.integers(0, 2, (B, S)), jnp.int32),
+            attn_mask: jnp.ones((B, S), jnp.float32),
+            mlm_labels: jnp.asarray(mlm, jnp.int32),
+            nsp_labels: jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32)}
 
-    # warmup / compile
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0]), "non-finite loss"
+    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
+    ours = B / dt
 
-    steps = 5 if (quick or on_cpu) else 20
-    start = time.perf_counter()
-    for _ in range(steps):
-        out = ex.run("train", feed_dict=feed)
-    jax.block_until_ready([o for o in out if o is not None])
-    elapsed = time.perf_counter() - start
+    from benchmarks.flax_baselines import bert_samples_per_sec
+    base = bert_samples_per_sec(B, S, layers=L, steps=max(3, steps // 2))
+    return {"metric": "bert_base_train_samples_per_sec_per_chip",
+            "value": round(ours, 2), "unit": "samples/sec",
+            "vs_baseline": round(ours / base, 3),
+            "baseline": {"flax_same_chip": round(base, 2)}}
 
-    samples_per_sec = steps * B / elapsed
-    print(json.dumps({
-        "metric": "bert_base_train_samples_per_sec_per_chip",
-        "value": round(samples_per_sec, 2),
-        "unit": "samples/sec",
-        "vs_baseline": round(samples_per_sec / A100_BASELINE_SAMPLES_PER_SEC,
-                             3),
-    }))
+
+def bench_gpt_layer(quick):
+    """Ours: pre-norm GPT-2.7B-shape layer (d_head=80) with the Pallas
+    flash kernel, 30-layer `lax.scan` in ONE jitted program (per-call
+    timing through the dev tunnel is unreliable; BASELINE.md notes)."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.ops.pallas.flash_attention import flash_attention
+
+    if quick:
+        B, S, H, heads, n_layers, reps = 1, 256, 128, 2, 2, 2
+    else:
+        B, S, H, heads, n_layers, reps = 2, 2048, 2560, 32, 30, 5
+    d = H // heads
+    dtype = jnp.bfloat16
+    key = jax.random.key(0)
+    ks = jax.random.split(key, 6)
+    s3 = 0.02
+    params = {
+        "ln1": jnp.ones((n_layers, H), dtype),
+        "ln2": jnp.ones((n_layers, H), dtype),
+        "qkv": jax.random.normal(ks[0], (n_layers, H, 3 * H), dtype) * s3,
+        "proj": jax.random.normal(ks[1], (n_layers, H, H), dtype) * s3,
+        "fc1": jax.random.normal(ks[2], (n_layers, H, 4 * H), dtype) * s3,
+        "fc2": jax.random.normal(ks[3], (n_layers, 4 * H, H), dtype) * s3,
+    }
+    x = jax.random.normal(ks[4], (B, S, H), dtype)
+
+    def ln(x, g):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.var(xf, -1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype) * g
+
+    def layer(x, p):
+        h = ln(x, p["ln1"])
+        qkv = h @ p["qkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        rs = lambda t: t.reshape(B, S, heads, d).transpose(0, 2, 1, 3)
+        o = flash_attention(rs(q), rs(k), rs(v), causal=True)
+        assert o is not None, "flash kernel must cover the GPT shape"
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
+        x = x + o @ p["proj"]
+        f = ln(x, p["ln2"])
+        f = jax.nn.gelu(f @ p["fc1"])
+        return (x + f @ p["fc2"], None)
+
+    @jax.jit
+    def fwd(params, x):
+        out, _ = jax.lax.scan(lambda c, p: layer(c, p), x, params)
+        return jnp.sum(out.astype(jnp.float32))
+
+    dt, _ = _timeit(lambda: fwd(params, x), reps)
+    ours_ms = dt * 1000.0 / n_layers
+    # free our stacked params before the flax baseline allocates its own
+    # 30-layer f32 stack — together they exceed one chip's HBM
+    del params, x
+    fwd.clear_cache()
+    import gc
+    gc.collect()
+
+    from benchmarks.flax_baselines import gpt_layer_fwd_ms
+    if quick:
+        base_ms = gpt_layer_fwd_ms(batch=B, seq=S, hidden=H, heads=heads,
+                                   n_layers=n_layers, reps=reps)
+    else:
+        base_ms = gpt_layer_fwd_ms()
+    return {"metric": "gpt_2.7b_layer_fwd_ms", "value": round(ours_ms, 4),
+            "unit": "ms (lower is better)",
+            "vs_baseline": round(base_ms / ours_ms, 3),
+            "baseline": {"flax_same_chip_ms": round(base_ms, 4),
+                         "reference_a100_ms": REFERENCE_A100_GPT_LAYER_MS}}
+
+
+def bench_wdl(quick):
+    """Ours: graph-API Wide&Deep, in-graph embedding (the TPU-preferred
+    path when the table fits HBM), Adam."""
+    import hetu_tpu as ht
+    from hetu_tpu.models import WDL
+
+    B, rows = (32, 5000) if quick else (128, 337000)
+    steps = 10 if quick else 30
+    rng = np.random.default_rng(0)
+    dense = ht.placeholder_op("dense", (B, 13))
+    sparse = ht.placeholder_op("sparse", (B, 26), dtype=np.int32)
+    labels = ht.placeholder_op("labels", (B,))
+    model = WDL(rows, embedding_dim=16)
+    loss = model.loss(dense, sparse, labels)
+    ex = ht.Executor(
+        {"train": [loss, ht.AdamOptimizer(0.01).minimize(loss)]})
+    import jax.numpy as jnp
+    feed = {dense: jnp.asarray(rng.standard_normal((B, 13)), jnp.float32),
+            sparse: jnp.asarray(rng.integers(0, rows, (B, 26)), jnp.int32),
+            labels: jnp.asarray(rng.integers(0, 2, (B,)), jnp.float32)}
+    out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
+    assert np.isfinite(out[0])
+    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
+    ours = 1.0 / dt
+
+    from benchmarks.flax_baselines import wdl_steps_per_sec
+    base = wdl_steps_per_sec(B, rows=rows, steps=steps)
+    return {"metric": "wdl_criteo_train_steps_per_sec",
+            "value": round(ours, 2), "unit": "steps/sec",
+            "vs_baseline": round(ours / base, 3),
+            "baseline": {"flax_same_chip": round(base, 2)}}
+
+
+STAGES = {"bert": bench_bert, "gpt": bench_gpt_layer, "wdl": bench_wdl}
+
+
+def main():
+    quick = "--quick" in sys.argv
+    if "--stage" in sys.argv:
+        # only stage children may touch jax: the backend check in the
+        # PARENT would acquire the TPU exclusively and starve them
+        quick = quick or __import__("jax").default_backend() == "cpu"
+        stage = sys.argv[sys.argv.index("--stage") + 1]
+        print(json.dumps(STAGES[stage](quick)))
+        return
+    # each stage in its own process: ours + the flax baseline together
+    # exceed one chip's HBM at the BERT headline shapes, and a fresh
+    # process returns the chip clean for the next stage
+    import subprocess
+    results = {}
+    for stage in STAGES:
+        cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage]
+        if quick:
+            cmd.append("--quick")
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr[-2000:])
+            raise RuntimeError(f"bench stage {stage} failed")
+        results[stage] = json.loads(proc.stdout.strip().splitlines()[-1])
+    headline = dict(results["bert"])
+    headline["extra_metrics"] = [results["gpt"], results["wdl"]]
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -1,6 +1,6 @@
 from .node import (Op, PlaceholderOp, VariableOp, find_topo_sort,
                    graph_variables, graph_placeholders, stage,
-                   current_stage)
+                   current_stage, name_scope, scoped_init)
 from .trace import TraceContext, evaluate
 from .autodiff import gradients
 from .executor import Executor, SubExecutor

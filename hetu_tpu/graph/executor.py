@@ -107,7 +107,12 @@ class SubExecutor:
                 return x.astype(compute_dtype)
             return x
 
-        def step_fn(params, opt_state, feeds, key):
+        def step_fn(params, opt_state, feeds, base_key, step):
+            # the per-step key derives INSIDE the program from a
+            # device-resident step counter — an eager fold_in per run()
+            # would dispatch a separate device op each step (several ms
+            # through a remote-tunnel link, dominating small models)
+            key = jax.random.fold_in(base_key, step)
             # mixed precision: forward/backward run in compute_dtype while
             # optimizers update the full-precision masters (the standard
             # TPU bf16-compute / f32-master-weights policy).
@@ -126,9 +131,9 @@ class SubExecutor:
                 new_params[var.name] = val.astype(params[var.name].dtype)
             new_opt_state = dict(opt_state)
             new_opt_state.update(ctx.new_opt_state)
-            return vals, new_params, new_opt_state
+            return vals, new_params, new_opt_state, step + 1
 
-        donate = (0, 1) if self.training else ()
+        donate = (0, 1, 4) if self.training else (4,)
         in_shardings = self.executor._input_shardings(self)
         if in_shardings is not None:
             # pin updated params/opt-state to their INPUT shardings: with
@@ -138,9 +143,9 @@ class SubExecutor:
             # defeat donation aliasing).  Eval outputs gather replicated
             # (reference reduceMean/gatherPredict, executor.py:680).
             from ..parallel.mesh import replicated
-            param_sh, opt_sh, _, _ = in_shardings
-            out_shardings = (replicated(self.executor.mesh),
-                             param_sh, opt_sh)
+            rep = replicated(self.executor.mesh)
+            param_sh, opt_sh, _, _, _ = in_shardings
+            out_shardings = (rep, param_sh, opt_sh, rep)
             self._jitted = jax.jit(step_fn, donate_argnums=donate,
                                    in_shardings=in_shardings,
                                    out_shardings=out_shardings)
@@ -213,10 +218,11 @@ class SubExecutor:
             v = feeds[p.name]
             if not isinstance(v, jax.Array):
                 feeds[p.name] = jnp.asarray(v, dtype=p.dtype)
-        key = jax.random.fold_in(ex._base_key, ex._global_step)
+        if ex._step_arr is None:
+            ex._step_arr = jnp.uint32(ex._global_step)
         ex._global_step += 1
-        vals, new_params, new_opt_state = self._jitted(
-            ex.params, ex.opt_state, feeds, key)
+        vals, new_params, new_opt_state, ex._step_arr = self._jitted(
+            ex.params, ex.opt_state, feeds, ex._base_key, ex._step_arr)
         ex.params = new_params
         ex.opt_state = new_opt_state
         # push PS-embedding grads ASYNC: the device array goes straight to
@@ -295,7 +301,8 @@ class SubExecutor:
         args = (jax.tree_util.tree_map(abstract, ex.params),
                 jax.tree_util.tree_map(abstract, ex.opt_state),
                 feeds,
-                jax.ShapeDtypeStruct((), ex._base_key.dtype))
+                jax.ShapeDtypeStruct((), ex._base_key.dtype),
+                jax.ShapeDtypeStruct((), jnp.uint32))
         return self._jitted.lower(*args).compile().cost_analysis()
 
 
@@ -329,9 +336,22 @@ class Executor:
                 self.mesh = dist_strategy.mesh
         self.all_topo = find_topo_sort(all_nodes)
         self.variables = [n for n in self.all_topo if isinstance(n, VariableOp)]
+        by_name = {}
+        for v in self.variables:
+            if by_name.setdefault(v.name, v) is not v:
+                raise ValueError(
+                    f"two distinct variables named {v.name!r} reach this "
+                    "executor; give the models distinct `name=`s or build "
+                    "them under separate `ht.name_scope()`s")
 
-        self._base_key = jax.random.key(seed)
+        # rng_impl="rbg" maps dropout/noise ops onto the TPU's hardware RNG
+        # (threefry, the default, burns real FLOPs generating bits —
+        # measurable on dropout-heavy training; rbg is the TPU-native
+        # choice when bit-exact cross-platform replay isn't required)
+        self._base_key = jax.random.key(seed,
+                                        impl=kwargs.get("rng_impl", None))
         self._global_step = 0
+        self._step_arr = None  # device-resident step counter (lazy)
         self.params = {}
         init_key = jax.random.fold_in(self._base_key, 0x5EED)
         for v in self.variables:
@@ -388,7 +408,8 @@ class Executor:
                     if vname in param_sh:
                         opt_sh[opname]["slots"][vname] = jax.tree_util.tree_map(
                             lambda _: param_sh[vname], state["slots"][vname])
-        return (param_sh, opt_sh, feed_sh, replicated(self.mesh))
+        return (param_sh, opt_sh, feed_sh, replicated(self.mesh),
+                replicated(self.mesh))
 
     # -- reference-compatible API -----------------------------------------
     def run(self, name_or_feed=None, feed_dict=None,
@@ -433,11 +454,29 @@ class Executor:
             if name in var_by_name:
                 v = var_by_name[name]
                 self.params[name] = self._place(v, jnp.asarray(value))
-        self.opt_state = jax.tree_util.tree_map(jnp.asarray,
-                                                state["opt_state"])
+        saved_opt = state["opt_state"]
+        if (set(saved_opt) != set(self.opt_state)
+                and len(saved_opt) == len(self.opt_state)):
+            # optimizer-op names carry a process-wide counter (a second
+            # optimizer instance in the same process gets `optimizer_2`);
+            # remap by construction order, validated against slot structure
+            remap = {}
+            for cur_name, sv_name in zip(sorted(self.opt_state),
+                                         sorted(saved_opt)):
+                cur, sv = self.opt_state[cur_name], saved_opt[sv_name]
+                if set(cur.get("slots", {})) != set(sv.get("slots", {})):
+                    raise ValueError(
+                        f"checkpoint optimizer state {sv_name!r} does not "
+                        f"match this graph's {cur_name!r} (different "
+                        "variable sets)")
+                remap[cur_name] = sv
+            saved_opt = remap
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray, saved_opt)
         self._global_step = state["global_step"]
+        self._step_arr = None  # re-materializes from _global_step
         self._base_key = jax.random.wrap_key_data(
-            jnp.asarray(state["base_key"]))
+            jnp.asarray(state["base_key"]),
+            impl=self.config.get("rng_impl", None))
 
     def get_params(self):
         return dict(self.params)

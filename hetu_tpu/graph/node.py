@@ -68,6 +68,64 @@ def current_stage():
     return _stage_stack()[-1]
 
 
+_naming_tls = _threading.local()
+
+
+def _naming_stack():
+    # index 0 is the process-global namespace (scope-less construction
+    # keeps its historical behavior); each `with name_scope():` pushes a
+    # fresh namespace so names are deterministic per instance.
+    stack = getattr(_naming_tls, "stack", None)
+    if stack is None:
+        stack = _naming_tls.stack = [{"vars": {}, "layers": {}}]
+    return stack
+
+
+class name_scope:
+    """Fresh, deterministic naming namespace for variables and layers.
+
+    Construction inside ``with name_scope():`` always produces the same
+    variable names, independent of what else was built in the process
+    before — so checkpoints keyed by name are stable across construction
+    order.  Model constructors open one per instance.  Genuine collisions
+    (two same-named variables reaching one Executor) raise there instead
+    of being silently renamed.
+    """
+
+    def __enter__(self):
+        _naming_stack().append({"vars": {}, "layers": {}})
+        return self
+
+    def __exit__(self, *exc):
+        _naming_stack().pop()
+        return False
+
+
+def scoped_init(init):
+    """Decorator: run a model's ``__init__`` inside its own `name_scope`,
+    making its parameter names independent of construction order."""
+    import functools
+
+    @functools.wraps(init)
+    def wrapper(self, *args, **kwargs):
+        with name_scope():
+            return init(self, *args, **kwargs)
+
+    return wrapper
+
+
+def _unique_var_name(name: str) -> str:
+    table = _naming_stack()[-1]["vars"]
+    count = table.get(name)
+    if count is None:
+        table[name] = 1
+        return name
+    table[name] = count + 1
+    name = f"{name}_{count}"
+    table[name] = 1
+    return name
+
+
 class Op:
     """A node in the dataflow graph.
 
@@ -184,19 +242,12 @@ class VariableOp(Op):
     __slots__ = ("shape", "dtype", "initializer", "trainable")
 
     # Executor state is keyed by variable name, so names must be unique
-    # across the process — two model instances built with default names
-    # would otherwise silently share (and clobber) parameter slots.
-    _used_names = {}
+    # within a namespace (`name_scope`); the Executor raises on genuine
+    # cross-scope collisions rather than silently renaming.
 
     def __init__(self, name, shape, initializer, trainable=True,
                  dtype=np.float32):
-        count = VariableOp._used_names.get(name)
-        if count is None:
-            VariableOp._used_names[name] = 1
-        else:
-            VariableOp._used_names[name] = count + 1
-            name = f"{name}_{count}"
-            VariableOp._used_names[name] = 1
+        name = _unique_var_name(name)
         super().__init__(name=name)
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)

@@ -7,14 +7,15 @@ param pytree — the graph owns the Variables, matching the reference design.
 
 from __future__ import annotations
 
-import itertools
-
-_layer_counters = {}
+from ..graph.node import _naming_stack
 
 
 def fresh_name(prefix):
-    c = _layer_counters.get(prefix, 0)
-    _layer_counters[prefix] = c + 1
+    # counters live in the innermost `name_scope` (graph/node.py), so a
+    # model instance's default layer names don't depend on process history
+    counters = _naming_stack()[-1]["layers"]
+    c = counters.get(prefix, 0)
+    counters[prefix] = c + 1
     return f"{prefix}{c}" if c else prefix
 
 

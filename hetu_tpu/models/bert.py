@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..graph.node import Op, VariableOp
+from ..graph.node import Op, VariableOp, scoped_init
 from .. import initializers as init
 from ..layers import (Linear, LayerNorm, Embedding, TransformerLayer,
                       fresh_name)
@@ -86,6 +86,7 @@ class BertEmbeddings:
 
 
 class BertModel:
+    @scoped_init
     def __init__(self, config, name="bert"):
         c = config
         self.config = c
@@ -123,6 +124,7 @@ class FirstTokenOp(Op):
 class BertForPreTraining:
     """MLM + NSP heads (reference examples/nlp/bert/hetu_bert.py)."""
 
+    @scoped_init
     def __init__(self, config, name="bert"):
         c = config
         self.config = c

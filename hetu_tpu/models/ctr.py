@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..graph.node import VariableOp, Op
+from ..graph.node import VariableOp, Op, scoped_init
 from .. import initializers as init
 from ..layers import Linear, Embedding, Sequence, fresh_name
 from ..ops import (array_reshape_op, concat_op, relu_op, sigmoid_op,
@@ -37,6 +37,7 @@ class SparseFeatureEmbedding:
 class WDL:
     """Wide & Deep (reference wdl_criteo: 13 dense + 26 sparse slots)."""
 
+    @scoped_init
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, hidden=(256, 256, 256), name="wdl",
                  ps_embedding=None):
@@ -86,6 +87,7 @@ class FMSecondOrderOp(Op):
 class DeepFM:
     """DeepFM (reference dfm_criteo)."""
 
+    @scoped_init
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, hidden=(256, 256), name="dfm",
                  ps_embedding=None):
@@ -132,6 +134,7 @@ class CrossLayerOp(Op):
 class DCN:
     """Deep & Cross Network."""
 
+    @scoped_init
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, num_cross=3, hidden=(256, 256), name="dcn",
                  ps_embedding=None):
@@ -182,6 +185,7 @@ class DLRMInteractionOp(Op):
 
 
 class DLRM:
+    @scoped_init
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, bottom=(512, 256), top=(512, 256),
                  name="dlrm", ps_embedding=None):

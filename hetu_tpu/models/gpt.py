@@ -12,7 +12,7 @@ from contextlib import nullcontext
 
 import numpy as np
 
-from ..graph.node import Op, VariableOp, stage
+from ..graph.node import Op, VariableOp, stage, scoped_init
 from .. import initializers as init
 from ..layers import Embedding, LayerNorm, TransformerLayer
 from ..ops import (array_reshape_op, matmul_op, reduce_mean_op,
@@ -50,6 +50,7 @@ class GPTModel:
     the graph pipeline executor (parallel/graph_pipeline.py; reference
     raw_ctx staging, context.py:1430)."""
 
+    @scoped_init
     def __init__(self, config, name="gpt", pipeline_stages=None):
         c = config
         self.config = c
@@ -98,6 +99,7 @@ class GPTModel:
 
 
 class GPTLMHeadModel:
+    @scoped_init
     def __init__(self, config, name="gpt", pipeline_stages=None):
         self.transformer = GPTModel(config, name=name,
                                     pipeline_stages=pipeline_stages)

@@ -2,11 +2,14 @@
 
 from __future__ import annotations
 
+from ..graph.node import scoped_init
+
 from ..layers import Linear, Conv2d, MaxPool2d, Sequence, Relu, Reshape
 from ..ops import relu_op, array_reshape_op, flatten_op
 
 
 class MLP:
+    @scoped_init
     def __init__(self, dims=(784, 256, 256, 10), name="mlp"):
         self.linears = [Linear(dims[i], dims[i + 1], name=f"{name}_fc{i}")
                         for i in range(len(dims) - 1)]
@@ -20,6 +23,7 @@ class MLP:
 
 
 class LeNet:
+    @scoped_init
     def __init__(self, num_classes=10, name="lenet"):
         self.conv1 = Conv2d(1, 6, 5, padding=2, name=f"{name}_c1")
         self.pool = MaxPool2d(2)

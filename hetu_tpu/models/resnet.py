@@ -4,6 +4,8 @@ ResNet-18/34 with BasicBlock; the v0 end-to-end gate model per SURVEY §7.3).
 
 from __future__ import annotations
 
+from ..graph.node import scoped_init
+
 from ..layers import (Conv2d, BatchNorm, Linear, Sequence, Identity)
 from ..ops import (relu_op, global_avg_pool2d_op, array_reshape_op,
                    avg_pool2d_op)
@@ -36,6 +38,7 @@ class BasicBlock:
 
 
 class ResNet:
+    @scoped_init
     def __init__(self, num_blocks=(2, 2, 2, 2), num_classes=10,
                  name="resnet"):
         self.in_planes = 64

@@ -17,10 +17,14 @@ softmax, so HBM traffic stays O(S·d):
             sums so O = dropout(softmax(S))·V exactly.
 
 Supported: additive key mask [B, 1, 1, S] (BERT padding masks), causal,
-8-aligned head dims in [32, 512] (64/128/256 tile the MXU exactly; others
-like GPT-2.7B's d=80 pad lanes but still beat the O(S^2) path), seq a
-multiple of the 256 block.  Returns None for unsupported shapes so callers
-fall back to the jnp composition (ops/attention.py).
+any head dim ≤ 512 and any seq ≥ 128: the wrapper zero-pads d to the
+8-aligned [32, 512] kernel envelope and pads seq up to a block multiple
+with -inf key-column masking, then slices the output (padding/slicing sit
+OUTSIDE the custom_vjp, so jnp.pad's own VJP zeroes the padded rows'
+cotangents and the gradients stay exact).  Returns None only for truly
+unsupported cases (d > 512, short seqs where the O(S^2) composition is
+cheaper, non-[B,1,1,S] masks) so callers fall back to the jnp composition
+(ops/attention.py).
 """
 
 from __future__ import annotations
@@ -33,8 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_BLOCK_Q = 256
-_BLOCK_K = 256
+_BLOCK_Q = 512
+_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
@@ -49,15 +53,31 @@ def _supported(q, k, v, mask):
         return False
     b, h, s, d = q.shape
     # head dim is always the FULL last block dim, so Mosaic only needs it
-    # 8-aligned; 64/128/256 tile the MXU perfectly, others (80, 96, ...)
-    # pad lanes but still beat the O(S^2) jnp path at long seq
-    if d % 8 or d < 32 or d > 512:
+    # 8-aligned (the wrapper pads to that); > 512 would blow VMEM tiles
+    if d > 512:
         return False
-    if s % _BLOCK_Q or s % _BLOCK_K:
+    # below one lane-tile of rows the O(S^2) composition is cheaper than
+    # padding up to a kernel block
+    if s < 128:
         return False
     if mask is not None and tuple(mask.shape) != (b, 1, 1, s):
         return False
     return True
+
+
+def _pad_plan(s):
+    """(padded_seq, block): pad seq to a block multiple and pick the block.
+
+    512 tiles measured fastest on v5e at both BERT (B64·H12·S512·d64:
+    9.9 ms vs 13.9 ms fwd+bwd with 256 tiles — beating XLA's S^2
+    composition at 13.7 ms) and GPT-2.7B shapes (causal S2048·d80:
+    64 ms vs 87 ms); smaller blocks only when the padded seq doesn't
+    divide, keeping padding waste < one 128-row tile."""
+    s_pad = s if s % 128 == 0 else -(-s // 128) * 128
+    for block in (512, 256, 128):
+        if s_pad % block == 0:
+            return s_pad, block
+    raise AssertionError(s_pad)
 
 
 def _keep_threshold(keep_prob):
@@ -372,40 +392,44 @@ def _bwd_impl(q, k, v, mask, o, lse, dout, causal, scale, keep_prob, seed,
 # two variants (with/without mask) keep the signatures positional; the
 # dropout seed is a traced uint32 tensor with zero cotangent.
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_nomask(q, k, v, seed, causal, scale, keep_prob):
-    return _fwd(q, k, v, None, causal, scale, keep_prob, seed)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_nomask(q, k, v, seed, causal, scale, keep_prob, block):
+    return _fwd(q, k, v, None, causal, scale, keep_prob, seed,
+                block_q=block, block_k=block)[0]
 
 
-def _flash_nomask_fwd(q, k, v, seed, causal, scale, keep_prob):
-    o, lse = _fwd(q, k, v, None, causal, scale, keep_prob, seed)
+def _flash_nomask_fwd(q, k, v, seed, causal, scale, keep_prob, block):
+    o, lse = _fwd(q, k, v, None, causal, scale, keep_prob, seed,
+                  block_q=block, block_k=block)
     return o, (q, k, v, seed, o, lse)
 
 
-def _flash_nomask_bwd(causal, scale, keep_prob, res, g):
+def _flash_nomask_bwd(causal, scale, keep_prob, block, res, g):
     q, k, v, seed, o, lse = res
     dq, dk, dv = _bwd_impl(q, k, v, None, o, lse, g, causal, scale,
-                           keep_prob, seed)
+                           keep_prob, seed, block_q=block, block_k=block)
     return dq, dk, dv, jnp.zeros_like(seed)
 
 
 _flash_nomask.defvjp(_flash_nomask_fwd, _flash_nomask_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash_mask(q, k, v, mask, seed, causal, scale, keep_prob):
-    return _fwd(q, k, v, mask, causal, scale, keep_prob, seed)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_mask(q, k, v, mask, seed, causal, scale, keep_prob, block):
+    return _fwd(q, k, v, mask, causal, scale, keep_prob, seed,
+                block_q=block, block_k=block)[0]
 
 
-def _flash_mask_fwd(q, k, v, mask, seed, causal, scale, keep_prob):
-    o, lse = _fwd(q, k, v, mask, causal, scale, keep_prob, seed)
+def _flash_mask_fwd(q, k, v, mask, seed, causal, scale, keep_prob, block):
+    o, lse = _fwd(q, k, v, mask, causal, scale, keep_prob, seed,
+                  block_q=block, block_k=block)
     return o, (q, k, v, mask, seed, o, lse)
 
 
-def _flash_mask_bwd(causal, scale, keep_prob, res, g):
+def _flash_mask_bwd(causal, scale, keep_prob, block, res, g):
     q, k, v, mask, seed, o, lse = res
     dq, dk, dv = _bwd_impl(q, k, v, mask, o, lse, g, causal, scale,
-                           keep_prob, seed)
+                           keep_prob, seed, block_q=block, block_k=block)
     # The additive mask is treated as NON-differentiable data (our graphs
     # build it from placeholder attention masks).  A learned attention bias
     # must use the jnp fallback path, which differentiates the bias.
@@ -431,12 +455,34 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None,
         raise ValueError(
             "flash_attention: dropout_keep < 1 requires seed= (an int32 "
             "scalar array; the per-tile dropout masks derive from it)")
+    b, h, s, d = q.shape
     if scale is None:
-        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+        scale = 1.0 / float(np.sqrt(d))
     if dropout_keep >= 1.0:
         seed = jnp.zeros((1,), jnp.int32)
+
+    # pad into the kernel envelope; padding/slicing live OUTSIDE the
+    # custom_vjp so jnp.pad's VJP zero-fills the padded rows' cotangents
+    # and the gradients of the real region stay exact
+    d_pad = max(32, -(-d // 8) * 8)
+    s_pad, block = _pad_plan(s)
+    if d_pad != d or s_pad != s:
+        pad3 = ((0, 0), (0, 0), (0, s_pad - s), (0, d_pad - d))
+        q, k, v = (jnp.pad(t, pad3) for t in (q, k, v))
+        if s_pad != s and not (causal and mask is None):
+            # padded key columns must not attend; real causal rows never
+            # see columns ≥ s, so pure-causal needs no mask
+            base = (mask if mask is not None
+                    else jnp.zeros((b, 1, 1, s), jnp.float32))
+            mask = jnp.pad(base, ((0, 0), (0, 0), (0, 0), (0, s_pad - s)),
+                           constant_values=_NEG_INF)
+
     if mask is None:
-        return _flash_nomask(q, k, v, seed, causal, float(scale),
-                             float(dropout_keep))
-    return _flash_mask(q, k, v, mask, seed, causal, float(scale),
-                       float(dropout_keep))
+        out = _flash_nomask(q, k, v, seed, causal, float(scale),
+                            float(dropout_keep), block)
+    else:
+        out = _flash_mask(q, k, v, mask, seed, causal, float(scale),
+                          float(dropout_keep), block)
+    if d_pad != d or s_pad != s:
+        out = out[:, :, :s, :d]
+    return out

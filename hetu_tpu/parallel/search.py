@@ -236,7 +236,9 @@ class HeterogeneousStrategy(Strategy):
         first_dp = None
         for node, choice in self.assignment.items():
             dp_axes, tp_axes = self._split(choice)
-            if first_dp is None:
+            if not first_dp:
+                # first NON-empty dp axes: a leading tp-only entry (dp=1,
+                # empty axes) must not lock batch placeholders to replicated
                 first_dp = dp_axes
             splits = {}
             if dp_axes:

@@ -173,15 +173,19 @@ class ShardedTable:
             self.shards = list(tables)
             self.nshards = len(self.shards)
             self.rows, self.dim = int(rows), int(dim)
-            per = (self.rows + self.nshards - 1) // self.nshards
             for s, t in enumerate(self.shards):
                 if t.dim != self.dim:
                     raise ValueError(f"shard {s} dim {t.dim} != {self.dim}")
-                if t.rows < per:
+                # under key%nshards routing, shard s holds local rows for
+                # keys s, s+n, s+2n, ... — exactly-sized tail shards hold
+                # one row fewer than the leading ones
+                need = ((self.rows - 1 - s) // self.nshards + 1
+                        if s < self.rows else 0)
+                if t.rows < need:
                     # undersized shards would make the native store treat
                     # tail keys as pads: pushes silently dropped
                     raise ValueError(
-                        f"shard {s} has {t.rows} rows < {per} needed for "
+                        f"shard {s} has {t.rows} rows < {need} needed for "
                         f"{self.rows} rows over {self.nshards} shards")
             return
         self.nshards = nshards

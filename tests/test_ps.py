@@ -370,3 +370,15 @@ def test_ps_embedding_grads_deduped(rng):
     # d loss/d row = 1 per occurrence → summed grad = B; sgd lr=1 → w = -B
     np.testing.assert_allclose(emb.table.lookup([0])[0], -float(B),
                                rtol=1e-6)
+
+
+def test_sharded_table_accepts_exact_tail_shards():
+    # key % nshards routing: shard s needs floor((rows-1-s)/n)+1 rows, so
+    # exactly-partitioned tail shards hold one row fewer than leading ones
+    from hetu_tpu.ps.store import EmbeddingTable, ShardedTable
+    shards = [EmbeddingTable(4, 4), EmbeddingTable(3, 4), EmbeddingTable(3, 4)]
+    st = ShardedTable(10, 4, tables=shards)
+    rows = st.lookup(np.arange(10))
+    assert rows.shape == (10, 4)
+    with pytest.raises(ValueError, match="rows <"):
+        ShardedTable(10, 4, tables=[EmbeddingTable(3, 4)] * 3)
