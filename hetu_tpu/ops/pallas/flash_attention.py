@@ -108,19 +108,32 @@ def _drop_tile(p, seed_ref, tile, keep_prob):
 
 # -- forward ---------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref, *,
-                scale, causal, block_k, seq_len, keep_prob):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, offs_ref,
+                o_ref, lse_ref, *, scale, causal, block_k, q_len, k_len,
+                keep_prob, empty_lse_neg=False):
+    """offs_ref (optional SMEM int32[2] = [q_off, k_off]): GLOBAL sequence
+    offsets of the local q/k blocks — the ring-attention path attends a
+    rotating remote K/V block, so causal masking compares global positions.
+    ``empty_lse_neg``: blockwise callers need lse=-inf semantics for rows
+    with no live key in THIS block (so the cross-block logaddexp combine
+    ignores them); self-attention callers need +inf (see comment below)."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
     d = q_ref.shape[2]
     q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
-    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    q_off = offs_ref[0] if offs_ref is not None else 0
+    k_off = offs_ref[1] if offs_ref is not None else 0
+    row = (q_off + qi * bq
+           + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
 
-    nk = seq_len // block_k
+    nk = k_len // block_k
     if causal:
-        # kv blocks strictly above the diagonal contribute nothing
-        nk = jax.lax.min(nk, ((qi + 1) * bq + block_k - 1) // block_k)
+        # kv blocks strictly above the diagonal contribute nothing; with
+        # offsets the bound is dynamic (clamped below), without it's static
+        hi = (q_off + (qi + 1) * bq - 1 - k_off) // block_k + 1
+        nk = jax.lax.clamp(0, hi, nk) if offs_ref is not None \
+            else jax.lax.min(nk, hi)
 
     def body(j, carry):
         m, l, acc = carry
@@ -131,7 +144,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref, *,
         if mask_ref is not None:
             s = s + mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
-            col = (j * block_k
+            col = (k_off + j * block_k
                    + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
             s = jnp.where(row >= col, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
@@ -140,7 +153,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref, *,
         # l accumulates UN-dropped sums: O = dropout(P_normalized) @ V
         l_new = l * alpha + jnp.sum(p, axis=1)
         if keep_prob < 1.0:
-            nq, nk_tot = seq_len // bq, seq_len // block_k
+            nq, nk_tot = q_len // bq, k_len // block_k
             p = _drop_tile(p, seed_ref,
                            _tile_index(bh, qi, j, nq, nk_tot), keep_prob)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
@@ -157,69 +170,82 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref, *,
     # fully-masked rows (l == 0, every key at -inf): output is 0; store
     # lse = +large so the backward's p = exp(s - lse) underflows to 0 —
     # storing m (≈ -1e30) instead would give p = exp(0) = 1 everywhere
-    # and garbage dq/dk/dv for the row
-    lse = jnp.where(l == 0.0, -_NEG_INF, m + jnp.log(l_safe))
+    # and garbage dq/dk/dv for the row.  Blockwise (ring) callers instead
+    # want -large: their backward uses the COMBINED lse (never empty for a
+    # causal row), and the fwd combine must treat this block as weightless.
+    empty = _NEG_INF if empty_lse_neg else -_NEG_INF
+    lse = jnp.where(l == 0.0, empty, m + jnp.log(l_safe))
     lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
-def _make_kern(base, has_mask, has_seed, n_out, **consts):
-    """Adapts a kernel with optional (mask_ref, seed_ref) slots to the
-    positional ref list pallas_call passes."""
+def _make_kern(base, has_mask, has_seed, n_out, has_offs=False, **consts):
+    """Adapts a kernel with optional (mask_ref, seed_ref, offs_ref) slots
+    to the positional ref list pallas_call passes."""
 
     def kern(*refs):
         n_in = len(refs) - n_out
         ins = list(refs[:n_in])
         outs = list(refs[n_in:])
+        offs_ref = ins.pop() if has_offs else None
         seed_ref = ins.pop() if has_seed else None
         mask_ref = ins.pop() if has_mask else None
-        base(*ins, mask_ref, seed_ref, *outs, **consts)
+        base(*ins, mask_ref, seed_ref, offs_ref, *outs, **consts)
 
     return kern
 
 
 def _fwd(q, k, v, mask, causal, scale, keep_prob=1.0, seed=None,
-         block_q=_BLOCK_Q, block_k=_BLOCK_K):
-    b, h, s, d = q.shape
-    qf = q.reshape(b * h, s, d)
-    kf = k.reshape(b * h, s, d)
-    vf = v.reshape(b * h, s, d)
+         block_q=_BLOCK_Q, block_k=_BLOCK_K, offsets=None,
+         empty_lse_neg=False):
+    """q: [b,h,sq,d]; k,v: [b,h,sk,d] (sq != sk in the blockwise/ring path,
+    where ``offsets`` = int32[2] global [q_off, k_off])."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
-        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
     ]
     args = [qf, kf, vf]
     if mask is not None:
         in_specs.append(pl.BlockSpec(
-            (1, 1, s), lambda bh, i, h=h: (bh // h, 0, 0)))
-        args.append(mask.reshape(b, 1, s).astype(jnp.float32))
+            (1, 1, sk), lambda bh, i, h=h: (bh // h, 0, 0)))
+        args.append(mask.reshape(b, 1, sk).astype(jnp.float32))
     if keep_prob < 1.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed.reshape(1).astype(jnp.int32))
+    if offsets is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(offsets)
     kern = _make_kern(_fwd_kernel, mask is not None, keep_prob < 1.0, 2,
+                      has_offs=offsets is not None,
                       scale=scale, causal=causal, block_k=block_k,
-                      seq_len=s, keep_prob=keep_prob)
+                      q_len=sq, k_len=sk, keep_prob=keep_prob,
+                      empty_lse_neg=empty_lse_neg)
     o, lse = pl.pallas_call(
         kern,
         interpret=_interpret(),
-        grid=(b * h, s // block_q),
+        grid=(b * h, sq // block_q),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ])(*args)
-    return o.reshape(b, h, s, d), lse
+    return o.reshape(b, h, sq, d), lse
 
 
 # -- backward --------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
-                   seed_ref, dq_ref, *, scale, causal, block_k, seq_len,
-                   keep_prob):
+                   seed_ref, offs_ref, dq_ref, *, scale, causal, block_k,
+                   q_len, k_len, keep_prob):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -228,7 +254,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0]
     dsum = dsum_ref[0, 0]
-    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    q_off = offs_ref[0] if offs_ref is not None else 0
+    k_off = offs_ref[1] if offs_ref is not None else 0
+    row = (q_off + qi * bq
+           + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
 
     def body(j, acc):
         kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -238,14 +267,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
         if mask_ref is not None:
             s = s + mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
-            col = (j * block_k
+            col = (k_off + j * block_k
                    + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
             s = jnp.where(row >= col, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if keep_prob < 1.0:  # replay the fwd tile mask on dP
-            nq, nk_tot = seq_len // bq, seq_len // block_k
+            nq, nk_tot = q_len // bq, k_len // block_k
             dp = _drop_tile(dp, seed_ref,
                             _tile_index(bh, qi, j, nq, nk_tot), keep_prob)
         ds = p * (dp - dsum[:, None])
@@ -253,24 +282,29 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
                                          preferred_element_type=jnp.float32)
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    nk = seq_len // block_k
+    nk = k_len // block_k
     if causal:
         # above-diagonal kv tiles are fully masked (p == 0): skip them
-        nk = jax.lax.min(nk, ((qi + 1) * bq + block_k - 1) // block_k)
+        hi = (q_off + (qi + 1) * bq - 1 - k_off) // block_k + 1
+        nk = jax.lax.clamp(0, hi, nk) if offs_ref is not None \
+            else jax.lax.min(nk, hi)
     acc = jax.lax.fori_loop(0, nk, body, acc0)
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
-                    seed_ref, dk_ref, dv_ref, *, scale, causal, block_q,
-                    seq_len, keep_prob):
+                    seed_ref, offs_ref, dk_ref, dv_ref, *, scale, causal,
+                    block_q, q_len, k_len, keep_prob):
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     bk = k_ref.shape[1]
     d = k_ref.shape[2]
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
-    col = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    q_off = offs_ref[0] if offs_ref is not None else 0
+    k_off = offs_ref[1] if offs_ref is not None else 0
+    col = (k_off + ki * bk
+           + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1))
     mblk = (mask_ref[0, 0, pl.ds(ki * bk, bk)][None, :]
             if mask_ref is not None else None)
 
@@ -285,13 +319,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
         if mblk is not None:
             s = s + mblk
         if causal:
-            rr = (i * block_q
+            rr = (q_off + i * block_q
                   + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
             s = jnp.where(rr >= col, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         if keep_prob < 1.0:
             # fwd seeded by tile (bh, q-block=i, kv-block=ki)
-            nq, nk_tot = seq_len // block_q, seq_len // bk
+            nq, nk_tot = q_len // block_q, k_len // bk
             keep = _tile_keep(p.shape, seed_ref,
                               _tile_index(bh, i, ki, nq, nk_tot),
                               keep_prob)
@@ -316,38 +350,46 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
     dv0 = jnp.zeros((bk, d), jnp.float32)
     i_start = 0
     if causal:
-        # q tiles strictly above the diagonal see none of this kv block
-        i_start = (ki * bk) // block_q
-    dk, dv = jax.lax.fori_loop(i_start, seq_len // block_q, body,
+        # q tiles strictly above the diagonal see none of this kv block;
+        # with offsets the bound is dynamic (global positions)
+        lo = (k_off + ki * bk - q_off) // block_q
+        i_start = jax.lax.clamp(0, lo, q_len // block_q) \
+            if offs_ref is not None else lo
+    dk, dv = jax.lax.fori_loop(i_start, q_len // block_q, body,
                                (dk0, dv0))
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _bwd_impl(q, k, v, mask, o, lse, dout, causal, scale, keep_prob, seed,
-              block_q=_BLOCK_Q, block_k=_BLOCK_K):
-    b, h, s, d = q.shape
-    qf, kf, vf = (t.reshape(b * h, s, d) for t in (q, k, v))
-    dof = dout.reshape(b * h, s, d)
+              block_q=_BLOCK_Q, block_k=_BLOCK_K, offsets=None):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    qf = q.reshape(b * h, sq, d)
+    kf, vf = (t.reshape(b * h, sk, d) for t in (k, v))
+    dof = dout.reshape(b * h, sq, d)
     dsum = jnp.sum(dof.astype(jnp.float32)
-                   * o.reshape(b * h, s, d).astype(jnp.float32),
-                   axis=-1)[:, None, :]                      # (BH, 1, S)
+                   * o.reshape(b * h, sq, d).astype(jnp.float32),
+                   axis=-1)[:, None, :]                      # (BH, 1, Sq)
     args = [qf, kf, vf, dof, lse, dsum]
     base_specs = [
-        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),   # q (full)
-        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),   # k
-        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),   # v
-        pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),   # do
-        pl.BlockSpec((1, 1, s), lambda bh, i: (bh, 0, 0)),   # lse
-        pl.BlockSpec((1, 1, s), lambda bh, i: (bh, 0, 0)),   # dsum
+        pl.BlockSpec((1, sq, d), lambda bh, i: (bh, 0, 0)),  # q (full)
+        pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),  # k
+        pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),  # v
+        pl.BlockSpec((1, sq, d), lambda bh, i: (bh, 0, 0)),  # do
+        pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),  # lse
+        pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),  # dsum
     ]
     extra_args, extra_specs = [], []
     if mask is not None:
-        extra_args.append(mask.reshape(b, 1, s).astype(jnp.float32))
+        extra_args.append(mask.reshape(b, 1, sk).astype(jnp.float32))
         extra_specs.append(pl.BlockSpec(
-            (1, 1, s), lambda bh, i, h=h: (bh // h, 0, 0)))
+            (1, 1, sk), lambda bh, i, h=h: (bh // h, 0, 0)))
     if keep_prob < 1.0:
         extra_args.append(seed.reshape(1).astype(jnp.int32))
+        extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if offsets is not None:
+        extra_args.append(offsets)
         extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     dq_specs = list(base_specs)
@@ -357,35 +399,38 @@ def _bwd_impl(q, k, v, mask, o, lse, dout, causal, scale, keep_prob, seed,
     dq_specs[5] = pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i))
 
     dq_kern = _make_kern(_bwd_dq_kernel, mask is not None, keep_prob < 1.0,
-                         1, scale=scale, causal=causal, block_k=block_k,
-                         seq_len=s, keep_prob=keep_prob)
+                         1, has_offs=offsets is not None,
+                         scale=scale, causal=causal, block_k=block_k,
+                         q_len=sq, k_len=sk, keep_prob=keep_prob)
     dq = pl.pallas_call(
-        dq_kern, interpret=_interpret(), grid=(b * h, s // block_q),
+        dq_kern, interpret=_interpret(), grid=(b * h, sq // block_q),
         in_specs=dq_specs + extra_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
     )(*args, *extra_args)
 
     dkv_specs = list(base_specs)
     dkv_specs[1] = pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0))
     dkv_specs[2] = pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0))
     dkv_kern = _make_kern(_bwd_dkv_kernel, mask is not None,
-                          keep_prob < 1.0, 2, scale=scale, causal=causal,
-                          block_q=block_q, seq_len=s, keep_prob=keep_prob)
+                          keep_prob < 1.0, 2, has_offs=offsets is not None,
+                          scale=scale, causal=causal,
+                          block_q=block_q, q_len=sq, k_len=sk,
+                          keep_prob=keep_prob)
     dk, dv = pl.pallas_call(
-        dkv_kern, interpret=_interpret(), grid=(b * h, s // block_k),
+        dkv_kern, interpret=_interpret(), grid=(b * h, sk // block_k),
         in_specs=dkv_specs + extra_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ])(*args, *extra_args)
 
-    shape = (b, h, s, d)
-    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 # -- custom-vjp wrappers ---------------------------------------------------
@@ -437,6 +482,61 @@ def _flash_mask_bwd(causal, scale, keep_prob, block, res, g):
 
 
 _flash_mask.defvjp(_flash_mask_fwd, _flash_mask_bwd)
+
+
+# -- blockwise API (ring / context parallelism) ----------------------------
+# One (Q-local, K/V-block) pair with GLOBAL sequence offsets: the ring
+# schedule (parallel/context_parallel.py) rotates K/V blocks around the
+# ICI ring and combines per-block results with logaddexp.  No reference
+# counterpart (SURVEY §5: the reference has no ring attention); the
+# blockwise math follows the flash-attention decomposition.
+
+def _block_sizes(sq, sk):
+    bq = next((b for b in (512, 256, 128) if sq % b == 0), None)
+    bk = next((b for b in (512, 256, 128) if sk % b == 0), None)
+    return bq, bk
+
+
+def blockwise_supported(q_shape, k_shape):
+    b, h, sq, d = q_shape
+    sk = k_shape[2]
+    bq, bk = _block_sizes(sq, sk)
+    return (d <= 512 and d % 8 == 0 and d >= 32
+            and bq is not None and bk is not None)
+
+
+def flash_attention_block(q, k, v, q_off, k_off, *, causal=True,
+                          scale=None):
+    """Fused attention of local q [B,H,Sq,D] against ONE K/V block
+    [B,H,Sk,D] at global offsets (q_off, k_off) — returns
+    (o_normalized [B,H,Sq,D], lse [B,H,Sq]) where rows with no live key in
+    this block get lse = -1e30 (weightless under the logaddexp combine)."""
+    b, h, sq, d = q.shape
+    bq, bk = _block_sizes(sq, k.shape[2])
+    offsets = jnp.stack([q_off, k_off]).astype(jnp.int32)
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    o, lse = _fwd(q, k, v, None, causal, float(scale), 1.0,
+                  jnp.zeros((1,), jnp.int32), block_q=bq, block_k=bk,
+                  offsets=offsets, empty_lse_neg=True)
+    return o, lse.reshape(b, h, sq)
+
+
+def flash_attention_block_bwd(q, k, v, o, lse, dout, q_off, k_off, *,
+                              causal=True, scale=None):
+    """Gradients of one ring step given the COMBINED (o, lse) of the full
+    ring forward: p = exp(s - lse_final) is each block's true global
+    attention weight, so dq sums over blocks and (dk, dv) are per-block
+    exact.  lse: [B,H,Sq]."""
+    b, h, sq, d = q.shape
+    bq, bk = _block_sizes(sq, k.shape[2])
+    offsets = jnp.stack([q_off, k_off]).astype(jnp.int32)
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    return _bwd_impl(q, k, v, None, o, lse.reshape(b * h, 1, sq), dout,
+                     causal, float(scale), 1.0,
+                     jnp.zeros((1,), jnp.int32), block_q=bq, block_k=bk,
+                     offsets=offsets)
 
 
 def flash_attention(q, k, v, mask=None, causal=False, scale=None,

@@ -93,10 +93,109 @@ def ring_attention_shard(q, k, v, axis_name, n_shards, causal=True,
     return (o / l[..., None]).astype(q.dtype)
 
 
+# -- flash ring attention --------------------------------------------------
+# Same ring schedule, but each (Q-local, K-block) pair runs through the
+# Pallas flash kernels (ops/pallas/flash_attention.py blockwise API):
+# per-pair HBM traffic stays O(S·d) instead of the jnp path's O(S_local²)
+# score tensors, which is what makes long local sequences feasible.  The
+# backward is a second ring pass: dq accumulates locally from the combined
+# lse, while (dk, dv) accumulators travel WITH their K/V block around the
+# ring and arrive home after n steps holding every shard's contribution.
+
+
+def _ring_rotate(xs, axis_name, n_shards):
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    return [lax.ppermute(x, axis_name, perm) for x in xs]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, n_shards, causal, scale):
+    o, _ = _ring_flash_fwd_impl(q, k, v, axis_name, n_shards, causal,
+                                scale)
+    return o
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, n_shards, causal, scale):
+    from ..ops.pallas.flash_attention import flash_attention_block
+    sq = q.shape[-2]
+    my = lax.axis_index(axis_name)
+    q_off = my * sq
+    o0 = varying(jnp.zeros(q.shape, jnp.float32), (axis_name,))
+    lse0 = varying(jnp.full(q.shape[:-1], -1e30, jnp.float32),
+                   (axis_name,))
+
+    def step(carry, r):
+        k_blk, v_blk, o, lse = carry
+        src = jnp.mod(my - r, n_shards)
+        o_blk, lse_blk = flash_attention_block(
+            q, k_blk, v_blk, q_off, src * sq, causal=causal, scale=scale)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + o_blk.astype(jnp.float32)
+             * jnp.exp(lse_blk - lse_new)[..., None])
+        k_blk, v_blk = _ring_rotate([k_blk, v_blk], axis_name, n_shards)
+        return (k_blk, v_blk, o, lse_new), None
+
+    (_, _, o, lse), _ = lax.scan(step, (k, v, o0, lse0),
+                                 jnp.arange(n_shards))
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_fwd(q, k, v, axis_name, n_shards, causal, scale):
+    o, lse = _ring_flash_fwd_impl(q, k, v, axis_name, n_shards, causal,
+                                  scale)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis_name, n_shards, causal, scale, res, g):
+    from ..ops.pallas.flash_attention import flash_attention_block_bwd
+    q, k, v, o, lse = res
+    sq = q.shape[-2]
+    my = lax.axis_index(axis_name)
+    q_off = my * sq
+    dq0 = varying(jnp.zeros(q.shape, jnp.float32), (axis_name,))
+    dk0 = varying(jnp.zeros(k.shape, jnp.float32), (axis_name,))
+    dv0 = varying(jnp.zeros(v.shape, jnp.float32), (axis_name,))
+
+    def step(carry, r):
+        k_blk, v_blk, dk_blk, dv_blk, dq = carry
+        src = jnp.mod(my - r, n_shards)
+        dq_c, dk_c, dv_c = flash_attention_block_bwd(
+            q, k_blk, v_blk, o, lse, g, q_off, src * sq,
+            causal=causal, scale=scale)
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_blk = dk_blk + dk_c.astype(jnp.float32)
+        dv_blk = dv_blk + dv_c.astype(jnp.float32)
+        k_blk, v_blk, dk_blk, dv_blk = _ring_rotate(
+            [k_blk, v_blk, dk_blk, dv_blk], axis_name, n_shards)
+        return (k_blk, v_blk, dk_blk, dv_blk, dq), None
+
+    (_, _, dk, dv, dq), _ = lax.scan(step, (k, v, dk0, dv0, dq0),
+                                     jnp.arange(n_shards))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(mesh, q, k, v, *, axis="cp", causal=True, scale=None):
-    """Host-level: q,k,v [B, H, S, D] with S sharded over `axis`."""
+    """Host-level: q,k,v [B, H, S, D] with S sharded over `axis`.
+
+    Uses the Pallas blockwise flash kernels when the per-shard shapes fit
+    the kernel envelope (128-multiple local seq, 8-aligned d ≤ 512);
+    otherwise the jnp online-softmax path."""
+    from ..ops.pallas.flash_attention import blockwise_supported
     n = mesh.shape[axis]
     spec = P(None, None, axis, None)
+    local_q = (q.shape[0], q.shape[1], q.shape[2] // n, q.shape[3])
+    if blockwise_supported(local_q, local_q):
+        # custom_vjp functions take positional args only; check_vma off
+        # because pallas_call out_shapes don't carry vma annotations
+        f = shard_map(
+            lambda q, k, v: _ring_flash(q, k, v, axis, n, causal, scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return f(q, k, v)
     f = shard_map(
         functools.partial(ring_attention_shard, axis_name=axis, n_shards=n,
                           causal=causal, scale=scale),
