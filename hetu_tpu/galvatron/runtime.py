@@ -144,6 +144,37 @@ class TransformerHPLayer:
         var = jnp.var(x, -1, keepdims=True)
         return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g
 
+    def _attend(self, q, k, v, sh: LayerShardings):
+        """[b, nh, t, hd] heads tp-sharded, batch dp-sharded.
+
+        Long sequences route through the Pallas flash kernel inside a
+        shard_map over the layer mesh (pallas_call is not GSPMD-
+        partitionable, but attention is local per head, so a head/batch-
+        sharded shard_map is exact); short sequences keep the jnp path."""
+        b, nh, t, hd = q.shape
+        mesh = sh.mesh
+        tp = int(np.prod([mesh.shape[a] for a in sh.tp_axes] or [1]))
+        dp = int(np.prod([mesh.shape[a] for a in sh.dp_axes] or [1]))
+        if (t >= 128 and hd <= 512 and nh % tp == 0 and b % dp == 0):
+            from ..ops.pallas.flash_attention import flash_attention
+            from jax import shard_map
+            spec = P(sh._axes(sh.dp_axes) if sh.dp_axes else None,
+                     sh._axes(sh.tp_axes) if sh.tp_axes else None,
+                     None, None)
+
+            def body(q, k, v):
+                o = flash_attention(q, k, v, causal=True)
+                assert o is not None  # guaranteed by the shape pre-check
+                return o
+
+            return shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec, check_vma=False)(q, k, v)
+        a = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        a = jnp.where(mask, a, -1e9)
+        a = jax.nn.softmax(a, axis=-1)
+        return (a @ v).astype(v.dtype)
+
     def apply(self, params, x, sh: LayerShardings):
         b, t, h = x.shape
         nh = self.heads
@@ -153,11 +184,8 @@ class TransformerHPLayer:
         q = q.reshape(b, t, nh, h // nh).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, nh, h // nh).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, nh, h // nh).transpose(0, 2, 1, 3)
-        a = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(h // nh)
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        a = jnp.where(mask, a, -1e9)
-        a = jax.nn.softmax(a, axis=-1)
-        o = (a @ v).transpose(0, 2, 1, 3).reshape(b, t, h)
+        o = self._attend(q, k, v, sh)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, h).astype(x.dtype)
         x = x + sh.constrain(o @ params["wo"])         # row-parallel + psum
         y = self._ln(x, params["ln2"])
         y = jax.nn.gelu(y @ params["w1"])              # column-parallel

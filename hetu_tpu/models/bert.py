@@ -149,14 +149,76 @@ class BertForPreTraining:
 
     def loss(self, input_ids, token_type_ids, attention_mask, mlm_labels,
              nsp_labels):
-        """mlm_labels: [B*S] with -1 for unmasked; nsp_labels: [B]."""
-        logits, nsp_logits = self(input_ids, token_type_ids, attention_mask)
-        ce = softmax_cross_entropy_sparse_op(logits, mlm_labels,
+        """mlm_labels: [B*S] with -1 for unmasked; nsp_labels: [B].
+
+        The MLM head (transform + LN + tied vocab decoder) runs only on a
+        static BUCKET of masked positions (`config.mlm_bucket_frac`,
+        default 0.25 of the tokens — standard masking is 0.15): unmasked
+        positions contribute zero loss AND zero gradient through the head,
+        so gathering first is mathematically identical while cutting the
+        dominant [tokens, vocab] matmuls ~4x.  Set mlm_bucket_frac=None
+        for the dense full-position head.
+        """
+        c = self.config
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        flat = array_reshape_op(seq, output_shape=(-1, c.hidden_size))
+        frac = getattr(c, "mlm_bucket_frac", 0.25)
+        n_tokens = None
+        shape = getattr(mlm_labels, "shape", None)
+        if frac is not None and shape is not None and shape[0] is not None:
+            n_tokens = int(shape[0])
+        if n_tokens is not None:
+            bucket = min(n_tokens, -(-int(n_tokens * frac) // 128) * 128)
+            h_in = MaskedSelectOp(flat, mlm_labels, bucket=bucket)
+            labels_in = MaskedSelectLabelsOp(mlm_labels, bucket=bucket)
+        else:
+            h_in, labels_in = flat, mlm_labels
+        h = self.mlm_ln(gelu_op(self.mlm_transform(h_in)))
+        logits = matmul_op(h, self.bert.embeddings.word.weight, trans_B=True)
+        logits = logits + broadcastto_op(self.mlm_bias, logits)
+        ce = softmax_cross_entropy_sparse_op(logits, labels_in,
                                              ignored_index=-1)
-        mlm_loss = MaskedMeanOp(ce, mlm_labels)
+        mlm_loss = MaskedMeanOp(ce, labels_in)
         nsp_loss = reduce_mean_op(softmax_cross_entropy_sparse_op(
-            nsp_logits, nsp_labels))
+            self.nsp(pooled), nsp_labels))
         return mlm_loss + nsp_loss
+
+
+class MaskedSelectOp(Op):
+    """Rows of ``x`` at the first ``bucket`` positions where label >= 0
+    (fill rows repeat index 0; their loss weight is zeroed downstream, so
+    their gradients vanish too).  If more than ``bucket`` positions are
+    masked, the excess is dropped — size the bucket above the masking
+    rate."""
+
+    def __init__(self, x, labels, bucket, name=None):
+        super().__init__(x, labels, name=name)
+        self.bucket = int(bucket)
+
+    def _compute(self, input_vals, ctx):
+        import jax.numpy as jnp
+        x, labels = input_vals
+        (pos,) = jnp.nonzero(labels.reshape(-1) >= 0, size=self.bucket,
+                             fill_value=0)
+        return x[pos]
+
+
+class MaskedSelectLabelsOp(Op):
+    """Labels gathered like MaskedSelectOp's rows, with fill slots forced
+    to -1 (ignored) so downstream CE/normalization see only true masks."""
+
+    def __init__(self, labels, bucket, name=None):
+        super().__init__(labels, name=name)
+        self.bucket = int(bucket)
+
+    def _compute(self, input_vals, ctx):
+        import jax.numpy as jnp
+        (labels,) = input_vals
+        labels = labels.reshape(-1)
+        valid = labels >= 0
+        (pos,) = jnp.nonzero(valid, size=self.bucket, fill_value=0)
+        live = jnp.arange(self.bucket) < jnp.sum(valid)
+        return jnp.where(live, labels[pos], -1)
 
 
 class MaskedMeanOp(Op):

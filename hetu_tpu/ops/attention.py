@@ -55,6 +55,17 @@ class ScaledDotProductAttentionOp(Op):
         mask = input_vals[3] if self.has_mask else None
         d = q.shape[-1]
         scale = self.scale if self.scale is not None else 1.0 / (d ** 0.5)
+        # long-context: when the executor's mesh has a 'cp' axis, the
+        # sequence dim is context-sharded — lower to flash ring attention
+        # (K/V blocks rotate the ICI ring; parallel/context_parallel.py).
+        # Dropout/masks stay on the single-device paths.
+        if (ctx.mesh is not None and "cp" in ctx.mesh.shape
+                and ctx.mesh.shape["cp"] > 1 and mask is None
+                and self.dropout_keep >= 1.0 and q.ndim == 4
+                and q.shape == k.shape == v.shape):
+            from ..parallel.context_parallel import ring_attention
+            return ring_attention(ctx.mesh, q, k, v, causal=self.causal,
+                                  scale=scale)
         if _use_flash(q):
             from .pallas.flash_attention import flash_attention
             keep = self.dropout_keep if ctx.training else 1.0

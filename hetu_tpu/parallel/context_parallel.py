@@ -178,16 +178,23 @@ def _ring_flash_bwd(axis_name, n_shards, causal, scale, res, g):
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
-def ring_attention(mesh, q, k, v, *, axis="cp", causal=True, scale=None):
+def ring_attention(mesh, q, k, v, *, axis="cp", causal=True, scale=None,
+                   batch_axis="dp"):
     """Host-level: q,k,v [B, H, S, D] with S sharded over `axis`.
 
     Uses the Pallas blockwise flash kernels when the per-shard shapes fit
     the kernel envelope (128-multiple local seq, 8-aligned d ≤ 512);
-    otherwise the jnp online-softmax path."""
+    otherwise the jnp online-softmax path.  On a combined mesh the batch
+    dim stays sharded over ``batch_axis`` (if present) — attention is
+    batch-local, so dp shards pass straight through the shard_map."""
     from ..ops.pallas.flash_attention import blockwise_supported
     n = mesh.shape[axis]
-    spec = P(None, None, axis, None)
-    local_q = (q.shape[0], q.shape[1], q.shape[2] // n, q.shape[3])
+    b_ax = batch_axis if (batch_axis and batch_axis in mesh.shape
+                          and q.shape[0] % mesh.shape[batch_axis] == 0) \
+        else None
+    spec = P(b_ax, None, axis, None)
+    b_local = q.shape[0] // (mesh.shape[b_ax] if b_ax else 1)
+    local_q = (b_local, q.shape[1], q.shape[2] // n, q.shape[3])
     if blockwise_supported(local_q, local_q):
         # custom_vjp functions take positional args only; check_vma off
         # because pallas_call out_shapes don't carry vma annotations
@@ -221,17 +228,23 @@ def ulysses_attention_shard(q, k, v, axis_name, n_shards, causal=True,
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     d = q.shape[-1]
     scale_ = scale if scale is not None else 1.0 / (d ** 0.5)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale_
-    if causal:
-        S = s.shape[-1]
-        iq = jnp.arange(S)[:, None]
-        ik = jnp.arange(S)[None, :]
-        s = jnp.where(iq >= ik, s, -1e9)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
-                   preferred_element_type=jnp.float32).astype(v.dtype)
-    return heads_to_seq(o)
+    # after the a2a the attention is plain LOCAL self-attention over the
+    # full sequence (head subset) — route it through the flash kernel when
+    # the shape fits, the same win as single-device attention
+    from ..ops.pallas.flash_attention import flash_attention
+    o = flash_attention(q, k, v, causal=causal, scale=scale_)
+    if o is None:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale_
+        if causal:
+            S = s.shape[-1]
+            iq = jnp.arange(S)[:, None]
+            ik = jnp.arange(S)[None, :]
+            s = jnp.where(iq >= ik, s, -1e9)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                       preferred_element_type=jnp.float32).astype(v.dtype)
+    return heads_to_seq(o.astype(v.dtype))
 
 
 def ulysses_attention(mesh, q, k, v, *, axis="cp", causal=True, scale=None):
@@ -241,5 +254,6 @@ def ulysses_attention(mesh, q, k, v, *, axis="cp", causal=True, scale=None):
     f = shard_map(
         functools.partial(ulysses_attention_shard, axis_name=axis,
                           n_shards=n, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)  # pallas out_shapes carry no vma annotations
     return f(q, k, v)

@@ -252,3 +252,49 @@ class TestRuntime:
         # layer 1: tp=1 + fsdp → w sharded over dp axes on a dim
         sh1 = params[1]["wqkv"].sharding.spec
         assert any(s is not None for s in sh1)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_runtime_flash_attention_branch_matches_oracle():
+    # t=128 reaches the shard_map+Pallas branch in TransformerHPLayer
+    # (heads tp-sharded, batch dp-sharded); oracle is plain numpy math
+    from hetu_tpu.galvatron.runtime import (HybridParallelModel,
+                                            TransformerHPLayer)
+    from hetu_tpu.galvatron.config import HybridParallelConfig
+
+    spec = TransformerHPLayer(hidden=32, heads=4)
+    cfg = HybridParallelConfig(pp_deg=1, tp_sizes=[2], dp_types=[0],
+                               chunks=1, world=8)
+    model = HybridParallelModel([spec], cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 128, 32))
+    out = np.asarray(jax.jit(model.apply)(params, x))
+
+    p = jax.tree_util.tree_map(np.asarray, params[0])
+    xh = np.asarray(x).astype(np.float64)
+
+    def ln(z, g):
+        mu = z.mean(-1, keepdims=True)
+        var = z.var(-1, keepdims=True)
+        return (z - mu) / np.sqrt(var + 1e-5) * g
+
+    b, t, h = xh.shape
+    nh = 4
+    y = ln(xh, p["ln1"])
+    qkv = y @ p["wqkv"].astype(np.float64)
+    q, k, v = np.split(qkv, 3, axis=-1)
+    rs = lambda z: z.reshape(b, t, nh, h // nh).transpose(0, 2, 1, 3)
+    q, k, v = rs(q), rs(k), rs(v)
+    a = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(h / nh)
+    mask = np.tril(np.ones((t, t), bool))
+    a = np.where(mask, a, -np.inf)
+    a = np.exp(a - a.max(-1, keepdims=True))
+    a = a / a.sum(-1, keepdims=True)
+    o = (a @ v).transpose(0, 2, 1, 3).reshape(b, t, h)
+    xh = xh + o @ p["wo"].astype(np.float64)
+    y = ln(xh, p["ln2"])
+    from scipy.special import erf  # noqa: F401  (gelu below is exact)
+    y = y @ p["w1"].astype(np.float64)
+    y = 0.5 * y * (1 + erf(y / np.sqrt(2)))
+    ref = xh + y @ p["w2"].astype(np.float64)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
