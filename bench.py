@@ -182,6 +182,48 @@ def bench_gpt_layer(quick):
                          "reference_a100_ms": REFERENCE_A100_GPT_LAYER_MS}}
 
 
+def bench_gpt_e2e(quick):
+    """Ours: graph-API GPT-small end-to-end causal-LM pretraining step
+    (flagship e2e: flash attention w/ in-kernel dropout, rbg RNG, bf16
+    compute + f32 masters, AdamW)."""
+    import jax
+    import jax.numpy as jnp
+    import hetu_tpu as ht
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+
+    if quick:
+        B, S, L, steps = 2, 128, 2, 3
+    else:
+        B, S, L, steps = 8, 1024, 12, 10
+    c = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=L,
+                  num_heads=12, seq_len=S, dropout_prob=0.1)
+    rng = np.random.default_rng(0)
+    ids = ht.placeholder_op("gpt_ids", (B, S), dtype=np.int32)
+    labels = ht.placeholder_op("gpt_labels", (B, S), dtype=np.int32)
+    loss = GPTLMHeadModel(c).loss(ids, labels)
+    opt = ht.AdamWOptimizer(learning_rate=1e-4, weight_decay=0.01)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
+                     compute_dtype=jnp.bfloat16,
+                     rng_impl=None if quick else "rbg")
+    ids_v = rng.integers(0, c.vocab_size, (B, S))
+    feed = {ids: jnp.asarray(ids_v, jnp.int32),
+            labels: jnp.asarray(np.roll(ids_v, -1, 1), jnp.int32)}
+    out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
+    assert np.isfinite(out[0]), "non-finite loss"
+    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
+    ours = B / dt
+
+    import gc
+    del ex
+    gc.collect()
+    from benchmarks.flax_baselines import gpt_samples_per_sec
+    base = gpt_samples_per_sec(B, S, layers=L, steps=steps)
+    return {"metric": "gpt_small_train_samples_per_sec_per_chip",
+            "value": round(ours, 2), "unit": "samples/sec",
+            "vs_baseline": round(ours / base, 3),
+            "baseline": {"flax_same_chip": round(base, 2)}}
+
+
 def bench_wdl(quick):
     """Ours: graph-API Wide&Deep, in-graph embedding (the TPU-preferred
     path when the table fits HBM), Adam."""
@@ -215,7 +257,8 @@ def bench_wdl(quick):
             "baseline": {"flax_same_chip": round(base, 2)}}
 
 
-STAGES = {"bert": bench_bert, "gpt": bench_gpt_layer, "wdl": bench_wdl}
+STAGES = {"bert": bench_bert, "gpt": bench_gpt_layer,
+          "gpt_e2e": bench_gpt_e2e, "wdl": bench_wdl}
 
 
 def main():
@@ -242,7 +285,8 @@ def main():
             raise RuntimeError(f"bench stage {stage} failed")
         results[stage] = json.loads(proc.stdout.strip().splitlines()[-1])
     headline = dict(results["bert"])
-    headline["extra_metrics"] = [results["gpt"], results["wdl"]]
+    headline["extra_metrics"] = [results["gpt"], results["gpt_e2e"],
+                                 results["wdl"]]
     print(json.dumps(headline))
 
 

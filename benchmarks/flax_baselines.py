@@ -224,3 +224,75 @@ def wdl_steps_per_sec(batch=128, *, rows=337000, dim=16, num_sparse=26,
         params, opt_state, loss = step(params, opt_state)
     float(loss)
     return steps / (time.perf_counter() - start)
+
+
+# --------------------------------------------------------------------------
+# GPT-small end-to-end causal-LM pretraining step (flagship e2e workload)
+# --------------------------------------------------------------------------
+
+def gpt_samples_per_sec(batch, seq_len, *, vocab=50257, hidden=768,
+                        layers=12, heads=12, steps=10, dropout=0.1):
+    import flax.linen as nn
+    import optax
+
+    dtype = jnp.bfloat16
+
+    class Layer(nn.Module):
+        @nn.compact
+        def __call__(self, x, mask, train: bool):
+            h = nn.LayerNorm(dtype=dtype)(x)
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=heads, dtype=dtype, param_dtype=jnp.float32,
+                dropout_rate=dropout, deterministic=not train)(h, h,
+                                                               mask=mask)
+            h = nn.Dropout(dropout, deterministic=not train)(h)
+            x = x + h
+            f = nn.LayerNorm(dtype=dtype)(x)
+            f = nn.gelu(nn.Dense(4 * hidden, dtype=dtype)(f))
+            f = nn.Dense(hidden, dtype=dtype)(f)
+            f = nn.Dropout(dropout, deterministic=not train)(f)
+            return x + f
+
+    class GPT(nn.Module):
+        @nn.compact
+        def __call__(self, ids, train: bool = True):
+            x = nn.Embed(vocab, hidden, dtype=dtype)(ids)
+            x = x + nn.Embed(seq_len, hidden, dtype=dtype)(
+                jnp.arange(ids.shape[1])[None, :])
+            x = nn.Dropout(dropout, deterministic=not train)(x)
+            mask = nn.make_causal_mask(ids, dtype=dtype)
+            for _ in range(layers):
+                x = Layer()(x, mask, train)
+            x = nn.LayerNorm(dtype=dtype)(x)
+            return nn.Dense(vocab, use_bias=False, dtype=dtype)(x)
+
+    model = GPT()
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    key = jax.random.key(0, impl="rbg")
+    params = model.init({"params": jax.random.key(0), "dropout": key}, ids)
+    tx = optax.adamw(1e-4, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, dk):
+        logits = model.apply(p, ids, train=True, rngs={"dropout": dk})
+        ll = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(ll, labels[..., None],
+                                             axis=-1)[..., 0])
+
+    @jax.jit
+    def step(p, s, k):
+        k, dk = jax.random.split(k)
+        loss, grads = jax.value_and_grad(loss_fn)(p, dk)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, k, loss
+
+    params, opt_state, key, loss = step(params, opt_state, key)
+    assert np.isfinite(float(loss))  # float() forces materialization
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, key, loss = step(params, opt_state, key)
+    float(loss)
+    return steps * batch / (time.perf_counter() - start)
