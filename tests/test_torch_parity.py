@@ -183,3 +183,49 @@ def test_tiny_gpt2_matches_huggingface(rng):
     with torch.no_grad():
         want = hf(input_ids=torch.from_numpy(ids_v)).last_hidden_state
     np.testing.assert_allclose(got, _t2n(want), rtol=1e-3, atol=1e-3)
+
+
+def test_gpt2_training_curve_matches_huggingface(rng):
+    """END-TO-END loss-curve parity (the reference's loss-parity harness,
+    north-star metric #3): tiny GPT-2 with identical HF-imported weights,
+    identical batches, AdamW on both sides — 8 training losses must track
+    through autodiff + optimizer + tied-embedding LM loss."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.models.hf_import import load_hf_gpt2_weights
+
+    B, S, V = 2, 16, 100
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=V, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        activation_function="gelu_new")
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    hf.train()
+
+    c = GPTConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                  num_heads=4, seq_len=S, dropout_prob=0.0)
+    model = GPTLMHeadModel(c, name="gpt2curve")
+    ids = ht.placeholder_op("g2c_ids", (B, S), dtype=np.int32)
+    labels = ht.placeholder_op("g2c_labels", (B, S), dtype=np.int32)
+    loss = model.loss(ids, labels)
+    opt = ht.AdamWOptimizer(learning_rate=1e-3, weight_decay=0.01)
+    ex = ht.Executor([loss, opt.minimize(loss)])
+    load_hf_gpt2_weights(ex, model.transformer, hf.transformer.state_dict(),
+                         name="gpt2curve")
+
+    topt = torch.optim.AdamW(hf.parameters(), lr=1e-3, weight_decay=0.01)
+    ours, theirs = [], []
+    for step in range(8):
+        ids_v = rng.integers(0, V, (B, S))
+        lab_v = np.roll(ids_v, -1, axis=1)
+        out = ex.run(feed_dict={ids: ids_v, labels: lab_v},
+                     convert_to_numpy_ret_vals=True)
+        ours.append(float(out[0]))
+        topt.zero_grad()
+        logits = hf(input_ids=torch.from_numpy(ids_v)).logits
+        tl = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, V), torch.from_numpy(lab_v).reshape(-1))
+        tl.backward()
+        topt.step()
+        theirs.append(float(tl))
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
