@@ -224,6 +224,44 @@ def bench_gpt_e2e(quick):
             "baseline": {"flax_same_chip": round(base, 2)}}
 
 
+def bench_resnet(quick):
+    """Ours: graph-API ResNet-18 / CIFAR10-shape training step (reference
+    benchmark config #1, examples/cnn) — convs on the MXU, BatchNorm
+    running stats threaded through the fused vjp."""
+    import hetu_tpu as ht
+    from hetu_tpu.models import resnet18
+    import jax.numpy as jnp
+
+    # large batch: CIFAR steps are tiny, and through the dev tunnel a
+    # small-batch measurement times dispatch, not the chip
+    B, steps = (16, 5) if quick else (2048, 20)
+    rng = np.random.default_rng(0)
+    x = ht.placeholder_op("rn_x", (B, 3, 32, 32))
+    y = ht.placeholder_op("rn_y", (B,), dtype=np.int32)
+    model = resnet18(num_classes=10)
+    loss = ht.reduce_mean_op(
+        ht.softmax_cross_entropy_sparse_op(model(x), y))
+    opt = ht.MomentumOptimizer(learning_rate=0.1, momentum=0.9)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]})
+    feed = {x: jnp.asarray(rng.standard_normal((B, 3, 32, 32)),
+                           jnp.float32),
+            y: jnp.asarray(rng.integers(0, 10, (B,)), jnp.int32)}
+    out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
+    assert np.isfinite(out[0])
+    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
+    ours = B / dt
+
+    import gc
+    del ex
+    gc.collect()
+    from benchmarks.flax_baselines import resnet18_samples_per_sec
+    base = resnet18_samples_per_sec(B, steps=steps)
+    return {"metric": "resnet18_cifar_train_samples_per_sec_per_chip",
+            "value": round(ours, 2), "unit": "samples/sec",
+            "vs_baseline": round(ours / base, 3),
+            "baseline": {"flax_same_chip": round(base, 2)}}
+
+
 def bench_wdl(quick):
     """Ours: graph-API Wide&Deep, in-graph embedding (the TPU-preferred
     path when the table fits HBM), Adam."""
@@ -258,7 +296,8 @@ def bench_wdl(quick):
 
 
 STAGES = {"bert": bench_bert, "gpt": bench_gpt_layer,
-          "gpt_e2e": bench_gpt_e2e, "wdl": bench_wdl}
+          "gpt_e2e": bench_gpt_e2e, "resnet": bench_resnet,
+          "wdl": bench_wdl}
 
 
 def main():
@@ -286,7 +325,7 @@ def main():
         results[stage] = json.loads(proc.stdout.strip().splitlines()[-1])
     headline = dict(results["bert"])
     headline["extra_metrics"] = [results["gpt"], results["gpt_e2e"],
-                                 results["wdl"]]
+                                 results["resnet"], results["wdl"]]
     print(json.dumps(headline))
 
 

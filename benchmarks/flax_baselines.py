@@ -296,3 +296,76 @@ def gpt_samples_per_sec(batch, seq_len, *, vocab=50257, hidden=768,
         params, opt_state, key, loss = step(params, opt_state, key)
     float(loss)
     return steps * batch / (time.perf_counter() - start)
+
+
+# --------------------------------------------------------------------------
+# ResNet-18 / CIFAR10 (reference benchmark config #1: examples/cnn)
+# --------------------------------------------------------------------------
+
+def resnet18_samples_per_sec(batch=256, *, num_classes=10, steps=20):
+    import flax.linen as nn
+    import optax
+
+    class Block(nn.Module):
+        filters: int
+        strides: int
+
+        @nn.compact
+        def __call__(self, x, train: bool):
+            y = nn.Conv(self.filters, (3, 3), (self.strides,) * 2,
+                        use_bias=False)(x)
+            y = nn.BatchNorm(use_running_average=not train)(y)
+            y = nn.relu(y)
+            y = nn.Conv(self.filters, (3, 3), use_bias=False)(y)
+            y = nn.BatchNorm(use_running_average=not train)(y)
+            if x.shape[-1] != self.filters or self.strides != 1:
+                x = nn.Conv(self.filters, (1, 1), (self.strides,) * 2,
+                            use_bias=False)(x)
+                x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.relu(x + y)
+
+    class ResNet18(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            x = nn.Conv(64, (3, 3), use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            for filters, blocks, stride in ((64, 2, 1), (128, 2, 2),
+                                            (256, 2, 2), (512, 2, 2)):
+                for j in range(blocks):
+                    x = Block(filters, stride if j == 0 else 1)(x, train)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(num_classes)(x)
+
+    model = ResNet18()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, num_classes, (batch,)), jnp.int32)
+
+    variables = model.init(jax.random.key(0), x)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, bs):
+        logits, mut = model.apply({"params": p, "batch_stats": bs}, x,
+                                  train=True, mutable=["batch_stats"])
+        ll = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        loss = -jnp.mean(jnp.take_along_axis(ll, y[:, None], 1)[:, 0])
+        return loss, mut["batch_stats"]
+
+    @jax.jit
+    def step(p, bs, s):
+        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, bs)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), bs, s, loss
+
+    params, batch_stats, opt_state, loss = step(params, batch_stats,
+                                                opt_state)
+    assert np.isfinite(float(loss))  # float() forces materialization
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = step(params, batch_stats,
+                                                    opt_state)
+    float(loss)
+    return steps * batch / (time.perf_counter() - start)

@@ -38,19 +38,9 @@ class GradientsBundleOp(Op):
     def __init__(self, loss, xs, grad_out=None):
         self.xs = list(xs)
         self.grad_out = grad_out
-        self._stateless = None
         inputs = [loss] + self.xs + ([grad_out] if grad_out is not None else [])
         super().__init__(*inputs, name=f"grads_of_{loss.name}")
         self.loss = loss
-
-    def subgraph_stateless(self):
-        """True iff no stateful op (batchnorm update, assign) sits in the
-        loss subgraph — the condition for skipping the separate primal
-        forward (stateful ops record updates only on the primal trace)."""
-        if self._stateless is None:
-            self._stateless = not any(
-                n.is_stateful for n in find_topo_sort([self.loss]))
-        return self._stateless
 
     # evaluated via _compute_with_env (special-cased by trace/executor)
     def _compute_with_env(self, env, ctx: TraceContext, want_primal=False):
@@ -64,8 +54,13 @@ class GradientsBundleOp(Op):
                   if isinstance(n, (PlaceholderOp, VariableOp))
                   and n not in x_set]
 
-        # updates from the re-trace are discarded (the primal forward already
-        # recorded them); RNG is shared so dropout masks replay identically.
+        # stateful updates (batchnorm running stats, assigns) surface as
+        # the vjp's aux so the primal-fusion path can record them; on the
+        # non-fused path they're discarded (the primal forward already
+        # recorded them).  RNG is shared either way, so dropout masks
+        # replay identically.
+        node_by_name = {}  # aux pytree keys must sort; map names back
+
         def f(x_vals):
             inner = TraceContext(key=ctx.key, training=ctx.training,
                                  mesh=ctx.mesh,
@@ -73,17 +68,20 @@ class GradientsBundleOp(Op):
             bind = {n: env[n] for n in leaves if n in env}
             bind.update(dict(zip(self.xs, x_vals)))
             (loss_val,), _ = evaluate([self.loss], bind, inner)
-            return loss_val
+            node_by_name.update({v.name: v for v in inner.updates})
+            return loss_val, {v.name: val
+                              for v, val in inner.updates.items()}
 
         primals = [env[x] for x in self.xs]
-        loss_val, vjp_fn = jax.vjp(f, primals)
+        loss_val, vjp_fn, updates = jax.vjp(f, primals, has_aux=True)
         if self.grad_out is not None:
             ct = env[self.grad_out]
         else:
             ct = jnp.ones_like(loss_val)
         (grads,) = vjp_fn(ct)
         if want_primal:
-            return loss_val, tuple(grads)
+            return loss_val, tuple(grads), {node_by_name[k]: v
+                                            for k, v in updates.items()}
         return tuple(grads)
 
     def _compute(self, input_vals, ctx):

@@ -72,13 +72,17 @@ def evaluate(eval_nodes, bindings, ctx: TraceContext, topo=None):
     # Pallas custom_vjp boundaries; 25% extra FLOPs on BERT-base).
     for node in topo:
         if (getattr(node, "fuses_primal", False) and node not in env
-                and node.loss not in env and node.subgraph_stateless()
+                and node.loss not in env
                 and all(x in env for x in node.xs)
                 and (node.grad_out is None or node.grad_out in env)):
-            primal, grads = node._compute_with_env(env, ctx,
-                                                   want_primal=True)
+            primal, grads, updates = node._compute_with_env(
+                env, ctx, want_primal=True)
             env[node] = grads
             env[node.loss] = primal
+            # stateful ops in the (now skipped) primal forward recorded
+            # their updates on the vjp's inner trace; thread them out
+            for var, val in updates.items():
+                ctx.record_update(var, val)
     # -- demand pruning: with losses pre-bound, their interior forward
     # nodes may be orphaned; compute only what the eval nodes still need
     needed = set()

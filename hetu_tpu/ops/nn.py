@@ -28,11 +28,18 @@ def _conv2d(x, w, padding=0, stride=1, dilation=1, groups=1):
     ph, pw = _pair(padding)
     sh, sw = _pair(stride)
     dh, dw = _pair(dilation)
-    return lax.conv_general_dilated(
-        x, w, window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+    # API layout is NCHW (reference parity) but the compute runs NHWC —
+    # the TPU-native conv layout (channels on the lane dim).  XLA's
+    # algebraic simplifier pushes the boundary transposes through the
+    # elementwise/BN chain so conv→bn→relu→conv stays NHWC end to end
+    # (measured: ResNet-18/CIFAR trains ~25% faster than NCHW compute).
+    out = lax.conv_general_dilated(
+        x.transpose(0, 2, 3, 1), w.transpose(2, 3, 1, 0),
+        window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
         rhs_dilation=(dh, dw), feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=jnp.float32).astype(x.dtype)
+    return out.transpose(0, 3, 1, 2)
 
 
 conv2d_op = simple_op(_conv2d, "conv2d")
@@ -147,8 +154,12 @@ class BatchNormOp(Op):
             # masters (bf16 bindings would re-quantize them every step and
             # round small momentum updates away)
             xf = x.astype(jnp.float32)
+            # one-pass stats (E[x^2] - E[x]^2): x is read once for both
+            # reductions, halving the stats traffic vs jnp.var's
+            # mean-then-deviations form
             mean = jnp.mean(xf, axis=(0, 2, 3))
-            var = jnp.var(xf, axis=(0, 2, 3))
+            mean2 = jnp.mean(jnp.square(xf), axis=(0, 2, 3))
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
             m = self.momentum
             master = ctx.master_params
             rm = (master[self.running_mean.name]
