@@ -330,6 +330,36 @@ class Executor:
         self.config = kwargs
 
         all_nodes = [n for lst in self.eval_node_dict.values() for n in lst]
+        # reference comm_mode semantics (executor.py:278-306):
+        #   'AllReduce' — dense grads allreduced across data-parallel
+        #     replicas; here that's the DataParallel strategy (GSPMD emits
+        #     the psum over the dp axis).
+        #   'PS'/'Hybrid' — embedding tables live behind the parameter
+        #     store (ps.PSEmbedding feeds/pushes rows); dense params stay
+        #     on-device.  Selecting the mode without any PS-backed table
+        #     in the graph is almost certainly a mistake — flag it.
+        if comm_mode is not None:
+            mode = str(comm_mode).lower()
+            if mode == "allreduce":
+                if dist_strategy is None and mesh is None:
+                    from ..parallel.strategies import DataParallel
+                    dist_strategy = DataParallel(ndev=len(jax.devices()))
+            elif mode in ("ps", "hybrid"):
+                has_ps = any(hasattr(n, "ps_embedding")
+                             for n in find_topo_sort(all_nodes))
+                if not has_ps:
+                    import warnings
+                    warnings.warn(
+                        f"comm_mode={comm_mode!r} but no PSEmbedding-backed "
+                        "table reaches this executor; dense parameters "
+                        "always train on-device (use ps.PSEmbedding for "
+                        "host-store tables)")
+                if mode == "hybrid" and dist_strategy is None \
+                        and mesh is None and len(jax.devices()) > 1:
+                    from ..parallel.strategies import DataParallel
+                    dist_strategy = DataParallel(ndev=len(jax.devices()))
+            else:
+                raise ValueError(f"unknown comm_mode {comm_mode!r}")
         if dist_strategy is not None:
             dist_strategy.annotate(all_nodes)
             if mesh is None and getattr(dist_strategy, "mesh", None) is not None:

@@ -248,3 +248,27 @@ def test_rbg_rng_checkpoint_roundtrip(tmp_path):
     a = ex.run("train", feed_dict={x: X}, convert_to_numpy_ret_vals=True)
     b = ex2.run("train", feed_dict={x: X}, convert_to_numpy_ret_vals=True)
     np.testing.assert_allclose(a[0], b[0])
+
+
+def test_comm_mode_allreduce_is_data_parallel():
+    # reference comm_mode='AllReduce' (executor.py:278): dense grads
+    # allreduce across replicas == our DataParallel annotation
+    import jax
+    x = ht.placeholder_op("cm_x", (16, 8))
+    y = ht.placeholder_op("cm_y", (16, 1))
+    w = ht.Variable("cm_w", shape=(8, 1), initializer=ht.init.zeros())
+    loss = ht.mse_loss_op(ht.matmul_op(x, w), y)
+    ex = ht.Executor([loss, ht.SGDOptimizer(0.1).minimize(loss)],
+                     comm_mode="AllReduce")
+    assert ex.mesh is not None and len(ex.mesh.devices.flatten()) == \
+        len(jax.devices())
+    X = np.ones((16, 8), np.float32)
+    Y = np.full((16, 1), 2.0, np.float32)
+    l0 = ex.run(feed_dict={x: X, y: Y}, convert_to_numpy_ret_vals=True)[0]
+    l1 = ex.run(feed_dict={x: X, y: Y}, convert_to_numpy_ret_vals=True)[0]
+    assert l1 < l0
+
+    with pytest.warns(UserWarning, match="no PSEmbedding"):
+        ht.Executor([loss], comm_mode="PS")
+    with pytest.raises(ValueError, match="unknown comm_mode"):
+        ht.Executor([loss], comm_mode="bogus")
