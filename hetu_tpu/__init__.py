@@ -13,7 +13,8 @@ from __future__ import annotations
 import numpy as np
 
 from .graph import (Op, PlaceholderOp, VariableOp, find_topo_sort,
-                    graph_variables, gradients, Executor, stage)
+                    graph_variables, gradients, Executor, stage,
+                    name_scope, remat)
 from . import initializers as init
 from .ops import *  # noqa: F401,F403
 from .optim import (SGDOptimizer, MomentumOptimizer, AdaGradOptimizer,

@@ -64,6 +64,44 @@ class stage:
         return False
 
 
+_remat_tls = _threading.local()
+_remat_counter = [0]
+
+
+def _remat_stack():
+    stack = getattr(_remat_tls, "stack", None)
+    if stack is None:
+        stack = _remat_tls.stack = [None]
+    return stack
+
+
+class remat:
+    """Rematerialization scope: ops created inside form one
+    `jax.checkpoint` group — their activations are NOT saved for the
+    backward pass; the group recomputes during the vjp instead.
+
+    The graph-API face of the reference's memory planner (SURVEY §2.2
+    P10: memory_pool.py / swap — on TPU the trade is FLOPs-for-HBM via
+    remat, not host swap).  Typical use wraps each transformer layer::
+
+        with ht.remat():
+            x = layer(x, ...)
+
+    Stateful ops (batchnorm update, assign) must stay outside — the
+    recompute would replay their side effects; `evaluate` raises.
+    """
+
+    def __enter__(self):
+        _remat_counter[0] += 1
+        self.idx = _remat_counter[0]
+        _remat_stack().append(self.idx)
+        return self
+
+    def __exit__(self, *exc):
+        _remat_stack().pop()
+        return False
+
+
 def current_stage():
     return _stage_stack()[-1]
 
@@ -136,7 +174,7 @@ class Op:
 
     __slots__ = (
         "id", "name", "inputs", "attrs", "dist_state", "raw_ctx",
-        "_shape_cache",
+        "remat_scope", "_shape_cache",
     )
 
     def __init__(self, *inputs, name=None, **attrs):
@@ -151,6 +189,8 @@ class Op:
         # reference raw_ctx (Node.py / context.py DeviceGroup).  Picked up
         # from an enclosing `with stage(i):` scope.
         self.raw_ctx = _stage_stack()[-1]
+        # `with remat():` group id (jax.checkpoint at trace time), or None
+        self.remat_scope = _remat_stack()[-1]
         self._shape_cache = None
 
     # -- graph protocol ----------------------------------------------------

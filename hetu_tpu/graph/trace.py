@@ -54,12 +54,15 @@ class TraceContext:
         self.updates[var] = value
 
 
-def evaluate(eval_nodes, bindings, ctx: TraceContext, topo=None):
+def evaluate(eval_nodes, bindings, ctx: TraceContext, topo=None,
+             _remat=True):
     """Evaluate ``eval_nodes`` given ``bindings`` {node: value}.
 
     ``bindings`` must cover every PlaceholderOp/VariableOp reachable; other
     nodes may also be pre-bound (used by autodiff to rebase gradients).
-    Returns (values list, env dict).
+    Returns (values list, env dict).  ``_remat=False`` disables remat-group
+    handling (used INSIDE a group's checkpointed body, where the group's
+    own nodes must evaluate plainly).
     """
     env = dict(bindings)
     if topo is None:
@@ -93,11 +96,85 @@ def evaluate(eval_nodes, bindings, ctx: TraceContext, topo=None):
             continue
         needed.add(n.id)
         stack.extend(i for i in n.inputs if i not in env)
+    # -- remat groups: ops created under `with ht.remat():` evaluate as
+    # one jax.checkpoint'ed function (their activations recompute in the
+    # vjp instead of being saved — the FLOPs-for-HBM memory planner)
+    remat_groups = {}
+    if _remat:
+        for node in topo:
+            if (node.id in needed and node not in env
+                    and not isinstance(node, (PlaceholderOp, VariableOp))
+                    and node.remat_scope is not None):
+                remat_groups.setdefault(node.remat_scope, []).append(node)
+    group_outputs = {}
+    if remat_groups:
+        eval_ids = {n.id for n in eval_nodes}
+        consumed_outside = {}
+        for n in topo:
+            scope = getattr(n, "remat_scope", None)
+            for i in n.inputs:
+                iscope = getattr(i, "remat_scope", None)
+                if iscope is not None and iscope != scope:
+                    consumed_outside.setdefault(iscope, set()).add(i.id)
+        for scope, group in remat_groups.items():
+            outs = [n for n in group
+                    if n.id in consumed_outside.get(scope, ())
+                    or n.id in eval_ids]
+            group_outputs[scope] = outs or group[-1:]
+
+    done_ids = set()
+
+    def eval_remat_group(scope):
+        group = remat_groups[scope]
+        gids = {n.id for n in group}
+        for n in group:
+            if n.is_stateful:
+                raise ValueError(
+                    f"stateful op {n.name} inside a remat scope — its "
+                    "update would replay on recompute; move it outside")
+        ins, seen = [], set()
+        for n in group:
+            for i in n.inputs:
+                if i.id not in gids and i.id not in seen:
+                    seen.add(i.id)
+                    ins.append(i)
+        missing = [i for i in ins if i not in env]
+        if missing:
+            # external inputs later in topo than the group's first node:
+            # demand-evaluate them now (a cycle through the group itself
+            # is impossible to checkpoint as one function)
+            closure = find_topo_sort(missing)
+            if any(getattr(c, "remat_scope", None) == scope
+                   for c in closure if c not in env):
+                raise ValueError(
+                    "remat scope interleaves with outside computation; "
+                    "split the scope")
+            _, env2 = evaluate(missing, env, ctx)
+            env.update(env2)
+        outs = group_outputs[scope]
+
+        def f(*in_vals):
+            # bind ONLY the group's external inputs: everything the group
+            # needs flows through the checkpoint boundary as an argument
+            # (no closure captures), so the vjp recomputes exactly the
+            # group's interior and saves only `ins`
+            vals, _ = evaluate(outs, dict(zip(ins, in_vals)), ctx,
+                               _remat=False)
+            return tuple(vals)
+
+        out_vals = jax.checkpoint(f)(*[env[i] for i in ins])
+        for n, v in zip(outs, out_vals):
+            env[n] = v
+        done_ids.update(gids)
+
     for node in topo:
-        if node in env or node.id not in needed:
+        if node in env or node.id not in needed or node.id in done_ids:
             continue
         if isinstance(node, (PlaceholderOp, VariableOp)):
             raise RuntimeError(f"{node} reached trace without a binding")
+        if _remat and node.remat_scope is not None:
+            eval_remat_group(node.remat_scope)
+            continue
         if hasattr(node, "_compute_with_env"):
             env[node] = node._compute_with_env(env, ctx)
         else:

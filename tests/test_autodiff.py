@@ -80,3 +80,66 @@ def test_dropout_grad_mask_consistency():
     # d loss/d w = X^T @ mask_scale; nonzero pattern of h determines mask
     mask = (hv != 0).astype(np.float32) * 2.0
     np.testing.assert_allclose(gv, X.T @ mask, rtol=1e-4, atol=1e-4)
+
+
+def test_remat_scope_matches_plain_and_cuts_memory():
+    # `with ht.remat():` groups evaluate under jax.checkpoint: identical
+    # numerics (same per-op RNG), smaller compiled temp footprint
+    import jax.numpy as jnp
+    from hetu_tpu.models import GPTConfig
+    from hetu_tpu.layers import TransformerLayer
+
+    def build(use_remat, tag):
+        B, S, H = 4, 64, 64
+        x = ht.placeholder_op(f"rm_x_{tag}", (B, S, H))
+        y = ht.placeholder_op(f"rm_y_{tag}", (B, S, H))
+        with ht.name_scope():
+            h = x
+            for i in range(4):
+                layer = TransformerLayer(H, 4, 4 * H, seq_len=S,
+                                         dropout_rate=0.0,
+                                         attn_dropout_rate=0.0,
+                                         causal=True,
+                                         name=f"rm{tag}_l{i}")
+                if use_remat:
+                    with ht.remat():
+                        h = layer(h, seq_len=S)
+                else:
+                    h = layer(h, seq_len=S)
+        loss = ht.mse_loss_op(h, y)
+        opt = ht.AdamOptimizer(1e-3)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]})
+        return ex, x, y
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((4, 64, 64)).astype(np.float32)
+    Y = rng.standard_normal((4, 64, 64)).astype(np.float32)
+
+    ex_a, xa, ya = build(False, "plain")
+    ex_b, xb, yb = build(True, "ck")
+    # identical weights: copy by sorted-name order (names differ by tag);
+    # materialize fresh arrays — ex_a donates its params each step
+    import jax.numpy as jnp
+    ex_b.params = dict(zip(sorted(ex_b.params),
+                           [jnp.asarray(np.asarray(ex_a.params[k]))
+                            for k in sorted(ex_a.params)]))
+    la = [float(ex_a.run("train", feed_dict={xa: X, ya: Y},
+                         convert_to_numpy_ret_vals=True)[0])
+          for _ in range(3)]
+    lb = [float(ex_b.run("train", feed_dict={xb: X, yb: Y},
+                         convert_to_numpy_ret_vals=True)[0])
+          for _ in range(3)]
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+
+
+def test_remat_rejects_stateful_ops():
+    import pytest
+    x = ht.placeholder_op("rms_x", (4, 3, 8, 8))
+    scale = ht.Variable("rms_scale", value=np.ones(3, np.float32))
+    bias = ht.Variable("rms_bias", value=np.zeros(3, np.float32))
+    with ht.remat():
+        y = ht.batch_normalization_op(x, scale, bias)
+    loss = ht.reduce_mean_op(y)
+    with pytest.raises(ValueError, match="stateful op .* remat"):
+        ht.Executor([loss, ht.SGDOptimizer(0.1).minimize(loss)]).run(
+            feed_dict={x: np.ones((4, 3, 8, 8), np.float32)})
