@@ -89,6 +89,7 @@ class remat:
 
     Stateful ops (batchnorm update, assign) must stay outside — the
     recompute would replay their side effects; `evaluate` raises.
+    Nested scopes merge into the outermost group (one coarse checkpoint).
     """
 
     def __enter__(self):
@@ -189,8 +190,13 @@ class Op:
         # reference raw_ctx (Node.py / context.py DeviceGroup).  Picked up
         # from an enclosing `with stage(i):` scope.
         self.raw_ctx = _stage_stack()[-1]
-        # `with remat():` group id (jax.checkpoint at trace time), or None
-        self.remat_scope = _remat_stack()[-1]
+        # `with remat():` group id (jax.checkpoint at trace time), or
+        # None.  The OUTERMOST active scope wins: nested scopes merge
+        # into one coarser checkpoint group (wrapping a block whose
+        # sublayers also remat composes instead of erroring).
+        _rs = _remat_stack()
+        self.remat_scope = next((s for s in _rs[1:] if s is not None),
+                                None) if len(_rs) > 1 else None
         self._shape_cache = None
 
     # -- graph protocol ----------------------------------------------------

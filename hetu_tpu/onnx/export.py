@@ -370,7 +370,10 @@ def _infer_shapes(eval_nodes, params):
         for vr in vars_:
             bindings[vr] = jnp.zeros(np.shape(params[vr.name]),
                                      np.asarray(params[vr.name]).dtype)
-        _, env = evaluate(eval_nodes, bindings, ctx)
+        # _remat=False: shape inference has no backward pass, and remat
+        # grouping binds only group OUTPUTS in env — interior nodes would
+        # KeyError here
+        _, env = evaluate(eval_nodes, bindings, ctx, _remat=False)
         return [env[n] for n in interior]
 
     feed_structs = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype)
