@@ -369,3 +369,76 @@ def resnet18_samples_per_sec(batch=256, *, num_classes=10, steps=20):
                                                     opt_state)
     float(loss)
     return steps * batch / (time.perf_counter() - start)
+
+
+# --------------------------------------------------------------------------
+# MoE FFN block (reference benchmark config #5: examples/moe)
+# --------------------------------------------------------------------------
+
+def moe_tokens_per_sec(batch=8, seq=1024, hidden=512, d_ff=2048,
+                       num_experts=8, k=2, capacity_factor=1.25, steps=15):
+    """Straightforward flax/optax GShard-style top-k MoE (one-hot
+    dispatch/combine einsums with expert capacity) — the trusted
+    implementation pattern for a dense-dispatch MoE on one chip."""
+    import flax.linen as nn
+    import optax
+
+    T = batch * seq
+    C = int(capacity_factor * T * k / num_experts)
+
+    class MoE(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            xt = x.reshape(T, hidden)
+            logits = nn.Dense(num_experts, use_bias=False)(xt)
+            gates = jax.nn.softmax(logits, -1)                    # [T, E]
+            # top-k gating with capacity (GShard): iterate k choices
+            dispatch = jnp.zeros((T, num_experts, C), x.dtype)
+            combine = jnp.zeros((T, num_experts, C), x.dtype)
+            g = gates
+            denom = jnp.zeros((T,), x.dtype)
+            for _ in range(k):
+                idx = jnp.argmax(g, -1)                           # [T]
+                onehot = jax.nn.one_hot(idx, num_experts, dtype=x.dtype)
+                pos = (jnp.cumsum(onehot, 0) - onehot) * onehot   # rank
+                pos = jnp.sum(pos, -1).astype(jnp.int32)
+                keep = pos < C
+                pslot = jax.nn.one_hot(pos, C, dtype=x.dtype)
+                d = onehot[..., None] * pslot[:, None, :] \
+                    * keep[:, None, None]
+                w = jnp.sum(g * onehot, -1)
+                dispatch = dispatch + d
+                combine = combine + w[:, None, None] * d
+                denom = denom + w * keep
+                g = g * (1 - onehot)
+            combine = combine / jnp.maximum(denom, 1e-9)[:, None, None]
+            xe = jnp.einsum("tec,th->ech", dispatch, xt)          # [E,C,H]
+            h = nn.relu(nn.DenseGeneral((d_ff,), axis=-1)(xe))
+            ye = nn.DenseGeneral((hidden,), axis=-1)(h)           # [E,C,H]
+            y = jnp.einsum("tec,ech->th", combine, ye)
+            return y.reshape(batch, seq, hidden)
+
+    model = MoE()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, seq, hidden)), jnp.float32)
+    y = jnp.zeros_like(x)
+    params = model.init(jax.random.key(0), x)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def loss_fn(p):
+        return jnp.mean((model.apply(p, x) - y) ** 2)
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        u, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    params, opt_state, loss = step(params, opt_state)
+    assert np.isfinite(float(loss))  # float() forces materialization
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state)
+    float(loss)
+    return steps * batch * seq / (time.perf_counter() - start)
