@@ -349,18 +349,28 @@ def main():
         return
     # each stage in its own process: ours + the flax baseline together
     # exceed one chip's HBM at the BERT headline shapes, and a fresh
-    # process returns the chip clean for the next stage
+    # process returns the chip clean for the next stage.  One retry per
+    # stage (the dev tunnel's remote_compile can fail transiently); a
+    # non-headline stage that still fails is reported as failed rather
+    # than sinking the whole benchmark.
     import subprocess
     results = {}
     for stage in STAGES:
         cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage]
         if quick:
             cmd.append("--quick")
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
+        for attempt in (0, 1):
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode == 0:
+                results[stage] = json.loads(
+                    proc.stdout.strip().splitlines()[-1])
+                break
             sys.stderr.write(proc.stderr[-2000:])
-            raise RuntimeError(f"bench stage {stage} failed")
-        results[stage] = json.loads(proc.stdout.strip().splitlines()[-1])
+        else:
+            if stage == "bert":
+                raise RuntimeError("bench headline stage failed twice")
+            results[stage] = {"metric": stage, "value": None,
+                              "unit": "FAILED", "vs_baseline": None}
     headline = dict(results["bert"])
     headline["extra_metrics"] = [results["gpt"], results["gpt_e2e"],
                                  results["resnet"], results["moe"],

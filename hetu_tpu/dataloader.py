@@ -35,7 +35,8 @@ class Dataloader:
     """
 
     def __init__(self, raw_data, batch_size, shuffle=False, drop_last=True,
-                 dp_rank=0, dp_nrank=1, seed=0, prefetch=2, name="data"):
+                 dp_rank=0, dp_nrank=1, seed=0, prefetch=2, name="data",
+                 device_prefetch=False, dtype=None):
         data = np.asarray(raw_data)
         if dp_nrank > 1:
             # contiguous equal shards; tail dropped so every rank agrees
@@ -46,6 +47,13 @@ class Dataloader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.name = name
+        # device_prefetch: the producer thread uploads each batch with
+        # jax.device_put as soon as it's sliced, so the host->device copy
+        # overlaps the previous step instead of landing on the critical
+        # path (on a remote-tunnel chip a per-step synchronous upload
+        # costs a full link round trip; on TPU-VM it's PCIe time)
+        self.device_prefetch = device_prefetch
+        self.dtype = dtype
         self._rng = np.random.default_rng(seed + dp_rank)
         self._queue = queue.Queue(maxsize=prefetch)
         self._epoch_order = None
@@ -77,6 +85,11 @@ class Dataloader:
                     return
                 sel = order[i * self.batch_size:(i + 1) * self.batch_size]
                 batch = self.data[sel]
+                if self.device_prefetch:
+                    import jax
+                    import jax.numpy as jnp
+                    batch = jax.device_put(
+                        jnp.asarray(batch, dtype=self.dtype))
                 while not self._stop.is_set():
                     try:
                         self._queue.put(batch, timeout=0.1)

@@ -140,3 +140,27 @@ def test_encode_truncates_longest_first():
     ids, _, mask = tok.encode("the quick brown fox over lazy",
                               "dog", max_len=8)
     assert len(ids) == 8 and sum(mask) == 8
+
+
+def test_dataloader_device_prefetch():
+    # device_prefetch=True: the producer thread uploads batches ahead of
+    # the consumer, so next_batch() returns device-resident jax arrays
+    import jax
+    data = np.arange(64, dtype=np.float32).reshape(16, 4)
+    dl = Dataloader(data, batch_size=4, device_prefetch=True,
+                    dtype=np.float32)
+    seen = [dl.next_batch() for _ in range(4)]
+    dl.stop()
+    assert all(isinstance(b, jax.Array) for b in seen)
+    got = np.sort(np.concatenate([np.asarray(b) for b in seen]).ravel())
+    np.testing.assert_array_equal(got, np.arange(64, dtype=np.float32))
+
+    # flows through the executor's auto-feed path unchanged
+    op = DataloaderOp(Dataloader(data, batch_size=4, device_prefetch=True,
+                                 dtype=np.float32))
+    w = ht.Variable("dp_w", value=np.ones((4, 1), np.float32))
+    loss = ht.reduce_mean_op(ht.matmul_op(op, w))
+    ex = ht.Executor({"train": [loss, ht.SGDOptimizer(0.01).minimize(loss)]})
+    for _ in range(3):
+        out = ex.run("train", convert_to_numpy_ret_vals=True)
+        assert np.isfinite(out[0])
