@@ -229,3 +229,72 @@ def test_gpt2_training_curve_matches_huggingface(rng):
         topt.step()
         theirs.append(float(tl))
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
+def test_wdl_training_curve_matches_torch(rng):
+    """CTR-family loss-curve parity (reference keeps tf/torch companion
+    models for examples/ctr): Wide&Deep with identical weights and batches,
+    Adam both sides, 8 steps."""
+    from hetu_tpu.models import WDL
+
+    B, rows, dim, F, DN = 32, 500, 8, 6, 5
+    model = WDL(rows, embedding_dim=dim, num_sparse=F, num_dense=DN,
+                hidden=(16, 16), name="wdlp")
+    dense = ht.placeholder_op("wp_dense", (B, DN))
+    sparse = ht.placeholder_op("wp_sparse", (B, F), dtype=np.int32)
+    labels = ht.placeholder_op("wp_labels", (B,))
+    loss = model.loss(dense, sparse, labels)
+    ex = ht.Executor([loss, ht.AdamOptimizer(1e-2).minimize(loss)])
+
+    # torch twin with copied weights
+    class TorchWDL(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = torch.nn.Embedding(rows, dim)
+            self.wide = torch.nn.Linear(DN, 1)
+            self.deep = torch.nn.ModuleList(
+                [torch.nn.Linear(F * dim + DN, 16), torch.nn.Linear(16, 16)])
+            self.out = torch.nn.Linear(16, 1)
+
+        def forward(self, dn, sp):
+            e = self.emb(sp).reshape(dn.shape[0], -1)
+            h = torch.cat([e, dn], dim=1)
+            for l in self.deep:
+                h = torch.relu(l(h))
+            return (self.out(h) + self.wide(dn)).reshape(-1)
+
+    tm = TorchWDL()
+    with torch.no_grad():
+        tm.emb.weight.copy_(torch.from_numpy(
+            np.asarray(ex.params[model.emb.table.name])))
+        tm.wide.weight.copy_(torch.from_numpy(
+            np.asarray(ex.params["wdlp_wide_weight"]).T))
+        tm.wide.bias.copy_(torch.from_numpy(
+            np.asarray(ex.params["wdlp_wide_bias"])))
+        for i, l in enumerate(tm.deep):
+            l.weight.copy_(torch.from_numpy(
+                np.asarray(ex.params[f"wdlp_deep{i}_weight"]).T))
+            l.bias.copy_(torch.from_numpy(
+                np.asarray(ex.params[f"wdlp_deep{i}_bias"])))
+        tm.out.weight.copy_(torch.from_numpy(
+            np.asarray(ex.params["wdlp_out_weight"]).T))
+        tm.out.bias.copy_(torch.from_numpy(
+            np.asarray(ex.params["wdlp_out_bias"])))
+    topt = torch.optim.Adam(tm.parameters(), lr=1e-2)
+
+    ours, theirs = [], []
+    for _ in range(8):
+        dn = rng.standard_normal((B, DN)).astype(np.float32)
+        sp = rng.integers(0, rows, (B, F))
+        lb = rng.integers(0, 2, B).astype(np.float32)
+        out = ex.run(feed_dict={dense: dn, sparse: sp, labels: lb},
+                     convert_to_numpy_ret_vals=True)
+        ours.append(float(out[0]))
+        topt.zero_grad()
+        tl = torch.nn.functional.binary_cross_entropy_with_logits(
+            tm(torch.from_numpy(dn), torch.from_numpy(sp)),
+            torch.from_numpy(lb))
+        tl.backward()
+        topt.step()
+        theirs.append(float(tl))
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
