@@ -250,6 +250,46 @@ class HybridParallelModel:
             params.append(p)
         return params
 
+    def save(self, path, params, opt_state=None):
+        """Checkpoint the hybrid-parallel state: params gather to host
+        numpy (shardings are a placement property, not data), alongside
+        the searched config for load-time validation.  Reference:
+        Galvatron's save_checkpoint over Megatron state dicts."""
+        import pickle
+        state = {
+            "config": self.config.to_json(),
+            "params": jax.tree_util.tree_map(np.asarray, params),
+            "opt_state": (None if opt_state is None else
+                          jax.tree_util.tree_map(np.asarray, opt_state)),
+        }
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+
+    def load(self, path):
+        """Restore (params, opt_state); params re-place onto each layer's
+        searched shardings (a checkpoint written under one parallel config
+        reloads under another — the host copy is layout-free)."""
+        import pickle
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        saved_layers = len(state["params"])
+        if saved_layers != len(self.specs):
+            raise ValueError(
+                f"checkpoint has {saved_layers} layers, model has "
+                f"{len(self.specs)}")
+        params = []
+        for spec, sh, p in zip(self.specs, self.shardings,
+                               state["params"]):
+            pspecs = spec.param_specs(sh)
+            params.append({
+                n: jax.device_put(jnp.asarray(v),
+                                  NamedSharding(sh.mesh, pspecs[n]))
+                for n, v in p.items()})
+        opt_state = state["opt_state"]
+        if opt_state is not None:
+            opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+        return params, opt_state
+
     def _apply_range(self, idxs, stage_params, x):
         for j, i in enumerate(idxs):
             spec, sh = self.specs[i], self.shardings[i]
