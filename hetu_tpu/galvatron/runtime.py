@@ -265,10 +265,20 @@ class HybridParallelModel:
         with open(path, "wb") as f:
             pickle.dump(state, f)
 
+    def _expected_param_shapes(self):
+        # abstract init: shapes without spending FLOPs
+        key = jax.random.PRNGKey(0)
+        return [jax.eval_shape(spec.init, key) for spec in self.specs]
+
     def load(self, path):
         """Restore (params, opt_state); params re-place onto each layer's
         searched shardings (a checkpoint written under one parallel config
-        reloads under another — the host copy is layout-free)."""
+        reloads under another — the host copy is layout-free).
+
+        Optimizer state is pipeline-layout-bound: under pp_deg>1 it is a
+        per-STAGE list whose grouping follows the saving config, so when
+        the pipeline layout differs the load refuses it (reload with
+        opt_state discarded, or keep the same pp layout)."""
         import pickle
         with open(path, "rb") as f:
             state = pickle.load(f)
@@ -277,17 +287,74 @@ class HybridParallelModel:
             raise ValueError(
                 f"checkpoint has {saved_layers} layers, model has "
                 f"{len(self.specs)}")
+        expect = self._expected_param_shapes()
+        for i, (p, exp) in enumerate(zip(state["params"], expect)):
+            for n, v in p.items():
+                if n not in exp or tuple(np.shape(v)) != tuple(exp[n].shape):
+                    raise ValueError(
+                        f"checkpoint layer {i} param {n!r} has shape "
+                        f"{np.shape(v)}, model expects "
+                        f"{tuple(exp[n].shape) if n in exp else 'absent'} "
+                        "— wrong model for this checkpoint")
+        shard_specs = []
         params = []
         for spec, sh, p in zip(self.specs, self.shardings,
                                state["params"]):
             pspecs = spec.param_specs(sh)
-            params.append({
-                n: jax.device_put(jnp.asarray(v),
-                                  NamedSharding(sh.mesh, pspecs[n]))
-                for n, v in p.items()})
+            shards = {n: NamedSharding(sh.mesh, pspecs[n]) for n in p}
+            shard_specs.append(shards)
+            params.append({n: jax.device_put(jnp.asarray(v), shards[n])
+                           for n, v in p.items()})
         opt_state = state["opt_state"]
         if opt_state is not None:
-            opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+            saved_cfg = state.get("config", {})
+            cur_cfg = self.config.to_json()
+            same_pp = (saved_cfg.get("pp_deg") == cur_cfg["pp_deg"] and
+                       saved_cfg.get("pp_division")
+                       == cur_cfg["pp_division"])
+            if not same_pp:
+                raise ValueError(
+                    "checkpoint optimizer state was written under pipeline "
+                    f"layout pp_deg={saved_cfg.get('pp_deg')}, this model "
+                    f"uses pp_deg={self.config.pp_deg}; per-stage state "
+                    "does not remap — load params only (save with "
+                    "opt_state=None) or keep the pipeline layout")
+            if self.pp == 1:
+                # place optimizer subtrees that mirror the params tree
+                # (adam mu/nu etc.) onto the params' shardings, so FSDP's
+                # zero-3 memory sharding holds for the moments too
+                param_td = jax.tree_util.tree_structure(params)
+                flat_shards = [shard_specs[i][n]
+                               for i in range(len(params))
+                               for n in sorted(params[i])]
+
+                def place(sub):
+                    try:
+                        leaves, td = jax.tree_util.tree_flatten(sub)
+                    except Exception:
+                        return None
+                    if td != param_td:
+                        return None
+                    return jax.tree_util.tree_unflatten(
+                        td, [jax.device_put(jnp.asarray(l), s)
+                             for l, s in zip(leaves, flat_shards)])
+
+                def walk(node):
+                    placed = place(node)
+                    if placed is not None:
+                        return placed
+                    if isinstance(node, (list, tuple)):
+                        out = [walk(c) for c in node]
+                        return (type(node)(*out)
+                                if hasattr(node, "_fields")
+                                else type(node)(out))
+                    return jax.tree_util.tree_map(jnp.asarray, node)
+
+                opt_state = walk(opt_state)
+            else:
+                # same pipeline layout: per-stage programs re-place the
+                # state onto their submeshes on the first update
+                opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
         return params, opt_state
 
     def _apply_range(self, idxs, stage_params, x):

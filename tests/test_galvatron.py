@@ -334,3 +334,42 @@ def test_runtime_checkpoint_roundtrip_across_configs(tmp_path):
     # equals what the original model would produce on the same batch
     params, opt_state, l1_ref = step(params, opt_state, x, tgt)
     np.testing.assert_allclose(float(l1), float(l1_ref), rtol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_runtime_checkpoint_guards(tmp_path):
+    from hetu_tpu.galvatron.runtime import (HybridParallelModel,
+                                            TransformerHPLayer)
+    from hetu_tpu.galvatron.config import HybridParallelConfig
+    import optax
+
+    def make(hidden, pp, tp_sizes, dp_types):
+        specs = [TransformerHPLayer(hidden=hidden, heads=4)
+                 for _ in tp_sizes]
+        cfg = HybridParallelConfig(pp_deg=pp, tp_sizes=tp_sizes,
+                                   dp_types=dp_types, chunks=2, world=8)
+        return HybridParallelModel(specs, cfg)
+
+    m = make(32, 1, [1, 2], [0, 0])
+    params = m.init_params(jax.random.PRNGKey(0))
+    step, opt_init = m.make_train_step(optax.adam(1e-3))
+    opt_state = opt_init(params)
+    p = str(tmp_path / "g.ckpt")
+    m.save(p, params, opt_state)
+
+    # wrong model width -> clear error at load time
+    with pytest.raises(ValueError, match="wrong model"):
+        make(64, 1, [1, 2], [0, 0]).load(p)
+
+    # different pipeline layout refuses the per-stage optimizer state
+    with pytest.raises(ValueError, match="pipeline layout"):
+        make(32, 2, [1, 1], [0, 0]).load(p)
+
+    # FSDP reload: adam moments come back sharded like their params
+    m3 = make(32, 1, [1, 1], [1, 1])
+    p3, o3 = m3.load(p)
+    mu_leaf = jax.tree_util.tree_leaves(o3)[1]  # some mu tensor
+    assert any(jax.tree_util.tree_leaves(
+        [x.sharding.spec != jax.sharding.PartitionSpec()
+         for x in jax.tree_util.tree_leaves(o3)
+         if hasattr(x, "sharding") and x.ndim >= 2]))
