@@ -30,6 +30,15 @@ softmax_cross_entropy_op = simple_op(_softmax_cross_entropy,
 
 
 def _softmax_cross_entropy_sparse(y, labels, dim=-1, ignored_index=-1):
+    if dim in (-1, y.ndim - 1):
+        # fused Pallas path: streams the vocab once with online logsumexp;
+        # also sidesteps an XLA pathology for lane-unaligned vocab sizes
+        # (GPT-2's 50257: 3.3x slower than 50304 through the jnp form)
+        from .pallas.softmax_ce import fused_softmax_ce_sparse
+        out = fused_softmax_ce_sparse(y, labels,
+                                      ignored_index=ignored_index)
+        if out is not None:
+            return out
     y = y.astype(jnp.float32)  # stable under bf16 compute policies
     lse = jax.scipy.special.logsumexp(y, axis=dim)
     labels = labels.astype(jnp.int32)

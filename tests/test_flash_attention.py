@@ -244,3 +244,39 @@ def test_graph_op_uses_flash_on_tpu_only(rng):
     want = ref_attn(jnp.asarray(qv), jnp.asarray(kv), jnp.asarray(vv),
                     causal=True)
     np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax-CE kernel (ops/pallas/softmax_ce.py)
+
+
+@pytest.mark.parametrize("N,V", [(64, 4096), (100, 5000), (32, 50257 // 8)])
+def test_fused_softmax_ce_matches_jnp(rng, N, V):
+    from hetu_tpu.ops.pallas.softmax_ce import fused_softmax_ce_sparse
+    logits = jnp.asarray(rng.standard_normal((N, V)), jnp.float32)
+    labels = rng.integers(0, V, N)
+    labels[:: 7] = -1   # ignored rows
+    labels = jnp.asarray(labels, jnp.int32)
+
+    def ref(lg, lb):
+        lse = jax.scipy.special.logsumexp(lg, axis=-1)
+        picked = jnp.take_along_axis(
+            lg, jnp.maximum(lb, 0)[:, None], axis=1)[:, 0]
+        return jnp.where(lb == -1, 0.0, lse - picked)
+
+    out = fused_softmax_ce_sparse(logits, labels)
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(logits,
+                                                               labels)),
+                               rtol=1e-5, atol=1e-5)
+
+    def f_loss(lg):
+        return jnp.sum(fused_softmax_ce_sparse(lg, labels) ** 2)
+
+    def r_loss(lg):
+        return jnp.sum(ref(lg, labels) ** 2)
+
+    got = jax.grad(f_loss)(logits)
+    want = jax.grad(r_loss)(logits)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
