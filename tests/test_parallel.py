@@ -208,3 +208,23 @@ def test_bert_mlm_bucket_under_data_parallel():
                      convert_to_numpy_ret_vals=True)
         losses.append(float(out[0]))
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ce_under_megatron_mesh():
+    # the Pallas fused CE (vocab >= 1024) must survive GSPMD dp x tp
+    # sharding (jax replicates the pallas operands; numerics intact)
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.parallel import MegatronLM
+    rng = np.random.default_rng(0)
+    B, S, V = 8, 16, 2048
+    c = GPTConfig(vocab_size=V, hidden_size=32, num_layers=2, num_heads=4,
+                  seq_len=S, dropout_prob=0.0)
+    ids = ht.placeholder_op("fce_ids", (B, S), dtype=np.int32)
+    labels = ht.placeholder_op("fce_labels", (B, S), dtype=np.int32)
+    loss = GPTLMHeadModel(c, name="fcegpt").loss(ids, labels)
+    ex = ht.Executor([loss, ht.AdamOptimizer(1e-3).minimize(loss)],
+                     dist_strategy=MegatronLM(dp=2, tp=4))
+    ids_v = rng.integers(0, V, (B, S))
+    out = ex.run(feed_dict={ids: ids_v, labels: np.roll(ids_v, -1, 1)},
+                 convert_to_numpy_ret_vals=True)
+    assert np.isfinite(out[0])
