@@ -1,0 +1,169 @@
+"""Classic model zoo: the reference's remaining examples/cnn models.
+
+Reference: examples/cnn/models/{LogReg,CNN,AlexNet,VGG,RNN,LSTM}.py.
+Conv stacks reuse the layer library; the recurrent models ride the
+scan-based ops (ops/rnn.py) instead of the reference's 28-step unrolled
+graphs.  All default to the reference's MNIST/CIFAR shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import VariableOp, scoped_init
+from .. import initializers as init
+from ..layers import Linear, Conv2d, BatchNorm, MaxPool2d, Relu, Sequence
+from ..ops import relu_op, array_reshape_op, max_pool2d_op
+from ..ops.base import SimpleOp
+from ..ops.rnn import rnn_op, lstm_op
+
+
+def _last_step(hs):
+    # [N, T, H] -> [N, H] (the classifier reads the final hidden state)
+    return SimpleOp(lambda h: h[:, -1, :], "last_step", hs)
+
+
+class LogReg:
+    """Logistic regression (reference LogReg.py)."""
+
+    @scoped_init
+    def __init__(self, in_dim=784, num_classes=10, name="logreg"):
+        self.fc = Linear(in_dim, num_classes, name=f"{name}_fc")
+
+    def __call__(self, x):
+        return self.fc(x)
+
+
+class CNN3:
+    """The reference's plain 3-conv "CNN" (CNN.py), MNIST shapes."""
+
+    @scoped_init
+    def __init__(self, in_channels=1, num_classes=10, name="cnn"):
+        self.c1 = Conv2d(in_channels, 32, 5, padding=2, name=f"{name}_c1")
+        self.c2 = Conv2d(32, 64, 5, padding=2, name=f"{name}_c2")
+        self.fc = Linear(7 * 7 * 64, num_classes, name=f"{name}_fc")
+
+    def __call__(self, x):
+        x = max_pool2d_op(relu_op(self.c1(x)), kernel_H=2, kernel_W=2,
+                          stride=2)
+        x = max_pool2d_op(relu_op(self.c2(x)), kernel_H=2, kernel_W=2,
+                          stride=2)
+        x = array_reshape_op(x, output_shape=(-1, 7 * 7 * 64))
+        return self.fc(x)
+
+
+class AlexNet:
+    """AlexNet for 28x28 inputs (reference AlexNet.py's MNIST variant)."""
+
+    @scoped_init
+    def __init__(self, in_channels=1, num_classes=10, name="alexnet"):
+        n = name
+        self.features = []
+        chans = [(in_channels, 32, True), (32, 64, True), (64, 128, False),
+                 (128, 256, False), (256, 256, True)]
+        for i, (ci, co, pool) in enumerate(chans):
+            self.features.append((Conv2d(ci, co, 3, padding=1,
+                                         name=f"{n}_conv{i}"),
+                                  BatchNorm(co, name=f"{n}_bn{i}"), pool))
+        self.fc1 = Linear(256 * 3 * 3, 1024, name=f"{n}_fc1")
+        self.fc2 = Linear(1024, 512, name=f"{n}_fc2")
+        self.fc3 = Linear(512, num_classes, name=f"{n}_fc3")
+
+    def __call__(self, x):
+        for conv, bn, pool in self.features:
+            x = relu_op(bn(conv(x)))
+            if pool:
+                x = max_pool2d_op(x, kernel_H=2, kernel_W=2, stride=2)
+        x = array_reshape_op(x, output_shape=(-1, 256 * 3 * 3))
+        x = relu_op(self.fc1(x))
+        x = relu_op(self.fc2(x))
+        return self.fc3(x)
+
+
+_VGG_PLANS = {
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+class VGG:
+    """VGG-16/19 with BN (reference VGG.py), CIFAR 32x32 inputs."""
+
+    @scoped_init
+    def __init__(self, depth=16, in_channels=3, num_classes=10, name=None):
+        name = name or f"vgg{depth}"
+        plan = _VGG_PLANS[depth]
+        chans = (64, 128, 256, 512, 512)
+        self.blocks = []
+        ci = in_channels
+        for b, (n_layers, co) in enumerate(zip(plan, chans)):
+            layers = []
+            for l in range(n_layers):
+                layers.append((Conv2d(ci, co, 3, padding=1,
+                                      name=f"{name}_b{b}c{l}"),
+                               BatchNorm(co, name=f"{name}_b{b}bn{l}")))
+                ci = co
+            self.blocks.append(layers)
+        self.fc1 = Linear(512, 4096, name=f"{name}_fc1")
+        self.fc2 = Linear(4096, 4096, name=f"{name}_fc2")
+        self.fc3 = Linear(4096, num_classes, name=f"{name}_fc3")
+
+    def __call__(self, x):
+        for layers in self.blocks:
+            for conv, bn in layers:
+                x = relu_op(bn(conv(x)))
+            x = max_pool2d_op(x, kernel_H=2, kernel_W=2, stride=2)
+        x = array_reshape_op(x, output_shape=(-1, 512))
+        x = relu_op(self.fc1(x))
+        x = relu_op(self.fc2(x))
+        return self.fc3(x)
+
+
+def vgg16(num_classes=10):
+    return VGG(16, num_classes=num_classes)
+
+
+def vgg19(num_classes=10):
+    return VGG(19, num_classes=num_classes)
+
+
+class RNNClassifier:
+    """Elman RNN over rows of a 28x28 image (reference RNN.py)."""
+
+    @scoped_init
+    def __init__(self, dim_in=28, dim_hidden=128, num_classes=10,
+                 name="rnn"):
+        std = init.normal(stddev=0.1)
+        self.w_x = VariableOp(f"{name}_wx", (dim_in, dim_hidden), std)
+        self.w_h = VariableOp(f"{name}_wh", (dim_hidden, dim_hidden), std)
+        self.b = VariableOp(f"{name}_b", (dim_hidden,), init.zeros())
+        self.head = Linear(dim_hidden, num_classes, name=f"{name}_out")
+        self.dims = (dim_in, dim_hidden)
+
+    def __call__(self, x):
+        """x: [N, T, dim_in] (feed MNIST as [N, 28, 28])."""
+        hs = rnn_op(x, self.w_x, self.w_h, self.b)
+        return self.head(_last_step(hs))
+
+
+class LSTMClassifier:
+    """LSTM over rows of a 28x28 image (reference LSTM.py); torch-packed
+    gates so torch.nn.LSTM weights transfer directly."""
+
+    @scoped_init
+    def __init__(self, dim_in=28, dim_hidden=128, num_classes=10,
+                 name="lstm"):
+        std = init.normal(stddev=0.1)
+        self.w_ih = VariableOp(f"{name}_wih", (4 * dim_hidden, dim_in), std)
+        self.w_hh = VariableOp(f"{name}_whh", (4 * dim_hidden, dim_hidden),
+                               std)
+        self.b_ih = VariableOp(f"{name}_bih", (4 * dim_hidden,),
+                               init.zeros())
+        self.b_hh = VariableOp(f"{name}_bhh", (4 * dim_hidden,),
+                               init.zeros())
+        self.head = Linear(dim_hidden, num_classes, name=f"{name}_out")
+        self.dims = (dim_in, dim_hidden)
+
+    def __call__(self, x):
+        hs = lstm_op(x, self.w_ih, self.w_hh, self.b_ih, self.b_hh)
+        return self.head(_last_step(hs))

@@ -68,7 +68,9 @@ def _pool(x, kernel_H, kernel_W, padding=0, stride=1, mode="max"):
     strides = (1, 1, sh, sw)
     pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
     if mode == "max":
-        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        # -inf init (not finfo.min): jax only attaches the max-pool VJP
+        # rule when the reduction is recognizably reduce-window-max
+        neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
             else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, neg, lax.max, window, strides, pads)
     s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)

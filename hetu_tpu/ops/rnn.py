@@ -1,0 +1,78 @@
+"""Recurrent ops: Elman RNN and LSTM as single scan ops.
+
+Reference: examples/cnn/models/RNN.py / LSTM.py build the recurrence by
+UNROLLING graph ops per timestep (28 slice/concat/matmul nodes for MNIST).
+On TPU that defeats the compiler — the idiomatic form is ONE op whose
+``_compute`` runs `lax.scan` over time: XLA sees a fori-style loop with a
+fused cell body, autodiff scans backward for free, and sequence length is
+static only in the scan bound (no per-step graph blowup).
+
+Gate packing follows torch.nn.LSTM ([i, f, g, o] rows of w_ih/w_hh) so
+weights transfer 1:1 (pinned by tests/test_models.py torch parity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..graph.node import Op
+
+
+class RNNOp(Op):
+    """Elman RNN over x [N, T, D]: h_t = tanh(x_t @ w_x + h_{t-1} @ w_h + b).
+
+    Returns the full hidden sequence [N, T, H] (slice the last step for a
+    classifier head).
+    """
+
+    def _compute(self, input_vals, ctx):
+        x, w_x, w_h, b = input_vals
+
+        def cell(h, x_t):
+            h = jnp.tanh(x_t @ w_x + h @ w_h + b)
+            return h, h
+
+        n = x.shape[0]
+        h0 = jnp.zeros((n, w_h.shape[0]), x.dtype)
+        _, hs = lax.scan(cell, h0, jnp.swapaxes(x, 0, 1))
+        return jnp.swapaxes(hs, 0, 1)
+
+
+def rnn_op(x, w_x, w_h, b, name=None):
+    return RNNOp(x, w_x, w_h, b, name=name)
+
+
+class LSTMOp(Op):
+    """LSTM over x [N, T, D] with torch-packed gates.
+
+    w_ih: [4H, D], w_hh: [4H, H], b_ih/b_hh: [4H] in [i, f, g, o] order
+    (exactly torch.nn.LSTM's layout).  Returns hidden sequence [N, T, H].
+    """
+
+    def _compute(self, input_vals, ctx):
+        x, w_ih, w_hh, b_ih, b_hh = input_vals
+        hdim = w_hh.shape[1]
+
+        def cell(carry, x_t):
+            h, c = carry
+            z = x_t @ w_ih.T + h @ w_hh.T + b_ih + b_hh       # [N, 4H]
+            i, f, g, o = (z[:, :hdim], z[:, hdim:2 * hdim],
+                          z[:, 2 * hdim:3 * hdim], z[:, 3 * hdim:])
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        n = x.shape[0]
+        h0 = jnp.zeros((n, hdim), x.dtype)
+        (_, _), hs = lax.scan(cell, (h0, h0), jnp.swapaxes(x, 0, 1))
+        return jnp.swapaxes(hs, 0, 1)
+
+
+def lstm_op(x, w_ih, w_hh, b_ih, b_hh, name=None):
+    return LSTMOp(x, w_ih, w_hh, b_ih, b_hh, name=name)

@@ -183,3 +183,66 @@ def test_bert_mlm_bucket_matches_dense_loss():
                      convert_to_numpy_ret_vals=True)
         losses.append(float(out[0]))
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5, atol=1e-6)
+
+
+def test_zoo_models_train():
+    # the reference's remaining examples/cnn zoo: forward shapes + one
+    # optimizer step decreasing loss on a separable toy problem
+    from hetu_tpu.models import (LogReg, CNN3, AlexNet, vgg16,
+                                 RNNClassifier, LSTMClassifier)
+    rng = np.random.default_rng(0)
+
+    cases = [
+        (LogReg(), (8, 784)),
+        (CNN3(), (4, 1, 28, 28)),
+        (AlexNet(), (2, 1, 28, 28)),
+        (vgg16(), (2, 3, 32, 32)),
+        (RNNClassifier(), (4, 28, 28)),
+        (LSTMClassifier(), (4, 28, 28)),
+    ]
+    for model, shape in cases:
+        X = rng.standard_normal(shape).astype(np.float32)
+        Y = rng.integers(0, 10, shape[0])
+        x = ht.placeholder_op(f"zoo_x_{type(model).__name__}", shape)
+        y = ht.placeholder_op(f"zoo_y_{type(model).__name__}", (shape[0],),
+                              dtype=np.int32)
+        loss = ht.reduce_mean_op(
+            ht.softmax_cross_entropy_sparse_op(model(x), y))
+        ex = ht.Executor(
+            {"train": [loss, ht.AdamOptimizer(1e-3).minimize(loss)]})
+        l0 = float(ex.run("train", feed_dict={x: X, y: Y},
+                          convert_to_numpy_ret_vals=True)[0])
+        for _ in range(8):
+            l1 = float(ex.run("train", feed_dict={x: X, y: Y},
+                              convert_to_numpy_ret_vals=True)[0])
+        assert np.isfinite(l1) and l1 < l0, \
+            f"{type(model).__name__}: {l0} -> {l1}"
+
+
+def test_lstm_matches_torch():
+    # gate packing follows torch.nn.LSTM: copied weights => same outputs
+    import torch
+    from hetu_tpu.models import LSTMClassifier
+    rng = np.random.default_rng(1)
+    N, T, D, H = 3, 7, 28, 16
+    model = LSTMClassifier(dim_in=D, dim_hidden=H, name="lstmp")
+    x = ht.placeholder_op("lp_x", (N, T, D))
+    from hetu_tpu.ops.rnn import lstm_op
+    hs = lstm_op(x, model.w_ih, model.w_hh, model.b_ih, model.b_hh)
+    ex = ht.Executor([hs])
+
+    tl = torch.nn.LSTM(D, H, batch_first=True)
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.from_numpy(
+            np.asarray(ex.params[model.w_ih.name])))
+        tl.weight_hh_l0.copy_(torch.from_numpy(
+            np.asarray(ex.params[model.w_hh.name])))
+        tl.bias_ih_l0.copy_(torch.from_numpy(
+            np.asarray(ex.params[model.b_ih.name])))
+        tl.bias_hh_l0.copy_(torch.from_numpy(
+            np.asarray(ex.params[model.b_hh.name])))
+    X = rng.standard_normal((N, T, D)).astype(np.float32)
+    (got,) = ex.run(feed_dict={x: X}, convert_to_numpy_ret_vals=True)
+    with torch.no_grad():
+        want, _ = tl(torch.from_numpy(X))
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-5)
