@@ -20,19 +20,19 @@ import numpy as np
 import hetu_tpu as ht
 from hetu_tpu import models as M
 
-# model -> (constructor, input shape (per sample), flatten to 2d?)
+# model -> (constructor, per-sample input shape)
 ZOO = {
-    "mlp": (lambda: M.MLP(), (784,)),
-    "logreg": (lambda: M.LogReg(), (784,)),
-    "cnn": (lambda: M.CNN3(), (1, 28, 28)),
-    "lenet": (lambda: M.LeNet(), (1, 28, 28)),
-    "alexnet": (lambda: M.AlexNet(), (1, 28, 28)),
-    "vgg16": (lambda: M.vgg16(), (3, 32, 32)),
-    "vgg19": (lambda: M.vgg19(), (3, 32, 32)),
-    "resnet18": (lambda: M.resnet18(), (3, 32, 32)),
-    "resnet34": (lambda: M.resnet34(), (3, 32, 32)),
-    "rnn": (lambda: M.RNNClassifier(), (28, 28)),
-    "lstm": (lambda: M.LSTMClassifier(), (28, 28)),
+    "mlp": (M.MLP, (784,)),
+    "logreg": (M.LogReg, (784,)),
+    "cnn": (M.CNN3, (1, 28, 28)),
+    "lenet": (M.LeNet, (1, 28, 28)),
+    "alexnet": (M.AlexNet, (1, 28, 28)),
+    "vgg16": (M.vgg16, (3, 32, 32)),
+    "vgg19": (M.vgg19, (3, 32, 32)),
+    "resnet18": (M.resnet18, (3, 32, 32)),
+    "resnet34": (M.resnet34, (3, 32, 32)),
+    "rnn": (M.RNNClassifier, (28, 28)),
+    "lstm": (M.LSTMClassifier, (28, 28)),
 }
 
 
@@ -52,15 +52,7 @@ def main():
     x = ht.placeholder_op("images", (B,) + sample_shape)
     y = ht.placeholder_op("labels", (B,), dtype=np.int32)
     model = build()
-    if args.model == "mlp":
-        h = x
-        for i, lin in enumerate(model.linears):
-            h = lin(h)
-            if i < len(model.linears) - 1:
-                h = ht.relu_op(h)
-        logits = h
-    else:
-        logits = model(x)
+    logits = model(x)
     loss = ht.reduce_mean_op(ht.softmax_cross_entropy_sparse_op(logits, y))
     opt = {"sgd": lambda: ht.SGDOptimizer(args.lr),
            "momentum": lambda: ht.MomentumOptimizer(args.lr, momentum=0.9),
