@@ -390,9 +390,11 @@ class Executor:
                                  v.shape, jnp.dtype(v.dtype)))
 
         self.opt_state = {}
+        self._opt_ops = {}  # name -> op, in graph (construction) order
         for n in self.all_topo:
             if n.is_stateful and hasattr(n, "init_state"):
                 self.opt_state[n.name] = n.init_state(self.params)
+                self._opt_ops[n.name] = n
 
         if "pipeline" in self.config:
             # graph-driven pipeline over inhomogeneous stages (raw_ctx /
@@ -465,7 +467,13 @@ class Executor:
     def state_dict(self):
         host = jax.tree_util.tree_map(np.asarray, self.params)
         opt = jax.tree_util.tree_map(np.asarray, self.opt_state)
-        return {"params": host, "opt_state": opt,
+        # kept outside opt_state so the jitted step never sees string
+        # leaves; load_state_dict uses it to pair optimizer instances by
+        # construction order + class instead of by sorted-name luck
+        meta = {name: {"class": type(op.optimizer).__name__, "order": i}
+                for i, (name, op) in enumerate(self._opt_ops.items())
+                if hasattr(op, "optimizer")}
+        return {"params": host, "opt_state": opt, "opt_meta": meta,
                 "global_step": self._global_step,
                 "base_key": np.asarray(jax.random.key_data(self._base_key))}
 
@@ -489,16 +497,50 @@ class Executor:
                 and len(saved_opt) == len(self.opt_state)):
             # optimizer-op names carry a process-wide counter (a second
             # optimizer instance in the same process gets `optimizer_2`);
-            # remap by construction order, validated against slot structure
+            # remap by construction order.  Slot variable-name sets alone
+            # can't disambiguate two optimizers over the same variables
+            # (same vars under different hyperparams), so also pair by the
+            # checkpoint's recorded construction order + class when
+            # available, and refuse a pairing order can't resolve.
+            meta = state.get("opt_meta")
+            if meta is not None and set(meta) == set(saved_opt):
+                # construction order on BOTH sides
+                sv_order = sorted(saved_opt, key=lambda n: meta[n]["order"])
+                cur_order = list(self._opt_ops)
+            else:
+                # legacy checkpoint: pair sorted-vs-sorted (the old
+                # behavior — consistent on both sides, unlike zipping
+                # construction order against sorted names, which
+                # mispairs once 'optimizer_10' sorts before
+                # 'optimizer_2')
+                sv_order = sorted(saved_opt)
+                cur_order = sorted(self.opt_state)
+                slot_sets = [frozenset(s.get("slots", {}))
+                             for s in self.opt_state.values()]
+                if len(set(slot_sets)) != len(slot_sets):
+                    raise ValueError(
+                        "checkpoint has no optimizer construction-order "
+                        "metadata and this graph has multiple optimizers "
+                        "over identical variable sets — the pairing is "
+                        "ambiguous; re-save the checkpoint with this "
+                        "version or load opt state manually")
             remap = {}
-            for cur_name, sv_name in zip(sorted(self.opt_state),
-                                         sorted(saved_opt)):
+            for cur_name, sv_name in zip(cur_order, sv_order):
                 cur, sv = self.opt_state[cur_name], saved_opt[sv_name]
                 if set(cur.get("slots", {})) != set(sv.get("slots", {})):
                     raise ValueError(
                         f"checkpoint optimizer state {sv_name!r} does not "
                         f"match this graph's {cur_name!r} (different "
                         "variable sets)")
+                if meta is not None and sv_name in meta:
+                    cur_op = self._opt_ops[cur_name]
+                    cur_cls = type(getattr(cur_op, "optimizer",
+                                           cur_op)).__name__
+                    if meta[sv_name]["class"] != cur_cls:
+                        raise ValueError(
+                            f"checkpoint optimizer {sv_name!r} is a "
+                            f"{meta[sv_name]['class']} but this graph's "
+                            f"{cur_name!r} is a {cur_cls}")
                 remap[cur_name] = sv
             saved_opt = remap
         self.opt_state = jax.tree_util.tree_map(jnp.asarray, saved_opt)

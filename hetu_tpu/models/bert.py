@@ -24,7 +24,8 @@ class BertConfig:
                  num_hidden_layers=12, num_attention_heads=12,
                  intermediate_size=3072, max_position_embeddings=512,
                  type_vocab_size=2, hidden_dropout_prob=0.1,
-                 attention_probs_dropout_prob=0.1, seq_len=128):
+                 attention_probs_dropout_prob=0.1, seq_len=128,
+                 mlm_bucket_frac=0.25):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -35,6 +36,13 @@ class BertConfig:
         self.hidden_dropout_prob = hidden_dropout_prob
         self.attention_probs_dropout_prob = attention_probs_dropout_prob
         self.seq_len = seq_len
+        # Fraction of tokens the MLM head's masked-position bucket holds.
+        # Must exceed the masking rate (0.25 covers the standard 15%
+        # recipe); batches that mask more positions than the bucket trip a
+        # runtime overflow warning in MaskedSelectLabelsOp and the excess
+        # tokens are excluded from the loss.  None = dense full-position
+        # head (use for span/40% masking recipes).
+        self.mlm_bucket_frac = mlm_bucket_frac
 
 
 class AttentionMaskOp(Op):
@@ -162,7 +170,7 @@ class BertForPreTraining:
         c = self.config
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
         flat = array_reshape_op(seq, output_shape=(-1, c.hidden_size))
-        frac = getattr(c, "mlm_bucket_frac", 0.25)
+        frac = c.mlm_bucket_frac
         n_tokens = None
         shape = getattr(mlm_labels, "shape", None)
         if frac is not None and shape is not None and shape[0] is not None:
@@ -210,14 +218,36 @@ class MaskedSelectLabelsOp(Op):
     def __init__(self, labels, bucket, name=None):
         super().__init__(labels, name=name)
         self.bucket = int(bucket)
+        # probe at CONSTRUCTION (eager host Python): by _compute time the
+        # graph is being traced, where the probe cannot run for real
+        from ..platform import host_callbacks_supported
+        self._warn_overflow = host_callbacks_supported()
 
     def _compute(self, input_vals, ctx):
+        import jax
         import jax.numpy as jnp
         (labels,) = input_vals
         labels = labels.reshape(-1)
         valid = labels >= 0
+        n_valid = jnp.sum(valid)
+        # Overflowed masked positions are dropped from the loss; that is a
+        # silent objective change, so surface it.  The false branch of the
+        # cond is a no-op, so the callback costs nothing unless a batch
+        # actually masks more than the bucket.  Backends without host
+        # callbacks (axon dev-tunnel PJRT) skip the check rather than
+        # crashing every MLM step.
+        if self._warn_overflow:
+            jax.lax.cond(
+                n_valid > self.bucket,
+                lambda n: jax.debug.print(
+                    "hetu_tpu: MLM bucket overflow — {n} masked positions "
+                    "> bucket {b}; excess tokens excluded from the loss.  "
+                    "Raise BertConfig.mlm_bucket_frac or set it to None.",
+                    n=n, b=self.bucket),
+                lambda n: None,
+                n_valid)
         (pos,) = jnp.nonzero(valid, size=self.bucket, fill_value=0)
-        live = jnp.arange(self.bucket) < jnp.sum(valid)
+        live = jnp.arange(self.bucket) < n_valid
         return jnp.where(live, labels[pos], -1)
 
 

@@ -62,7 +62,12 @@ class ScaledDotProductAttentionOp(Op):
         if (ctx.mesh is not None and "cp" in ctx.mesh.shape
                 and ctx.mesh.shape["cp"] > 1 and mask is None
                 and self.dropout_keep >= 1.0 and q.ndim == 4
-                and q.shape == k.shape == v.shape):
+                and q.shape == k.shape == v.shape
+                # shard_map dies opaquely on indivisible shapes — route
+                # those to the flash/jnp paths below instead
+                and q.shape[2] % ctx.mesh.shape["cp"] == 0
+                and ("dp" not in ctx.mesh.shape
+                     or q.shape[0] % ctx.mesh.shape["dp"] == 0)):
             from ..parallel.context_parallel import ring_attention
             return ring_attention(ctx.mesh, q, k, v, causal=self.causal,
                                   scale=scale)

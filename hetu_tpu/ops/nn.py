@@ -156,12 +156,19 @@ class BatchNormOp(Op):
             # masters (bf16 bindings would re-quantize them every step and
             # round small momentum updates away)
             xf = x.astype(jnp.float32)
-            # one-pass stats (E[x^2] - E[x]^2): x is read once for both
-            # reductions, halving the stats traffic vs jnp.var's
-            # mean-then-deviations form
-            mean = jnp.mean(xf, axis=(0, 2, 3))
-            mean2 = jnp.mean(jnp.square(xf), axis=(0, 2, 3))
-            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            # shifted one-pass stats: x is read once for both reductions
+            # (half the stats traffic of jnp.var's mean-then-deviations
+            # form), but deviations are taken against a per-channel shift
+            # (the first element) before squaring — the raw E[x^2]-E[x]^2
+            # form cancels catastrophically in f32 when |mean| >> std.
+            # mean and var are mathematically independent of the shift, so
+            # stop_gradient keeps the backward pass exact.
+            s = lax.stop_gradient(xf[:1, :, :1, :1])
+            d = xf - s
+            dmean = jnp.mean(d, axis=(0, 2, 3))
+            d2mean = jnp.mean(jnp.square(d), axis=(0, 2, 3))
+            var = jnp.maximum(d2mean - jnp.square(dmean), 0.0)
+            mean = s.reshape(-1) + dmean
             m = self.momentum
             master = ctx.master_params
             rm = (master[self.running_mean.name]

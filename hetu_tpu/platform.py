@@ -1,0 +1,39 @@
+"""Platform capability probes.
+
+Some PJRT plugins (notably the axon dev-tunnel used for single-chip TPU
+access) implement the compute path but not host send/recv callbacks
+(jax.debug.print / io_callback / pure_callback).  Backend NAME checks
+can't detect this — the tunnel reports platform "tpu" — so capabilities
+are feature-probed once per process and cached.
+"""
+
+from __future__ import annotations
+
+_HOST_CALLBACKS = None
+
+
+def host_callbacks_supported() -> bool:
+    """True iff jitted host callbacks (jax.debug.print et al) execute on
+    the default backend.  Probes with a trivial jitted program once and
+    caches the verdict for the process lifetime."""
+    global _HOST_CALLBACKS
+    if _HOST_CALLBACKS is None:
+        import jax
+        import jax.numpy as jnp
+        try:
+            if not jax.core.trace_state_clean():
+                # called mid-trace with no cached verdict: a jit probe
+                # here would STAGE into the enclosing program
+                # (omnistaging) and "succeed" while smuggling the
+                # callback into the caller's compiled program.  Answer
+                # conservatively and leave the cache unset so an eager
+                # call can still establish the real verdict.
+                return False
+            jax.block_until_ready(jax.jit(
+                lambda x: (jax.debug.print("", ordered=False), x)[1]
+            )(jnp.zeros(())))
+            jax.effects_barrier()
+            _HOST_CALLBACKS = True
+        except Exception:
+            _HOST_CALLBACKS = False
+    return _HOST_CALLBACKS
