@@ -1,0 +1,86 @@
+"""Searched hybrid-parallel Llama training (reference:
+tools/Hetu-Galvatron/galvatron/models/llama/train_dist.py — search a
+per-layer (tp, dp-type, ckpt) x pipeline config, then train under it).
+
+Profiles a Llama layer stack, runs the Galvatron search, builds the
+LlamaHPLayer model under the searched config (RoPE/GQA/SwiGLU per-layer
+TP x DP/FSDP, searched pipeline schedule), and runs a few training steps.
+
+Usage (8 virtual devices):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/auto_parallel/llama_hybrid.py --preset tiny
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import jax
+
+# a pre-registered accelerator plugin (axon sitecustomize) wins over the
+# JAX_PLATFORMS env var; force the choice through config like
+# tests/conftest.py does
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+from hetu_tpu.galvatron import (GalvatronSearch, HybridParallelModel,
+                                LayerProfile, LlamaHPLayer)
+
+PRESETS = {
+    # hidden, layers, heads, kv_heads, ffn  (tiny = CI-sized)
+    "tiny": (32, 4, 4, 2, 64),
+    "llama-7b-ish": (4096, 32, 32, 32, 11008),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
+    ap.add_argument("--world", type=int, default=None)
+    ap.add_argument("--mem-gb", type=float, default=16.0)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+
+    h, n_layers, heads, kv_heads, ffn = PRESETS[args.preset]
+    world = args.world or len(jax.devices())
+
+    # 1. profile (analytic; swap in profiler.py measurements for real runs)
+    per_layer_params = 4 * h * h + 3 * h * ffn
+    act_bytes = 10 * args.seq_len * h * 2
+    layers = [LayerProfile(2.0, per_layer_params * 4, act_bytes)
+              for _ in range(n_layers)]
+
+    # 2. search
+    cfg = GalvatronSearch(world, args.mem_gb * (1 << 30),
+                          micro_bsz=2).search(layers)
+    print("searched config:", cfg.to_json())
+
+    # 3. build + train under the searched config
+    specs = [LlamaHPLayer(hidden=h, heads=heads, kv_heads=kv_heads, ffn=ffn)
+             for _ in range(n_layers)]
+    model = HybridParallelModel(specs, cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    step, opt_init = model.make_train_step(lr=1e-2)
+    opt_state = opt_init(params)
+
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (args.batch, args.seq_len, h), jnp.float32)
+    tgt = jax.random.normal(jax.random.PRNGKey(2),
+                            (args.batch, args.seq_len, h)) * 0.1
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, x, tgt)
+        print(f"step {i} loss {float(loss):.5f} "
+              f"(schedule={cfg.pipeline_type}, pp={cfg.pp_deg}, "
+              f"tp={cfg.tp_sizes[0]})")
+
+
+if __name__ == "__main__":
+    main()

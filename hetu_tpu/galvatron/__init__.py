@@ -10,12 +10,12 @@ from .search import (CostModel, GalvatronSearch, LayerProfile, Strategy,
                      load_profile, profile_layers_analytic, save_profile,
                      strategy_space)
 from .runtime import (HybridParallelModel, LayerShardings,
-                      TransformerHPLayer, build_mesh)
+                      TransformerHPLayer, LlamaHPLayer, build_mesh)
 
 __all__ = [
     "dp_core", "dp_core_numpy", "HybridParallelConfig", "layer_mesh_axes",
     "tp_dp_axes", "CostModel", "GalvatronSearch", "LayerProfile", "Strategy",
     "load_profile", "profile_layers_analytic", "save_profile",
     "strategy_space", "HybridParallelModel", "LayerShardings",
-    "TransformerHPLayer", "build_mesh",
+    "TransformerHPLayer", "LlamaHPLayer", "build_mesh",
 ]

@@ -132,9 +132,12 @@ class TransformerHPLayer:
                "ln1": (None, None), "ln2": (None, None)}
 
     def param_specs(self, sh: LayerShardings):
+        # (None, None) marks the 1-D norm scales; everything else is a
+        # 2-D projection.  Shared by subclasses whose tp_dims follow the
+        # same convention (LlamaHPLayer).
         out = {}
         for name, (tp_dim, fsdp_dim) in self.tp_dims.items():
-            ndim = 1 if name.startswith("ln") else 2
+            ndim = 1 if (tp_dim, fsdp_dim) == (None, None) else 2
             out[name] = sh.param_spec(tp_dim if ndim > 1 else None, ndim,
                                       fsdp_dim if ndim > 1 else None)
         return out
@@ -193,6 +196,82 @@ class TransformerHPLayer:
         return sh.constrain(x)
 
 
+class LlamaHPLayer(TransformerHPLayer):
+    """A Llama decoder layer as an HP layer spec: RMSNorm, rotary q/k,
+    optional GQA, SwiGLU FFN — the reference's Llama/Baichuan Galvatron
+    tier (tools/Hetu-Galvatron/galvatron/models/llama/
+    LlamaModel_tensor_parallel.py) rebuilt on shardings instead of
+    Megatron process groups.  ``alibi=True`` gives the Baichuan-13B
+    position scheme instead of RoPE (models/baichuan/)."""
+
+    def __init__(self, hidden, heads, kv_heads=None, ffn=None,
+                 rope_theta=10000.0, alibi=False, dtype=jnp.float32):
+        self.hidden, self.heads = hidden, heads
+        self.kv_heads = kv_heads or heads
+        assert heads % self.kv_heads == 0
+        self.ffn = ffn or int(hidden * 8 / 3)
+        self.rope_theta = rope_theta
+        self.alibi = alibi
+        self.dtype = dtype
+
+    def init(self, key):
+        h, f = self.hidden, self.ffn
+        kvd = self.kv_heads * (h // self.heads)
+        ks = jax.random.split(key, 6)
+        s = 0.02
+        return {
+            "wq": jax.random.normal(ks[0], (h, h), self.dtype) * s,
+            "wkv": jax.random.normal(ks[1], (h, 2 * kvd), self.dtype) * s,
+            "wo": jax.random.normal(ks[2], (h, h), self.dtype) * s,
+            "wgate": jax.random.normal(ks[3], (h, f), self.dtype) * s,
+            "wup": jax.random.normal(ks[4], (h, f), self.dtype) * s,
+            "wdown": jax.random.normal(ks[5], (f, h), self.dtype) * s,
+            "rms1": jnp.ones((h,), self.dtype),
+            "rms2": jnp.ones((h,), self.dtype),
+        }
+
+    tp_dims = {"wq": (1, 0), "wkv": (1, 0), "wo": (0, 1),
+               "wgate": (1, 0), "wup": (1, 0), "wdown": (0, 1),
+               "rms1": (None, None), "rms2": (None, None)}
+
+    def _rms(self, x, g):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), -1, keepdims=True)
+        return (xf * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype) * g
+
+    def apply(self, params, x, sh: LayerShardings):
+        from ..ops.rotary import _rotary, _repeat_kv, _alibi_bias
+        b, t, h = x.shape
+        nh, kvh = self.heads, self.kv_heads
+        hd = h // nh
+        y = self._rms(x, params["rms1"])
+        q = (y @ params["wq"]).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        kv = y @ params["wkv"]                        # column-parallel
+        k, v = jnp.split(kv, 2, axis=-1)
+        k = k.reshape(b, t, kvh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, kvh, hd).transpose(0, 2, 1, 3)
+        if not self.alibi:
+            q = _rotary(q, theta=self.rope_theta)
+            k = _rotary(k, theta=self.rope_theta)
+        if kvh != nh:
+            k = _repeat_kv(k, n_rep=nh // kvh)
+            v = _repeat_kv(v, n_rep=nh // kvh)
+        if self.alibi:
+            bias = _alibi_bias(q, num_heads=nh)
+            a = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd) + bias
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            a = jax.nn.softmax(jnp.where(mask, a, -1e9), axis=-1)
+            o = (a @ v).astype(v.dtype)
+        else:
+            o = self._attend(q, k, v, sh)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, h).astype(x.dtype)
+        x = x + sh.constrain(o @ params["wo"])        # row-parallel + psum
+        y = self._rms(x, params["rms2"])
+        y = jax.nn.silu(y @ params["wgate"]) * (y @ params["wup"])
+        x = x + sh.constrain(y @ params["wdown"])     # row-parallel + psum
+        return sh.constrain(x)
+
+
 class HybridParallelModel:
     """Applies a searched HybridParallelConfig to a stack of HP layers.
 
@@ -202,12 +281,12 @@ class HybridParallelModel:
     pp_deg>1: the searched ``pp_division`` is HONORED — layers partition
     into stages, each stage compiles its own forward and rematerializing
     backward over its pp-slice submesh (per-layer TP×DP/FSDP shardings
-    intact inside the stage), and a host scheduler drives the GPipe flush
-    schedule over ``chunks`` micro-batches, transferring boundary
-    activations/cotangents between stage device sets (the reference's
-    pipeline/pipeline.py:133/343 batched-p2p schedules).  JAX async
-    dispatch overlaps stage programs — chunk m can be in stage 1 while
-    chunk m+1 runs stage 0.
+    intact inside the stage), and a host scheduler drives the searched
+    ``config.pipeline_type`` schedule (gpipe or pipedream_flush/1F1B) over
+    ``chunks`` micro-batches, transferring boundary activations/cotangents
+    between stage device sets (the reference's pipeline/pipeline.py:133/343
+    batched-p2p schedules).  JAX async dispatch overlaps stage programs —
+    chunk m can be in stage 1 while chunk m+1 runs stage 0.
     """
 
     def __init__(self, layer_specs, config: HybridParallelConfig,
@@ -438,33 +517,41 @@ class HybridParallelModel:
         return tl * inv, jax.tree_util.tree_map(lambda g: g * inv, tg)
 
     def _grads_pipelined(self, params, x, tgt, chunks):
+        """GPipe or pipedream-flush (1F1B) over ``chunks`` micro-batches,
+        selected by ``config.pipeline_type`` (the searched schedule,
+        reference pipeline/pipeline.py:133 pipedream_flush_forward_backward
+        vs :343 gpipe_forward_backward).
+
+        Both stash only boundary activations (stage inputs; intra-stage
+        activations recompute in the vjp backward).  GPipe keeps all
+        ``chunks`` of them live through the flush; pipedream-flush issues
+        each chunk's full backward chain as soon as its forward leaves the
+        last stage and frees that chunk's stash — at most ``pp`` chunks
+        live, which is exactly what search.py's memory model
+        (min(chunks, pp) live micro-batches) scores."""
         if self._stage_fwd is None:
             self._build_stage_programs()
         b = x.shape[0]
         assert b % chunks == 0, f"batch {b} not divisible by chunks {chunks}"
+        schedule = self.config.pipeline_type
         mb = b // chunks
         xs = [x[m * mb:(m + 1) * mb] for m in range(chunks)]
         ts = [tgt[m * mb:(m + 1) * mb] for m in range(chunks)]
         sparams = [[params[i] for i in idxs] for idxs in self.stage_layers]
 
-        # forward wavefront: stash only boundary activations (stage inputs);
-        # intra-stage activations recompute in the vjp backward (remat)
         stage_in = [[None] * self.pp for _ in range(chunks)]
-        order = sorted(((m, s) for m in range(chunks)
-                        for s in range(self.pp)),
-                       key=lambda t: (t[0] + t[1], t[1]))
-        for m, s in order:
-            src = xs[m] if s == 0 else stage_in[m][s]
-            xin = self._to_stage(src, s)   # ICI transfer between stages
-            stage_in[m][s] = xin
-            if s < self.pp - 1:
-                stage_in[m][s + 1] = self._stage_fwd[s](sparams[s], xin)
-
-        # backward: last stage seeds with d(mean over chunks)/dloss
+        # d(mean over chunks)/dloss seed; losses stay device-resident —
+        # a float() per chunk would sync the host mid-pipeline
         scale = jnp.asarray(1.0 / chunks, x.dtype)
         grad_acc = [None] * self.pp
         losses = []
-        for m in reversed(range(chunks)):
+        self._live_chunks_hwm = 0
+
+        def note_live():
+            live = sum(any(a is not None for a in sl) for sl in stage_in)
+            self._live_chunks_hwm = max(self._live_chunks_hwm, live)
+
+        def backward(m):
             tgt_m = self._to_stage(ts[m], self.pp - 1) \
                 if ts[m].ndim else ts[m]
             loss_m, gp, ct = self._stage_last_bwd(
@@ -477,13 +564,37 @@ class HybridParallelModel:
                 gp, ct = self._stage_bwd[s](sparams[s], stage_in[m][s], ct)
                 grad_acc[s] = gp if grad_acc[s] is None else \
                     jax.tree_util.tree_map(jnp.add, grad_acc[s], gp)
+            stage_in[m] = [None] * self.pp   # chunk m's stash is consumed
 
-        loss = sum(float(l) for l in losses) / chunks
+        # forward wavefront: (chunk+stage) diagonal issue order; JAX async
+        # dispatch overlaps stage programs across their device sets
+        order = sorted(((m, s) for m in range(chunks)
+                        for s in range(self.pp)),
+                       key=lambda t: (t[0] + t[1], t[1]))
+        for m, s in order:
+            src = xs[m] if s == 0 else stage_in[m][s]
+            xin = self._to_stage(src, s)   # ICI transfer between stages
+            stage_in[m][s] = xin
+            if s < self.pp - 1:
+                stage_in[m][s + 1] = self._stage_fwd[s](sparams[s], xin)
+                note_live()
+            elif schedule == "pipedream_flush":
+                backward(m)
+                note_live()
+            else:
+                note_live()
+        if schedule == "gpipe":
+            for m in reversed(range(chunks)):
+                backward(m)
+
+        loss = losses[0]
+        for l in losses[1:]:
+            loss = loss + l
         grads = [None] * self.config.n_layers
         for s, idxs in enumerate(self.stage_layers):
             for j, i in enumerate(idxs):
                 grads[i] = grad_acc[s][j]
-        return jnp.asarray(loss), grads
+        return loss * scale.astype(loss.dtype), grads
 
     def make_train_step(self, optimizer=None, lr=1e-3):
         """Returns (step_fn, opt_state_init).

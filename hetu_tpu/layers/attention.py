@@ -5,6 +5,13 @@ Reference: /root/reference/python/hetu/layers/attention.py MultiHeadAttention
 layer keeps the [B, S, H] layout end to end — projections are 3D matmuls XLA
 maps straight onto the MXU — and the core product is a single fused-attention
 op (ops/attention.py) lowered to Pallas flash attention on TPU.
+
+Position-encoding variants for the Llama/Baichuan model tier (reference
+tools/Hetu-Galvatron/galvatron/models/llama, models/baichuan): ``rope_theta``
+applies rotary embeddings to q/k before the attention product; ``alibi``
+adds the per-head linear bias instead; ``num_kv_heads`` < num_heads gives
+grouped-query attention (K/V projected to the smaller head count and
+broadcast back at the attention einsum).
 """
 
 from __future__ import annotations
@@ -13,37 +20,60 @@ from .base import BaseLayer, fresh_name
 from .common import Linear
 from ..ops import array_reshape_op, transpose_op
 from ..ops.attention import scaled_dot_product_attention_op
+from ..ops.rotary import rotary_embedding_op, repeat_kv_op, alibi_bias_op
 
 
 class MultiHeadAttention(BaseLayer):
     def __init__(self, hidden_size, num_heads, sequence_length=None,
-                 dropout_rate=0.0, causal_mask=False, name=None):
+                 dropout_rate=0.0, causal_mask=False, num_kv_heads=None,
+                 rope_theta=None, alibi=False, bias=True, name=None):
         assert hidden_size % num_heads == 0
         name = fresh_name(name or "attn")
         self.hidden_size = hidden_size
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        assert num_heads % self.num_kv_heads == 0
         self.head_dim = hidden_size // num_heads
         self.sequence_length = sequence_length
         self.dropout_keep = 1.0 - dropout_rate
         self.causal = causal_mask
-        self.q_proj = Linear(hidden_size, hidden_size, name=f"{name}_q")
-        self.k_proj = Linear(hidden_size, hidden_size, name=f"{name}_k")
-        self.v_proj = Linear(hidden_size, hidden_size, name=f"{name}_v")
-        self.out_proj = Linear(hidden_size, hidden_size, name=f"{name}_out")
+        self.rope_theta = rope_theta
+        self.alibi = alibi
+        assert not (alibi and rope_theta), "pick one position encoding"
+        kv_dim = self.num_kv_heads * self.head_dim
+        self.q_proj = Linear(hidden_size, hidden_size, bias=bias,
+                             name=f"{name}_q")
+        self.k_proj = Linear(hidden_size, kv_dim, bias=bias,
+                             name=f"{name}_k")
+        self.v_proj = Linear(hidden_size, kv_dim, bias=bias,
+                             name=f"{name}_v")
+        self.out_proj = Linear(hidden_size, hidden_size, bias=bias,
+                               name=f"{name}_out")
 
-    def _split_heads(self, x, seq_len):
+    def _split_heads(self, x, seq_len, n_heads):
         # [B, S, H] (or [B*S, H]) -> [B, heads, S, d]
         x = array_reshape_op(
-            x, output_shape=(-1, seq_len, self.num_heads, self.head_dim))
+            x, output_shape=(-1, seq_len, n_heads, self.head_dim))
         return transpose_op(x, perm=(0, 2, 1, 3))
 
     def __call__(self, query, key, value, attention_mask=None, seq_len=None):
         """Returns [B, S, H]."""
         seq_len = seq_len or self.sequence_length
         assert seq_len is not None, "sequence length required"
-        q = self._split_heads(self.q_proj(query), seq_len)
-        k = self._split_heads(self.k_proj(key), seq_len)
-        v = self._split_heads(self.v_proj(value), seq_len)
+        q = self._split_heads(self.q_proj(query), seq_len, self.num_heads)
+        k = self._split_heads(self.k_proj(key), seq_len, self.num_kv_heads)
+        v = self._split_heads(self.v_proj(value), seq_len, self.num_kv_heads)
+        if self.rope_theta is not None:
+            q = rotary_embedding_op(q, theta=self.rope_theta)
+            k = rotary_embedding_op(k, theta=self.rope_theta)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = repeat_kv_op(k, n_rep=rep)
+            v = repeat_kv_op(v, n_rep=rep)
+        if self.alibi:
+            bias = alibi_bias_op(q, num_heads=self.num_heads)
+            attention_mask = (bias if attention_mask is None
+                              else attention_mask + bias)
         ctx_ = scaled_dot_product_attention_op(
             q, k, v, mask=attention_mask, causal=self.causal,
             dropout_keep=self.dropout_keep)

@@ -116,3 +116,57 @@ def load_hf_gpt2_weights(executor, model, state_dict, name="gpt"):
     _put(p, f"{name}_ln_f_scale", sd["ln_f.weight"])
     _put(p, f"{name}_ln_f_bias", sd["ln_f.bias"])
     return executor
+
+
+def load_hf_llama_weights(executor, model, state_dict, name="llama"):
+    """Copy a transformers Llama-family state_dict into a
+    LlamaForCausalLM.  Baichuan checkpoints also fit: their fused
+    ``self_attn.W_pack`` projection is split into equal q/k/v thirds
+    (Baichuan has no GQA, so the thirds are all hidden-sized).
+
+    Accepts state_dicts with or without the ``model.`` prefix.  Our
+    rotary op follows HF's rotate_half convention, so q/k come over
+    unpermuted.
+    """
+    sd = {}
+    for k, v in state_dict.items():
+        v = v.detach().cpu().numpy() if hasattr(v, "detach") else \
+            np.asarray(v)
+        sd[k[6:] if k.startswith("model.") else k] = v
+    p = executor.params
+    cfg = model.config
+    _put(p, f"{name}_embed_table", sd["embed_tokens.weight"])
+    for i in range(cfg.num_layers):
+        hf = f"layers.{i}."
+        our = f"{name}_layer{i}"
+        if hf + "self_attn.W_pack.weight" in sd:   # Baichuan fused qkv
+            wp = sd[hf + "self_attn.W_pack.weight"]       # (3H, H)
+            h3 = wp.shape[0] // 3
+            for j, proj in enumerate(("q", "k", "v")):
+                sd[hf + f"self_attn.{proj}_proj.weight"] = \
+                    wp[j * h3:(j + 1) * h3]
+        for proj, hname in (("q", "self_attn.q_proj"),
+                            ("k", "self_attn.k_proj"),
+                            ("v", "self_attn.v_proj"),
+                            ("out", "self_attn.o_proj")):
+            _put(p, f"{our}_attn_{proj}_weight", sd[hf + hname + ".weight"].T)
+        _put(p, f"{our}_mlp_gate_weight", sd[hf + "mlp.gate_proj.weight"].T)
+        _put(p, f"{our}_mlp_up_weight", sd[hf + "mlp.up_proj.weight"].T)
+        _put(p, f"{our}_mlp_out_weight", sd[hf + "mlp.down_proj.weight"].T)
+        _put(p, f"{our}_input_norm_scale", sd[hf + "input_layernorm.weight"])
+        _put(p, f"{our}_post_norm_scale",
+             sd[hf + "post_attention_layernorm.weight"])
+    _put(p, f"{name}_norm_scale", sd["norm.weight"])
+    if model.lm_head is not None:
+        if "lm_head.weight" in sd:
+            _put(p, f"{name}_lm_head_weight", sd["lm_head.weight"].T)
+        else:  # tied checkpoint into an untied model
+            _put(p, f"{name}_lm_head_weight", sd["embed_tokens.weight"].T)
+    elif ("lm_head.weight" in sd
+          and not np.array_equal(sd["lm_head.weight"],
+                                 sd["embed_tokens.weight"])):
+        raise ValueError(
+            "checkpoint has an untied lm_head.weight but the model was "
+            "built with tie_embeddings=True — its logits would silently "
+            "diverge; rebuild with tie_embeddings=False")
+    return executor

@@ -1,0 +1,185 @@
+"""Llama / Baichuan decoder LMs (reference: tools/Hetu-Galvatron/galvatron/
+models/llama/LlamaModel_sequential.py, models/baichuan/ — the reference's
+modern-LLM tier under hybrid parallelism).
+
+TPU-native rebuild: RMSNorm pre-norm blocks, SwiGLU FFN, rotary position
+embeddings (or ALiBi for the Baichuan-13B shape), optional grouped-query
+attention.  No learned position table — positions live in the rotation, so
+the model serves any sequence length the attention envelope admits.
+Parallelism comes from strategy annotations (parallel/strategies.py
+MegatronLM) or a searched Galvatron config; ``pipeline_stages=k`` stages
+construction for the graph pipeline executor exactly like GPTModel.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+
+import numpy as np
+
+from ..graph.node import stage, scoped_init
+from .. import initializers as init
+from ..layers import Embedding, Linear, RMSNorm
+from ..layers.base import BaseLayer
+from ..layers.attention import MultiHeadAttention
+from ..ops import (array_reshape_op, matmul_op, silu_op,
+                   softmax_cross_entropy_sparse_op)
+from .bert import MaskedMeanOp
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=4096, num_layers=32,
+                 num_heads=32, num_kv_heads=None, intermediate_size=11008,
+                 seq_len=2048, rope_theta=10000.0, rms_eps=1e-5,
+                 position_embedding="rope", tie_embeddings=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.intermediate_size = intermediate_size
+        self.seq_len = seq_len
+        self.rope_theta = rope_theta
+        self.rms_eps = rms_eps
+        assert position_embedding in ("rope", "alibi")
+        self.position_embedding = position_embedding
+        self.tie_embeddings = tie_embeddings
+
+
+# published shapes (match the reference's meta_configs/hf_configs)
+LLAMA_CONFIGS = {
+    "llama-7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                     intermediate_size=11008),
+    "llama-13b": dict(hidden_size=5120, num_layers=40, num_heads=40,
+                      intermediate_size=13824),
+    "llama-30b": dict(hidden_size=6656, num_layers=60, num_heads=52,
+                      intermediate_size=17920),
+    # llama3-style GQA shape
+    "llama3-8b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                      num_kv_heads=8, intermediate_size=14336,
+                      vocab_size=128256, rope_theta=500000.0),
+    # reference models/baichuan: 7B is rope, 13B is alibi
+    "baichuan-7b": dict(vocab_size=64000, hidden_size=4096, num_layers=32,
+                        num_heads=32, intermediate_size=11008),
+    "baichuan-13b": dict(vocab_size=64000, hidden_size=5120, num_layers=40,
+                         num_heads=40, intermediate_size=13696,
+                         position_embedding="alibi"),
+}
+
+
+class LlamaMLP(BaseLayer):
+    """SwiGLU: down(silu(gate(x)) * up(x)) (HF LlamaMLP semantics).
+
+    Names follow the TP contract — gate/up are column-parallel, the down
+    projection is `_out` (row-parallel) so MegatronLM.annotate shards it
+    without model-specific rules.
+    """
+
+    def __init__(self, hidden_size, intermediate_size, name):
+        self.gate = Linear(hidden_size, intermediate_size, bias=False,
+                           name=f"{name}_gate")
+        self.up = Linear(hidden_size, intermediate_size, bias=False,
+                         name=f"{name}_up")
+        self.down = Linear(intermediate_size, hidden_size, bias=False,
+                           name=f"{name}_out")
+
+    def __call__(self, x):
+        return self.down(silu_op(self.gate(x)) * self.up(x))
+
+
+class LlamaDecoderLayer(BaseLayer):
+    def __init__(self, config, name):
+        c = config
+        self.attn = MultiHeadAttention(
+            c.hidden_size, c.num_heads, sequence_length=c.seq_len,
+            causal_mask=True, num_kv_heads=c.num_kv_heads,
+            rope_theta=(c.rope_theta
+                        if c.position_embedding == "rope" else None),
+            alibi=c.position_embedding == "alibi", bias=False,
+            name=f"{name}_attn")
+        self.mlp = LlamaMLP(c.hidden_size, c.intermediate_size,
+                            name=f"{name}_mlp")
+        self.input_norm = RMSNorm(c.hidden_size, eps=c.rms_eps,
+                                  name=f"{name}_input_norm")
+        self.post_norm = RMSNorm(c.hidden_size, eps=c.rms_eps,
+                                 name=f"{name}_post_norm")
+
+    def __call__(self, x, seq_len=None):
+        a_in = self.input_norm(x)
+        x = x + self.attn(a_in, a_in, a_in, seq_len=seq_len)
+        return x + self.mlp(self.post_norm(x))
+
+
+class LlamaModel:
+    @scoped_init
+    def __init__(self, config, name="llama", pipeline_stages=None):
+        c = config
+        self.config = c
+        self.pipeline_stages = pipeline_stages
+        self.embed = Embedding(c.vocab_size, c.hidden_size,
+                               initializer=init.normal(0.0, 0.02),
+                               name=f"{name}_embed")
+        self.layers = [LlamaDecoderLayer(c, name=f"{name}_layer{i}")
+                       for i in range(c.num_layers)]
+        self.norm = RMSNorm(c.hidden_size, eps=c.rms_eps,
+                            name=f"{name}_norm")
+
+    def _scope(self, layer_idx=None):
+        S = self.pipeline_stages
+        if not S:
+            return nullcontext()
+        if layer_idx is None:
+            return stage(0)
+        bounds = np.array_split(np.arange(len(self.layers)), S)
+        for s, chunk in enumerate(bounds):
+            if layer_idx in chunk:
+                return stage(s)
+        return stage(S - 1)
+
+    def __call__(self, input_ids):
+        with self._scope():
+            x = self.embed(input_ids)
+        for i, layer in enumerate(self.layers):
+            with self._scope(i):
+                x = layer(x, seq_len=self.config.seq_len)
+        with (stage(self.pipeline_stages - 1) if self.pipeline_stages
+              else nullcontext()):
+            return self.norm(x)
+
+
+class LlamaForCausalLM:
+    @scoped_init
+    def __init__(self, config, name="llama", pipeline_stages=None):
+        self.model = LlamaModel(config, name=name,
+                                pipeline_stages=pipeline_stages)
+        self.config = config
+        with (stage(pipeline_stages - 1) if pipeline_stages
+              else nullcontext()):
+            self.lm_head = (None if config.tie_embeddings else
+                            Linear(config.hidden_size, config.vocab_size,
+                                   bias=False,
+                                   initializer=init.normal(0.0, 0.02),
+                                   name=f"{name}_lm_head"))
+
+    def __call__(self, input_ids):
+        h = self.model(input_ids)
+        h = array_reshape_op(h, output_shape=(-1, self.config.hidden_size))
+        if self.lm_head is None:
+            return matmul_op(h, self.model.embed.weight, trans_B=True)
+        return self.lm_head(h)
+
+    def loss(self, input_ids, labels):
+        """labels: [B, S] next-token ids with -1 at ignored positions
+        (caller shifts, matching GPTLMHeadModel's convention)."""
+        logits = self(input_ids)
+        flat = array_reshape_op(labels, output_shape=(-1,))
+        ce = softmax_cross_entropy_sparse_op(logits, flat, ignored_index=-1)
+        return MaskedMeanOp(ce, flat)
+
+
+def BaichuanForCausalLM(config, name="baichuan", pipeline_stages=None):
+    """The Baichuan family is the Llama architecture with its own vocab
+    and (for 13B) ALiBi positions — config-level, not code-level, variants
+    (reference models/baichuan/BaiChuanModel_sequential.py)."""
+    return LlamaForCausalLM(config, name=name,
+                            pipeline_stages=pipeline_stages)

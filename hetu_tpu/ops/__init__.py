@@ -11,6 +11,8 @@ from .moe import (top_k_gating, hash_gating, layout_transform_op,
                   reverse_layout_transform_op, topk_idx_op, topk_val_op,
                   scatter1d_op, balance_assignment, sam_group_sum)
 from .attention import scaled_dot_product_attention_op
+from .rotary import (rotary_embedding_op, repeat_kv_op, alibi_bias_op,
+                     alibi_slopes)
 from .quantize import (rounding_to_int, dequantize, signed_quantize,
                        signed_dequantize, quantized_embedding_lookup,
                        quantized_embedding_lookup_per_row, fake_quantize,

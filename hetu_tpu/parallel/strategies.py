@@ -133,8 +133,8 @@ class MegatronLM(Strategy):
     pairs the reference placed as AllReduce after row-parallel matmuls.
     """
 
-    COL_W = re.compile(r"(_q|_k|_v|_in)_weight$")
-    COL_B = re.compile(r"(_q|_k|_v|_in)_bias$")
+    COL_W = re.compile(r"(_q|_k|_v|_in|_gate|_up)_weight$")
+    COL_B = re.compile(r"(_q|_k|_v|_in|_gate|_up)_bias$")
     ROW_W = re.compile(r"_out_weight$")
     # embedding tables (layers/common.py Embedding -> '<name>_table'):
     # vocab-parallel dim-0 sharding; a table also used as a tied LM head

@@ -274,3 +274,57 @@ def test_random_sample_ops(rng):
     assert u.min() >= -1.0 and u.max() <= 1.0 and abs(u.mean()) < 0.1
     assert abs(g.mean() - 0.5772) < 0.15          # Euler-Mascheroni
     assert ri.min() >= 0 and ri.max() <= 9
+
+
+def test_rotary_embedding_matches_manual(rng):
+    """RoPE op vs a from-scratch numpy rotate_half implementation
+    (HF convention: non-interleaved halves, f32 tables)."""
+    B, H, S, D = 2, 3, 8, 16
+    X = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    theta = 10000.0
+
+    x = ht.placeholder_op("rope_x", X.shape)
+    ex = ht.Executor([ht.rotary_embedding_op(x, theta=theta)])
+    (got,) = ex.run(feed_dict={x: X}, convert_to_numpy_ret_vals=True)
+
+    pos = np.arange(S, dtype=np.float64)
+    inv = 1.0 / theta ** (np.arange(0, D, 2, dtype=np.float64) / D)
+    freqs = np.outer(pos, inv)
+    cos = np.cos(np.concatenate([freqs, freqs], -1))
+    sin = np.sin(np.concatenate([freqs, freqs], -1))
+    rot = np.concatenate([-X[..., D // 2:], X[..., : D // 2]], -1)
+    want = X * cos[None, None] + rot * sin[None, None]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # rotation preserves norms pairwise
+    np.testing.assert_allclose(
+        np.linalg.norm(got, axis=-1), np.linalg.norm(X, axis=-1),
+        rtol=1e-5)
+
+
+def test_repeat_kv_and_alibi(rng):
+    from hetu_tpu.ops import alibi_slopes
+
+    B, KV, S, D = 2, 2, 4, 8
+    X = rng.standard_normal((B, KV, S, D)).astype(np.float32)
+    x = ht.placeholder_op("rkv_x", X.shape)
+    q = ht.placeholder_op("al_q", (B, 8, S, D))
+    ex = ht.Executor([ht.repeat_kv_op(x, n_rep=3),
+                      ht.alibi_bias_op(q, num_heads=8)])
+    got, bias = ex.run(
+        feed_dict={x: X, q: np.zeros((B, 8, S, D), np.float32)},
+        convert_to_numpy_ret_vals=True)
+    assert got.shape == (B, KV * 3, S, D)
+    np.testing.assert_array_equal(got[:, 0], X[:, 0])
+    np.testing.assert_array_equal(got[:, 2], X[:, 0])
+    np.testing.assert_array_equal(got[:, 3], X[:, 1])
+
+    # ALiBi slopes: published closed form for 8 heads is 2^-1 .. 2^-8
+    np.testing.assert_allclose(alibi_slopes(8),
+                               [2.0 ** -i for i in range(1, 9)])
+    assert bias.shape == (1, 8, S, S)
+    # zero on the diagonal, -slope * distance in the causal part
+    np.testing.assert_allclose(bias[0, :, 2, 2], 0.0)
+    np.testing.assert_allclose(bias[0, 0, 3, 1], -2 * 0.5, rtol=1e-6)
+    # non-power-of-two head count still yields monotone positive slopes
+    s12 = alibi_slopes(12)
+    assert len(s12) == 12 and all(v > 0 for v in s12)

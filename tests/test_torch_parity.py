@@ -231,6 +231,89 @@ def test_gpt2_training_curve_matches_huggingface(rng):
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
 
 
+def test_tiny_llama_matches_huggingface(rng):
+    """LlamaForCausalLM logits vs transformers with imported weights —
+    covers RoPE (rotate_half convention), GQA kv-head broadcast, RMSNorm
+    and SwiGLU in one forward (reference ships Llama under Galvatron,
+    tools/Hetu-Galvatron/galvatron/models/llama)."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                 load_hf_llama_weights)
+
+    B, S, V = 2, 16, 100
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=V, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=56, max_position_embeddings=64,
+        rms_norm_eps=1e-6, rope_theta=10000.0, attention_bias=False,
+        tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.eval()
+
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=S, rms_eps=1e-6, rope_theta=10000.0)
+    model = LlamaForCausalLM(c, name="llamaparity")
+    ids = ht.placeholder_op("ll_ids", (B, S), dtype=np.int32)
+    logits = model(ids)
+    ex = ht.Executor([logits])
+    load_hf_llama_weights(ex, model, hf.state_dict(), name="llamaparity")
+
+    ids_v = rng.integers(0, V, (B, S))
+    (got,) = ex.run(feed_dict={ids: ids_v}, convert_to_numpy_ret_vals=True)
+    with torch.no_grad():
+        want = hf(input_ids=torch.from_numpy(ids_v)).logits
+    np.testing.assert_allclose(got.reshape(B, S, V), _t2n(want),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_llama_training_curve_matches_huggingface(rng):
+    """End-to-end Llama loss-curve parity: identical HF-imported weights,
+    identical batches, AdamW both sides, 8 steps through autodiff +
+    RoPE/GQA backward."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                 load_hf_llama_weights)
+
+    B, S, V = 2, 16, 100
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=V, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        intermediate_size=56, max_position_embeddings=64,
+        rms_norm_eps=1e-6, attention_bias=False,
+        tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.train()
+
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=56, seq_len=S,
+                    rms_eps=1e-6)
+    model = LlamaForCausalLM(c, name="llamacurve")
+    ids = ht.placeholder_op("llc_ids", (B, S), dtype=np.int32)
+    labels = ht.placeholder_op("llc_labels", (B, S), dtype=np.int32)
+    loss = model.loss(ids, labels)
+    opt = ht.AdamWOptimizer(learning_rate=1e-3, weight_decay=0.01)
+    ex = ht.Executor([loss, opt.minimize(loss)])
+    load_hf_llama_weights(ex, model, hf.state_dict(), name="llamacurve")
+
+    topt = torch.optim.AdamW(hf.parameters(), lr=1e-3, weight_decay=0.01)
+    ours, theirs = [], []
+    for _ in range(8):
+        ids_v = rng.integers(0, V, (B, S))
+        lab_v = np.roll(ids_v, -1, axis=1)
+        out = ex.run(feed_dict={ids: ids_v, labels: lab_v},
+                     convert_to_numpy_ret_vals=True)
+        ours.append(float(out[0]))
+        topt.zero_grad()
+        logits = hf(input_ids=torch.from_numpy(ids_v)).logits
+        tl = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, V), torch.from_numpy(lab_v).reshape(-1).long())
+        tl.backward()
+        topt.step()
+        theirs.append(float(tl))
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
 def test_wdl_training_curve_matches_torch(rng):
     """CTR-family loss-curve parity (reference keeps tf/torch companion
     models for examples/ctr): Wide&Deep with identical weights and batches,
