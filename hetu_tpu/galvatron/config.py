@@ -73,6 +73,12 @@ class HybridParallelConfig:
 
     def validate(self):
         n = self.n_layers
+        if self.pipeline_type not in ("gpipe", "pipedream_flush"):
+            # refuse, don't silently rewrite — executing a different
+            # schedule than searched breaks the search's memory model
+            raise ValueError(
+                f"unknown pipeline_type {self.pipeline_type!r}; this "
+                "runtime honors 'gpipe' and 'pipedream_flush'")
         assert len(self.dp_types) == n and len(self.tp_consecutive) == n \
             and len(self.checkpoint_flags) == n
         assert sum(self.pp_division) == n and len(self.pp_division) == self.pp_deg

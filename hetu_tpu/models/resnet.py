@@ -4,7 +4,11 @@ ResNet-18/34 with BasicBlock; the v0 end-to-end gate model per SURVEY §7.3).
 
 from __future__ import annotations
 
-from ..graph.node import scoped_init
+from contextlib import nullcontext
+
+import numpy as np
+
+from ..graph.node import scoped_init, stage
 
 from ..layers import (Conv2d, BatchNorm, Linear, Sequence, Identity)
 from ..ops import (relu_op, global_avg_pool2d_op, array_reshape_op,
@@ -38,9 +42,15 @@ class BasicBlock:
 
 
 class ResNet:
+    """``pipeline_stages=k`` stages construction for the graph pipeline
+    executor (stem on stage 0, blocks split evenly, pool+fc on the last
+    stage); batchnorm running stats thread through the pipeline's
+    stateful-update path (graph_pipeline.py _fwd_micro)."""
+
     @scoped_init
     def __init__(self, num_blocks=(2, 2, 2, 2), num_classes=10,
-                 name="resnet"):
+                 name="resnet", pipeline_stages=None):
+        self.pipeline_stages = pipeline_stages
         self.in_planes = 64
         self.conv1 = Conv2d(3, 64, 3, stride=1, padding=1, bias=False,
                             name=f"{name}_conv1")
@@ -57,13 +67,29 @@ class ResNet:
             self.layers.append(blocks)
         self.fc = Linear(512, num_classes, name=f"{name}_fc")
 
+    def _scope(self, flat_idx, n_flat):
+        S = self.pipeline_stages
+        if not S:
+            return nullcontext()
+        if flat_idx is None:
+            return stage(0)
+        bounds = np.array_split(np.arange(n_flat), S)
+        for s, chunk in enumerate(bounds):
+            if flat_idx in chunk:
+                return stage(s)
+        return stage(S - 1)
+
     def __call__(self, x):
-        out = relu_op(self.bn1(self.conv1(x)))
-        for blocks in self.layers:
-            for b in blocks:
+        flat = [b for blocks in self.layers for b in blocks]
+        with self._scope(None, len(flat)):
+            out = relu_op(self.bn1(self.conv1(x)))
+        for i, b in enumerate(flat):
+            with self._scope(i, len(flat)):
                 out = b(out)
-        out = global_avg_pool2d_op(out)
-        return self.fc(out)
+        with (stage(self.pipeline_stages - 1) if self.pipeline_stages
+              else nullcontext()):
+            out = global_avg_pool2d_op(out)
+            return self.fc(out)
 
 
 def resnet18(num_classes=10):

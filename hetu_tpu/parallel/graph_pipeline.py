@@ -286,11 +286,12 @@ class PipelineSubExecutor:
             for u in st.acts_in:
                 bindings[u] = in_acts[u.name]
             vals, _ = evaluate(out_nodes, bindings, ctx, topo=st.topo)
-            if ctx.updates:
-                raise NotImplementedError(
-                    "stateful ops (batchnorm/assign) inside a pipeline "
-                    "stage are not supported yet")
-            return {n.name: v for n, v in zip(out_nodes, vals)}
+            # stateful ops (batchnorm running stats, assign): thread the
+            # new values out; the scheduler chains them across micro-
+            # batches and writes them back after the step (reference
+            # gpipe_subexecutor.py:7 schedules arbitrary subgraphs)
+            updates = {v.name: val for v, val in ctx.updates.items()}
+            return {n.name: v for n, v in zip(out_nodes, vals)}, updates
 
         return jax.jit(fwd), out_nodes
 
@@ -415,7 +416,14 @@ class PipelineSubExecutor:
         st = self.stages[s]
         ins = {u.name: st.device_put(acts[i][u.name])
                for u in st.acts_in}
-        outs = st.fwd(pviews[s], stage_feeds[i][s], ins, keys[i])
+        outs, updates = st.fwd(pviews[s], stage_feeds[i][s], ins, keys[i])
+        if updates:
+            # chain running-state (batchnorm stats, assigns) through the
+            # micro-batch sequence: the next micro's forward on this stage
+            # sees this micro's EMA, and the final values write back to
+            # executor params after the step
+            pviews[s] = {**pviews[s], **updates}
+            self._pending_state.update(updates)
         for n in st.out_nodes:
             if n in st.acts_out:
                 acts[i][n.name] = outs[n.name]
@@ -469,6 +477,7 @@ class PipelineSubExecutor:
                         for st in self.stages] for i in range(m)]
         params = ex.params
         pviews = self._stage_pviews(params)
+        self._pending_state = {}               # stateful-op write-backs
 
         acts = [dict() for _ in range(m)]      # micro -> {name: value}
         evals = [dict() for _ in range(m)]     # micro -> {name: value}
@@ -504,15 +513,20 @@ class PipelineSubExecutor:
             step = opt_state["step"]
             scale = jnp.asarray(1.0)
             if self.opt_op.clip_global_norm is not None:
-                sq = 0.0
+                sq = []
                 for name, gs in grad_acc.items():
                     g = self._accum(gs, self._home_put(name))
                     grad_acc[name] = [g]
-                    sq += float(np.sum(np.square(
-                        np.asarray(g, dtype=np.float32))))
-                gnorm = float(np.sqrt(sq))
-                scale = jnp.asarray(min(
-                    1.0, self.opt_op.clip_global_norm / (gnorm + 1e-6)))
+                    # device-resident partial: a host np.asarray here
+                    # would sync mid-step and stall the async pipeline
+                    sq.append(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                home = self.stages[0].device_put
+                total = home(sq[0])
+                for p in sq[1:]:
+                    total = total + home(p)
+                gnorm = jnp.sqrt(total)
+                scale = jnp.minimum(
+                    1.0, self.opt_op.clip_global_norm / (gnorm + 1e-6))
             new_slots = dict(opt_state["slots"])
             for st in self.stages:
                 if st.update is None:
@@ -530,6 +544,12 @@ class PipelineSubExecutor:
                 new_slots.update(news)
             ex.opt_state[self.opt_op.name] = {
                 "step": step + 1, "slots": new_slots}
+
+        # stateful-op results (batchnorm running stats, assigns): the
+        # last micro's chained value becomes the step's new state
+        if self._pending_state:
+            params.update(self._pending_state)
+            self._pending_state = {}
 
         # ---- outputs ---------------------------------------------------
         vals = []

@@ -184,13 +184,45 @@ class TestRuntime:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
-    def _make_pp(self, pp_deg, tp_sizes, dp_types, chunks=1, world=8):
+    def _make_pp(self, pp_deg, tp_sizes, dp_types, chunks=1, world=8,
+                 pipeline_type="gpipe"):
         n = len(tp_sizes)
         specs = [TransformerHPLayer(hidden=32, heads=4) for _ in range(n)]
         cfg = HybridParallelConfig(
             pp_deg=pp_deg, tp_sizes=tp_sizes, dp_types=dp_types,
-            chunks=chunks, world=world)
+            chunks=chunks, world=world, pipeline_type=pipeline_type)
         return HybridParallelModel(specs, cfg)
+
+    def test_pipedream_flush_matches_gpipe_and_bounds_memory(self):
+        """config.pipeline_type is HONORED (the search emits
+        pipedream_flush, search.py:271): 1F1B numerics == GPipe, and the
+        1F1B stash high-water mark is <= pp_deg live chunks while GPipe
+        keeps all of them (search.py's min(chunks, pp) memory model now
+        describes the schedule that actually runs)."""
+        chunks, pp = 6, 2
+        m_1f1b = self._make_pp(pp, [1, 1, 1, 1], [0, 0, 0, 0],
+                               chunks=chunks, pipeline_type="pipedream_flush")
+        m_gpipe = self._make_pp(pp, [1, 1, 1, 1], [0, 0, 0, 0],
+                                chunks=chunks, pipeline_type="gpipe")
+        params = m_1f1b.init_params(jax.random.PRNGKey(0))
+        params_g = m_gpipe.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (24, 4, 32))
+        tgt = jax.random.normal(jax.random.PRNGKey(2), (24, 4, 32)) * 0.1
+        l1, g1 = m_1f1b.grads(params, x, tgt)
+        lg, gg = m_gpipe.grads(params_g, x, tgt)
+        np.testing.assert_allclose(float(l1), float(lg), rtol=2e-5)
+        for ga, gb in zip(g1, gg):
+            for k in gb:
+                np.testing.assert_allclose(np.asarray(ga[k]),
+                                           np.asarray(gb[k]),
+                                           rtol=2e-4, atol=2e-5)
+        assert m_1f1b._live_chunks_hwm <= pp
+        assert m_gpipe._live_chunks_hwm == chunks
+
+    def test_unknown_pipeline_type_refused(self):
+        with pytest.raises(ValueError, match="pipeline_type"):
+            HybridParallelConfig(pp_deg=2, tp_sizes=[1, 1], dp_types=[0, 0],
+                                 pipeline_type="interleaved", world=8)
 
     def test_pp_honors_searched_division_and_matches_unstaged(self):
         """pp_deg=2, chunks=4: the searched pipeline degree actually
@@ -373,3 +405,47 @@ def test_runtime_checkpoint_guards(tmp_path):
         [x.sharding.spec != jax.sharding.PartitionSpec()
          for x in jax.tree_util.tree_leaves(o3)
          if hasattr(x, "sharding") and x.ndim >= 2]))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestLlamaHPLayer:
+    def _model(self, n=2, pp=1, tp=None, kv_heads=None, alibi=False,
+               chunks=1, pipeline_type="gpipe"):
+        from hetu_tpu.galvatron import LlamaHPLayer
+        specs = [LlamaHPLayer(hidden=32, heads=4, kv_heads=kv_heads,
+                              ffn=64, alibi=alibi) for _ in range(n)]
+        cfg = HybridParallelConfig(
+            pp_deg=pp, tp_sizes=tp or [1] * n, dp_types=[0] * n,
+            chunks=chunks, world=8, pipeline_type=pipeline_type)
+        return HybridParallelModel(specs, cfg)
+
+    @pytest.mark.parametrize("kv_heads,alibi", [(None, False), (2, False),
+                                                (None, True)])
+    def test_forward_matches_unsharded(self, kv_heads, alibi):
+        model = self._model(tp=[2, 4], kv_heads=kv_heads, alibi=alibi)
+        params = model.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 32))
+        out = jax.jit(model.apply)(params, x)
+        host = [jax.tree_util.tree_map(np.asarray, p) for p in params]
+        ref = np.asarray(x)
+        for spec, sh, p in zip(model.specs, model.shardings, host):
+            ref = np.asarray(spec.apply(
+                {k: jnp.asarray(v) for k, v in p.items()},
+                jnp.asarray(ref), sh))
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4,
+                                   rtol=2e-4)
+
+    def test_pipelined_training_decreases_loss(self):
+        model = self._model(n=4, pp=2, tp=[2, 2, 2, 2], kv_heads=2,
+                            chunks=4, pipeline_type="pipedream_flush")
+        params = model.init_params(jax.random.PRNGKey(0))
+        step, opt_init = model.make_train_step(lr=0.05)
+        opt_state = opt_init(params)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 32))
+        tgt = jax.random.normal(jax.random.PRNGKey(2), (8, 4, 32)) * 0.1
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, x, tgt)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert model._live_chunks_hwm <= 2
