@@ -32,20 +32,35 @@ class DenseParamStore:
     one table per pytree leaf, one row per leading index, server-side
     optimizer applies pushed gradients."""
 
-    def __init__(self, params, optimizer="sgd", lr=0.01, **opt_kwargs):
+    def __init__(self, params, optimizer="sgd", lr=0.01, tables=None,
+                 seed_values=True, **opt_kwargs):
         self.treedef = jax.tree_util.tree_structure(params)
         leaves = jax.tree_util.tree_leaves(params)
         self.shapes = [l.shape for l in leaves]
-        self.tables = []
-        for leaf in leaves:
-            arr = np.asarray(leaf, np.float32).reshape(leaf.shape[0], -1) \
-                if leaf.ndim > 1 else np.asarray(leaf,
-                                                 np.float32).reshape(1, -1)
-            t = EmbeddingTable(arr.shape[0], arr.shape[1],
-                               optimizer=optimizer, lr=lr, init_scale=0,
-                               **opt_kwargs)
-            t.set_rows(np.arange(arr.shape[0]), arr)
-            self.tables.append(t)
+        arrs = [np.asarray(l, np.float32).reshape(l.shape[0], -1)
+                if l.ndim > 1 else np.asarray(l, np.float32).reshape(1, -1)
+                for l in leaves]
+        if tables is None:
+            tables = [EmbeddingTable(a.shape[0], a.shape[1],
+                                     optimizer=optimizer, lr=lr,
+                                     init_scale=0, **opt_kwargs)
+                      for a in arrs]
+        self.tables = tables
+        if seed_values:
+            for t, a in zip(self.tables, arrs):
+                t.set_rows(np.arange(a.shape[0]), a)
+
+    @classmethod
+    def remote(cls, host, port, params, seed_values=False, **kw):
+        """Leaves served by one PSServer process's named tables
+        ('leaf0'..'leafN', ps/rpc.serve_dense_params).  Only one replica
+        should seed_values; the rest attach (reference workers pull the
+        server's authoritative weights)."""
+        from ..ps.rpc import RemoteTable
+        leaves = jax.tree_util.tree_leaves(params)
+        tables = [RemoteTable(host, port, table=f"leaf{i}", **kw)
+                  for i in range(len(leaves))]
+        return cls(params, tables=tables, seed_values=seed_values)
 
     def _rows(self, leaf_idx):
         return np.arange(self.tables[leaf_idx].rows)
@@ -107,7 +122,8 @@ class HetPipeTrainer:
 
     def __init__(self, pipeline, init_params, nworkers, mode="hetpipe",
                  optimizer="sgd", lr=0.01, staleness=1, wait_time=100.0,
-                 scheduler=None, ssp_timeout=120.0, **opt_kwargs):
+                 scheduler=None, ssp_timeout=120.0, store=None, ssp=None,
+                 reducer=None, **opt_kwargs):
         assert mode in ("hetpipe", "preduce")
         self.pipeline = pipeline
         self.nworkers = nworkers
@@ -118,10 +134,14 @@ class HetPipeTrainer:
         # jit once: pipeline.grads builds fresh shard_map closures per call,
         # so an unjitted loop would retrace + recompile every step
         self._grads = jax.jit(pipeline.grads)
+        # store/ssp/scheduler/reducer injection: pass the DCN clients
+        # (ps/rpc DenseParamStore.remote + RemoteCoordinator) to run
+        # replicas as separate PROCESSES against one server authority;
+        # the in-process defaults are the thread-replica test harness
         if mode == "hetpipe":
-            self.store = DenseParamStore(init_params, optimizer=optimizer,
-                                         lr=lr, **opt_kwargs)
-            self.ssp = SSPController(nworkers, staleness=staleness)
+            self.store = store or DenseParamStore(
+                init_params, optimizer=optimizer, lr=lr, **opt_kwargs)
+            self.ssp = ssp or SSPController(nworkers, staleness=staleness)
         else:
             if optimizer != "sgd" or opt_kwargs:
                 raise ValueError(
@@ -129,7 +149,7 @@ class HetPipeTrainer:
                     "group average; server-side optimizers only exist in "
                     "mode='hetpipe'")
             self.scheduler = scheduler or PReduceScheduler(nworkers)
-            self.reducer = _ThreadReducer()
+            self.reducer = reducer or _ThreadReducer()
         self._round = [0] * nworkers
         # workers that finished or died: excluded from the SSP min so the
         # survivors don't spin forever on a frozen clock
@@ -140,13 +160,19 @@ class HetPipeTrainer:
         dies) so SSP-gated peers stop waiting on its clock."""
         self._inactive.add(rank)
 
+    def _clocks(self):
+        if hasattr(self.ssp, "clocks"):
+            return self.ssp.clocks()        # one RPC for all clocks
+        return [self.ssp.clock(w) for w in range(self.nworkers)]
+
     def _ssp_can_advance(self, rank):
         active = [w for w in range(self.nworkers)
                   if w not in self._inactive]
         if not active:
             return True
-        lo = min(self.ssp.clock(w) for w in active)
-        return self.ssp.clock(rank) - lo <= self.ssp.staleness
+        cl = self._clocks()
+        lo = min(cl[w] for w in active)
+        return cl[rank] - lo <= self.ssp.staleness
 
     def step(self, rank, params, xs, targets):
         """One training round for worker ``rank``; returns (loss, params)."""
@@ -168,15 +194,18 @@ class HetPipeTrainer:
                     raise RuntimeError(
                         f"SSP wait exceeded {self.ssp_timeout}s: a peer "
                         f"stopped ticking (clocks="
-                        f"{[self.ssp.clock(w) for w in range(self.nworkers)]}"
+                        f"{self._clocks()}"
                         f"); call mark_done(rank) for finished workers")
-                time.sleep(0.001)
+                # remote clocks poll over RPC: back off harder than the
+                # in-process 1ms spin
+                time.sleep(0.01 if hasattr(self.ssp, "clocks") else 0.001)
             new_params = self.store.pull()
         else:
             rid = self._round[rank]
             self._round[rank] += 1
             partner = self.scheduler.get_partner(
                 rid, rank, self.nworkers, self.wait_time)
+            self.last_partner = partner
             mean_g = self.reducer.reduce(rid, rank, partner, grads)
             new_params = jax.tree_util.tree_map(
                 lambda p, g: p - self.lr * g, params, mean_g)

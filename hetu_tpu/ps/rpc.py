@@ -17,18 +17,40 @@ service over DCN in front of the native store (ps/native/hetu_ps.cpp):
                        'server' role of the reference's heturun bring-up
                        (runner.py:150).
 
+Fault tolerance & concurrency (reference ps-lite/src/resender.h +
+van.cc:105 heartbeats):
+
+  * every request carries a (client_id, seq) pair; on timeout or a
+    dropped connection the client reconnects (exponential backoff) and
+    RETRANSMITS the same request,
+  * the server keeps a bounded dedup cache of recently applied
+    non-idempotent requests (push/set_rows) keyed by (client_id, seq),
+    so a retransmission whose first copy DID apply is acknowledged
+    without double-applying the gradient,
+  * a client-side heartbeat thread pings the server on its own
+    connection (van.cc heartbeats to the scheduler); ``alive`` reports
+    liveness without touching the data path,
+  * ``RemoteTable(pool_size=k)`` opens k independent connections;
+    concurrent calls (the executor's async prefetch + push workers,
+    ps/embedding.py) proceed in parallel instead of serializing on one
+    locked socket.
+
 Wire format (trusted-cluster, no pickle): one u32 little-endian JSON
-header length, the JSON header ({"verb", "sizes", ...}), then the raw
-little-endian array payloads back to back.
+header length, the JSON header ({"verb", "seq", "cid", "sizes", ...}),
+then the raw little-endian array payloads back to back.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import socket
 import socketserver
 import struct
 import threading
+import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -58,79 +80,204 @@ def recv_msg(sock):
     return header, payloads
 
 
+# verbs whose re-execution on retransmit is WRONG: push double-applies a
+# gradient, tick double-advances an SSP clock, reduce re-opens a completed
+# group slot (which would then wait forever).  Their REPLIES are cached by
+# (cid, seq) and replayed verbatim (resender.h ack-cache semantics).
+_NON_IDEMPOTENT = frozenset({"push", "tick", "reduce"})
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
-        table = self.server.table
         while True:
             try:
                 header, payloads = recv_msg(self.request)
-            except (ConnectionError, struct.error):
+            except (ConnectionError, struct.error, OSError):
                 return
+            seq, cid = header.get("seq"), header.get("cid")
             try:
-                self._dispatch(table, header, payloads)
+                table = self.server.tables.get(header.get("table", ""))
+                dedup_key = ((cid, seq)
+                             if (header.get("verb") in _NON_IDEMPOTENT
+                                 and cid is not None and seq is not None)
+                             else None)
+                if dedup_key is not None:
+                    cached = self.server._seen(dedup_key)
+                    if cached is not None:
+                        # retransmission of an already-applied request:
+                        # replay the cached reply, don't re-run
+                        rh, rp = cached
+                        send_msg(self.request, dict(rh, dedup=True), *rp)
+                        continue
+                reply, rpayloads = self._dispatch(table, header, payloads)
+                send_msg(self.request, reply, *rpayloads)
+                if dedup_key is not None and reply.get("verb") == "ok":
+                    self.server._record(dedup_key, (reply, rpayloads))
             except Exception as e:  # noqa: BLE001 — surfaced to the client
                 # keep the connection alive and report the REAL error, so
                 # one bad request (save path, malformed push) doesn't
                 # brick the shard for the rest of training
                 try:
                     send_msg(self.request,
-                             {"verb": "error",
+                             {"verb": "error", "seq": seq,
                               "message": f"{type(e).__name__}: {e}"})
                 except OSError:
                     return
 
+    _TABLE_VERBS = frozenset({"lookup", "push", "set_rows", "versions",
+                              "meta", "save", "load"})
+
     def _dispatch(self, table, header, payloads):
+        """Returns (reply_header, reply_payloads) — the caller sends (and
+        caches non-idempotent replies for retransmission replay)."""
         verb = header["verb"]
+        ok = {"verb": "ok", "seq": header.get("seq")}
+        if verb in self._TABLE_VERBS and table is None:
+            raise KeyError(
+                f"no table {header.get('table', '')!r} on this server "
+                f"(tables: {sorted(self.server.tables)})")
         if verb == "lookup":
             keys = np.frombuffer(payloads[0], "<i8")
-            send_msg(self.request, {"verb": "ok"},
-                     table.lookup(keys).astype("<f4"))
+            return ok, [table.lookup(keys).astype("<f4")]
         elif verb == "push":
             keys = np.frombuffer(payloads[0], "<i8")
             grads = np.frombuffer(payloads[1], "<f4").reshape(
                 keys.size, table.dim)
             table.push(keys, grads)
-            send_msg(self.request, {"verb": "ok"})
+            return ok, []
         elif verb == "set_rows":
             keys = np.frombuffer(payloads[0], "<i8")
             vals = np.frombuffer(payloads[1], "<f4").reshape(
                 keys.size, table.dim)
             table.set_rows(keys, vals)
-            send_msg(self.request, {"verb": "ok"})
+            return ok, []
         elif verb == "versions":
             keys = np.frombuffer(payloads[0], "<i8")
-            send_msg(self.request, {"verb": "ok"},
-                     table.versions(keys).astype("<u8"))
+            return ok, [table.versions(keys).astype("<u8")]
         elif verb == "meta":
-            send_msg(self.request, {"verb": "ok", "rows": table.rows,
-                                    "dim": table.dim})
+            return dict(ok, rows=table.rows, dim=table.dim), []
+        elif verb == "ping":
+            return dict(ok, t=header.get("t")), []
         elif verb == "save":
             table.save(header["path"])
-            send_msg(self.request, {"verb": "ok"})
+            return ok, []
         elif verb == "load":
             table.load(header["path"])
-            send_msg(self.request, {"verb": "ok"})
+            return ok, []
         elif verb == "shutdown":
-            send_msg(self.request, {"verb": "ok"})
             self.server._shutdown_requested.set()
+            return ok, []
+        # -- worker coordination (HetPipe/preduce over DCN; reference
+        #    psf/ssp.h server clocks + preduce_handler.cc matchmaking) --
+        elif verb == "tick":
+            self.server.ssp.tick(int(header["worker"]))
+            return dict(ok, clocks=self.server.clocks()), []
+        elif verb == "clocks":
+            return dict(ok, clocks=self.server.clocks(),
+                        staleness=self.server.ssp.staleness), []
+        elif verb == "preduce_join":
+            partner = self.server.scheduler.get_partner(
+                int(header["round"]), int(header["rank"]),
+                int(header.get("target", -1)),
+                float(header.get("wait_ms", 100.0)))
+            return dict(ok, partner=list(partner)), []
+        elif verb == "reduce":
+            arrays = [np.frombuffer(p, "<f4").reshape(s)
+                      for p, s in zip(payloads, header["shapes"])]
+            mean = self.server.reducer.reduce(
+                int(header["round"]), int(header["rank"]),
+                tuple(header["group"]), arrays)
+            return (dict(ok, shapes=header["shapes"]),
+                    [m.astype("<f4") for m in mean])
         else:
-            send_msg(self.request, {"verb": "error",
-                                    "message": f"bad verb {verb}"})
+            return {"verb": "error", "seq": header.get("seq"),
+                    "message": f"bad verb {verb}"}, []
+
+
+class _ArrayReducer:
+    """Server-side grad averaging for preduce groups (the DCN analogue of
+    the reference's lazily-built NCCL subgroups): each group member posts
+    its arrays for (round, group) and blocks until the group is complete,
+    then everyone receives the mean.  A member that never posts (process
+    died after matchmaking) trips ``timeout`` so the survivors' handler
+    threads surface an error instead of pinning forever."""
+
+    def __init__(self, timeout=120.0):
+        self._lock = threading.Condition()
+        self._rounds = {}
+        self.timeout = timeout
+
+    def reduce(self, round_id, rank, group, arrays):
+        key = (round_id, tuple(group))
+        deadline = time.monotonic() + self.timeout
+        with self._lock:
+            slot = self._rounds.setdefault(key, {"reads": 0})
+            slot[rank] = arrays
+            self._lock.notify_all()
+            while not all(r in slot for r in group):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._rounds.pop(key, None)   # free the dead group
+                    missing = [r for r in group if r not in slot]
+                    raise RuntimeError(
+                        f"reduce group {key} incomplete after "
+                        f"{self.timeout}s: members {missing} never "
+                        "posted (worker died after matchmaking?)")
+                self._lock.wait(timeout=remaining)
+            mean = [np.mean([slot[r][i] for r in group], axis=0)
+                    for i in range(len(arrays))]
+            slot["reads"] += 1
+            if slot["reads"] == len(group):
+                self._rounds.pop(key, None)
+        return mean
 
 
 class PSServer:
-    """Serves one EmbeddingTable shard over TCP (reference kvserver.h)."""
+    """Serves EmbeddingTable shard(s) over TCP (reference kvserver.h).
 
-    def __init__(self, table, host="127.0.0.1", port=0):
-        self.table = table
+    ``table`` may be a single table (served under the default name "") or
+    a {name: table} dict.  ``nworkers`` additionally attaches the worker-
+    coordination plane — server-held SSP clocks, preduce matchmaking, and
+    group grad reduction (reference psf/ssp.h, preduce_handler.cc) — so
+    HetPipe replicas in separate PROCESSES share one consistency
+    authority."""
+
+    DEDUP_CAPACITY = 4096
+
+    def __init__(self, table, host="127.0.0.1", port=0, nworkers=None,
+                 staleness=1):
+        self.tables = table if isinstance(table, dict) else {"": table}
+        self.table = next(iter(self.tables.values()))
 
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
         self._srv = _Srv((host, port), _Handler)
-        self._srv.table = table
+        self._srv.tables = self.tables
+        if nworkers:
+            from .store import SSPController
+            from .preduce import PReduceScheduler
+            self._srv.ssp = SSPController(nworkers, staleness=staleness)
+            self._srv.scheduler = PReduceScheduler(nworkers)
+            self._srv.reducer = _ArrayReducer()
+            self._srv.clocks = lambda: [
+                self._srv.ssp.clock(w) for w in range(nworkers)]
         self._srv._shutdown_requested = threading.Event()
+        dedup = OrderedDict()   # (cid, seq) -> (reply_header, payloads)
+        dedup_lock = threading.Lock()
+
+        def seen(key):
+            with dedup_lock:
+                return dedup.get(key)
+
+        def record(key, reply):
+            with dedup_lock:
+                dedup[key] = reply
+                while len(dedup) > self.DEDUP_CAPACITY:
+                    dedup.popitem(last=False)
+
+        self._srv._seen, self._srv._record = seen, record
         self.host, self.port = self._srv.server_address
         self._thread = None
 
@@ -155,24 +302,142 @@ class PSServer:
         self._srv.server_close()
 
 
+class _Conn:
+    """One pooled connection: socket + in-flight bookkeeping."""
+
+    def __init__(self):
+        self.sock = None
+        self.lock = threading.Lock()
+
+
 class RemoteTable:
-    """EmbeddingTable-interface client for a PSServer shard."""
+    """EmbeddingTable-interface client for a PSServer shard.
 
-    def __init__(self, host, port, timeout=30.0):
+    ``pool_size`` connections serve calls concurrently (full-duplex
+    lookup+push overlap); each call retries with retransmission across
+    reconnects until ``retry_deadline`` seconds have elapsed."""
+
+    _cid_counter = itertools.count()
+
+    def __init__(self, host, port, timeout=30.0, pool_size=2,
+                 retry_deadline=60.0, heartbeat_interval=None, table="",
+                 fetch_meta=True):
         self._addr = (host, int(port))
-        self._sock = socket.create_connection(self._addr, timeout=timeout)
-        self._lock = threading.Lock()
-        meta = self._call({"verb": "meta"})[0]
-        self.rows, self.dim = meta["rows"], meta["dim"]
+        self._timeout = timeout
+        self._deadline = retry_deadline
+        self._table = table
+        # unique across processes AND instances (resender keys on sender)
+        self._cid = f"{os.getpid()}.{next(self._cid_counter)}"
+        self._seq = itertools.count()
+        self._seq_lock = threading.Lock()
+        self._pool = [_Conn() for _ in range(max(1, int(pool_size)))]
+        self._pool_sem = threading.Semaphore(len(self._pool))
+        self._closed = False
+        self.last_pong = None
+        self._hb_thread = None
+        if fetch_meta:
+            meta = self._call({"verb": "meta"})[0]
+            self.rows, self.dim = meta["rows"], meta["dim"]
+        if heartbeat_interval:
+            self._hb_interval = float(heartbeat_interval)
+            self._hb_thread = threading.Thread(target=self._heartbeat,
+                                               daemon=True)
+            self._hb_thread.start()
 
-    def _call(self, header, *arrays):
-        with self._lock:
-            send_msg(self._sock, header, *arrays)
-            reply, payloads = recv_msg(self._sock)
+    # -- connection management --------------------------------------------
+    def _connect(self):
+        return socket.create_connection(self._addr, timeout=self._timeout)
+
+    def _acquire(self):
+        self._pool_sem.acquire()
+        for c in self._pool:
+            if c.lock.acquire(blocking=False):
+                return c
+        # unreachable: the semaphore guarantees a free connection
+        self._pool_sem.release()
+        raise RuntimeError("connection pool accounting broken")
+
+    def _release(self, conn):
+        conn.lock.release()
+        self._pool_sem.release()
+
+    def _next_seq(self):
+        with self._seq_lock:
+            return next(self._seq)
+
+    def _call(self, header, *arrays, conn=None):
+        """Send with (cid, seq), await the matching reply; on socket
+        failure reconnect with backoff and RETRANSMIT (the server's dedup
+        cache absorbs double-applied mutations) until the deadline.
+        ``conn`` bypasses the pool (the heartbeat's dedicated channel)."""
+        header = dict(header, cid=self._cid, seq=self._next_seq())
+        if self._table:
+            header.setdefault("table", self._table)
+        pooled = conn is None
+        if pooled:
+            conn = self._acquire()
+        else:
+            conn.lock.acquire()
+        try:
+            deadline = time.monotonic() + self._deadline
+            backoff = 0.05
+            last_err = None
+            while time.monotonic() < deadline:
+                try:
+                    if conn.sock is None:
+                        conn.sock = self._connect()
+                    send_msg(conn.sock, header, *arrays)
+                    reply, payloads = recv_msg(conn.sock)
+                    break
+                except (ConnectionError, socket.timeout, OSError) as e:
+                    last_err = e
+                    if conn.sock is not None:
+                        try:
+                            conn.sock.close()
+                        except OSError:
+                            pass
+                        conn.sock = None
+                    if self._closed:
+                        raise
+                    time.sleep(min(backoff, max(
+                        0.0, deadline - time.monotonic())))
+                    backoff = min(backoff * 2, 2.0)
+            else:
+                raise ConnectionError(
+                    f"PS {self._addr} unreachable for {self._deadline}s "
+                    f"(last error: {last_err})")
+        finally:
+            if pooled:
+                self._release(conn)
+            else:
+                conn.lock.release()
         if reply.get("verb") != "ok":
             raise RuntimeError(f"PS RPC failed: {reply}")
         return reply, payloads
 
+    # -- heartbeat (van.cc:105) -------------------------------------------
+    def _heartbeat(self):
+        # dedicated connection: a long server-side blocking call (e.g. a
+        # 'reduce' waiting for partners) on the pool must not starve the
+        # liveness probe into a false death verdict
+        hb_conn = _Conn()
+        while not self._closed:
+            try:
+                self._call({"verb": "ping", "t": time.time()},
+                           conn=hb_conn)
+                self.last_pong = time.monotonic()
+            except (ConnectionError, RuntimeError):
+                pass
+            time.sleep(self._hb_interval)
+
+    @property
+    def alive(self):
+        """False once two heartbeat intervals pass without a pong."""
+        if self._hb_thread is None or self.last_pong is None:
+            return True   # no heartbeat configured / none completed yet
+        return (time.monotonic() - self.last_pong) < 2 * self._hb_interval
+
+    # -- table interface ---------------------------------------------------
     def lookup(self, keys):
         keys = np.asarray(keys).reshape(-1).astype("<i8")
         _, payloads = self._call({"verb": "lookup"}, keys)
@@ -204,10 +469,73 @@ class RemoteTable:
         self._call({"verb": "shutdown"})
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._closed = True
+        for c in self._pool:
+            if c.sock is not None:
+                try:
+                    c.sock.close()
+                except OSError:
+                    pass
+                c.sock = None
+
+
+class RemoteCoordinator(RemoteTable):
+    """Client for the server's worker-coordination plane: SSP clocks,
+    preduce matchmaking, and group grad reduction — the DCN face of
+    SSPController/_ArrayReducer/PReduceScheduler, so HetPipe replicas in
+    separate processes share one authority (reference psf/ssp.h +
+    preduce_handler.cc)."""
+
+    def __init__(self, host, port, **kw):
+        kw.setdefault("pool_size", 1)
+        super().__init__(host, port, fetch_meta=False, **kw)
+
+    # SSPController face
+    def tick(self, worker):
+        self._clocks = self._call({"verb": "tick", "worker": int(worker)}
+                                  )[0]["clocks"]
+
+    def clocks(self):
+        reply = self._call({"verb": "clocks"})[0]
+        self.staleness = reply["staleness"]
+        return reply["clocks"]
+
+    # PReduceScheduler face
+    def get_partner(self, key, rank, target=-1, wait_time=100.0):
+        reply = self._call({"verb": "preduce_join", "round": int(key),
+                            "rank": int(rank), "target": int(target),
+                            "wait_ms": float(wait_time)})[0]
+        return tuple(reply["partner"])
+
+    # _ThreadReducer face (jax pytrees in/out)
+    def reduce(self, round_id, rank, group, grads):
+        import jax
+        import jax.numpy as jnp
+        leaves = [np.asarray(l, np.float32)
+                  for l in jax.tree_util.tree_leaves(grads)]
+        tree = jax.tree_util.tree_structure(grads)
+        reply, payloads = self._call(
+            {"verb": "reduce", "round": int(round_id), "rank": int(rank),
+             "group": [int(g) for g in group],
+             "shapes": [list(l.shape) for l in leaves]},
+            *leaves)
+        out = [jnp.asarray(np.frombuffer(p, "<f4").reshape(s))
+               for p, s in zip(payloads, reply["shapes"])]
+        return jax.tree_util.tree_unflatten(tree, out)
+
+
+def serve_dense_params(shapes, host="127.0.0.1", port=0, optimizer="sgd",
+                       lr=0.01, nworkers=None, staleness=1, **opt_kwargs):
+    """One server holding a named table per dense param leaf (+ the
+    coordination plane): the HetPipe PS for multi-process replicas.
+    ``shapes``: [(rows, dim)] per leaf, tables named 'leaf0'..'leafN'."""
+    from .store import EmbeddingTable
+    tables = {
+        f"leaf{i}": EmbeddingTable(r, d, optimizer=optimizer, lr=lr,
+                                   init_scale=0, **opt_kwargs)
+        for i, (r, d) in enumerate(shapes)}
+    return PSServer(tables, host=host, port=port, nworkers=nworkers,
+                    staleness=staleness)
 
 
 def main(argv=None):
@@ -216,19 +544,42 @@ def main(argv=None):
     from .store import EmbeddingTable
 
     ap = argparse.ArgumentParser(prog="hetu_tpu.ps.rpc")
-    ap.add_argument("--rows", type=int, required=True)
-    ap.add_argument("--dim", type=int, required=True)
+    ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument("--dim", type=int, default=None)
+    ap.add_argument("--dense-leaves", default=None,
+                    help="'RxD,RxD,...' — serve one named table per dense "
+                         "param leaf (HetPipe PS role) instead of a "
+                         "single sparse table")
+    ap.add_argument("--nworkers", type=int, default=None,
+                    help="attach the worker-coordination plane (SSP "
+                         "clocks, preduce matchmaking, group reduce)")
+    ap.add_argument("--staleness", type=int, default=1)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--optimizer", default="sgd")
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--init-scale", type=float, default=None)
+    ap.add_argument("--load", default=None,
+                    help="restore table state from this path at bring-up "
+                         "(server restart mid-training)")
     ns = ap.parse_args(argv)
-    table = EmbeddingTable(ns.rows, ns.dim, optimizer=ns.optimizer,
-                           lr=ns.lr, seed=ns.seed,
-                           init_scale=ns.init_scale)
-    server = PSServer(table, host=ns.host, port=ns.port)
+    if ns.dense_leaves:
+        shapes = [tuple(int(v) for v in leaf.split("x"))
+                  for leaf in ns.dense_leaves.split(",")]
+        server = serve_dense_params(
+            shapes, host=ns.host, port=ns.port, optimizer=ns.optimizer,
+            lr=ns.lr, nworkers=ns.nworkers, staleness=ns.staleness)
+    else:
+        if ns.rows is None or ns.dim is None:
+            ap.error("--rows/--dim required without --dense-leaves")
+        table = EmbeddingTable(ns.rows, ns.dim, optimizer=ns.optimizer,
+                               lr=ns.lr, seed=ns.seed,
+                               init_scale=ns.init_scale)
+        if ns.load:
+            table.load(ns.load)
+        server = PSServer(table, host=ns.host, port=ns.port,
+                          nworkers=ns.nworkers, staleness=ns.staleness)
     # parseable bring-up line for launchers (reference DMLC env handshake)
     print(f"PS_SERVER_READY {server.host} {server.port}", flush=True)
     server.serve_forever()

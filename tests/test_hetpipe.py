@@ -1,6 +1,9 @@
 """HetPipe (pipeline + PS) and preduce-pipeline tests (reference:
 pipedream_subexecutor.py:78-88 hetpipe/preduce modes)."""
 
+import json
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -161,3 +164,83 @@ def test_thread_reducer_means():
     np.testing.assert_allclose(np.asarray(results[0]["x"]), 2.0)
     np.testing.assert_allclose(np.asarray(results[1]["x"]), 2.0)
     assert red._rounds == {}   # cleaned up
+
+
+# -- cross-PROCESS HetPipe/preduce (VERDICT #10) ---------------------------
+
+import re as _re
+import subprocess as _subprocess
+import sys as _sys
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _spawn_coord_server(dense_leaves, nworkers, lr):
+    proc = _subprocess.Popen(
+        [_sys.executable, "-m", "hetu_tpu.ps.rpc",
+         "--dense-leaves", dense_leaves, "--nworkers", str(nworkers),
+         "--staleness", "1", "--optimizer", "sgd", "--lr", str(lr),
+         "--port", "0"],
+        cwd=_REPO, stdout=_subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    m = _re.match(r"PS_SERVER_READY (\S+) (\d+)", line)
+    assert m, f"server failed to start: {line!r}"
+    return proc, m.group(1), int(m.group(2))
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("mode", ["hetpipe", "preduce"])
+def test_hetpipe_replicas_as_real_processes(mode, tmp_path):
+    """Two worker PROCESSES run the HetPipe pipeline + weight sync against
+    one PSServer (server-held SSP clocks / matchmaking / group reduce),
+    with worker 1 an injected straggler.  Reference
+    pipedream_subexecutor.py:78-88 over ps-lite, here over the DCN RPC
+    plane."""
+    nworkers, steps = 2, 4
+    # leaf shapes for params {"b": [2, 8], "w": [2, 8, 8]} — tree_leaves
+    # order is alphabetical: b -> 2x8, w -> 2x64
+    server, host, port = _spawn_coord_server("2x8,2x64", nworkers, lr=0.05)
+    script = os.path.join(_REPO, "examples", "parallel",
+                          "hetpipe_worker.py")
+    workers = []
+    try:
+        for rank in range(nworkers):
+            straggle = 200.0 if rank == 1 else 0.0
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            workers.append(_subprocess.Popen(
+                [_sys.executable, script, f"{host}:{port}", mode,
+                 str(rank), str(nworkers), str(steps), str(straggle),
+                 str(tmp_path)],
+                cwd=_REPO, env=env, stdout=_subprocess.PIPE,
+                stderr=_subprocess.STDOUT, text=True))
+        for w in workers:
+            out, _ = w.communicate(timeout=240)
+            assert w.returncode == 0, f"worker failed:\n{out}"
+        results = []
+        for rank in range(nworkers):
+            with open(tmp_path / f"hetpipe_{rank}.json") as f:
+                results.append(json.load(f))
+        for r in results:
+            assert len(r["losses"]) == steps
+            assert np.isfinite(r["losses"]).all()
+            # training converged across the sync protocol
+            assert r["losses"][-1] < r["losses"][0]
+        if mode == "hetpipe":
+            # server-held SSP clocks advanced for both replicas; the
+            # straggler may lag by the staleness bound at snapshot time
+            clocks = results[0]["clocks"]
+            assert clocks[0] == steps, clocks
+            assert all(c >= steps - 2 for c in clocks), clocks
+        else:
+            # matchmaking ran: groups formed (straggler may fall out of
+            # some windows, but at least one full group must have formed
+            # across the run for the averaging to be cross-process)
+            sizes = [s for r in results for s in r["group_sizes"]]
+            assert max(sizes) == nworkers, sizes
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+        if server.poll() is None:
+            server.kill()

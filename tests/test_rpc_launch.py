@@ -147,3 +147,176 @@ def test_launcher_spawns_two_jax_distributed_workers(rng, tmp_path):
                 w.kill()
         if proc.poll() is None:
             proc.kill()
+
+
+# -- fault tolerance (reference ps-lite/src/resender.h, van.cc:105) --------
+
+def _spawn_server_at(rows, dim, port, lr=1.0, load=None):
+    cmd = [sys.executable, "-m", "hetu_tpu.ps.rpc", "--rows", str(rows),
+           "--dim", str(dim), "--port", str(port), "--optimizer", "sgd",
+           "--lr", str(lr), "--init-scale", "0"]
+    if load:
+        cmd += ["--load", str(load)]
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                            text=True)
+    line = proc.stdout.readline()
+    m = re.match(r"PS_SERVER_READY (\S+) (\d+)", line)
+    assert m, f"server failed to start: {line!r}"
+    return proc, m.group(1), int(m.group(2))
+
+
+def test_retransmitted_push_is_deduplicated(rng):
+    """A push replayed with the SAME (cid, seq) — what the client does
+    after a lost reply — must apply exactly once (resender.h ack-cache)."""
+    from hetu_tpu.ps.rpc import PSServer, send_msg, recv_msg
+    import socket as socket_mod
+
+    table = EmbeddingTable(16, 4, optimizer="sgd", lr=1.0, init_scale=0)
+    server = PSServer(table).start()
+    try:
+        sock = socket_mod.create_connection((server.host, server.port))
+        keys = np.array([3], "<i8")
+        grads = np.ones((1, 4), "<f4")
+        for _ in range(3):   # same seq replayed thrice
+            send_msg(sock, {"verb": "push", "cid": "t1", "seq": 7},
+                     keys, grads)
+            reply, _ = recv_msg(sock)
+            assert reply["verb"] == "ok"
+        # sgd lr=1: one application -> -1.0; three -> -3.0
+        assert float(table.lookup(np.array([3]))[0, 0]) == -1.0
+        # a NEW seq applies again
+        send_msg(sock, {"verb": "push", "cid": "t1", "seq": 8},
+                 keys, grads)
+        recv_msg(sock)
+        assert float(table.lookup(np.array([3]))[0, 0]) == -2.0
+        sock.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.timeout(120)
+def test_server_kill_restart_mid_training(rng, tmp_path):
+    """VERDICT #4 done-criterion: SIGKILL the PS server process
+    mid-training; the client blocks, retries, reconnects to the restarted
+    server (state restored from checkpoint) and training converges — the
+    final table matches an oracle that saw every push exactly once."""
+    rows, dim = 32, 4
+    proc, host, port = _spawn_server(rows, dim, lr=1.0)
+    ckpt = str(tmp_path / "ps_shard.bin")
+    oracle = EmbeddingTable(rows, dim, optimizer="sgd", lr=1.0,
+                            init_scale=0)
+    try:
+        remote = RemoteTable(host, port, timeout=5.0, retry_deadline=60.0)
+        keys = np.arange(8)
+        g1 = rng.standard_normal((8, dim)).astype(np.float32)
+        for _ in range(3):
+            remote.push(keys, g1)
+            oracle.push(keys, g1)
+        remote.save(ckpt)
+
+        proc.kill()          # hard failure, no goodbye
+        proc.wait()
+
+        # push during the outage from a worker thread: must block in the
+        # retry loop, not raise
+        g2 = rng.standard_normal((8, dim)).astype(np.float32)
+        err = []
+        import threading as threading_mod
+        t = threading_mod.Thread(
+            target=lambda: (remote.push(keys, g2)
+                            if not err else None))
+        t.start()
+        time.sleep(1.0)      # server stays dead a while
+        assert t.is_alive()  # still retrying, not crashed
+
+        proc2, _, port2 = _spawn_server_at(rows, dim, port, lr=1.0,
+                                           load=ckpt)
+        assert port2 == port
+        t.join(timeout=60)
+        assert not t.is_alive(), "push did not complete after restart"
+        oracle.push(keys, g2)
+
+        # training continues and converges to the oracle state
+        g3 = rng.standard_normal((8, dim)).astype(np.float32)
+        remote.push(keys, g3)
+        oracle.push(keys, g3)
+        np.testing.assert_allclose(remote.lookup(np.arange(rows)),
+                                   oracle.lookup(np.arange(rows)),
+                                   rtol=1e-6)
+        remote.shutdown_server()
+        remote.close()
+        proc2.wait(timeout=10)
+    finally:
+        for p in (proc,):
+            if p.poll() is None:
+                p.kill()
+        try:
+            if proc2.poll() is None:
+                proc2.kill()
+        except NameError:
+            pass
+
+
+def test_connection_pool_overlaps_lookup_and_push():
+    """weak #6 done-criterion: with pool_size=2, a slow lookup and a slow
+    push overlap in wall time instead of serializing on one socket."""
+    from hetu_tpu.ps.rpc import PSServer
+
+    class SlowTable:
+        rows, dim = 16, 4
+
+        def __init__(self):
+            self.inner = EmbeddingTable(16, 4, optimizer="sgd", lr=1.0,
+                                        init_scale=0)
+
+        def lookup(self, keys):
+            time.sleep(0.4)
+            return self.inner.lookup(keys)
+
+        def push(self, keys, grads):
+            time.sleep(0.4)
+            self.inner.push(keys, grads)
+
+    server = PSServer(SlowTable()).start()
+    try:
+        import threading as threading_mod
+        remote = RemoteTable(server.host, server.port, pool_size=2)
+        keys = np.arange(4)
+        grads = np.ones((4, 4), np.float32)
+        start = time.monotonic()
+        t = threading_mod.Thread(target=remote.push, args=(keys, grads))
+        t.start()
+        remote.lookup(keys)
+        t.join()
+        elapsed = time.monotonic() - start
+        # serialized would be >= 0.8s; overlapped ~0.4s
+        assert elapsed < 0.7, f"lookup+push serialized ({elapsed:.2f}s)"
+        remote.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.timeout(120)
+def test_heartbeat_detects_dead_server_and_recovery():
+    """Client heartbeats mark a SIGKILLed server dead within ~2 intervals
+    and alive again once it restarts (van.cc:105 heartbeat semantics)."""
+    proc, host, port = _spawn_server(8, 2, lr=1.0)
+    remote = RemoteTable(host, port, timeout=1.0, pool_size=1,
+                         retry_deadline=2.0, heartbeat_interval=0.2)
+    proc2 = None
+    try:
+        time.sleep(0.7)
+        assert remote.alive
+        proc.kill()
+        proc.wait()
+        time.sleep(3.5)      # > retry deadline + 2 intervals
+        assert not remote.alive
+        proc2, _, _ = _spawn_server_at(8, 2, port, lr=1.0)
+        time.sleep(2.0)
+        assert remote.alive
+        remote.shutdown_server()
+    finally:
+        remote.close()
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
