@@ -5,13 +5,20 @@ slices the dataset by dp_rank/dp_nrank and prefetches batches through
 multiprocess queues; `DataloaderOp` (:289) is a graph node whose value the
 executor pulls per step (per named subgraph: 'default'/'train'/'validate').
 
-TPU redesign: feeding is host-side (no kernels involved), so the pipeline is
-a background *thread* + bounded queue per dataloader — processes buy nothing
-here because batch assembly is numpy slicing (GIL-releasing) and the XLA
-step fully overlaps it; the queue depth plays the role of the reference's
-batch_num prefetch window.  `DataloaderOp` follows the executor's
-placeholder-autofill protocol (same hook as ps/embedding.PSRowsOp): the
-executor asks the node for the next batch instead of requiring a feed.
+TPU redesign: feeding is host-side (no kernels involved).  Plain batch
+slicing runs on a background *thread* + bounded queue — numpy slicing
+releases the GIL and the XLA step fully overlaps it; the queue depth plays
+the role of the reference's batch_num prefetch window.  A Python
+``transform`` (augmentation, tokenization) is GIL-BOUND, so
+``num_workers>0`` switches to the reference's architecture (worker
+processes + shared memory, dataloader.py:125): the dataset is published
+once into a SharedMemory block, workers apply the transform and write
+batches into a fixed ring of shared-memory slots (slot i%S guarded by an
+empty/filled semaphore pair), and the consumer drains the ring in batch
+order — deterministic regardless of worker timing.  `DataloaderOp`
+follows the executor's placeholder-autofill protocol (same hook as
+ps/embedding.PSRowsOp): the executor asks the node for the next batch
+instead of requiring a feed.
 """
 
 from __future__ import annotations
@@ -22,6 +29,132 @@ import threading
 import numpy as np
 
 from .graph.node import PlaceholderOp
+
+
+def _mp_worker(worker_id, num_workers, stop, data_shm_name, data_shape,
+               data_dtype, out_shm_name, out_shape, out_dtype, slots,
+               empty_sems, filled_sems, batch_size, num_batches, shuffle,
+               seed, transform):
+    """Worker process body: handles batches i with i % num_workers ==
+    worker_id, writing each into ring slot i % slots."""
+    from multiprocessing import shared_memory
+    data_shm = shared_memory.SharedMemory(name=data_shm_name)
+    out_shm = shared_memory.SharedMemory(name=out_shm_name)
+    try:
+        data = np.ndarray(data_shape, dtype=data_dtype, buffer=data_shm.buf)
+        ring = np.ndarray((slots,) + out_shape, dtype=out_dtype,
+                          buffer=out_shm.buf)
+        # GLOBAL batch counter g (continuous across epochs): the consumer
+        # drains slot g % slots in g order, so the slot index must come
+        # from g, not the within-epoch index — the within-epoch form
+        # collides as soon as num_batches % slots != 0
+        g = worker_id
+        order, order_epoch = None, -1
+        while not stop.is_set():
+            epoch, i = divmod(g, num_batches)
+            if epoch != order_epoch:
+                # every worker derives the SAME per-epoch order from the
+                # seed, so index-sharding keeps global order deterministic
+                order = (np.random.default_rng((seed, epoch))
+                         .permutation(data_shape[0])
+                         if shuffle else np.arange(data_shape[0]))
+                order_epoch = epoch
+            sel = order[i * batch_size:(i + 1) * batch_size]
+            batch = data[sel]
+            if transform is not None:
+                batch = np.asarray(transform(batch), dtype=out_dtype)
+            slot = g % slots
+            while not stop.is_set():
+                if empty_sems[slot].acquire(timeout=0.1):
+                    break
+            else:
+                return
+            ring[slot] = batch
+            filled_sems[slot].release()
+            g += num_workers
+    finally:
+        data_shm.close()
+        out_shm.close()
+
+
+class _MPEngine:
+    """Worker processes + shared-memory ring (reference dataloader.py:125
+    multiprocess queues, rebuilt on SharedMemory instead of pickled Queue
+    traffic — one copy out of the ring per batch, zero per-batch pickling)."""
+
+    def __init__(self, data, batch_size, num_batches, shuffle, seed,
+                 num_workers, prefetch, transform):
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+        # spawn: never fork a process that may hold a live XLA client
+        self._mp = mp.get_context("spawn")
+        self.num_batches = num_batches
+        if data.shape[0] < num_batches * batch_size:
+            # a ragged tail batch can't share the fixed-shape ring slots
+            # (and XLA would retrace on it anyway)
+            raise ValueError(
+                "num_workers > 0 requires drop_last=True (ragged final "
+                f"batch: {data.shape[0]} rows, batch {batch_size})")
+        # ring slots: >= the worker fan-out (a worker blocking on a slot
+        # must not deadlock the ring) AND a MULTIPLE of num_workers — the
+        # consumer's slot-(g % slots) discipline assumes slot s is always
+        # refilled by the same worker ((g + slots) % W == g % W); with an
+        # indivisible slot count a fast worker could steal a slot one
+        # epoch ahead and the consumer would read the wrong batch
+        slots = max(2 * num_workers, int(prefetch))
+        slots += (-slots) % num_workers
+        probe = data[:batch_size]
+        if transform is not None:
+            probe = np.asarray(transform(probe))
+        self._out_shape = probe.shape
+        self._out_dtype = probe.dtype
+        self._data_shm = shared_memory.SharedMemory(
+            create=True, size=data.nbytes)
+        np.ndarray(data.shape, data.dtype,
+                   buffer=self._data_shm.buf)[...] = data
+        self._out_shm = shared_memory.SharedMemory(
+            create=True, size=int(np.prod((slots,) + probe.shape)
+                                  * probe.dtype.itemsize))
+        self._ring = np.ndarray((slots,) + probe.shape, probe.dtype,
+                                buffer=self._out_shm.buf)
+        self._slots = slots
+        self._stop = self._mp.Event()
+        self._empty = [self._mp.Semaphore(1) for _ in range(slots)]
+        self._filled = [self._mp.Semaphore(0) for _ in range(slots)]
+        self._procs = [
+            self._mp.Process(
+                target=_mp_worker,
+                args=(w, num_workers, self._stop, self._data_shm.name,
+                      data.shape, data.dtype, self._out_shm.name,
+                      probe.shape, probe.dtype, slots, self._empty,
+                      self._filled, batch_size, num_batches, shuffle,
+                      seed, transform),
+                daemon=True)
+            for w in range(num_workers)]
+        for p in self._procs:
+            p.start()
+        self._cursor = 0
+
+    def next_batch(self):
+        slot = self._cursor % self._slots
+        self._filled[slot].acquire()
+        batch = self._ring[slot].copy()
+        self._empty[slot].release()
+        self._cursor += 1
+        return batch
+
+    def stop(self):
+        self._stop.set()
+        for p in self._procs:
+            p.join(timeout=2.0)
+            if p.is_alive():
+                p.terminate()
+        for shm in (self._data_shm, self._out_shm):
+            try:
+                shm.close()
+                shm.unlink()
+            except FileNotFoundError:
+                pass
 
 
 class Dataloader:
@@ -36,7 +169,8 @@ class Dataloader:
 
     def __init__(self, raw_data, batch_size, shuffle=False, drop_last=True,
                  dp_rank=0, dp_nrank=1, seed=0, prefetch=2, name="data",
-                 device_prefetch=False, dtype=None):
+                 device_prefetch=False, dtype=None, transform=None,
+                 num_workers=0):
         data = np.asarray(raw_data)
         if dp_nrank > 1:
             # contiguous equal shards; tail dropped so every rank agrees
@@ -54,11 +188,17 @@ class Dataloader:
         # costs a full link round trip; on TPU-VM it's PCIe time)
         self.device_prefetch = device_prefetch
         self.dtype = dtype
-        self._rng = np.random.default_rng(seed + dp_rank)
+        # transform: per-batch augmentation/tokenization callable.  Pure
+        # Python transforms are GIL-bound — pair with num_workers>0 to
+        # run them in worker processes (reference dataloader.py:125);
+        # must be picklable (module-level function) in that case.
+        self.transform = transform
+        self.num_workers = int(num_workers)
+        self._seed = seed + dp_rank
+        self._prefetch = prefetch
         self._queue = queue.Queue(maxsize=prefetch)
-        self._epoch_order = None
-        self._cursor = 0
         self._thread = None
+        self._engine = None
         self._stop = threading.Event()
         if self.num_batches == 0:
             raise ValueError(
@@ -76,15 +216,25 @@ class Dataloader:
     def get_batch_num(self, name=None):
         return self.num_batches
 
+    def _epoch_perm(self, epoch):
+        # keyed by (seed, epoch) — the exact stream the MP workers use, so
+        # thread and process engines yield identical batch sequences
+        return (np.random.default_rng((self._seed, epoch))
+                .permutation(self.data.shape[0])
+                if self.shuffle else np.arange(self.data.shape[0]))
+
     def _producer(self):
+        epoch = 0
         while not self._stop.is_set():
-            order = (self._rng.permutation(self.data.shape[0])
-                     if self.shuffle else np.arange(self.data.shape[0]))
+            order = self._epoch_perm(epoch)
+            epoch += 1
             for i in range(self.num_batches):
                 if self._stop.is_set():
                     return
                 sel = order[i * self.batch_size:(i + 1) * self.batch_size]
                 batch = self.data[sel]
+                if self.transform is not None:
+                    batch = np.asarray(self.transform(batch))
                 if self.device_prefetch:
                     import jax
                     import jax.numpy as jnp
@@ -98,6 +248,13 @@ class Dataloader:
                         continue
 
     def start(self):
+        if self.num_workers > 0:
+            if self._engine is None:
+                self._engine = _MPEngine(
+                    self.data, self.batch_size, self.num_batches,
+                    self.shuffle, self._seed, self.num_workers,
+                    self._prefetch, self.transform)
+            return self
         if self._thread is None:
             self._thread = threading.Thread(target=self._producer,
                                             daemon=True)
@@ -106,18 +263,40 @@ class Dataloader:
 
     def next_batch(self):
         self.start()
+        if self._engine is not None:
+            batch = self._engine.next_batch()
+            if self.device_prefetch:
+                import jax
+                import jax.numpy as jnp
+                batch = jax.device_put(jnp.asarray(batch, dtype=self.dtype))
+            return batch
         return self._queue.get()
 
     def stop(self):
         self._stop.set()
+        if self._engine is not None:
+            self._engine.stop()
+            self._engine = None
+
+    @property
+    def batch_shape(self):
+        """[batch, ...] shape AFTER the transform (what the graph sees)."""
+        base = (self.batch_size,) + self.data.shape[1:]
+        if self.transform is None:
+            return base
+        return np.asarray(
+            self.transform(self.data[:self.batch_size])).shape
 
     def __iter__(self):
-        """Single-epoch iteration without the prefetch thread (eval loops)."""
-        order = (self._rng.permutation(self.data.shape[0])
-                 if self.shuffle else np.arange(self.data.shape[0]))
+        """Single-epoch iteration without the prefetch machinery (eval
+        loops)."""
+        order = self._epoch_perm(0)
         for i in range(self.num_batches):
             sel = order[i * self.batch_size:(i + 1) * self.batch_size]
-            yield self.data[sel]
+            batch = self.data[sel]
+            if self.transform is not None:
+                batch = np.asarray(self.transform(batch))
+            yield batch
 
 
 class DataloaderOp(PlaceholderOp):
@@ -136,9 +315,8 @@ class DataloaderOp(PlaceholderOp):
             dataloaders = {"default": dataloaders}
         self.dataloaders = dataloaders
         some = next(iter(dataloaders.values()))
-        shape = (some.batch_size,) + some.data.shape[1:]
-        super().__init__(name or f"dataloader_{some.name}", shape=shape,
-                         dtype=dtype)
+        super().__init__(name or f"dataloader_{some.name}",
+                         shape=tuple(some.batch_shape), dtype=dtype)
 
     def auto_feed(self, subgraph_name):
         dl = self.dataloaders.get(subgraph_name)
