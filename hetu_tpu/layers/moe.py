@@ -22,7 +22,8 @@ from ..graph.node import Op, VariableOp
 from .. import initializers as init
 from ..ops.moe import (top_k_gating, hash_gating, ktop1_gating, sam_gating,
                        base_balance_gating, top_k_balance_aux,
-                       ktop1_balance_aux, sam_balance_aux)
+                       ktop1_balance_aux, sam_balance_aux,
+                       top_k_gating_choices, hash_gating_choices)
 
 
 def _orthogonal_rows(rng, rows, cols, gain=0.1):
@@ -48,6 +49,9 @@ class TopKGate(BaseLayer):
     def gating(self, tokens, wg, ids, k, capacity):
         return top_k_gating(tokens @ wg, k, capacity)
 
+    def gating_choices(self, tokens, wg, ids, k, capacity):
+        return top_k_gating_choices(tokens @ wg, k, capacity)
+
     def aux(self, tokens, wg, ids, k):
         return top_k_balance_aux(tokens @ wg)
 
@@ -65,6 +69,10 @@ class HashGate(BaseLayer):
     def gating(self, tokens, wg, ids, k, capacity):
         return hash_gating(ids.reshape(-1), self.num_experts, capacity,
                            dtype=tokens.dtype)
+
+    def gating_choices(self, tokens, wg, ids, k, capacity):
+        return hash_gating_choices(ids.reshape(-1), self.num_experts,
+                                   capacity, dtype=tokens.dtype)
 
 
 class KTop1Gate(BaseLayer):
@@ -124,7 +132,7 @@ class _MoEOp(Op):
     sharding annotations stay local to the op)."""
 
     def __init__(self, x, gate, w1, b1, w2, b2, num_experts, capacity_factor,
-                 k, ep_axis=None, ids=None, name=None):
+                 k, ep_axis=None, ids=None, sparse=True, name=None):
         inputs = [x, w1, b1, w2, b2]
         if gate.wg is not None:
             inputs.append(gate.wg)
@@ -136,6 +144,7 @@ class _MoEOp(Op):
         self.capacity_factor = capacity_factor
         self.k = k
         self.ep_axis = ep_axis
+        self.sparse = sparse
         self.has_ids = ids is not None
 
     def _unpack(self, input_vals):
@@ -153,6 +162,7 @@ class _MoEOp(Op):
     def _compute(self, input_vals, ctx):
         import jax
         import jax.numpy as jnp
+        from ..ops.moe import sparse_dispatch, sparse_combine
         x, w1, b1, w2, b2, wg, ids = self._unpack(input_vals)
 
         orig_shape = x.shape
@@ -161,10 +171,26 @@ class _MoEOp(Op):
         T = tokens.shape[0]
         C = self._capacity(T)
 
-        dispatch, combine, aux = self.gate.gating(tokens, wg, ids,
-                                                  self.k, C)
-
-        expert_in = jnp.einsum("tec,th->ech", dispatch, tokens)
+        # scatter-style dispatch (reference LayoutTransform.cu) when the
+        # gate exposes routing CHOICES: memory is O(T·H + E·C·H), never
+        # the O(T·E·C) one-hot tensors of the dense einsum form — at real
+        # T·E·C those are the memory wall (SURVEY §2.1 N3).  Gates
+        # without a choices form (BASE auction) keep the dense path.
+        sparse = self.sparse and hasattr(self.gate, "gating_choices")
+        if sparse:
+            choices, aux = self.gate.gating_choices(tokens, wg, ids,
+                                                    self.k, C)
+            # pallas_call does not partition under GSPMD: inside ANY
+            # meshed program (ep-sharded or just dp) the gather lowers
+            # via XLA instead
+            pallas_ok = ctx.mesh is None
+            expert_in = sparse_dispatch(tokens, choices,
+                                        self.num_experts, C,
+                                        use_pallas=pallas_ok)
+        else:
+            dispatch, combine, aux = self.gate.gating(tokens, wg, ids,
+                                                      self.k, C)
+            expert_in = jnp.einsum("tec,th->ech", dispatch, tokens)
         if self.ep_axis is not None and ctx.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             expert_in = jax.lax.with_sharding_constraint(
@@ -178,7 +204,11 @@ class _MoEOp(Op):
             from jax.sharding import NamedSharding, PartitionSpec as P
             out = jax.lax.with_sharding_constraint(
                 out, NamedSharding(ctx.mesh, P(self.ep_axis, None, None)))
-        combined = jnp.einsum("ech,tec->th", out, combine)
+        if sparse:
+            combined = sparse_combine(out, choices,
+                                      use_pallas=pallas_ok)
+        else:
+            combined = jnp.einsum("ech,tec->th", out, combine)
         return combined.reshape(orig_shape)
 
 
@@ -217,7 +247,7 @@ class MoELayer(BaseLayer):
 
     def __init__(self, hidden_size, intermediate_size, num_experts, k=2,
                  capacity_factor=1.25, gate="top", ep_axis=None,
-                 num_groups=None, name=None):
+                 num_groups=None, sparse=True, name=None):
         name = fresh_name(name or "moe")
         if isinstance(gate, BaseLayer):
             self.gate = gate                      # caller-built gate
@@ -248,6 +278,10 @@ class MoELayer(BaseLayer):
         self.capacity_factor = capacity_factor
         self.k = k
         self.ep_axis = ep_axis
+        # sparse=False forces the dense one-hot einsum dispatch (debug /
+        # exactness oracle); sparse routing needs a gate with a choices
+        # form and is the default memory-safe path
+        self.sparse = sparse
         if ep_axis is not None:
             for v in (self.w1, self.b1, self.w2, self.b2):
                 from ..parallel.mesh import DistState
@@ -261,7 +295,8 @@ class MoELayer(BaseLayer):
         self.last_op = _MoEOp(x, self.gate, self.w1, self.b1, self.w2,
                               self.b2, self.num_experts,
                               self.capacity_factor, self.k,
-                              ep_axis=self.ep_axis, ids=ids)
+                              ep_axis=self.ep_axis, ids=ids,
+                              sparse=self.sparse)
         return self.last_op
 
     def aux_loss(self):

@@ -31,6 +31,21 @@ def top_k_gating(logits, k, capacity, *, second_renorm=True,
     capacity C are dropped (zero rows), as in the reference TopGate
     (python/hetu/layers/TopGate.py GShard top-2 with capacity).
     """
+    choices, aux = top_k_gating_choices(
+        logits, k, capacity, second_renorm=second_renorm,
+        noise_rng=noise_rng, noise_eps=noise_eps)
+    T, E = logits.shape
+    dispatch, combine = _accumulate_dispatch(T, E, capacity, choices,
+                                             logits.dtype)
+    return dispatch, combine, aux
+
+
+def top_k_gating_choices(logits, k, capacity, *, second_renorm=True,
+                         noise_rng=None, noise_eps=0.0):
+    """``top_k_gating`` in CHOICES form — [(expert_idx, gate, pos)] per
+    routing choice plus the aux loss, never materializing the [T, E, C]
+    dispatch/combine tensors (the sparse dispatch path feeds these to
+    ops/pallas/moe_dispatch.row_gather)."""
     if k not in (1, 2):
         raise ValueError(f"top_k_gating supports k in (1, 2), got k={k}")
     T, E = logits.shape
@@ -61,9 +76,47 @@ def top_k_gating(logits, k, capacity, *, second_renorm=True,
         denom = total + 1e-9
         choices = [(i, g / denom * (total > 0), p)
                    for (i, g, p) in choices]
-    dispatch, combine = _accumulate_dispatch(T, E, capacity, choices,
-                                             probs.dtype)
-    return dispatch, combine, aux
+    return choices, aux
+
+
+def sparse_dispatch(tokens, choices, num_experts, capacity,
+                    use_pallas=True):
+    """[E, C, H] expert inputs straight from routing choices (reference
+    LayoutTransform.cu) — a row gather by the slot→token inverse map; the
+    O(T·E·C) one-hot tensors never exist."""
+    from .pallas.moe_dispatch import row_gather
+    T, H = tokens.shape
+    S = num_experts * capacity
+    slot_tok = jnp.full((S,), -1, jnp.int32)
+    for idx, gate, pos in choices:
+        keep = (pos < capacity) & (gate > 0)
+        slot = jnp.where(keep,
+                         idx.astype(jnp.int32) * capacity
+                         + pos.astype(jnp.int32), S)
+        slot_tok = slot_tok.at[slot].set(
+            jnp.arange(T, dtype=jnp.int32), mode="drop",
+            unique_indices=True)
+    return row_gather(tokens, slot_tok, use_pallas).reshape(
+        num_experts, capacity, H)
+
+
+def sparse_combine(expert_out, choices, use_pallas=True):
+    """[T, H] outputs from [E, C, H] expert results + routing choices
+    (reference ReverseLayoutTransform.cu): per choice, gather the token's
+    slot row and scale by its gate."""
+    from .pallas.moe_dispatch import row_gather
+    E, C, H = expert_out.shape
+    flat = expert_out.reshape(E * C, H)
+    out = None
+    for idx, gate, pos in choices:
+        keep = (pos < C) & (gate > 0)
+        slot = jnp.where(keep,
+                         idx.astype(jnp.int32) * C
+                         + pos.astype(jnp.int32), -1)
+        term = (row_gather(flat, slot, use_pallas)
+                * gate[:, None].astype(flat.dtype))
+        out = term if out is None else out + term
+    return out
 
 
 def top_k_balance_aux(logits):
@@ -110,12 +163,19 @@ def sam_balance_aux(logits, num_groups):
     return balance + alignment
 
 
-def hash_gating(ids, num_experts, capacity, dtype=jnp.float32):
-    """HashGate (reference layers/HashGate.py): expert = id % E, gate = 1."""
+def hash_gating_choices(ids, num_experts, capacity, dtype=jnp.float32):
+    """``hash_gating`` in CHOICES form (see top_k_gating_choices)."""
     T = ids.shape[0]
     idx = jnp.mod(ids.astype(jnp.int32), num_experts)
     mask = jax.nn.one_hot(idx, num_experts, dtype=dtype)
     choices = _choices_with_positions([(mask, jnp.ones((T,), dtype))])
+    return choices, jnp.asarray(0.0, dtype)
+
+
+def hash_gating(ids, num_experts, capacity, dtype=jnp.float32):
+    """HashGate (reference layers/HashGate.py): expert = id % E, gate = 1."""
+    T = ids.shape[0]
+    choices, _ = hash_gating_choices(ids, num_experts, capacity, dtype)
     dispatch, _ = _accumulate_dispatch(T, num_experts, capacity, choices,
                                        dtype)
     return dispatch, dispatch, jnp.asarray(0.0, dtype)

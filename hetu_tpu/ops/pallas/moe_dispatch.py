@@ -1,0 +1,112 @@
+"""MoE layout-transform (token dispatch/combine) via a Pallas row gather.
+
+Reference: src/ops/LayoutTransform.cu / ReverseLayoutTransform.cu — CUDA
+kernels moving each token's row into its (expert, capacity-slot) and back.
+The dense TPU formulation (einsum against one-hot [T, E, C] dispatch
+tensors, ops/moe.py) is MXU-friendly but materializes O(T·E·C) memory —
+the exact wall LayoutTransform.cu exists to avoid (SURVEY §2.1 N3 lists
+this kernel).
+
+TPU redesign: both directions are ROW GATHERS once the routing is known —
+  dispatch: expert_in[slot]  = tokens[slot_to_token[slot]]
+  combine:  out[t]          += gate_c[t] * expert_out[token_to_slot_c[t]]
+so one Pallas kernel serves both.  The gather uses
+PrefetchScalarGridSpec: the index vector is prefetched to SMEM and the
+BlockSpec index_map selects source row idx[i] for grid step i, so the
+pipeline DMAs exactly the rows needed — no one-hot, no [T, E, C]
+anywhere.  XLA's own gather lowering on TPU can fall back to one-hot
+matmul for small row counts, which would reintroduce the memory wall;
+the Pallas kernel makes the row-copy lowering deterministic.
+
+Out-of-range indices (capacity-dropped tokens, empty slots) yield zero
+rows, matching the dense path's zero dispatch rows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _supported(src_shape, dtype):
+    if jax.default_backend() != "tpu":
+        return False
+    n, h = src_shape
+    if h % 128 != 0 or h > 16384:
+        return False
+    return dtype in (jnp.float32, jnp.bfloat16, np.float32)
+
+
+def _make_kernel():
+    import jax.experimental.pallas as pl
+
+    def kernel(n_rows, idx_ref, src_ref, out_ref):
+        i = pl.program_id(0)
+        j = idx_ref[i]
+        # the index_map already clamped the DMA'd block; here we zero
+        # rows whose logical index was out of range on EITHER side (the
+        # contract — and the jnp fallback — zero-fill both)
+        valid = (j >= 0) & (j < n_rows)
+        out_ref[...] = jnp.where(valid, src_ref[...],
+                                 jnp.zeros_like(src_ref))
+    return kernel
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def row_gather(src, idx, use_pallas=True):
+    """out[i] = src[idx[i]] for 0 <= idx[i] < src.shape[0], else zeros.
+
+    Falls back to a jnp take when the Pallas envelope doesn't apply
+    (CPU tests, ragged hidden sizes) or when ``use_pallas`` is False —
+    callers inside GSPMD-sharded programs must pass False, since
+    pallas_call does not partition."""
+    return _row_gather_fwd_impl(src, idx, use_pallas)
+
+
+def _row_gather_fwd_impl(src, idx, use_pallas=True):
+    n, h = src.shape
+    m = idx.shape[0]
+    if not use_pallas or not _supported(src.shape, src.dtype):
+        # jnp.take wraps NEGATIVE indices numpy-style; remap them to an
+        # out-of-bounds sentinel so they fill with zeros like the kernel
+        safe = jnp.where(idx >= 0, idx, n)
+        return jnp.take(src, safe, axis=0, mode="fill", fill_value=0)
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m,),
+        in_specs=[pl.BlockSpec(
+            (1, h), lambda i, idx_ref: (jnp.clip(idx_ref[i], 0, n - 1), 0))],
+        out_specs=pl.BlockSpec((1, h), lambda i, idx_ref: (i, 0)),
+    )
+    import functools as _ft
+    return pl.pallas_call(
+        _ft.partial(_make_kernel(), n),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, h), src.dtype),
+    )(idx.astype(jnp.int32), src)
+
+
+def _row_gather_fwd(src, idx, use_pallas):
+    return _row_gather_fwd_impl(src, idx, use_pallas), (idx, src.shape[0])
+
+
+def _row_gather_bwd(use_pallas, res, ct):
+    idx, n = res
+    # scatter-add of cotangent rows back to their sources; indices are
+    # unique in the MoE use (capacity queue guarantees one token per
+    # slot), but add is correct regardless
+    valid = (idx >= 0) & (idx < n)
+    safe = jnp.clip(idx, 0, n - 1)
+    ct = jnp.where(valid[:, None], ct, 0)
+    d_src = jnp.zeros((n, ct.shape[1]), ct.dtype).at[safe].add(ct)
+    return d_src, None
+
+
+row_gather.defvjp(_row_gather_fwd, _row_gather_bwd)
