@@ -103,10 +103,21 @@ def bench_bert(quick):
 
     from benchmarks.flax_baselines import bert_samples_per_sec
     base = bert_samples_per_sec(B, S, layers=L, steps=max(3, steps // 2))
+    # flash-equipped baseline (jax's public TPU flash kernel) — the bar
+    # is the STRONGER of the two (VERDICT r2 item 5b)
+    try:
+        base_flash = bert_samples_per_sec(B, S, layers=L,
+                                          steps=max(3, steps // 2),
+                                          flash=True)
+    except Exception:
+        base_flash = None
+    bar = max(base, base_flash or 0.0)
     return {"metric": "bert_base_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": round(ours / base, 3),
-            "baseline": {"flax_same_chip": round(base, 2)}}
+            "vs_baseline": round(ours / bar, 3),
+            "baseline": {"flax_same_chip": round(base, 2),
+                         "flax_flash_same_chip":
+                         round(base_flash, 2) if base_flash else None}}
 
 
 def bench_gpt_layer(quick):
@@ -170,15 +181,21 @@ def bench_gpt_layer(quick):
     gc.collect()
 
     from benchmarks.flax_baselines import gpt_layer_fwd_ms
-    if quick:
-        base_ms = gpt_layer_fwd_ms(batch=B, seq=S, hidden=H, heads=heads,
-                                   n_layers=n_layers, reps=reps)
-    else:
-        base_ms = gpt_layer_fwd_ms()
+    kw = dict(batch=B, seq=S, hidden=H, heads=heads,
+              n_layers=n_layers, reps=reps) if quick else {}
+    base_ms = gpt_layer_fwd_ms(**kw)
+    try:
+        base_flash_ms = gpt_layer_fwd_ms(flash=True, **kw)
+    except Exception:
+        base_flash_ms = None
+    bar_ms = min(base_ms, base_flash_ms or base_ms)
     return {"metric": "gpt_2.7b_layer_fwd_ms", "value": round(ours_ms, 4),
             "unit": "ms (lower is better)",
-            "vs_baseline": round(base_ms / ours_ms, 3),
+            "vs_baseline": round(bar_ms / ours_ms, 3),
             "baseline": {"flax_same_chip_ms": round(base_ms, 4),
+                         "flax_flash_same_chip_ms":
+                         round(base_flash_ms, 4) if base_flash_ms
+                         else None,
                          "reference_a100_ms": REFERENCE_A100_GPT_LAYER_MS}}
 
 
@@ -218,10 +235,18 @@ def bench_gpt_e2e(quick):
     gc.collect()
     from benchmarks.flax_baselines import gpt_samples_per_sec
     base = gpt_samples_per_sec(B, S, layers=L, steps=steps)
+    try:
+        base_flash = gpt_samples_per_sec(B, S, layers=L, steps=steps,
+                                         flash=True)
+    except Exception:
+        base_flash = None
+    bar = max(base, base_flash or 0.0)
     return {"metric": "gpt_small_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": round(ours / base, 3),
-            "baseline": {"flax_same_chip": round(base, 2)}}
+            "vs_baseline": round(ours / bar, 3),
+            "baseline": {"flax_same_chip": round(base, 2),
+                         "flax_flash_same_chip":
+                         round(base_flash, 2) if base_flash else None}}
 
 
 def bench_resnet(quick):
