@@ -34,6 +34,25 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REFERENCE_A100_GPT_LAYER_MS = 2.0645  # published in the reference repo
 
 
+def _with_flash_baseline(baseline_fn, lower_is_better=False, **kw):
+    """Measure the stock and flash-equipped flax baselines; the bar is
+    the STRONGER of the two (VERDICT r2 item 5b).  Returns
+    (bar, baseline_dict) with both raw numbers reported."""
+    suffix = "_ms" if lower_is_better else ""
+    base = baseline_fn(**kw)
+    try:
+        base_flash = baseline_fn(flash=True, **kw)
+    except Exception:
+        base_flash = None
+    if lower_is_better:
+        bar = min(base, base_flash if base_flash else base)
+    else:
+        bar = max(base, base_flash or 0.0)
+    return bar, {"flax_same_chip" + suffix: round(base, 4),
+                 "flax_flash_same_chip" + suffix:
+                 round(base_flash, 4) if base_flash else None}
+
+
 def _timeit(fn, reps):
     """Time reps calls of fn; fn must return something SMALL (a scalar or
     loss list).  np.asarray forces real materialization — through the dev
@@ -102,22 +121,12 @@ def bench_bert(quick):
     ours = B / dt
 
     from benchmarks.flax_baselines import bert_samples_per_sec
-    base = bert_samples_per_sec(B, S, layers=L, steps=max(3, steps // 2))
-    # flash-equipped baseline (jax's public TPU flash kernel) — the bar
-    # is the STRONGER of the two (VERDICT r2 item 5b)
-    try:
-        base_flash = bert_samples_per_sec(B, S, layers=L,
-                                          steps=max(3, steps // 2),
-                                          flash=True)
-    except Exception:
-        base_flash = None
-    bar = max(base, base_flash or 0.0)
+    bar, baselines = _with_flash_baseline(
+        bert_samples_per_sec, batch=B, seq_len=S, layers=L,
+        steps=max(3, steps // 2))
     return {"metric": "bert_base_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": round(ours / bar, 3),
-            "baseline": {"flax_same_chip": round(base, 2),
-                         "flax_flash_same_chip":
-                         round(base_flash, 2) if base_flash else None}}
+            "vs_baseline": round(ours / bar, 3), "baseline": baselines}
 
 
 def bench_gpt_layer(quick):
@@ -183,20 +192,13 @@ def bench_gpt_layer(quick):
     from benchmarks.flax_baselines import gpt_layer_fwd_ms
     kw = dict(batch=B, seq=S, hidden=H, heads=heads,
               n_layers=n_layers, reps=reps) if quick else {}
-    base_ms = gpt_layer_fwd_ms(**kw)
-    try:
-        base_flash_ms = gpt_layer_fwd_ms(flash=True, **kw)
-    except Exception:
-        base_flash_ms = None
-    bar_ms = min(base_ms, base_flash_ms or base_ms)
+    bar_ms, baselines = _with_flash_baseline(gpt_layer_fwd_ms,
+                                             lower_is_better=True, **kw)
+    baselines["reference_a100_ms"] = REFERENCE_A100_GPT_LAYER_MS
     return {"metric": "gpt_2.7b_layer_fwd_ms", "value": round(ours_ms, 4),
             "unit": "ms (lower is better)",
             "vs_baseline": round(bar_ms / ours_ms, 3),
-            "baseline": {"flax_same_chip_ms": round(base_ms, 4),
-                         "flax_flash_same_chip_ms":
-                         round(base_flash_ms, 4) if base_flash_ms
-                         else None,
-                         "reference_a100_ms": REFERENCE_A100_GPT_LAYER_MS}}
+            "baseline": baselines}
 
 
 def bench_gpt_e2e(quick):
@@ -234,19 +236,55 @@ def bench_gpt_e2e(quick):
     del ex
     gc.collect()
     from benchmarks.flax_baselines import gpt_samples_per_sec
-    base = gpt_samples_per_sec(B, S, layers=L, steps=steps)
-    try:
-        base_flash = gpt_samples_per_sec(B, S, layers=L, steps=steps,
-                                         flash=True)
-    except Exception:
-        base_flash = None
-    bar = max(base, base_flash or 0.0)
+    bar, baselines = _with_flash_baseline(
+        gpt_samples_per_sec, batch=B, seq_len=S, layers=L, steps=steps)
     return {"metric": "gpt_small_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": round(ours / bar, 3),
-            "baseline": {"flax_same_chip": round(base, 2),
-                         "flax_flash_same_chip":
-                         round(base_flash, 2) if base_flash else None}}
+            "vs_baseline": round(ours / bar, 3), "baseline": baselines}
+
+
+def bench_llama(quick):
+    """Ours: Llama-small causal-LM pretraining step (RoPE + GQA + RMSNorm
+    + SwiGLU — the reference's Galvatron Llama tier,
+    tools/Hetu-Galvatron/galvatron/models/llama) vs a flax twin; the bar
+    is the stronger of stock and flash-equipped baselines."""
+    import jax
+    import jax.numpy as jnp
+    import hetu_tpu as ht
+    from hetu_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if quick:
+        B, S, L, steps = 2, 128, 2, 3
+    else:
+        B, S, L, steps = 8, 1024, 12, 10
+    c = LlamaConfig(vocab_size=32000, hidden_size=768, num_layers=L,
+                    num_heads=12, num_kv_heads=4, intermediate_size=2048,
+                    seq_len=S)
+    rng = np.random.default_rng(0)
+    ids = ht.placeholder_op("lm_ids", (B, S), dtype=np.int32)
+    labels = ht.placeholder_op("lm_labels", (B, S), dtype=np.int32)
+    loss = LlamaForCausalLM(c).loss(ids, labels)
+    opt = ht.AdamWOptimizer(learning_rate=1e-4, weight_decay=0.01)
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]},
+                     compute_dtype=jnp.bfloat16)
+    ids_v = rng.integers(0, c.vocab_size, (B, S))
+    feed = {ids: jnp.asarray(ids_v, jnp.int32),
+            labels: jnp.asarray(np.roll(ids_v, -1, 1), jnp.int32)}
+    out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
+    assert np.isfinite(out[0]), "non-finite loss"
+    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
+    ours = B / dt
+
+    import gc
+    del ex
+    gc.collect()
+    from benchmarks.flax_baselines import llama_samples_per_sec
+    bar, baselines = _with_flash_baseline(
+        llama_samples_per_sec, batch=B, seq_len=S, layers=L, kv_heads=4,
+        steps=steps)
+    return {"metric": "llama_small_train_samples_per_sec_per_chip",
+            "value": round(ours, 2), "unit": "samples/sec",
+            "vs_baseline": round(ours / bar, 3), "baseline": baselines}
 
 
 def bench_resnet(quick):
@@ -359,8 +397,8 @@ def bench_wdl(quick):
 
 
 STAGES = {"bert": bench_bert, "gpt": bench_gpt_layer,
-          "gpt_e2e": bench_gpt_e2e, "resnet": bench_resnet,
-          "moe": bench_moe, "wdl": bench_wdl}
+          "gpt_e2e": bench_gpt_e2e, "llama": bench_llama,
+          "resnet": bench_resnet, "moe": bench_moe, "wdl": bench_wdl}
 
 
 def main():
@@ -398,6 +436,7 @@ def main():
                               "unit": "FAILED", "vs_baseline": None}
     headline = dict(results["bert"])
     headline["extra_metrics"] = [results["gpt"], results["gpt_e2e"],
+                                 results["llama"],
                                  results["resnet"], results["moe"],
                                  results["wdl"]]
     print(json.dumps(headline))

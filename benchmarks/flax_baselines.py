@@ -343,6 +343,101 @@ def gpt_samples_per_sec(batch, seq_len, *, vocab=50257, hidden=768,
 
 
 # --------------------------------------------------------------------------
+# Llama-style causal LM (reference tools/Hetu-Galvatron/galvatron/models/
+# llama configs — the modern-LLM tier; RMSNorm + SwiGLU + RoPE)
+# --------------------------------------------------------------------------
+
+def llama_samples_per_sec(batch, seq_len, *, vocab=32000, hidden=768,
+                          layers=12, heads=12, kv_heads=None, inter=2048,
+                          steps=10, flash=False):
+    import flax.linen as nn
+    import optax
+
+    dtype = jnp.bfloat16
+    kv_heads = kv_heads or heads
+    hd = hidden // heads
+
+    def rope(x):  # [B, S, H, D] -> rotated (HF rotate_half convention)
+        s, d = x.shape[1], x.shape[-1]
+        pos = jnp.arange(s, dtype=jnp.float32)
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, jnp.float32) / d))
+        f = jnp.concatenate([jnp.outer(pos, inv)] * 2, -1)
+        cos, sin = jnp.cos(f)[None, :, None, :], jnp.sin(f)[None, :, None, :]
+        xf = x.astype(jnp.float32)
+        x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+        rot = jnp.concatenate([-x2, x1], -1)
+        return (xf * cos + rot * sin).astype(x.dtype)
+
+    class Layer(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.RMSNorm(dtype=dtype)(x)
+            q = nn.DenseGeneral((heads, hd), use_bias=False, dtype=dtype,
+                                param_dtype=jnp.float32)(h)
+            k = nn.DenseGeneral((kv_heads, hd), use_bias=False, dtype=dtype,
+                                param_dtype=jnp.float32)(h)
+            v = nn.DenseGeneral((kv_heads, hd), use_bias=False, dtype=dtype,
+                                param_dtype=jnp.float32)(h)
+            q, k = rope(q), rope(k)
+            if kv_heads != heads:
+                rep = heads // kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            if flash:
+                o = _flash_core(q, k, v, causal=True)
+            else:
+                mask = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
+                a = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+                a = jax.nn.softmax(jnp.where(mask, a, -1e9), -1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", a.astype(dtype), v)
+            x = x + nn.DenseGeneral(hidden, axis=(-2, -1), use_bias=False,
+                                    dtype=dtype,
+                                    param_dtype=jnp.float32)(o)
+            f = nn.RMSNorm(dtype=dtype)(x)
+            g = nn.Dense(inter, use_bias=False, dtype=dtype)(f)
+            u = nn.Dense(inter, use_bias=False, dtype=dtype)(f)
+            return x + nn.Dense(hidden, use_bias=False,
+                                dtype=dtype)(nn.silu(g) * u)
+
+    class Llama(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            x = nn.Embed(vocab, hidden, dtype=dtype)(ids)
+            for _ in range(layers):
+                x = Layer()(x)
+            x = nn.RMSNorm(dtype=dtype)(x)
+            return nn.Dense(vocab, use_bias=False, dtype=dtype)(x)
+
+    model = Llama()
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    params = model.init(jax.random.key(0), ids)
+    tx = optax.adamw(1e-4, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    def loss_fn(p):
+        ll = jax.nn.log_softmax(
+            model.apply(p, ids).astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(ll, labels[..., None],
+                                             axis=-1)[..., 0])
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    params, opt_state, loss = step(params, opt_state)
+    assert np.isfinite(float(loss))  # float() forces materialization
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state)
+    float(loss)
+    return steps * batch / (time.perf_counter() - start)
+
+
+# --------------------------------------------------------------------------
 # ResNet-18 / CIFAR10 (reference benchmark config #1: examples/cnn)
 # --------------------------------------------------------------------------
 

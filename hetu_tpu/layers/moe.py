@@ -23,7 +23,8 @@ from .. import initializers as init
 from ..ops.moe import (top_k_gating, hash_gating, ktop1_gating, sam_gating,
                        base_balance_gating, top_k_balance_aux,
                        ktop1_balance_aux, sam_balance_aux,
-                       top_k_gating_choices, hash_gating_choices)
+                       top_k_gating_choices, hash_gating_choices,
+                       ktop1_gating_choices, sam_gating_choices)
 
 
 def _orthogonal_rows(rng, rows, cols, gain=0.1):
@@ -87,6 +88,9 @@ class KTop1Gate(BaseLayer):
     def gating(self, tokens, wg, ids, k, capacity):
         return ktop1_gating(tokens @ wg, k, capacity)
 
+    def gating_choices(self, tokens, wg, ids, k, capacity):
+        return ktop1_gating_choices(tokens @ wg, k, capacity)
+
     def aux(self, tokens, wg, ids, k):
         return ktop1_balance_aux(tokens @ wg, k)
 
@@ -104,6 +108,10 @@ class SAMGate(BaseLayer):
 
     def gating(self, tokens, wg, ids, k, capacity):
         return sam_gating(tokens @ wg, k, capacity, self.num_groups)
+
+    def gating_choices(self, tokens, wg, ids, k, capacity):
+        return sam_gating_choices(tokens @ wg, k, capacity,
+                                  self.num_groups)
 
     def aux(self, tokens, wg, ids, k):
         return sam_balance_aux(tokens @ wg, self.num_groups)

@@ -241,11 +241,8 @@ def _accumulate_dispatch(T, E, C, choices, dtype):
     return dispatch, combine
 
 
-def ktop1_gating(logits, k, capacity):
-    """KTop1 gate (reference layers/KTop1Gate.py): experts split into k
-    prototypes of E/k; each token routes top-1 WITHIN every prototype
-    (k assignments total), with an independent balance loss per prototype.
-    """
+def ktop1_gating_choices(logits, k, capacity):
+    """``ktop1_gating`` in CHOICES form (see top_k_gating_choices)."""
     T, E = logits.shape
     assert E % k == 0, "KTop1 needs num_experts divisible by k"
     Ep = E // k
@@ -261,20 +258,23 @@ def ktop1_gating(logits, k, capacity):
                                  * jnp.mean(mask_local, axis=0))
         mask = jax.nn.one_hot(i * Ep + idx_local, E, dtype=probs.dtype)
         masks_gates.append((mask, gate))
-    choices = _choices_with_positions(masks_gates)
+    return _choices_with_positions(masks_gates), aux
+
+
+def ktop1_gating(logits, k, capacity):
+    """KTop1 gate (reference layers/KTop1Gate.py): experts split into k
+    prototypes of E/k; each token routes top-1 WITHIN every prototype
+    (k assignments total), with an independent balance loss per prototype.
+    """
+    T, E = logits.shape
+    choices, aux = ktop1_gating_choices(logits, k, capacity)
     dispatch, combine = _accumulate_dispatch(T, E, capacity, choices,
-                                             probs.dtype)
+                                             logits.dtype)
     return dispatch, combine, aux
 
 
-def sam_gating(logits, k, capacity, num_groups):
-    """SAM gate (reference layers/SAMGate.py): experts form ``num_groups``
-    locality groups (one per host in the reference); each token picks the
-    group with the largest probability mass, then its top-k experts INSIDE
-    that group — keeping all its expert traffic on one host.  Aux = GShard
-    balance loss + an alignment term rewarding the chosen group's mass
-    (adaptation of SamMax.cu's alignment objective).
-    """
+def sam_gating_choices(logits, k, capacity, num_groups):
+    """``sam_gating`` in CHOICES form (see top_k_gating_choices)."""
     T, E = logits.shape
     assert E % num_groups == 0
     Eg = E // num_groups
@@ -298,12 +298,25 @@ def sam_gating(logits, k, capacity, num_groups):
         masks_gates.append((mask, jnp.sum(probs * mask, axis=-1)))
         remaining = jnp.where(mask > 0, -jnp.inf, remaining)
     choices = _choices_with_positions(masks_gates)
-    dispatch, combine = _accumulate_dispatch(T, E, capacity, choices,
-                                             probs.dtype)
     balance = E * jnp.sum(jnp.mean(probs, axis=0)
                           * jnp.mean(first_mask, axis=0))
     alignment = jnp.mean(1.0 - jnp.max(gmass, axis=-1))
-    return dispatch, combine, balance + alignment
+    return choices, balance + alignment
+
+
+def sam_gating(logits, k, capacity, num_groups):
+    """SAM gate (reference layers/SAMGate.py): experts form ``num_groups``
+    locality groups (one per host in the reference); each token picks the
+    group with the largest probability mass, then its top-k experts INSIDE
+    that group — keeping all its expert traffic on one host.  Aux = GShard
+    balance loss + an alignment term rewarding the chosen group's mass
+    (adaptation of SamMax.cu's alignment objective).
+    """
+    T, E = logits.shape
+    choices, aux = sam_gating_choices(logits, k, capacity, num_groups)
+    dispatch, combine = _accumulate_dispatch(T, E, capacity, choices,
+                                             logits.dtype)
+    return dispatch, combine, aux
 
 
 def base_balance_gating(scores, capacity):

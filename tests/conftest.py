@@ -26,3 +26,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def clone_params_into(ex, prev):
+    """Copy a prior executor's params into ``ex`` by sorted-name pairing
+    (two same-structure models built sequentially differ only by name
+    tags, and sorted order preserves correspondence).  Returns HOST
+    copies of the placed params taken NOW — the train step donates the
+    device buffers, so reading them later would hit deleted arrays."""
+    import jax.numpy as jnp
+    if prev is not None:
+        ren = dict(zip(sorted(ex.params), sorted(prev)))
+        for k in ex.params:
+            ex.params[k] = jnp.asarray(prev[ren[k]])
+    return {k: np.asarray(v) for k, v in ex.params.items()}

@@ -120,3 +120,33 @@ def test_moe_layer_trains_with_gate(gate, kw, rng):
               for _ in range(12)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], (gate, losses)
+
+
+@pytest.mark.parametrize("gate_kind", ["ktop1", "sam"])
+def test_sparse_path_matches_dense_for_ktop1_and_sam(rng, gate_kind):
+    """KTop1/SAM gates also expose the CHOICES form: the sparse
+    scatter-dispatch MoELayer matches a dense-forced twin end to end."""
+    from hetu_tpu.layers import MoELayer
+
+    B, S, H = 4, 8, 16
+    X = rng.standard_normal((B, S, H)).astype(np.float32)
+    Y = np.zeros_like(X)
+    losses, prev = {}, None
+    for mode in ("sparse", "dense"):
+        kw = dict(num_groups=2) if gate_kind == "sam" else {}
+        moe = MoELayer(H, 32, num_experts=4, k=2, capacity_factor=2.0,
+                       gate=gate_kind, sparse=(mode == "sparse"),
+                       name=f"ks_{gate_kind}_{mode}", **kw)
+        x = ht.placeholder_op(f"ksx_{gate_kind}_{mode}", X.shape)
+        y = ht.placeholder_op(f"ksy_{gate_kind}_{mode}", X.shape)
+        loss = ht.mse_loss_op(moe(x), y) + 0.01 * moe.aux_loss()
+        ex = ht.Executor({"train": [loss, ht.AdamOptimizer(0.01)
+                                    .minimize(loss)]}, seed=4)
+        from conftest import clone_params_into
+        prev = clone_params_into(ex, prev)
+        losses[mode] = [
+            float(ex.run("train", feed_dict={x: X, y: Y},
+                         convert_to_numpy_ret_vals=True)[0])
+            for _ in range(3)]
+    np.testing.assert_allclose(losses["sparse"], losses["dense"],
+                               rtol=2e-5, atol=2e-6)

@@ -504,13 +504,8 @@ def test_moe_layer_sparse_matches_dense_and_memory_sweep(rng):
         loss = ht.mse_loss_op(moe(x), y) + 0.01 * moe.aux_loss()
         opt = ht.AdamOptimizer(0.01)
         ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=9)
-        if prev is not None:
-            import jax.numpy as jnp_
-            ren = dict(zip(sorted(ex.params), sorted(prev)))
-            for kk in ex.params:
-                ex.params[kk] = jnp_.asarray(prev[ren[kk]])
-        # host copies NOW: the train step donates the device buffers
-        prev = {kk: np.asarray(v) for kk, v in ex.params.items()}
+        from conftest import clone_params_into
+        prev = clone_params_into(ex, prev)
         losses[mode] = [
             float(ex.run("train", feed_dict={x: X, y: Y},
                          convert_to_numpy_ret_vals=True)[0])
