@@ -1,0 +1,97 @@
+"""Llama/Baichuan causal-LM training (reference:
+tools/Hetu-Galvatron/galvatron/models/llama/train.py, models/baichuan/).
+
+Covers the graph-API training path with optional parallelism flags:
+  --tp/--dp      dp x tp via the MegatronLM strategy (SwiGLU gate/up
+                 column-parallel, down row-parallel)
+  --pp           graph-pipeline staging (1f1b schedule)
+  --hf-import    load a transformers Llama checkpoint by path
+
+Usage: python examples/nlp/train_llama.py [--model llama-7b --layers 2]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+from hetu_tpu.models import (LlamaConfig, LlamaForCausalLM, LLAMA_CONFIGS,
+                             load_hf_llama_weights)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-7b",
+                    choices=list(LLAMA_CONFIGS))
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=0,
+                    help="override layer count (0 = model default)")
+    ap.add_argument("--hidden", type=int, default=0,
+                    help="override hidden size (0 = model default)")
+    ap.add_argument("--intermediate", type=int, default=0,
+                    help="override FFN size (0 = model default)")
+    ap.add_argument("--vocab", type=int, default=0,
+                    help="override vocab size (0 = model default)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=0,
+                    help="pipeline stages (graph pipeline, 1f1b)")
+    ap.add_argument("--hf-import", default=None,
+                    help="path to a transformers checkpoint dir to load")
+    args = ap.parse_args()
+
+    base = dict(LLAMA_CONFIGS[args.model])
+    for field, val in (("num_layers", args.layers),
+                       ("hidden_size", args.hidden),
+                       ("intermediate_size", args.intermediate),
+                       ("vocab_size", args.vocab)):
+        if val:
+            base[field] = val
+    c = LlamaConfig(seq_len=args.seq_len, **base)
+    rng = np.random.default_rng(0)
+    B, S = args.batch_size, args.seq_len
+
+    ids = ht.placeholder_op("ids", (B, S), dtype=np.int32)
+    labels = ht.placeholder_op("labels", (B, S), dtype=np.int32)
+    model = LlamaForCausalLM(c, pipeline_stages=args.pp or None)
+    loss = model.loss(ids, labels)
+    opt = ht.AdamWOptimizer(learning_rate=args.lr, weight_decay=0.01)
+
+    kwargs = dict(compute_dtype=jnp.bfloat16)
+    if args.pp:
+        from hetu_tpu.parallel import make_mesh
+        kwargs.update(mesh=make_mesh({"pp": args.pp}), pipeline="1f1b",
+                      num_micro=max(2, args.pp))
+    elif args.tp > 1 or args.dp > 1:
+        from hetu_tpu.parallel import MegatronLM
+        kwargs.update(dist_strategy=MegatronLM(dp=args.dp, tp=args.tp))
+    ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, **kwargs)
+
+    if args.hf_import:
+        import transformers
+        hf = transformers.AutoModelForCausalLM.from_pretrained(
+            args.hf_import)
+        load_hf_llama_weights(ex, model, hf.state_dict())
+        print(f"imported weights from {args.hf_import}")
+
+    for step in range(args.steps):
+        tok = rng.integers(0, c.vocab_size, (B, S + 1))
+        feed = {ids: tok[:, :-1], labels: tok[:, 1:]}
+        out = ex.run("train", feed_dict=feed,
+                     convert_to_numpy_ret_vals=True)
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step:4d}  loss {out[0]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

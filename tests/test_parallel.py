@@ -259,3 +259,34 @@ def test_megatron_tp_llama():
     assert ex.params[gate.name].sharding.spec[1] == "tp"
     kw = [v for v in ex.variables if v.name.endswith("_k_weight")][0]
     assert ex.params[kw.name].sharding.spec[1] == "tp"  # GQA kv still tp
+
+
+def test_llama_long_context_cp_matches_single_device():
+    """Llama forward under a cp (sequence-sharded) mesh: RoPE rotates on
+    GLOBAL positions before the attention op lowers to flash ring
+    attention, so context-parallel logits must equal single-device ones
+    (long-context tier: ring attention over the cp axis + rotary
+    positions)."""
+    from hetu_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    B, S = 2, 64   # S sharded 8-way -> 8 tokens per shard
+    c = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=2, intermediate_size=32, seq_len=S)
+    rng = np.random.default_rng(3)
+    ids_v = rng.integers(0, 64, (B, S))
+
+    outs = {}
+    for tag, mesh in (("sd", None), ("cp", make_mesh({"cp": 8}))):
+        i_ = ht.placeholder_op(f"lcp_ids_{tag}", (B, S), dtype=np.int32)
+        model = LlamaForCausalLM(c, name=f"llamacp_{tag}")
+        logits = model(i_)
+        ex = ht.Executor([logits], seed=21, mesh=mesh, training=False)
+        from conftest import clone_params_into
+        if "sd" in outs:
+            clone_params_into(ex, outs["params"])
+        outs.setdefault("params",
+                        {k: np.asarray(v) for k, v in ex.params.items()})
+        outs[tag] = ex.run(feed_dict={i_: ids_v},
+                           convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(outs["cp"], outs["sd"], rtol=2e-4,
+                               atol=2e-4)
