@@ -320,3 +320,83 @@ def test_heartbeat_detects_dead_server_and_recovery():
         for p in (proc, proc2):
             if p is not None and p.poll() is None:
                 p.kill()
+
+
+def test_retransmitted_tick_and_reduce_replay_cached_replies():
+    """tick and reduce are non-idempotent: a retransmission with the same
+    (cid, seq) must replay the CACHED reply — not advance the clock
+    again, and not re-open a completed reduce group (which would hang
+    forever waiting for partners that already left)."""
+    from hetu_tpu.ps.rpc import PSServer, send_msg, recv_msg
+    import socket as socket_mod
+    import threading as threading_mod
+
+    table = EmbeddingTable(8, 2, optimizer="sgd", lr=1.0, init_scale=0)
+    server = PSServer(table, nworkers=2).start()
+    try:
+        sock = socket_mod.create_connection((server.host, server.port))
+        # tick worker 0 twice with the SAME seq: clock advances once
+        for _ in range(2):
+            send_msg(sock, {"verb": "tick", "worker": 0, "cid": "c",
+                            "seq": 1})
+            reply, _ = recv_msg(sock)
+            assert reply["verb"] == "ok"
+        assert reply["clocks"][0] == 1, reply
+
+        # complete a 2-member reduce, then retransmit member 0's request:
+        # the cached mean must come back instantly (no re-opened slot)
+        arrs = [np.ones((2, 3), "<f4")]
+
+        def member1():
+            s1 = socket_mod.create_connection((server.host, server.port))
+            send_msg(s1, {"verb": "reduce", "round": 0, "rank": 1,
+                          "group": [0, 1], "shapes": [[2, 3]],
+                          "cid": "c1", "seq": 1},
+                     np.full((2, 3), 3.0, "<f4"))
+            recv_msg(s1)
+            s1.close()
+
+        t = threading_mod.Thread(target=member1)
+        t.start()
+        send_msg(sock, {"verb": "reduce", "round": 0, "rank": 0,
+                        "group": [0, 1], "shapes": [[2, 3]],
+                        "cid": "c", "seq": 2}, *arrs)
+        reply, payloads = recv_msg(sock)
+        t.join()
+        mean = np.frombuffer(payloads[0], "<f4").reshape(2, 3)
+        np.testing.assert_allclose(mean, 2.0)   # mean(1, 3)
+
+        sock.settimeout(5.0)
+        send_msg(sock, {"verb": "reduce", "round": 0, "rank": 0,
+                        "group": [0, 1], "shapes": [[2, 3]],
+                        "cid": "c", "seq": 2}, *arrs)   # retransmission
+        reply2, payloads2 = recv_msg(sock)       # must NOT block
+        assert reply2.get("dedup") is True
+        np.testing.assert_allclose(
+            np.frombuffer(payloads2[0], "<f4").reshape(2, 3), 2.0)
+        sock.close()
+    finally:
+        server.stop()
+
+
+def test_reduce_times_out_on_dead_member():
+    """A reduce group whose member never posts trips the liveness timeout
+    with an error reply instead of pinning the handler thread forever."""
+    from hetu_tpu.ps.rpc import PSServer, send_msg, recv_msg
+    import socket as socket_mod
+
+    table = EmbeddingTable(8, 2, optimizer="sgd", lr=1.0, init_scale=0)
+    server = PSServer(table, nworkers=2).start()
+    server._srv.reducer.timeout = 1.0
+    try:
+        sock = socket_mod.create_connection((server.host, server.port))
+        sock.settimeout(10.0)
+        send_msg(sock, {"verb": "reduce", "round": 5, "rank": 0,
+                        "group": [0, 1], "shapes": [[1, 2]],
+                        "cid": "c", "seq": 9}, np.ones((1, 2), "<f4"))
+        reply, _ = recv_msg(sock)
+        assert reply["verb"] == "error" and "never posted" in \
+            reply["message"], reply
+        sock.close()
+    finally:
+        server.stop()
