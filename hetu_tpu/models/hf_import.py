@@ -170,3 +170,39 @@ def load_hf_llama_weights(executor, model, state_dict, name="llama"):
             "built with tie_embeddings=True — its logits would silently "
             "diverge; rebuild with tie_embeddings=False")
     return executor
+
+
+def export_hf_llama_weights(executor, model, name="llama"):
+    """Inverse of ``load_hf_llama_weights``: an executor's Llama params as
+    a transformers-layout state_dict of numpy arrays (``model.`` prefix,
+    (out, in) weight orientation) — loadable by
+    transformers.LlamaForCausalLM.load_state_dict after torch.from_numpy.
+    Round-trip interop is the reference's ONNX-bridge role for modern
+    checkpoints (tests/test_torch_parity.py proves both directions)."""
+    p = executor.params
+    cfg = model.config
+
+    def get(n):
+        return np.asarray(p[n])
+
+    sd = {"model.embed_tokens.weight": get(f"{name}_embed_table"),
+          "model.norm.weight": get(f"{name}_norm_scale")}
+    for i in range(cfg.num_layers):
+        hf = f"model.layers.{i}."
+        our = f"{name}_layer{i}"
+        for proj, hname in (("q", "self_attn.q_proj"),
+                            ("k", "self_attn.k_proj"),
+                            ("v", "self_attn.v_proj"),
+                            ("out", "self_attn.o_proj")):
+            sd[hf + hname + ".weight"] = get(f"{our}_attn_{proj}_weight").T
+        sd[hf + "mlp.gate_proj.weight"] = get(f"{our}_mlp_gate_weight").T
+        sd[hf + "mlp.up_proj.weight"] = get(f"{our}_mlp_up_weight").T
+        sd[hf + "mlp.down_proj.weight"] = get(f"{our}_mlp_out_weight").T
+        sd[hf + "input_layernorm.weight"] = get(f"{our}_input_norm_scale")
+        sd[hf + "post_attention_layernorm.weight"] = \
+            get(f"{our}_post_norm_scale")
+    if model.lm_head is not None:
+        sd["lm_head.weight"] = get(f"{name}_lm_head_weight").T
+    else:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    return sd

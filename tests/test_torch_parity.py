@@ -381,3 +381,41 @@ def test_wdl_training_curve_matches_torch(rng):
         topt.step()
         theirs.append(float(tl))
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
+def test_llama_hf_export_roundtrip(rng):
+    """export_hf_llama_weights is the exact inverse of the importer: a
+    transformers model loaded from our export produces identical logits."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                 load_hf_llama_weights,
+                                 export_hf_llama_weights)
+
+    B, S, V = 2, 16, 100
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=S, rms_eps=1e-6)
+    model = LlamaForCausalLM(c, name="llamaexp")
+    ids = ht.placeholder_op("lex_ids", (B, S), dtype=np.int32)
+    logits = model(ids)
+    ex = ht.Executor([logits], seed=13)
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=V, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=56, max_position_embeddings=64,
+        rms_norm_eps=1e-6, attention_bias=False,
+        tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    sd = {k: torch.from_numpy(v.copy())
+          for k, v in export_hf_llama_weights(ex, model,
+                                              name="llamaexp").items()}
+    hf.load_state_dict(sd)
+    hf.eval()
+
+    ids_v = rng.integers(0, V, (B, S))
+    (got,) = ex.run(feed_dict={ids: ids_v}, convert_to_numpy_ret_vals=True)
+    with torch.no_grad():
+        want = hf(input_ids=torch.from_numpy(ids_v)).logits
+    np.testing.assert_allclose(got.reshape(B, S, V), _t2n(want),
+                               rtol=1e-3, atol=1e-3)
