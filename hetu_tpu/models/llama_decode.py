@@ -1,0 +1,175 @@
+"""KV-cache autoregressive decoding for the Llama tier.
+
+The reference is a training system; its deployment story is export
+(ONNX / switchinference forms).  A modern-LLM tier needs generation, so
+this module adds a TPU-native decode path: one jitted program containing
+prompt prefill + a ``lax.scan`` over decode steps with a scan-carried
+K/V cache — static shapes throughout (cache preallocated at
+prompt_len + max_new, future positions masked), so XLA compiles exactly
+two matmul-shaped programs regardless of how many tokens are generated.
+
+It consumes an Executor's params by the canonical variable names
+(models/llama.py naming), so a trained or HF-imported model decodes
+without graph changes:
+
+    fn = build_greedy_decode(config, max_new=32, name="llama")
+    tokens = fn(ex.params, prompt_ids)     # [B, P+32] int32
+
+Greedy decoding matches transformers' ``generate(do_sample=False)``
+token-for-token (tests/test_torch_parity.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.rotary import _rope_tables
+
+
+def _rms(x, g, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), -1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g
+
+
+def _rotate(x, cos, sin):
+    """x [..., S, D] with per-position cos/sin [S, D] (rotate_half)."""
+    d = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return (xf * cos + rot * sin).astype(x.dtype)
+
+
+def build_greedy_decode(config, max_new, name="llama"):
+    """Returns jitted ``fn(params, prompt_ids [B, P]) -> [B, P+max_new]``.
+
+    The prompt length is baked at first call (a new P retraces, the
+    executor's usual static-shape contract)."""
+    c = config
+    hd = c.hidden_size // c.num_heads
+    n_rep = c.num_heads // c.num_kv_heads
+
+    def layer_params(params, i):
+        our = f"{name}_layer{i}"
+        return {
+            "in_norm": params[f"{our}_input_norm_scale"],
+            "post_norm": params[f"{our}_post_norm_scale"],
+            "wq": params[f"{our}_attn_q_weight"],
+            "wk": params[f"{our}_attn_k_weight"],
+            "wv": params[f"{our}_attn_v_weight"],
+            "wo": params[f"{our}_attn_out_weight"],
+            "gate": params[f"{our}_mlp_gate_weight"],
+            "up": params[f"{our}_mlp_up_weight"],
+            "down": params[f"{our}_mlp_out_weight"],
+        }
+
+    def attend(q, keys, vals, pos_mask):
+        """q [B, H, Sq, D]; keys/vals [B, KV, T, D]; pos_mask [Sq, T]."""
+        if n_rep > 1:
+            b, kv, t, d = keys.shape
+            keys = jnp.broadcast_to(keys[:, :, None],
+                                    (b, kv, n_rep, t, d)).reshape(
+                b, kv * n_rep, t, d)
+            vals = jnp.broadcast_to(vals[:, :, None],
+                                    (b, kv, n_rep, t, d)).reshape(
+                b, kv * n_rep, t, d)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
+                       preferred_element_type=jnp.float32) / np.sqrt(hd)
+        s = jnp.where(pos_mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(vals.dtype), vals,
+                          preferred_element_type=jnp.float32
+                          ).astype(vals.dtype)
+
+    def block(lp, x, cache_k, cache_v, cos, sin, pos_mask, write_at):
+        """x [B, Sq, H]; returns (x', cache_k', cache_v')."""
+        b, sq, _ = x.shape
+        h = _rms(x, lp["in_norm"], c.rms_eps)
+        q = (h @ lp["wq"]).reshape(b, sq, c.num_heads, hd)
+        k = (h @ lp["wk"]).reshape(b, sq, c.num_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(b, sq, c.num_kv_heads, hd)
+        q = _rotate(q.transpose(0, 2, 1, 3), cos, sin)
+        k = _rotate(k.transpose(0, 2, 1, 3), cos, sin)
+        v = v.transpose(0, 2, 1, 3)
+        cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, write_at,
+                                                      axis=2)
+        cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, write_at,
+                                                      axis=2)
+        o = attend(q, cache_k, cache_v, pos_mask)
+        o = o.transpose(0, 2, 1, 3).reshape(b, sq, c.hidden_size)
+        x = x + o @ lp["wo"]
+        f = _rms(x, lp["post_norm"], c.rms_eps)
+        return (x + (jax.nn.silu(f @ lp["gate"]) * (f @ lp["up"]))
+                @ lp["down"], cache_k, cache_v)
+
+    def logits_of(params, h_last):
+        h = _rms(h_last, params[f"{name}_norm_scale"], c.rms_eps)
+        if c.tie_embeddings:
+            return h @ params[f"{name}_embed_table"].T
+        return h @ params[f"{name}_lm_head_weight"]
+
+    @jax.jit
+    def decode(params, prompt_ids):
+        b, p_len = prompt_ids.shape
+        total = p_len + max_new
+        cos_t, sin_t = _rope_tables(total, hd, c.rope_theta)
+        emb = params[f"{name}_embed_table"]
+        lps = [layer_params(params, i) for i in range(c.num_layers)]
+        kshape = (b, c.num_kv_heads, total, hd)
+        dtype = emb.dtype
+
+        # ---- prefill: prompt through all layers, fill cache[0:P] -------
+        x = emb[prompt_ids]
+        caches = []
+        pre_mask = (jnp.arange(total)[None, :]
+                    <= jnp.arange(p_len)[:, None])   # [P, total] causal
+        for lp in lps:
+            ck = jnp.zeros(kshape, dtype)
+            cv = jnp.zeros(kshape, dtype)
+            x, ck, cv = block(lp, x, ck, cv, cos_t[:p_len], sin_t[:p_len],
+                              pre_mask, 0)
+            caches.append((ck, cv))
+        first = jnp.argmax(logits_of(params, x[:, -1:, :]),
+                           axis=-1).astype(prompt_ids.dtype)   # [B, 1]
+
+        # ---- decode: scan over single-token steps ----------------------
+        def step(carry, t):
+            tok, caches = carry
+            pos = p_len + t                              # dynamic scalar
+            x = emb[tok]                                  # [B, 1, H]
+            cos = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, 0)
+            sin = jax.lax.dynamic_slice_in_dim(sin_t, pos, 1, 0)
+            mask = (jnp.arange(total) <= pos)[None, :]    # [1, total]
+            new_caches = []
+            for lp, (ck, cv) in zip(lps, caches):
+                x, ck, cv = block(lp, x, ck, cv, cos, sin, mask, pos)
+                new_caches.append((ck, cv))
+            nxt = jnp.argmax(logits_of(params, x), axis=-1).astype(
+                tok.dtype)                                # [B, 1]
+            return (nxt, new_caches), tok[:, 0]
+
+        (last, _), toks = jax.lax.scan(
+            step, (first, caches), jnp.arange(max_new - 1))
+        gen = jnp.concatenate(
+            [toks.transpose(1, 0), last], axis=1) if max_new > 1 else last
+        return jnp.concatenate([prompt_ids, gen], axis=1)
+
+    return decode
+
+
+def greedy_generate(executor, model, prompt_ids, max_new, name=None):
+    """Convenience wrapper: decode from an Executor's params.
+
+    ``model``: the LlamaForCausalLM whose config/naming to use."""
+    name = name or next(k for k in executor.params
+                        if k.endswith("_embed_table")).rsplit(
+        "_embed_table", 1)[0]
+    fn = build_greedy_decode(model.config, max_new, name=name)
+    return np.asarray(fn(executor.params,
+                         jnp.asarray(prompt_ids, jnp.int32)))

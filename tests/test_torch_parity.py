@@ -419,3 +419,40 @@ def test_llama_hf_export_roundtrip(rng):
         want = hf(input_ids=torch.from_numpy(ids_v)).logits
     np.testing.assert_allclose(got.reshape(B, S, V), _t2n(want),
                                rtol=1e-3, atol=1e-3)
+
+
+def test_llama_greedy_decode_matches_hf_generate(rng):
+    """KV-cache greedy decoding (prefill + lax.scan single-token steps,
+    models/llama_decode.py) produces the EXACT token sequence of
+    transformers generate(do_sample=False) from imported weights."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                 load_hf_llama_weights)
+    from hetu_tpu.models.llama_decode import greedy_generate
+
+    B, P, V, NEW = 2, 8, 100, 10
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=V, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=56, max_position_embeddings=64,
+        rms_norm_eps=1e-6, attention_bias=False,
+        tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.eval()
+    hf.generation_config.pad_token_id = 0
+
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=P, rms_eps=1e-6)
+    model = LlamaForCausalLM(c, name="llamadec")
+    ids = ht.placeholder_op("ld_ids", (B, P), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    load_hf_llama_weights(ex, model, hf.state_dict(), name="llamadec")
+
+    prompt = rng.integers(1, V, (B, P))
+    ours = greedy_generate(ex, model, prompt, NEW)
+    with torch.no_grad():
+        want = hf.generate(torch.from_numpy(prompt),
+                           max_new_tokens=NEW, do_sample=False,
+                           use_cache=True)
+    np.testing.assert_array_equal(ours, _t2n(want))
