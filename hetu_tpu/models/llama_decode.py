@@ -46,9 +46,14 @@ def _rotate(x, cos, sin):
     return (xf * cos + rot * sin).astype(x.dtype)
 
 
-def build_greedy_decode(config, max_new, name="llama"):
-    """Returns jitted ``fn(params, prompt_ids [B, P]) -> [B, P+max_new]``.
+def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
+                        top_k=0):
+    """Returns jitted ``fn(params, prompt_ids [B, P][, key]) ->
+    [B, P+max_new]``.
 
+    ``temperature`` 0 = greedy argmax; > 0 samples from
+    softmax(logits/temperature), restricted to the ``top_k`` largest
+    logits when top_k > 0 (pass a jax PRNG key as the third argument).
     The prompt length is baked at first call (a new P retraces, the
     executor's usual static-shape contract)."""
     c = config
@@ -114,8 +119,20 @@ def build_greedy_decode(config, max_new, name="llama"):
             return h @ params[f"{name}_embed_table"].T
         return h @ params[f"{name}_lm_head_weight"]
 
+    def pick(logits, key):
+        """[B, 1, V] -> [B, 1] token ids (greedy or sampled)."""
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        lg = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        return jax.random.categorical(key, lg, axis=-1)
+
     @jax.jit
-    def decode(params, prompt_ids):
+    def decode(params, prompt_ids, key=None):
+        if key is None:
+            key = jax.random.key(0)
         b, p_len = prompt_ids.shape
         total = p_len + max_new
         cos_t, sin_t = _rope_tables(total, hd, c.rope_theta)
@@ -135,12 +152,14 @@ def build_greedy_decode(config, max_new, name="llama"):
             x, ck, cv = block(lp, x, ck, cv, cos_t[:p_len], sin_t[:p_len],
                               pre_mask, 0)
             caches.append((ck, cv))
-        first = jnp.argmax(logits_of(params, x[:, -1:, :]),
-                           axis=-1).astype(prompt_ids.dtype)   # [B, 1]
+        key, k0 = jax.random.split(key)
+        first = pick(logits_of(params, x[:, -1:, :]),
+                     k0).astype(prompt_ids.dtype)              # [B, 1]
 
         # ---- decode: scan over single-token steps ----------------------
         def step(carry, t):
-            tok, caches = carry
+            tok, caches, key = carry
+            key, kt = jax.random.split(key)
             pos = p_len + t                              # dynamic scalar
             x = emb[tok]                                  # [B, 1, H]
             cos = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, 0)
@@ -150,12 +169,11 @@ def build_greedy_decode(config, max_new, name="llama"):
             for lp, (ck, cv) in zip(lps, caches):
                 x, ck, cv = block(lp, x, ck, cv, cos, sin, mask, pos)
                 new_caches.append((ck, cv))
-            nxt = jnp.argmax(logits_of(params, x), axis=-1).astype(
-                tok.dtype)                                # [B, 1]
-            return (nxt, new_caches), tok[:, 0]
+            nxt = pick(logits_of(params, x), kt).astype(tok.dtype)
+            return (nxt, new_caches, key), tok[:, 0]
 
-        (last, _), toks = jax.lax.scan(
-            step, (first, caches), jnp.arange(max_new - 1))
+        (last, _, _), toks = jax.lax.scan(
+            step, (first, caches, key), jnp.arange(max_new - 1))
         gen = jnp.concatenate(
             [toks.transpose(1, 0), last], axis=1) if max_new > 1 else last
         return jnp.concatenate([prompt_ids, gen], axis=1)
@@ -163,13 +181,16 @@ def build_greedy_decode(config, max_new, name="llama"):
     return decode
 
 
-def greedy_generate(executor, model, prompt_ids, max_new, name=None):
+def greedy_generate(executor, model, prompt_ids, max_new, name=None,
+                    temperature=0.0, top_k=0, seed=0):
     """Convenience wrapper: decode from an Executor's params.
 
     ``model``: the LlamaForCausalLM whose config/naming to use."""
     name = name or next(k for k in executor.params
                         if k.endswith("_embed_table")).rsplit(
         "_embed_table", 1)[0]
-    fn = build_greedy_decode(model.config, max_new, name=name)
+    fn = build_greedy_decode(model.config, max_new, name=name,
+                             temperature=temperature, top_k=top_k)
     return np.asarray(fn(executor.params,
-                         jnp.asarray(prompt_ids, jnp.int32)))
+                         jnp.asarray(prompt_ids, jnp.int32),
+                         jax.random.key(seed)))

@@ -456,3 +456,28 @@ def test_llama_greedy_decode_matches_hf_generate(rng):
                            max_new_tokens=NEW, do_sample=False,
                            use_cache=True)
     np.testing.assert_array_equal(ours, _t2n(want))
+
+
+def test_llama_sampled_decode_topk1_equals_greedy(rng):
+    """temperature>0 with top_k=1 must reduce to greedy (the sampled set
+    is a single token), and unrestricted sampling yields valid ids."""
+    from hetu_tpu.models import LlamaConfig, LlamaForCausalLM
+    from hetu_tpu.models.llama_decode import greedy_generate
+
+    B, P, V, NEW = 2, 8, 50, 6
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=1,
+                    num_heads=4, intermediate_size=56, seq_len=P)
+    model = LlamaForCausalLM(c, name="llamasamp")
+    ids = ht.placeholder_op("ls_ids", (B, P), dtype=np.int32)
+    ex = ht.Executor([model(ids)], seed=2)
+    prompt = rng.integers(1, V, (B, P))
+
+    greedy = greedy_generate(ex, model, prompt, NEW)
+    topk1 = greedy_generate(ex, model, prompt, NEW, temperature=0.7,
+                            top_k=1, seed=9)
+    np.testing.assert_array_equal(greedy, topk1)
+
+    sampled = greedy_generate(ex, model, prompt, NEW, temperature=1.0,
+                              top_k=10, seed=3)
+    assert sampled.shape == (B, P + NEW)
+    assert (sampled >= 0).all() and (sampled < V).all()
