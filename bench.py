@@ -423,7 +423,15 @@ def main():
         if quick:
             cmd.append("--quick")
         for attempt in (0, 1):
-            proc = subprocess.run(cmd, capture_output=True, text=True)
+            # hard per-attempt timeout: a WEDGED dev tunnel (observed: the
+            # relay dies and device calls block forever) must surface as a
+            # failed stage, not hang the whole benchmark run
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=1500)
+            except subprocess.TimeoutExpired:
+                sys.stderr.write(f"stage {stage} timed out\n")
+                continue
             if proc.returncode == 0:
                 results[stage] = json.loads(
                     proc.stdout.strip().splitlines()[-1])
