@@ -1,0 +1,145 @@
+"""KV-cache autoregressive decoding for the GPT tier.
+
+Same architecture as llama_decode.py (one jitted prefill + lax.scan
+decode with a scan-carried, position-masked K/V cache, static shapes)
+specialized to the GPT block: LayerNorm with bias, biased q/k/v/out
+projections, gelu MLP, learned position embeddings, tied LM head.
+Consumes Executor params by the GPTModel naming contract.
+
+NOTE: the learned position table caps generation at
+``config.seq_len`` total positions (rotary models have no such cap) —
+build the model with seq_len >= prompt + max_new.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _ln(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b)
+
+
+def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,
+                        top_k=0):
+    """Returns jitted ``fn(params, prompt_ids [B, P][, key]) ->
+    [B, P+max_new]`` for a GPTModel (pre-norm, tied head)."""
+    c = config
+    hd = c.hidden_size // c.num_heads
+
+    def layer_params(params, i):
+        our = f"{name}_h{i}"
+        return {k: params[f"{our}_{v}"] for k, v in {
+            "ln1_g": "ln1_scale", "ln1_b": "ln1_bias",
+            "ln2_g": "ln2_scale", "ln2_b": "ln2_bias",
+            "wq": "attn_q_weight", "bq": "attn_q_bias",
+            "wk": "attn_k_weight", "bk": "attn_k_bias",
+            "wv": "attn_v_weight", "bv": "attn_v_bias",
+            "wo": "attn_out_weight", "bo": "attn_out_bias",
+            "w1": "ffn_in_weight", "b1": "ffn_in_bias",
+            "w2": "ffn_out_weight", "b2": "ffn_out_bias",
+        }.items()}
+
+    def attend(q, keys, vals, pos_mask):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
+                       preferred_element_type=jnp.float32) / np.sqrt(hd)
+        s = jnp.where(pos_mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(vals.dtype), vals,
+                          preferred_element_type=jnp.float32
+                          ).astype(vals.dtype)
+
+    def block(lp, x, ck, cv, pos_mask, write_at):
+        b, sq, _ = x.shape
+        h = _ln(x, lp["ln1_g"], lp["ln1_b"])
+        q = (h @ lp["wq"] + lp["bq"]).reshape(b, sq, c.num_heads, hd)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(b, sq, c.num_heads, hd)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(b, sq, c.num_heads, hd)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, write_at, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, write_at, axis=2)
+        o = attend(q, ck, cv, pos_mask)
+        o = o.transpose(0, 2, 1, 3).reshape(b, sq, c.hidden_size)
+        x = x + o @ lp["wo"] + lp["bo"]
+        f = _ln(x, lp["ln2_g"], lp["ln2_b"])
+        f = jax.nn.gelu(f @ lp["w1"] + lp["b1"])   # approximate, as gelu_op
+        return x + f @ lp["w2"] + lp["b2"], ck, cv
+
+    def logits_of(params, h_last):
+        h = _ln(h_last, params[f"{name}_ln_f_scale"],
+                params[f"{name}_ln_f_bias"])
+        return h @ params[f"{name}_wte_table"].T     # tied head
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        lg = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        return jax.random.categorical(key, lg, axis=-1)
+
+    @jax.jit
+    def decode(params, prompt_ids, key=None):
+        if key is None:
+            key = jax.random.key(0)
+        b, p_len = prompt_ids.shape
+        total = p_len + max_new
+        assert total <= c.seq_len, (
+            f"learned positions cover seq_len={c.seq_len} < "
+            f"prompt+max_new={total}")
+        emb = params[f"{name}_wte_table"]
+        wpe = params[f"{name}_wpe"]
+        lps = [layer_params(params, i) for i in range(c.num_layers)]
+        kshape = (b, c.num_heads, total, hd)
+        dtype = emb.dtype
+
+        x = emb[prompt_ids] + wpe[None, :p_len]
+        pre_mask = (jnp.arange(total)[None, :]
+                    <= jnp.arange(p_len)[:, None])
+        caches = []
+        for lp in lps:
+            ck = jnp.zeros(kshape, dtype)
+            cv = jnp.zeros(kshape, dtype)
+            x, ck, cv = block(lp, x, ck, cv, pre_mask, 0)
+            caches.append((ck, cv))
+        key, k0 = jax.random.split(key)
+        first = pick(logits_of(params, x[:, -1:, :]),
+                     k0).astype(prompt_ids.dtype)
+
+        def step(carry, t):
+            tok, caches, key = carry
+            key, kt = jax.random.split(key)
+            pos = p_len + t
+            x = emb[tok] + jax.lax.dynamic_slice_in_dim(
+                wpe, pos, 1, 0)[None]
+            mask = (jnp.arange(total) <= pos)[None, :]
+            new_caches = []
+            for lp, (ck, cv) in zip(lps, caches):
+                x, ck, cv = block(lp, x, ck, cv, mask, pos)
+                new_caches.append((ck, cv))
+            nxt = pick(logits_of(params, x), kt).astype(tok.dtype)
+            return (nxt, new_caches, key), tok[:, 0]
+
+        (last, _, _), toks = jax.lax.scan(
+            step, (first, caches, key), jnp.arange(max_new - 1))
+        gen = jnp.concatenate(
+            [toks.transpose(1, 0), last], axis=1) if max_new > 1 else last
+        return jnp.concatenate([prompt_ids, gen], axis=1)
+
+    return decode
+
+
+def greedy_generate(executor, model, prompt_ids, max_new, name="gpt",
+                    temperature=0.0, top_k=0, seed=0):
+    fn = build_greedy_decode(model.config, max_new, name=name,
+                             temperature=temperature, top_k=top_k)
+    return np.asarray(fn(executor.params,
+                         jnp.asarray(prompt_ids, jnp.int32),
+                         jax.random.key(seed)))

@@ -481,3 +481,38 @@ def test_llama_sampled_decode_topk1_equals_greedy(rng):
                               top_k=10, seed=3)
     assert sampled.shape == (B, P + NEW)
     assert (sampled >= 0).all() and (sampled < V).all()
+
+
+def test_gpt2_greedy_decode_matches_hf_generate(rng):
+    """GPT KV-cache decode (models/gpt_decode.py) matches transformers
+    GPT2 generate(do_sample=False) token-for-token from imported
+    weights."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import GPTConfig, GPTModel
+    from hetu_tpu.models.hf_import import load_hf_gpt2_weights
+    from hetu_tpu.models.gpt_decode import greedy_generate
+
+    B, P, V, NEW = 2, 8, 100, 10
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=V, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        activation_function="gelu_new")
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    hf.eval()
+    hf.generation_config.pad_token_id = 0
+
+    c = GPTConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                  num_heads=4, seq_len=P + NEW, dropout_prob=0.0)
+    model = GPTModel(c, name="gptdec")
+    ids = ht.placeholder_op("gd_ids", (B, P + NEW), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    load_hf_gpt2_weights(ex, model, hf.transformer.state_dict(),
+                         name="gptdec")
+
+    prompt = rng.integers(1, V, (B, P))
+    ours = greedy_generate(ex, model, prompt, NEW, name="gptdec")
+    with torch.no_grad():
+        want = hf.generate(torch.from_numpy(prompt),
+                           max_new_tokens=NEW, do_sample=False,
+                           use_cache=True)
+    np.testing.assert_array_equal(ours, _t2n(want))
