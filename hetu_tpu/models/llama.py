@@ -42,6 +42,14 @@ class LlamaConfig:
         self.rope_theta = rope_theta
         self.rms_eps = rms_eps
         assert position_embedding in ("rope", "alibi")
+        assert hidden_size % num_heads == 0, (hidden_size, num_heads)
+        if position_embedding == "rope":
+            # rotate_half pairs dimensions: an odd head_dim silently
+            # broadcasts the tables to the wrong width downstream
+            assert (hidden_size // num_heads) % 2 == 0, (
+                f"RoPE needs an even head_dim; got "
+                f"{hidden_size // num_heads} (hidden {hidden_size}, "
+                f"heads {num_heads})")
         self.position_embedding = position_embedding
         self.tie_embeddings = tie_embeddings
 
