@@ -449,3 +449,36 @@ class TestLlamaHPLayer:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
         assert model._live_chunks_hwm <= 2
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_llama_hp_checkpoint_roundtrip_across_configs(tmp_path):
+    """Cross-config checkpoint reload works for the Llama HP layer too
+    (GQA kv projections included): save under one per-layer strategy,
+    reload under another, training continues with identical loss."""
+    from hetu_tpu.galvatron import LlamaHPLayer
+    import optax
+
+    def make(tp_sizes, dp_types):
+        specs = [LlamaHPLayer(hidden=32, heads=4, kv_heads=2, ffn=64)
+                 for _ in tp_sizes]
+        cfg = HybridParallelConfig(pp_deg=1, tp_sizes=tp_sizes,
+                                   dp_types=dp_types, chunks=1, world=8)
+        return HybridParallelModel(specs, cfg)
+
+    m1 = make([1, 2], [0, 1])
+    params = m1.init_params(jax.random.PRNGKey(0))
+    step, opt_init = m1.make_train_step(optax.adam(1e-3))
+    opt_state = opt_init(params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 32))
+    tgt = jnp.zeros_like(x)
+    params, opt_state, l0 = step(params, opt_state, x, tgt)
+    p = str(tmp_path / "llama_hp.ckpt")
+    m1.save(p, params, opt_state)
+
+    m2 = make([2, 4], [1, 0])
+    params2, opt_state2 = m2.load(p)
+    step2, _ = m2.make_train_step(optax.adam(1e-3))
+    params2, opt_state2, l1 = step2(params2, opt_state2, x, tgt)
+    params, opt_state, l1_ref = step(params, opt_state, x, tgt)
+    np.testing.assert_allclose(float(l1), float(l1_ref), rtol=1e-5)
