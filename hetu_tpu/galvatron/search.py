@@ -283,3 +283,50 @@ def profile_layers_analytic(n_layers, hidden, seq, ffn_mult=4, dtype_bytes=2,
     act_bytes = seq * hidden * dtype_bytes
     return [LayerProfile(compute_ms, param_bytes, act_bytes)
             for _ in range(n_layers)]
+
+
+def profile_hp_layers(specs, batch=2, seq=128, reps=5, devices=None):
+    """MEASURED LayerProfile for each HP layer spec (TransformerHPLayer,
+    LlamaHPLayer, ...) — the reference's computation-profiling step
+    (tools/Hetu-Galvatron/galvatron/core/profiler.py:194-478 writes
+    computation_profiling_*.json per layer type, which the search loads).
+
+    Times the UNSHARDED layer forward on one device of the current
+    backend (one profile per distinct spec type; same-typed layers share
+    it, like the reference's layertype_* entries)."""
+    import time
+    import jax
+    import jax.numpy as jnp
+    from .runtime import LayerShardings
+    from .config import HybridParallelConfig
+    from jax.sharding import Mesh
+
+    dev = (devices or jax.devices())[0]
+    mesh = Mesh(np.asarray([dev]), ("m0",))
+    by_type = {}
+    out = []
+    for spec in specs:
+        key = (type(spec).__name__, spec.hidden,
+               getattr(spec, "ffn", None), getattr(spec, "heads", None))
+        if key not in by_type:
+            cfg = HybridParallelConfig(pp_deg=1, tp_sizes=[1],
+                                       dp_types=[0], world=1)
+            sh = LayerShardings(mesh, cfg, 0)
+            params = jax.device_put(spec.init(jax.random.PRNGKey(0)), dev)
+            x = jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(1),
+                                  (batch, seq, spec.hidden), spec.dtype),
+                dev)
+            fwd = jax.jit(lambda p, x: spec.apply(p, x, sh))
+            np.asarray(fwd(params, x))           # compile + real sync
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                o = fwd(params, x)
+            np.asarray(o)
+            ms = (time.perf_counter() - t0) / reps * 1e3
+            param_bytes = sum(v.size * v.dtype.itemsize
+                              for v in jax.tree_util.tree_leaves(params))
+            act_bytes = seq * spec.hidden * jnp.dtype(spec.dtype).itemsize
+            by_type[key] = LayerProfile(ms / batch, param_bytes, act_bytes)
+        out.append(by_type[key])
+    return out

@@ -226,3 +226,26 @@ def test_measured_times_feed_search(tmp_path):
     import json
     with open(tmp_path / "times.json") as f:
         assert json.load(f)  # persisted for the next search
+
+
+def test_measured_hp_layer_profiles_feed_search():
+    """profile_hp_layers times the actual HP layer specs (reference
+    computation_profiling_*.json role) and the searcher consumes the
+    measured profiles; a heavier layer must get a larger compute_ms."""
+    from hetu_tpu.galvatron import (GalvatronSearch, LlamaHPLayer,
+                                    TransformerHPLayer, profile_hp_layers)
+
+    small = TransformerHPLayer(hidden=32, heads=4)
+    big = TransformerHPLayer(hidden=128, heads=4)
+    llama = LlamaHPLayer(hidden=32, heads=4, kv_heads=2, ffn=64)
+    profiles = profile_hp_layers([small, big, llama, small], reps=3)
+    assert len(profiles) == 4
+    assert profiles[0] is profiles[3]           # same type shares profile
+    assert profiles[1].compute_ms > profiles[0].compute_ms
+    assert profiles[1].param_bytes > profiles[0].param_bytes
+    assert all(p.compute_ms > 0 for p in profiles)
+
+    cfg = GalvatronSearch(world=8, mem_budget_bytes=8 << 30,
+                          micro_bsz=2, pp_candidates=[1],
+                          chunks_candidates=(1,)).search(profiles)
+    assert cfg is not None and cfg.n_layers == 4
