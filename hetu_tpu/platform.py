@@ -20,15 +20,15 @@ def host_callbacks_supported() -> bool:
     if _HOST_CALLBACKS is None:
         import jax
         import jax.numpy as jnp
+        if _in_trace():
+            # called mid-trace with no cached verdict: a jit probe here
+            # would STAGE into the enclosing program (omnistaging) and
+            # "succeed" while smuggling the callback into the caller's
+            # compiled program.  Answer conservatively and leave the
+            # cache unset so an eager call can still establish the real
+            # verdict.
+            return False
         try:
-            if not jax.core.trace_state_clean():
-                # called mid-trace with no cached verdict: a jit probe
-                # here would STAGE into the enclosing program
-                # (omnistaging) and "succeed" while smuggling the
-                # callback into the caller's compiled program.  Answer
-                # conservatively and leave the cache unset so an eager
-                # call can still establish the real verdict.
-                return False
             jax.block_until_ready(jax.jit(
                 lambda x: (jax.debug.print("", ordered=False), x)[1]
             )(jnp.zeros(())))
@@ -37,3 +37,20 @@ def host_callbacks_supported() -> bool:
         except Exception:
             _HOST_CALLBACKS = False
     return _HOST_CALLBACKS
+
+
+def _in_trace() -> bool:
+    """True when called under an active jax trace.
+
+    jax.core.trace_state_clean was removed in newer jax; the portable
+    detection is whether array CREATION gets staged to a Tracer (under
+    omnistaging any op inside a trace context does)."""
+    import jax
+    import jax.numpy as jnp
+    clean = getattr(jax.core, "trace_state_clean", None)
+    if clean is not None:
+        try:
+            return not clean()
+        except Exception:
+            pass
+    return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
