@@ -117,6 +117,8 @@ class SubExecutor:
             # optimizers update the full-precision masters (the standard
             # TPU bf16-compute / f32-master-weights policy).
             ctx = TraceContext(key=key, training=training, mesh=mesh,
+                               cp_impl=self.executor.config.get(
+                                   "cp_impl", "ring"),
                                master_params=(params if compute_dtype
                                               is not None else None))
             ctx.opt_state = opt_state

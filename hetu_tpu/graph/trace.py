@@ -32,10 +32,14 @@ class TraceContext:
     """
 
     def __init__(self, key=None, training=True, mesh=None,
-                 master_params=None):
+                 master_params=None, cp_impl="ring"):
         self.key = key
         self.training = training
         self.mesh = mesh
+        # long-context lowering flavor over a 'cp' mesh axis: 'ring'
+        # (K/V rotate the ICI ring) or 'ulysses' (all-to-all head
+        # parallelism); Executor(cp_impl=...) selects it
+        self.cp_impl = cp_impl
         self.updates = {}        # VariableOp -> new value (tracer)
         self.opt_state = {}      # {optimizer_op_name: state pytree} (input)
         self.new_opt_state = {}  # {optimizer_op_name: state pytree} (output)

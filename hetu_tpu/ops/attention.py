@@ -68,6 +68,12 @@ class ScaledDotProductAttentionOp(Op):
                 and q.shape[2] % ctx.mesh.shape["cp"] == 0
                 and ("dp" not in ctx.mesh.shape
                      or q.shape[0] % ctx.mesh.shape["dp"] == 0)):
+            impl = getattr(ctx, "cp_impl", "ring")
+            if (impl == "ulysses"
+                    and q.shape[1] % ctx.mesh.shape["cp"] == 0):
+                from ..parallel.context_parallel import ulysses_attention
+                return ulysses_attention(ctx.mesh, q, k, v,
+                                         causal=self.causal, scale=scale)
             from ..parallel.context_parallel import ring_attention
             return ring_attention(ctx.mesh, q, k, v, causal=self.causal,
                                   scale=scale)

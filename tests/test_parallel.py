@@ -327,3 +327,30 @@ def test_llama_decode_under_tp_mesh_matches_single_device():
     np.testing.assert_array_equal(got, ref)
     # params genuinely sharded
     assert sharded["llamadtp_layer0_attn_q_weight"].sharding.spec[1] == "tp"
+
+
+def test_llama_cp_ulysses_impl_matches_single_device():
+    """Executor(cp_impl='ulysses') lowers the attention op to all-to-all
+    head parallelism instead of the ring — same logits as one device
+    (heads must divide cp; here 8 heads over cp=8)."""
+    from hetu_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    B, S = 2, 64
+    c = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=8, intermediate_size=32, seq_len=S)
+    rng = np.random.default_rng(5)
+    ids_v = rng.integers(0, 64, (B, S))
+
+    outs, prev = {}, None
+    for tag, kw in (("sd", {}),
+                    ("uly", dict(mesh=make_mesh({"cp": 8}),
+                                 cp_impl="ulysses"))):
+        i_ = ht.placeholder_op(f"uly_ids_{tag}", (B, S), dtype=np.int32)
+        model = LlamaForCausalLM(c, name=f"llamauly_{tag}")
+        ex = ht.Executor([model(i_)], seed=31, training=False, **kw)
+        from conftest import clone_params_into
+        prev = clone_params_into(ex, prev)
+        outs[tag] = ex.run(feed_dict={i_: ids_v},
+                           convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(outs["uly"], outs["sd"], rtol=2e-4,
+                               atol=2e-4)
