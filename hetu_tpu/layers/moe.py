@@ -140,8 +140,11 @@ class _MoEOp(Op):
     sharding annotations stay local to the op)."""
 
     def __init__(self, x, gate, w1, b1, w2, b2, num_experts, capacity_factor,
-                 k, ep_axis=None, ids=None, sparse=True, name=None):
+                 k, ep_axis=None, ids=None, sparse=True, w3=None,
+                 name=None):
         inputs = [x, w1, b1, w2, b2]
+        if w3 is not None:                    # swiglu experts: up proj
+            inputs.append(w3)
         if gate.wg is not None:
             inputs.append(gate.wg)
         if ids is not None:
@@ -153,15 +156,17 @@ class _MoEOp(Op):
         self.k = k
         self.ep_axis = ep_axis
         self.sparse = sparse
+        self.has_w3 = w3 is not None
         self.has_ids = ids is not None
 
     def _unpack(self, input_vals):
         """Input layout shared with MoEAuxLossOp (same inputs list)."""
         x, w1, b1, w2, b2 = input_vals[:5]
         rest = list(input_vals[5:])
+        w3 = rest.pop(0) if self.has_w3 else None
         wg = rest.pop(0) if self.gate.wg is not None else None
         ids = rest.pop(0) if self.has_ids else None
-        return x, w1, b1, w2, b2, wg, ids
+        return x, w1, b1, w2, b2, w3, wg, ids
 
     def _capacity(self, T):
         return max(int(np.ceil(self.capacity_factor * T * self.k
@@ -171,7 +176,7 @@ class _MoEOp(Op):
         import jax
         import jax.numpy as jnp
         from ..ops.moe import sparse_dispatch, sparse_combine
-        x, w1, b1, w2, b2, wg, ids = self._unpack(input_vals)
+        x, w1, b1, w2, b2, w3, wg, ids = self._unpack(input_vals)
 
         orig_shape = x.shape
         h = x.shape[-1]
@@ -205,9 +210,15 @@ class _MoEOp(Op):
                 expert_in, NamedSharding(ctx.mesh,
                                          P(self.ep_axis, None, None)))
         # per-expert FFN: [E, C, H] @ [E, H, F] -> [E, C, F]
-        a = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, w1)
-                        + b1[:, None, :])
-        out = jnp.einsum("ecf,efh->ech", a, w2) + b2[:, None, :]
+        if self.has_w3:
+            # swiglu experts (Mixtral-style): silu(x@w1) * (x@w3) @ w2
+            a = (jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in, w1))
+                 * jnp.einsum("ech,ehf->ecf", expert_in, w3))
+            out = jnp.einsum("ecf,efh->ech", a, w2)
+        else:
+            a = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, w1)
+                            + b1[:, None, :])
+            out = jnp.einsum("ecf,efh->ech", a, w2) + b2[:, None, :]
         if self.ep_axis is not None and ctx.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             out = jax.lax.with_sharding_constraint(
@@ -231,7 +242,7 @@ class MoEAuxLossOp(Op):
         # subexecutor from the MoE op must not pay the full dispatch
         # recompute (in the same jitted program, CSE merges it anyway)
         import jax.numpy as jnp
-        x, _, _, _, _, wg, ids = self.moe._unpack(input_vals)
+        x, _, _, _, _, _, wg, ids = self.moe._unpack(input_vals)
         if not getattr(self.moe.gate, "has_aux", True):
             # hash/balance gates have identically-zero aux: skip the
             # dispatch recompute entirely
@@ -255,7 +266,8 @@ class MoELayer(BaseLayer):
 
     def __init__(self, hidden_size, intermediate_size, num_experts, k=2,
                  capacity_factor=1.25, gate="top", ep_axis=None,
-                 num_groups=None, sparse=True, name=None):
+                 num_groups=None, sparse=True, expert_act="gelu",
+                 name=None):
         name = fresh_name(name or "moe")
         if isinstance(gate, BaseLayer):
             self.gate = gate                      # caller-built gate
@@ -272,6 +284,8 @@ class MoELayer(BaseLayer):
             self.gate = BalanceGate(hidden_size, num_experts, name=name)
         else:
             raise ValueError(gate)
+        assert expert_act in ("gelu", "swiglu")
+        self.expert_act = expert_act
         self.w1 = VariableOp(f"{name}_w1",
                              (num_experts, hidden_size, intermediate_size),
                              init.xavier_uniform())
@@ -282,6 +296,12 @@ class MoELayer(BaseLayer):
                              init.xavier_uniform())
         self.b2 = VariableOp(f"{name}_b2", (num_experts, hidden_size),
                              init.zeros())
+        # swiglu experts (Mixtral-style, reference-beyond): gated FFN
+        # silu(x@w1) * (x@w3) @ w2, no biases
+        self.w3 = VariableOp(f"{name}_w3",
+                             (num_experts, hidden_size, intermediate_size),
+                             init.xavier_uniform()) \
+            if expert_act == "swiglu" else None
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.k = k
@@ -291,7 +311,9 @@ class MoELayer(BaseLayer):
         # form and is the default memory-safe path
         self.sparse = sparse
         if ep_axis is not None:
-            for v in (self.w1, self.b1, self.w2, self.b2):
+            ep_vars = [self.w1, self.b1, self.w2, self.b2] \
+                + ([self.w3] if self.w3 is not None else [])
+            for v in ep_vars:
                 from ..parallel.mesh import DistState
                 v.dist_state = DistState({0: ep_axis})
         self.last_op = None
@@ -304,7 +326,7 @@ class MoELayer(BaseLayer):
                               self.b2, self.num_experts,
                               self.capacity_factor, self.k,
                               ep_axis=self.ep_axis, ids=ids,
-                              sparse=self.sparse)
+                              sparse=self.sparse, w3=self.w3)
         return self.last_op
 
     def aux_loss(self):

@@ -9,6 +9,7 @@ from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
                     BaichuanForCausalLM, LLAMA_CONFIGS)
 from .llama_decode import build_greedy_decode, greedy_generate
 from .hf_import import (load_hf_bert_weights, load_hf_gpt2_weights,
-                        load_hf_llama_weights, export_hf_llama_weights)
+                        load_hf_llama_weights, export_hf_llama_weights,
+                        load_hf_mixtral_weights)
 from .zoo import (LogReg, CNN3, AlexNet, VGG, vgg16, vgg19,
                   RNNClassifier, LSTMClassifier)

@@ -206,3 +206,47 @@ def export_hf_llama_weights(executor, model, name="llama"):
     else:
         sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
     return sd
+
+
+def load_hf_mixtral_weights(executor, model, state_dict, name="llama"):
+    """Copy a transformers.MixtralForCausalLM state_dict into a
+    LlamaForCausalLM built with ``num_experts`` (SwiGLU sparse-MoE
+    blocks).  Router gate -> TopKGate wg; per-expert w1/w3/w2 stack into
+    the MoELayer's [E, H, F]/[E, F, H] tensors.  Gating math matches:
+    top-2 renormalization of full-softmax probs equals Mixtral's softmax
+    over the top-2 logits, and capacity_factor >= E/k drops nothing."""
+    sd = {}
+    for k, v in state_dict.items():
+        v = v.detach().cpu().numpy() if hasattr(v, "detach") else \
+            np.asarray(v)
+        sd[k[6:] if k.startswith("model.") else k] = v
+    p = executor.params
+    cfg = model.config
+    E = cfg.num_experts
+    _put(p, f"{name}_embed_table", sd["embed_tokens.weight"])
+    for i in range(cfg.num_layers):
+        hf = f"layers.{i}."
+        our = f"{name}_layer{i}"
+        for proj, hname in (("q", "self_attn.q_proj"),
+                            ("k", "self_attn.k_proj"),
+                            ("v", "self_attn.v_proj"),
+                            ("out", "self_attn.o_proj")):
+            _put(p, f"{our}_attn_{proj}_weight", sd[hf + hname + ".weight"].T)
+        moe = hf + "block_sparse_moe."
+        # variable names come from the layer object (fresh_name may have
+        # suffixed the gate), not from string reconstruction
+        mlp = model.model.layers[i].mlp
+        _put(p, mlp.gate.wg.name, sd[moe + "gate.weight"].T)   # [H, E]
+        _put(p, mlp.w1.name, np.stack(
+            [sd[moe + f"experts.{j}.w1.weight"].T for j in range(E)]))
+        _put(p, mlp.w3.name, np.stack(
+            [sd[moe + f"experts.{j}.w3.weight"].T for j in range(E)]))
+        _put(p, mlp.w2.name, np.stack(
+            [sd[moe + f"experts.{j}.w2.weight"].T for j in range(E)]))
+        _put(p, f"{our}_input_norm_scale", sd[hf + "input_layernorm.weight"])
+        _put(p, f"{our}_post_norm_scale",
+             sd[hf + "post_attention_layernorm.weight"])
+    _put(p, f"{name}_norm_scale", sd["norm.weight"])
+    if model.lm_head is not None:
+        _put(p, f"{name}_lm_head_weight", sd["lm_head.weight"].T)
+    return executor

@@ -31,7 +31,9 @@ class LlamaConfig:
     def __init__(self, vocab_size=32000, hidden_size=4096, num_layers=32,
                  num_heads=32, num_kv_heads=None, intermediate_size=11008,
                  seq_len=2048, rope_theta=10000.0, rms_eps=1e-5,
-                 position_embedding="rope", tie_embeddings=False):
+                 position_embedding="rope", tie_embeddings=False,
+                 num_experts=None, moe_k=2, moe_capacity_factor=2.0,
+                 moe_aux_coeff=0.01, ep_axis=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -52,6 +54,14 @@ class LlamaConfig:
                 f"heads {num_heads})")
         self.position_embedding = position_embedding
         self.tie_embeddings = tie_embeddings
+        # num_experts turns each block's FFN into a top-k sparse-MoE of
+        # SwiGLU experts (Mixtral-style; the reference's MoE tier is a
+        # plain transformer, examples/moe — this composes it with Llama)
+        self.num_experts = num_experts
+        self.moe_k = moe_k
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_aux_coeff = moe_aux_coeff
+        self.ep_axis = ep_axis
 
 
 # published shapes (match the reference's meta_configs/hf_configs)
@@ -105,8 +115,16 @@ class LlamaDecoderLayer(BaseLayer):
                         if c.position_embedding == "rope" else None),
             alibi=c.position_embedding == "alibi", bias=False,
             name=f"{name}_attn")
-        self.mlp = LlamaMLP(c.hidden_size, c.intermediate_size,
-                            name=f"{name}_mlp")
+        if c.num_experts:
+            from ..layers.moe import MoELayer
+            self.mlp = MoELayer(c.hidden_size, c.intermediate_size,
+                                num_experts=c.num_experts, k=c.moe_k,
+                                capacity_factor=c.moe_capacity_factor,
+                                expert_act="swiglu", ep_axis=c.ep_axis,
+                                name=f"{name}_moe")
+        else:
+            self.mlp = LlamaMLP(c.hidden_size, c.intermediate_size,
+                                name=f"{name}_mlp")
         self.input_norm = RMSNorm(c.hidden_size, eps=c.rms_eps,
                                   name=f"{name}_input_norm")
         self.post_norm = RMSNorm(c.hidden_size, eps=c.rms_eps,
@@ -182,7 +200,12 @@ class LlamaForCausalLM:
         logits = self(input_ids)
         flat = array_reshape_op(labels, output_shape=(-1,))
         ce = softmax_cross_entropy_sparse_op(logits, flat, ignored_index=-1)
-        return MaskedMeanOp(ce, flat)
+        loss = MaskedMeanOp(ce, flat)
+        if self.config.num_experts:
+            for layer in self.model.layers:
+                loss = loss + self.config.moe_aux_coeff \
+                    * layer.mlp.aux_loss()
+        return loss
 
 
 def BaichuanForCausalLM(config, name="baichuan", pipeline_stages=None):

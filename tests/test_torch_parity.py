@@ -521,3 +521,45 @@ def test_gpt2_greedy_decode_matches_hf_generate(rng):
                            max_new_tokens=NEW, do_sample=False,
                            use_cache=True)
     np.testing.assert_array_equal(ours, _t2n(want))
+
+
+def test_tiny_mixtral_matches_huggingface(rng):
+    """Mixtral-class sparse-MoE Llama (SwiGLU experts, top-2 router) vs
+    transformers.MixtralForCausalLM with imported weights: logits parity.
+    Top-2 renorm of full-softmax probs == Mixtral's softmax over top-2
+    logits, and capacity_factor = E/k guarantees no capacity drops, so
+    the routing math is identical."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                 load_hf_mixtral_weights)
+
+    B, S, V, E = 2, 16, 100, 4
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=V, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=56, max_position_embeddings=64,
+        num_local_experts=E, num_experts_per_tok=2,
+        rms_norm_eps=1e-6, rope_theta=10000.0, sliding_window=None,
+        attention_bias=False, tie_word_embeddings=False,
+        output_router_logits=False)
+    torch.manual_seed(7)
+    hf = transformers.MixtralForCausalLM(hf_cfg)
+    hf.eval()
+
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=S, rms_eps=1e-6, rope_theta=10000.0,
+                    num_experts=E, moe_k=2,
+                    moe_capacity_factor=E / 2)   # C = T: no drops
+    model = LlamaForCausalLM(c, name="mixparity")
+    ids = ht.placeholder_op("mx_ids", (B, S), dtype=np.int32)
+    logits = model(ids)
+    ex = ht.Executor([logits], training=False)
+    load_hf_mixtral_weights(ex, model, hf.state_dict(), name="mixparity")
+
+    ids_v = rng.integers(0, V, (B, S))
+    (got,) = ex.run(feed_dict={ids: ids_v}, convert_to_numpy_ret_vals=True)
+    with torch.no_grad():
+        want = hf(input_ids=torch.from_numpy(ids_v)).logits
+    np.testing.assert_allclose(got.reshape(B, S, V), _t2n(want),
+                               rtol=2e-3, atol=2e-3)
