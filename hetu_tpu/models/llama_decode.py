@@ -47,7 +47,7 @@ def _rotate(x, cos, sin):
 
 
 def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
-                        top_k=0):
+                        top_k=0, moe_names=None):
     """Returns jitted ``fn(params, prompt_ids [B, P][, key]) ->
     [B, P+max_new]``.
 
@@ -62,17 +62,42 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
 
     def layer_params(params, i):
         our = f"{name}_layer{i}"
-        return {
+        out = {
             "in_norm": params[f"{our}_input_norm_scale"],
             "post_norm": params[f"{our}_post_norm_scale"],
             "wq": params[f"{our}_attn_q_weight"],
             "wk": params[f"{our}_attn_k_weight"],
             "wv": params[f"{our}_attn_v_weight"],
             "wo": params[f"{our}_attn_out_weight"],
-            "gate": params[f"{our}_mlp_gate_weight"],
-            "up": params[f"{our}_mlp_up_weight"],
-            "down": params[f"{our}_mlp_out_weight"],
         }
+        if c.num_experts:
+            # sparse-MoE blocks (Mixtral-style): variable names resolved
+            # by the caller from the layer objects (moe_names), since
+            # fresh_name may suffix the router gate
+            nm = moe_names[i]
+            out.update(wg=params[nm["wg"]], ew1=params[nm["w1"]],
+                       ew2=params[nm["w2"]], ew3=params[nm["w3"]])
+        else:
+            out.update(gate=params[f"{our}_mlp_gate_weight"],
+                       up=params[f"{our}_mlp_up_weight"],
+                       down=params[f"{our}_mlp_out_weight"])
+        return out
+
+    def moe_ffn(lp, f):
+        """Dense-combine top-k MoE for decode: every expert computes, the
+        router's top-k renormalized weights combine.  Correct for any
+        batch; the bandwidth-optimal per-token expert gather is a decode
+        optimization, not a semantics change."""
+        probs = jax.nn.softmax((f @ lp["wg"]).astype(jnp.float32), -1)
+        topv, topi = jax.lax.top_k(probs, c.moe_k)        # [B, S, k]
+        w = topv / jnp.sum(topv, -1, keepdims=True)
+        e_w = jnp.sum(jax.nn.one_hot(topi, c.num_experts,
+                                     dtype=w.dtype) * w[..., None],
+                      axis=-2)                            # [B, S, E]
+        a = (jax.nn.silu(jnp.einsum("bsh,ehf->bsef", f, lp["ew1"]))
+             * jnp.einsum("bsh,ehf->bsef", f, lp["ew3"]))
+        y = jnp.einsum("bsef,efh->bseh", a, lp["ew2"])
+        return jnp.einsum("bse,bseh->bsh", e_w.astype(y.dtype), y)
 
     def attend(q, keys, vals, pos_mask):
         """q [B, H, Sq, D]; keys/vals [B, KV, T, D]; pos_mask [Sq, T]."""
@@ -110,6 +135,8 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
         o = o.transpose(0, 2, 1, 3).reshape(b, sq, c.hidden_size)
         x = x + o @ lp["wo"]
         f = _rms(x, lp["post_norm"], c.rms_eps)
+        if c.num_experts:
+            return x + moe_ffn(lp, f), cache_k, cache_v
         return (x + (jax.nn.silu(f @ lp["gate"]) * (f @ lp["up"]))
                 @ lp["down"], cache_k, cache_v)
 
@@ -189,8 +216,14 @@ def greedy_generate(executor, model, prompt_ids, max_new, name=None,
     name = name or next(k for k in executor.params
                         if k.endswith("_embed_table")).rsplit(
         "_embed_table", 1)[0]
+    moe_names = None
+    if model.config.num_experts:
+        moe_names = [{"wg": l.mlp.gate.wg.name, "w1": l.mlp.w1.name,
+                      "w2": l.mlp.w2.name, "w3": l.mlp.w3.name}
+                     for l in model.model.layers]
     fn = build_greedy_decode(model.config, max_new, name=name,
-                             temperature=temperature, top_k=top_k)
+                             temperature=temperature, top_k=top_k,
+                             moe_names=moe_names)
     return np.asarray(fn(executor.params,
                          jnp.asarray(prompt_ids, jnp.int32),
                          jax.random.key(seed)))

@@ -563,3 +563,43 @@ def test_tiny_mixtral_matches_huggingface(rng):
         want = hf(input_ids=torch.from_numpy(ids_v)).logits
     np.testing.assert_allclose(got.reshape(B, S, V), _t2n(want),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_mixtral_greedy_decode_matches_hf_generate(rng):
+    """KV-cache decode of the sparse-MoE Llama (dense-combine experts)
+    matches transformers MixtralForCausalLM generate token-for-token."""
+    transformers = pytest.importorskip("transformers")
+    from hetu_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                 load_hf_mixtral_weights)
+    from hetu_tpu.models.llama_decode import greedy_generate
+
+    B, P, V, E, NEW = 2, 8, 100, 4, 8
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=V, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=56, max_position_embeddings=64,
+        num_local_experts=E, num_experts_per_tok=2,
+        rms_norm_eps=1e-6, rope_theta=10000.0, sliding_window=None,
+        attention_bias=False, tie_word_embeddings=False,
+        output_router_logits=False)
+    torch.manual_seed(11)
+    hf = transformers.MixtralForCausalLM(hf_cfg)
+    hf.eval()
+    hf.generation_config.pad_token_id = 0
+
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=P, rms_eps=1e-6, num_experts=E, moe_k=2,
+                    moe_capacity_factor=E / 2)
+    model = LlamaForCausalLM(c, name="mixdec")
+    ids = ht.placeholder_op("mxd_ids", (B, P), dtype=np.int32)
+    ex = ht.Executor([model(ids)], training=False)
+    load_hf_mixtral_weights(ex, model, hf.state_dict(), name="mixdec")
+
+    prompt = rng.integers(1, V, (B, P))
+    ours = greedy_generate(ex, model, prompt, NEW, name="mixdec")
+    with torch.no_grad():
+        want = hf.generate(torch.from_numpy(prompt),
+                           max_new_tokens=NEW, do_sample=False,
+                           use_cache=True)
+    np.testing.assert_array_equal(ours, _t2n(want))
