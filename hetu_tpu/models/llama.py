@@ -76,6 +76,14 @@ LLAMA_CONFIGS = {
     "llama3-8b": dict(hidden_size=4096, num_layers=32, num_heads=32,
                       num_kv_heads=8, intermediate_size=14336,
                       vocab_size=128256, rope_theta=500000.0),
+    # GQA shapes of the Mistral family (sliding-window attention not
+    # modeled; full causal within seq_len)
+    "mistral-7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                       num_kv_heads=8, intermediate_size=14336,
+                       vocab_size=32000),
+    "mixtral-8x7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                         num_kv_heads=8, intermediate_size=14336,
+                         vocab_size=32000, num_experts=8, moe_k=2),
     # reference models/baichuan: 7B is rope, 13B is alibi
     "baichuan-7b": dict(vocab_size=64000, hidden_size=4096, num_layers=32,
                         num_heads=32, intermediate_size=11008),
