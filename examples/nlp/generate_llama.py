@@ -80,9 +80,14 @@ def main():
     import jax
     import jax.numpy as jnp
     from hetu_tpu.models.llama_decode import build_greedy_decode
+    moe_names = None
+    if c.num_experts:
+        moe_names = [{"wg": l.mlp.gate.wg.name, "w1": l.mlp.w1.name,
+                      "w2": l.mlp.w2.name, "w3": l.mlp.w3.name}
+                     for l in model.model.layers]
     fn = build_greedy_decode(c, args.max_new, name="gen",
                              temperature=args.temperature,
-                             top_k=args.top_k)
+                             top_k=args.top_k, moe_names=moe_names)
     key = jax.random.key(args.seed)
     pids = jnp.asarray(prompt, jnp.int32)
     out = np.asarray(fn(ex.params, pids, key))   # compile

@@ -142,7 +142,10 @@ class _MoEOp(Op):
     def __init__(self, x, gate, w1, b1, w2, b2, num_experts, capacity_factor,
                  k, ep_axis=None, ids=None, sparse=True, w3=None,
                  name=None):
-        inputs = [x, w1, b1, w2, b2]
+        # swiglu experts are biasless: b1/b2 are None and stay out of the
+        # graph entirely (no dead optimizer state / checkpoint entries)
+        inputs = [x, w1, w2] if b1 is None else [x, w1, b1, w2, b2]
+        self.has_biases = b1 is not None
         if w3 is not None:                    # swiglu experts: up proj
             inputs.append(w3)
         if gate.wg is not None:
@@ -161,8 +164,13 @@ class _MoEOp(Op):
 
     def _unpack(self, input_vals):
         """Input layout shared with MoEAuxLossOp (same inputs list)."""
-        x, w1, b1, w2, b2 = input_vals[:5]
-        rest = list(input_vals[5:])
+        if self.has_biases:
+            x, w1, b1, w2, b2 = input_vals[:5]
+            rest = list(input_vals[5:])
+        else:
+            x, w1, w2 = input_vals[:3]
+            b1 = b2 = None
+            rest = list(input_vals[3:])
         w3 = rest.pop(0) if self.has_w3 else None
         wg = rest.pop(0) if self.gate.wg is not None else None
         ids = rest.pop(0) if self.has_ids else None
@@ -290,12 +298,14 @@ class MoELayer(BaseLayer):
                              (num_experts, hidden_size, intermediate_size),
                              init.xavier_uniform())
         self.b1 = VariableOp(f"{name}_b1", (num_experts, intermediate_size),
-                             init.zeros())
+                             init.zeros()) \
+            if expert_act == "gelu" else None
         self.w2 = VariableOp(f"{name}_w2",
                              (num_experts, intermediate_size, hidden_size),
                              init.xavier_uniform())
         self.b2 = VariableOp(f"{name}_b2", (num_experts, hidden_size),
-                             init.zeros())
+                             init.zeros()) \
+            if expert_act == "gelu" else None
         # swiglu experts (Mixtral-style, reference-beyond): gated FFN
         # silu(x@w1) * (x@w3) @ w2, no biases
         self.w3 = VariableOp(f"{name}_w3",
@@ -311,8 +321,8 @@ class MoELayer(BaseLayer):
         # form and is the default memory-safe path
         self.sparse = sparse
         if ep_axis is not None:
-            ep_vars = [self.w1, self.b1, self.w2, self.b2] \
-                + ([self.w3] if self.w3 is not None else [])
+            ep_vars = [v for v in (self.w1, self.b1, self.w2, self.b2,
+                                   self.w3) if v is not None]
             for v in ep_vars:
                 from ..parallel.mesh import DistState
                 v.dist_state = DistState({0: ep_axis})

@@ -1,0 +1,60 @@
+"""Shared pieces of the KV-cache decoders (llama_decode / gpt_decode)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def make_picker(temperature, top_k):
+    """Token selection for decode: greedy argmax at temperature<=0, else
+    categorical over softmax(logits/temperature) restricted to the top_k
+    largest logits."""
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        lg = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        return jax.random.categorical(key, lg, axis=-1)
+
+    return pick
+
+
+def make_attend(head_dim, n_rep=1):
+    """Masked cache attention: q [B, H, Sq, D] against cached keys/vals
+    [B, KV, T, D] (kv heads broadcast n_rep-fold for GQA), with an
+    additive position mask [Sq, T]."""
+
+    def attend(q, keys, vals, pos_mask):
+        if n_rep > 1:
+            b, kv, t, d = keys.shape
+            keys = jnp.broadcast_to(keys[:, :, None],
+                                    (b, kv, n_rep, t, d)).reshape(
+                b, kv * n_rep, t, d)
+            vals = jnp.broadcast_to(vals[:, :, None],
+                                    (b, kv, n_rep, t, d)).reshape(
+                b, kv * n_rep, t, d)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
+                       preferred_element_type=jnp.float32) \
+            / np.sqrt(head_dim)
+        s = jnp.where(pos_mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(vals.dtype), vals,
+                          preferred_element_type=jnp.float32
+                          ).astype(vals.dtype)
+
+    return attend
+
+
+def assemble(prompt_ids, first, last, toks, max_new):
+    """[prompt | generated] given the scan outputs (first token computed
+    at prefill, `toks` the scanned tokens, `last` the final carry)."""
+    del first
+    gen = jnp.concatenate(
+        [toks.transpose(1, 0), last], axis=1) if max_new > 1 else last
+    return jnp.concatenate([prompt_ids, gen], axis=1)

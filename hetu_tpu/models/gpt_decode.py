@@ -18,6 +18,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ._decode_common import make_picker, make_attend, assemble
+
 
 def _ln(x, g, b, eps=1e-5):
     xf = x.astype(jnp.float32)
@@ -46,14 +48,7 @@ def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,
             "w2": "ffn_out_weight", "b2": "ffn_out_bias",
         }.items()}
 
-    def attend(q, keys, vals, pos_mask):
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
-                       preferred_element_type=jnp.float32) / np.sqrt(hd)
-        s = jnp.where(pos_mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(vals.dtype), vals,
-                          preferred_element_type=jnp.float32
-                          ).astype(vals.dtype)
+    attend = make_attend(hd)
 
     def block(lp, x, ck, cv, pos_mask, write_at):
         b, sq, _ = x.shape
@@ -76,14 +71,7 @@ def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,
                 params[f"{name}_ln_f_bias"])
         return h @ params[f"{name}_wte_table"].T     # tied head
 
-    def pick(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        lg = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
-            lg = jnp.where(lg < kth, -jnp.inf, lg)
-        return jax.random.categorical(key, lg, axis=-1)
+    pick = make_picker(temperature, top_k)
 
     @jax.jit
     def decode(params, prompt_ids, key=None):
@@ -129,9 +117,7 @@ def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,
 
         (last, _, _), toks = jax.lax.scan(
             step, (first, caches, key), jnp.arange(max_new - 1))
-        gen = jnp.concatenate(
-            [toks.transpose(1, 0), last], axis=1) if max_new > 1 else last
-        return jnp.concatenate([prompt_ids, gen], axis=1)
+        return assemble(prompt_ids, first, last, toks, max_new)
 
     return decode
 

@@ -81,9 +81,11 @@ LLAMA_CONFIGS = {
     "mistral-7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
                        num_kv_heads=8, intermediate_size=14336,
                        vocab_size=32000),
+    # moe_capacity_factor = E/k: the no-drop point Mixtral parity needs
     "mixtral-8x7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
                          num_kv_heads=8, intermediate_size=14336,
-                         vocab_size=32000, num_experts=8, moe_k=2),
+                         vocab_size=32000, num_experts=8, moe_k=2,
+                         moe_capacity_factor=4.0),
     # reference models/baichuan: 7B is rope, 13B is alibi
     "baichuan-7b": dict(vocab_size=64000, hidden_size=4096, num_layers=32,
                         num_heads=32, intermediate_size=11008),

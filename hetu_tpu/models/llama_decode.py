@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.rotary import _rope_tables
+from ._decode_common import make_picker, make_attend, assemble
 
 
 def _rms(x, g, eps):
@@ -99,23 +100,7 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
         y = jnp.einsum("bsef,efh->bseh", a, lp["ew2"])
         return jnp.einsum("bse,bseh->bsh", e_w.astype(y.dtype), y)
 
-    def attend(q, keys, vals, pos_mask):
-        """q [B, H, Sq, D]; keys/vals [B, KV, T, D]; pos_mask [Sq, T]."""
-        if n_rep > 1:
-            b, kv, t, d = keys.shape
-            keys = jnp.broadcast_to(keys[:, :, None],
-                                    (b, kv, n_rep, t, d)).reshape(
-                b, kv * n_rep, t, d)
-            vals = jnp.broadcast_to(vals[:, :, None],
-                                    (b, kv, n_rep, t, d)).reshape(
-                b, kv * n_rep, t, d)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
-                       preferred_element_type=jnp.float32) / np.sqrt(hd)
-        s = jnp.where(pos_mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(vals.dtype), vals,
-                          preferred_element_type=jnp.float32
-                          ).astype(vals.dtype)
+    attend = make_attend(hd, n_rep)
 
     def block(lp, x, cache_k, cache_v, cos, sin, pos_mask, write_at):
         """x [B, Sq, H]; returns (x', cache_k', cache_v')."""
@@ -146,15 +131,7 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
             return h @ params[f"{name}_embed_table"].T
         return h @ params[f"{name}_lm_head_weight"]
 
-    def pick(logits, key):
-        """[B, 1, V] -> [B, 1] token ids (greedy or sampled)."""
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        lg = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
-            lg = jnp.where(lg < kth, -jnp.inf, lg)
-        return jax.random.categorical(key, lg, axis=-1)
+    pick = make_picker(temperature, top_k)
 
     @jax.jit
     def decode(params, prompt_ids, key=None):
@@ -201,9 +178,7 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
 
         (last, _, _), toks = jax.lax.scan(
             step, (first, caches, key), jnp.arange(max_new - 1))
-        gen = jnp.concatenate(
-            [toks.transpose(1, 0), last], axis=1) if max_new > 1 else last
-        return jnp.concatenate([prompt_ids, gen], axis=1)
+        return assemble(prompt_ids, first, last, toks, max_new)
 
     return decode
 
