@@ -28,6 +28,9 @@ def main():
     ap.add_argument("--gate", default="top2",
                     choices=["top1", "top2", "hash"])
     ap.add_argument("--experts", type=int, default=4)
+    ap.add_argument("--expert-act", default="gelu",
+                    choices=["gelu", "swiglu"],
+                    help="swiglu = Mixtral-style gated experts")
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--seq-len", type=int, default=32)
     ap.add_argument("--hidden", type=int, default=32)
@@ -40,7 +43,8 @@ def main():
     y = ht.placeholder_op("y", (B, S, Hd))
     k = 1 if args.gate == "top1" else 2
     moe = MoELayer(Hd, 4 * Hd, args.experts, k=k,
-                   gate=("hash" if args.gate == "hash" else "top"))
+                   gate=("hash" if args.gate == "hash" else "top"),
+                   expert_act=args.expert_act)
     tok_ids = None
     if args.gate == "hash":
         tok_ids = ht.placeholder_op("tok_ids", (B, S), dtype=np.int32)
