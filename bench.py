@@ -34,14 +34,25 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REFERENCE_A100_GPT_LAYER_MS = 2.0645  # published in the reference repo
 
 
+def _rerun(fn, lower_is_better=False, n=2, **kw):
+    """Run a baseline measurement n times and keep the BEST result (max
+    throughput / min latency).  The second run reuses the in-process jit
+    cache, so the extra cost is one timed loop — and the best-of guards
+    the ratio against one-off interference (the r02 ResNet 0.975 was a
+    variance artifact: BASELINE.md's own table for the same build says
+    1.01).  Ours-side timing gets the same treatment in _timeit."""
+    vals = [fn(**kw) for _ in range(n)]
+    return min(vals) if lower_is_better else max(vals)
+
+
 def _with_flash_baseline(baseline_fn, lower_is_better=False, **kw):
     """Measure the stock and flash-equipped flax baselines; the bar is
     the STRONGER of the two (VERDICT r2 item 5b).  Returns
     (bar, baseline_dict) with both raw numbers reported."""
     suffix = "_ms" if lower_is_better else ""
-    base = baseline_fn(**kw)
+    base = _rerun(baseline_fn, lower_is_better, **kw)
     try:
-        base_flash = baseline_fn(flash=True, **kw)
+        base_flash = _rerun(baseline_fn, lower_is_better, flash=True, **kw)
     except Exception:
         base_flash = None
     if lower_is_better:
@@ -65,11 +76,14 @@ def _timeit(fn, reps):
 
     out = fn()
     sync(out)
-    start = time.perf_counter()
-    for _ in range(reps):
-        out = fn()
-    sync(out)
-    return (time.perf_counter() - start) / reps, out
+    best = float("inf")
+    for _ in range(3):  # best-of-3 groups: robust to one-off interference
+        start = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        sync(out)
+        best = min(best, (time.perf_counter() - start) / reps)
+    return best, out
 
 
 def bench_bert(quick):
@@ -318,7 +332,7 @@ def bench_resnet(quick):
     del ex
     gc.collect()
     from benchmarks.flax_baselines import resnet18_samples_per_sec
-    base = resnet18_samples_per_sec(B, steps=steps)
+    base = _rerun(resnet18_samples_per_sec, batch=B, steps=steps)
     return {"metric": "resnet18_cifar_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
             "vs_baseline": round(ours / base, 3),
@@ -356,7 +370,8 @@ def bench_moe(quick):
     del ex
     gc.collect()
     from benchmarks.flax_baselines import moe_tokens_per_sec
-    base = moe_tokens_per_sec(B, S, hidden=H, d_ff=F, steps=steps)
+    base = _rerun(moe_tokens_per_sec, batch=B, seq=S, hidden=H, d_ff=F,
+                  steps=steps)
     return {"metric": "moe_top2_8expert_train_tokens_per_sec",
             "value": round(ours, 2), "unit": "tokens/sec",
             "vs_baseline": round(ours / base, 3),
@@ -389,7 +404,7 @@ def bench_wdl(quick):
     ours = 1.0 / dt
 
     from benchmarks.flax_baselines import wdl_steps_per_sec
-    base = wdl_steps_per_sec(B, rows=rows, steps=steps)
+    base = _rerun(wdl_steps_per_sec, batch=B, rows=rows, steps=steps)
     return {"metric": "wdl_criteo_train_steps_per_sec",
             "value": round(ours, 2), "unit": "steps/sec",
             "vs_baseline": round(ours / base, 3),
@@ -406,7 +421,15 @@ def main():
     if "--stage" in sys.argv:
         # only stage children may touch jax: the backend check in the
         # PARENT would acquire the TPU exclusively and starve them
-        quick = quick or __import__("jax").default_backend() == "cpu"
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            # the axon sitecustomize overrides the env var (config reads
+            # "axon,cpu"); honoring it through config keeps a CPU run from
+            # initializing the tunnel backend — which HANGS when the
+            # tunnel is down (tests/conftest.py does the same)
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        quick = quick or jax.default_backend() == "cpu"
         stage = sys.argv[sys.argv.index("--stage") + 1]
         print(json.dumps(STAGES[stage](quick)))
         return

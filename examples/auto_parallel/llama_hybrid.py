@@ -29,8 +29,8 @@ if os.environ.get("JAX_PLATFORMS"):
 
 import jax.numpy as jnp
 
-from hetu_tpu.galvatron import (GalvatronSearch, HybridParallelModel,
-                                LayerProfile, LlamaHPLayer)
+from hetu_tpu.galvatron import (GalvatronSearch, LayerProfile, LlamaHPLayer,
+                                make_lm_hybrid_model)
 
 PRESETS = {
     # hidden, layers, heads, kv_heads, ffn  (tiny = CI-sized)
@@ -47,6 +47,10 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=16)
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--embed-sdp", dest="embed_sdp", type=int, default=0,
+                    help="FSDP-shard the embedding/head rows (reference "
+                         "embed_sdp flag)")
     args = ap.parse_args()
 
     h, n_layers, heads, kv_heads, ffn = PRESETS[args.preset]
@@ -63,23 +67,26 @@ def main():
                           micro_bsz=2).search(layers)
     print("searched config:", cfg.to_json())
 
-    # 3. build + train under the searched config
+    # 3. build + train the FULL LM under the searched config: vocab-parallel
+    #    embedding + RMS-normed head wrap onto the first/last stage
+    #    (embed_sdp), tokens in → CE loss out (reference train_dist.py)
     specs = [LlamaHPLayer(hidden=h, heads=heads, kv_heads=kv_heads, ffn=ffn)
              for _ in range(n_layers)]
-    model = HybridParallelModel(specs, cfg)
+    model = make_lm_hybrid_model(args.vocab, specs, cfg,
+                                 embed_sdp=args.embed_sdp, norm="rms")
     params = model.init_params(jax.random.PRNGKey(0))
     step, opt_init = model.make_train_step(lr=1e-2)
     opt_state = opt_init(params)
 
-    x = jax.random.normal(jax.random.PRNGKey(1),
-                          (args.batch, args.seq_len, h), jnp.float32)
-    tgt = jax.random.normal(jax.random.PRNGKey(2),
-                            (args.batch, args.seq_len, h)) * 0.1
+    kx, kt = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.randint(kx, (args.batch, args.seq_len), 0, args.vocab)
+    tgt = jax.random.randint(kt, (args.batch, args.seq_len), 0, args.vocab)
     for i in range(args.steps):
         params, opt_state, loss = step(params, opt_state, x, tgt)
         print(f"step {i} loss {float(loss):.5f} "
               f"(schedule={cfg.pipeline_type}, pp={cfg.pp_deg}, "
-              f"tp={cfg.tp_sizes[0]})")
+              f"tp={cfg.tp_sizes[0]}, sp={cfg.sp_flags[0]}, "
+              f"embed_sdp={args.embed_sdp})")
 
 
 if __name__ == "__main__":

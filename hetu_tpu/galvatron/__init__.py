@@ -11,7 +11,9 @@ from .search import (CostModel, GalvatronSearch, LayerProfile, Strategy,
                      save_profile,
                      strategy_space)
 from .runtime import (HybridParallelModel, LayerShardings,
-                      TransformerHPLayer, LlamaHPLayer, build_mesh)
+                      TransformerHPLayer, LlamaHPLayer, VocabEmbedHPSpec,
+                      LMHeadHPSpec, lm_cross_entropy, lm_wrap_config,
+                      make_lm_hybrid_model, build_mesh)
 
 __all__ = [
     "dp_core", "dp_core_numpy", "HybridParallelConfig", "layer_mesh_axes",
@@ -19,5 +21,7 @@ __all__ = [
     "load_profile", "profile_layers_analytic", "profile_hp_layers",
     "save_profile",
     "strategy_space", "HybridParallelModel", "LayerShardings",
-    "TransformerHPLayer", "LlamaHPLayer", "build_mesh",
+    "TransformerHPLayer", "LlamaHPLayer", "VocabEmbedHPSpec",
+    "LMHeadHPSpec", "lm_cross_entropy", "lm_wrap_config",
+    "make_lm_hybrid_model", "build_mesh",
 ]

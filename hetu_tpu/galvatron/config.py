@@ -46,7 +46,7 @@ class HybridParallelConfig:
     def __init__(self, pp_deg, tp_sizes, dp_types, tp_consecutive=None,
                  checkpoint_flags=None, pp_division=None, global_bsz=None,
                  chunks=1, pipeline_type="gpipe", default_dp_type="ddp",
-                 embed_sdp=0, world=None):
+                 embed_sdp=0, world=None, sp_flags=None):
         n = len(tp_sizes)
         self.pp_deg = int(pp_deg)
         self.tp_sizes = [int(t) for t in tp_sizes]
@@ -55,6 +55,13 @@ class HybridParallelConfig:
                                if tp_consecutive is not None else [1] * n)
         self.checkpoint_flags = ([int(c) for c in checkpoint_flags]
                                  if checkpoint_flags is not None else [0] * n)
+        # Megatron sequence parallelism per layer (reference
+        # tensor_parallel/transformer.py sequence_parallel flag): the
+        # residual/LN segments are sharded along the sequence dim over the
+        # layer's tp axes.  Numerically identical to plain TP; a pure
+        # memory win.  Meaningful only where tp > 1.
+        self.sp_flags = ([int(s) for s in sp_flags]
+                         if sp_flags is not None else [0] * n)
         if pp_division is None:
             avg = n // self.pp_deg
             pp_division = [avg] * (self.pp_deg - 1) + [n - avg * (self.pp_deg - 1)]
@@ -80,7 +87,7 @@ class HybridParallelConfig:
                 f"unknown pipeline_type {self.pipeline_type!r}; this "
                 "runtime honors 'gpipe' and 'pipedream_flush'")
         assert len(self.dp_types) == n and len(self.tp_consecutive) == n \
-            and len(self.checkpoint_flags) == n
+            and len(self.checkpoint_flags) == n and len(self.sp_flags) == n
         assert sum(self.pp_division) == n and len(self.pp_division) == self.pp_deg
         for t in self.tp_sizes:
             assert t >= 1 and (t & (t - 1)) == 0, f"tp size {t} not a power of 2"
@@ -106,6 +113,7 @@ class HybridParallelConfig:
             "tp_consecutive_flags": array2str(self.tp_consecutive),
             "dp_types_enc": array2str(self.dp_types),
             "checkpoint": array2str(self.checkpoint_flags),
+            "sp_flags_enc": array2str(self.sp_flags),
             "pp_division": array2str(self.pp_division),
             "global_bsz": self.global_bsz,
             "chunks": self.chunks,
@@ -129,6 +137,8 @@ class HybridParallelConfig:
                             if "tp_consecutive_flags" in cfg else None),
             checkpoint_flags=(str2array(cfg["checkpoint"])
                               if "checkpoint" in cfg else None),
+            sp_flags=(str2array(cfg["sp_flags_enc"])
+                      if "sp_flags_enc" in cfg else None),
             pp_division=(str2array(cfg["pp_division"])
                          if "pp_division" in cfg else None),
             global_bsz=cfg.get("global_bsz"),
@@ -146,11 +156,12 @@ class HybridParallelConfig:
 
     @classmethod
     def uniform(cls, n_layers, world, pp_deg=1, tp=1, fsdp=False, ckpt=False,
-                **kw):
+                sp=False, **kw):
         """GLOBAL-mode equivalent: one strategy for every layer."""
         return cls(pp_deg=pp_deg, tp_sizes=[tp] * n_layers,
                    dp_types=[1 if fsdp else 0] * n_layers,
                    checkpoint_flags=[1 if ckpt else 0] * n_layers,
+                   sp_flags=[1 if sp else 0] * n_layers,
                    world=world, **kw)
 
     def __repr__(self):

@@ -67,6 +67,12 @@ class LayerShardings:
         self.dp_axes, self.tp_axes = tp_dp_axes(k, maxes, tp, consec)
         self.fsdp = bool(config.dp_types[layer_idx])
         self.ckpt = bool(config.checkpoint_flags[layer_idx])
+        # Megatron SP (reference transformer.py sequence_parallel): the
+        # residual stream is seq-sharded over the tp axes; GSPMD turns the
+        # entry to column-parallel matmuls into an all-gather and the exit
+        # from row-parallel ones into a reduce-scatter (same ring bytes as
+        # the plain-TP allreduce, 1/tp the LN/residual memory).
+        self.sp = bool(config.sp_flags[layer_idx]) and bool(self.tp_axes)
         self.mesh = mesh
 
     def _axes(self, axes):
@@ -96,7 +102,11 @@ class LayerShardings:
             spec[1] = self._axes(self.tp_axes)
         return P(*spec)
 
-    def constrain(self, x, seq_shard=False):
+    def constrain(self, x, seq_shard=None):
+        """Residual-stream constraint; seq_shard defaults to the layer's
+        sequence-parallel flag."""
+        if seq_shard is None:
+            seq_shard = self.sp
         return lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, self.act_spec(x.ndim, seq_shard)))
 
@@ -270,6 +280,121 @@ class LlamaHPLayer(TransformerHPLayer):
         y = jax.nn.silu(y @ params["wgate"]) * (y @ params["wup"])
         x = x + sh.constrain(y @ params["wdown"])     # row-parallel + psum
         return sh.constrain(x)
+
+
+class VocabEmbedHPSpec:
+    """Token embedding as an HP 'layer': tokens [b, t] int32 → [b, t, h].
+
+    tp shards the VOCAB dim of the table (Megatron VocabParallelEmbedding,
+    reference site_package/megatron/core/tensor_parallel/layers.py — XLA's
+    SPMD partitioner lowers the vocab-sharded gather to the same
+    mask-local-rows + psum pattern Megatron hand-writes); an fsdp dp_type
+    row — set from ``config.embed_sdp`` by ``lm_wrap_config`` — further
+    shards it over the dp axes (the reference's embed_sdp flag,
+    hybrid_parallel_config.py)."""
+
+    def __init__(self, vocab, hidden, dtype=jnp.float32, init_scale=0.02):
+        self.vocab, self.hidden = int(vocab), int(hidden)
+        self.dtype, self.init_scale = dtype, init_scale
+
+    def init(self, key):
+        return {"wte": jax.random.normal(
+            key, (self.vocab, self.hidden), self.dtype) * self.init_scale}
+
+    def param_specs(self, sh: "LayerShardings"):
+        return {"wte": sh.param_spec(0, 2, 1)}
+
+    def apply(self, params, x, sh: "LayerShardings"):
+        return sh.constrain(jnp.take(params["wte"], x, axis=0))
+
+
+class LMHeadHPSpec:
+    """Final norm + vocab-parallel LM head: [b, t, h] → logits [b, t, V]
+    sharded over the tp axes on V (column-parallel; the CE loss reduces
+    over the sharded vocab dim, GSPMD inserting the psum — logits are
+    never unsharded, the point of Megatron's vocab-parallel CE)."""
+
+    def __init__(self, vocab, hidden, dtype=jnp.float32, norm="ln",
+                 init_scale=0.02):
+        self.vocab, self.hidden = int(vocab), int(hidden)
+        self.dtype, self.norm, self.init_scale = dtype, norm, init_scale
+
+    def init(self, key):
+        return {"gnorm": jnp.ones((self.hidden,), self.dtype),
+                "wlm": jax.random.normal(
+                    key, (self.hidden, self.vocab),
+                    self.dtype) * self.init_scale}
+
+    def param_specs(self, sh: "LayerShardings"):
+        return {"gnorm": sh.param_spec(None, 1),
+                "wlm": sh.param_spec(1, 2, 0)}
+
+    def apply(self, params, x, sh: "LayerShardings"):
+        if self.norm == "rms":
+            xf = x.astype(jnp.float32)
+            var = jnp.mean(xf * xf, -1, keepdims=True)
+            y = (xf * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype)
+            y = y * params["gnorm"]
+        else:
+            mu = jnp.mean(x, -1, keepdims=True)
+            var = jnp.var(x, -1, keepdims=True)
+            y = (x - mu) * jax.lax.rsqrt(var + 1e-5) * params["gnorm"]
+        logits = y @ params["wlm"]
+        spec = [None] * 3
+        if sh.dp_axes:
+            spec[0] = sh._axes(sh.dp_axes)
+        if sh.tp_axes:
+            spec[2] = sh._axes(sh.tp_axes)
+        return lax.with_sharding_constraint(
+            logits, NamedSharding(sh.mesh, P(*spec)))
+
+
+def lm_cross_entropy(logits, tokens):
+    """Mean next-token CE over [b, t, V] logits vs [b, t] int targets.
+    Works with vocab-sharded logits: the logsumexp reduction over V
+    becomes a psum over the tp axes under GSPMD."""
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logits.astype(jnp.float32), tokens[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - picked)
+
+
+def lm_wrap_config(cfg: HybridParallelConfig, embed_sdp=None):
+    """Extend a searched per-transformer-layer config with embedding and
+    LM-head rows on the first/last pipeline stage (the reference wraps
+    model layers with embed/cls modules there, hybrid_parallel_config.py);
+    ``embed_sdp`` (default: cfg.embed_sdp) makes both rows FSDP."""
+    e = int(cfg.embed_sdp if embed_sdp is None else embed_sdp)
+    div = list(cfg.pp_division)
+    div[0] += 1
+    div[-1] += 1   # pp_deg==1: same stage gets both rows
+    return HybridParallelConfig(
+        pp_deg=cfg.pp_deg,
+        tp_sizes=[cfg.tp_sizes[0]] + cfg.tp_sizes + [cfg.tp_sizes[-1]],
+        dp_types=[e] + cfg.dp_types + [e],
+        tp_consecutive=([cfg.tp_consecutive[0]] + cfg.tp_consecutive
+                        + [cfg.tp_consecutive[-1]]),
+        checkpoint_flags=[0] + cfg.checkpoint_flags + [0],
+        sp_flags=[0] + cfg.sp_flags + [0],
+        pp_division=div, global_bsz=cfg.global_bsz, chunks=cfg.chunks,
+        pipeline_type=cfg.pipeline_type,
+        default_dp_type=cfg.default_dp_type, embed_sdp=e, world=cfg.world)
+
+
+def make_lm_hybrid_model(vocab, layer_specs, cfg, embed_sdp=None,
+                         norm="ln", dtype=jnp.float32, devices=None):
+    """Full-LM hybrid-parallel model (tokens → CE loss): embedding + the
+    given transformer HP layers + vocab-parallel head under the searched
+    config, matching the reference's Galvatron models
+    (models/gpt/GPTModel_hybrid_parallel.py: embed and cls wrapped onto
+    the first/last stage, embed_sdp honored)."""
+    hidden = layer_specs[0].hidden
+    specs = ([VocabEmbedHPSpec(vocab, hidden, dtype=dtype)]
+             + list(layer_specs)
+             + [LMHeadHPSpec(vocab, hidden, dtype=dtype, norm=norm)])
+    full = lm_wrap_config(cfg, embed_sdp)
+    return HybridParallelModel(specs, full, loss_fn=lm_cross_entropy,
+                               devices=devices)
 
 
 class HybridParallelModel:
@@ -484,7 +609,7 @@ class HybridParallelModel:
                         return self.loss_fn(
                             self._apply_range(idxs, p_, x_), tgt)
                     loss, vjp_fn = jax.vjp(f, sp, x)
-                    gp, gx = vjp_fn(scale)
+                    gp, gx = vjp_fn(scale.astype(loss.dtype))
                     return loss, gp, gx
 
                 self._stage_last_bwd = jax.jit(last_bwd)
@@ -541,8 +666,10 @@ class HybridParallelModel:
 
         stage_in = [[None] * self.pp for _ in range(chunks)]
         # d(mean over chunks)/dloss seed; losses stay device-resident —
-        # a float() per chunk would sync the host mid-pipeline
-        scale = jnp.asarray(1.0 / chunks, x.dtype)
+        # a float() per chunk would sync the host mid-pipeline.  f32 here
+        # (x may be int tokens for the LM tier); last_bwd casts it to the
+        # loss dtype before seeding the vjp
+        scale = jnp.asarray(1.0 / chunks, jnp.float32)
         grad_acc = [None] * self.pp
         losses = []
         self._live_chunks_hwm = 0

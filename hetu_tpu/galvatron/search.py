@@ -65,17 +65,21 @@ def load_profile(path):
 
 
 class Strategy:
-    """One inner-DP strategy: (tp, dp_type, ckpt) on a per-stage submesh of
-    ``per_stage`` devices (dp degree = per_stage // tp)."""
+    """One inner-DP strategy: (tp, dp_type, ckpt, sp) on a per-stage submesh
+    of ``per_stage`` devices (dp degree = per_stage // tp).  ``sp`` =
+    Megatron sequence parallelism: residual/LN activations seq-sharded over
+    the tp group (reference tensor_parallel/transformer.py
+    sequence_parallel flag)."""
 
-    __slots__ = ("tp", "dp_type", "ckpt")
+    __slots__ = ("tp", "dp_type", "ckpt", "sp")
 
-    def __init__(self, tp, dp_type, ckpt):
-        self.tp, self.dp_type, self.ckpt = tp, dp_type, ckpt
+    def __init__(self, tp, dp_type, ckpt, sp=0):
+        self.tp, self.dp_type, self.ckpt, self.sp = tp, dp_type, ckpt, sp
 
     def __repr__(self):
         kind = "fsdp" if self.dp_type else "ddp"
-        return f"(tp={self.tp},{kind},ckpt={self.ckpt})"
+        tag = ",sp" if self.sp else ""
+        return f"(tp={self.tp},{kind},ckpt={self.ckpt}{tag})"
 
 
 def strategy_space(per_stage, with_ckpt=True):
@@ -85,8 +89,9 @@ def strategy_space(per_stage, with_ckpt=True):
         dp = per_stage // tp
         dp_types = [0, 1] if dp > 1 else [0]
         ckpts = [0, 1] if with_ckpt else [0]
-        for dt, ck in itertools.product(dp_types, ckpts):
-            out.append(Strategy(tp, dt, ck))
+        sps = [0, 1] if tp > 1 else [0]
+        for dt, ck, sp in itertools.product(dp_types, ckpts, sps):
+            out.append(Strategy(tp, dt, ck, sp))
         tp *= 2
     return out
 
@@ -131,7 +136,11 @@ class CostModel:
         bwd = 2.0 * fwd
         recompute = fwd if st.ckpt else 0.0
         act = L.act_bytes * lb
-        # Megatron TP: allreduce activations in fwd + bwd (2 each)
+        # Megatron TP: allreduce activations in fwd + bwd (2 each).  Under
+        # sp the allreduce becomes all-gather + reduce-scatter with the
+        # same total ring bytes, so the comm term is unchanged — sp is a
+        # pure memory lever (mem_bytes), exactly why the search should
+        # prefer it whenever tp > 1 and memory binds.
         tp_comm = 4.0 * self._coll_ms(act, st.tp)
         # DP grad sync once per step: reduce-scatter + all-gather of this
         # layer's param shard, amortized over the micro-batches
@@ -153,6 +162,12 @@ class CostModel:
         n = max(prev_st.tp, st.tp)
         return self._coll_ms(L.act_bytes * lb, n)
 
+    # fraction of a layer's activation bytes that live on the residual/LN
+    # segments ([b, t, h] tensors) — tp-sharded ONLY under sequence
+    # parallelism; the rest (qkv, probs, ffn intermediate) is tp-sharded
+    # by plain Megatron TP already
+    RESIDUAL_ACT_FRAC = 0.25
+
     def mem_bytes(self, i, st, n_micro_live=1):
         L = self.layers[i]
         dp = self.per_stage // st.tp
@@ -160,11 +175,16 @@ class CostModel:
         param_shard = L.param_bytes / st.tp / (dp if st.dp_type else 1)
         # params + grads + adam moments (m, v) in f32 masters ≈ 4x params
         state = 4.0 * param_shard
-        act = (L.act_bytes * lb / st.tp) * n_micro_live
+        r = self.RESIDUAL_ACT_FRAC
+        res_shard = st.tp if st.sp else 1    # runtime act_spec(seq_shard)
         if st.ckpt:
-            # only stage-boundary activations survive, but still one copy
-            # per in-flight micro-batch
-            act = L.act_bytes * lb / st.tp * 0.2 * n_micro_live
+            # only stage-boundary activations survive — and those ARE the
+            # residual stream, so plain TP cannot shard them; sp can.
+            # Still one copy per in-flight micro-batch.
+            act = L.act_bytes * lb * 0.2 / res_shard * n_micro_live
+        else:
+            act = (L.act_bytes * lb
+                   * ((1.0 - r) / st.tp + r / res_shard) * n_micro_live)
         return state + act
 
 
@@ -266,6 +286,7 @@ class GalvatronSearch:
             tp_sizes=[space[s].tp for s in assignment],
             dp_types=[space[s].dp_type for s in assignment],
             checkpoint_flags=[space[s].ckpt for s in assignment],
+            sp_flags=[space[s].sp for s in assignment],
             pp_division=division,
             global_bsz=global_bsz, chunks=chunks, world=self.world,
             pipeline_type="pipedream_flush" if pp > 1 else "gpipe")
