@@ -111,6 +111,21 @@ class LayerShardings:
             x, NamedSharding(self.mesh, self.act_spec(x.ndim, seq_shard)))
 
 
+def _layer_norm(x, g):
+    """Shared LN (no bias): used by the transformer blocks AND the LM
+    head so eps/dtype behavior can never drift between body and head."""
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g
+
+
+def _rms_norm(x, g):
+    """Shared RMSNorm (f32 accumulation, Llama convention)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), -1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype) * g
+
+
 class TransformerHPLayer:
     """A Megatron-parallel transformer layer as an HP layer spec.
 
@@ -153,9 +168,7 @@ class TransformerHPLayer:
         return out
 
     def _ln(self, x, g):
-        mu = jnp.mean(x, -1, keepdims=True)
-        var = jnp.var(x, -1, keepdims=True)
-        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g
+        return _layer_norm(x, g)
 
     def _attend(self, q, k, v, sh: LayerShardings):
         """[b, nh, t, hd] heads tp-sharded, batch dp-sharded.
@@ -245,9 +258,7 @@ class LlamaHPLayer(TransformerHPLayer):
                "rms1": (None, None), "rms2": (None, None)}
 
     def _rms(self, x, g):
-        xf = x.astype(jnp.float32)
-        var = jnp.mean(jnp.square(xf), -1, keepdims=True)
-        return (xf * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype) * g
+        return _rms_norm(x, g)
 
     def apply(self, params, x, sh: LayerShardings):
         from ..ops.rotary import _rotary, _repeat_kv, _alibi_bias
@@ -312,34 +323,44 @@ class LMHeadHPSpec:
     """Final norm + vocab-parallel LM head: [b, t, h] → logits [b, t, V]
     sharded over the tp axes on V (column-parallel; the CE loss reduces
     over the sharded vocab dim, GSPMD inserting the psum — logits are
-    never unsharded, the point of Megatron's vocab-parallel CE)."""
+    never unsharded, the point of Megatron's vocab-parallel CE).
+
+    ``tied=True`` drops the head's own projection and reuses the
+    embedding table (GPT-2/Megatron weight tying; the shared-table grad
+    accumulates through the single vjp — no separate embedding-grad
+    allreduce needed because pp_deg==1 keeps both on one submesh)."""
 
     def __init__(self, vocab, hidden, dtype=jnp.float32, norm="ln",
-                 init_scale=0.02):
+                 init_scale=0.02, tied=False):
         self.vocab, self.hidden = int(vocab), int(hidden)
         self.dtype, self.norm, self.init_scale = dtype, norm, init_scale
+        self.tied = bool(tied)
 
     def init(self, key):
-        return {"gnorm": jnp.ones((self.hidden,), self.dtype),
-                "wlm": jax.random.normal(
-                    key, (self.hidden, self.vocab),
-                    self.dtype) * self.init_scale}
+        p = {"gnorm": jnp.ones((self.hidden,), self.dtype)}
+        if not self.tied:
+            p["wlm"] = jax.random.normal(
+                key, (self.hidden, self.vocab),
+                self.dtype) * self.init_scale
+        return p
 
     def param_specs(self, sh: "LayerShardings"):
-        return {"gnorm": sh.param_spec(None, 1),
-                "wlm": sh.param_spec(1, 2, 0)}
+        out = {"gnorm": sh.param_spec(None, 1)}
+        if not self.tied:
+            out["wlm"] = sh.param_spec(1, 2, 0)
+        return out
 
     def apply(self, params, x, sh: "LayerShardings"):
-        if self.norm == "rms":
-            xf = x.astype(jnp.float32)
-            var = jnp.mean(xf * xf, -1, keepdims=True)
-            y = (xf * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype)
-            y = y * params["gnorm"]
-        else:
-            mu = jnp.mean(x, -1, keepdims=True)
-            var = jnp.var(x, -1, keepdims=True)
-            y = (x - mu) * jax.lax.rsqrt(var + 1e-5) * params["gnorm"]
-        logits = y @ params["wlm"]
+        norm = _rms_norm if self.norm == "rms" else _layer_norm
+        y = norm(x, params["gnorm"])
+        if self.tied and "_tied_wte" not in params:
+            raise KeyError(
+                "tied LMHeadHPSpec.apply needs the shared table under "
+                "'_tied_wte' (injected by HybridParallelModel._apply_range"
+                "; pass the embedding table yourself when calling apply "
+                "directly)")
+        wlm = params["wlm"] if not self.tied else params["_tied_wte"].T
+        logits = y @ wlm
         spec = [None] * 3
         if sh.dp_axes:
             spec[0] = sh._axes(sh.dp_axes)
@@ -382,16 +403,25 @@ def lm_wrap_config(cfg: HybridParallelConfig, embed_sdp=None):
 
 
 def make_lm_hybrid_model(vocab, layer_specs, cfg, embed_sdp=None,
-                         norm="ln", dtype=jnp.float32, devices=None):
+                         norm="ln", dtype=jnp.float32, devices=None,
+                         tie_embeddings=False):
     """Full-LM hybrid-parallel model (tokens → CE loss): embedding + the
     given transformer HP layers + vocab-parallel head under the searched
     config, matching the reference's Galvatron models
     (models/gpt/GPTModel_hybrid_parallel.py: embed and cls wrapped onto
-    the first/last stage, embed_sdp honored)."""
+    the first/last stage, embed_sdp honored).  ``tie_embeddings`` shares
+    the table with the head (GPT-2 semantics) — pp_deg must be 1 so both
+    live on one submesh; refused otherwise rather than silently untied."""
+    if tie_embeddings and cfg.pp_deg > 1:
+        raise ValueError(
+            "tie_embeddings requires pp_deg == 1 (embedding and head must "
+            "share a stage submesh); got pp_deg="
+            f"{cfg.pp_deg}")
     hidden = layer_specs[0].hidden
     specs = ([VocabEmbedHPSpec(vocab, hidden, dtype=dtype)]
              + list(layer_specs)
-             + [LMHeadHPSpec(vocab, hidden, dtype=dtype, norm=norm)])
+             + [LMHeadHPSpec(vocab, hidden, dtype=dtype, norm=norm,
+                             tied=tie_embeddings)])
     full = lm_wrap_config(cfg, embed_sdp)
     return HybridParallelModel(specs, full, loss_fn=lm_cross_entropy,
                                devices=devices)
@@ -564,10 +594,22 @@ class HybridParallelModel:
     def _apply_range(self, idxs, stage_params, x):
         for j, i in enumerate(idxs):
             spec, sh = self.specs[i], self.shardings[i]
+            p = stage_params[j]
+            if getattr(spec, "tied", False):
+                # weight-tied LM head: borrow the embedding table from
+                # layer 0 (make_lm_hybrid_model guarantees it shares this
+                # stage); the vjp accumulates both uses into one grad
+                if 0 not in idxs or "wte" not in stage_params[idxs.index(0)]:
+                    raise ValueError(
+                        "tied LM head requires a vocab-embedding spec as "
+                        "layer 0 on the SAME pipeline stage (pp_deg == 1; "
+                        "build via make_lm_hybrid_model)")
+                p = dict(p)
+                p["_tied_wte"] = stage_params[idxs.index(0)]["wte"]
             body = lambda p_, x_, spec_=spec, sh_=sh: spec_.apply(p_, x_, sh_)
             if sh.ckpt:
                 body = jax.checkpoint(body)
-            x = body(stage_params[j], x)
+            x = body(p, x)
         return x
 
     def apply(self, params, x):

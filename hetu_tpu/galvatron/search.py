@@ -245,6 +245,13 @@ class GalvatronSearch:
         t_s  — steady state is bound by the slowest stage, the rest is
         fill/drain.  The cost tables depend on chunks (micro-batch size and
         grad-sync amortization), so they are rebuilt per (pp, chunks).
+
+        ``pp_division`` is SEARCHED, not fixed: the uniform split and a
+        balanced split (min-max stage time over each layer's best feasible
+        strategy cost) both run through the per-stage DP; the cheaper
+        feasible one wins — heterogeneous layer profiles get uneven stages,
+        exactly what the reference's searched configs record in
+        ``pp_division``.
         """
         micro_bsz = global_bsz // chunks
         if micro_bsz == 0:
@@ -255,25 +262,72 @@ class GalvatronSearch:
         unit = self.budget / self.mem_units
         # gpipe keeps ~chunks micro-batch activations live; 1f1b keeps ≤ pp
         n_live = min(chunks, pp) if pp > 1 else 1
-        # uniform stage division (reference default pp_divide)
+        # cost tables, built once per (pp, chunks) — division-independent
+        mem = np.zeros((L, S), dtype=np.int32)
+        intra = np.zeros((L, S))
+        inter = np.zeros((L, S, S))
+        feasible = np.zeros((L, S), dtype=bool)
+        for i in range(L):
+            for s, st in enumerate(space):
+                mem[i, s] = max(1, int(np.ceil(
+                    model.mem_bytes(i, st, n_live) / unit)))
+                intra[i, s] = model.intra_ms(i, st)
+                feasible[i, s] = mem[i, s] <= self.mem_units
+                for sp, stp in enumerate(space):
+                    inter[i, sp, s] = model.inter_ms(i, stp, st)
+
+        best = (float("inf"), None)
+        for division in self._candidate_divisions(pp, intra, feasible):
+            total, cfg = self._eval_division(
+                division, pp, space, chunks, global_bsz, mem, intra, inter)
+            if total < best[0]:
+                best = (total, cfg)
+        return best
+
+    def _candidate_divisions(self, pp, intra, feasible):
+        """Uniform split plus (when it differs) the contiguous partition
+        minimizing the max per-stage sum of best-case layer costs."""
+        L = intra.shape[0]
         avg = L // pp
-        division = [avg] * (pp - 1) + [L - avg * (pp - 1)]
+        uniform = [avg] * (pp - 1) + [L - avg * (pp - 1)]
+        if pp == 1:
+            return [uniform]
+        # per-layer optimistic cost: cheapest feasible strategy (inf if none)
+        c = np.where(feasible, intra, np.inf).min(axis=1)
+        if not np.isfinite(c).all():
+            return [uniform]
+        # DP over contiguous partitions: f[k][i] = min over j of
+        # max(f[k-1][j], sum c[j..i)) — classic min-max partition
+        pre = np.concatenate([[0.0], np.cumsum(c)])
+        f = np.full((pp + 1, L + 1), np.inf)
+        cut = np.zeros((pp + 1, L + 1), dtype=np.int32)
+        f[0, 0] = 0.0
+        for k in range(1, pp + 1):
+            for i in range(k, L - (pp - k) + 1):
+                for j in range(k - 1, i):
+                    v = max(f[k - 1, j], pre[i] - pre[j])
+                    if v < f[k, i]:
+                        f[k, i], cut[k, i] = v, j
+        bounds = [L]
+        for k in range(pp, 0, -1):
+            bounds.append(int(cut[k, bounds[-1]]))
+        bounds = bounds[::-1]
+        balanced = [bounds[k + 1] - bounds[k] for k in range(pp)]
+        if balanced == uniform or 0 in balanced:
+            return [uniform]
+        return [uniform, balanced]
+
+    def _eval_division(self, division, pp, space, chunks, global_bsz,
+                       mem, intra, inter):
         run = dp_core if self.use_native else dp_core_numpy
         assignment, stage_times = [], []
         lo = 0
         for stage_len in division:
             hi = lo + stage_len
-            mem = np.zeros((stage_len, S), dtype=np.int32)
-            intra = np.zeros((stage_len, S))
-            inter = np.zeros((stage_len, S, S))
-            for j, i in enumerate(range(lo, hi)):
-                for s, st in enumerate(space):
-                    mem[j, s] = max(1, int(np.ceil(
-                        model.mem_bytes(i, st, n_live) / unit)))
-                    intra[j, s] = model.intra_ms(i, st)
-                    for sp, stp in enumerate(space):
-                        inter[j, sp, s] = model.inter_ms(i, stp, st)
-            cost, stage_assign, _ = run(mem, intra, inter, self.mem_units)
+            cost, stage_assign, _ = run(
+                np.ascontiguousarray(mem[lo:hi]),
+                np.ascontiguousarray(intra[lo:hi]),
+                np.ascontiguousarray(inter[lo:hi]), self.mem_units)
             if stage_assign is None:
                 return float("inf"), None
             assignment += stage_assign

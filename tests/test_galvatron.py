@@ -168,6 +168,49 @@ class TestSearch:
         assert (m.mem_bytes(0, pc) - m.mem_bytes(0, sc)
                 == pytest.approx(ckpt_act / 2, rel=1e-6))
 
+    def test_pp_division_searched_for_heterogeneous_layers(self):
+        """pp_division is searched, not fixed: with the first layers 9x
+        heavier, a balanced split beats the uniform one and the emitted
+        config records it (reference searched configs carry pp_division)."""
+        heavy = LayerProfile(9.0, 4e6, 2e5)
+        light = LayerProfile(1.0, 4e6, 2e5)
+        layers = [heavy] * 2 + [light] * 6
+        s = GalvatronSearch(world=8, mem_budget_bytes=int(1e9), micro_bsz=4,
+                            chunks_candidates=(4,))
+        # force pp=2 path via the internal API so the uniform-vs-balanced
+        # choice is observable regardless of what full search would pick
+        space = strategy_space(4)
+        cost_u, _ = s._eval_division(
+            *self._tables(s, layers, 2, space))
+        total, cfg = s._search_inner(layers, pp=2, per_stage=4, space=space,
+                                     chunks=4, global_bsz=16)
+        assert cfg is not None
+        assert cfg.pp_division != [4, 4]          # balanced won
+        assert sum(cfg.pp_division) == 8 and len(cfg.pp_division) == 2
+        assert total <= cost_u + 1e-9
+
+    @staticmethod
+    def _tables(s, layers, pp, space):
+        """Uniform-division evaluation args for comparison."""
+        from hetu_tpu.galvatron.search import CostModel
+        model = CostModel(layers, per_stage=s.world // pp, micro_bsz=4,
+                          chunks=4, ici_gbps=s.ici_gbps)
+        L, S = len(layers), len(space)
+        unit = s.budget / s.mem_units
+        mem = np.zeros((L, S), dtype=np.int32)
+        intra = np.zeros((L, S))
+        inter = np.zeros((L, S, S))
+        for i in range(L):
+            for k, st in enumerate(space):
+                mem[i, k] = max(1, int(np.ceil(
+                    model.mem_bytes(i, st, min(4, pp)) / unit)))
+                intra[i, k] = model.intra_ms(i, st)
+                for kp, stp in enumerate(space):
+                    inter[i, kp, k] = model.inter_ms(i, stp, st)
+        avg = L // pp
+        division = [avg] * (pp - 1) + [L - avg * (pp - 1)]
+        return division, pp, space, 4, 16, mem, intra, inter
+
     def test_search_emits_sp_flags_honored_by_config(self):
         layers = profile_layers_analytic(4, hidden=64, seq=128)
         s = GalvatronSearch(world=8, mem_budget_bytes=int(200e6),
@@ -400,6 +443,38 @@ class TestLMGalvatron:
         l0 = float(jax.jit(m0.loss)(p0, x, tgt))
         l1 = float(jax.jit(m1.loss)(p1, x, tgt))
         assert l0 == pytest.approx(l1, rel=1e-5)
+
+    def test_tied_embeddings(self):
+        from hetu_tpu.galvatron import make_lm_hybrid_model
+        cfg = HybridParallelConfig.uniform(2, world=8, tp=2)
+        specs = [TransformerHPLayer(hidden=32, heads=4) for _ in range(2)]
+        m = make_lm_hybrid_model(self.VOCAB, specs, cfg,
+                                 tie_embeddings=True)
+        params = m.init_params(jax.random.PRNGKey(0))
+        assert "wlm" not in params[-1]          # head has no own table
+        x, tgt = self._data()
+        loss, g = m.grads(params, x, tgt)
+        # the shared table receives gradient from BOTH uses: nonzero and
+        # different from the untied embed-only grad
+        tied_g = np.asarray(g[0]["wte"])
+        assert np.abs(tied_g).sum() > 0
+        mu = make_lm_hybrid_model(self.VOCAB, specs, cfg)
+        pu = mu.init_params(jax.random.PRNGKey(0))
+        _, gu = mu.grads(pu, x, tgt)
+        assert not np.allclose(tied_g, np.asarray(gu[0]["wte"]))
+        # trains
+        step, opt_init = m.make_train_step(lr=0.1)
+        st = opt_init(params)
+        traj = []
+        for _ in range(4):
+            params, st, l = step(params, st, x, tgt)
+            traj.append(float(l))
+        assert traj[-1] < traj[0]
+        # tying across pipeline stages is refused, not silently untied
+        cfg_pp = HybridParallelConfig.uniform(2, world=8, pp_deg=2, tp=2)
+        with pytest.raises(ValueError, match="tie_embeddings"):
+            make_lm_hybrid_model(self.VOCAB, specs, cfg_pp,
+                                 tie_embeddings=True)
 
     def test_pipelined_lm_trains_and_schedules_agree(self):
         x, tgt = self._data()
