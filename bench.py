@@ -448,13 +448,22 @@ def main():
     # silence.
     env = dict(os.environ)
     cpu_fallback = False
-    if not env.get("JAX_PLATFORMS"):
-        # an explicit JAX_PLATFORMS means the user already chose a
-        # platform (stage children honor it through config) — probing
-        # would init the default backend instead and block/acquire it
+    if not env.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # skip the probe only for an explicit CPU run (nothing to fall
+        # back from).  Any accelerator selection — including the ambient
+        # JAX_PLATFORMS=axon the driver environment sets — gets probed:
+        # the probe child inherits the env, so it initializes the same
+        # backend the stages would, and a dead tunnel surfaces here as a
+        # 120s timeout instead of a 25-minute hang per stage.
         try:
+            # select the platform the same way stage children do (config
+            # update — a pre-registered plugin wins over the env var), so
+            # the probe initializes the SAME backend the stages will use
             subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
+                [sys.executable, "-c",
+                 "import jax, os; p = os.environ.get('JAX_PLATFORMS'); "
+                 "p and jax.config.update('jax_platforms', p); "
+                 "jax.devices()"],
                 capture_output=True, timeout=120, env=env, check=True)
         except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
             cpu_fallback = True

@@ -278,6 +278,109 @@ def _tile(node, ctx):
                    name=node.name)]
 
 
+@exporter("rms_norm")
+def _rms_norm_exp(node, ctx):
+    """x / sqrt(mean(x^2) + eps) * scale as standard ONNX ops (no RMSNorm
+    in mainline opsets), so any consumer — and our importer — runs the
+    Llama tier's normalization without custom ops."""
+    x, g = _in(node, 0), _in(node, 1)
+    eps = float(node.attrs.get("eps", 1e-6))
+    sq, mn, ve, sd, nm = (ctx.aux(f"{node.name}_{h}")
+                          for h in ("sq", "mean", "vareps", "std", "norm"))
+    axes = ctx.const(f"{node.name}_axes", np.asarray([-1], np.int64))
+    epsc = ctx.const(f"{node.name}_eps", np.asarray(eps, np.float32))
+    return [
+        NodeIR("Mul", [x, x], [sq]),
+        NodeIR("ReduceMean", [sq, axes], [mn], {"keepdims": 1}),
+        NodeIR("Add", [mn, epsc], [ve]),
+        NodeIR("Sqrt", [ve], [sd]),
+        NodeIR("Div", [x, sd], [nm]),
+        NodeIR("Mul", [nm, g], [node.name], name=node.name),
+    ]
+
+
+@exporter("rotary_embedding")
+def _rotary_exp(node, ctx):
+    """RoPE (HF rotate_half convention) on [B, H, S, D]: the cos/sin
+    tables are precomputed constants (shapes are static), the rotation is
+    Slice/Neg/Concat/Mul/Add — plain opset ops (ops/rotary.py:33)."""
+    shape = ctx.shapes.get(node.inputs[0])
+    if shape is None:
+        raise NotImplementedError(
+            "rotary_embedding export needs inferred shapes "
+            "(placeholders must declare shapes)")
+    s, d = int(shape[-2]), int(shape[-1])
+    theta = float(node.attrs.get("theta", 10000.0))
+    off = int(node.attrs.get("pos_offset", 0))
+    pos = np.arange(off, off + s, dtype=np.float32)
+    inv = 1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float32) / d))
+    freqs = np.outer(pos, inv)
+    emb = np.concatenate([freqs, freqs], axis=-1)[None, None]   # [1,1,S,D]
+    cosc = ctx.const(f"{node.name}_cos", np.cos(emb).astype(np.float32))
+    sinc = ctx.const(f"{node.name}_sin", np.sin(emb).astype(np.float32))
+    ax = ctx.const(f"{node.name}_ax", np.asarray([-1], np.int64))
+    s0 = ctx.const(f"{node.name}_0", np.asarray([0], np.int64))
+    sh = ctx.const(f"{node.name}_h", np.asarray([d // 2], np.int64))
+    sd_ = ctx.const(f"{node.name}_d", np.asarray([d], np.int64))
+    x = _in(node, 0)
+    x1, x2, neg, rot, xc, rs = (ctx.aux(f"{node.name}_{h}") for h in
+                                ("x1", "x2", "neg", "rot", "xcos", "rsin"))
+    return [
+        NodeIR("Slice", [x, s0, sh, ax], [x1]),
+        NodeIR("Slice", [x, sh, sd_, ax], [x2]),
+        NodeIR("Neg", [x2], [neg]),
+        NodeIR("Concat", [neg, x1], [rot], {"axis": -1}),
+        NodeIR("Mul", [x, cosc], [xc]),
+        NodeIR("Mul", [rot, sinc], [rs]),
+        NodeIR("Add", [xc, rs], [node.name], name=node.name),
+    ]
+
+
+@exporter("repeat_kv")
+def _repeat_kv_exp(node, ctx):
+    """GQA K/V head repetition: Reshape → Tile → Reshape (the broadcast
+    trick of ops/rotary.py:48 has no ONNX spelling; Tile is the portable
+    equivalent)."""
+    n = int(node.attrs["n_rep"])
+    if n == 1:
+        return [NodeIR("Identity", [_in(node, 0)], [node.name],
+                       name=node.name)]
+    shape = ctx.shapes.get(node.inputs[0])
+    if shape is None:
+        raise NotImplementedError("repeat_kv export needs inferred shapes")
+    b, kv, s, d = (int(v) for v in shape)
+    sh5 = ctx.const(f"{node.name}_s5",
+                    np.asarray([b, kv, 1, s, d], np.int64))
+    reps = ctx.const(f"{node.name}_reps",
+                     np.asarray([1, 1, n, 1, 1], np.int64))
+    sh4 = ctx.const(f"{node.name}_s4",
+                    np.asarray([b, kv * n, s, d], np.int64))
+    r5, tl = ctx.aux(f"{node.name}_r5"), ctx.aux(f"{node.name}_tile")
+    return [
+        NodeIR("Reshape", [_in(node, 0), sh5], [r5]),
+        NodeIR("Tile", [r5, reps], [tl]),
+        NodeIR("Reshape", [tl, sh4], [node.name], name=node.name),
+    ]
+
+
+@exporter("alibi_bias")
+def _alibi_exp(node, ctx):
+    """ALiBi additive bias depends only on (num_heads, seq_len), both
+    static — exported as a constant initializer (ops/rotary.py:78)."""
+    shape = ctx.shapes.get(node.inputs[0])
+    if shape is None:
+        raise NotImplementedError("alibi_bias export needs inferred shapes")
+    s = int(shape[-2])
+    nh = int(node.attrs["num_heads"])
+    from ..ops.rotary import alibi_slopes
+    slopes = np.asarray(alibi_slopes(nh), np.float32)
+    rel = (np.arange(s, dtype=np.float32)[None, :]
+           - np.arange(s, dtype=np.float32)[:, None])
+    bias = (slopes[:, None, None] * rel[None, :, :])[None]   # [1,H,S,S]
+    c = ctx.const(f"{node.name}_bias", bias.astype(np.float32))
+    return [NodeIR("Identity", [c], [node.name], name=node.name)]
+
+
 def _export_batchnorm(node, ctx):
     return [NodeIR("BatchNormalization", [i.name for i in node.inputs],
                    [node.name],

@@ -160,6 +160,56 @@ def test_onnx_bytes_roundtrip_causal_gpt(rng):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+def test_onnx_bytes_roundtrip_llama(rng):
+    """Llama tier through ModelProto bytes: RMSNorm, RoPE (constant
+    cos/sin tables + Slice/Neg/Concat rotation), GQA repeat_kv
+    (Reshape/Tile/Reshape), SwiGLU — all as standard opset ops, so any
+    ONNX consumer can run the modern-LLM tier."""
+    from hetu_tpu.models import LlamaConfig, LlamaForCausalLM
+    c = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=32,
+                    seq_len=8)
+    ids = ht.placeholder_op("llx_ids", (2, 8), dtype=np.int32)
+    logits = LlamaForCausalLM(c, name="llx")(ids)
+    ex = ht.Executor({"inference": [logits]})
+    model = hx.deserialize_model(
+        hx.serialize_model(hx.hetu2onnx([logits], ex.params)))
+    counts = model.summary()["op_counts"]
+    # RoPE rotations (2/layer on q,k) and GQA tiles survived lowering
+    assert counts.get("Neg") == 4 and counts.get("Tile") == 4
+    assert counts.get("Sigmoid") == 2          # SwiGLU silu
+    ph, outs = hx.onnx2hetu(model)
+    ex2 = ht.Executor({"inference": outs})
+    iv = rng.integers(0, 64, (2, 8))
+    want = ex.run("inference", feed_dict={ids: iv},
+                  convert_to_numpy_ret_vals=True)[0]
+    got = ex2.run("inference", feed_dict={ph["llx_ids"]: iv},
+                  convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_onnx_bytes_roundtrip_llama_alibi(rng):
+    """Baichuan-13B-style ALiBi variant: the bias lowers to a constant
+    initializer (static shapes), everything else as in the RoPE test."""
+    from hetu_tpu.models import LlamaConfig, LlamaForCausalLM
+    c = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=4, intermediate_size=32, seq_len=8,
+                    position_embedding="alibi")
+    ids = ht.placeholder_op("lax_ids", (2, 8), dtype=np.int32)
+    logits = LlamaForCausalLM(c, name="lax")(ids)
+    ex = ht.Executor({"inference": [logits]})
+    model = hx.deserialize_model(
+        hx.serialize_model(hx.hetu2onnx([logits], ex.params)))
+    ph, outs = hx.onnx2hetu(model)
+    ex2 = ht.Executor({"inference": outs})
+    iv = rng.integers(0, 64, (2, 8))
+    want = ex.run("inference", feed_dict={ids: iv},
+                  convert_to_numpy_ret_vals=True)[0]
+    got = ex2.run("inference", feed_dict={ph["lax_ids"]: iv},
+                  convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
 def test_wire_attribute_kinds_roundtrip():
     """Every attribute kind the encoder supports survives the wire."""
     from hetu_tpu.onnx import wire
