@@ -34,13 +34,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REFERENCE_A100_GPT_LAYER_MS = 2.0645  # published in the reference repo
 
 
-def _rerun(fn, lower_is_better=False, n=2, **kw):
+def _rerun(fn, lower_is_better=False, n=3, **kw):
     """Run a baseline measurement n times and keep the BEST result (max
-    throughput / min latency).  The second run reuses the in-process jit
-    cache, so the extra cost is one timed loop — and the best-of guards
-    the ratio against one-off interference (the r02 ResNet 0.975 was a
+    throughput / min latency).  Re-runs reuse the in-process jit cache,
+    so the extra cost is timed loops only — and the best-of guards the
+    ratio against one-off interference (the r02 ResNet 0.975 was a
     variance artifact: BASELINE.md's own table for the same build says
-    1.01).  Ours-side timing gets the same treatment in _timeit."""
+    1.01).  n=3 matches _timeit's best-of-3 groups ours-side, so the
+    treatment is symmetric."""
     vals = [fn(**kw) for _ in range(n)]
     return min(vals) if lower_is_better else max(vals)
 

@@ -30,24 +30,34 @@ from .config import HybridParallelConfig
 class LayerProfile:
     """Per-layer measurements driving the cost model.
 
-    compute_ms : forward time of the full (unsharded) layer for ONE sample
-                 (profiled time / profiled batch size — profiler contract).
-    param_bytes: total parameter bytes of the layer.
-    act_bytes  : activation bytes entering/leaving the layer per sample.
+    compute_ms   : forward time of the full (unsharded) layer for ONE sample
+                   (profiled time / profiled batch size — profiler contract).
+    param_bytes  : total parameter bytes of the layer.
+    act_bytes    : activation bytes entering/leaving the layer per sample —
+                   the BOUNDARY tensor, used by the TP/resharding comm terms.
+    act_mem_bytes: MEASURED per-sample activation memory of the compiled
+                   fwd+bwd (XLA temp-bytes slope over batch; includes qkv,
+                   probs, ffn intermediates).  None → the memory model
+                   falls back to its analytic heuristic on act_bytes.
     """
 
-    def __init__(self, compute_ms, param_bytes, act_bytes):
+    def __init__(self, compute_ms, param_bytes, act_bytes,
+                 act_mem_bytes=None):
         self.compute_ms = float(compute_ms)
         self.param_bytes = float(param_bytes)
         self.act_bytes = float(act_bytes)
+        self.act_mem_bytes = (None if act_mem_bytes is None
+                              else float(act_mem_bytes))
 
     def to_json(self):
         return {"compute_ms": self.compute_ms, "param_bytes": self.param_bytes,
-                "act_bytes": self.act_bytes}
+                "act_bytes": self.act_bytes,
+                "act_mem_bytes": self.act_mem_bytes}
 
     @classmethod
     def from_json(cls, d):
-        return cls(d["compute_ms"], d["param_bytes"], d["act_bytes"])
+        return cls(d["compute_ms"], d["param_bytes"], d["act_bytes"],
+                   d.get("act_mem_bytes"))
 
 
 def save_profile(path, layers, ici_gbps=100.0, dcn_gbps=10.0):
@@ -175,16 +185,29 @@ class CostModel:
         param_shard = L.param_bytes / st.tp / (dp if st.dp_type else 1)
         # params + grads + adam moments (m, v) in f32 masters ≈ 4x params
         state = 4.0 * param_shard
-        r = self.RESIDUAL_ACT_FRAC
         res_shard = st.tp if st.sp else 1    # runtime act_spec(seq_shard)
+        if L.act_mem_bytes is not None:
+            # MEASURED split: boundary (residual) bytes are act_bytes;
+            # everything else in the compiled fwd+bwd footprint is
+            # internal and tp-sharded by plain Megatron TP already
+            boundary = L.act_bytes
+            internal = max(0.0, L.act_mem_bytes - L.act_bytes)
+        else:
+            # analytic heuristic: act_bytes stands in for the whole
+            # footprint, split by RESIDUAL_ACT_FRAC
+            boundary = L.act_bytes * self.RESIDUAL_ACT_FRAC
+            internal = L.act_bytes * (1.0 - self.RESIDUAL_ACT_FRAC)
         if st.ckpt:
             # only stage-boundary activations survive — and those ARE the
             # residual stream, so plain TP cannot shard them; sp can.
-            # Still one copy per in-flight micro-batch.
-            act = L.act_bytes * lb * 0.2 / res_shard * n_micro_live
+            # Still one copy per in-flight micro-batch.  (Analytic mode
+            # keeps the historical 0.2 * total fudge for continuity.)
+            keep = (boundary if L.act_mem_bytes is not None
+                    else L.act_bytes * 0.2)
+            act = keep * lb / res_shard * n_micro_live
         else:
-            act = (L.act_bytes * lb
-                   * ((1.0 - r) / st.tp + r / res_shard) * n_micro_live)
+            act = ((internal / st.tp + boundary / res_shard)
+                   * lb * n_micro_live)
         return state + act
 
 
@@ -401,7 +424,28 @@ def profile_hp_layers(specs, batch=2, seq=128, reps=5, devices=None):
             ms = (time.perf_counter() - t0) / reps * 1e3
             param_bytes = sum(v.size * v.dtype.itemsize
                               for v in jax.tree_util.tree_leaves(params))
+            # boundary bytes (comm terms) stay analytic: [s, h] per sample
             act_bytes = seq * spec.hidden * jnp.dtype(spec.dtype).itemsize
-            by_type[key] = LayerProfile(ms / batch, param_bytes, act_bytes)
+            # activation MEMORY from XLA's own ledger: temp-bytes slope of
+            # the compiled fwd+bwd over two batch sizes, isolating the
+            # batch-scaling bytes (saved qkv/probs/ffn intermediates) from
+            # weight-sized scratch — the reference's memory_profiling step
+            # measured, not estimated (galvatron/core/profiler.py JSONs)
+            act_mem = None
+            try:
+                def temp_at(b):
+                    xb = jax.ShapeDtypeStruct((b, seq, spec.hidden),
+                                              spec.dtype)
+                    vg = jax.jit(jax.value_and_grad(
+                        lambda p, x: jnp.sum(spec.apply(p, x, sh))))
+                    ma = vg.lower(params, xb).compile().memory_analysis()
+                    return float(getattr(ma, "temp_size_in_bytes", 0) or 0)
+                t1, t2 = temp_at(batch), temp_at(2 * batch)
+                if t2 > t1 > 0:
+                    act_mem = max(act_bytes, (t2 - t1) / batch)
+            except Exception:
+                pass                    # memory model falls back to analytic
+            by_type[key] = LayerProfile(ms / batch, param_bytes, act_bytes,
+                                        act_mem_bytes=act_mem)
         out.append(by_type[key])
     return out

@@ -476,6 +476,32 @@ class TestLMGalvatron:
             make_lm_hybrid_model(self.VOCAB, specs, cfg_pp,
                                  tie_embeddings=True)
 
+    def test_lm_checkpoint_across_configs(self, tmp_path):
+        """Embed/head rows ride the cross-config checkpoint path: save
+        under tp=2/sdp, reload under tp=4 plain, identical next loss."""
+        from hetu_tpu.galvatron import make_lm_hybrid_model
+        import optax
+        specs = [TransformerHPLayer(hidden=32, heads=4) for _ in range(2)]
+        mk = lambda tp, sdp: make_lm_hybrid_model(
+            self.VOCAB, specs,
+            HybridParallelConfig.uniform(2, world=8, tp=tp),
+            embed_sdp=sdp)
+        m1 = mk(2, 1)
+        params = m1.init_params(jax.random.PRNGKey(0))
+        step, opt_init = m1.make_train_step(optax.adam(1e-3))
+        opt_state = opt_init(params)
+        x, tgt = self._data()
+        params, opt_state, _ = step(params, opt_state, x, tgt)
+        p = str(tmp_path / "lm.ckpt")
+        m1.save(p, params, opt_state)
+        params, opt_state, l1 = step(params, opt_state, x, tgt)
+
+        m2 = mk(4, 0)
+        params2, opt_state2 = m2.load(p)
+        step2, _ = m2.make_train_step(optax.adam(1e-3))
+        _, _, l2 = step2(params2, opt_state2, x, tgt)
+        assert float(l1) == pytest.approx(float(l2), rel=1e-5)
+
     def test_pipelined_lm_trains_and_schedules_agree(self):
         x, tgt = self._data()
         losses = {}

@@ -244,6 +244,17 @@ def test_measured_hp_layer_profiles_feed_search():
     assert profiles[1].compute_ms > profiles[0].compute_ms
     assert profiles[1].param_bytes > profiles[0].param_bytes
     assert all(p.compute_ms > 0 for p in profiles)
+    # act_mem_bytes is MEASURED (XLA compiled fwd+bwd temp-bytes slope):
+    # the measured branch must have actually fired — a silent fallback to
+    # None here would mean the memory-profiling contract regressed — and
+    # internals (qkv + ffn + probs saved for backward) must exceed the
+    # boundary tensor, while act_bytes stays the analytic boundary
+    import jax.numpy as jnp
+    boundary = 128 * 32 * jnp.dtype(small.dtype).itemsize
+    assert profiles[0].act_bytes == boundary
+    assert profiles[0].act_mem_bytes is not None
+    assert profiles[0].act_mem_bytes > profiles[0].act_bytes
+    assert profiles[1].act_mem_bytes > profiles[0].act_mem_bytes
 
     cfg = GalvatronSearch(world=8, mem_budget_bytes=8 << 30,
                           micro_bsz=2, pp_candidates=[1],
