@@ -465,8 +465,7 @@ def bench_wdl_ps(quick):
     stats = ps_emb.stats()
 
     from benchmarks.flax_baselines import wdl_steps_per_sec
-    base = _rerun(wdl_steps_per_sec, batch=B, rows=rows,
-                  steps=max(3, steps // 2))
+    base = _rerun(wdl_steps_per_sec, batch=B, rows=rows, steps=steps)
     return {"metric": "wdl_criteo_ps_het_train_steps_per_sec",
             "value": round(ours, 2), "unit": "steps/sec",
             "vs_baseline": round(ours / base, 3),

@@ -536,6 +536,15 @@ def test_runtime_flash_attention_branch_matches_oracle():
     x = jax.random.normal(jax.random.PRNGKey(1), (8, 128, 32))
     out = np.asarray(jax.jit(model.apply)(params, x))
 
+    # sequence parallelism composes with the flash shard_map branch:
+    # GSPMD reshards seq-sharded residuals to head-sharded q/k/v at the
+    # shard_map boundary — numerics identical
+    cfg_sp = HybridParallelConfig(pp_deg=1, tp_sizes=[2], dp_types=[0],
+                                  sp_flags=[1], chunks=1, world=8)
+    m_sp = HybridParallelModel([spec], cfg_sp)
+    out_sp = np.asarray(jax.jit(m_sp.apply)(params, x))
+    np.testing.assert_allclose(out_sp, out, rtol=2e-4, atol=2e-4)
+
     p = jax.tree_util.tree_map(np.asarray, params[0])
     xh = np.asarray(x).astype(np.float64)
 
