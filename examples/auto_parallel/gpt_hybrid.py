@@ -58,9 +58,11 @@ def main():
     layers = [LayerProfile(2.0, per_layer_params * 4, act_bytes)
               for _ in range(n_layers)]
 
+    from hetu_tpu.galvatron import measure_ici_gbps
+    ici = measure_ici_gbps() or 100.0        # measured hardware bandwidth
     cfg = GalvatronSearch(world, args.mem_gb * (1 << 30),
-                          micro_bsz=2).search(layers)
-    print("searched config:", cfg.to_json())
+                          micro_bsz=2, ici_gbps=ici).search(layers)
+    print(f"searched config (ici {ici:.1f} GB/s):", cfg.to_json())
 
     specs = [TransformerHPLayer(hidden=h, heads=heads)
              for _ in range(n_layers)]

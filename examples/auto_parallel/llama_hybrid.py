@@ -63,9 +63,11 @@ def main():
               for _ in range(n_layers)]
 
     # 2. search
+    from hetu_tpu.galvatron import measure_ici_gbps
+    ici = measure_ici_gbps() or 100.0        # measured hardware bandwidth
     cfg = GalvatronSearch(world, args.mem_gb * (1 << 30),
-                          micro_bsz=2).search(layers)
-    print("searched config:", cfg.to_json())
+                          micro_bsz=2, ici_gbps=ici).search(layers)
+    print(f"searched config (ici {ici:.1f} GB/s):", cfg.to_json())
 
     # 3. build + train the FULL LM under the searched config: vocab-parallel
     #    embedding + RMS-normed head wrap onto the first/last stage

@@ -7,7 +7,8 @@ PartitionSpecs on a binary-factorized mesh inside one SPMD program."""
 from .build import dp_core, dp_core_numpy
 from .config import HybridParallelConfig, layer_mesh_axes, tp_dp_axes
 from .search import (CostModel, GalvatronSearch, LayerProfile, Strategy,
-                     load_profile, profile_layers_analytic, profile_hp_layers,
+                     load_profile, measure_ici_gbps,
+                     profile_layers_analytic, profile_hp_layers,
                      save_profile,
                      strategy_space)
 from .runtime import (HybridParallelModel, LayerShardings,
@@ -18,7 +19,8 @@ from .runtime import (HybridParallelModel, LayerShardings,
 __all__ = [
     "dp_core", "dp_core_numpy", "HybridParallelConfig", "layer_mesh_axes",
     "tp_dp_axes", "CostModel", "GalvatronSearch", "LayerProfile", "Strategy",
-    "load_profile", "profile_layers_analytic", "profile_hp_layers",
+    "load_profile", "measure_ici_gbps",
+    "profile_layers_analytic", "profile_hp_layers",
     "save_profile",
     "strategy_space", "HybridParallelModel", "LayerShardings",
     "TransformerHPLayer", "LlamaHPLayer", "VocabEmbedHPSpec",

@@ -370,6 +370,41 @@ class GalvatronSearch:
         return total, cfg
 
 
+def measure_ici_gbps(mesh=None, nbytes=1 << 22, repeats=5):
+    """MEASURED interconnect bandwidth for the search's cost model — the
+    reference's hardware-profiling step (GalvatronProfiler
+    profile_bandwidth drives nccl-tests, galvatron/core/profiler.py:405).
+
+    Times a psum over the mesh's first axis with the collective
+    micro-bench (profiler.CommProfiler) and returns GB/s calibrated to
+    CostModel._coll_ms's ring convention ((n-1)/n * bytes / time), so
+    plugging the result into GalvatronSearch(ici_gbps=...) makes the
+    model's collective terms match this hardware.  None when
+    unmeasurable (single device)."""
+    import jax
+    from jax.sharding import Mesh
+    from ..profiler import CommProfiler
+
+    if mesh is None:
+        devs = np.array(jax.devices())
+        if devs.size < 2:
+            return None
+        mesh = Mesh(devs, ("all",))
+    axis = mesh.axis_names[0]
+    n = int(mesh.shape[axis])
+    if n < 2:
+        return None
+    t_s = CommProfiler(mesh).bench_collective("psum", nbytes=nbytes,
+                                              axis=axis, repeats=repeats)
+    if not t_s or t_s <= 0:
+        return None
+    # bench_collective shards its buffer P(axis): the psum'd payload is
+    # the PER-DEVICE block, nbytes/n — credit exactly that, in the same
+    # one-phase ring convention CostModel._coll_ms prices with
+    payload = nbytes / n
+    return (payload * (n - 1) / n) / t_s / 1e9
+
+
 def profile_layers_analytic(n_layers, hidden, seq, ffn_mult=4, dtype_bytes=2,
                             chip_tflops=200.0):
     """Analytic LayerProfile for a transformer layer (used when no measured

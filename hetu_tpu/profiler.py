@@ -190,12 +190,19 @@ class CommProfiler:
         fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
                                out_specs=P(axis) if kind == "ppermute"
                                else (P() if kind == "psum" else P())))
+        import numpy as _np
+
+        def _sync(o):
+            # materialize: through the dev tunnel block_until_ready has
+            # been observed returning before the work finishes
+            _np.asarray(jax.tree_util.tree_leaves(o)[0])
+
         out = fn(x)
-        jax.block_until_ready(out)
+        _sync(out)
         t0 = time.perf_counter()
         for _ in range(repeats):
             out = fn(x)
-        jax.block_until_ready(out)
+        _sync(out)
         return (time.perf_counter() - t0) / repeats
 
 

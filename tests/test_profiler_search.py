@@ -260,3 +260,18 @@ def test_measured_hp_layer_profiles_feed_search():
                           micro_bsz=2, pp_candidates=[1],
                           chunks_candidates=(1,)).search(profiles)
     assert cfg is not None and cfg.n_layers == 4
+
+
+def test_measured_ici_bandwidth_feeds_search():
+    """measure_ici_gbps times a real psum over the mesh (reference
+    GalvatronProfiler.profile_bandwidth / nccl-tests role) and the
+    search consumes the measured number."""
+    from hetu_tpu.galvatron import (GalvatronSearch, measure_ici_gbps,
+                                    profile_layers_analytic)
+    gbps = measure_ici_gbps(nbytes=1 << 18, repeats=2)
+    assert gbps is not None and gbps > 0
+    layers = profile_layers_analytic(4, hidden=64, seq=128)
+    cfg = GalvatronSearch(world=8, mem_budget_bytes=1 << 30, micro_bsz=2,
+                          ici_gbps=gbps,
+                          chunks_candidates=(1,)).search(layers)
+    assert cfg is not None
