@@ -16,6 +16,14 @@ sys.path.insert(0, os.path.abspath(os.path.join(
 import argparse
 import json
 
+import jax
+
+# a pre-registered accelerator plugin (axon sitecustomize) wins over the
+# JAX_PLATFORMS env var; force the choice through config like
+# tests/conftest.py does
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 from hetu_tpu.galvatron import (LayerProfile, GalvatronSearch)
 
 
@@ -28,17 +36,34 @@ def main():
     ap.add_argument("--mem-gb", type=float, default=16.0)
     ap.add_argument("--micro-bsz", type=int, default=2)
     ap.add_argument("--out", default=None, help="write config JSON here")
+    ap.add_argument("--measure", action="store_true",
+                    help="profile real HP layers (time + XLA memory "
+                         "ledger) and the mesh's psum bandwidth instead "
+                         "of analytic estimates")
     args = ap.parse_args()
 
     h, s = args.hidden, args.seq_len
-    per_layer_params = 12 * h * h
-    act_bytes = 10 * s * h * 2          # bf16 activations per sample
-    compute_ms = 2.0                     # per-layer fwd estimate
-    layers = [LayerProfile(compute_ms, per_layer_params * 4, act_bytes)
-              for _ in range(args.layers)]
+    if args.measure:
+        from hetu_tpu.galvatron import (TransformerHPLayer,
+                                        measure_ici_gbps,
+                                        profile_hp_layers)
+        specs = [TransformerHPLayer(hidden=h, heads=max(1, h // 64))
+                 for _ in range(args.layers)]
+        # profile at the REAL sequence length: compute and memory terms
+        # scale super-linearly with seq, so capping here would feed the
+        # search numbers from a different workload than the emitted config
+        layers = profile_hp_layers(specs, batch=2, seq=s)
+        ici = measure_ici_gbps() or 100.0
+    else:
+        per_layer_params = 12 * h * h
+        act_bytes = 10 * s * h * 2      # bf16 activations per sample
+        compute_ms = 2.0                 # per-layer fwd estimate
+        layers = [LayerProfile(compute_ms, per_layer_params * 4, act_bytes)
+                  for _ in range(args.layers)]
+        ici = 100.0
 
     search = GalvatronSearch(args.world, args.mem_gb * (1 << 30),
-                             micro_bsz=args.micro_bsz)
+                             micro_bsz=args.micro_bsz, ici_gbps=ici)
     cfg = search.search(layers)
     out = cfg.to_json()
     print(json.dumps(out, indent=2))

@@ -28,6 +28,13 @@ from .graph.node import Op, PlaceholderOp, VariableOp, find_topo_sort
 from .graph.trace import TraceContext
 
 
+def _sync(out):
+    """Materialize a result to end a timing window: through the dev
+    tunnel, jax.block_until_ready has been observed returning before the
+    work actually finishes (BASELINE.md methodology note)."""
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+
+
 # ---------------------------------------------------------------------------
 # shape inference over the graph
 
@@ -137,11 +144,11 @@ class HetuProfiler:
         try:
             for _ in range(warmup):
                 out = fn(*args)
-            jax.block_until_ready(out)
+            _sync(out)
             t0 = time.perf_counter()
             for _ in range(repeats):
                 out = fn(*args)
-            jax.block_until_ready(out)
+            _sync(out)
             return (time.perf_counter() - t0) / repeats
         except Exception:
             return 0.0
@@ -190,13 +197,6 @@ class CommProfiler:
         fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
                                out_specs=P(axis) if kind == "ppermute"
                                else (P() if kind == "psum" else P())))
-        import numpy as _np
-
-        def _sync(o):
-            # materialize: through the dev tunnel block_until_ready has
-            # been observed returning before the work finishes
-            _np.asarray(jax.tree_util.tree_leaves(o)[0])
-
         out = fn(x)
         _sync(out)
         t0 = time.perf_counter()
@@ -307,11 +307,11 @@ class HetuSimulator:
         """Measure actual matmul throughput to scale the roofline."""
         x = jnp.ones((size, size), jnp.bfloat16)
         fn = jax.jit(lambda a: a @ a)
-        jax.block_until_ready(fn(x))
+        _sync(fn(x))
         t0 = time.perf_counter()
         for _ in range(repeats):
             out = fn(x)
-        jax.block_until_ready(out)
+        _sync(out)
         dt = (time.perf_counter() - t0) / repeats
         self.peak_flops = 2.0 * size ** 3 / dt
         return self.peak_flops
