@@ -22,8 +22,8 @@ import argparse
 
 import jax
 
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+from hetu_tpu.platform import force_platform_from_env
+force_platform_from_env()
 
 from hetu_tpu.galvatron import (GalvatronSearch, LayerProfile,
                                 TransformerHPLayer, make_lm_hybrid_model)

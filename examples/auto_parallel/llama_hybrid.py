@@ -21,11 +21,8 @@ import argparse
 
 import jax
 
-# a pre-registered accelerator plugin (axon sitecustomize) wins over the
-# JAX_PLATFORMS env var; force the choice through config like
-# tests/conftest.py does
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+from hetu_tpu.platform import force_platform_from_env
+force_platform_from_env()
 
 import jax.numpy as jnp
 

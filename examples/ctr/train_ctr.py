@@ -12,6 +12,9 @@ import sys
 sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), "..", "..")))
 
+from hetu_tpu.platform import force_platform_from_env
+force_platform_from_env()
+
 import argparse
 
 import numpy as np
@@ -30,6 +33,9 @@ def main():
     ap.add_argument("--embedding-dim", type=int, default=16)
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--sparse-opt", action="store_true",
+                    help="lazy (IndexedSlices) in-graph embedding updates "
+                         "— only touched rows read/write per step")
     ap.add_argument("--ps", action="store_true",
                     help="host-RAM PS embedding table (server-side SGD)")
     ap.add_argument("--cache", type=int, default=0,
@@ -54,7 +60,16 @@ def main():
                                ps_embedding=ps_emb)
     loss = model.loss(dense, sparse, labels)
     opt = ht.AdamOptimizer(learning_rate=args.lr)
-    ex = ht.Executor({"train": [loss, opt.minimize(loss)]})
+    sparse_vars = ()
+    if args.sparse_opt and ps_emb is not None:
+        ap.error("--sparse-opt applies to the in-graph table; it is "
+                 "mutually exclusive with --ps (server-side updates)")
+    if args.sparse_opt and ps_emb is None:
+        # lazy in-graph updates: Adam moments for untouched rows stay
+        # frozen (reference OptimizersSparse.cu semantics)
+        sparse_vars = [model.emb.table]
+    ex = ht.Executor(
+        {"train": [loss, opt.minimize(loss, sparse_vars=sparse_vars)]})
 
     for step in range(args.steps):
         feed = {dense: rng.standard_normal((B, 13)).astype(np.float32),

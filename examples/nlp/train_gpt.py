@@ -9,6 +9,9 @@ import sys
 sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), "..", "..")))
 
+from hetu_tpu.platform import force_platform_from_env
+force_platform_from_env()
+
 import argparse
 
 import numpy as np

@@ -21,6 +21,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), "..", "..")))
 
+from hetu_tpu.platform import force_platform_from_env
+force_platform_from_env()
+
 
 def main():
     import numpy as np

@@ -28,6 +28,9 @@ class Optimizer:
     """Base optimizer: subclasses define slot init + dense update rule."""
 
     slot_names = ()
+    # rowwise (lazy) sparse application is exact for elementwise update
+    # rules; optimizers with whole-tensor terms (Lamb trust ratio) opt out
+    supports_sparse = True
 
     def __init__(self, learning_rate=0.01, l2reg=0.0):
         self.lr = as_schedule(learning_rate)
@@ -40,20 +43,79 @@ class Optimizer:
     def apply_dense(self, param, grad, slots, lr, step):
         raise NotImplementedError
 
+    def apply_sparse(self, param, ids, grad_rows, slots, lr, step):
+        """LAZY sparse update (reference src/ops/OptimizersSparse.cu):
+        gather the touched rows of param and slots, run the dense rule
+        rowwise, scatter back — untouched rows (and their moments) are
+        never read or written.  ``ids`` are deduped with pad -1."""
+        mask = (ids >= 0).reshape(-1, *([1] * (param.ndim - 1)))
+        gather = jnp.maximum(ids, 0).astype(jnp.int32)
+        # pad entries write OUT OF BOUNDS so the scatter DROPS them — a
+        # clamped pad index would race the real row-0 update (duplicate
+        # scatter indices have no ordering guarantee)
+        scatter = jnp.where(ids >= 0, ids,
+                            param.shape[0]).astype(jnp.int32)
+        p_rows = param[gather]
+        s_rows = {k: v[gather] for k, v in slots.items()}
+        g_rows = jnp.where(mask, grad_rows.astype(param.dtype), 0)
+        new_rows, new_s = self.apply_dense(p_rows, g_rows, s_rows, lr, step)
+        new_param = param.at[scatter].set(new_rows, mode="drop")
+        new_slots = {k: slots[k].at[scatter].set(new_s[k], mode="drop")
+                     for k in slots}
+        return new_param, new_slots
+
     def _regularized(self, param, grad):
         if self.l2reg > 0.0:
             return grad + self.l2reg * param
         return grad
 
     # -- graph construction ------------------------------------------------
-    def minimize(self, loss, var_list=None):
-        from ..graph.node import graph_variables
+    def minimize(self, loss, var_list=None, sparse_vars=()):
+        """Build grads + the OptimizerOp.
+
+        ``sparse_vars``: variables (embedding tables) to update LAZILY —
+        gradients are taken w.r.t. their lookup OUTPUTS and applied as
+        deduped (ids, rows) without ever densifying a [V, H] gradient
+        (reference optimizer.py sparse op pairs + OptimizersSparse.cu).
+        A listed var consumed by anything other than embedding_lookup
+        falls back to the dense path.
+        """
+        from ..graph.node import graph_variables, find_topo_sort
         if var_list is None:
             var_list = graph_variables([loss], trainable_only=True)
+        sparse_set = set(sparse_vars)
+        if sparse_set and not self.supports_sparse:
+            raise ValueError(
+                f"{type(self).__name__} has whole-tensor update terms; "
+                "rowwise sparse application would change its semantics")
+        dense_vars, sparse_entries = [], []
+        topo = find_topo_sort([loss]) if sparse_set else []
+        for v in var_list:
+            if v not in sparse_set:
+                dense_vars.append(v)
+                continue
+            uses = [n for n in topo if v in n.inputs]
+            lookups = [n for n in uses
+                       if getattr(n, "op_kind", None) == "embedding_lookup"
+                       and n.inputs[0] is v]
+            if not lookups or len(uses) != len(lookups):
+                dense_vars.append(v)     # non-lookup uses: stay dense
+                continue
+            sparse_entries.append((v, lookups))
+        targets = dense_vars + [lk for _, lks in sparse_entries
+                                for lk in lks]
         # var_list may be empty (all params PS-resident); the OptimizerOp
         # then only anchors the loss for PS-embedding grad derivation
-        grads = gradients(loss, var_list) if var_list else []
-        op = OptimizerOp(grads, var_list, self)
+        grads = gradients(loss, targets) if targets else []
+        nd = len(dense_vars)
+        sparse, k = [], nd
+        for v, lks in sparse_entries:
+            sites = []
+            for lk in lks:
+                sites.append((grads[k], lk.inputs[1]))
+                k += 1
+            sparse.append((v, sites))
+        op = OptimizerOp(grads[:nd], dense_vars, self, sparse=sparse)
         op.loss = loss  # lets the executor derive PS-embedding grads
         return op
 
@@ -166,6 +228,8 @@ class AdamWOptimizer(AdamOptimizer):
 class LambOptimizer(AdamOptimizer):
     """Layer-wise adaptive moments (reference optimizer.py:686)."""
 
+    supports_sparse = False   # whole-tensor trust ratio
+
     def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999, eps=1e-6,
                  weight_decay=0.0):
         super().__init__(learning_rate, beta1, beta2, eps)
@@ -190,14 +254,20 @@ class OptimizerOp(Op):
     (matching reference train_op semantics).
     """
 
-    def __init__(self, grads, var_list, optimizer, clip_global_norm=None):
+    def __init__(self, grads, var_list, optimizer, clip_global_norm=None,
+                 sparse=None):
         assert len(grads) == len(var_list)
-        super().__init__(*grads, name=f"optimizer_{_opt_count()}")
+        # sparse: [(var, [(rows_grad_node, ids_node), ...]), ...] — lazy
+        # embedding updates (Optimizer.minimize sparse_vars)
+        self.sparse = list(sparse or [])
+        extra = [n for _, sites in self.sparse
+                 for g, ids in sites for n in (g, ids)]
+        super().__init__(*grads, *extra, name=f"optimizer_{_opt_count()}")
         self.var_list = list(var_list)
         self.optimizer = optimizer
         self.clip_global_norm = clip_global_norm
         self.loss = None
-        for v in var_list:
+        for v in list(var_list) + [v for v, _ in self.sparse]:
             assert isinstance(v, VariableOp), f"cannot optimize {v}"
 
     @property
@@ -209,31 +279,66 @@ class OptimizerOp(Op):
         return {
             "step": jnp.zeros((), dtype=jnp.int32),
             "slots": {v.name: self.optimizer.init_slots(params[v.name])
-                      for v in self.var_list},
+                      for v in (self.var_list
+                                + [sv for sv, _ in self.sparse])},
         }
 
+    @staticmethod
+    def _bucket(n, floor=64):
+        b = floor
+        while b < n:
+            b *= 2
+        return b
+
     def _compute_with_env(self, env, ctx):
+        from ..ops.embedding import reduce_indexedslices
         state = ctx.opt_state[self.name]
         step = state["step"]
         lr = self.optimizer.lr.get(step)
-        grads = [env[g] for g in self.inputs]
+        grads = [env[g] for g in self.inputs[:len(self.var_list)]]
+        # lazy-sparse vars: dedup each var's (ids, rows) across its
+        # lookup sites FIRST, so the clip norm matches the dense norm
+        # exactly (duplicate ids would double-count otherwise)
+        sparse_ready = []
+        for var, sites in self.sparse:
+            ids = jnp.concatenate(
+                [env[i].reshape(-1) for _, i in sites]).astype(jnp.int32)
+            rows = jnp.concatenate(
+                [env[g].reshape(-1, env[g].shape[-1]) for g, _ in sites])
+            uniq, summed = reduce_indexedslices(
+                ids, rows, self._bucket(int(ids.shape[0])))
+            sparse_ready.append((var, uniq, summed))
         if self.clip_global_norm is not None:
             # accumulate the norm in f32 (bf16 grads under mixed precision
             # would underestimate it once the sum saturates the mantissa)
-            gnorm = jnp.sqrt(sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in grads)
+            sq += sum(jnp.sum(jnp.square(r.astype(jnp.float32)))
+                      for _, _, r in sparse_ready)
+            gnorm = jnp.sqrt(sq)
             scale = jnp.minimum(1.0, self.clip_global_norm / (gnorm + 1e-6))
             grads = [g * scale for g in grads]
+            sparse_ready = [(v, i, r * scale) for v, i, r in sparse_ready]
         new_slots = {}
         master = ctx.master_params
-        for var, grad in zip(self.var_list, grads):
+
+        def _param_of(var):
             # mixed precision: update the full-precision master copy, not
             # the low-precision working value bound in the trace env.
-            param = master[var.name] if (master is not None
-                                         and var.name in master) else env[var]
+            return master[var.name] if (master is not None
+                                        and var.name in master) else env[var]
+
+        for var, grad in zip(self.var_list, grads):
+            param = _param_of(var)
             grad = grad.astype(param.dtype)
             new_p, ns = self.optimizer.apply_dense(
                 param, grad, state["slots"][var.name], lr, step)
+            new_slots[var.name] = ns
+            ctx.record_update(var, new_p)
+        for var, uniq, summed in sparse_ready:
+            param = _param_of(var)
+            new_p, ns = self.optimizer.apply_sparse(
+                param, uniq, summed, state["slots"][var.name], lr, step)
             new_slots[var.name] = ns
             ctx.record_update(var, new_p)
         ctx.new_opt_state[self.name] = {"step": step + 1, "slots": new_slots}

@@ -139,6 +139,14 @@ class PipelineSubExecutor:
                              if not isinstance(n, OptimizerOp)]
         self.opt_op = self.opt_ops[0] if self.opt_ops else None
         self.training = self.opt_op is not None
+        if self.opt_op is not None and getattr(self.opt_op, "sparse", None):
+            # refuse, don't silently skip: stage homing and the backward
+            # builders consult var_list only, so a sparse-flagged table
+            # would train NOTHING under the pipeline path
+            raise NotImplementedError(
+                "lazy sparse optimizer updates (minimize(sparse_vars=...)) "
+                "are not supported under the graph pipeline; use the "
+                "dense path or the PS embedding subsystem")
 
         roots = list(self.user_outputs)
         self.loss = None

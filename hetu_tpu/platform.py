@@ -12,6 +12,20 @@ from __future__ import annotations
 _HOST_CALLBACKS = None
 
 
+def force_platform_from_env():
+    """Honor JAX_PLATFORMS through jax.config BEFORE any device use.
+
+    A pre-registered accelerator plugin (the axon sitecustomize) wins
+    over the env var — the config reads "axon,cpu" regardless — and with
+    the tunnel down a default-backend init blocks forever.  Call this at
+    the top of scripts that accept JAX_PLATFORMS (tests/conftest.py and
+    bench.py stage children apply the same rule inline)."""
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def host_callbacks_supported() -> bool:
     """True iff jitted host callbacks (jax.debug.print et al) execute on
     the default backend.  Probes with a trivial jitted program once and

@@ -46,6 +46,128 @@ def test_optimizer_converges(opt_cls, kwargs):
     assert lv < first * 0.05, f"{opt_cls.__name__} failed: {first} -> {lv}"
 
 
+class TestSparseOptimizer:
+    """Lazy (IndexedSlices) in-graph embedding updates — reference
+    optimizer.py sparse op pairs + src/ops/OptimizersSparse.cu."""
+
+    V, D, B, F = 64, 8, 16, 4
+
+    class _FixedInit:
+        def __init__(self, vals):
+            self.vals = vals
+
+        def __call__(self, key, shape, dtype=None):
+            import jax.numpy as jnp
+            return jnp.asarray(self.vals, dtype or jnp.float32)
+
+    def _graph(self, opt, sparse, tag=""):
+        rng = np.random.default_rng(0)
+        init_vals = np.random.default_rng(42).standard_normal(
+            (self.V, self.D)).astype(np.float32)
+        ids = ht.placeholder_op(f"so_ids{tag}", (self.B, self.F),
+                                dtype=np.int32)
+        y = ht.placeholder_op(f"so_y{tag}", (self.B, self.F, self.D))
+        table = ht.Variable(f"so_table{tag}", shape=(self.V, self.D),
+                            initializer=self._FixedInit(init_vals))
+        e = ht.embedding_lookup_op(table, ids)
+        loss = ht.reduce_mean_op(ht.pow_op(e - y, exponent=2.0))
+        train = opt.minimize(loss,
+                             sparse_vars=[table] if sparse else ())
+        ex = ht.Executor([loss, train], seed=7)
+        feeds = [{ids: rng.integers(0, self.V, (self.B, self.F)),
+                  y: rng.standard_normal(
+                      (self.B, self.F, self.D)).astype(np.float32)}
+                 for _ in range(4)]
+        return ex, table, feeds
+
+    def test_sgd_sparse_matches_dense_exactly(self):
+        # SGD has no cross-step slot dynamics: lazy == dense bitwise-ish
+        runs = []
+        for sparse in (False, True):
+            ex, table, feeds = self._graph(ht.SGDOptimizer(0.1), sparse,
+                                           tag=f"_{int(sparse)}")
+            for f in feeds:
+                ex.run(feed_dict=f)
+            runs.append(np.asarray(ex.params[table.name]))
+        np.testing.assert_allclose(runs[0], runs[1], rtol=1e-6, atol=1e-6)
+
+    def test_adam_sparse_is_lazy(self):
+        # untouched rows keep their moments frozen (lazy semantics);
+        # touched rows converge the loss like dense
+        ex, table, feeds = self._graph(ht.AdamOptimizer(0.05), True)
+        p0 = np.asarray(ex.params[table.name])
+        losses = [float(ex.run(feed_dict=f,
+                               convert_to_numpy_ret_vals=True)[0])
+                  for f in feeds * 4]
+        assert losses[-1] < losses[0]
+        p1 = np.asarray(ex.params[table.name])
+        touched = np.unique(np.concatenate(
+            [np.asarray(f[list(f)[0]]).ravel() for f in feeds]))
+        untouched = np.setdiff1d(np.arange(self.V), touched)
+        if untouched.size:                    # pure-lazy: never written
+            np.testing.assert_array_equal(p0[untouched], p1[untouched])
+        assert not np.allclose(p0[touched], p1[touched])
+
+    def test_clip_norm_counts_sparse_grads(self):
+        # the global-norm clip sees the deduped sparse rows: with a tiny
+        # clip bound, updates shrink vs unclipped
+        deltas = []
+        for clip in (None, 1e-3):
+            opt = ht.AdamOptimizer(0.05)
+            ids = ht.placeholder_op(f"cl_ids_{clip}", (8,),
+                                    dtype=np.int32)
+            y = ht.placeholder_op(f"cl_y_{clip}", (8, self.D))
+            table = ht.Variable(f"cl_table_{clip}", shape=(32, self.D),
+                                initializer=ht.init.normal(0.0, 1.0))
+            e = ht.embedding_lookup_op(table, ids)
+            loss = ht.reduce_mean_op(ht.pow_op(e - y, exponent=2.0))
+            grads_op = opt.minimize(loss, sparse_vars=[table])
+            grads_op.clip_global_norm = clip
+            ex = ht.Executor([loss, grads_op], seed=3)
+            p0 = np.asarray(ex.params[table.name])
+            rng = np.random.default_rng(1)
+            ex.run(feed_dict={ids: rng.integers(0, 32, (8,)),
+                              y: rng.standard_normal((8, self.D))
+                              .astype(np.float32)})
+            deltas.append(
+                np.abs(np.asarray(ex.params[table.name]) - p0).max())
+        assert deltas[1] < deltas[0]
+
+    def test_pipeline_refuses_sparse(self):
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multi-device mesh")
+        from hetu_tpu.parallel import make_mesh
+        ids = ht.placeholder_op("pr_ids", (4, 2), dtype=np.int32)
+        y = ht.placeholder_op("pr_y", (4, 2, self.D))
+        table = ht.Variable("pr_table", shape=(16, self.D),
+                            initializer=ht.init.normal(0.0, 1.0))
+        e = ht.embedding_lookup_op(table, ids)
+        loss = ht.reduce_mean_op(ht.pow_op(e - y, exponent=2.0))
+        op = ht.SGDOptimizer(0.1).minimize(loss, sparse_vars=[table])
+        with pytest.raises(NotImplementedError, match="sparse"):
+            ht.Executor({"train": [loss, op]},
+                        mesh=make_mesh({"pp": 2}), pipeline="gpipe",
+                        num_micro=2)
+
+    def test_lamb_refuses_sparse(self):
+        ids = ht.placeholder_op("lb_ids", (4,), dtype=np.int32)
+        table = ht.Variable("lb_table", shape=(16, 4),
+                            initializer=ht.init.normal(0.0, 1.0))
+        loss = ht.reduce_mean_op(ht.embedding_lookup_op(table, ids))
+        with pytest.raises(ValueError, match="whole-tensor"):
+            ht.LambOptimizer(0.1).minimize(loss, sparse_vars=[table])
+
+    def test_non_lookup_use_falls_back_to_dense(self):
+        ids = ht.placeholder_op("fb_ids", (4,), dtype=np.int32)
+        table = ht.Variable("fb_table", shape=(16, 4),
+                            initializer=ht.init.normal(0.0, 1.0))
+        loss = ht.reduce_mean_op(ht.embedding_lookup_op(table, ids)) \
+            + ht.reduce_mean_op(table)        # second, non-lookup use
+        op = ht.SGDOptimizer(0.1).minimize(loss, sparse_vars=[table])
+        assert table in op.var_list and not op.sparse
+
+
 def test_optimizer_matches_torch_sgd_momentum():
     import torch
     X, Y = _toy_problem(1)
