@@ -88,6 +88,13 @@ class Optimizer:
             raise ValueError(
                 f"{type(self).__name__} has whole-tensor update terms; "
                 "rowwise sparse application would change its semantics")
+        unknown = sparse_set - set(var_list)
+        if unknown:
+            # loud, not silent: a sparse var outside var_list would get
+            # no gradient and no fallback — the table would never train
+            raise ValueError(
+                "sparse_vars must be optimized variables (in var_list / "
+                "trainable): " + ", ".join(v.name for v in unknown))
         dense_vars, sparse_entries = [], []
         topo = find_topo_sort([loss]) if sparse_set else []
         for v in var_list:
