@@ -403,6 +403,9 @@ def bench_wdl(quick):
     assert np.isfinite(out[0])
     dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
     ours = 1.0 / dt
+    import gc
+    del ex          # each timed executor runs alone (bench_moe discipline)
+    gc.collect()
 
     # informational: the same model with LAZY sparse table updates
     # (minimize(sparse_vars=...) — reference OptimizersSparse.cu).  Not
@@ -415,12 +418,7 @@ def bench_wdl(quick):
     out_s = ex_s.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out_s[0])
     dt_s, _ = _timeit(lambda: ex_s.run("train", feed_dict=feed), steps)
-
-    # free both executors' tables + slot state before the baseline runs
-    # (same discipline as bench_moe): leftover HBM pressure would slow
-    # the flax measurement and inflate vs_baseline
-    import gc
-    del ex, ex_s
+    del ex_s        # free before the baseline times
     gc.collect()
     from benchmarks.flax_baselines import wdl_steps_per_sec
     base = _rerun(wdl_steps_per_sec, batch=B, rows=rows, steps=steps)

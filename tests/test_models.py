@@ -120,19 +120,25 @@ def test_gpt_causality():
 
 
 @pytest.mark.parametrize("model_cls", [WDL, DeepFM, DCN, DLRM])
-def test_ctr_models_train(model_cls):
+@pytest.mark.parametrize("sparse_opt", [False, True])
+def test_ctr_models_train(model_cls, sparse_opt):
+    # sparse_opt=True: lazy (IndexedSlices) in-graph table updates
+    # (minimize(sparse_vars=...), reference OptimizersSparse.cu)
     rng = np.random.default_rng(5)
     B, F, D = 32, 26, 13
     dense = rng.standard_normal((B, D)).astype(np.float32)
     sparse = rng.integers(0, 1000, size=(B, F))
     labels = rng.integers(0, 2, size=(B,)).astype(np.float32)
-    d_ = ht.placeholder_op("dense", dense.shape)
-    s_ = ht.placeholder_op("sparse", sparse.shape, dtype=np.int32)
-    l_ = ht.placeholder_op("labels", labels.shape)
+    tag = f"{model_cls.__name__}_{int(sparse_opt)}"
+    d_ = ht.placeholder_op(f"dense_{tag}", dense.shape)
+    s_ = ht.placeholder_op(f"sparse_{tag}", sparse.shape, dtype=np.int32)
+    l_ = ht.placeholder_op(f"labels_{tag}", labels.shape)
     model = model_cls(num_embeddings=1000)
     loss = model.loss(d_, s_, l_)
     opt = ht.AdamOptimizer(learning_rate=0.01)
-    ex = ht.Executor([loss, opt.minimize(loss)])
+    train = opt.minimize(
+        loss, sparse_vars=[model.emb.table] if sparse_opt else ())
+    ex = ht.Executor([loss, train])
     feed = {d_: dense, s_: sparse, l_: labels}
     losses = [float(ex.run(feed_dict=feed, convert_to_numpy_ret_vals=True)[0])
               for _ in range(25)]
