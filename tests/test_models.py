@@ -129,10 +129,9 @@ def test_ctr_models_train(model_cls, sparse_opt):
     dense = rng.standard_normal((B, D)).astype(np.float32)
     sparse = rng.integers(0, 1000, size=(B, F))
     labels = rng.integers(0, 2, size=(B,)).astype(np.float32)
-    tag = f"{model_cls.__name__}_{int(sparse_opt)}"
-    d_ = ht.placeholder_op(f"dense_{tag}", dense.shape)
-    s_ = ht.placeholder_op(f"sparse_{tag}", sparse.shape, dtype=np.int32)
-    l_ = ht.placeholder_op(f"labels_{tag}", labels.shape)
+    d_ = ht.placeholder_op("dense", dense.shape)
+    s_ = ht.placeholder_op("sparse", sparse.shape, dtype=np.int32)
+    l_ = ht.placeholder_op("labels", labels.shape)
     model = model_cls(num_embeddings=1000)
     loss = model.loss(d_, s_, l_)
     opt = ht.AdamOptimizer(learning_rate=0.01)

@@ -42,6 +42,41 @@ def test_collectives_shard_map():
     np.testing.assert_allclose(rotated, np.roll(np.arange(8.0), 1))
 
 
+def test_quantized_psum_bounded_error_and_ef_convergence():
+    """quantized_psum approximates the exact psum within the int8 step
+    size; with error feedback, repeated accumulation tracks the exact sum
+    (the dropped error is carried, not lost)."""
+    mesh = make_mesh({"x": 8})
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.standard_normal((8, 64)), jnp.float32)
+
+    f = collectives.sharded_fn(
+        mesh, (P("x", None),), P("x", None),
+        lambda v: collectives.quantized_psum(v, "x"))
+    got = np.asarray(jax.jit(f)(data))
+    exact = np.sum(np.asarray(data), axis=0)
+    # every replica sees the same reduced value
+    np.testing.assert_allclose(got, np.tile(exact, (8, 1)), atol=8 * 2 *
+                               np.abs(data).max() / 127)
+    # error feedback: accumulate T quantized reductions of the SAME x;
+    # the running total stays within one quantization step of T * exact
+    def ef_loop(v):
+        def body(carry, _):
+            total, resid = carry
+            red, resid = collectives.error_feedback(v, resid, "x")
+            return (total + red, resid), None
+        (total, _), _ = jax.lax.scan(
+            body, (jnp.zeros_like(v), jnp.zeros_like(v)), None, length=16)
+        return total
+
+    ef = collectives.sharded_fn(mesh, (P("x", None),), P("x", None),
+                                ef_loop)
+    tot = np.asarray(jax.jit(ef)(data))[0]
+    step = 8 * 2 * np.abs(data).max() / 127   # one reduction's worst case
+    assert np.abs(tot - 16 * exact).max() < 2 * step, (
+        "error feedback failed to carry quantization error")
+
+
 def test_all_to_all():
     mesh = make_mesh({"x": 4})
     data = jnp.arange(16.0).reshape(4, 4)  # dev i holds row i
