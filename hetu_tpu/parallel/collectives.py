@@ -137,14 +137,19 @@ def _quantize(x, scale, qmax, itype):
 
 
 def _quantized_psum(x, axis_name, bits):
-    """Returns (reduced, sent_local) — sent_local is the dequantized
-    stage-1 contribution this replica actually put on the wire (what
-    error feedback must subtract)."""
+    """Returns (reduced, sent_local) — sent_local is the value this
+    replica is accountable for delivering (stage-1 payload minus its own
+    shard's stage-2 re-quantization error), so ``x_c - sent_local`` in
+    error_feedback carries EXACTLY the undelivered mass.
+
+    All quantize/dequantize/accumulate arithmetic runs in f32 (bf16
+    inputs would cap bits=16 at bf16's 8 mantissa bits); only the final
+    outputs cast back to x.dtype."""
     assert bits in (8, 16)
     qmax = float(2 ** (bits - 1) - 1)
     itype = jnp.int8 if bits == 8 else jnp.int16
     n = jax.lax.psum(1, axis_name)
-    flat = x.reshape(-1)
+    flat = x.reshape(-1).astype(jnp.float32)
     pad = (-flat.shape[0]) % n
     flat_p = jnp.pad(flat, (0, pad))
     # stage 1: shared scale; int payload rides all_to_all (pure data
@@ -156,17 +161,26 @@ def _quantized_psum(x, axis_name, bits):
     shards = jax.lax.all_to_all(q1.reshape(n, -1), axis_name,
                                 split_axis=0, concat_axis=0, tiled=True)
     # local accumulation in int32 (max |sum| = n * qmax, no overflow)
-    local = shards.reshape(n, -1).astype(jnp.int32).sum(0)
-    r = local.astype(x.dtype) * (scale1 / qmax)
+    local = shards.astype(jnp.int32).sum(0)
+    r = local.astype(jnp.float32) * (scale1 / qmax)
     # stage 2: re-quantize the reduced shard for the gather leg
     scale2 = jnp.maximum(jax.lax.pmax(jnp.max(jnp.abs(r)), axis_name),
                          1e-30)
     q2 = _quantize(r, scale2, qmax, itype)
     g = jax.lax.all_gather(q2, axis_name, tiled=True)
-    out = g.astype(x.dtype) * (scale2 / qmax)
-    out = out[:flat.shape[0]].reshape(x.shape)
-    sent = (q1.astype(x.dtype) * (scale1 / qmax))[:flat.shape[0]] \
-        .reshape(x.shape)
+    out_flat = g.astype(jnp.float32) * (scale2 / qmax)
+    out = out_flat[:flat.shape[0]].reshape(x.shape).astype(x.dtype)
+    sent1 = q1.astype(jnp.float32) * (scale1 / qmax)
+    # my shard's stage-2 error is MINE to re-send next step: r_i equals
+    # the exact sum of everyone's dequantized stage-1 payloads at shard
+    # i, so charging err2_i to replica i's ledger makes
+    # sum_replicas(sent) == what was actually delivered, elementwise
+    chunk = r.shape[0]
+    err2 = r - q2.astype(jnp.float32) * (scale2 / qmax)
+    off = (jax.lax.axis_index(axis_name) * chunk,)
+    sent_eff = jax.lax.dynamic_update_slice(
+        sent1, jax.lax.dynamic_slice(sent1, off, (chunk,)) - err2, off)
+    sent = sent_eff[:flat.shape[0]].reshape(x.shape).astype(x.dtype)
     return out, sent
 
 

@@ -150,6 +150,34 @@ class TestSparseOptimizer:
             res.append(np.asarray(ex.params[table.name]))
         np.testing.assert_allclose(res[0], res[1], atol=1e-5)
 
+    def test_sparse_under_mixed_precision(self):
+        """Lazy updates hit the f32 master copy under bf16 compute, like
+        the dense path (slots and masters stay full precision)."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        init_vals = np.random.default_rng(42).standard_normal(
+            (self.V, self.D)).astype(np.float32)
+        ids = ht.placeholder_op("mp_ids", (self.B, self.F),
+                                dtype=np.int32)
+        y = ht.placeholder_op("mp_y", (self.B, self.F, self.D))
+        t = ht.Variable("mp_table", shape=(self.V, self.D),
+                        initializer=self._FixedInit(init_vals))
+        e = ht.embedding_lookup_op(t, ids)
+        loss = ht.reduce_mean_op(ht.pow_op(e - y, exponent=2.0))
+        train = ht.AdamOptimizer(0.05).minimize(loss, sparse_vars=[t])
+        ex_mp = ht.Executor([loss, train], seed=7,
+                            compute_dtype=jnp.bfloat16)
+        losses = []
+        for _ in range(4):
+            fm = {ids: rng.integers(0, self.V, (self.B, self.F)),
+                  y: rng.standard_normal(
+                      (self.B, self.F, self.D)).astype(np.float32)}
+            losses.append(float(ex_mp.run(
+                feed_dict=fm, convert_to_numpy_ret_vals=True)[0]))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        # master copy stays f32
+        assert np.asarray(ex_mp.params[t.name]).dtype == np.float32
+
     def test_sparse_state_checkpoints(self, tmp_path):
         """Adam moments of a lazily-updated table ride save/load: loss
         sequences replay exactly after restore."""

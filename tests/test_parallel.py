@@ -77,6 +77,49 @@ def test_quantized_psum_bounded_error_and_ef_convergence():
         "error feedback failed to carry quantization error")
 
 
+def test_quantized_dp_training_tracks_exact():
+    """A DP training loop whose grad sync uses error-feedback quantized
+    allreduce converges like the exact-psum loop (the feature's purpose:
+    ~4x less DCN wire traffic without losing the training)."""
+    mesh = make_mesh({"dp": 8})
+    rng = np.random.default_rng(0)
+    Xs = jnp.asarray(rng.standard_normal((8, 16, 10)), jnp.float32)
+    true_w = jnp.asarray(rng.standard_normal((10, 1)), jnp.float32)
+    Ys = jnp.einsum("dbi,ij->dbj", Xs, true_w)
+
+    def make_loop(quantized):
+        def loop(x, y):
+            w = collectives.varying(jnp.zeros((10, 1), jnp.float32),
+                                    ("dp",))
+            resid = jnp.zeros_like(w)
+
+            def body(carry, _):
+                w, resid = carry
+                def loss_fn(w_):
+                    return jnp.mean((x @ w_ - y) ** 2)
+                l, g = jax.value_and_grad(loss_fn)(w)
+                if quantized:
+                    g, resid = collectives.error_feedback(g, resid, "dp")
+                    g = g / 8.0
+                else:
+                    g = jax.lax.pmean(g, "dp")
+                return (w - 0.1 * g, resid), jax.lax.pmean(l, "dp")
+
+            (_, _), losses = jax.lax.scan(body, (w, resid), None,
+                                          length=40)
+            return losses
+
+        return collectives.sharded_fn(
+            mesh, (P("dp", None, None), P("dp", None, None)), P(None),
+            loop)
+
+    exact = np.asarray(jax.jit(make_loop(False))(Xs, Ys))
+    quant = np.asarray(jax.jit(make_loop(True))(Xs, Ys))
+    assert exact[-1] < exact[0] * 0.05
+    assert quant[-1] < quant[0] * 0.05        # converges too
+    assert abs(quant[-1] - exact[-1]) < 0.05 * max(exact[0], 1e-6)
+
+
 def test_all_to_all():
     mesh = make_mesh({"x": 4})
     data = jnp.arange(16.0).reshape(4, 4)  # dev i holds row i
