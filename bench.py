@@ -312,7 +312,7 @@ def bench_resnet(quick):
 
     # large batch: CIFAR steps are tiny, and through the dev tunnel a
     # small-batch measurement times dispatch, not the chip
-    B, steps = (16, 5) if quick else (2048, 20)
+    B, steps = (128, 5) if quick else (2048, 20)
     rng = np.random.default_rng(0)
     x = ht.placeholder_op("rn_x", (B, 3, 32, 32))
     y = ht.placeholder_op("rn_y", (B,), dtype=np.int32)
