@@ -91,6 +91,19 @@ class TestConfig:
         assert loaded.pp_ranks() == [0, 0, 1, 1]
         raw = json.loads(p.read_text())
         assert raw["tp_sizes_enc"] == "2,2,4,4"  # reference string encoding
+        # sp flags ride the same string encoding (absent -> zeros)
+        cfg_sp = HybridParallelConfig(
+            pp_deg=1, tp_sizes=[2, 2], dp_types=[0, 0], sp_flags=[1, 0],
+            world=8)
+        p2 = tmp_path / "cfg_sp.json"
+        cfg_sp.save(p2)
+        assert HybridParallelConfig.load(p2).sp_flags == [1, 0]
+        # LEGACY file (pre-sp JSON, no sp_flags_enc key) defaults to zeros
+        legacy = json.loads(p.read_text())
+        legacy.pop("sp_flags_enc")
+        p3 = tmp_path / "cfg_legacy.json"
+        p3.write_text(json.dumps(legacy))
+        assert HybridParallelConfig.load(p3).sp_flags == [0, 0, 0, 0]
 
     def test_axes_split(self):
         k, axes = layer_mesh_axes(world=8, pp_deg=1)

@@ -262,6 +262,23 @@ def test_measured_hp_layer_profiles_feed_search():
     assert cfg is not None and cfg.n_layers == 4
 
 
+def test_profile_json_roundtrip(tmp_path):
+    """The profile persistence contract (reference writes/loads
+    computation_profiling_*.json): all fields survive, including the
+    measured act_mem_bytes (and its absence, for legacy files)."""
+    from hetu_tpu.galvatron import (LayerProfile, load_profile,
+                                    save_profile)
+    layers = [LayerProfile(1.5, 4e6, 2e5, act_mem_bytes=8e5),
+              LayerProfile(2.5, 8e6, 4e5)]          # legacy: no measure
+    p = str(tmp_path / "prof.json")
+    save_profile(p, layers, ici_gbps=42.0)
+    loaded, ici, _ = load_profile(p)
+    assert ici == 42.0 and len(loaded) == 2
+    assert loaded[0].act_mem_bytes == 8e5
+    assert loaded[1].act_mem_bytes is None
+    assert loaded[0].compute_ms == 1.5 and loaded[1].param_bytes == 8e6
+
+
 def test_measured_ici_bandwidth_feeds_search():
     """measure_ici_gbps times a real psum over the mesh (reference
     GalvatronProfiler.profile_bandwidth / nccl-tests role) and the
