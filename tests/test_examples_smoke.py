@@ -28,6 +28,19 @@ def test_gpt_hybrid_example_smoke():
     assert "searched config" in r.stdout and "step 0 loss" in r.stdout
 
 
+def test_galvatron_search_measured_mode_smoke(tmp_path):
+    """--measure profiles real HP layers (time + XLA memory ledger) and
+    psum bandwidth, then searches and emits the config JSON."""
+    out = str(tmp_path / "cfg.json")
+    r = _run(["examples/auto_parallel/galvatron_search.py", "--world", "8",
+              "--layers", "2", "--hidden", "64", "--seq-len", "64",
+              "--measure", "--out", out])
+    assert r.returncode == 0, r.stderr[-2000:]
+    import json
+    cfg = json.load(open(out))
+    assert "sp_flags_enc" in cfg and "pp_division" in cfg
+
+
 def test_ctr_sparse_opt_example_smoke():
     """train_ctr --sparse-opt (lazy in-graph table updates) runs."""
     r = _run(["examples/ctr/train_ctr.py", "--model", "wdl", "--steps",
