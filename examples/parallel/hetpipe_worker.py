@@ -66,7 +66,11 @@ def main():
     else:
         kw["scheduler"] = coord
         kw["reducer"] = coord
-        kw["wait_time"] = 300.0
+        # matchmaking window must cover the injected straggle (200ms)
+        # PLUS scheduling jitter: on a loaded single-core CI box a 300ms
+        # window lets the fast worker miss the straggler in every round,
+        # so no full group ever forms
+        kw["wait_time"] = 900.0
     trainer = HetPipeTrainer(pipeline, params, nworkers, **kw)
 
     losses, group_sizes = [], []

@@ -13,3 +13,5 @@ from .hf_import import (load_hf_bert_weights, load_hf_gpt2_weights,
                         load_hf_mixtral_weights)
 from .zoo import (LogReg, CNN3, AlexNet, VGG, vgg16, vgg19,
                   RNNClassifier, LSTMClassifier)
+from .rec import (RatingModelHead, MFHead, GMFHead, MLPHead, NeuMFHead,
+                  NCFModel, REC_HEADS)

@@ -1,0 +1,158 @@
+"""NCF-family recommendation models: MF / GMF / MLP / NeuMF rating heads.
+
+Reference: examples/rec/models/base.py:5 (RatingModel_Head: MSE + MAE
+losses over a prediction computed from user/item embeddings),
+mf.py:5 (MF_Head), gmf.py:6 (GMF_Head), mlp.py:6 (MLP_Head),
+neumf.py:6 (NeuMF_Head), driven by examples/rec/run_compressed.py which
+feeds `[B, 2]` (user, item) ids through a — possibly compressed —
+embedding layer.  The heads here take the embedding output directly so
+they compose with every `embed_compress` method exactly as the
+reference's do; `NCFModel` is the convenience wrapper with a plain
+shared table.
+"""
+
+from __future__ import annotations
+
+from ..layers import Embedding, Linear, Sequence, fresh_name
+from .. import initializers as init
+from ..ops import (array_reshape_op, concat_op, mae_loss_op, mse_loss_op,
+                   reduce_mul_op, reduce_sum_op, relu_op, slice_op)
+
+
+class RatingModelHead:
+    """Base rating head (reference examples/rec/models/base.py:5).
+
+    ``__call__(embeddings, label)`` takes the looked-up (user, item)
+    embeddings — shape ``[B, 2, D]`` or ``[B, 2*D]`` — and the rating
+    labels ``[B]``, and returns ``(mse_loss, mae_loss, prediction)``.
+    """
+
+    def __init__(self, embed_dim):
+        self.embed_dim = embed_dim
+
+    def create_mlp(self, dims, name="mlp"):
+        # reference base.py:18 create_mlp: xavier-normal Linears with relu
+        return Sequence(*[
+            Linear(int(n), int(m), initializer=init.xavier_normal(),
+                   activation=relu_op, name=f"{name}_{i * 2}")
+            for i, (n, m) in enumerate(zip(dims[:-1], dims[1:]))])
+
+    def __call__(self, embeddings, label):
+        raise NotImplementedError
+
+    def output(self, prediction, label):
+        # reference base.py:40: MSE is the training loss, MAE reported
+        return (mse_loss_op(prediction, label),
+                mae_loss_op(prediction, label), prediction)
+
+
+class MFHead(RatingModelHead):
+    """Matrix factorization: dot(user, item) (reference mf.py:5)."""
+
+    def __call__(self, embeddings, label):
+        embeddings = array_reshape_op(
+            embeddings, output_shape=(-1, 2, self.embed_dim))
+        prediction = reduce_sum_op(
+            reduce_mul_op(embeddings, axes=(1,)), axes=(-1,))
+        return self.output(prediction, label)
+
+
+class GMFHead(RatingModelHead):
+    """Generalized MF: learned combination of the elementwise product
+    (reference gmf.py:6)."""
+
+    def __init__(self, embed_dim, name=None):
+        super().__init__(embed_dim)
+        name = fresh_name(name or "gmf")
+        self.predict_layer = Linear(embed_dim, 1,
+                                    initializer=init.xavier_normal(),
+                                    name=f"{name}_predict")
+
+    def __call__(self, embeddings, label):
+        embeddings = array_reshape_op(
+            embeddings, output_shape=(-1, 2, self.embed_dim))
+        interaction = reduce_mul_op(embeddings, axes=(1,))
+        prediction = array_reshape_op(self.predict_layer(interaction),
+                                      output_shape=(-1,))
+        return self.output(prediction, label)
+
+
+class MLPHead(RatingModelHead):
+    """MLP over concatenated embeddings (reference mlp.py:6): with
+    ``f = D // 4`` the tower is ``[8f, 4f, 2f, f]`` then ``f -> 1``."""
+
+    def __init__(self, embed_dim, name=None):
+        if embed_dim % 4:
+            raise ValueError("MLPHead needs embed_dim % 4 == 0 "
+                             f"(got {embed_dim})")
+        super().__init__(embed_dim)
+        name = fresh_name(name or "ncf_mlp")
+        f = embed_dim // 4
+        self.mlp_layers = self.create_mlp([8 * f, 4 * f, 2 * f, f],
+                                          name=name)
+        self.predict_layer = Linear(f, 1, initializer=init.xavier_normal(),
+                                    name=f"{name}_predict")
+
+    def __call__(self, embeddings, label):
+        flat = array_reshape_op(embeddings,
+                                output_shape=(-1, 2 * self.embed_dim))
+        prediction = array_reshape_op(
+            self.predict_layer(self.mlp_layers(flat)), output_shape=(-1,))
+        return self.output(prediction, label)
+
+
+class NeuMFHead(RatingModelHead):
+    """Neural MF (reference neumf.py:6): with ``f = D // 5`` the first
+    ``f`` dims of each embedding feed the GMF branch, the remaining
+    ``4f`` feed the MLP tower ``[8f, 4f, 2f, f]``; concat -> ``2f -> 1``."""
+
+    def __init__(self, embed_dim, name=None):
+        if embed_dim % 5:
+            raise ValueError("NeuMFHead needs embed_dim % 5 == 0 "
+                             f"(got {embed_dim})")
+        super().__init__(embed_dim)
+        name = fresh_name(name or "neumf")
+        f = embed_dim // 5
+        self.factor_num = f
+        self.mlp_layers = self.create_mlp([8 * f, 4 * f, 2 * f, f],
+                                          name=name)
+        self.predict_layer = Linear(2 * f, 1,
+                                    initializer=init.xavier_normal(),
+                                    name=f"{name}_predict")
+
+    def __call__(self, embeddings, label):
+        f = self.factor_num
+        embeddings = array_reshape_op(
+            embeddings, output_shape=(-1, 2, self.embed_dim))
+        gmf_embs = slice_op(embeddings, begin_pos=(0, 0, 0),
+                            output_shape=(-1, -1, f))
+        mlp_embs = slice_op(embeddings, begin_pos=(0, 0, f),
+                            output_shape=(-1, -1, -1))
+        output_gmf = reduce_mul_op(gmf_embs, axes=(1,))
+        input_mlp = array_reshape_op(
+            mlp_embs, output_shape=(-1, 2 * (self.embed_dim - f)))
+        output_mlp = self.mlp_layers(input_mlp)
+        prediction = array_reshape_op(
+            self.predict_layer(concat_op(output_gmf, output_mlp, axis=-1)),
+            output_shape=(-1,))
+        return self.output(prediction, label)
+
+
+REC_HEADS = {"mf": MFHead, "gmf": GMFHead, "mlp": MLPHead,
+             "neumf": NeuMFHead}
+
+
+class NCFModel:
+    """Head + shared (user|item) table, the reference driver's shape:
+    ids ``[B, 2]`` where item ids are pre-offset by ``num_users``
+    (examples/rec/run_compressed.py builds the same single table over
+    users+items so compression methods see one id space)."""
+
+    def __init__(self, num_users, num_items, embed_dim, head="neumf",
+                 embedding=None, name="ncf"):
+        self.embedding = embedding or Embedding(
+            num_users + num_items, embed_dim, name=name)
+        self.head = REC_HEADS[head](embed_dim)
+
+    def __call__(self, ids, label):
+        return self.head(self.embedding(ids), label)

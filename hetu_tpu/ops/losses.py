@@ -107,6 +107,11 @@ mse_loss_op = simple_op(
         jnp.mean(jnp.square(y - y_)) if reduction == "mean"
         else jnp.square(y - y_),
     "mse_loss")
+mae_loss_op = simple_op(
+    lambda y, y_, reduction="mean":
+        jnp.mean(jnp.abs(y - y_)) if reduction == "mean"
+        else jnp.abs(y - y_),
+    "mae_loss")
 huber_loss_op = simple_op(
     lambda y, y_, delta=1.0: jnp.where(
         jnp.abs(y - y_) <= delta,

@@ -33,7 +33,10 @@ def concatenate_op(nodes, axis=0, name=None):
 
 
 def _slice(a, begin_pos=None, output_shape=None):
-    idx = tuple(slice(b, b + s) for b, s in zip(begin_pos, output_shape))
+    # size -1 = "to the end of the dim" (reference Slice.cu semantics,
+    # e.g. examples/rec/models/neumf.py slices with [-1, -1, -1])
+    idx = tuple(slice(b, d if s == -1 else b + s)
+                for b, s, d in zip(begin_pos, output_shape, a.shape))
     return a[idx]
 
 

@@ -41,6 +41,16 @@ def test_galvatron_search_measured_mode_smoke(tmp_path):
     assert "sp_flags_enc" in cfg and "pp_division" in cfg
 
 
+def test_ncf_example_smoke():
+    """NCF trainer runs with a compressed table, exercising the per-method
+    machinery (codebook_update wiring) through the real script."""
+    r = _run(["examples/rec/train_ncf.py", "--head", "neumf", "--method",
+              "dpq", "--steps", "5", "--num-users", "300", "--num-items",
+              "200", "--batch-size", "64"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "mse" in r.stdout and "mae" in r.stdout
+
+
 def test_ctr_sparse_opt_example_smoke():
     """train_ctr --sparse-opt (lazy in-graph table updates) runs."""
     r = _run(["examples/ctr/train_ctr.py", "--model", "wdl", "--steps",

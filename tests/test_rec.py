@@ -1,0 +1,175 @@
+"""NCF-family recommendation model tests (reference examples/rec/models,
+driven by run_compressed.py; tested the reference way — numpy golden
+forward + torch loss-curve parity, SURVEY §4)."""
+
+import numpy as np
+import pytest
+import torch
+
+import hetu_tpu as ht
+from hetu_tpu import embed_compress as ec
+from hetu_tpu.models import NCFModel, REC_HEADS
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _feed(rng, model, B, users, items, D):
+    ids = np.stack([rng.integers(0, users, B),
+                    users + rng.integers(0, items, B)], axis=1)
+    ratings = rng.uniform(1, 5, B).astype(np.float32)
+    return ids.astype(np.int32), ratings
+
+
+@pytest.mark.parametrize("head", sorted(REC_HEADS))
+def test_ncf_head_forward_matches_numpy(head, rng):
+    B, users, items, D = 16, 50, 40, 20
+    model = NCFModel(users, items, D, head=head, name=f"ncf_{head}")
+    ids = ht.placeholder_op("rec_ids", (B, 2), dtype=np.int32)
+    labels = ht.placeholder_op("rec_labels", (B,))
+    mse, mae, pred = model(ids, labels)
+    ex = ht.Executor([mse, mae, pred])
+    idv, lbv = _feed(rng, model, B, users, items, D)
+    mse_v, mae_v, pred_v = ex.run(
+        feed_dict={ids: idv, labels: lbv}, convert_to_numpy_ret_vals=True)
+
+    # numpy oracle from the executor's own initialized weights
+    table = np.asarray(ex.params[model.embedding.weight.name])
+    emb = table[idv]                                   # [B, 2, D]
+
+    def lin(x, layer, act=False):
+        w = np.asarray(ex.params[layer.weight.name])
+        b = np.asarray(ex.params[layer.bias.name])
+        y = x @ w + b
+        return np.maximum(y, 0) if act else y
+
+    if head == "mf":
+        want = (emb[:, 0] * emb[:, 1]).sum(-1)
+    elif head == "gmf":
+        want = lin(emb[:, 0] * emb[:, 1], model.head.predict_layer)[:, 0]
+    elif head == "mlp":
+        h = emb.reshape(B, 2 * D)
+        for l in model.head.mlp_layers.layers:
+            h = lin(h, l, act=True)
+        want = lin(h, model.head.predict_layer)[:, 0]
+    else:  # neumf
+        f = model.head.factor_num
+        gmf = (emb[:, 0, :f] * emb[:, 1, :f])
+        h = emb[:, :, f:].reshape(B, 2 * (D - f))
+        for l in model.head.mlp_layers.layers:
+            h = lin(h, l, act=True)
+        want = lin(np.concatenate([gmf, h], -1),
+                   model.head.predict_layer)[:, 0]
+
+    np.testing.assert_allclose(pred_v, want, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(mse_v, np.mean((want - lbv) ** 2), rtol=1e-4)
+    np.testing.assert_allclose(mae_v, np.mean(np.abs(want - lbv)),
+                               rtol=1e-4)
+
+
+def test_neumf_training_curve_matches_torch(rng):
+    """8-step Adam loss-curve parity vs a hand-built torch NeuMF twin
+    (reference keeps loss-parity companions for every example family)."""
+    B, users, items, D = 32, 60, 50, 20
+    f = D // 5
+    model = NCFModel(users, items, D, head="neumf", name="ncfp")
+    ids = ht.placeholder_op("ncfp_ids", (B, 2), dtype=np.int32)
+    labels = ht.placeholder_op("ncfp_labels", (B,))
+    mse, mae, pred = model(ids, labels)
+    ex = ht.Executor([mse, ht.AdamOptimizer(1e-2).minimize(mse)])
+
+    class TorchNeuMF(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = torch.nn.Embedding(users + items, D)
+            self.mlp = torch.nn.ModuleList(
+                [torch.nn.Linear(8 * f, 4 * f),
+                 torch.nn.Linear(4 * f, 2 * f),
+                 torch.nn.Linear(2 * f, f)])
+            self.out = torch.nn.Linear(2 * f, 1)
+
+        def forward(self, idv):
+            e = self.emb(idv)                          # [B, 2, D]
+            gmf = e[:, 0, :f] * e[:, 1, :f]
+            h = e[:, :, f:].reshape(idv.shape[0], -1)
+            for l in self.mlp:
+                h = torch.relu(l(h))
+            return self.out(torch.cat([gmf, h], -1)).reshape(-1)
+
+    tm = TorchNeuMF()
+    with torch.no_grad():
+        tm.emb.weight.copy_(torch.from_numpy(
+            np.asarray(ex.params[model.embedding.weight.name])))
+        for tl, ol in zip(tm.mlp, model.head.mlp_layers.layers):
+            tl.weight.copy_(torch.from_numpy(
+                np.asarray(ex.params[ol.weight.name]).T))
+            tl.bias.copy_(torch.from_numpy(
+                np.asarray(ex.params[ol.bias.name])))
+        tm.out.weight.copy_(torch.from_numpy(
+            np.asarray(ex.params[model.head.predict_layer.weight.name]).T))
+        tm.out.bias.copy_(torch.from_numpy(
+            np.asarray(ex.params[model.head.predict_layer.bias.name])))
+    topt = torch.optim.Adam(tm.parameters(), lr=1e-2)
+
+    ours, theirs = [], []
+    for _ in range(8):
+        idv, lbv = _feed(rng, model, B, users, items, D)
+        out = ex.run(feed_dict={ids: idv, labels: lbv},
+                     convert_to_numpy_ret_vals=True)
+        ours.append(float(out[0]))
+        topt.zero_grad()
+        tl = torch.nn.functional.mse_loss(
+            tm(torch.from_numpy(idv.astype(np.int64))),
+            torch.from_numpy(lbv))
+        tl.backward()
+        topt.step()
+        theirs.append(float(tl))
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
+def test_mf_converges_on_low_rank_ratings(rng):
+    """MF recovers a rank-4 rating matrix (the convergence smoke the
+    reference runs on MovieLens, scaled down to synthetic data)."""
+    users, items, D, B = 30, 25, 8, 64
+    U = rng.standard_normal((users, 4)) * 0.8
+    V = rng.standard_normal((items, 4)) * 0.8
+    R = (U @ V.T).astype(np.float32)
+    model = NCFModel(users, items, D, head="mf", name="ncf_conv")
+    ids = ht.placeholder_op("cv_ids", (B, 2), dtype=np.int32)
+    labels = ht.placeholder_op("cv_labels", (B,))
+    mse, _, _ = model(ids, labels)
+    ex = ht.Executor([mse, ht.AdamOptimizer(5e-2).minimize(mse)])
+    losses = []
+    for _ in range(120):
+        u = rng.integers(0, users, B)
+        i = rng.integers(0, items, B)
+        idv = np.stack([u, users + i], 1).astype(np.int32)
+        out = ex.run(feed_dict={ids: idv, labels: R[u, i]},
+                     convert_to_numpy_ret_vals=True)
+        losses.append(float(out[0]))
+    assert np.mean(losses[-10:]) < 0.15 * np.mean(losses[:10])
+
+
+def test_ncf_composes_with_compressed_embedding(rng):
+    """The heads take any embedding layer — here a tensor-train
+    compressed table, the reference run_compressed.py composition."""
+    B, users, items, D = 16, 40, 30, 16
+    layer = ec.make_compressed_embedding(
+        "tt", users + items, D, compress_rate=0.5, batch_size=B,
+        num_slot=2, rng=rng)
+    model = NCFModel(users, items, D, head="mlp", embedding=layer,
+                     name="ncf_tt")
+    ids = ht.placeholder_op("tt_ids", (B, 2), dtype=np.int32)
+    labels = ht.placeholder_op("tt_labels", (B,))
+    mse, mae, pred = model(ids, labels)
+    ex = ht.Executor([mse, ht.AdamOptimizer(1e-2).minimize(mse)])
+    idv, lbv = _feed(rng, model, B, users, items, D)
+    first = None
+    for _ in range(12):
+        out = ex.run(feed_dict={ids: idv, labels: lbv},
+                     convert_to_numpy_ret_vals=True)
+        if first is None:
+            first = float(out[0])
+    assert np.isfinite(out[0]) and float(out[0]) < first
