@@ -49,15 +49,19 @@ def main():
     R = np.clip(3.0 + U @ V.T, 1.0, 5.0).astype(np.float32)
 
     embedding = None
+    # zipf-ish synthetic id frequencies; the training loop samples ids
+    # from the SAME distribution (run_compressed.py's p=freq/freq.sum()),
+    # so frequency-tiered methods (adapt/mgqe/autosrh) see the hot ids
+    # they sized their uncompressed tiers for
+    freq = (1.0 / (1 + np.arange(users + items))) ** 1.1
+    user_p = freq[:users] / freq[:users].sum()
+    item_p = freq[users:] / freq[users:].sum()
     if args.method != "full":
-        # zipf-ish synthetic id frequencies (adapt/mgqe/autosrh need them,
-        # same as run_compressed.py)
-        freq = (1.0 / (1 + np.arange(users + items))) ** 1.1
-        freq = (freq / freq.sum() * 1e6).astype(np.int64)
+        counts = (freq / freq.sum() * 1e6).astype(np.int64)
         embedding = ec.make_compressed_embedding(
             args.method, users + items, D,
             compress_rate=args.compress_rate, batch_size=B, num_slot=2,
-            frequencies=freq, rng=rng)
+            frequencies=counts, rng=rng)
     model = NCFModel(users, items, D, head=args.head, embedding=embedding)
 
     ids = ht.placeholder_op("ids", (B, 2), dtype=np.int32)
@@ -78,8 +82,8 @@ def main():
     ex = ht.Executor({"train": train_nodes})
 
     for step in range(args.steps):
-        u = rng.integers(0, users, B)
-        i = rng.integers(0, items, B)
+        u = rng.choice(users, size=B, p=user_p)
+        i = rng.choice(items, size=B, p=item_p)
         feed = {ids: np.stack([u, users + i], 1).astype(np.int32),
                 labels: R[u, i]}
         out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)

@@ -128,9 +128,19 @@ instance_normalization2d_op = simple_op(_instance_norm2d, "instance_norm2d")
 class BatchNormOp(Op):
     """BatchNorm with running-stat state (reference CudnnBn.cu keeps
     running mean/var on the op; here they are non-trainable Variables updated
-    through the trace context)."""
+    through the trace context).
 
-    def __init__(self, x, scale, bias, momentum=0.1, eps=1e-5, name=None):
+    Batch statistics use a shifted one-pass form by default (shift = the
+    running mean — a parameter, so the reductions fuse with the producing
+    conv; flax's ``use_fast_variance`` default accepts the same
+    single-read tradeoff with NO shift at all).  The shift lags the data
+    by the EMA horizon, so pathological inputs (per-channel |mean| >> std
+    before the EMA catches up) can still lose variance precision in f32;
+    ``precise_stats=True`` selects the exact two-pass mean-then-deviations
+    form (one extra read of x) for such inputs."""
+
+    def __init__(self, x, scale, bias, momentum=0.1, eps=1e-5,
+                 precise_stats=False, name=None):
         base = name or f"bn_{scale.name}"
         c = scale.shape[0] if isinstance(scale, VariableOp) else None
         assert c is not None, "BatchNorm scale must be a Variable"
@@ -142,6 +152,7 @@ class BatchNormOp(Op):
                          name=base)
         self.momentum = momentum
         self.eps = eps
+        self.precise_stats = precise_stats
 
     @property
     def is_stateful(self):
@@ -156,25 +167,37 @@ class BatchNormOp(Op):
             # masters (bf16 bindings would re-quantize them every step and
             # round small momentum updates away)
             xf = x.astype(jnp.float32)
-            # shifted one-pass stats: x is read once for both reductions
-            # (half the stats traffic of jnp.var's mean-then-deviations
-            # form), but deviations are taken against a per-channel shift
-            # (the first element) before squaring — the raw E[x^2]-E[x]^2
-            # form cancels catastrophically in f32 when |mean| >> std.
-            # mean and var are mathematically independent of the shift, so
-            # stop_gradient keeps the backward pass exact.
-            s = lax.stop_gradient(xf[:1, :, :1, :1])
-            d = xf - s
-            dmean = jnp.mean(d, axis=(0, 2, 3))
-            d2mean = jnp.mean(jnp.square(d), axis=(0, 2, 3))
-            var = jnp.maximum(d2mean - jnp.square(dmean), 0.0)
-            mean = s.reshape(-1) + dmean
             m = self.momentum
             master = ctx.master_params
             rm = (master[self.running_mean.name]
                   if master is not None else rmean).astype(jnp.float32)
             rv = (master[self.running_var.name]
                   if master is not None else rvar).astype(jnp.float32)
+            if self.precise_stats:
+                # exact two-pass mean-then-deviations (one extra read)
+                mean = jnp.mean(xf, axis=(0, 2, 3))
+                var = jnp.mean(jnp.square(
+                    xf - mean.reshape(1, -1, 1, 1)), axis=(0, 2, 3))
+            else:
+                # shifted one-pass stats: x is read once for both
+                # reductions (half the stats traffic of the two-pass
+                # form), deviations taken against a per-channel shift
+                # before squaring — the raw E[x^2]-E[x]^2 form cancels
+                # catastrophically in f32 when |mean| >> std.  The shift
+                # is the RUNNING mean: a parameter, so it fuses freely (a
+                # shift sliced from x itself costs ~7% of a ResNet-18
+                # step by blocking the reduction's fusion with the
+                # producing conv) and converges to the true mean, the
+                # optimal shift.  mean/var are mathematically
+                # shift-independent, so stop_gradient keeps the backward
+                # pass exact.  See the class docstring for the
+                # early-steps caveat and the precise_stats escape hatch.
+                s = lax.stop_gradient(rm).reshape(1, -1, 1, 1)
+                d = xf - s
+                dmean = jnp.mean(d, axis=(0, 2, 3))
+                d2mean = jnp.mean(jnp.square(d), axis=(0, 2, 3))
+                var = jnp.maximum(d2mean - jnp.square(dmean), 0.0)
+                mean = rm + dmean
             ctx.record_update(self.running_mean, (1 - m) * rm + m * mean)
             ctx.record_update(self.running_var, (1 - m) * rv + m * var)
             mean = mean.astype(x.dtype)
@@ -188,8 +211,10 @@ class BatchNormOp(Op):
         return (x - mean) * lax.rsqrt(var + self.eps) * scale + bias
 
 
-def batch_normalization_op(x, scale, bias, momentum=0.1, eps=1e-5, name=None):
-    return BatchNormOp(x, scale, bias, momentum=momentum, eps=eps, name=name)
+def batch_normalization_op(x, scale, bias, momentum=0.1, eps=1e-5,
+                           precise_stats=False, name=None):
+    return BatchNormOp(x, scale, bias, momentum=momentum, eps=eps,
+                       precise_stats=precise_stats, name=name)
 
 
 class DropoutOp(Op):

@@ -10,13 +10,17 @@ this kernel).
 TPU redesign: both directions are ROW GATHERS once the routing is known —
   dispatch: expert_in[slot]  = tokens[slot_to_token[slot]]
   combine:  out[t]          += gate_c[t] * expert_out[token_to_slot_c[t]]
-so one Pallas kernel serves both.  The gather uses
-PrefetchScalarGridSpec: the index vector is prefetched to SMEM and the
-BlockSpec index_map selects source row idx[i] for grid step i, so the
-pipeline DMAs exactly the rows needed — no one-hot, no [T, E, C]
-anywhere.  XLA's own gather lowering on TPU can fall back to one-hot
-matmul for small row counts, which would reintroduce the memory wall;
-the Pallas kernel makes the row-copy lowering deterministic.
+so one Pallas kernel serves both.  The source table stays in HBM
+(`pl.ANY` memory space) and the index vector is scalar-prefetched to
+SMEM; each grid step DMAs its 8 arbitrary source rows into a VMEM
+scratch (8 parallel `make_async_copy`s) and writes the masked block out
+— exactly the rows needed move, no one-hot, no [T, E, C] anywhere.
+(A BlockSpec index_map gather with (1, H) blocks is rejected by Mosaic:
+the sublane dim of a block must be divisible by 8, and one index_map
+can't pick 8 unrelated rows — hence the explicit-DMA form.)  XLA's own
+gather lowering on TPU can fall back to one-hot matmul for small row
+counts, which would reintroduce the memory wall; the Pallas kernel
+makes the row-copy lowering deterministic.
 
 Out-of-range indices (capacity-dropped tokens, empty slots) yield zero
 rows, matching the dense path's zero dispatch rows.
@@ -41,18 +45,43 @@ def _supported(src_shape, dtype):
     return dtype in (jnp.float32, jnp.bfloat16, np.float32)
 
 
+_BLK = 8  # output rows per grid step = the TPU sublane quantum
+
+
 def _make_kernel():
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    def kernel(n_rows, idx_ref, src_ref, out_ref):
-        i = pl.program_id(0)
-        j = idx_ref[i]
-        # the index_map already clamped the DMA'd block; here we zero
-        # rows whose logical index was out of range on EITHER side (the
-        # contract — and the jnp fallback — zero-fill both)
-        valid = (j >= 0) & (j < n_rows)
-        out_ref[...] = jnp.where(valid, src_ref[...],
-                                 jnp.zeros_like(src_ref))
+    def kernel(n_rows, idx_ref, src_hbm, out_ref, scratch, sems):
+        # scratch is [_BLK, 1, h]: the DMA'd dim must sit OUTSIDE the
+        # (8, 128)-tiled trailing pair — a 1-row slice of a 2-D VMEM
+        # buffer is not a legal DMA target ("slice along dimension 0
+        # must be aligned to tiling (8)")
+        b = pl.program_id(0)
+        copies = []
+        for k in range(_BLK):
+            j = idx_ref[b * _BLK + k]
+            jc = jnp.clip(j, 0, n_rows - 1)
+            # src arrives as [n, 1, h] so the gathered dim is untiled on
+            # the source side too (ANY may resolve to VMEM for small
+            # tables, where a 1-row slice of a tiled dim is illegal)
+            c = pltpu.make_async_copy(src_hbm.at[jc],
+                                      scratch.at[k],
+                                      sems.at[k])
+            c.start()
+            copies.append(c)
+        for c in copies:
+            c.wait()
+        # zero rows whose logical index was out of range (the contract —
+        # and the jnp fallback — zero-fill both sides)
+        idxs = jnp.stack([idx_ref[b * _BLK + k] for k in range(_BLK)])
+        # expand the minor dim while still i32 (Mosaic rejects the
+        # equivalent reshape on an i1 vector), then compare
+        idxs2 = idxs[:, None]
+        valid = (idxs2 >= 0) & (idxs2 < n_rows)
+        out_ref[...] = jnp.where(valid, scratch[:, 0, :],
+                                 jnp.zeros((_BLK, scratch.shape[2]),
+                                           scratch.dtype))
     return kernel
 
 
@@ -78,19 +107,26 @@ def _row_gather_fwd_impl(src, idx, use_pallas=True):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # pad the index vector to a whole number of 8-row blocks; the pad
+    # rows carry the invalid sentinel and come out zero
+    m_pad = (m + _BLK - 1) // _BLK * _BLK
+    idx_p = jnp.full((m_pad,), -1, jnp.int32).at[:m].set(
+        idx.astype(jnp.int32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(m,),
-        in_specs=[pl.BlockSpec(
-            (1, h), lambda i, idx_ref: (jnp.clip(idx_ref[i], 0, n - 1), 0))],
-        out_specs=pl.BlockSpec((1, h), lambda i, idx_ref: (i, 0)),
+        grid=(m_pad // _BLK,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((_BLK, h), lambda b, idx_ref: (b, 0)),
+        scratch_shapes=[pltpu.VMEM((_BLK, 1, h), src.dtype),
+                        pltpu.SemaphoreType.DMA((_BLK,))],
     )
     import functools as _ft
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _ft.partial(_make_kernel(), n),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((m, h), src.dtype),
-    )(idx.astype(jnp.int32), src)
+        out_shape=jax.ShapeDtypeStruct((m_pad, h), src.dtype),
+    )(idx_p, src[:, None, :])
+    return out[:m] if m_pad != m else out
 
 
 def _row_gather_fwd(src, idx, use_pallas):

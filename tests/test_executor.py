@@ -361,6 +361,45 @@ def test_batchnorm_state_updates():
     np.testing.assert_allclose(rm, 0.1 * X.mean(axis=(0, 2, 3)), rtol=1e-4)
 
 
+def test_batchnorm_precise_stats_survives_huge_mean():
+    """precise_stats=True keeps the f32 variance exact when
+    |mean| >> std — the case where one-pass E[d^2]-E[d]^2 with the
+    (zero-initialized) running-mean shift cancels catastrophically."""
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal((8, 3, 4, 4)).astype(np.float32)
+    X = base + 1e4  # per-channel mean ~1e4, std ~1
+    outs = {}
+    for precise in (False, True):
+        with ht.name_scope():
+            x = ht.placeholder_op("pbn_x", X.shape)
+            scale = ht.Variable("pbn_scale", value=np.ones(3, np.float32))
+            bias = ht.Variable("pbn_bias", value=np.zeros(3, np.float32))
+            y = ht.batch_normalization_op(x, scale, bias,
+                                          precise_stats=precise)
+            train_op = ht.SGDOptimizer(learning_rate=0.0).minimize(
+                ht.reduce_mean_op(y))
+            ex = ht.Executor({"train": [y, train_op]})
+        outs[precise] = ex.run("train", feed_dict={x: X},
+                               convert_to_numpy_ret_vals=True)[0]
+        # running_var starts at ones: rv = 0.9*1 + 0.1*var after one step
+        var = (np.asarray(ex.params[y.running_var.name]) - 0.9) / 0.1
+        if precise:
+            # exact two-pass form: variance stays correct (~1), so the
+            # normalized output matches the f64 oracle
+            want = (X.astype(np.float64)
+                    - X.astype(np.float64).mean((0, 2, 3), keepdims=True))
+            want /= np.sqrt(
+                X.astype(np.float64).var((0, 2, 3), keepdims=True) + 1e-5)
+            np.testing.assert_allclose(outs[True], want, atol=1e-2)
+            np.testing.assert_allclose(
+                var, X.astype(np.float64).var((0, 2, 3)), rtol=1e-3)
+        else:
+            # the fast default genuinely loses precision here (documents
+            # the tradeoff this test's sibling path exists to fix)
+            assert not np.allclose(
+                var, X.astype(np.float64).var((0, 2, 3)), rtol=0.2)
+
+
 def test_cost_analysis_reports_flops():
     X = np.random.default_rng(0).standard_normal((32, 16)).astype(np.float32)
     x = ht.placeholder_op("ca_x", X.shape)
