@@ -436,48 +436,19 @@ def bench_wdl_ps(quick):
     display.  Baseline: the flax in-graph W&D at the same shapes (the
     table fits HBM here; the PS path exists for when it doesn't — the
     ratio shows what the HET cache recovers of the in-graph speed)."""
-    import hetu_tpu as ht
-    from hetu_tpu.models.ctr import WDL
-    from hetu_tpu.ps import PSEmbedding
-
     B, steps = (32, 5) if quick else (128, 30)
     rows = 1000 if quick else 337000
     rng = np.random.default_rng(0)
-    ps_emb = PSEmbedding(rows, 16, optimizer="sgd", lr=0.01,
-                         cache_limit=max(64, rows // 10), policy="lfu",
-                         stale_reads=True, push_bound=2)
-    dense = ht.placeholder_op("wps_dense", (B, 13))
-    sparse = ht.placeholder_op("wps_sparse", (B, 26), dtype=np.int32)
-    labels = ht.placeholder_op("wps_labels", (B,))
-    model = WDL(rows, embedding_dim=16, ps_embedding=ps_emb)
-    loss = model.loss(dense, sparse, labels)
-    ex = ht.Executor(
-        {"train": [loss, ht.AdamOptimizer(0.01).minimize(loss)]})
-
-    import jax.numpy as jnp
-
-    def zipf_ids(shape):
-        z = rng.zipf(1.2, size=shape)
-        return ((z - 1) % rows).astype(np.int32)
-
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from ps_harness import build_wdl_ps, time_steps, zipf_feeds
+    ex, ps_emb, ph = build_wdl_ps(rows, 16, B, 26, optimizer="sgd",
+                                  lr=0.01, name_prefix="wps")
     # dense/labels device-resident like every other stage (a per-step
     # host upload times the tunnel, not the chip); only the sparse ids
     # stay host-visible — the PS lookup runs on the host by design
-    feeds = [{dense: jnp.asarray(rng.standard_normal((B, 13)),
-                                 jnp.float32),
-              sparse: zipf_ids((B, 26)),
-              labels: jnp.asarray(rng.integers(0, 2, (B,)), jnp.float32)}
-             for _ in range(8)]
-    out = ex.run("train", feed_dict=feeds[0],
-                 convert_to_numpy_ret_vals=True)
-    assert np.isfinite(out[0])
-    i = [0]
-
-    def step():
-        i[0] += 1
-        return ex.run("train", feed_dict=feeds[i[0] % len(feeds)])
-
-    dt, _ = _timeit(step, steps)
+    feeds = zipf_feeds(rng, rows, B, 26, ph)
+    dt = time_steps(ex, feeds, steps)
     ours = 1.0 / dt
     stats = ps_emb.stats()
 

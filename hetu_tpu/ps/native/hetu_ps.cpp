@@ -371,7 +371,15 @@ void ps_table_lookup(int64_t h, const int64_t* keys, int64_t n, float* out) {
   Table* t = table_of(h);
   if (!t) return;
   parallel_for(n, 1 << 12, [&](int64_t lo, int64_t hi) {
+    constexpr int64_t kAhead = 8;  // software prefetch distance: random
+    // rows of a multi-GB table are DRAM-latency-bound (measured 0.63x
+    // throughput at 28 GB vs 3 GB working sets before prefetching)
     for (int64_t i = lo; i < hi; ++i) {
+      if (i + kAhead < hi) {
+        int64_t pk = keys[i + kAhead];
+        if (pk >= 0 && pk < t->rows)
+          __builtin_prefetch(t->data.data() + pk * t->dim, 0, 1);
+      }
       int64_t k = keys[i];
       if (k < 0 || k >= t->rows) {  // pad ids read as zero rows
         std::memset(out + i * t->dim, 0, sizeof(float) * t->dim);
@@ -399,7 +407,18 @@ void ps_table_push(int64_t h, const int64_t* keys, const float* grads,
   Table* t = table_of(h);
   if (!t) return;
   parallel_for(n, 1 << 12, [&](int64_t lo, int64_t hi) {
+    constexpr int64_t kAhead = 8;
     for (int64_t i = lo; i < hi; ++i) {
+      if (i + kAhead < hi) {
+        int64_t pk = keys[i + kAhead];
+        if (pk >= 0 && pk < t->rows) {
+          __builtin_prefetch(t->data.data() + pk * t->dim, 1, 1);
+          if (!t->slot1.empty())
+            __builtin_prefetch(t->slot1.data() + pk * t->dim, 1, 1);
+          if (!t->slot2.empty())
+            __builtin_prefetch(t->slot2.data() + pk * t->dim, 1, 1);
+        }
+      }
       int64_t k = keys[i];
       // skip padded slots from fixed-size dedup buffers + out-of-range ids
       if (k < 0 || k >= t->rows) continue;

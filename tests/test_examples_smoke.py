@@ -51,6 +51,18 @@ def test_ncf_example_smoke():
     assert "mse" in r.stdout and "mae" in r.stdout
 
 
+def test_ps_scale_bench_smoke():
+    """The HET-at-scale sweep runs end-to-end (small tables) and reports
+    per-size steps/s + the in-graph feasibility arithmetic."""
+    r = _run(["benchmarks/ps_scale_bench.py", "--quick", "--steps", "5"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    import json
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert len(out["per_table"]) == 2
+    assert all(p["steps_per_sec"] > 0 for p in out["per_table"])
+    assert out["in_graph_feasible_at_largest"] is True  # quick sizes fit
+
+
 def test_ctr_sparse_opt_example_smoke():
     """train_ctr --sparse-opt (lazy in-graph table updates) runs."""
     r = _run(["examples/ctr/train_ctr.py", "--model", "wdl", "--steps",
