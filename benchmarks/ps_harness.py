@@ -36,7 +36,7 @@ def build_wdl_ps(rows, dim, batch, fields, optimizer="sgd", lr=0.01,
                     ps_embedding=ps_emb)
         loss = model.loss(dense, sparse, labels)
         ex = ht.Executor(
-            {"train": [loss, ht.AdamOptimizer(1e-2).minimize(loss)]})
+            {"train": [loss, ht.AdamOptimizer(lr).minimize(loss)]})
     return ex, ps_emb, (dense, sparse, labels)
 
 

@@ -56,13 +56,20 @@ class MultiHeadAttention(BaseLayer):
             x, output_shape=(-1, seq_len, n_heads, self.head_dim))
         return transpose_op(x, perm=(0, 2, 1, 3))
 
-    def __call__(self, query, key, value, attention_mask=None, seq_len=None):
-        """Returns [B, S, H]."""
+    def __call__(self, query, key, value, attention_mask=None, seq_len=None,
+                 kv_seq_len=None):
+        """Returns [B, S, H].  ``kv_seq_len`` (default: ``seq_len``)
+        supports cross-attention over a memory of different length
+        (reference examples/nlp/hetu_transformer.py multihead_attention,
+        decoder side)."""
         seq_len = seq_len or self.sequence_length
         assert seq_len is not None, "sequence length required"
+        kv_seq_len = kv_seq_len or seq_len
         q = self._split_heads(self.q_proj(query), seq_len, self.num_heads)
-        k = self._split_heads(self.k_proj(key), seq_len, self.num_kv_heads)
-        v = self._split_heads(self.v_proj(value), seq_len, self.num_kv_heads)
+        k = self._split_heads(self.k_proj(key), kv_seq_len,
+                              self.num_kv_heads)
+        v = self._split_heads(self.v_proj(value), kv_seq_len,
+                              self.num_kv_heads)
         if self.rope_theta is not None:
             q = rotary_embedding_op(q, theta=self.rope_theta)
             k = rotary_embedding_op(k, theta=self.rope_theta)

@@ -15,3 +15,5 @@ from .zoo import (LogReg, CNN3, AlexNet, VGG, vgg16, vgg19,
                   RNNClassifier, LSTMClassifier)
 from .rec import (RatingModelHead, MFHead, GMFHead, MLPHead, NeuMFHead,
                   NCFModel, REC_HEADS)
+from .transformer import (TransformerConfig, Seq2SeqTransformer,
+                          sinusoidal_positions)

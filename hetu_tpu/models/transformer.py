@@ -1,0 +1,158 @@
+"""Seq2seq Transformer (encoder-decoder with cross-attention).
+
+Reference: examples/nlp/hetu_transformer.py — `Transformer` with
+`encode` (self-attn blocks over the source), `decode` (causal self-attn
++ vanilla cross-attention over the encoder memory, embeddings shared
+and tied to the output projection, both scaled by sqrt(d_model),
+sinusoidal positions) and `train` (label-smoothed softmax CE);
+hparams.py for the defaults (d_model 512, 6 blocks, 8 heads, eps 0.1).
+
+TPU notes: positions are a precomputed constant table (host numpy →
+device once); the loss masks pad positions like the reference's TF
+companion (`tf_transformer.py` nonpadding) — the reference's hetu
+variant averages pads in, which just rescales the loss by a constant
+factor at fixed pad ratio.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import initializers as init
+from ..graph.node import VariableOp, name_scope
+from ..layers import (LayerNorm, MultiHeadAttention, TransformerFFN,
+                      TransformerLayer)
+from ..ops import (array_reshape_op, dropout_op, embedding_lookup_op,
+                   matmul_op, mul_op, one_hot_op, reduce_sum_op,
+                   softmax_cross_entropy_op)
+
+
+def sinusoidal_positions(max_len, d_model):
+    """The standard sin/cos table (reference positional_encoding,
+    hetu_transformer.py:161)."""
+    pos = np.arange(max_len)[:, None].astype(np.float64)
+    i = np.arange(d_model)[None, :]
+    angle = pos / np.power(10000.0, (2 * (i // 2)) / d_model)
+    table = np.where(i % 2 == 0, np.sin(angle), np.cos(angle))
+    return table.astype(np.float32)
+
+
+class TransformerConfig:
+    def __init__(self, vocab_size=8000, d_model=128, num_blocks=2,
+                 num_heads=8, d_ff=512, src_len=32, tgt_len=32,
+                 dropout_rate=0.1, label_smoothing=0.1, pad_id=0):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.num_blocks = num_blocks
+        self.num_heads = num_heads
+        self.d_ff = d_ff
+        self.src_len = src_len
+        self.tgt_len = tgt_len
+        self.dropout_rate = dropout_rate
+        self.label_smoothing = label_smoothing
+        self.pad_id = pad_id
+
+
+class _DecoderBlock:
+    def __init__(self, c, name):
+        self.self_attn = MultiHeadAttention(c.d_model, c.num_heads,
+                                            dropout_rate=c.dropout_rate,
+                                            causal_mask=True,
+                                            name=f"{name}_self")
+        self.cross_attn = MultiHeadAttention(c.d_model, c.num_heads,
+                                             dropout_rate=c.dropout_rate,
+                                             name=f"{name}_cross")
+        self.ffn = TransformerFFN(c.d_model, c.d_ff,
+                                  dropout_rate=c.dropout_rate,
+                                  name=f"{name}_ffn")
+        self.ln1 = LayerNorm(c.d_model, name=f"{name}_ln1")
+        self.ln2 = LayerNorm(c.d_model, name=f"{name}_ln2")
+        self.ln3 = LayerNorm(c.d_model, name=f"{name}_ln3")
+
+    def __call__(self, x, memory, tgt_mask, src_mask, tgt_len, src_len):
+        x = self.ln1(x + self.self_attn(x, x, x, attention_mask=tgt_mask,
+                                        seq_len=tgt_len))
+        x = self.ln2(x + self.cross_attn(x, memory, memory,
+                                         attention_mask=src_mask,
+                                         seq_len=tgt_len,
+                                         kv_seq_len=src_len))
+        return self.ln3(x + self.ffn(x))
+
+
+def _pad_bias(keep_f32, seq_len):
+    """[B, S] 0/1 keep-mask (float) -> additive [B, 1, 1, S] bias
+    (0 where kept, -1e9 at pads; reference src_masks/attention_mask)."""
+    keep = array_reshape_op(keep_f32, output_shape=(-1, 1, 1, seq_len))
+    return (keep - 1.0) * 1e9
+
+
+class Seq2SeqTransformer:
+    """Reference Transformer (hetu_transformer.py:186): shared scaled
+    embeddings, sinusoidal positions, post-LN blocks, tied LM head."""
+
+    def __init__(self, config, name="transformer"):
+        c = self.config = config
+        with name_scope():
+            self.embeddings = VariableOp(
+                f"{name}_embeddings", (c.vocab_size, c.d_model),
+                init.xavier_normal())
+            max_len = max(c.src_len, c.tgt_len)
+            self.pos_table = VariableOp(
+                f"{name}_positions", (max_len, c.d_model),
+                init.NumpyInit(sinusoidal_positions(max_len, c.d_model)),
+                trainable=False)
+            # post-LN encoder block ≡ the shared TransformerLayer
+            self.enc = [TransformerLayer(
+                c.d_model, c.num_heads, c.d_ff,
+                dropout_rate=c.dropout_rate,
+                attn_dropout_rate=c.dropout_rate,
+                name=f"{name}_enc{i}") for i in range(c.num_blocks)]
+            self.dec = [_DecoderBlock(c, f"{name}_dec{i}")
+                        for i in range(c.num_blocks)]
+
+    def _embed(self, ids, seq_len):
+        c = self.config
+        from .bert import PositionIdsOp
+        e = embedding_lookup_op(self.embeddings, ids) * (c.d_model ** 0.5)
+        e = e + PositionIdsOp(self.pos_table, e, seq_len)
+        if c.dropout_rate:
+            e = dropout_op(e, keep_prob=1.0 - c.dropout_rate)
+        return e
+
+    def encode(self, src_ids, src_keep):
+        c = self.config
+        x = self._embed(src_ids, c.src_len)
+        mask = _pad_bias(src_keep, c.src_len)
+        for blk in self.enc:
+            x = blk(x, attention_mask=mask, seq_len=c.src_len)
+        return x
+
+    def decode(self, tgt_in_ids, memory, src_keep, tgt_keep):
+        c = self.config
+        x = self._embed(tgt_in_ids, c.tgt_len)
+        tgt_mask = _pad_bias(tgt_keep, c.tgt_len)
+        src_mask = _pad_bias(src_keep, c.src_len)
+        for blk in self.dec:
+            x = blk(x, memory, tgt_mask, src_mask, c.tgt_len, c.src_len)
+        flat = array_reshape_op(x, output_shape=(-1, c.d_model))
+        logits = matmul_op(flat, self.embeddings, trans_B=True)
+        return array_reshape_op(
+            logits, output_shape=(-1, c.tgt_len, c.vocab_size))
+
+    def __call__(self, src_ids, tgt_in_ids, src_keep, tgt_keep):
+        memory = self.encode(src_ids, src_keep)
+        return self.decode(tgt_in_ids, memory, src_keep, tgt_keep)
+
+    def loss(self, src_ids, tgt_in_ids, tgt_out_ids, src_keep, tgt_keep):
+        """Label-smoothed CE over non-pad target positions (reference
+        train() + label_smoothing, with the TF companion's nonpadding
+        normalization)."""
+        c = self.config
+        logits = self(src_ids, tgt_in_ids, src_keep, tgt_keep)
+        onehot = one_hot_op(tgt_out_ids, num_classes=c.vocab_size)
+        eps = c.label_smoothing
+        smoothed = onehot * (1.0 - eps) + eps / c.vocab_size
+        ce = softmax_cross_entropy_op(logits, smoothed)  # [B, T]
+        ce = mul_op(ce, tgt_keep)
+        denom = reduce_sum_op(tgt_keep) + 1e-7
+        return reduce_sum_op(ce) / denom
