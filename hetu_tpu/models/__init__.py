@@ -17,3 +17,4 @@ from .rec import (RatingModelHead, MFHead, GMFHead, MLPHead, NeuMFHead,
                   NCFModel, REC_HEADS)
 from .transformer import (TransformerConfig, Seq2SeqTransformer,
                           sinusoidal_positions)
+from .transformer_decode import build_seq2seq_decode, seq2seq_generate

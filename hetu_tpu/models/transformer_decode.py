@@ -1,0 +1,184 @@
+"""KV-cache greedy/sampled decoding for the seq2seq Transformer.
+
+One jitted program: full encoder pass + per-layer cross-attention K/V
+precomputed from the memory, then a `lax.scan` over decode steps with a
+scan-carried self-attention cache — the same deployment story the
+GPT/Llama tiers have (models/gpt_decode.py), extended with the
+encoder-memory plumbing.  The reference's transformer has no decoding
+path (training example only, examples/nlp/train_hetu_transformer.py) —
+this goes beyond it the way llama_decode does.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ._decode_common import make_attend, make_picker
+
+
+def _ln(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b)
+
+
+def build_seq2seq_decode(config, max_new, name="transformer",
+                         temperature=0.0, top_k=0, bos_id=1):
+    """Returns jitted ``fn(params, src_ids [B, S], src_keep [B, S]
+    [, key]) -> [B, max_new]`` generated target tokens."""
+    c = config
+    h = c.num_heads
+    hd = c.d_model // h
+    pos_rows = max(c.src_len, c.tgt_len)
+    if max_new > pos_rows:
+        # dynamic_slice clamps out-of-range starts, which would silently
+        # reuse the last position row for every token past the table
+        raise ValueError(
+            f"max_new={max_new} exceeds the positional table "
+            f"({pos_rows} rows = max(src_len, tgt_len)); build the model "
+            f"with a longer tgt_len to decode further")
+
+    def attn_params(params, prefix):
+        return {k: params[f"{prefix}_{v}"] for k, v in {
+            "wq": "q_weight", "bq": "q_bias", "wk": "k_weight",
+            "bk": "k_bias", "wv": "v_weight", "bv": "v_bias",
+            "wo": "out_weight", "bo": "out_bias"}.items()}
+
+    def split(x, n_seq):
+        return x.reshape(-1, n_seq, h, hd).transpose(0, 2, 1, 3)
+
+    attend = make_attend(hd)          # self-attention (shared [Sq, T] mask)
+    pick = make_picker(temperature, top_k)
+
+    def cross_attend(q, keys, vals, src_keep):
+        """q [B,h,1,d] vs memory K/V [B,h,S,d] with per-batch pad bias."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
+                       preferred_element_type=jnp.float32) / np.sqrt(hd)
+        s = s + ((src_keep - 1.0) * 1e9)[:, None, None, :]
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(vals.dtype), vals,
+                          preferred_element_type=jnp.float32
+                          ).astype(vals.dtype)
+
+    @jax.jit
+    def decode(params, src_ids, src_keep, key=None):
+        if key is None:
+            key = jax.random.key(0)
+        emb = params[f"{name}_embeddings"]
+        pos = params[f"{name}_positions"]
+        b, s_len = src_ids.shape
+        scale = c.d_model ** 0.5
+        sbias = ((src_keep - 1.0) * 1e9)[:, None, None, :]
+
+        # ---- encoder (post-LN TransformerLayer semantics) ----
+        x = emb[src_ids] * scale + pos[None, :s_len]
+        for i in range(c.num_blocks):
+            p = f"{name}_enc{i}"
+            ap = attn_params(params, f"{p}_attn")
+            q = split(x @ ap["wq"] + ap["bq"], s_len)
+            k = split(x @ ap["wk"] + ap["bk"], s_len)
+            v = split(x @ ap["wv"] + ap["bv"], s_len)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) \
+                / np.sqrt(hd) + sbias
+            o = jnp.einsum("bhqk,bhkd->bhqd",
+                           jax.nn.softmax(s, -1).astype(v.dtype), v,
+                           preferred_element_type=jnp.float32
+                           ).astype(v.dtype)
+            o = o.transpose(0, 2, 1, 3).reshape(b, s_len, c.d_model)
+            x = _ln(x + o @ ap["wo"] + ap["bo"],
+                    params[f"{p}_ln1_scale"], params[f"{p}_ln1_bias"])
+            f = jax.nn.gelu(x @ params[f"{p}_ffn_in_weight"]
+                            + params[f"{p}_ffn_in_bias"])
+            x = _ln(x + f @ params[f"{p}_ffn_out_weight"]
+                    + params[f"{p}_ffn_out_bias"],
+                    params[f"{p}_ln2_scale"], params[f"{p}_ln2_bias"])
+        memory = x
+
+        # ---- per-layer decoder params + cross K/V computed once ----
+        dec_ps, cross_kv = [], []
+        for i in range(c.num_blocks):
+            p = f"{name}_dec{i}"
+            sp = attn_params(params, f"{p}_self")
+            cp = attn_params(params, f"{p}_cross")
+            dec_ps.append((p, sp, cp))
+            cross_kv.append((split(memory @ cp["wk"] + cp["bk"], s_len),
+                             split(memory @ cp["wv"] + cp["bv"], s_len)))
+
+        def dec_step(tok, caches, t):
+            """One decoder position: tok [B, 1] at absolute position t."""
+            x = emb[tok] * scale + jax.lax.dynamic_slice_in_dim(
+                pos, t, 1, 0)[None]
+            self_mask = (jnp.arange(max_new) <= t)[None, :]
+            new_caches = []
+            for (p, sp, cp), (ck_x, cv_x), (ck, cv) in zip(
+                    dec_ps, cross_kv, caches):
+                q = split(x @ sp["wq"] + sp["bq"], 1)
+                k1 = split(x @ sp["wk"] + sp["bk"], 1)
+                v1 = split(x @ sp["wv"] + sp["bv"], 1)
+                ck = jax.lax.dynamic_update_slice_in_dim(ck, k1, t,
+                                                         axis=2)
+                cv = jax.lax.dynamic_update_slice_in_dim(cv, v1, t,
+                                                         axis=2)
+                o = attend(q, ck, cv, self_mask)
+                o = o.transpose(0, 2, 1, 3).reshape(-1, 1, c.d_model)
+                x = _ln(x + o @ sp["wo"] + sp["bo"],
+                        params[f"{p}_ln1_scale"],
+                        params[f"{p}_ln1_bias"])
+                qc = split(x @ cp["wq"] + cp["bq"], 1)
+                oc = cross_attend(qc, ck_x, cv_x, src_keep)
+                oc = oc.transpose(0, 2, 1, 3).reshape(-1, 1, c.d_model)
+                x = _ln(x + oc @ cp["wo"] + cp["bo"],
+                        params[f"{p}_ln2_scale"],
+                        params[f"{p}_ln2_bias"])
+                f = jax.nn.gelu(x @ params[f"{p}_ffn_in_weight"]
+                                + params[f"{p}_ffn_in_bias"])
+                x = _ln(x + f @ params[f"{p}_ffn_out_weight"]
+                        + params[f"{p}_ffn_out_bias"],
+                        params[f"{p}_ln3_scale"],
+                        params[f"{p}_ln3_bias"])
+                new_caches.append((ck, cv))
+            return x @ emb.T, new_caches
+
+        kshape = (b, h, max_new, hd)
+        caches0 = [(jnp.zeros(kshape, emb.dtype),
+                    jnp.zeros(kshape, emb.dtype))
+                   for _ in range(c.num_blocks)]
+        bos = jnp.full((b, 1), bos_id, src_ids.dtype)
+        key, k0 = jax.random.split(key)
+        logits, caches = dec_step(bos, caches0, 0)
+        first = pick(logits[:, -1, :], k0).astype(src_ids.dtype)[:, None]
+
+        def step(carry, t):
+            tok, caches, key = carry
+            key, kt = jax.random.split(key)
+            logits, caches = dec_step(tok, caches, t + 1)
+            nxt = pick(logits[:, -1, :], kt).astype(tok.dtype)[:, None]
+            return (nxt, caches, key), tok[:, 0]
+
+        if max_new == 1:
+            return first
+        (last, _, _), toks = jax.lax.scan(
+            step, (first, caches, key), jnp.arange(max_new - 1))
+        return jnp.concatenate([toks.transpose(1, 0), last], axis=1)
+
+    return decode
+
+
+def seq2seq_generate(executor, model, src_ids, src_keep, max_new,
+                     name=None, temperature=0.0, top_k=0,
+                     bos_id=1, seed=0):
+    if name is None:
+        # infer the param prefix from the model (llama_decode convention)
+        name = model.embeddings.name.rsplit("_embeddings", 1)[0]
+    fn = build_seq2seq_decode(model.config, max_new, name=name,
+                              temperature=temperature, top_k=top_k,
+                              bos_id=bos_id)
+    return np.asarray(fn(executor.params,
+                         jnp.asarray(src_ids, jnp.int32),
+                         jnp.asarray(src_keep, jnp.float32),
+                         jax.random.key(seed)))

@@ -160,6 +160,42 @@ def test_onnx_bytes_roundtrip_causal_gpt(rng):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+def test_onnx_bytes_roundtrip_seq2seq(rng):
+    """Encoder-decoder Transformer through ModelProto bytes: cross-
+    attention (different q/kv lengths), pad-mask bias arithmetic, tied
+    head — all standard opset ops (reference tests/onnx round-trips its
+    transformer examples the same way)."""
+    from hetu_tpu.models import Seq2SeqTransformer, TransformerConfig
+    c = TransformerConfig(vocab_size=40, d_model=16, num_blocks=1,
+                          num_heads=2, d_ff=32, src_len=10, tgt_len=6,
+                          dropout_rate=0.0)
+    model = Seq2SeqTransformer(c, name="s2sx")
+    B = 2
+    src = ht.placeholder_op("s2sx_src", (B, c.src_len), dtype=np.int32)
+    tin = ht.placeholder_op("s2sx_tin", (B, c.tgt_len), dtype=np.int32)
+    skeep = ht.placeholder_op("s2sx_skeep", (B, c.src_len))
+    tkeep = ht.placeholder_op("s2sx_tkeep", (B, c.tgt_len))
+    logits = model(src, tin, skeep, tkeep)
+    ex = ht.Executor({"inference": [logits]})
+    model_pb = hx.deserialize_model(
+        hx.serialize_model(hx.hetu2onnx([logits], ex.params)))
+    ph, outs = hx.onnx2hetu(model_pb)
+    ex2 = ht.Executor({"inference": outs})
+    sv = rng.integers(1, 40, (B, c.src_len))
+    tv = rng.integers(1, 40, (B, c.tgt_len))
+    sk = np.ones((B, c.src_len), np.float32)
+    sk[:, -2:] = 0.0
+    tk = np.ones((B, c.tgt_len), np.float32)
+    feed = {src: sv, tin: tv, skeep: sk, tkeep: tk}
+    want = ex.run("inference", feed_dict=feed,
+                  convert_to_numpy_ret_vals=True)[0]
+    got = ex2.run("inference", feed_dict={
+        ph["s2sx_src"]: sv, ph["s2sx_tin"]: tv,
+        ph["s2sx_skeep"]: sk, ph["s2sx_tkeep"]: tk},
+        convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
 def test_onnx_bytes_roundtrip_llama(rng):
     """Llama tier through ModelProto bytes: RMSNorm, RoPE (constant
     cos/sin tables + Slice/Neg/Concat rotation), GQA repeat_kv

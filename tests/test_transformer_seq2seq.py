@@ -211,6 +211,47 @@ def test_seq2seq_learns_copy_task(rng):
     assert losses[-1] < 0.25 * losses[0], (losses[0], losses[-1])
 
 
+def test_seq2seq_greedy_decode_matches_iterative_oracle(rng):
+    """The KV-cache decoder must emit token-for-token what iterative
+    re-evaluation of the training graph emits (cache correctness incl.
+    cross-attention over a padded source)."""
+    from hetu_tpu.models.transformer_decode import seq2seq_generate
+    c = TransformerConfig(vocab_size=40, d_model=32, num_blocks=2,
+                          num_heads=4, d_ff=64, src_len=10, tgt_len=8,
+                          dropout_rate=0.0)
+    B, max_new = 3, 8
+    model = Seq2SeqTransformer(c, name="transformer")
+    src = ht.placeholder_op("g_src", (B, c.src_len), dtype=np.int32)
+    tin = ht.placeholder_op("g_tin", (B, c.tgt_len), dtype=np.int32)
+    skeep = ht.placeholder_op("g_skeep", (B, c.src_len))
+    tkeep = ht.placeholder_op("g_tkeep", (B, c.tgt_len))
+    logits = model(src, tin, skeep, tkeep)
+    ex = ht.Executor({"inference": [logits]})
+
+    sv = rng.integers(2, 40, (B, c.src_len)).astype(np.int32)
+    sk = np.ones((B, c.src_len), np.float32)
+    sk[0, -3:] = 0.0   # one padded source row
+    sv[0, -3:] = 0
+
+    # oracle: greedy decode by re-running the full graph per step
+    cur = np.zeros((B, c.tgt_len), np.int64)
+    cur[:, 0] = 1      # BOS
+    out_tokens = []
+    for t in range(max_new):
+        lg = ex.run("inference", feed_dict={
+            src: sv, tin: cur, skeep: sk,
+            tkeep: np.ones((B, c.tgt_len), np.float32)},
+            convert_to_numpy_ret_vals=True)[0]
+        nxt = lg[:, t].argmax(-1)
+        out_tokens.append(nxt)
+        if t + 1 < c.tgt_len:
+            cur[:, t + 1] = nxt
+    want = np.stack(out_tokens, axis=1)
+
+    got = seq2seq_generate(ex, model, sv, sk, max_new)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_cross_attention_different_lengths(rng):
     """src_len != tgt_len exercises the kv_seq_len path."""
     c = TransformerConfig(vocab_size=30, d_model=16, num_blocks=1,
