@@ -8,6 +8,14 @@ import jax
 import jax.numpy as jnp
 
 
+def layer_norm(x, g, b, eps=1e-5):
+    """fp32-moments LayerNorm shared by the hand-written decoders."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b)
+
+
 def make_picker(temperature, top_k):
     """Token selection for decode: greedy argmax at temperature<=0, else
     categorical over softmax(logits/temperature) restricted to the top_k

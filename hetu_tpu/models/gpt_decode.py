@@ -18,14 +18,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ._decode_common import layer_norm as _ln
 from ._decode_common import make_picker, make_attend, assemble
-
-
-def _ln(x, g, b, eps=1e-5):
-    xf = x.astype(jnp.float32)
-    mu = jnp.mean(xf, -1, keepdims=True)
-    var = jnp.var(xf, -1, keepdims=True)
-    return (((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b)
 
 
 def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,

@@ -16,14 +16,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ._decode_common import layer_norm as _ln
 from ._decode_common import make_attend, make_picker
-
-
-def _ln(x, g, b, eps=1e-5):
-    xf = x.astype(jnp.float32)
-    mu = jnp.mean(xf, -1, keepdims=True)
-    var = jnp.var(xf, -1, keepdims=True)
-    return (((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b)
 
 
 def build_seq2seq_decode(config, max_new, name="transformer",
@@ -33,13 +27,12 @@ def build_seq2seq_decode(config, max_new, name="transformer",
     c = config
     h = c.num_heads
     hd = c.d_model // h
-    pos_rows = max(c.src_len, c.tgt_len)
-    if max_new > pos_rows:
-        # dynamic_slice clamps out-of-range starts, which would silently
-        # reuse the last position row for every token past the table
+    if max_new > c.tgt_len:
+        # positions past tgt_len were never used by the training decoder
+        # (and dynamic_slice would silently clamp past the table end)
         raise ValueError(
-            f"max_new={max_new} exceeds the positional table "
-            f"({pos_rows} rows = max(src_len, tgt_len)); build the model "
+            f"max_new={max_new} exceeds tgt_len={c.tgt_len}, the "
+            f"positional range the decoder trained on; build the model "
             f"with a longer tgt_len to decode further")
 
     def attn_params(params, prefix):

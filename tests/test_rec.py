@@ -152,6 +152,40 @@ def test_mf_converges_on_low_rank_ratings(rng):
     assert np.mean(losses[-10:]) < 0.15 * np.mean(losses[:10])
 
 
+def test_ncf_data_parallel_matches_single(rng):
+    """NeuMF under DataParallel(8) ≡ single device (sparse embedding
+    grads ride the same GSPMD lowering as the CTR models)."""
+    from hetu_tpu.parallel import DataParallel
+    B, users, items, D = 16, 40, 30, 20
+
+    def build():
+        with ht.name_scope():
+            model = NCFModel(users, items, D, head="neumf",
+                             name="ncf_dp")
+            ids = ht.placeholder_op("dp_ids", (B, 2), dtype=np.int32)
+            labels = ht.placeholder_op("dp_labels", (B,))
+            mse, _, _ = model(ids, labels)
+            train = ht.AdamOptimizer(1e-2).minimize(mse)
+        return ids, labels, mse, train
+
+    feeds = [_feed(np.random.default_rng(9), None, B, users, items, D)
+             for _ in range(5)]
+    # SAME graph under both executors (same variable ids -> identical
+    # init), the test_parallel.py loss-parity pattern
+    ids, labels, mse, train = build()
+    curves = []
+    for strat in (None, DataParallel(ndev=8)):
+        ex = ht.Executor([mse, train], dist_strategy=strat)
+        ls = []
+        for idv, lbv in feeds:
+            ls.append(float(ex.run(
+                feed_dict={ids: idv, labels: lbv},
+                convert_to_numpy_ret_vals=True)[0]))
+        curves.append(ls)
+    np.testing.assert_allclose(curves[0], curves[1], rtol=2e-3,
+                               atol=1e-5)
+
+
 def test_ncf_composes_with_compressed_embedding(rng):
     """The heads take any embedding layer — here a tensor-train
     compressed table, the reference run_compressed.py composition."""

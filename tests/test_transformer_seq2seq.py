@@ -252,6 +252,48 @@ def test_seq2seq_greedy_decode_matches_iterative_oracle(rng):
     np.testing.assert_array_equal(got, want)
 
 
+def test_seq2seq_data_parallel_matches_single(rng):
+    """The encoder-decoder tier under DataParallel(8) reproduces
+    single-device training exactly (the loss-parity methodology every
+    other model family in tests/test_parallel.py follows)."""
+    from hetu_tpu.parallel import DataParallel
+    c = TransformerConfig(vocab_size=32, d_model=16, num_blocks=1,
+                          num_heads=2, d_ff=32, src_len=8, tgt_len=8,
+                          dropout_rate=0.0)
+    B = 16
+
+    def build():
+        with ht.name_scope():
+            model = Seq2SeqTransformer(c, name="s2sdp")
+            src = ht.placeholder_op("dp_src", (B, c.src_len),
+                                    dtype=np.int32)
+            tin = ht.placeholder_op("dp_tin", (B, c.tgt_len),
+                                    dtype=np.int32)
+            tout = ht.placeholder_op("dp_tout", (B, c.tgt_len),
+                                     dtype=np.int32)
+            skeep = ht.placeholder_op("dp_skeep", (B, c.src_len))
+            tkeep = ht.placeholder_op("dp_tkeep", (B, c.tgt_len))
+            loss = model.loss(src, tin, tout, skeep, tkeep)
+            train = ht.AdamOptimizer(1e-2).minimize(loss)
+        return (src, tin, tout, skeep, tkeep), loss, train
+
+    feeds_np = [_batch(np.random.default_rng(5), c, B) for _ in range(5)]
+    # SAME graph under both executors (same variable ids -> identical
+    # init), the test_parallel.py loss-parity pattern
+    ph, loss, train = build()
+    curves = []
+    for strat in (None, DataParallel(ndev=8)):
+        ex = ht.Executor([loss, train], dist_strategy=strat)
+        ls = []
+        for f in feeds_np:
+            feed = dict(zip(ph, f))
+            ls.append(float(ex.run(feed_dict=feed,
+                                   convert_to_numpy_ret_vals=True)[0]))
+        curves.append(ls)
+    np.testing.assert_allclose(curves[0], curves[1], rtol=2e-3,
+                               atol=1e-5)
+
+
 def test_cross_attention_different_lengths(rng):
     """src_len != tgt_len exercises the kv_seq_len path."""
     c = TransformerConfig(vocab_size=30, d_model=16, num_blocks=1,
