@@ -51,6 +51,9 @@ def main():
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--dropout", type=float, default=0.1)
+    ap.add_argument("--generate", action="store_true",
+                    help="greedy-decode a batch after training "
+                         "(KV-cache decoder) and report token accuracy")
     args = ap.parse_args()
 
     c = TransformerConfig(vocab_size=args.vocab, d_model=args.d_model,
@@ -78,6 +81,15 @@ def main():
                      convert_to_numpy_ret_vals=True)
         if step % 20 == 0 or step == args.steps - 1:
             print(f"step {step:5d}  loss {out[0]:.4f}")
+
+    if args.generate:
+        from hetu_tpu.models import seq2seq_generate
+        s, _, to, sk, tk = make_batch(rng, c, B)
+        gen = seq2seq_generate(ex, model, s, sk, c.tgt_len)
+        acc = float((((gen == to) * tk).sum()) / tk.sum())
+        print(f"greedy decode token accuracy: {acc:.3f}")
+        print("src:", s[0][sk[0] > 0][:12])
+        print("gen:", gen[0][:int(tk[0].sum())][:12])
 
 
 if __name__ == "__main__":
