@@ -65,25 +65,52 @@ def _with_flash_baseline(baseline_fn, lower_is_better=False, **kw):
                  round(base_flash, 4) if base_flash else None}
 
 
-def _timeit(fn, reps):
-    """Time reps calls of fn; fn must return something SMALL (a scalar or
-    loss list).  np.asarray forces real materialization — through the dev
-    tunnel, block_until_ready alone has been observed returning before
-    pure pallas outputs finish (0.02 ms "timings")."""
+def _sync(out):
+    """Force real materialization of a (small) output.  np.asarray, not
+    block_until_ready: through the dev tunnel the latter has been observed
+    returning before pure pallas outputs finish (0.02 ms "timings")."""
     import jax
 
-    def sync(out):
-        np.asarray(jax.tree_util.tree_leaves(out)[0])
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
 
+
+def _time_group(fn, reps):
+    """One timed group of reps calls (fn already warmed); returns s/call."""
+    start = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn()
+    _sync(out)
+    return (time.perf_counter() - start) / reps
+
+
+def _interleaved(ours_fn, base_group, reps, rounds=5):
+    """Variance-robust protocol for the small latency-bound stages: the
+    dev tunnel's RTT drifts minute-to-minute (the same flax W&D baseline
+    has measured 298-536 steps/s in back-to-back runs), so ours and the
+    baseline are timed in ALTERNATING groups within one process — drift
+    hits both sides — and the reported ratio is the MEDIAN of per-round
+    adjacent-group ratios (drift is mostly shared within a round, and the
+    median drops rounds where a burst hit one side only).  ours_fn: one
+    step (already warmed).  base_group: () -> steps/sec for one baseline
+    group (compiles once, jit-cached).  Returns
+    (ours_best_steps_per_sec, base_best_steps_per_sec, median_ratio)."""
+    ours_v, base_v = [], []
+    for _ in range(rounds):
+        ours_v.append(1.0 / _time_group(ours_fn, reps))
+        base_v.append(base_group())
+    ratios = sorted(o / b for o, b in zip(ours_v, base_v))
+    return max(ours_v), max(base_v), ratios[len(ratios) // 2]
+
+
+def _timeit(fn, reps):
+    """Time reps calls of fn; fn must return something SMALL (a scalar or
+    loss list)."""
     out = fn()
-    sync(out)
+    _sync(out)
     best = float("inf")
     for _ in range(3):  # best-of-3 groups: robust to one-off interference
-        start = time.perf_counter()
-        for _ in range(reps):
-            out = fn()
-        sync(out)
-        best = min(best, (time.perf_counter() - start) / reps)
+        best = min(best, _time_group(fn, reps))
     return best, out
 
 
@@ -326,17 +353,19 @@ def bench_resnet(quick):
             y: jnp.asarray(rng.integers(0, 10, (B,)), jnp.int32)}
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0])
-    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
-    ours = B / dt
-
-    import gc
-    del ex
-    gc.collect()
-    from benchmarks.flax_baselines import resnet18_samples_per_sec
-    base = _rerun(resnet18_samples_per_sec, batch=B, steps=steps)
+    # interleaved ours/baseline groups (same rationale as bench_wdl: the
+    # 0.975-0.991 r2/r3 misses sit inside sequential-measurement drift)
+    from benchmarks.flax_baselines import resnet18_train_group
+    base_group = resnet18_train_group(batch=B)        # built+warmed ONCE
+    ours_sps, base, ratio = _interleaved(
+        lambda: ex.run("train", feed_dict=feed),
+        lambda: base_group(steps) / B,
+        steps)
+    ours, base = ours_sps * B, base * B
     return {"metric": "resnet18_cifar_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": round(ours / base, 3),
+            "vs_baseline": round(ratio, 3),
+            "protocol": "interleaved_median_of_5",
             "baseline": {"flax_same_chip": round(base, 2)}}
 
 
@@ -386,7 +415,7 @@ def bench_wdl(quick):
     from hetu_tpu.models import WDL
 
     B, rows = (32, 5000) if quick else (128, 337000)
-    steps = 10 if quick else 30
+    steps = 10 if quick else 100   # ~2 ms/step: long groups beat jitter
     rng = np.random.default_rng(0)
     dense = ht.placeholder_op("dense", (B, 13))
     sparse = ht.placeholder_op("sparse", (B, 26), dtype=np.int32)
@@ -401,8 +430,15 @@ def bench_wdl(quick):
             labels: jnp.asarray(rng.integers(0, 2, (B,)), jnp.float32)}
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0])
-    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
-    ours = 1.0 / dt
+    # interleaved ours/baseline groups: both fit HBM at these shapes, and
+    # tunnel drift between sequential measurements has swung this stage's
+    # ratio 0.69-1.09 across otherwise-identical runs (VERDICT r3 item 1)
+    from benchmarks.flax_baselines import wdl_train_group
+    base_group = wdl_train_group(batch=B, rows=rows)  # built+warmed ONCE
+    ours, base, ratio = _interleaved(
+        lambda: ex.run("train", feed_dict=feed),
+        lambda: base_group(steps),
+        steps)
     import gc
     del ex          # each timed executor runs alone (bench_moe discipline)
     gc.collect()
@@ -418,13 +454,10 @@ def bench_wdl(quick):
     out_s = ex_s.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out_s[0])
     dt_s, _ = _timeit(lambda: ex_s.run("train", feed_dict=feed), steps)
-    del ex_s        # free before the baseline times
-    gc.collect()
-    from benchmarks.flax_baselines import wdl_steps_per_sec
-    base = _rerun(wdl_steps_per_sec, batch=B, rows=rows, steps=steps)
     return {"metric": "wdl_criteo_train_steps_per_sec",
             "value": round(ours, 2), "unit": "steps/sec",
-            "vs_baseline": round(ours / base, 3),
+            "vs_baseline": round(ratio, 3),
+            "protocol": "interleaved_median_of_5",
             "baseline": {"flax_same_chip": round(base, 2)},
             "lazy_sparse_opt_steps_per_sec": round(1.0 / dt_s, 2)}
 

@@ -1,0 +1,75 @@
+"""Scratch profiler for the W&D bench stage: where does the per-step
+time go — executor.run() Python overhead, the compiled program, or
+dispatch latency?  Run on the real chip."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import hetu_tpu as ht
+from hetu_tpu.models import WDL
+
+B, rows, steps = 128, 337000, 100
+rng = np.random.default_rng(0)
+dense = ht.placeholder_op("dense", (B, 13))
+sparse = ht.placeholder_op("sparse", (B, 26), dtype=np.int32)
+labels = ht.placeholder_op("labels", (B,))
+model = WDL(rows, embedding_dim=16)
+loss = model.loss(dense, sparse, labels)
+ex = ht.Executor({"train": [loss, ht.AdamOptimizer(0.01).minimize(loss)]})
+feed = {dense: jnp.asarray(rng.standard_normal((B, 13)), jnp.float32),
+        sparse: jnp.asarray(rng.integers(0, rows, (B, 26)), jnp.int32),
+        labels: jnp.asarray(rng.integers(0, 2, (B,)), jnp.float32)}
+out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
+assert np.isfinite(out[0])
+
+
+def timeit(fn, reps=steps, groups=3):
+    out = fn()
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+    best = float("inf")
+    for _ in range(groups):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
+
+
+# 1. full run() path
+dt_full = timeit(lambda: ex.run("train", feed_dict=feed))
+print(f"full ex.run():        {dt_full*1e3:8.3f} ms/step")
+
+# 2. bypass run(): call the jitted fn directly with prebuilt args
+sub = ex.subexecutor["train"]
+feeds = {n.name: v for n, v in feed.items()}
+
+
+def direct():
+    vals, ex.params, ex.opt_state, ex._step_arr = sub._jitted(
+        ex.params, ex.opt_state, feeds, ex._base_key, ex._step_arr)
+    return vals
+
+
+dt_direct = timeit(direct)
+print(f"direct jitted call:   {dt_direct*1e3:8.3f} ms/step")
+print(f"  -> run() python overhead: {(dt_full-dt_direct)*1e3:.3f} ms")
+
+# 3. program cost analysis
+ca = sub.cost_analysis(feed_dict=feed)
+print(f"flops={ca.get('flops'):.3e} bytes={ca.get('bytes accessed'):.3e}")
+
+# 4. flax baseline for comparison in the same process
+from flax_baselines import wdl_steps_per_sec  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+base = wdl_steps_per_sec(batch=B, rows=rows, steps=steps)
+print(f"flax baseline:        {1e3/base:8.3f} ms/step ({base:.1f} steps/s)")
+print(f"ours full:            {1e3*dt_full:8.3f} ms/step "
+      f"({1/dt_full:.1f} steps/s)")

@@ -93,6 +93,48 @@ class SubExecutor:
         if first_error is not None:
             raise first_error
 
+    def _should_donate(self):
+        """Donate params/opt-state only under real memory pressure.
+
+        Donation halves peak parameter memory, but on current TPU XLA it
+        also makes the compiler stage the param-update fusions in scoped
+        memory (S(1)) and COPY every updated parameter back to its HBM
+        buffer — measured 1.42 -> 2.18 ms/step on the W&D bench shapes
+        (+13% HBM bytes), and the same pattern taxes every stage.  When
+        the state comfortably fits HBM the copies buy nothing, so: donate
+        iff params+opt bytes exceed a quarter of device memory (both
+        copies plus activations still fit below ~50%), or the user forces
+        it with ``Executor(..., donate_params=True/False)``.
+        """
+        cfg = self.executor.config.get("donate_params", "auto")
+        if cfg != "auto":
+            return bool(cfg)
+        ex = self.executor
+        # lazy-sparse (scatter) param updates NEED aliasing: a functional
+        # .at[ids].set over a non-donated table forces XLA to copy the
+        # whole [V, H] buffer first, turning the rowwise update back into
+        # a full-table pass (measured 2.8 ms vs 1.0 ms on the W&D lazy
+        # path).  The S(1) copy-back tax donation carries only hits the
+        # DENSE params, which are small whenever someone bothered with a
+        # sparse table.
+        if any(getattr(op, "sparse", None) for op in self.opt_ops):
+            return True
+        state_bytes = sum(
+            getattr(v, "nbytes", 0)
+            for v in jax.tree_util.tree_leaves((ex.params, ex.opt_state)))
+        limit = 16 * 1024 ** 3  # v5e/v5p-class HBM default
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats and stats.get("bytes_limit"):
+                limit = stats["bytes_limit"]
+        except Exception:
+            pass
+        # compare against ONE device's HBM: replicated state (plain DP)
+        # costs its full global size on EVERY chip, and for sharded state
+        # the global total over-counts per-device pressure — which only
+        # errs toward donating, the memory-safe direction.
+        return state_bytes > 0.25 * limit
+
     def _build(self):
         placeholders = self.placeholders
         eval_nodes = self._all_eval
@@ -107,12 +149,19 @@ class SubExecutor:
                 return x.astype(compute_dtype)
             return x
 
+        # skip the per-step key derivation entirely when nothing in the
+        # subgraph draws random bits (dropout/noise ops) — the threefry
+        # fold_in is small but pure overhead on RNG-free models (W&D,
+        # ResNet eval, ...)
+        needs_rng = any(getattr(n, "needs_rng", False) for n in topo)
+
         def step_fn(params, opt_state, feeds, base_key, step):
             # the per-step key derives INSIDE the program from a
             # device-resident step counter — an eager fold_in per run()
             # would dispatch a separate device op each step (several ms
             # through a remote-tunnel link, dominating small models)
-            key = jax.random.fold_in(base_key, step)
+            key = (jax.random.fold_in(base_key, step) if needs_rng
+                   else base_key)
             # mixed precision: forward/backward run in compute_dtype while
             # optimizers update the full-precision masters (the standard
             # TPU bf16-compute / f32-master-weights policy).
@@ -135,7 +184,8 @@ class SubExecutor:
             new_opt_state.update(ctx.new_opt_state)
             return vals, new_params, new_opt_state, step + 1
 
-        donate = (0, 1, 4) if self.training else (4,)
+        donate = ((0, 1, 4) if self.training and self._should_donate()
+                  else (4,))
         in_shardings = self.executor._input_shardings(self)
         if in_shardings is not None:
             # pin updated params/opt-state to their INPUT shardings: with
@@ -493,7 +543,20 @@ class Executor:
         for name, value in state["params"].items():
             if name in var_by_name:
                 v = var_by_name[name]
-                self.params[name] = self._place(v, jnp.asarray(value))
+                value = jnp.asarray(value)
+                if v.shape is not None and tuple(value.shape) != tuple(
+                        v.shape):
+                    hint = ""
+                    if value.ndim == 4 and tuple(value.shape) == (
+                            v.shape[3], v.shape[2], v.shape[0], v.shape[1]):
+                        hint = (" — this looks like an OIHW conv kernel; "
+                                "layers.Conv2d stores HWIO (TPU-native); "
+                                "convert with Conv2d.load_oihw")
+                    raise ValueError(
+                        f"checkpoint param {name!r} has shape "
+                        f"{tuple(value.shape)} but the graph expects "
+                        f"{tuple(v.shape)}{hint}")
+                self.params[name] = self._place(v, value)
         saved_opt = state["opt_state"]
         if (set(saved_opt) != set(self.opt_state)
                 and len(saved_opt) == len(self.opt_state)):

@@ -202,6 +202,24 @@ def _conv(node, ctx):
                    name=node.name)]
 
 
+@exporter("conv2d_hwio", "conv2d_hwio_add_bias")
+def _conv_hwio(node, ctx):
+    # layer weights are stored HWIO (TPU-native); ONNX Conv wants OIHW —
+    # emit an explicit Transpose on the weight input
+    p = _pair(node.attrs.get("padding", 0))
+    s = _pair(node.attrs.get("stride", 1))
+    wname = node.inputs[1].name
+    tname = f"{node.name}_w_oihw"
+    tr = NodeIR("Transpose", [wname], [tname], {"perm": [3, 2, 0, 1]},
+                name=tname)
+    ins = [node.inputs[0].name, tname] + [i.name for i in node.inputs[2:]]
+    return [tr, NodeIR("Conv", ins, [node.name],
+                       {"pads": [p[0], p[1], p[0], p[1]],
+                        "strides": list(s),
+                        "group": node.attrs.get("groups", 1)},
+                       name=node.name)]
+
+
 @exporter("max_pool2d", "avg_pool2d")
 def _pool(node, ctx):
     typ = "MaxPool" if node.op_kind == "max_pool2d" else "AveragePool"

@@ -50,6 +50,33 @@ conv2d_add_bias_op = simple_op(
     "conv2d_add_bias")
 
 
+def _conv2d_hwio(x, w, padding=0, stride=1, dilation=1, groups=1):
+    """Conv with the weight ALREADY in HWIO (the TPU-native kernel
+    layout).  The OIHW->HWIO transpose in ``_conv2d`` is a logical
+    no-op but XLA materializes it as a physical copy of every kernel
+    every step (~177 MB/step on ResNet-18); layers that own their
+    weights store HWIO natively (layers/common.py Conv2d) and only the
+    op API keeps NCHW activations for reference parity."""
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilation)
+    out = lax.conv_general_dilated(
+        x.transpose(0, 2, 3, 1), w,
+        window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dh, dw), feature_group_count=groups,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    return out.transpose(0, 3, 1, 2)
+
+
+conv2d_hwio_op = simple_op(_conv2d_hwio, "conv2d_hwio")
+conv2d_hwio_add_bias_op = simple_op(
+    lambda x, w, b, padding=0, stride=1, dilation=1, groups=1:
+        _conv2d_hwio(x, w, padding, stride, dilation, groups)
+        + b.reshape(1, -1, 1, 1),
+    "conv2d_hwio_add_bias")
+
+
 def _conv2d_transpose(x, w, padding=0, stride=1):
     ph, pw = _pair(padding)
     sh, sw = _pair(stride)

@@ -94,8 +94,12 @@ def estimate_flops(node, shapes):
             return 2.0 * n_out * k
         return 2.0 * n_out
     if "conv" in tname and ins and len(ins) > 1 and ins[1] is not None:
-        w = ins[1].shape  # OIHW
-        return 2.0 * n_out * float(np.prod(w[1:]))
+        w = ins[1].shape
+        if "hwio" in tname:          # (Kh, Kw, I, O): per-output-element
+            k = float(np.prod(w[:2])) * w[2]   # Kh*Kw*I MACs
+        else:                        # OIHW: drop the O dim
+            k = float(np.prod(w[1:]))
+        return 2.0 * n_out * k
     if "attention" in tname and ins and ins[0] is not None:
         b, h, s, d = ins[0].shape
         return 4.0 * b * h * s * s * d
