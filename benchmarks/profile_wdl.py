@@ -66,9 +66,8 @@ ca = sub.cost_analysis(feed_dict=feed)
 print(f"flops={ca.get('flops'):.3e} bytes={ca.get('bytes accessed'):.3e}")
 
 # 4. flax baseline for comparison in the same process
-from flax_baselines import wdl_steps_per_sec  # noqa: E402
-
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from flax_baselines import wdl_steps_per_sec  # noqa: E402
 base = wdl_steps_per_sec(batch=B, rows=rows, steps=steps)
 print(f"flax baseline:        {1e3/base:8.3f} ms/step ({base:.1f} steps/s)")
 print(f"ours full:            {1e3*dt_full:8.3f} ms/step "
