@@ -76,6 +76,15 @@ class SubExecutor:
             self.topo = find_topo_sort(self._all_eval)
         self._ps_pending = []
         self._jitted = None
+        # monitor variables: non-trainable in-graph counters (e.g. the
+        # BERT MLM bucket-overflow total) polled host-side every
+        # monitor_interval steps — works on every platform, unlike host
+        # callbacks (VERDICT r3 item 7)
+        self._monitor_vars = [v for v in self.variables
+                              if getattr(v, "monitor", None) is not None]
+        self._monitor_interval = int(
+            executor.config.get("monitor_interval", 200))
+        self._runs = 0  # per-subgraph step count (monitor poll schedule)
 
     def ps_synchronize(self):
         """Wait for all in-flight PS pushes (call before reading tables
@@ -277,6 +286,16 @@ class SubExecutor:
             ex.params, ex.opt_state, feeds, ex._base_key, ex._step_arr)
         ex.params = new_params
         ex.opt_state = new_opt_state
+        # poll monitor counters after this SUBGRAPH's first step and
+        # every interval of ITS runs (a global-step schedule can
+        # permanently miss a subgraph under alternating train/validate);
+        # np.asarray syncs on a scalar — negligible at the interval.
+        # Executor.check_monitors() is the final flush.
+        self._runs += 1
+        if self._monitor_vars and (
+                self._runs == 1
+                or self._runs % self._monitor_interval == 0):
+            self.check_monitors()
         # push PS-embedding grads ASYNC: the device array goes straight to
         # the table's worker thread, which blocks on the device→host copy
         # there — run() returns without waiting for the step, so the push
@@ -307,6 +326,14 @@ class SubExecutor:
         if convert_to_numpy_ret_vals:
             vals = [None if v is None else np.asarray(v) for v in vals]
         return vals
+
+    def check_monitors(self):
+        """Warn on any tripped monitor counter (MLM overflow etc.)."""
+        import warnings
+        for v in self._monitor_vars:
+            msg = v.monitor(float(np.asarray(self.executor.params[v.name])))
+            if msg:
+                warnings.warn(msg)
 
     def profile(self, feed_dict=None, repeats=10):
         """Wall-clock a compiled step (reference SubExecutor.profile)."""
@@ -515,8 +542,17 @@ class Executor:
             if hasattr(sub, "ps_synchronize"):
                 sub.ps_synchronize()
 
+    def check_monitors(self):
+        """Final flush of monitor counters across all subgraphs (also
+        called from state_dict so a run that checkpoints before the next
+        poll interval still surfaces tripped counters)."""
+        for sub in self.subexecutor.values():
+            if hasattr(sub, "check_monitors"):
+                sub.check_monitors()
+
     # -- checkpoint (reference executor.py:558-670) ------------------------
     def state_dict(self):
+        self.check_monitors()
         host = jax.tree_util.tree_map(np.asarray, self.params)
         opt = jax.tree_util.tree_map(np.asarray, self.opt_state)
         # kept outside opt_state so the jitted step never sees string

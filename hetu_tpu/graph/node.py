@@ -285,7 +285,10 @@ class VariableOp(Op):
     functional state dict, not on the node.
     """
 
-    __slots__ = ("shape", "dtype", "initializer", "trainable")
+    # monitor: optional callable(float) -> warning-message-or-None; the
+    # executor polls monitored variables host-side every monitor_interval
+    # steps (in-graph counters, e.g. the BERT MLM overflow total)
+    __slots__ = ("shape", "dtype", "initializer", "trainable", "monitor")
 
     # Executor state is keyed by variable name, so names must be unique
     # within a namespace (`name_scope`); the Executor raises on genuine

@@ -106,15 +106,28 @@ class Conv2d(BaseLayer):
 
 
 class BatchNorm(BaseLayer):
-    def __init__(self, num_channels, momentum=0.1, eps=1e-5, name=None):
+    """BatchNorm over [N, C, H, W] (reference layers/normalization.py).
+
+    Batch statistics default to a shifted one-pass form whose shift is
+    the RUNNING mean — fastest (fuses with the producing conv), but for
+    the first steps the zero-initialized shift gives the raw
+    E[x^2]-E[x]^2 f32 form, which cancels catastrophically on inputs
+    with per-channel |mean| >> std.  For such offset-heavy inputs pass
+    ``precise_stats=True`` (exact two-pass stats, one extra read of x;
+    see ops/nn.py BatchNormOp)."""
+
+    def __init__(self, num_channels, momentum=0.1, eps=1e-5,
+                 precise_stats=False, name=None):
         name = fresh_name(name or "bn")
         self.scale = VariableOp(f"{name}_scale", (num_channels,), init.ones())
         self.bias = VariableOp(f"{name}_bias", (num_channels,), init.zeros())
         self.momentum, self.eps = momentum, eps
+        self.precise_stats = precise_stats
 
     def __call__(self, x):
         return batch_normalization_op(x, self.scale, self.bias,
-                                      momentum=self.momentum, eps=self.eps)
+                                      momentum=self.momentum, eps=self.eps,
+                                      precise_stats=self.precise_stats)
 
 
 class LayerNorm(BaseLayer):

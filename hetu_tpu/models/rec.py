@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from ..layers import Embedding, Linear, Sequence, fresh_name
 from .. import initializers as init
+from ..graph.node import scoped_init
 from ..ops import (array_reshape_op, concat_op, mae_loss_op, mse_loss_op,
                    reduce_mul_op, reduce_sum_op, relu_op, slice_op)
 
@@ -148,8 +149,13 @@ class NCFModel:
     (examples/rec/run_compressed.py builds the same single table over
     users+items so compression methods see one id space)."""
 
+    @scoped_init
     def __init__(self, num_users, num_items, embed_dim, head="neumf",
                  embedding=None, name="ncf"):
+        # scoped_init (one name_scope per instance, the model-constructor
+        # convention): head/layer names must not depend on process-global
+        # fresh_name state or checkpoint keys drift with construction
+        # order (ADVICE r3)
         self.embedding = embedding or Embedding(
             num_users + num_items, embed_dim, name=name)
         self.head = REC_HEADS[head](embed_dim)

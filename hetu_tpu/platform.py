@@ -1,15 +1,7 @@
-"""Platform capability probes.
-
-Some PJRT plugins (notably the axon dev-tunnel used for single-chip TPU
-access) implement the compute path but not host send/recv callbacks
-(jax.debug.print / io_callback / pure_callback).  Backend NAME checks
-can't detect this — the tunnel reports platform "tpu" — so capabilities
-are feature-probed once per process and cached.
-"""
+"""Platform selection helpers for the axon dev-tunnel environment."""
 
 from __future__ import annotations
 
-_HOST_CALLBACKS = None
 
 
 def force_platform_from_env():
@@ -24,47 +16,3 @@ def force_platform_from_env():
     if os.environ.get("JAX_PLATFORMS"):
         import jax
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-
-def host_callbacks_supported() -> bool:
-    """True iff jitted host callbacks (jax.debug.print et al) execute on
-    the default backend.  Probes with a trivial jitted program once and
-    caches the verdict for the process lifetime."""
-    global _HOST_CALLBACKS
-    if _HOST_CALLBACKS is None:
-        import jax
-        import jax.numpy as jnp
-        if _in_trace():
-            # called mid-trace with no cached verdict: a jit probe here
-            # would STAGE into the enclosing program (omnistaging) and
-            # "succeed" while smuggling the callback into the caller's
-            # compiled program.  Answer conservatively and leave the
-            # cache unset so an eager call can still establish the real
-            # verdict.
-            return False
-        try:
-            jax.block_until_ready(jax.jit(
-                lambda x: (jax.debug.print("", ordered=False), x)[1]
-            )(jnp.zeros(())))
-            jax.effects_barrier()
-            _HOST_CALLBACKS = True
-        except Exception:
-            _HOST_CALLBACKS = False
-    return _HOST_CALLBACKS
-
-
-def _in_trace() -> bool:
-    """True when called under an active jax trace.
-
-    jax.core.trace_state_clean was removed in newer jax; the portable
-    detection is whether array CREATION gets staged to a Tracer (under
-    omnistaging any op inside a trace context does)."""
-    import jax
-    import jax.numpy as jnp
-    clean = getattr(jax.core, "trace_state_clean", None)
-    if clean is not None:
-        try:
-            return not clean()
-        except Exception:
-            pass
-    return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)

@@ -498,30 +498,3 @@ def test_comm_mode_allreduce_is_data_parallel():
         ht.Executor([loss], comm_mode="bogus")
 
 
-def test_host_callback_probe_trace_safety():
-    """host_callbacks_supported: never probes mid-trace (a jit probe
-    would stage into the enclosing program and lie), answers
-    conservatively there WITHOUT caching, and caches the real verdict
-    from an eager call."""
-    import jax
-    from hetu_tpu import platform as plat
-
-    saved = plat._HOST_CALLBACKS
-    try:
-        plat._HOST_CALLBACKS = None
-        seen = {}
-
-        def f(x):
-            seen["in_trace"] = plat.host_callbacks_supported()
-            seen["cache_after_trace_call"] = plat._HOST_CALLBACKS
-            return x * 2
-        jax.jit(f)(jax.numpy.ones(()))
-        assert seen["in_trace"] is False          # conservative
-        assert seen["cache_after_trace_call"] is None   # not poisoned
-
-        eager = plat.host_callbacks_supported()   # CPU backend: works
-        assert eager is True
-        assert plat._HOST_CALLBACKS is True       # cached now
-        assert plat.host_callbacks_supported() is True
-    finally:
-        plat._HOST_CALLBACKS = saved
