@@ -25,6 +25,8 @@ from . import ps
 from . import metrics
 from .dataloader import Dataloader, DataloaderOp, dataloader_op
 from .logger import HetuLogger, WandbLogger
+from .profiler import HetuProfiler, HetuSimulator
+from . import timeline
 from . import embed_compress
 from . import onnx
 from . import graphboard

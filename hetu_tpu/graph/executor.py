@@ -542,6 +542,36 @@ class Executor:
             if hasattr(sub, "ps_synchronize"):
                 sub.ps_synchronize()
 
+    def profile(self, name=None, feed_dict=None, repeats=10,
+                trace_dir=None):
+        """Wall-clock ``repeats`` compiled steps of subgraph ``name``
+        (reference Executor.profile, executor.py:501).
+
+        With ``trace_dir``, the timed steps run under
+        ``jax.profiler.trace`` and per-op aggregates (the
+        timer_subexecutor.logOut role) are written to
+        ``<trace_dir>/op_aggregates.json`` — see hetu_tpu/timeline.py.
+        Returns avg seconds/step (and with trace_dir, the aggregates
+        dict as a second value)."""
+        if name is None:
+            name = next(iter(self.subexecutor))
+        sub = self.subexecutor[name]
+        if trace_dir is None:
+            return sub.profile(feed_dict, repeats=repeats)
+        sub.run(feed_dict)  # compile + warm OUTSIDE the capture, so the
+        # aggregates cover exactly `repeats` steps (matching meta)
+        with jax.profiler.trace(trace_dir):
+            start = time.perf_counter()
+            for _ in range(repeats):
+                out = sub.run(feed_dict)
+            jax.block_until_ready([o for o in out if o is not None])
+            dt = (time.perf_counter() - start) / repeats
+        from ..timeline import write_aggregates
+        aggs = write_aggregates(trace_dir, extra={
+            "subgraph": name, "repeats": repeats,
+            "avg_step_seconds": dt})
+        return dt, aggs
+
     def check_monitors(self):
         """Final flush of monitor counters across all subgraphs (also
         called from state_dict so a run that checkpoints before the next

@@ -65,13 +65,15 @@ class MultiHeadAttention(BaseLayer):
         seq_len = seq_len or self.sequence_length
         assert seq_len is not None, "sequence length required"
         if kv_seq_len is not None and kv_seq_len != seq_len:
-            # rotary positions implicitly start at 0 on BOTH q and k, and
-            # the causal mask assumes square [S, S] — a differing memory
-            # length would silently mis-position/mis-mask (ADVICE r3);
-            # only vanilla cross-attention supports it
-            assert self.rope_theta is None and not self.causal, (
+            # rotary positions implicitly start at 0 on BOTH q and k, the
+            # causal mask assumes square [S, S], and the ALiBi bias is
+            # built [.., Sq, Sq] from q alone — a differing memory length
+            # would silently mis-position/mis-mask (ADVICE r3); only
+            # vanilla cross-attention supports it
+            assert (self.rope_theta is None and not self.causal
+                    and not self.alibi), (
                 "kv_seq_len != seq_len is only supported for non-causal, "
-                "non-rotary cross-attention")
+                "non-rotary, non-alibi cross-attention")
         kv_seq_len = kv_seq_len or seq_len
         q = self._split_heads(self.q_proj(query), seq_len, self.num_heads)
         k = self._split_heads(self.k_proj(key), kv_seq_len,

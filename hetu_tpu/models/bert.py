@@ -239,11 +239,16 @@ class MaskedSelectLabelsOp(Op):
             "loss.  Raise BertConfig.mlm_bucket_frac or set it to None.")
         super().__init__(labels, self.overflow_total, name=name)
         self.bucket = int(bucket)
+        # opt OUT of any enclosing `with ht.remat():` scope instead of
+        # tripping its stateful-op guard: the op is a cheap label gather
+        # (nothing worth rematerializing) and keeping it outside the
+        # checkpoint group means the counter update runs exactly once
+        self.remat_scope = None
 
     @property
     def is_stateful(self):
-        # guards the remat-scope stateful check (trace.py): the counter
-        # update must not replay on recompute
+        # keeps the trace-level stateful guard honest for any future
+        # remat path that might capture this op
         return True
 
     def _compute(self, input_vals, ctx):

@@ -1,0 +1,108 @@
+"""jax.profiler timeline capture + per-op aggregates.
+
+Reference: python/hetu/timer_subexecutor.py wraps every ``node.compute``
+with CUDA event pairs and ``logOut`` dumps per-op totals; Galvatron's
+profiler scripts (tools/Hetu-Galvatron/galvatron/core/profiler.py:194)
+drive the same per-op JSON into the strategy search.  SURVEY §5 names
+"jax.profiler traces + per-step host timing" as the TPU translation.
+
+Under XLA the executable is one fused program, so the honest per-op
+breakdown is per-FUSION (and per-runtime-phase) timings from the
+profiler's own timeline.  ``jax.profiler.trace`` writes two artifacts
+per capture: an ``.xplane.pb`` (for TensorBoard/xprof) and a Chrome
+``.trace.json.gz`` — this module aggregates the latter (no tensorflow
+dependency) into the ``timer_subexecutor.logOut`` role:
+
+    {op_name: {"total_us": ..., "count": ..., "avg_us": ...}, ...}
+
+Wired into ``Executor.profile(..., trace_dir=...)`` (graph/executor.py),
+which times N steps under the trace and writes ``op_aggregates.json``
+next to the capture.  View the full timeline with
+``tensorboard --logdir <trace_dir>`` (xprof plugin) or chrome://tracing
+on the extracted .trace.json.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+
+
+def _latest_trace_json(trace_dir):
+    pats = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not pats:
+        raise FileNotFoundError(
+            f"no .trace.json.gz under {trace_dir}/plugins/profile — did "
+            "the capture run?")
+    return pats[-1]
+
+
+def trace_aggregates(trace_dir, *, include_host_python=False,
+                     device_ops_only=None):
+    """Aggregate the newest capture under ``trace_dir`` into per-op
+    totals: {name: {total_us, count, avg_us, pct}}, sorted by total.
+
+    When the capture carries a device plane with an "XLA Ops" lane (real
+    TPU runs), only those events aggregate by default — the true per-op
+    device breakdown, free of host/dispatch lanes.  Host-only captures
+    (CPU jax) aggregate every complete event instead, minus Python
+    frames (``$file.py:123 fn`` — they time the tracer, not the
+    program; ``include_host_python=True`` keeps them).  Force either
+    behavior with ``device_ops_only``."""
+    path = _latest_trace_json(trace_dir)
+    data = json.loads(gzip.open(path).read())
+    events = data.get("traceEvents", [])
+    # lane metadata: (pid, tid) -> thread name, pid -> process name
+    pnames, tnames = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pnames[e["pid"]] = e["args"].get("name", "")
+        elif e.get("name") == "thread_name":
+            tnames[(e["pid"], e.get("tid"))] = e["args"].get("name", "")
+    xla_lanes = {k for k, v in tnames.items()
+                 if v == "XLA Ops" and "device" in pnames.get(k[0], "")}
+    if device_ops_only is None:
+        device_ops_only = bool(xla_lanes)
+    agg = {}
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if device_ops_only and (e.get("pid"),
+                                e.get("tid")) not in xla_lanes:
+            continue
+        name = e.get("name", "")
+        if not include_host_python and name.startswith("$"):
+            continue
+        slot = agg.setdefault(name, [0.0, 0])
+        slot[0] += float(e["dur"])
+        slot[1] += 1
+    total = sum(v[0] for v in agg.values()) or 1.0
+    out = {
+        name: {"total_us": round(v[0], 3), "count": v[1],
+               "avg_us": round(v[0] / v[1], 3),
+               "pct": round(100.0 * v[0] / total, 2)}
+        for name, v in agg.items()}
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]["total_us"]))
+
+
+def write_aggregates(trace_dir, extra=None):
+    """Write ``op_aggregates.json`` into ``trace_dir``; returns the
+    aggregates dict (already parsed — callers shouldn't re-parse the
+    gzipped capture, which can run to tens of MB).
+
+    ``extra``: dict merged in under "meta" (e.g. measured step time) —
+    the per-op JSON + host-measured step time pair the reference's
+    profile-then-search contract carries."""
+    aggs = trace_aggregates(trace_dir)
+    doc = {"ops": aggs}
+    if extra:
+        doc["meta"] = extra
+    path = os.path.join(trace_dir, "op_aggregates.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return aggs

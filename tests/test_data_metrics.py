@@ -184,6 +184,7 @@ def _pad_transform(batch):
     return np.concatenate([batch, np.zeros_like(batch)], axis=1)
 
 
+@pytest.mark.slow
 def test_mp_dataloader_matches_thread_engine():
     """Worker processes + shared-memory ring produce byte-identical batch
     sequences to the thread engine, shuffled and not."""

@@ -20,6 +20,7 @@ def _run(args, timeout=420):
                           capture_output=True, text=True, timeout=timeout)
 
 
+@pytest.mark.slow
 def test_gpt_hybrid_example_smoke():
     """Searched full-LM Galvatron GPT (tied head) trains for a step."""
     r = _run(["examples/auto_parallel/gpt_hybrid.py", "--preset", "tiny",
@@ -51,6 +52,7 @@ def test_ncf_example_smoke():
     assert "mse" in r.stdout and "mae" in r.stdout
 
 
+@pytest.mark.slow
 def test_ps_scale_bench_smoke():
     """The HET-at-scale sweep runs end-to-end (small tables) and reports
     per-size steps/s + the in-graph feasibility arithmetic."""

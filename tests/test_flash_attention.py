@@ -140,6 +140,7 @@ def test_unsupported_shapes_fall_back(rng):
     (256, 44, False, True),    # head dim padded 44 -> 48
     (200, 20, True, True),     # both axes padded (s->256, d->32)
 ])
+@pytest.mark.slow
 def test_padded_envelope_matches_reference(rng, S, D, causal, with_mask):
     # VERDICT round 1 (weak #6): out-of-envelope shapes used to silently
     # take the O(S^2) path; now the wrapper pads into the kernel envelope.
@@ -251,6 +252,7 @@ def test_graph_op_uses_flash_on_tpu_only(rng):
 
 
 @pytest.mark.parametrize("N,V", [(64, 4096), (100, 5000), (32, 50257 // 8)])
+@pytest.mark.slow
 def test_fused_softmax_ce_matches_jnp(rng, N, V):
     from hetu_tpu.ops.pallas.softmax_ce import fused_softmax_ce_sparse
     logits = jnp.asarray(rng.standard_normal((N, V)), jnp.float32)

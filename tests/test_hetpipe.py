@@ -14,6 +14,8 @@ from hetu_tpu.parallel.hetpipe import (HetPipeTrainer, DenseParamStore,
                                        _ThreadReducer)
 from hetu_tpu.launcher import launch_local
 
+# heavyweight parity suite: deselect with -m 'not slow' (VERDICT r3 item 10)
+pytestmark = pytest.mark.slow
 
 def _stage_fn(params, x):
     return jnp.tanh(x @ params["w"] + params["b"])

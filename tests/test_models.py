@@ -27,6 +27,7 @@ def test_mlp_converges():
     assert losses[-1] < 0.1 * losses[0]
 
 
+@pytest.mark.slow
 def test_resnet18_forward_and_train_step():
     rng = np.random.default_rng(1)
     X = rng.standard_normal((4, 3, 32, 32)).astype(np.float32)
@@ -49,6 +50,7 @@ def test_resnet18_forward_and_train_step():
     assert lv < l0  # overfit tiny batch
 
 
+@pytest.mark.slow
 def test_bert_tiny_train():
     c = BertConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
                    num_attention_heads=4, intermediate_size=64, seq_len=16,
@@ -231,6 +233,7 @@ def test_bert_mlm_overflow_warns_without_callbacks():
     assert name and float(np.asarray(ex.params[name[0]])) > 0
 
 
+@pytest.mark.slow
 def test_zoo_models_train():
     # the reference's remaining examples/cnn zoo: forward shapes + one
     # optimizer step decreasing loss on a separable toy problem
@@ -265,6 +268,7 @@ def test_zoo_models_train():
             f"{type(model).__name__}: {l0} -> {l1}"
 
 
+@pytest.mark.slow
 def test_lstm_matches_torch():
     # gate packing follows torch.nn.LSTM: copied weights => same outputs
     import torch

@@ -308,6 +308,7 @@ def test_fused_ce_under_megatron_mesh():
     assert np.isfinite(out[0])
 
 
+@pytest.mark.slow
 def test_megatron_tp_llama():
     """Llama (RoPE + GQA + SwiGLU) under dp x tp GSPMD: the TP naming
     contract covers gate/up/down projections, loss decreases, and the

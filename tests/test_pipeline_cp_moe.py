@@ -14,6 +14,9 @@ from hetu_tpu.parallel import (make_mesh, PipelineParallel, ring_attention,
 
 # ---------------- pipeline ----------------
 
+# heavyweight parity suite: deselect with -m 'not slow' (VERDICT r3 item 10)
+pytestmark = pytest.mark.slow
+
 def _stage_fn(params, x):
     w, b = params["w"], params["b"]
     return jnp.tanh(x @ w + b)

@@ -20,6 +20,8 @@ from hetu_tpu.ps import (EmbeddingTable, ShardedTable, PSServer,
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
+# heavyweight parity suite: deselect with -m 'not slow' (VERDICT r3 item 10)
+pytestmark = pytest.mark.slow
 
 def _spawn_server(rows, dim, lr=1.0):
     proc = subprocess.Popen(

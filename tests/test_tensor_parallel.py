@@ -20,7 +20,10 @@ from hetu_tpu.parallel.tensor_parallel import (
     vocab_parallel_embedding, vocab_parallel_cross_entropy,
     column_parallel_linear, row_parallel_linear, shard_vocab_table,
     tp_lm_head_loss)
+import pytest
 
+# heavyweight parity suite: deselect with -m 'not slow' (VERDICT r3 item 10)
+pytestmark = pytest.mark.slow
 
 def _tp_mesh(n=4):
     return Mesh(np.array(jax.devices()[:n]), ("tp",))
