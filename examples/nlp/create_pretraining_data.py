@@ -1,0 +1,69 @@
+"""Corpus -> BERT MLM/NSP pretraining features (reference
+examples/nlp/bert/create_pretraining_data.py).
+
+Input format matches the reference: plain text, one sentence per line,
+blank lines between documents.  Output: one ``.npz`` with the exact
+feed arrays ``BertForPreTraining.loss`` consumes —
+input_ids/token_type_ids/attention_mask [N, S], mlm_labels [N*S]
+(-1 = unmasked), nsp_labels [N].
+
+    python examples/nlp/create_pretraining_data.py \
+        --input corpus.txt --vocab vocab.txt --output features.npz \
+        [--max_seq_length 128] [--dupe_factor 2] [--masked_lm_prob 0.15]
+
+Train from it:
+    data = np.load("features.npz")
+    ... feed slices into BertForPreTraining.loss (see examples/nlp/
+    train_bert.py for the executor setup).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True,
+                    help="text file(s), comma-separated")
+    ap.add_argument("--vocab", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--max_seq_length", type=int, default=128)
+    ap.add_argument("--dupe_factor", type=int, default=2)
+    ap.add_argument("--short_seq_prob", type=float, default=0.1)
+    ap.add_argument("--masked_lm_prob", type=float, default=0.15)
+    ap.add_argument("--max_predictions_per_seq", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=12345)
+    args = ap.parse_args()
+
+    from hetu_tpu.datasets import (create_pretraining_arrays,
+                                   documents_from_text_file)
+    from hetu_tpu.tokenizers import BertTokenizer
+
+    tok = BertTokenizer(vocab_file=args.vocab)
+    docs = []
+    for path in args.input.split(","):
+        docs.extend(documents_from_text_file(path, tok))
+    print(f"{len(docs)} documents, "
+          f"{sum(len(s) for d in docs for s in d)} tokens")
+    arrays = create_pretraining_arrays(
+        docs, tok, max_seq_length=args.max_seq_length,
+        dupe_factor=args.dupe_factor, short_seq_prob=args.short_seq_prob,
+        masked_lm_prob=args.masked_lm_prob,
+        max_predictions_per_seq=args.max_predictions_per_seq,
+        seed=args.seed)
+    np.savez_compressed(args.output, **arrays)
+    n, s = arrays["input_ids"].shape
+    masked = int((arrays["mlm_labels"] >= 0).sum())
+    print(f"wrote {args.output}: {n} instances x seq {s}, "
+          f"{masked} masked positions, "
+          f"NSP random fraction {arrays['nsp_labels'].mean():.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""NLP dataset pipelines: GLUE task processors + BERT pretraining features.
+
+Reference: examples/nlp/bert/glue_processor/glue.py (task processors →
+InputFeatures) and examples/nlp/bert/create_pretraining_data.py (corpus →
+MLM/NSP training instances).  Re-designed as framework modules producing
+dense numpy arrays ready for device upload (TPU feeds want rectangular
+batches, not per-example Python objects).
+"""
+
+from .glue import (GlueExample, GlueFeatures, GLUE_PROCESSORS,
+                   MrpcProcessor, Sst2Processor, ColaProcessor,
+                   MnliProcessor, convert_examples_to_arrays)
+from .pretraining import (create_pretraining_arrays,
+                          documents_from_text_file, mask_tokens)
+
+__all__ = [
+    "GlueExample", "GlueFeatures", "GLUE_PROCESSORS", "MrpcProcessor",
+    "Sst2Processor", "ColaProcessor", "MnliProcessor",
+    "convert_examples_to_arrays", "create_pretraining_arrays",
+    "documents_from_text_file", "mask_tokens",
+]

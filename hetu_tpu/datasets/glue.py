@@ -1,0 +1,165 @@
+"""GLUE task processors: TSV -> tokenized rectangular feature arrays.
+
+Reference: examples/nlp/bert/glue_processor/glue.py:1 — per-task
+``DataProcessor`` subclasses reading the published GLUE TSV layouts into
+``InputExample``s, then ``convert_examples_to_features`` building
+CLS/SEP/segment/pad features.  This module keeps the same task coverage
+and TSV column contracts (so downloaded GLUE data drops in unchanged)
+but emits dense numpy arrays directly — the shape TPU feeds want.
+
+Usage:
+    proc = GLUE_PROCESSORS["sst-2"]()
+    train = proc.train_examples(data_dir)
+    feats = convert_examples_to_arrays(train, proc.labels(), tokenizer,
+                                       max_seq_length=128)
+    # feats.input_ids [N, S] int32, .token_type_ids, .attention_mask,
+    # .label_ids [N]
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class GlueExample:
+    guid: str
+    text_a: str
+    text_b: str | None = None
+    label: str | None = None
+
+
+@dataclass
+class GlueFeatures:
+    """Rectangular batch-of-everything arrays (device-upload ready)."""
+
+    input_ids: np.ndarray       # [N, S] int32
+    token_type_ids: np.ndarray  # [N, S] int32
+    attention_mask: np.ndarray  # [N, S] float32
+    label_ids: np.ndarray       # [N] int32 (or float32 for regression)
+
+    def __len__(self):
+        return self.input_ids.shape[0]
+
+    def batches(self, batch_size, *, shuffle=False, seed=0,
+                drop_remainder=True):
+        """Yield dict feeds of size ``batch_size``."""
+        n = len(self)
+        order = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        stop = n - batch_size + 1 if drop_remainder else n
+        for i in range(0, max(stop, 0), batch_size):
+            sl = order[i:i + batch_size]
+            yield {"input_ids": self.input_ids[sl],
+                   "token_type_ids": self.token_type_ids[sl],
+                   "attention_mask": self.attention_mask[sl],
+                   "label_ids": self.label_ids[sl]}
+
+
+def _read_tsv(path, quotechar=None):
+    with open(path, "r", encoding="utf-8") as f:
+        return list(csv.reader(f, delimiter="\t", quotechar=quotechar))
+
+
+class GlueProcessor:
+    """Base: subclasses define the TSV column layout of one GLUE task."""
+
+    train_file = "train.tsv"
+    dev_file = "dev.tsv"
+
+    def labels(self):
+        raise NotImplementedError
+
+    def _examples(self, rows, set_type):
+        raise NotImplementedError
+
+    def train_examples(self, data_dir):
+        return self._examples(
+            _read_tsv(os.path.join(data_dir, self.train_file)), "train")
+
+    def dev_examples(self, data_dir):
+        return self._examples(
+            _read_tsv(os.path.join(data_dir, self.dev_file)), "dev")
+
+
+class MrpcProcessor(GlueProcessor):
+    """MRPC: paraphrase pairs; label col 0, sentences cols 3/4."""
+
+    def labels(self):
+        return ["0", "1"]
+
+    def _examples(self, rows, set_type):
+        return [GlueExample(f"{set_type}-{i}", r[3], r[4], r[0])
+                for i, r in enumerate(rows) if i > 0]
+
+
+class Sst2Processor(GlueProcessor):
+    """SST-2: single sentence col 0, label col 1."""
+
+    def labels(self):
+        return ["0", "1"]
+
+    def _examples(self, rows, set_type):
+        return [GlueExample(f"{set_type}-{i}", r[0], None, r[1])
+                for i, r in enumerate(rows) if i > 0]
+
+
+class ColaProcessor(GlueProcessor):
+    """CoLA: no header; sentence col 3, label col 1."""
+
+    def labels(self):
+        return ["0", "1"]
+
+    def _examples(self, rows, set_type):
+        return [GlueExample(f"{set_type}-{i}", r[3], None, r[1])
+                for i, r in enumerate(rows)]
+
+
+class MnliProcessor(GlueProcessor):
+    """MNLI: premise/hypothesis cols 8/9, label last col."""
+
+    dev_file = "dev_matched.tsv"
+
+    def labels(self):
+        return ["contradiction", "entailment", "neutral"]
+
+    def _examples(self, rows, set_type):
+        return [GlueExample(f"{set_type}-{r[0]}", r[8], r[9], r[-1])
+                for i, r in enumerate(rows) if i > 0]
+
+
+GLUE_PROCESSORS = {
+    "mrpc": MrpcProcessor,
+    "sst-2": Sst2Processor,
+    "sst2": Sst2Processor,
+    "cola": ColaProcessor,
+    "mnli": MnliProcessor,
+}
+
+
+def convert_examples_to_arrays(examples, label_list, tokenizer,
+                               max_seq_length):
+    """Tokenize + featurize into rectangular arrays.
+
+    Mirrors the reference's convert_examples_to_features contract
+    (glue_processor/glue.py:230): [CLS] a [SEP] (b [SEP]), longest-first
+    pair truncation (tokenizer.encode), zero-padded to max_seq_length.
+    """
+    label_map = {lab: i for i, lab in enumerate(label_list)}
+    n = len(examples)
+    ids = np.zeros((n, max_seq_length), np.int32)
+    types = np.zeros((n, max_seq_length), np.int32)
+    mask = np.zeros((n, max_seq_length), np.float32)
+    labels = np.zeros((n,), np.int32)
+    for i, ex in enumerate(examples):
+        a, t, m = tokenizer.encode(ex.text_a, ex.text_b,
+                                   max_len=max_seq_length)
+        ids[i], types[i], mask[i] = a, t, m
+        if ex.label is not None:
+            labels[i] = label_map[ex.label]
+    return GlueFeatures(ids, types, mask, labels)

@@ -1,0 +1,184 @@
+"""BERT pretraining features: raw corpus -> MLM/NSP arrays.
+
+Reference: examples/nlp/bert/create_pretraining_data.py:1 — documents
+are split into sentence segments; segment runs are packed into
+[CLS] A [SEP] B [SEP] pairs where B is the true continuation 50% of the
+time and a random document otherwise (NSP), then ~15% of tokens are
+masked 80/10/10 ([MASK] / keep / random word) for the MLM objective.
+
+Fresh design notes (same recipe, TPU-shaped output):
+  * emits dense rectangular numpy arrays — input_ids/token_type_ids/
+    attention_mask [N, S], mlm_labels [N*S] with -1 everywhere except
+    masked positions, nsp_labels [N] — exactly the feed contract of
+    ``models.BertForPreTraining.loss`` (the reference writes HDF5 of
+    positions+ids instead; our MLM head buckets positions in-graph).
+  * one ``np.random.default_rng`` drives every choice, so a (corpus,
+    seed) pair reproduces bit-identical features across runs/hosts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def documents_from_text_file(path, tokenizer):
+    """Read the reference input format (one sentence per line; blank
+    lines delimit documents) into token-id documents, dropping empties.
+
+    Returns list of documents; each document is a list of segments;
+    each segment is a list of token ids."""
+    docs, cur = [], []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                if cur:
+                    docs.append(cur)
+                cur = []
+                continue
+            toks = tokenizer.tokenize(line)
+            if toks:
+                cur.append(tokenizer.convert_tokens_to_ids(toks))
+    if cur:
+        docs.append(cur)
+    return docs
+
+
+def mask_tokens(ids, special_mask, rng, vocab_size, mask_id, *,
+                masked_lm_prob=0.15, max_predictions=None):
+    """Apply the 80/10/10 MLM recipe to one sequence (ids: int array).
+
+    Returns (masked_ids, labels) where labels[j] = original id at
+    masked positions and -1 elsewhere (the BertForPreTraining
+    contract)."""
+    ids = np.asarray(ids)
+    cand = np.nonzero(~special_mask)[0]
+    rng.shuffle(cand)
+    n_pred = max(1, int(round(len(ids) * masked_lm_prob)))
+    if max_predictions is not None:
+        n_pred = min(n_pred, max_predictions)
+    picked = cand[:n_pred]
+    out = ids.copy()
+    labels = np.full(ids.shape, -1, np.int64)
+    labels[picked] = ids[picked]
+    roll = rng.random(len(picked))
+    mask_pos = picked[roll < 0.8]
+    rand_pos = picked[roll >= 0.9]
+    out[mask_pos] = mask_id
+    out[rand_pos] = rng.integers(0, vocab_size, rand_pos.shape)
+    return out, labels
+
+
+def create_pretraining_arrays(documents, tokenizer, *, max_seq_length=128,
+                              dupe_factor=1, short_seq_prob=0.1,
+                              masked_lm_prob=0.15,
+                              max_predictions_per_seq=None, seed=0):
+    """Documents (token-id segments) -> MLM/NSP feature arrays.
+
+    Packing follows the reference recipe (create_instances_from_document,
+    create_pretraining_data.py:191): accumulate segments to a target
+    length, split the chunk at a random point into A, then B is either
+    the rest of the chunk (NSP label 0 = "is next") or a random span
+    from another document (label 1 = "random"), with unused segments
+    pushed back.  ``dupe_factor`` repeats the corpus with different
+    masking (reference --dupe_factor)."""
+    rng = np.random.default_rng(seed)
+    vocab = tokenizer.vocab
+    cls_id = vocab[tokenizer.cls_token]
+    sep_id = vocab[tokenizer.sep_token]
+    mask_id = vocab[tokenizer.mask_token]
+    vocab_size = len(vocab)
+    max_tokens = max_seq_length - 3
+
+    rows = []
+    for _ in range(dupe_factor):
+        for d_idx, doc in enumerate(documents):
+            target = max_tokens
+            if rng.random() < short_seq_prob:
+                target = int(rng.integers(2, max_tokens))
+            chunk, chunk_len, i = [], 0, 0
+            while i < len(doc):
+                chunk.append(doc[i])
+                chunk_len += len(doc[i])
+                if i == len(doc) - 1 or chunk_len >= target:
+                    if chunk:
+                        rows.append(_pack_pair(
+                            chunk, documents, d_idx, target, max_tokens,
+                            rng))
+                        # _pack_pair may push back unused segments
+                        i -= rows[-1].pop("pushed_back")
+                    chunk, chunk_len = [], 0
+                i += 1
+
+    n = len(rows)
+    input_ids = np.zeros((n, max_seq_length), np.int32)
+    token_type = np.zeros((n, max_seq_length), np.int32)
+    attn = np.zeros((n, max_seq_length), np.float32)
+    mlm_labels = np.full((n, max_seq_length), -1, np.int64)
+    nsp = np.zeros((n,), np.int32)
+    for r, row in enumerate(rows):
+        a, b = row["a"], row["b"]
+        seq = [cls_id] + a + [sep_id] + b + [sep_id]
+        types = [0] * (len(a) + 2) + [1] * (len(b) + 1)
+        special = np.zeros(len(seq), bool)
+        special[0] = special[len(a) + 1] = special[-1] = True
+        masked, labels = mask_tokens(
+            np.asarray(seq, np.int64), special, rng, vocab_size, mask_id,
+            masked_lm_prob=masked_lm_prob,
+            max_predictions=max_predictions_per_seq)
+        L = len(seq)
+        input_ids[r, :L] = masked
+        token_type[r, :L] = types
+        attn[r, :L] = 1.0
+        mlm_labels[r, :L] = labels
+        nsp[r] = row["is_random"]
+    return {"input_ids": input_ids, "token_type_ids": token_type,
+            "attention_mask": attn,
+            "mlm_labels": mlm_labels.reshape(-1),
+            "nsp_labels": nsp}
+
+
+def _pack_pair(chunk, documents, d_idx, target, max_tokens, rng):
+    """Split a segment chunk into an (A, B) pair per the NSP recipe."""
+    a_end = 1
+    if len(chunk) >= 2:
+        a_end = int(rng.integers(1, len(chunk)))
+    a = [t for seg in chunk[:a_end] for t in seg]
+    pushed_back = 0
+    if len(chunk) == 1 or rng.random() < 0.5:
+        # random next: B comes from another document
+        is_random = 1
+        other = d_idx
+        if len(documents) > 1:
+            for _ in range(10):
+                other = int(rng.integers(0, len(documents)))
+                if other != d_idx:
+                    break
+        if other == d_idx:
+            is_random = 0
+            b = [t for seg in chunk[a_end:] for t in seg]
+        else:
+            b = []
+            odoc = documents[other]
+            start = int(rng.integers(0, len(odoc)))
+            for seg in odoc[start:]:
+                b.extend(seg)
+                if len(b) >= target - len(a):
+                    break
+            pushed_back = len(chunk) - a_end  # unused segments: replay
+    else:
+        is_random = 0
+        b = [t for seg in chunk[a_end:] for t in seg]
+    if not b:   # degenerate single-segment doc: split A itself
+        half = max(1, len(a) // 2)
+        a, b, is_random = a[:half], a[half:] or a[:1], 0
+    # longest-first pair truncation, trimming front/back at random
+    # (reference truncate_seq_pair)
+    while len(a) + len(b) > max_tokens:
+        longer = a if len(a) >= len(b) else b
+        if rng.random() < 0.5:
+            longer.pop(0)
+        else:
+            longer.pop()
+    return {"a": a, "b": b, "is_random": is_random,
+            "pushed_back": pushed_back}

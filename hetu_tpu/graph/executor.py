@@ -558,8 +558,11 @@ class Executor:
         sub = self.subexecutor[name]
         if trace_dir is None:
             return sub.profile(feed_dict, repeats=repeats)
-        sub.run(feed_dict)  # compile + warm OUTSIDE the capture, so the
-        # aggregates cover exactly `repeats` steps (matching meta)
+        # compile + warm OUTSIDE the capture — and BLOCK, so no async
+        # warmup work leaks in: the aggregates cover exactly `repeats`
+        # steps (matching meta)
+        out = sub.run(feed_dict)
+        jax.block_until_ready([o for o in out if o is not None])
         with jax.profiler.trace(trace_dir):
             start = time.perf_counter()
             for _ in range(repeats):

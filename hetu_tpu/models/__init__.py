@@ -1,6 +1,7 @@
 from .mlp import MLP, LeNet
 from .resnet import ResNet, resnet18, resnet34
-from .bert import BertConfig, BertModel, BertForPreTraining
+from .bert import (BertConfig, BertModel, BertForPreTraining,
+                   BertForSequenceClassification)
 from .gpt import GPTConfig, GPTModel, GPTLMHeadModel, GPT_CONFIGS
 from .ctr import WDL, DeepFM, DCN, DLRM
 from .gnn import (DistGCN15D, GCNLayerOp, distgcn_15d_op, gcn_conv_op,

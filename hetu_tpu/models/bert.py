@@ -264,6 +264,33 @@ class MaskedSelectLabelsOp(Op):
         return jnp.where(live, labels[pos], -1)
 
 
+class BertForSequenceClassification:
+    """Pooled-CLS classifier head for GLUE fine-tuning (reference
+    examples/nlp/bert/test_glue_hetu_bert.py builds the same
+    dropout(pooled) -> Linear(num_labels) head)."""
+
+    @scoped_init
+    def __init__(self, config, num_labels, name="bert"):
+        self.config = config
+        self.num_labels = num_labels
+        self.bert = BertModel(config, name=name)
+        self.dropout_keep = 1.0 - config.hidden_dropout_prob
+        self.classifier = Linear(config.hidden_size, num_labels,
+                                 initializer=init.normal(0.0, 0.02),
+                                 name=f"{name}_classifier")
+
+    def __call__(self, input_ids, token_type_ids, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        if self.dropout_keep < 1.0:
+            pooled = dropout_op(pooled, self.dropout_keep)
+        return self.classifier(pooled)
+
+    def loss(self, input_ids, token_type_ids, attention_mask, labels):
+        logits = self(input_ids, token_type_ids, attention_mask)
+        return reduce_mean_op(
+            softmax_cross_entropy_sparse_op(logits, labels)), logits
+
+
 class MaskedMeanOp(Op):
     """Mean of per-token losses over positions with label >= 0 (the
     reference normalizes MLM loss by the masked-token count)."""
