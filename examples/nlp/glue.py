@@ -95,11 +95,18 @@ def main():
 
     def evaluate():
         preds, gold = [], []
-        for batch in dev.batches(B):
+        # keep the remainder: pad the last partial batch up to B (one
+        # compiled shape) and trim its predictions back
+        for batch in dev.batches(B, drop_remainder=False):
+            n_real = len(batch["label_ids"])
+            if n_real < B:
+                batch = {k: np.concatenate(
+                    [v, np.repeat(v[:1], B - n_real, axis=0)])
+                    for k, v in batch.items()}
             out = ex.run("eval", feed_dict=feeds(batch),
                          convert_to_numpy_ret_vals=True)[0]
-            preds.append(np.argmax(out, -1))
-            gold.append(batch["label_ids"])
+            preds.append(np.argmax(out, -1)[:n_real])
+            gold.append(batch["label_ids"][:n_real])
         preds, gold = np.concatenate(preds), np.concatenate(gold)
         res = {"accuracy": float((preds == gold).mean())}
         if args.task == "mrpc":
@@ -118,7 +125,7 @@ def main():
         res = evaluate()
         print(f"epoch {epoch}: loss {np.mean(run_loss):.4f} "
               f"dev {res} ({time.time()-t0:.1f}s)")
-    return evaluate()
+    return res
 
 
 if __name__ == "__main__":

@@ -1,0 +1,15 @@
+"""Distributed GNN tooling: graph partitioning + neighbor-sampling
+dataloaders (reference: examples/gnn/gnn_tools/part_graph.py via the
+GraphMix submodule, python/hetu/dataloader.py:253 GNNDataLoaderOp).
+
+The compute side lives in models/gnn.py (gcn_conv, DistGCN15D); this
+package owns the data side: cutting a graph into device-sized parts and
+streaming sampled subgraph batches.
+"""
+
+from .partition import GraphPartition, partition_graph, save_partition, \
+    load_partition
+from .sampling import NeighborSampler, GNNDataLoader
+
+__all__ = ["GraphPartition", "partition_graph", "save_partition",
+           "load_partition", "NeighborSampler", "GNNDataLoader"]

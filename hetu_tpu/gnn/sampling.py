@@ -1,0 +1,145 @@
+"""Neighbor sampling + the GNN dataloader (reference GNNDataLoaderOp,
+python/hetu/dataloader.py:253 — a double-buffered graph feed where
+``step(next_graph)`` publishes the next sampled subgraph while the
+current one trains).
+
+TPU shape discipline: every sampled batch is RECTANGULAR — per-parent
+fanout sampling (GraphSAGE-style, duplicates allowed) gives exactly
+``B*f1 + B*f1*f2 + ...`` edges, and the deduplicated node array is
+padded to the fixed worst-case ``B*(1 + f1 + f1*f2 + ...)`` — so ONE
+compiled program serves every batch (variable-degree CSR batches would
+retrace XLA every step)."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .partition import _build_csr
+
+
+class NeighborSampler:
+    """k-hop per-parent neighbor sampling with fixed fanouts.
+    Deterministic for a given seed.
+
+    Returns per batch (all shapes fixed for a fixed batch size):
+      nodes     [M]  original ids (seeds first; positions >= num_nodes
+                     are padding — no edge touches them).  M =
+                     B*(1 + f1 + f1*f2 + ...)
+      src, dst  [E]  edges in LOCAL subgraph indices, dst-owned form;
+                     E = B*(f1 + f1*f2 + ...).  Isolated parents get
+                     self-loop edges.
+      num_seeds      B (predictions read nodes[:B])
+      num_nodes      count of REAL (non-padding) entries in ``nodes``
+    """
+
+    def __init__(self, src, dst, num_nodes, fanouts=(10, 10), seed=0):
+        self.adj_start, self.adj = _build_csr(src, dst, num_nodes)
+        self.graph_nodes = num_nodes
+        self.fanouts = tuple(fanouts)
+        self.rng = np.random.default_rng(seed)
+
+    def node_budget(self, batch_size):
+        m, layer = batch_size, batch_size
+        for f in self.fanouts:
+            layer *= f
+            m += layer
+        return m
+
+    def sample(self, seeds):
+        seeds = np.asarray(seeds, np.int64)
+        nodes = list(seeds)
+        local = {int(s): i for i, s in enumerate(seeds)}
+        src_l, dst_l = [], []
+        # frontier keeps DUPLICATES: per-parent fanout => fixed edge count
+        frontier = [int(s) for s in seeds]
+        for fanout in self.fanouts:
+            nxt = []
+            for u in frontier:
+                neigh = self.adj[self.adj_start[u]:self.adj_start[u + 1]]
+                if len(neigh) == 0:
+                    picked = np.full(fanout, u, np.int64)   # self-loops
+                else:
+                    picked = self.rng.choice(neigh, size=fanout,
+                                             replace=True)
+                for v in picked:
+                    v = int(v)
+                    if v not in local:
+                        local[v] = len(nodes)
+                        nodes.append(v)
+                    src_l.append(local[v])
+                    dst_l.append(local[u])
+                    nxt.append(v)
+            frontier = nxt
+        num_real = len(nodes)
+        budget = self.node_budget(len(seeds))
+        # pad with a dummy original id (0) at positions no edge touches:
+        # feature gathers stay rectangular, results for pads are ignored
+        nodes = np.asarray(nodes + [0] * (budget - num_real), np.int64)
+        return {"nodes": nodes,
+                "src": np.asarray(src_l, np.int64),
+                "dst": np.asarray(dst_l, np.int64),
+                "num_seeds": len(seeds),
+                "num_nodes": num_real}
+
+
+class GNNDataLoader:
+    """Double-buffered sampled-subgraph stream (GNNDataLoaderOp role).
+
+    A background thread samples batch t+1 while batch t trains —
+    ``__next__`` swaps the buffers, exactly the reference's
+    graph/nxt_graph classmethod pair, minus the globals.  Worker
+    exceptions re-raise in the consumer thread."""
+
+    _END = object()
+
+    def __init__(self, sampler, train_nodes, batch_size, *, seed=0,
+                 drop_remainder=True):
+        self.sampler = sampler
+        self.train_nodes = np.asarray(train_nodes, np.int64)
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+        self.rng = np.random.default_rng(seed)
+        self._order = None
+        self._cursor = 0
+        self._next = self._END
+        self._error = None
+        self._thread = None
+
+    def __iter__(self):
+        self._order = self.rng.permutation(self.train_nodes)
+        self._cursor = 0
+        self._prefetch()
+        return self
+
+    def _sample_next(self):
+        if self._cursor >= len(self._order):
+            return self._END
+        end = self._cursor + self.batch_size
+        if end > len(self._order) and self.drop_remainder:
+            return self._END
+        seeds = self._order[self._cursor:end]
+        self._cursor = end
+        return self.sampler.sample(seeds)
+
+    def _prefetch(self):
+        def work():
+            try:
+                self._next = self._sample_next()
+            except BaseException as e:   # surfaced in __next__
+                self._error = e
+                self._next = self._END
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def __next__(self):
+        self._thread.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        batch = self._next
+        if batch is self._END:
+            raise StopIteration
+        self._prefetch()           # overlap next sample with training
+        return batch

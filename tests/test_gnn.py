@@ -83,3 +83,169 @@ def test_distgcn_op_in_graph_training(rng):
                            convert_to_numpy_ret_vals=True)[0])
               for _ in range(30)]
     assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+# -- distributed tier: partitioner + sampler + loader (VERDICT r3 #5) ----
+
+def _planted_graph(rng, n=128, classes=4, edges=768, p_cross=0.1):
+    comm = rng.integers(0, classes, n)
+    src, dst = [], []
+    while len(src) < edges:
+        u, v = rng.integers(0, n, 2)
+        if comm[u] == comm[v] or rng.random() < p_cross:
+            src.append(u)
+            dst.append(v)
+    return np.asarray(src), np.asarray(dst), comm
+
+
+def test_partition_balance_cut_and_reindex(rng):
+    from hetu_tpu.gnn import partition_graph
+    n, nparts = 128, 4
+    src, dst, comm = _planted_graph(rng, n)
+    gp = partition_graph(src, dst, n, nparts, seed=0)
+    # balance within the 5% cap
+    sizes = np.diff(gp.offsets)
+    assert sizes.sum() == n
+    assert sizes.max() <= int(np.ceil(1.05 * n / nparts))
+    # beats random assignment on edge cut (community structure present)
+    rand_part = rng.integers(0, nparts, n)
+    rand_cut = int((rand_part[src] != rand_part[dst]).sum())
+    assert gp.edge_cut < rand_cut, (gp.edge_cut, rand_cut)
+    # permutation is consistent: perm/inv_perm inverse, parts contiguous
+    assert (gp.perm[gp.inv_perm] == np.arange(n)).all()
+    for p in range(nparts):
+        owned = gp.part_nodes(p)
+        assert (gp.part[owned] == p).all()
+    # local edges: every edge lands in its dst's part exactly once
+    total = sum(len(s) for s, _ in gp.local_edges)
+    assert total == len(src)
+    for p, (es, ed) in enumerate(gp.local_edges):
+        assert (gp.part[ed] == p).all()
+        # halos are exactly the remote srcs
+        remote = np.unique(es[gp.part[es] != p])
+        np.testing.assert_array_equal(np.sort(gp.halos[p]), remote)
+    # determinism
+    gp2 = partition_graph(src, dst, n, nparts, seed=0)
+    np.testing.assert_array_equal(gp.part, gp2.part)
+
+
+def test_partition_save_load_roundtrip(rng, tmp_path):
+    from hetu_tpu.gnn import partition_graph, save_partition, load_partition
+    src, dst, _ = _planted_graph(rng, 64, edges=256)
+    gp = partition_graph(src, dst, 64, 4, seed=1)
+    save_partition(gp, str(tmp_path / "parts"))
+    gp2 = load_partition(str(tmp_path / "parts"))
+    np.testing.assert_array_equal(gp.part, gp2.part)
+    np.testing.assert_array_equal(gp.offsets, gp2.offsets)
+    for p in range(4):
+        np.testing.assert_array_equal(gp.local_edges[p][0],
+                                      gp2.local_edges[p][0])
+        np.testing.assert_array_equal(gp.halos[p], gp2.halos[p])
+
+
+def test_neighbor_sampler_shapes_and_membership(rng):
+    from hetu_tpu.gnn import NeighborSampler
+    n = 64
+    src, dst, _ = _planted_graph(rng, n, edges=512)
+    s = NeighborSampler(src, dst, n, fanouts=(4, 3), seed=0)
+    seeds = np.asarray([0, 5, 9, 17])
+    batch = s.sample(seeds)
+    # RECTANGULAR contract: exactly B*f1 + B*f1*f2 edges and the fixed
+    # node budget B*(1 + f1 + f1*f2), padded past num_nodes
+    assert batch["num_seeds"] == 4
+    np.testing.assert_array_equal(batch["nodes"][:4], seeds)
+    assert batch["src"].shape == batch["dst"].shape
+    assert len(batch["src"]) == 4 * 4 + 4 * 4 * 3
+    assert len(batch["nodes"]) == s.node_budget(4)
+    assert batch["num_nodes"] <= len(batch["nodes"])
+    # a second batch has IDENTICAL shapes (one compiled program)
+    b2 = s.sample(np.asarray([1, 2, 3, 4]))
+    assert b2["nodes"].shape == batch["nodes"].shape
+    assert b2["src"].shape == batch["src"].shape
+    # every local index is real (edges never touch padding), every
+    # sampled edge exists (or is a self-loop pad)
+    nodes = batch["nodes"]
+    assert batch["src"].max() < batch["num_nodes"]
+    adj = set(zip(src.tolist(), dst.tolist())) | \
+        set(zip(dst.tolist(), src.tolist()))
+    for ls, ld in zip(batch["src"][:50], batch["dst"][:50]):
+        u, v = int(nodes[ls]), int(nodes[ld])
+        assert u == v or (u, v) in adj
+
+
+def test_gnn_dataloader_double_buffer(rng):
+    from hetu_tpu.gnn import NeighborSampler, GNNDataLoader
+    n = 64
+    src, dst, _ = _planted_graph(rng, n, edges=512)
+    s = NeighborSampler(src, dst, n, fanouts=(3,), seed=0)
+    loader = GNNDataLoader(s, np.arange(n), batch_size=16, seed=0)
+    batches = list(loader)
+    assert len(batches) == 4
+    seen = np.concatenate([b["nodes"][:b["num_seeds"]] for b in batches])
+    assert len(np.unique(seen)) == n          # epoch covers all nodes
+    # worker exceptions surface in the consumer (not silent stale loops)
+    bad = GNNDataLoader(s, np.asarray([10 ** 9]), batch_size=1, seed=0)
+    with pytest.raises(IndexError):
+        list(bad)
+    # batches feed gcn_conv end-to-end (local reindexed edges)
+    b = batches[0]
+    h = rng.standard_normal((len(b["nodes"]), 8)).astype(np.float32)
+    w = rng.standard_normal((8, 4)).astype(np.float32)
+    out = _gcn_conv(jnp.asarray(h), jnp.asarray(w), src=b["src"],
+                    dst=b["dst"], num_nodes=len(b["nodes"]))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_partitioned_distgcn_loss_parity(rng):
+    """Multi-device GCN training over a PARTITIONED graph matches the
+    single-device trajectory step for step (the run_dist.py role) —
+    driving the SAME build_train_fn the example ships."""
+    import importlib.util
+    import os
+    import jax
+    from hetu_tpu.gnn import partition_graph
+
+    spec = importlib.util.spec_from_file_location(
+        "train_dist_gcn", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "gnn", "train_dist_gcn.py"))
+    example = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(example)
+
+    n, C, F, H = 64, 4, 8, 12
+    src, dst, comm = _planted_graph(rng, n, classes=C, edges=384)
+    labels = comm.astype(np.int32)
+    feats = (rng.standard_normal((n, F)).astype(np.float32)
+             + np.eye(C, F, dtype=np.float32)[comm])
+    mask = (rng.random(n) < 0.7).astype(np.float32)
+    gp = partition_graph(src, dst, n, 4, seed=0)
+    a = normalized_adjacency(gp.perm[src], gp.perm[dst], n)
+    h, y, m = feats[gp.inv_perm], labels[gp.inv_perm], mask[gp.inv_perm]
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("block", "rep"))
+    lr = 0.3
+    dist_step = example.build_train_fn(mesh, lr)
+    params = {"w1": jnp.asarray(
+                  rng.standard_normal((F, H)) * 0.3, jnp.float32),
+              "w2": jnp.asarray(
+                  rng.standard_normal((H, C)) * 0.3, jnp.float32)}
+
+    @jax.jit
+    def single_step(p):
+        def f(q):
+            z1 = jax.nn.relu(a @ (h @ q["w1"]))
+            ll = jax.nn.log_softmax(a @ (z1 @ q["w2"]), -1)
+            picked = jnp.take_along_axis(ll, y[:, None], 1)[:, 0]
+            return -jnp.sum(picked * m) / m.sum()
+        loss, g = jax.value_and_grad(f)(p)
+        return jax.tree_util.tree_map(lambda x, d: x - lr * d, p, g), loss
+
+    pd = ps = params
+    aj, hj = jnp.asarray(a), jnp.asarray(h)
+    yj, mj = jnp.asarray(y), jnp.asarray(m)
+    for i in range(10):
+        pd, ld = dist_step(pd, aj, hj, yj, mj)
+        ps, ls = single_step(ps)
+        np.testing.assert_allclose(float(ld), float(ls), rtol=2e-4,
+                                   atol=2e-5)
