@@ -114,6 +114,7 @@ def main():
         return res
 
     step = 0
+    res = None
     for epoch in range(args.epochs):
         t0 = time.time()
         run_loss = []
@@ -125,6 +126,9 @@ def main():
         res = evaluate()
         print(f"epoch {epoch}: loss {np.mean(run_loss):.4f} "
               f"dev {res} ({time.time()-t0:.1f}s)")
+    if res is None:               # --epochs 0: eval-only
+        res = evaluate()
+        print(f"eval-only dev {res}")
     return res
 
 

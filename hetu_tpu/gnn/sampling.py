@@ -108,6 +108,9 @@ class GNNDataLoader:
         self._thread = None
 
     def __iter__(self):
+        if self._thread is not None:
+            self._thread.join()   # a prior partial epoch's in-flight
+            self._error = None    # worker must not race the reset below
         self._order = self.rng.permutation(self.train_nodes)
         self._cursor = 0
         self._prefetch()
