@@ -1,0 +1,83 @@
+"""Lookup latency under concurrent bulk pushes: priority lanes on/off.
+
+Reference: ps-lite's P3 van (p3_van.h:12) schedules latency-critical
+messages ahead of bulk transfers and slices large messages.  Our TCP
+transport maps the same two-class design onto LANE SEPARATION (a
+reserved connection for small verbs) + client-side push slicing
+(RemoteTable.bulk_chunk_rows).  This benchmark measures what that buys:
+lookup p50/p99 while a background thread streams large gradient pushes,
+with the feature off vs on.
+
+    python benchmarks/ps_priority_bench.py
+Prints one JSON line with both configurations' latencies.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..")))
+
+import numpy as np
+
+from hetu_tpu.ps.store import EmbeddingTable
+from hetu_tpu.ps.rpc import PSServer, RemoteTable
+
+
+def measure(priority_channels, bulk_chunk_rows, *, rows=200_000, dim=64,
+            n_lookups=300, lookup_keys=128, push_rows=100_000,
+            duration=6.0):
+    table = EmbeddingTable(rows, dim, optimizer="sgd", lr=0.01)
+    server = PSServer({"": table})
+    server.start()
+    host, port = server.host, server.port
+    client = RemoteTable(host, port, pool_size=3,
+                         priority_channels=priority_channels,
+                         bulk_chunk_rows=bulk_chunk_rows)
+    rng = np.random.default_rng(0)
+    stop = threading.Event()
+
+    def pusher():
+        keys = rng.integers(0, rows, push_rows)
+        grads = rng.standard_normal((push_rows, dim)).astype(np.float32)
+        while not stop.is_set():
+            client.push(keys, grads)
+
+    t = threading.Thread(target=pusher, daemon=True)
+    t.start()
+    time.sleep(0.3)   # let bulk traffic saturate
+    lat = []
+    deadline = time.monotonic() + duration
+    for _ in range(n_lookups):
+        if time.monotonic() > deadline:
+            break
+        keys = rng.integers(0, rows, lookup_keys)
+        t0 = time.perf_counter()
+        client.lookup(keys)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    stop.set()
+    t.join(timeout=30)
+    client.close()
+    server.stop()
+    lat = np.asarray(lat)
+    return {"priority_channels": priority_channels,
+            "bulk_chunk_rows": bulk_chunk_rows,
+            "n": int(lat.size),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3)}
+
+
+def main():
+    off = measure(False, 1 << 62)     # FIFO, unsliced (pre-P3 behavior)
+    on = measure(True, 16384)
+    print(json.dumps({
+        "metric": "ps_lookup_latency_under_bulk_push",
+        "unit": "ms", "off": off, "on": on,
+        "p99_speedup": round(off["p99_ms"] / max(on["p99_ms"], 1e-9), 2)}))
+
+
+if __name__ == "__main__":
+    main()

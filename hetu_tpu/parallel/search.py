@@ -452,10 +452,30 @@ class FlexFlowSearch:
                 assign[n] = max(cands[n],
                                 key=lambda c: (c.tp, -c.dp))
             if not cost.feasible(assign):
-                raise ValueError(
-                    "FlexFlow found no feasible assignment under "
-                    f"mem_budget_bytes={self.mem_budget_bytes} (even "
-                    "the max-tp layout exceeds the per-device budget)")
+                # neither corner fits: sweep the uniform (dp, tp) grids
+                # (mixed layouts can fit when pure-dp blows the weight
+                # budget AND pure-tp blows the dp-unsharded activations)
+                grids = sorted({(c.dp, c.tp)
+                                for cc in cands.values() for c in cc})
+                for dp, tp in grids:
+                    trial = {}
+                    for n in chain:
+                        match = [c for c in cands[n]
+                                 if (c.dp, c.tp) == (dp, tp)] or \
+                                [c for c in cands[n]
+                                 if (c.dp, c.tp) == (dp, 1)]
+                        if not match:
+                            break
+                        trial[n] = match[0]
+                    if len(trial) == len(chain) and cost.feasible(trial):
+                        assign = trial
+                        break
+                else:
+                    raise ValueError(
+                        "FlexFlow found no feasible assignment under "
+                        f"mem_budget_bytes={self.mem_budget_bytes} (no "
+                        "corner or uniform-grid layout fits the "
+                        "per-device budget)")
         cur = cost.total(assign)
         best, best_assign = cur, dict(assign)
         for _ in range(self.iters):

@@ -319,9 +319,13 @@ class RemoteTable:
 
     _cid_counter = itertools.count()
 
-    def __init__(self, host, port, timeout=30.0, pool_size=2,
+    def __init__(self, host, port, timeout=30.0, pool_size=3,
                  retry_deadline=60.0, heartbeat_interval=None, table="",
-                 fetch_meta=True):
+                 fetch_meta=True, priority_channels=True,
+                 bulk_chunk_rows=65536):
+        # pool_size default is 3 so the reserved priority lane leaves
+        # TWO bulk connections — the same bulk concurrency the pre-lane
+        # pool_size=2 default offered
         self._addr = (host, int(port))
         self._timeout = timeout
         self._deadline = retry_deadline
@@ -331,7 +335,27 @@ class RemoteTable:
         self._seq = itertools.count()
         self._seq_lock = threading.Lock()
         self._pool = [_Conn() for _ in range(max(1, int(pool_size)))]
-        self._pool_sem = threading.Semaphore(len(self._pool))
+        # priority classes (reference ps-lite p3_van.h:12, selected via
+        # DMLC_PS_VAN_TYPE='p3': latency-critical messages scheduled
+        # ahead of bulk transfers).  TCP gives each connection its own
+        # kernel queue, so the two-class design maps to LANE SEPARATION:
+        # connection 0 is reserved for small latency-critical verbs
+        # (lookup/versions/meta/control), the rest carry bulk traffic
+        # (push/set_rows/save/load/reduce) — a bulk push in flight can no
+        # longer head-of-line-block a lookup.  Bulk pushes are
+        # additionally SLICED into ``bulk_chunk_rows`` requests (p3's
+        # message slicing) so the server interleaves lookups between
+        # chunks instead of stalling for one giant apply.
+        if priority_channels and len(self._pool) > 1:
+            self._lanes = {True: self._pool[:1], False: self._pool[1:]}
+        else:
+            self._lanes = {True: self._pool, False: self._pool}
+        self._sems = {
+            True: threading.Semaphore(len(self._lanes[True])),
+            False: threading.Semaphore(len(self._lanes[False]))}
+        if self._lanes[True] is self._lanes[False]:
+            self._sems[True] = self._sems[False]
+        self.bulk_chunk_rows = int(bulk_chunk_rows)
         self._closed = False
         self.last_pong = None
         self._hb_thread = None
@@ -348,22 +372,27 @@ class RemoteTable:
     def _connect(self):
         return socket.create_connection(self._addr, timeout=self._timeout)
 
-    def _acquire(self):
-        self._pool_sem.acquire()
-        for c in self._pool:
+    def _acquire(self, priority=False):
+        self._sems[priority].acquire()
+        for c in self._lanes[priority]:
             if c.lock.acquire(blocking=False):
-                return c
+                return c, priority
         # unreachable: the semaphore guarantees a free connection
-        self._pool_sem.release()
+        self._sems[priority].release()
         raise RuntimeError("connection pool accounting broken")
 
-    def _release(self, conn):
+    def _release(self, conn, priority):
         conn.lock.release()
-        self._pool_sem.release()
+        self._sems[priority].release()
 
     def _next_seq(self):
         with self._seq_lock:
             return next(self._seq)
+
+    # latency-critical verbs ride the priority lane; everything else is bulk
+    _PRIORITY_VERBS = frozenset({"lookup", "versions", "meta", "ping",
+                                 "clocks", "tick", "preduce_join",
+                                 "shutdown"})
 
     def _call(self, header, *arrays, conn=None):
         """Send with (cid, seq), await the matching reply; on socket
@@ -375,7 +404,8 @@ class RemoteTable:
             header.setdefault("table", self._table)
         pooled = conn is None
         if pooled:
-            conn = self._acquire()
+            conn, prio = self._acquire(
+                header.get("verb") in self._PRIORITY_VERBS)
         else:
             conn.lock.acquire()
         try:
@@ -408,7 +438,7 @@ class RemoteTable:
                     f"(last error: {last_err})")
         finally:
             if pooled:
-                self._release(conn)
+                self._release(conn, prio)
             else:
                 conn.lock.release()
         if reply.get("verb") != "ok":
@@ -444,15 +474,38 @@ class RemoteTable:
         return np.frombuffer(payloads[0], "<f4").reshape(
             keys.size, self.dim).copy()
 
+    def _chunked(self, verb, keys, vals):
+        """Slice a bulk mutation into bulk_chunk_rows requests (p3-style
+        slicing — each chunk gets its own seq, so the transport-level
+        retransmit dedup still holds per chunk and lookups interleave
+        between chunks).
+
+        Failure granularity: a ConnectionError past retry_deadline can
+        leave a PREFIX of chunks applied.  This is the same uncertainty
+        class as the unsliced call (whose reply can be lost after the
+        server applied it) at finer granularity; callers that retry a
+        RAISED push at the application level double-apply in either
+        design — checkpoint-restore style writers should prefer
+        set_rows, which is idempotent per row."""
+        step = max(1, self.bulk_chunk_rows)
+        if keys.size == 0:
+            # still round-trip once: surfaces dead-server / bad-table
+            # errors exactly like the unsliced call did
+            self._call({"verb": verb}, keys, vals)
+            return
+        for i in range(0, keys.size, step):
+            self._call({"verb": verb}, keys[i:i + step],
+                       vals[i:i + step])
+
     def push(self, keys, grads):
         keys = np.asarray(keys).reshape(-1).astype("<i8")
         grads = np.asarray(grads, "<f4").reshape(keys.size, self.dim)
-        self._call({"verb": "push"}, keys, grads)
+        self._chunked("push", keys, grads)
 
     def set_rows(self, keys, values):
         keys = np.asarray(keys).reshape(-1).astype("<i8")
         values = np.asarray(values, "<f4").reshape(keys.size, self.dim)
-        self._call({"verb": "set_rows"}, keys, values)
+        self._chunked("set_rows", keys, values)
 
     def versions(self, keys):
         keys = np.asarray(keys).reshape(-1).astype("<i8")

@@ -402,3 +402,62 @@ def test_reduce_times_out_on_dead_member():
         sock.close()
     finally:
         server.stop()
+
+
+def test_push_chunking_matches_single_apply():
+    """p3-style slicing must not change semantics: a sliced push applies
+    exactly what one big push applies (per-chunk dedup keys intact)."""
+    from hetu_tpu.ps.store import EmbeddingTable
+    from hetu_tpu.ps.rpc import PSServer, RemoteTable
+    rng = np.random.default_rng(0)
+    rows, dim, n = 512, 8, 300
+    keys = rng.integers(0, rows, n)
+    grads = rng.standard_normal((n, dim)).astype(np.float32)
+    out = {}
+    for chunk in (1 << 62, 64):     # unsliced vs 5 chunks
+        table = EmbeddingTable(rows, dim, optimizer="sgd", lr=0.1, seed=3)
+        server = PSServer({"": table})
+        server.start()
+        client = RemoteTable(server.host, server.port,
+                             bulk_chunk_rows=chunk)
+        client.push(keys, grads)
+        out[chunk] = client.lookup(np.arange(rows))
+        client.close()
+        server.stop()
+    np.testing.assert_allclose(out[1 << 62], out[64], rtol=1e-6)
+
+
+def test_priority_lane_serves_lookups_during_bulk_push():
+    """With priority lanes, lookups complete while a large push streams
+    on the bulk lane (and the numbers still add up afterwards)."""
+    import threading
+    from hetu_tpu.ps.store import EmbeddingTable
+    from hetu_tpu.ps.rpc import PSServer, RemoteTable
+    rng = np.random.default_rng(0)
+    rows, dim = 4096, 32
+    table = EmbeddingTable(rows, dim, optimizer="sgd", lr=0.01, seed=1)
+    server = PSServer({"": table})
+    server.start()
+    client = RemoteTable(server.host, server.port, pool_size=3,
+                         priority_channels=True, bulk_chunk_rows=1024)
+    n_push = 40960
+    keys = rng.integers(0, rows, n_push)
+    grads = rng.standard_normal((n_push, dim)).astype(np.float32)
+    done = threading.Event()
+
+    def pusher():
+        for _ in range(3):
+            client.push(keys, grads)
+        done.set()
+
+    t = threading.Thread(target=pusher, daemon=True)
+    t.start()
+    served = 0
+    while not done.is_set():
+        v = client.lookup(rng.integers(0, rows, 32))
+        assert v.shape == (32, dim)
+        served += 1
+    t.join()
+    assert served > 0
+    client.close()
+    server.stop()
