@@ -184,6 +184,20 @@ def bench_gpt_layer(quick):
     else:
         B, S, H, heads, n_layers, reps = 2, 2048, 2560, 32, 30, 5
     d = H // heads
+    import gc
+    from benchmarks.flax_baselines import gpt_layer_fwd_ms, gpt_layer_group
+    kw = dict(batch=B, seq=S, hidden=H, heads=heads,
+              n_layers=n_layers) if quick else {}
+    # jax's public flash kernel baseline: consistently far behind at
+    # d=80 (10.8 vs 6.6 ms stock in every capture) — measured FIRST on
+    # its own build (its f32 param stack cannot co-reside with ours +
+    # the stock baseline in HBM), then freed
+    try:
+        flash_ms = _rerun(gpt_layer_fwd_ms, lower_is_better=True,
+                          flash=True, reps=reps, **kw)
+    except Exception:
+        flash_ms = None
+    gc.collect()
     dtype = jnp.bfloat16
     key = jax.random.key(0)
     ks = jax.random.split(key, 6)
@@ -191,7 +205,13 @@ def bench_gpt_layer(quick):
     params = {
         "ln1": jnp.ones((n_layers, H), dtype),
         "ln2": jnp.ones((n_layers, H), dtype),
-        "qkv": jax.random.normal(ks[0], (n_layers, H, 3 * H), dtype) * s3,
+        # qkv weight shaped [H, 3, heads, d]: the head split+transpose
+        # rides the projection einsum's epilogue (the separate
+        # reshape->transpose materialized a copy of q/k/v every layer,
+        # ~0.25 ms at this shape) — same trick layers/attention.py
+        # ships via head_split_linear_op
+        "qkv": jax.random.normal(ks[0], (n_layers, H, 3, heads, d),
+                                 dtype) * s3,
         "proj": jax.random.normal(ks[1], (n_layers, H, H), dtype) * s3,
         "fc1": jax.random.normal(ks[2], (n_layers, H, 4 * H), dtype) * s3,
         "fc2": jax.random.normal(ks[3], (n_layers, 4 * H, H), dtype) * s3,
@@ -206,10 +226,8 @@ def bench_gpt_layer(quick):
 
     def layer(x, p):
         h = ln(x, p["ln1"])
-        qkv = h @ p["qkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        rs = lambda t: t.reshape(B, S, heads, d).transpose(0, 2, 1, 3)
-        o = flash_attention(rs(q), rs(k), rs(v), causal=True)
+        qkv = jnp.einsum("bsE,Ekhd->kbhsd", h, p["qkv"])
+        o = flash_attention(qkv[0], qkv[1], qkv[2], causal=True)
         assert o is not None, "flash kernel must cover the GPT shape"
         o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
         x = x + o @ p["proj"]
@@ -222,24 +240,29 @@ def bench_gpt_layer(quick):
         out, _ = jax.lax.scan(lambda c, p: layer(c, p), x, params)
         return jnp.sum(out.astype(jnp.float32))
 
-    dt, _ = _timeit(lambda: fwd(params, x), reps)
-    ours_ms = dt * 1000.0 / n_layers
-    # free our stacked params before the flax baseline allocates its own
-    # 30-layer f32 stack — together they exceed one chip's HBM
-    del params, x
-    fwd.clear_cache()
-    import gc
-    gc.collect()
-
-    from benchmarks.flax_baselines import gpt_layer_fwd_ms
-    kw = dict(batch=B, seq=S, hidden=H, heads=heads,
-              n_layers=n_layers, reps=reps) if quick else {}
-    bar_ms, baselines = _with_flash_baseline(gpt_layer_fwd_ms,
-                                             lower_is_better=True, **kw)
-    baselines["reference_a100_ms"] = REFERENCE_A100_GPT_LAYER_MS
+    # interleaved ours/stock rounds (same drift rationale as bench_wdl);
+    # the stock baseline stores bf16 params like ours — f32 stacked
+    # weights would double its per-layer HBM reads AND overflow HBM
+    # next to ours
+    base_group = gpt_layer_group(param_dtype=jnp.bfloat16, **kw)
+    _sync(fwd(params, x))        # compile+warm ours OUTSIDE the rounds
+    ours_v, base_v = [], []
+    for _ in range(5):
+        dt, _ = (_time_group(lambda: fwd(params, x), reps), None)
+        ours_v.append(dt * 1000.0 / n_layers)
+        base_v.append(base_group(reps))
+    ours_ms = min(ours_v)
+    base_ms = min(base_v)
+    bars = [min(b, flash_ms) if flash_ms else b for b in base_v]
+    ratios = sorted(b / o for o, b in zip(ours_v, bars))
+    baselines = {"flax_same_chip_ms": round(base_ms, 4),
+                 "flax_flash_same_chip_ms":
+                     round(flash_ms, 4) if flash_ms else None,
+                 "reference_a100_ms": REFERENCE_A100_GPT_LAYER_MS}
     return {"metric": "gpt_2.7b_layer_fwd_ms", "value": round(ours_ms, 4),
             "unit": "ms (lower is better)",
-            "vs_baseline": round(bar_ms / ours_ms, 3),
+            "vs_baseline": round(ratios[len(ratios) // 2], 3),
+            "protocol": "interleaved_median_of_5",
             "baseline": baselines}
 
 

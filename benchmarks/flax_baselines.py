@@ -165,31 +165,35 @@ def bert_samples_per_sec(batch, seq_len, *, vocab=30522, hidden=768,
 # layertype_0 = 2.0645 ms on A100-40GB)
 # --------------------------------------------------------------------------
 
-def gpt_layer_fwd_ms(*, batch=2, seq=2048, hidden=2560, heads=32,
-                     n_layers=30, reps=5, flash=False):
-    """Stock-flax per-layer forward time via an n_layer scan inside ONE
-    jitted program (per-call timing through the dev tunnel is unreliable;
-    BASELINE.md methodology notes)."""
+def gpt_layer_group(*, batch=2, seq=2048, hidden=2560, heads=32,
+                    n_layers=30, flash=False, param_dtype=None):
+    """Build + warm the stock-flax n_layer-scan program ONCE; returns
+    ``group(reps) -> ms_per_layer`` (per-call timing through the dev
+    tunnel is unreliable; BASELINE.md methodology notes).
+    ``param_dtype=jnp.bfloat16`` stores the stacked weights bf16 — the
+    stronger (and ours-matching) choice for a forward bench: f32 params
+    double the per-layer weight reads."""
     import flax.linen as nn
 
     dtype = jnp.bfloat16
+    pdt = param_dtype or jnp.float32
 
     class Layer(nn.Module):
         @nn.compact
         def __call__(self, x):
-            h = nn.LayerNorm(dtype=dtype)(x)
+            h = nn.LayerNorm(dtype=dtype, param_dtype=pdt)(x)
             if flash:
                 h = _make_flash_mha(nn, heads, hidden, dtype,
                                     causal=True)(h)
             else:
                 h = nn.MultiHeadDotProductAttention(
                     num_heads=heads, dtype=dtype,
-                    param_dtype=jnp.float32)(h, h)
+                    param_dtype=pdt)(h, h)
             x = x + h
-            f = nn.LayerNorm(dtype=dtype)(x)
-            f = nn.Dense(4 * hidden, dtype=dtype)(f)
+            f = nn.LayerNorm(dtype=dtype, param_dtype=pdt)(x)
+            f = nn.Dense(4 * hidden, dtype=dtype, param_dtype=pdt)(f)
             f = nn.gelu(f)
-            return x + nn.Dense(hidden, dtype=dtype)(f)
+            return x + nn.Dense(hidden, dtype=dtype, param_dtype=pdt)(f)
 
     layer = Layer()
     key = jax.random.key(0)
@@ -207,12 +211,20 @@ def gpt_layer_fwd_ms(*, batch=2, seq=2048, hidden=2560, heads=32,
 
     out = fwd(stacked, x)
     float(out)  # forces materialization (dev-tunnel timing caveat)
-    start = time.perf_counter()
-    for _ in range(reps):
-        out = fwd(stacked, x)
-    float(out)
-    total = (time.perf_counter() - start) / reps
-    return total * 1000.0 / n_layers
+
+    def group(reps_):
+        start = time.perf_counter()
+        for _ in range(reps_):
+            out = fwd(stacked, x)
+        float(out)
+        return (time.perf_counter() - start) / reps_ * 1000.0 / n_layers
+
+    return group
+
+
+def gpt_layer_fwd_ms(*, reps=5, **kw):
+    """One-shot convenience over gpt_layer_group (same kwargs)."""
+    return gpt_layer_group(**kw)(reps)
 
 
 # --------------------------------------------------------------------------

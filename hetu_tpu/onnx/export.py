@@ -202,6 +202,31 @@ def _conv(node, ctx):
                    name=node.name)]
 
 
+@exporter("head_split_linear")
+def _head_split_linear(node, ctx):
+    # decomposes to MatMul (+Add) + Reshape + Transpose — all standard
+    # ONNX ops the importer round-trips
+    nh = node.attrs["n_heads"]
+    hd = node.attrs["head_dim"]
+    seq = node.attrs["seq_len"]
+    mm = f"{node.name}_mm"
+    nodes = [NodeIR("MatMul", [node.inputs[0].name, node.inputs[1].name],
+                    [mm], name=mm)]
+    cur = mm
+    if len(node.inputs) > 2:
+        ad = f"{node.name}_bias"
+        nodes.append(NodeIR("Add", [cur, node.inputs[2].name], [ad],
+                            name=ad))
+        cur = ad
+    shp = ctx.const(f"{node.name}_shape",
+                    np.asarray([-1, seq, nh, hd], np.int64))
+    rs = f"{node.name}_rs"
+    nodes.append(NodeIR("Reshape", [cur, shp], [rs], name=rs))
+    nodes.append(NodeIR("Transpose", [rs], [node.name],
+                        {"perm": [0, 2, 1, 3]}, name=node.name))
+    return nodes
+
+
 @exporter("conv2d_hwio", "conv2d_hwio_add_bias")
 def _conv_hwio(node, ctx):
     # layer weights are stored HWIO (TPU-native); ONNX Conv wants OIHW —

@@ -30,7 +30,24 @@ def _bmm(a, b, trans_A=False, trans_B=False):
     return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
 
 
+def _head_split_linear(x, w, bias=None, seq_len=None, n_heads=None,
+                       head_dim=None):
+    """x [B, S, E] (or [B*S, E]) @ w [E, n_heads*head_dim] emitted
+    directly as [B, heads, S, d]: the head transpose rides the matmul
+    epilogue instead of materializing a copy of the projected tensor
+    (attention layers' q/k/v path)."""
+    e = x.shape[-1]
+    x3 = x.reshape(-1, seq_len, e)
+    w4 = w.reshape(e, n_heads, head_dim)
+    out = jnp.einsum("bse,ehd->bhsd", x3, w4,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        out = out + bias.reshape(1, n_heads, 1, head_dim).astype(out.dtype)
+    return out
+
+
 matmul_op = simple_op(_mm, "matmul")
+head_split_linear_op = simple_op(_head_split_linear, "head_split_linear")
 batch_matmul_op = simple_op(_bmm, "batch_matmul")
 linear_op = simple_op(
     lambda x, w, bias, trans_A=False, trans_B=False:

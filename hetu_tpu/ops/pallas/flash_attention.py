@@ -37,6 +37,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
+
 _BLOCK_Q = 512
 _BLOCK_K = 512
 _NEG_INF = -1e30
@@ -121,7 +124,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, offs_ref,
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
     d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    # inputs stay in their storage dtype (bf16 models hit the MXU's
+    # bf16 rate — pre-casting to f32 forced f32-rate matmuls, ~4x
+    # slower); products/accumulation are f32 via preferred_element_type,
+    # identical numerics on the input side (bf16->f32 casts are exact)
+    q = q_ref[0]                                      # (bq, d)
     q_off = offs_ref[0] if offs_ref is not None else 0
     k_off = offs_ref[1] if offs_ref is not None else 0
     row = (q_off + qi * bq
@@ -137,19 +144,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, offs_ref,
 
     def body(j, carry):
         m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        # scores tracked in BASE-2 units (s2 = s * log2(e)): exp2 is the
+        # VPU's native exponential; lse converts back to natural units at
+        # the end so the backward's exp(s - lse) contract is unchanged
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) \
+            * (scale * _LOG2E)
         if mask_ref is not None:
-            s = s + mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+            s = s + (mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+                     * _LOG2E)
         if causal:
             col = (k_off + j * block_k
                    + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
             s = jnp.where(row >= col, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m - m_new)
         # l accumulates UN-dropped sums: O = dropout(P_normalized) @ V
         l_new = l * alpha + jnp.sum(p, axis=1)
         if keep_prob < 1.0:
@@ -157,7 +169,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, offs_ref,
             p = _drop_tile(p, seed_ref,
                            _tile_index(bh, qi, j, nq, nk_tot), keep_prob)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -167,6 +179,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, offs_ref,
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    m = m * _LN2    # back to natural-log units for the stored lse
     # fully-masked rows (l == 0, every key at -inf): output is 0; store
     # lse = +large so the backward's p = exp(s - lse) underflows to 0 —
     # storing m (≈ -1e30) instead would give p = exp(0) = 1 everywhere
@@ -250,8 +263,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
     d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0]
     dsum = dsum_ref[0, 0]
     q_off = offs_ref[0] if offs_ref is not None else 0
@@ -260,17 +273,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
            + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
 
     def body(j, acc):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        # base-2 scores (exp2 = native VPU exponential; p identical)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) \
+            * (scale * _LOG2E)
         if mask_ref is not None:
-            s = s + mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+            s = s + (mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+                     * _LOG2E)
         if causal:
             col = (k_off + j * block_k
                    + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
             s = jnp.where(row >= col, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp2(s - (lse * _LOG2E)[:, None])
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if keep_prob < 1.0:  # replay the fwd tile mask on dP
@@ -278,8 +294,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
             dp = _drop_tile(dp, seed_ref,
                             _tile_index(bh, qi, j, nq, nk_tot), keep_prob)
         ds = p * (dp - dsum[:, None])
-        return acc + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        return acc + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
     nk = k_len // block_k
@@ -299,8 +316,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
     ki = pl.program_id(1)
     bk = k_ref.shape[1]
     d = k_ref.shape[2]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     q_off = offs_ref[0] if offs_ref is not None else 0
     k_off = offs_ref[1] if offs_ref is not None else 0
     col = (k_off + ki * bk
@@ -310,19 +327,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
 
     def body(i, carry):
         dk, dv = carry
-        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :]
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
         dsum = dsum_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = jax.lax.dot_general(qb, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) \
+            * (scale * _LOG2E)
         if mblk is not None:
-            s = s + mblk
+            s = s + mblk * _LOG2E
         if causal:
             rr = (q_off + i * block_q
                   + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
             s = jnp.where(rr >= col, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp2(s - (lse * _LOG2E)[:, None])
         if keep_prob < 1.0:
             # fwd seeded by tile (bh, q-block=i, kv-block=ki)
             nq, nk_tot = q_len // block_q, k_len // bk
@@ -334,7 +352,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
             keep = None
             p_dropped = p
         dv_new = dv + jax.lax.dot_general(
-            p_dropped, dob, (((0,), (0,)), ((), ())),
+            p_dropped.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(dob, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -342,7 +360,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, mask_ref,
             dp = jnp.where(keep, dp / keep_prob, 0.0)
         ds = p * (dp - dsum[:, None])
         dk_new = dk + jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 

@@ -373,6 +373,17 @@ class RemoteTable:
         return socket.create_connection(self._addr, timeout=self._timeout)
 
     def _acquire(self, priority=False):
+        if priority and self._lanes[True] is not self._lanes[False]:
+            # prefer the reserved lane, but BORROW an idle bulk
+            # connection rather than queueing behind another priority
+            # call (bulk verbs never take the reserved lane, so the
+            # asymmetry keeps the lane free for the next small verb)
+            for lane in (True, False):
+                if self._sems[lane].acquire(blocking=False):
+                    for c in self._lanes[lane]:
+                        if c.lock.acquire(blocking=False):
+                            return c, lane
+                    self._sems[lane].release()
         self._sems[priority].acquire()
         for c in self._lanes[priority]:
             if c.lock.acquire(blocking=False):
@@ -389,10 +400,11 @@ class RemoteTable:
         with self._seq_lock:
             return next(self._seq)
 
-    # latency-critical verbs ride the priority lane; everything else is bulk
+    # latency-critical verbs ride the priority lane; everything else is
+    # bulk — including preduce_join, which BLOCKS server-side for up to
+    # wait_time during matchmaking and would head-of-line-block the lane
     _PRIORITY_VERBS = frozenset({"lookup", "versions", "meta", "ping",
-                                 "clocks", "tick", "preduce_join",
-                                 "shutdown"})
+                                 "clocks", "tick", "shutdown"})
 
     def _call(self, header, *arrays, conn=None):
         """Send with (cid, seq), await the matching reply; on socket

@@ -328,3 +328,28 @@ def test_repeat_kv_and_alibi(rng):
     # non-power-of-two head count still yields monotone positive slopes
     s12 = alibi_slopes(12)
     assert len(s12) == 12 and all(v > 0 for v in s12)
+
+
+def test_head_split_linear_matches_split_heads():
+    """Fused projection+head-split (one einsum, transpose in the matmul
+    epilogue) must equal matmul + reshape + transpose, with and without
+    bias (layers/attention.py fused_head_projection)."""
+    import hetu_tpu as ht
+    rng = np.random.default_rng(0)
+    B, S, E, h, d = 2, 8, 16, 4, 4
+    x = rng.standard_normal((B, S, E)).astype(np.float32)
+    w = rng.standard_normal((E, h * d)).astype(np.float32)
+    b = rng.standard_normal((h * d,)).astype(np.float32)
+    xo = ht.placeholder_op("hs_x", (B, S, E))
+    wo = ht.Variable("hs_w", value=w)
+    bo = ht.Variable("hs_b", value=b)
+    fused = ht.head_split_linear_op(xo, wo, bo, seq_len=S, n_heads=h,
+                                    head_dim=d)
+    ref = ht.transpose_op(ht.array_reshape_op(
+        ht.linear_op(ht.array_reshape_op(xo, output_shape=(-1, E)), wo, bo),
+        output_shape=(-1, S, h, d)), perm=(0, 2, 1, 3))
+    ex = ht.Executor({"eval": [fused, ref]})
+    got, want = ex.run("eval", feed_dict={xo: x},
+                       convert_to_numpy_ret_vals=True)
+    assert got.shape == (B, h, S, d)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
