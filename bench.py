@@ -46,25 +46,6 @@ def _rerun(fn, lower_is_better=False, n=3, **kw):
     return min(vals) if lower_is_better else max(vals)
 
 
-def _with_flash_baseline(baseline_fn, lower_is_better=False, **kw):
-    """Measure the stock and flash-equipped flax baselines; the bar is
-    the STRONGER of the two (VERDICT r2 item 5b).  Returns
-    (bar, baseline_dict) with both raw numbers reported."""
-    suffix = "_ms" if lower_is_better else ""
-    base = _rerun(baseline_fn, lower_is_better, **kw)
-    try:
-        base_flash = _rerun(baseline_fn, lower_is_better, flash=True, **kw)
-    except Exception:
-        base_flash = None
-    if lower_is_better:
-        bar = min(base, base_flash if base_flash else base)
-    else:
-        bar = max(base, base_flash or 0.0)
-    return bar, {"flax_same_chip" + suffix: round(base, 4),
-                 "flax_flash_same_chip" + suffix:
-                 round(base_flash, 4) if base_flash else None}
-
-
 def _sync(out):
     """Force real materialization of a (small) output.  np.asarray, not
     block_until_ready: through the dev tunnel the latter has been observed
@@ -114,6 +95,33 @@ def _timeit(fn, reps):
     return best, out
 
 
+def _interleaved_vs_flash(ours_fn, sps_fn, group_ctor, steps, per_item,
+                          base_steps=None, **base_kw):
+    """Shared stage tail: measure the flash-equipped baseline on its own
+    build (freed after), then interleave ours with the warmed STOCK
+    baseline group; the flash number strengthens the bar only when it
+    beats stock.  Returns (ours, base, vs_baseline, baseline_dict) in
+    caller units (per_item scales a per-call rate to samples/tokens)."""
+    import gc
+
+    base_steps = base_steps or steps
+    try:
+        flash_sps = _rerun(sps_fn, steps=base_steps, flash=True, **base_kw)
+    except Exception:
+        flash_sps = None
+    gc.collect()
+    base_group = group_ctor(**base_kw)
+    ours_rate, base_rate, ratio = _interleaved(
+        ours_fn, lambda: base_group(base_steps) / per_item, steps)
+    ours, base = ours_rate * per_item, base_rate * per_item
+    bar_extra = (flash_sps / base) if flash_sps and flash_sps > base \
+        else 1.0
+    return ours, base, round(ratio / bar_extra, 3), {
+        "flax_same_chip": round(base, 2),
+        "flax_flash_same_chip":
+            round(flash_sps, 2) if flash_sps else None}
+
+
 def bench_bert(quick):
     """Ours: graph-API BERT-base, bf16 compute + f32 masters, Pallas flash
     attention, AdamW — the reference headline config."""
@@ -159,16 +167,20 @@ def bench_bert(quick):
 
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0]), "non-finite loss"
-    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
-    ours = B / dt
 
-    from benchmarks.flax_baselines import bert_samples_per_sec
-    bar, baselines = _with_flash_baseline(
-        bert_samples_per_sec, batch=B, seq_len=S, layers=L,
-        steps=max(3, steps // 2))
+    from benchmarks.flax_baselines import (bert_samples_per_sec,
+                                           bert_train_group)
+    ours, base, vs, baselines = _interleaved_vs_flash(
+        lambda: ex.run("train", feed_dict=feed),
+        bert_samples_per_sec,
+        lambda **kw: bert_train_group(kw.pop("batch"), kw.pop("seq_len"),
+                                      **kw),
+        steps, B, base_steps=max(3, steps // 2),
+        batch=B, seq_len=S, layers=L)
     return {"metric": "bert_base_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": round(ours / bar, 3), "baseline": baselines}
+            "vs_baseline": vs, "protocol": "interleaved_median_of_5",
+            "baseline": baselines}
 
 
 def bench_gpt_layer(quick):
@@ -194,7 +206,8 @@ def bench_gpt_layer(quick):
     # the stock baseline in HBM), then freed
     try:
         flash_ms = _rerun(gpt_layer_fwd_ms, lower_is_better=True,
-                          flash=True, reps=reps, **kw)
+                          flash=True, reps=reps,
+                          param_dtype=jnp.bfloat16, **kw)
     except Exception:
         flash_ms = None
     gc.collect()
@@ -248,7 +261,7 @@ def bench_gpt_layer(quick):
     _sync(fwd(params, x))        # compile+warm ours OUTSIDE the rounds
     ours_v, base_v = [], []
     for _ in range(5):
-        dt, _ = (_time_group(lambda: fwd(params, x), reps), None)
+        dt = _time_group(lambda: fwd(params, x), reps)
         ours_v.append(dt * 1000.0 / n_layers)
         base_v.append(base_group(reps))
     ours_ms = min(ours_v)
@@ -294,18 +307,19 @@ def bench_gpt_e2e(quick):
             labels: jnp.asarray(np.roll(ids_v, -1, 1), jnp.int32)}
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0]), "non-finite loss"
-    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
-    ours = B / dt
 
-    import gc
-    del ex
-    gc.collect()
-    from benchmarks.flax_baselines import gpt_samples_per_sec
-    bar, baselines = _with_flash_baseline(
-        gpt_samples_per_sec, batch=B, seq_len=S, layers=L, steps=steps)
+    from benchmarks.flax_baselines import (gpt_samples_per_sec,
+                                           gpt_train_group)
+    ours, base, vs, baselines = _interleaved_vs_flash(
+        lambda: ex.run("train", feed_dict=feed),
+        gpt_samples_per_sec,
+        lambda **kw: gpt_train_group(kw.pop("batch"), kw.pop("seq_len"),
+                                     **kw),
+        steps, B, batch=B, seq_len=S, layers=L)
     return {"metric": "gpt_small_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": round(ours / bar, 3), "baseline": baselines}
+            "vs_baseline": vs, "protocol": "interleaved_median_of_5",
+            "baseline": baselines}
 
 
 def bench_llama(quick):
@@ -337,19 +351,19 @@ def bench_llama(quick):
             labels: jnp.asarray(np.roll(ids_v, -1, 1), jnp.int32)}
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0]), "non-finite loss"
-    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
-    ours = B / dt
 
-    import gc
-    del ex
-    gc.collect()
-    from benchmarks.flax_baselines import llama_samples_per_sec
-    bar, baselines = _with_flash_baseline(
-        llama_samples_per_sec, batch=B, seq_len=S, layers=L, kv_heads=4,
-        steps=steps)
+    from benchmarks.flax_baselines import (llama_samples_per_sec,
+                                           llama_train_group)
+    ours, base, vs, baselines = _interleaved_vs_flash(
+        lambda: ex.run("train", feed_dict=feed),
+        llama_samples_per_sec,
+        lambda **kw: llama_train_group(kw.pop("batch"), kw.pop("seq_len"),
+                                       **kw),
+        steps, B, batch=B, seq_len=S, layers=L, kv_heads=4)
     return {"metric": "llama_small_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": round(ours / bar, 3), "baseline": baselines}
+            "vs_baseline": vs, "protocol": "interleaved_median_of_5",
+            "baseline": baselines}
 
 
 def bench_resnet(quick):
@@ -416,18 +430,16 @@ def bench_moe(quick):
             y: jnp.zeros((B, S, H), jnp.float32)}
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0])
-    dt, _ = _timeit(lambda: ex.run("train", feed_dict=feed), steps)
-    ours = B * S / dt
-
-    import gc
-    del ex
-    gc.collect()
-    from benchmarks.flax_baselines import moe_tokens_per_sec
-    base = _rerun(moe_tokens_per_sec, batch=B, seq=S, hidden=H, d_ff=F,
-                  steps=steps)
+    from benchmarks.flax_baselines import moe_train_group
+    base_group = moe_train_group(batch=B, seq=S, hidden=H, d_ff=F)
+    ours_sps, base_sps, ratio = _interleaved(
+        lambda: ex.run("train", feed_dict=feed),
+        lambda: base_group(steps) / (B * S), steps)
+    ours, base = ours_sps * B * S, base_sps * B * S
     return {"metric": "moe_top2_8expert_train_tokens_per_sec",
             "value": round(ours, 2), "unit": "tokens/sec",
-            "vs_baseline": round(ours / base, 3),
+            "vs_baseline": round(ratio, 3),
+            "protocol": "interleaved_median_of_5",
             "baseline": {"flax_same_chip": round(base, 2)}}
 
 

@@ -61,9 +61,10 @@ def _make_flash_mha(nn, heads, hidden, dtype, causal):
 # BERT-base pretraining (reference examples/nlp/bert headline config)
 # --------------------------------------------------------------------------
 
-def bert_samples_per_sec(batch, seq_len, *, vocab=30522, hidden=768,
-                         layers=12, heads=12, inter=3072, steps=10,
-                         dropout=0.1, flash=False):
+def bert_train_group(batch, seq_len, *, vocab=30522, hidden=768,
+                     layers=12, heads=12, inter=3072,
+                     dropout=0.1, flash=False):
+    """Build + warm ONCE; returns ``group(steps) -> samples/sec``."""
     import flax.linen as nn
     import optax
 
@@ -150,13 +151,18 @@ def bert_samples_per_sec(batch, seq_len, *, vocab=30522, hidden=768,
         updates, s = tx.update(grads, s, p)
         return optax.apply_updates(p, updates), s, k, loss
 
-    params, opt_state, key, loss = step(params, opt_state, key)
+    state = [params, opt_state, key]
+    state[0], state[1], state[2], loss = step(*state)
     assert np.isfinite(float(loss))  # float() forces materialization
-    start = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, key, loss = step(params, opt_state, key)
-    float(loss)
-    return steps * batch / (time.perf_counter() - start)
+
+    def group(steps_):
+        start = time.perf_counter()
+        for _ in range(steps_):
+            state[0], state[1], state[2], loss = step(*state)
+        float(loss)
+        return steps_ * batch / (time.perf_counter() - start)
+
+    return group
 
 
 # --------------------------------------------------------------------------
@@ -164,6 +170,10 @@ def bert_samples_per_sec(batch, seq_len, *, vocab=30522, hidden=768,
 # computation_profiling_bf16_hidden2560_head32_seqlen2048.json
 # layertype_0 = 2.0645 ms on A100-40GB)
 # --------------------------------------------------------------------------
+
+def bert_samples_per_sec(batch, seq_len, *, steps=10, **kw):
+    return bert_train_group(batch, seq_len, **kw)(steps)
+
 
 def gpt_layer_group(*, batch=2, seq=2048, hidden=2560, heads=32,
                     n_layers=30, flash=False, param_dtype=None):
@@ -295,9 +305,9 @@ def wdl_steps_per_sec(batch=128, *, rows=337000, dim=16, num_sparse=26,
 # GPT-small end-to-end causal-LM pretraining step (flagship e2e workload)
 # --------------------------------------------------------------------------
 
-def gpt_samples_per_sec(batch, seq_len, *, vocab=50257, hidden=768,
-                        layers=12, heads=12, steps=10, dropout=0.1,
-                        flash=False):
+def gpt_train_group(batch, seq_len, *, vocab=50257, hidden=768,
+                    layers=12, heads=12, dropout=0.1, flash=False):
+    """Build + warm ONCE; returns ``group(steps) -> samples/sec``."""
     import flax.linen as nn
     import optax
 
@@ -359,13 +369,22 @@ def gpt_samples_per_sec(batch, seq_len, *, vocab=50257, hidden=768,
         updates, s = tx.update(grads, s, p)
         return optax.apply_updates(p, updates), s, k, loss
 
-    params, opt_state, key, loss = step(params, opt_state, key)
+    state = [params, opt_state, key]
+    state[0], state[1], state[2], loss = step(*state)
     assert np.isfinite(float(loss))  # float() forces materialization
-    start = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, key, loss = step(params, opt_state, key)
-    float(loss)
-    return steps * batch / (time.perf_counter() - start)
+
+    def group(steps_):
+        start = time.perf_counter()
+        for _ in range(steps_):
+            state[0], state[1], state[2], loss = step(*state)
+        float(loss)
+        return steps_ * batch / (time.perf_counter() - start)
+
+    return group
+
+
+def gpt_samples_per_sec(batch, seq_len, *, steps=10, **kw):
+    return gpt_train_group(batch, seq_len, **kw)(steps)
 
 
 # --------------------------------------------------------------------------
@@ -373,9 +392,10 @@ def gpt_samples_per_sec(batch, seq_len, *, vocab=50257, hidden=768,
 # llama configs — the modern-LLM tier; RMSNorm + SwiGLU + RoPE)
 # --------------------------------------------------------------------------
 
-def llama_samples_per_sec(batch, seq_len, *, vocab=32000, hidden=768,
-                          layers=12, heads=12, kv_heads=None, inter=2048,
-                          steps=10, flash=False):
+def llama_train_group(batch, seq_len, *, vocab=32000, hidden=768,
+                      layers=12, heads=12, kv_heads=None, inter=2048,
+                      flash=False):
+    """Build + warm ONCE; returns ``group(steps) -> samples/sec``."""
     import flax.linen as nn
     import optax
 
@@ -454,13 +474,22 @@ def llama_samples_per_sec(batch, seq_len, *, vocab=32000, hidden=768,
         updates, s = tx.update(grads, s, p)
         return optax.apply_updates(p, updates), s, loss
 
-    params, opt_state, loss = step(params, opt_state)
+    state = [params, opt_state]
+    state[0], state[1], loss = step(*state)
     assert np.isfinite(float(loss))  # float() forces materialization
-    start = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state)
-    float(loss)
-    return steps * batch / (time.perf_counter() - start)
+
+    def group(steps_):
+        start = time.perf_counter()
+        for _ in range(steps_):
+            state[0], state[1], loss = step(*state)
+        float(loss)
+        return steps_ * batch / (time.perf_counter() - start)
+
+    return group
+
+
+def llama_samples_per_sec(batch, seq_len, *, steps=10, **kw):
+    return llama_train_group(batch, seq_len, **kw)(steps)
 
 
 # --------------------------------------------------------------------------
@@ -549,11 +578,12 @@ def resnet18_samples_per_sec(batch=256, *, num_classes=10, steps=20):
 # MoE FFN block (reference benchmark config #5: examples/moe)
 # --------------------------------------------------------------------------
 
-def moe_tokens_per_sec(batch=8, seq=1024, hidden=512, d_ff=2048,
-                       num_experts=8, k=2, capacity_factor=1.25, steps=15):
+def moe_train_group(batch=8, seq=1024, hidden=512, d_ff=2048,
+                    num_experts=8, k=2, capacity_factor=1.25):
     """Straightforward flax/optax GShard-style top-k MoE (one-hot
     dispatch/combine einsums with expert capacity) — the trusted
-    implementation pattern for a dense-dispatch MoE on one chip."""
+    implementation pattern for a dense-dispatch MoE on one chip.
+    Build + warm ONCE; returns ``group(steps) -> tokens/sec``."""
     import flax.linen as nn
     import optax
 
@@ -609,10 +639,19 @@ def moe_tokens_per_sec(batch=8, seq=1024, hidden=512, d_ff=2048,
         u, s = tx.update(grads, s, p)
         return optax.apply_updates(p, u), s, loss
 
-    params, opt_state, loss = step(params, opt_state)
+    state = [params, opt_state]
+    state[0], state[1], loss = step(*state)
     assert np.isfinite(float(loss))  # float() forces materialization
-    start = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state)
-    float(loss)
-    return steps * batch * seq / (time.perf_counter() - start)
+
+    def group(steps_):
+        start = time.perf_counter()
+        for _ in range(steps_):
+            state[0], state[1], loss = step(*state)
+        float(loss)
+        return steps_ * batch * seq / (time.perf_counter() - start)
+
+    return group
+
+
+def moe_tokens_per_sec(*, steps=15, **kw):
+    return moe_train_group(**kw)(steps)
