@@ -179,7 +179,7 @@ def bench_bert(quick):
         batch=B, seq_len=S, layers=L)
     return {"metric": "bert_base_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": vs, "protocol": "interleaved_median_of_5",
+            "vs_baseline": vs, "protocol": "interleaved_median",
             "baseline": baselines}
 
 
@@ -275,7 +275,7 @@ def bench_gpt_layer(quick):
     return {"metric": "gpt_2.7b_layer_fwd_ms", "value": round(ours_ms, 4),
             "unit": "ms (lower is better)",
             "vs_baseline": round(ratios[len(ratios) // 2], 3),
-            "protocol": "interleaved_median_of_5",
+            "protocol": "interleaved_median",
             "baseline": baselines}
 
 
@@ -318,7 +318,7 @@ def bench_gpt_e2e(quick):
         steps, B, batch=B, seq_len=S, layers=L)
     return {"metric": "gpt_small_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": vs, "protocol": "interleaved_median_of_5",
+            "vs_baseline": vs, "protocol": "interleaved_median",
             "baseline": baselines}
 
 
@@ -362,7 +362,7 @@ def bench_llama(quick):
         steps, B, batch=B, seq_len=S, layers=L, kv_heads=4)
     return {"metric": "llama_small_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": vs, "protocol": "interleaved_median_of_5",
+            "vs_baseline": vs, "protocol": "interleaved_median",
             "baseline": baselines}
 
 
@@ -397,12 +397,12 @@ def bench_resnet(quick):
     ours_sps, base, ratio = _interleaved(
         lambda: ex.run("train", feed_dict=feed),
         lambda: base_group(steps) / B,
-        steps)
+        steps, rounds=7)
     ours, base = ours_sps * B, base * B
     return {"metric": "resnet18_cifar_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
             "vs_baseline": round(ratio, 3),
-            "protocol": "interleaved_median_of_5",
+            "protocol": "interleaved_median",
             "baseline": {"flax_same_chip": round(base, 2)}}
 
 
@@ -439,7 +439,7 @@ def bench_moe(quick):
     return {"metric": "moe_top2_8expert_train_tokens_per_sec",
             "value": round(ours, 2), "unit": "tokens/sec",
             "vs_baseline": round(ratio, 3),
-            "protocol": "interleaved_median_of_5",
+            "protocol": "interleaved_median",
             "baseline": {"flax_same_chip": round(base, 2)}}
 
 
@@ -473,7 +473,7 @@ def bench_wdl(quick):
     ours, base, ratio = _interleaved(
         lambda: ex.run("train", feed_dict=feed),
         lambda: base_group(steps),
-        steps)
+        steps, rounds=7)
     import gc
     del ex          # each timed executor runs alone (bench_moe discipline)
     gc.collect()
@@ -492,7 +492,7 @@ def bench_wdl(quick):
     return {"metric": "wdl_criteo_train_steps_per_sec",
             "value": round(ours, 2), "unit": "steps/sec",
             "vs_baseline": round(ratio, 3),
-            "protocol": "interleaved_median_of_5",
+            "protocol": "interleaved_median",
             "baseline": {"flax_same_chip": round(base, 2)},
             "lazy_sparse_opt_steps_per_sec": round(1.0 / dt_s, 2)}
 

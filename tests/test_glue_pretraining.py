@@ -288,3 +288,42 @@ def test_glue_example_cli(tmp_path):
         capture_output=True, text=True, timeout=600, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "dev {'accuracy'" in proc.stdout, proc.stdout
+
+
+def test_cola_and_mnli_processors(tmp_path):
+    tok = _toy_tokenizer()
+    rng = np.random.default_rng(1)
+    cola = tmp_path / "cola"
+    cola.mkdir()
+    # CoLA: no header; cols gid, label, star, sentence
+    with open(cola / "train.tsv", "w") as f:
+        for i in range(6):
+            lab = int(rng.integers(0, 2))
+            f.write(f"gj0{i}\t{lab}\t*\t{' '.join(rng.choice(WORDS, 5))}\n")
+    with open(cola / "dev.tsv", "w") as f:
+        f.write("gj99\t1\t*\tthe movie was fun\n")
+    proc = GLUE_PROCESSORS["cola"]()
+    ex = proc.train_examples(str(cola))
+    assert len(ex) == 6 and ex[0].text_b is None   # no header row skipped
+    feats = convert_examples_to_arrays(ex, proc.labels(), tok, 12)
+    assert feats.input_ids.shape == (6, 12)
+    assert len(proc.dev_examples(str(cola))) == 1
+
+    mnli = tmp_path / "mnli"
+    mnli.mkdir()
+    hdr = "\t".join(f"c{i}" for i in range(12)) + "\n"
+    rows = []
+    for i, lab in enumerate(["neutral", "entailment", "contradiction"]):
+        cells = [f"{i}"] + ["x"] * 7 + [
+            " ".join(rng.choice(WORDS, 4)),
+            " ".join(rng.choice(WORDS, 4)), "x", lab]
+        rows.append("\t".join(cells) + "\n")
+    (mnli / "train.tsv").write_text(hdr + "".join(rows))
+    (mnli / "dev_matched.tsv").write_text(hdr + rows[0])
+    proc2 = GLUE_PROCESSORS["mnli"]()
+    ex2 = proc2.train_examples(str(mnli))
+    assert len(ex2) == 3 and ex2[0].text_b is not None
+    f2 = convert_examples_to_arrays(ex2, proc2.labels(), tok, 16)
+    # three-way labels map per labels() order
+    assert sorted(f2.label_ids.tolist()) == [0, 1, 2]
+    assert len(proc2.dev_examples(str(mnli))) == 1
