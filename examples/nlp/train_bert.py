@@ -31,11 +31,29 @@ def main():
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--features", default=None,
+                    help=".npz from examples/nlp/create_pretraining_data"
+                         ".py — real MLM/NSP features instead of "
+                         "synthetic ids")
+    ap.add_argument("--vocab-size", type=int, default=30522)
     args = ap.parse_args()
 
     rng = np.random.default_rng(0)
     B, S = args.batch_size, args.seq_len
-    c = BertConfig(vocab_size=30522, hidden_size=768,
+    data = None
+    if args.features:
+        with np.load(args.features) as z:
+            # materialize once: NpzFile re-decompresses on every access
+            data = {k: z[k] for k in z.files}
+        n, S = data["input_ids"].shape
+        data["mlm_labels"] = data["mlm_labels"].reshape(n, S)
+        assert n >= B, f"only {n} instances for batch {B}"
+        assert int(data["input_ids"].max()) < args.vocab_size, (
+            "features were built with a larger vocab than --vocab-size; "
+            "out-of-range ids would gather garbage embeddings silently")
+        print(f"loaded {n} pretraining instances (seq {S}) from "
+              f"{args.features}")
+    c = BertConfig(vocab_size=args.vocab_size, hidden_size=768,
                    num_hidden_layers=args.layers, seq_len=S,
                    max_position_embeddings=max(512, S))
 
@@ -54,15 +72,23 @@ def main():
                      compute_dtype=jnp.bfloat16)
 
     for step in range(args.steps):
-        ids = rng.integers(0, c.vocab_size, (B, S))
-        mlm = np.full((B * S,), -1, np.int64)
-        pos = rng.random(B * S) < 0.15
-        mlm[pos] = rng.integers(0, c.vocab_size, pos.sum())
-        feed = {input_ids: ids,
-                token_type: rng.integers(0, 2, (B, S)),
-                attn_mask: np.ones((B, S), np.float32),
-                mlm_labels: mlm,
-                nsp_labels: rng.integers(0, 2, (B,))}
+        if data is not None:
+            sl = rng.choice(data["input_ids"].shape[0], B, replace=False)
+            feed = {input_ids: data["input_ids"][sl],
+                    token_type: data["token_type_ids"][sl],
+                    attn_mask: data["attention_mask"][sl],
+                    mlm_labels: data["mlm_labels"][sl].reshape(-1),
+                    nsp_labels: data["nsp_labels"][sl]}
+        else:
+            ids = rng.integers(0, c.vocab_size, (B, S))
+            mlm = np.full((B * S,), -1, np.int64)
+            pos = rng.random(B * S) < 0.15
+            mlm[pos] = rng.integers(0, c.vocab_size, pos.sum())
+            feed = {input_ids: ids,
+                    token_type: rng.integers(0, 2, (B, S)),
+                    attn_mask: np.ones((B, S), np.float32),
+                    mlm_labels: mlm,
+                    nsp_labels: rng.integers(0, 2, (B,))}
         out = ex.run("train", feed_dict=feed,
                      convert_to_numpy_ret_vals=True)
         if step % 5 == 0 or step == args.steps - 1:
