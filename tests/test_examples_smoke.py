@@ -75,3 +75,22 @@ def test_ctr_sparse_opt_example_smoke():
     r2 = _run(["examples/ctr/train_ctr.py", "--sparse-opt", "--ps",
                "--steps", "1"])
     assert r2.returncode != 0 and "mutually exclusive" in r2.stderr
+
+
+def test_complex_pipeline_mlp_smoke():
+    """Mixed DP x PP graph pipeline example (reference
+    examples/runner/parallel/complex_pipeline_mlp.py role) runs with
+    per-step loss parity asserted inside."""
+    proc = _run(["examples/parallel/complex_pipeline_mlp.py",
+                 "--steps", "4", "--width", "16", "--batch", "16",
+                 "--num-micro", "2"])
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "loss parity" in proc.stdout, proc.stdout[-1500:]
+
+
+def test_dist_gcn_example_smoke():
+    proc = _run(["examples/gnn/train_dist_gcn.py",
+                 "--nodes", "64", "--edges", "256", "--steps", "6",
+                 "--hidden", "8", "--features", "8"])
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "loss parity" in proc.stdout, proc.stdout[-1500:]
