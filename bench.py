@@ -519,24 +519,34 @@ def bench_wdl_ps(quick):
         os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
     from ps_harness import build_wdl_ps, time_steps, zipf_feeds
 
-    def run_at(rows):
+    def build_at(rows):
         ex, ps_emb, ph = build_wdl_ps(
             rows, dim, B, 26, optimizer="adam", lr=1e-2,
             cache_limit=max(4096, rows // 100), name_prefix=f"wps{rows}")
         feeds = zipf_feeds(rng, rows, B, 26, ph)
-        dt = time_steps(ex, feeds, steps)
-        stats = ps_emb.stats()
-        return 1.0 / dt, stats.get("hit_rate", 0.0)
+        return ex, ps_emb, feeds
 
-    sps_small, _ = run_at(rows_small)
-    import gc
-    gc.collect()
-    sps_big, hit_big = run_at(rows_big)
+    # both stores resident (0.12 + 28.6 GiB host RAM), timed in
+    # ALTERNATING groups: the PS path is host-CPU-bound, so host load
+    # drift must hit both sizes for the flatness ratio to mean anything
+    ex_s, _, feeds_s = build_at(rows_small)
+    ex_b, emb_b, feeds_b = build_at(rows_big)
+    small_v, big_v = [], []
+    for _ in range(5):
+        # groups=1: the median over rounds IS the robustness; best-of-3
+        # inside each round would triple the work and push the
+        # small/big groups apart in time
+        small_v.append(1.0 / time_steps(ex_s, feeds_s, steps, groups=1))
+        big_v.append(1.0 / time_steps(ex_b, feeds_b, steps, groups=1))
+    ratios = sorted(b / s for s, b in zip(small_v, big_v))
+    flatness = ratios[len(ratios) // 2]
+    sps_small, sps_big = max(small_v), max(big_v)
+    hit_big = emb_b.stats().get("hit_rate", 0.0)
     in_graph_gib = rows_big * dim * 4 * 3 / 1024 ** 3  # params + adam m,v
     return {"metric": "wdl_ps_het_scale_train_steps_per_sec",
             "value": round(sps_big, 2), "unit": "steps/sec",
-            "vs_baseline": round(sps_big / sps_small, 3),
-            "protocol": "flatness_vs_337k_table",
+            "vs_baseline": round(flatness, 3),
+            "protocol": "flatness_vs_337k_interleaved_median_of_5",
             "table_rows": rows_big,
             "host_store_gib": round(in_graph_gib, 2),
             "in_graph_feasible": bool(in_graph_gib < 16.0),

@@ -69,22 +69,21 @@ def main():
 
     # ONE graph drives both executors (identical seeded init); the
     # dist_state annotations only bind when a mesh is attached
-    x1, y1, loss1 = build(args.stages, args.width, args.batch, "mlp",
-                          dp=args.dp > 1)
-    x2, y2, loss2 = x1, y1, loss1
+    x, y, loss = build(args.stages, args.width, args.batch, "mlp",
+                       dp=args.dp > 1)
     ex_ref = ht.Executor(
-        {"train": [loss1, ht.AdamOptimizer(1e-2).minimize(loss1)]}, seed=3)
+        {"train": [loss, ht.AdamOptimizer(1e-2).minimize(loss)]}, seed=3)
     # pp x dp mesh: stage i owns mesh.devices[i] (a dp-row of devices)
     mesh = make_mesh({"pp": args.stages, "dp": args.dp})
     ex_pp = ht.Executor(
-        {"train": [loss2, ht.AdamOptimizer(1e-2).minimize(loss2)]}, seed=3,
+        {"train": [loss, ht.AdamOptimizer(1e-2).minimize(loss)]}, seed=3,
         mesh=mesh, pipeline=args.schedule, num_micro=args.num_micro)
 
     t0 = time.time()
     for step in range(args.steps):
-        l_ref = ex_ref.run("train", feed_dict={x1: X, y1: Y},
+        l_ref = ex_ref.run("train", feed_dict={x: X, y: Y},
                            convert_to_numpy_ret_vals=True)[0]
-        l_pp = ex_pp.run("train", feed_dict={x2: X, y2: Y},
+        l_pp = ex_pp.run("train", feed_dict={x: X, y: Y},
                          convert_to_numpy_ret_vals=True)[0]
         np.testing.assert_allclose(l_pp, l_ref, rtol=3e-5, atol=3e-6)
         if step % 3 == 0 or step == args.steps - 1:
