@@ -76,6 +76,12 @@ class SubExecutor:
             self.topo = find_topo_sort(self._all_eval)
         self._ps_pending = []
         self._jitted = None
+        # fast-path cache for steady-state training loops: when run() is
+        # called repeatedly with the SAME feed_dict object holding
+        # device arrays (the common loop shape), the per-call feed
+        # validation/cast walk is skipped and values are re-extracted
+        # directly (so in-place value swaps in the dict still apply)
+        self._fast_feed = None
         # monitor variables: non-trainable in-graph counters (e.g. the
         # BERT MLM bucket-overflow total) polled host-side every
         # monitor_interval steps — works on every platform, unlike host
@@ -217,6 +223,19 @@ class SubExecutor:
         if self._jitted is None:
             self._build()
         ex = self.executor
+        fast = self._fast_feed
+        if fast is not None and fast[0] is feed_dict:
+            feeds = {}
+            for node, name in fast[1]:
+                v = feed_dict.get(node)
+                if not isinstance(v, jax.Array):
+                    feeds = None               # value class/keys changed:
+                    self._fast_feed = None     # fall back to the full path
+                    break
+                feeds[name] = v
+            if feeds is not None:
+                return self._dispatch(ex, feeds, None,
+                                      convert_to_numpy_ret_vals)
         feeds = {}
         feed_dict = feed_dict or {}
         for node, value in feed_dict.items():
@@ -275,10 +294,27 @@ class SubExecutor:
         names = {p.name for p in self.placeholders}
         feeds = {k: v for k, v in feeds.items() if k in names}
         # cast feeds to declared dtypes (reference DataloaderOp feeds float32)
+        all_device = True
         for p in self.placeholders:
             v = feeds[p.name]
             if not isinstance(v, jax.Array):
+                all_device = False
                 feeds[p.name] = jnp.asarray(v, dtype=p.dtype)
+        # arm the fast path: same dict object + pure device-array feeds +
+        # no PS/dataloader involvement means next call can skip this walk
+        if (feed_dict and all_device and not self.ps_rows
+                and len(feed_dict) == len(feeds)):
+            pairs = []
+            for node in feed_dict:
+                name = node.name if isinstance(node, Op) else node
+                if name in feeds:
+                    pairs.append((node, name))
+            if len(pairs) == len(feeds):
+                self._fast_feed = (feed_dict, pairs)
+        return self._dispatch(ex, feeds, ps_ids,
+                              convert_to_numpy_ret_vals)
+
+    def _dispatch(self, ex, feeds, ps_ids, convert_to_numpy_ret_vals):
         if ex._step_arr is None:
             ex._step_arr = jnp.uint32(ex._global_step)
         ex._global_step += 1
