@@ -498,3 +498,42 @@ def test_comm_mode_allreduce_is_data_parallel():
         ht.Executor([loss], comm_mode="bogus")
 
 
+
+
+def test_fast_feed_cache_semantics():
+    """The steady-state fast path must (a) apply in-place value swaps in
+    the same feed_dict object, (b) disarm cleanly when the dict's
+    structure or value classes change, (c) never skip dtype casts for
+    numpy feeds."""
+    import jax
+    import jax.numpy as jnp
+    x = ht.placeholder_op("ff_x", (4, 8))
+    w = ht.Variable("ff_w", value=np.ones((8, 2), np.float32))
+    out = ht.matmul_op(x, w)
+    s = ht.reduce_sum_op(ht.reduce_sum_op(out, axes=1), axes=0)
+    ex = ht.Executor({"eval": [s]}, training=False)
+    sub = ex.subexecutor["eval"]
+
+    a = jnp.ones((4, 8), jnp.float32)
+    feed = {x: a}
+    v1 = float(ex.run("eval", feed_dict=feed,
+                      convert_to_numpy_ret_vals=True)[0])
+    assert v1 == 64.0
+    assert sub._fast_feed is not None and sub._fast_feed[0] is feed
+
+    # (a) in-place swap of the value in the SAME dict object
+    feed[x] = 2 * a
+    v2 = float(ex.run("eval", feed_dict=feed,
+                      convert_to_numpy_ret_vals=True)[0])
+    assert v2 == 128.0
+
+    # (c) numpy value: fast path must disarm and the cast still happen
+    feed[x] = np.full((4, 8), 3.0, np.float64)
+    v3 = float(ex.run("eval", feed_dict=feed,
+                      convert_to_numpy_ret_vals=True)[0])
+    assert v3 == 192.0
+
+    # (b) a different dict object takes the full path and re-arms
+    v4 = float(ex.run("eval", feed_dict={x: a},
+                      convert_to_numpy_ret_vals=True)[0])
+    assert v4 == 64.0
