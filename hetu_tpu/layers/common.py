@@ -13,7 +13,8 @@ from .. import initializers as init
 from ..graph.node import VariableOp
 from ..ops import (matmul_op, linear_op, broadcastto_op, conv2d_op,
                    conv2d_add_bias_op, conv2d_hwio_op,
-                   conv2d_hwio_add_bias_op, batch_normalization_op,
+                   conv2d_hwio_add_bias_op, conv2d_nhwc_op,
+                   conv2d_nhwc_add_bias_op, batch_normalization_op,
                    layer_normalization_op, rms_norm_op, dropout_op, relu_op,
                    gelu_op, silu_op, tanh_op, sigmoid_op, leaky_relu_op,
                    max_pool2d_op, avg_pool2d_op, array_reshape_op,
@@ -69,7 +70,7 @@ class Conv2d(BaseLayer):
 
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, bias=True, initializer=None, activation=None,
-                 name=None):
+                 channels_last=False, name=None):
         name = fresh_name(name or "conv2d")
         ks = kernel_size if isinstance(kernel_size, tuple) \
             else (kernel_size, kernel_size)
@@ -80,6 +81,10 @@ class Conv2d(BaseLayer):
                                init.zeros()) if bias else None
         self.stride, self.padding = stride, padding
         self.activation = activation
+        # channels_last: activations are NHWC end to end (zero layout
+        # transposes — the fully TPU-native form); default keeps the
+        # reference's NCHW activation API
+        self.channels_last = channels_last
 
     @staticmethod
     def load_oihw(w):
@@ -93,13 +98,16 @@ class Conv2d(BaseLayer):
         return np.transpose(np.asarray(w), (3, 2, 0, 1))
 
     def __call__(self, x):
-        if self.bias is not None:
-            out = conv2d_hwio_add_bias_op(
-                x, self.weight, self.bias,
-                padding=self.padding, stride=self.stride)
+        if self.channels_last:
+            op, op_b = conv2d_nhwc_op, conv2d_nhwc_add_bias_op
         else:
-            out = conv2d_hwio_op(x, self.weight, padding=self.padding,
-                                 stride=self.stride)
+            op, op_b = conv2d_hwio_op, conv2d_hwio_add_bias_op
+        if self.bias is not None:
+            out = op_b(x, self.weight, self.bias,
+                       padding=self.padding, stride=self.stride)
+        else:
+            out = op(x, self.weight, padding=self.padding,
+                     stride=self.stride)
         if self.activation is not None:
             out = self.activation(out)
         return out
@@ -117,17 +125,19 @@ class BatchNorm(BaseLayer):
     see ops/nn.py BatchNormOp)."""
 
     def __init__(self, num_channels, momentum=0.1, eps=1e-5,
-                 precise_stats=False, name=None):
+                 precise_stats=False, channels_last=False, name=None):
         name = fresh_name(name or "bn")
         self.scale = VariableOp(f"{name}_scale", (num_channels,), init.ones())
         self.bias = VariableOp(f"{name}_bias", (num_channels,), init.zeros())
         self.momentum, self.eps = momentum, eps
         self.precise_stats = precise_stats
+        self.channel_axis = -1 if channels_last else 1
 
     def __call__(self, x):
         return batch_normalization_op(x, self.scale, self.bias,
                                       momentum=self.momentum, eps=self.eps,
-                                      precise_stats=self.precise_stats)
+                                      precise_stats=self.precise_stats,
+                                      channel_axis=self.channel_axis)
 
 
 class LayerNorm(BaseLayer):

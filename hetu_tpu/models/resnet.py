@@ -18,20 +18,25 @@ from ..ops import (relu_op, global_avg_pool2d_op, array_reshape_op,
 class BasicBlock:
     expansion = 1
 
-    def __init__(self, in_planes, planes, stride=1, name="block"):
+    def __init__(self, in_planes, planes, stride=1, name="block",
+                 channels_last=False):
+        cl = channels_last
         self.conv1 = Conv2d(in_planes, planes, 3, stride=stride, padding=1,
-                            bias=False, name=f"{name}_conv1")
-        self.bn1 = BatchNorm(planes, name=f"{name}_bn1")
+                            bias=False, channels_last=cl,
+                            name=f"{name}_conv1")
+        self.bn1 = BatchNorm(planes, channels_last=cl, name=f"{name}_bn1")
         self.conv2 = Conv2d(planes, planes, 3, stride=1, padding=1,
-                            bias=False, name=f"{name}_conv2")
-        self.bn2 = BatchNorm(planes, name=f"{name}_bn2")
+                            bias=False, channels_last=cl,
+                            name=f"{name}_conv2")
+        self.bn2 = BatchNorm(planes, channels_last=cl, name=f"{name}_bn2")
         self.shortcut = None
         if stride != 1 or in_planes != planes * self.expansion:
             self.sc_conv = Conv2d(in_planes, planes * self.expansion, 1,
                                   stride=stride, bias=False,
+                                  channels_last=cl,
                                   name=f"{name}_scconv")
             self.sc_bn = BatchNorm(planes * self.expansion,
-                                   name=f"{name}_scbn")
+                                   channels_last=cl, name=f"{name}_scbn")
             self.shortcut = lambda x: self.sc_bn(self.sc_conv(x))
 
     def __call__(self, x):
@@ -49,12 +54,18 @@ class ResNet:
 
     @scoped_init
     def __init__(self, num_blocks=(2, 2, 2, 2), num_classes=10,
-                 name="resnet", pipeline_stages=None):
+                 name="resnet", pipeline_stages=None, channels_last=False):
+        # channels_last: inputs are [B, H, W, C] and every activation
+        # stays NHWC (zero layout transposes — fully TPU-native); the
+        # default NCHW input contract matches the reference examples/cnn
         self.pipeline_stages = pipeline_stages
+        self.channels_last = channels_last
         self.in_planes = 64
         self.conv1 = Conv2d(3, 64, 3, stride=1, padding=1, bias=False,
+                            channels_last=channels_last,
                             name=f"{name}_conv1")
-        self.bn1 = BatchNorm(64, name=f"{name}_bn1")
+        self.bn1 = BatchNorm(64, channels_last=channels_last,
+                             name=f"{name}_bn1")
         self.layers = []
         for i, (planes, n, stride) in enumerate(
                 zip((64, 128, 256, 512), num_blocks, (1, 2, 2, 2))):
@@ -62,6 +73,7 @@ class ResNet:
             for j in range(n):
                 blocks.append(BasicBlock(self.in_planes, planes,
                                          stride if j == 0 else 1,
+                                         channels_last=channels_last,
                                          name=f"{name}_l{i}b{j}"))
                 self.in_planes = planes * BasicBlock.expansion
             self.layers.append(blocks)
@@ -88,13 +100,14 @@ class ResNet:
                 out = b(out)
         with (stage(self.pipeline_stages - 1) if self.pipeline_stages
               else nullcontext()):
-            out = global_avg_pool2d_op(out)
+            out = global_avg_pool2d_op(out,
+                                       channels_last=self.channels_last)
             return self.fc(out)
 
 
-def resnet18(num_classes=10):
-    return ResNet((2, 2, 2, 2), num_classes)
+def resnet18(num_classes=10, channels_last=False):
+    return ResNet((2, 2, 2, 2), num_classes, channels_last=channels_last)
 
 
-def resnet34(num_classes=10):
-    return ResNet((3, 4, 6, 3), num_classes)
+def resnet34(num_classes=10, channels_last=False):
+    return ResNet((3, 4, 6, 3), num_classes, channels_last=channels_last)

@@ -258,6 +258,10 @@ def _pool(node, ctx):
 
 @exporter("global_avg_pool2d")
 def _gap(node, ctx):
+    if node.attrs.get("channels_last"):
+        raise NotImplementedError(
+            "ONNX export supports NCHW global_avg_pool2d only; rebuild "
+            "the model with channels_last=False for export")
     return [NodeIR("GlobalAveragePool", [_in(node, 0)], [node.name],
                    name=node.name)]
 
@@ -425,6 +429,12 @@ def _alibi_exp(node, ctx):
 
 
 def _export_batchnorm(node, ctx):
+    if getattr(node, "channel_axis", 1) not in (1,):
+        # ONNX BatchNormalization is channel-axis-1 only; silently
+        # exporting a channels-last graph would normalize over H
+        raise NotImplementedError(
+            "ONNX export supports NCHW BatchNorm only; rebuild the model "
+            "with channels_last=False for export")
     return [NodeIR("BatchNormalization", [i.name for i in node.inputs],
                    [node.name],
                    {"epsilon": node.eps, "momentum": 1.0 - node.momentum},

@@ -50,6 +50,22 @@ conv2d_add_bias_op = simple_op(
     "conv2d_add_bias")
 
 
+def _conv2d_nhwc(x, w, padding=0, stride=1, dilation=1, groups=1):
+    """Fully channels-last conv: x NHWC, w HWIO, out NHWC — zero layout
+    transposes anywhere (the TPU-native end-to-end form; the NCHW API
+    ops keep reference parity and cost boundary transposes that XLA
+    mostly, but not always, cancels)."""
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilation)
+    return lax.conv_general_dilated(
+        x, w, window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dh, dw), feature_group_count=groups,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+
 def _conv2d_hwio(x, w, padding=0, stride=1, dilation=1, groups=1):
     """Conv with the weight ALREADY in HWIO (the TPU-native kernel
     layout).  The OIHW->HWIO transpose in ``_conv2d`` is a logical
@@ -57,16 +73,8 @@ def _conv2d_hwio(x, w, padding=0, stride=1, dilation=1, groups=1):
     every step (~177 MB/step on ResNet-18); layers that own their
     weights store HWIO natively (layers/common.py Conv2d) and only the
     op API keeps NCHW activations for reference parity."""
-    ph, pw = _pair(padding)
-    sh, sw = _pair(stride)
-    dh, dw = _pair(dilation)
-    out = lax.conv_general_dilated(
-        x.transpose(0, 2, 3, 1), w,
-        window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
-        rhs_dilation=(dh, dw), feature_group_count=groups,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32).astype(x.dtype)
-    return out.transpose(0, 3, 1, 2)
+    return _conv2d_nhwc(x.transpose(0, 2, 3, 1), w, padding, stride,
+                        dilation, groups).transpose(0, 3, 1, 2)
 
 
 conv2d_hwio_op = simple_op(_conv2d_hwio, "conv2d_hwio")
@@ -75,6 +83,13 @@ conv2d_hwio_add_bias_op = simple_op(
         _conv2d_hwio(x, w, padding, stride, dilation, groups)
         + b.reshape(1, -1, 1, 1),
     "conv2d_hwio_add_bias")
+
+
+conv2d_nhwc_op = simple_op(_conv2d_nhwc, "conv2d_nhwc")
+conv2d_nhwc_add_bias_op = simple_op(
+    lambda x, w, b, padding=0, stride=1, dilation=1, groups=1:
+        _conv2d_nhwc(x, w, padding, stride, dilation, groups) + b,
+    "conv2d_nhwc_add_bias")
 
 
 def _conv2d_transpose(x, w, padding=0, stride=1):
@@ -114,7 +129,9 @@ avg_pool2d_op = simple_op(
         _pool(x, kernel_H, kernel_W, padding, stride, "avg"),
     "avg_pool2d")
 global_avg_pool2d_op = simple_op(
-    lambda x: jnp.mean(x, axis=(2, 3)), "global_avg_pool2d")
+    lambda x, channels_last=False:
+        jnp.mean(x, axis=(1, 2) if channels_last else (2, 3)),
+    "global_avg_pool2d")
 
 softmax_op = simple_op(
     lambda x, dim=-1: jax.nn.softmax(x, axis=dim), "softmax")
@@ -167,7 +184,7 @@ class BatchNormOp(Op):
     form (one extra read of x) for such inputs."""
 
     def __init__(self, x, scale, bias, momentum=0.1, eps=1e-5,
-                 precise_stats=False, name=None):
+                 precise_stats=False, channel_axis=1, name=None):
         base = name or f"bn_{scale.name}"
         c = scale.shape[0] if isinstance(scale, VariableOp) else None
         assert c is not None, "BatchNorm scale must be a Variable"
@@ -180,6 +197,8 @@ class BatchNormOp(Op):
         self.momentum = momentum
         self.eps = eps
         self.precise_stats = precise_stats
+        # 1 = NCHW (reference layout); -1 = channels-last (NHWC)
+        self.channel_axis = channel_axis
 
     @property
     def is_stateful(self):
@@ -187,8 +206,12 @@ class BatchNormOp(Op):
 
     def _compute(self, input_vals, ctx):
         x, scale, bias, rmean, rvar = input_vals
-        scale = scale.reshape(1, -1, 1, 1)
-        bias = bias.reshape(1, -1, 1, 1)
+        ax = self.channel_axis % x.ndim
+        vec = [1] * x.ndim
+        vec[ax] = -1
+        red = tuple(i for i in range(x.ndim) if i != ax)
+        scale = scale.reshape(vec)
+        bias = bias.reshape(vec)
         if ctx.training:
             # batch stats in f32; running stats update against the f32
             # masters (bf16 bindings would re-quantize them every step and
@@ -202,9 +225,9 @@ class BatchNormOp(Op):
                   if master is not None else rvar).astype(jnp.float32)
             if self.precise_stats:
                 # exact two-pass mean-then-deviations (one extra read)
-                mean = jnp.mean(xf, axis=(0, 2, 3))
+                mean = jnp.mean(xf, axis=red)
                 var = jnp.mean(jnp.square(
-                    xf - mean.reshape(1, -1, 1, 1)), axis=(0, 2, 3))
+                    xf - mean.reshape(vec)), axis=red)
             else:
                 # shifted one-pass stats: x is read once for both
                 # reductions (half the stats traffic of the two-pass
@@ -219,10 +242,10 @@ class BatchNormOp(Op):
                 # shift-independent, so stop_gradient keeps the backward
                 # pass exact.  See the class docstring for the
                 # early-steps caveat and the precise_stats escape hatch.
-                s = lax.stop_gradient(rm).reshape(1, -1, 1, 1)
+                s = lax.stop_gradient(rm).reshape(vec)
                 d = xf - s
-                dmean = jnp.mean(d, axis=(0, 2, 3))
-                d2mean = jnp.mean(jnp.square(d), axis=(0, 2, 3))
+                dmean = jnp.mean(d, axis=red)
+                d2mean = jnp.mean(jnp.square(d), axis=red)
                 var = jnp.maximum(d2mean - jnp.square(dmean), 0.0)
                 mean = rm + dmean
             ctx.record_update(self.running_mean, (1 - m) * rm + m * mean)
@@ -231,17 +254,18 @@ class BatchNormOp(Op):
             var = var.astype(x.dtype)
         else:
             mean, var = rmean, rvar
-        mean = mean.reshape(1, -1, 1, 1)
-        var = var.reshape(1, -1, 1, 1)
+        mean = mean.reshape(vec)
+        var = var.reshape(vec)
         # stop_gradient on batch stats is NOT applied: gradients flow through
         # mean/var exactly as in cudnnBatchNormalizationBackward.
         return (x - mean) * lax.rsqrt(var + self.eps) * scale + bias
 
 
 def batch_normalization_op(x, scale, bias, momentum=0.1, eps=1e-5,
-                           precise_stats=False, name=None):
+                           precise_stats=False, channel_axis=1, name=None):
     return BatchNormOp(x, scale, bias, momentum=momentum, eps=eps,
-                       precise_stats=precise_stats, name=name)
+                       precise_stats=precise_stats,
+                       channel_axis=channel_axis, name=name)
 
 
 class DropoutOp(Op):

@@ -296,3 +296,40 @@ def test_lstm_matches_torch():
     with torch.no_grad():
         want, _ = tl(torch.from_numpy(X))
     np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_channels_last_matches_nchw():
+    """channels_last=True (fully NHWC, zero layout transposes) must be
+    numerically identical to the NCHW-API model with the same weights
+    (the weight storage — HWIO kernels, C-vector BN — is layout-free)."""
+    rng = np.random.default_rng(0)
+    B = 4
+    X = rng.standard_normal((B, 3, 8, 8)).astype(np.float32)
+    Y = rng.integers(0, 10, (B,)).astype(np.int32)
+
+    losses = {}
+    for cl in (False, True):
+        x = ht.placeholder_op(f"cl_x{cl}",
+                              (B, 8, 8, 3) if cl else (B, 3, 8, 8))
+        y = ht.placeholder_op(f"cl_y{cl}", (B,), dtype=np.int32)
+        model = resnet18(num_classes=10, channels_last=cl)
+        loss = ht.reduce_mean_op(
+            ht.softmax_cross_entropy_sparse_op(model(x), y))
+        opt = ht.MomentumOptimizer(learning_rate=0.05, momentum=0.9)
+        ex = ht.Executor({"train": [loss, opt.minimize(loss)]}, seed=0)
+        if losses:   # copy weights across by CONSTRUCTION order (both
+            # models build identically; sorted-name pairing mispairs when
+            # global fresh_name counters cross a digit boundary, e.g.
+            # bn_10 sorting before bn_9)
+            import jax.numpy as jnp
+            ex.params = dict(zip(ex.params.keys(),
+                                 [jnp.asarray(v) for v in prev.values()]))
+        prev = {k: np.asarray(v) for k, v in ex.params.items()}
+        feed = {x: X.transpose(0, 2, 3, 1) if cl else X, y: Y}
+        losses[cl] = [float(ex.run("train", feed_dict=feed,
+                                   convert_to_numpy_ret_vals=True)[0])
+                      for _ in range(3)]
+    # f32 drift accumulates over the training steps (the two layouts
+    # compile to differently-scheduled but equivalent programs)
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=5e-4, atol=5e-5)
