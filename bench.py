@@ -504,22 +504,32 @@ def bench_wdl(quick):
 
 
 def bench_wdl_ps(quick):
-    """Ours: W&D with the PS host-store embedding path at HET SCALE —
-    an 80M-row × 32-dim table whose in-graph Adam state (28.6 GiB)
-    cannot fit one chip's 16 GiB HBM, trained at a per-step cost flat in
-    table size thanks to the client cache (LFU, 1% of rows) absorbing
-    zipf traffic (SURVEY §3.4 / HET VLDB'22; VERDICT r3 item 2: the
-    driver-visible number should carry the thesis, not an
-    apples-to-oranges ratio vs a small in-graph table).
+    """Ours: W&D with the PS host-store embedding path at HET scale —
+    tables whose in-graph Adam state cannot fit one chip's 16 GiB HBM,
+    trained at a per-step cost FLAT in table size thanks to the client
+    cache (LFU, 1% of rows) absorbing zipf traffic (SURVEY §3.4 / HET
+    VLDB'22).
 
-    `vs_baseline` here is the FLATNESS ratio: steps/s at the infeasible
-    scale over steps/s at the small (337k) table through the same PS
-    path — ~1.0 means table size doesn't tax the step, which is exactly
-    what the in-graph path cannot offer past HBM."""
+    VERDICT r4 items 1c+8: three-point flatness (337k / 2.6M / 8M rows
+    by default; the 28.6 GiB 80M tier is opt-in via
+    HETU_BENCH_WDL_PS_BIG_ROWS=80000000 — same thesis, a tenth the
+    setup cost) with a log-log slope fit, and min/median/max of the
+    per-sweep ratios so one noisy group cannot swing the metric.
+
+    `vs_baseline` is the flatness ratio: steps/s at the LARGEST scale
+    over steps/s at the smallest (337k) table through the same PS path
+    — ~1.0 means table size doesn't tax the step, which is exactly what
+    the in-graph path cannot offer past HBM.  `flatness_slope` is the
+    fitted d log(steps/s) / d log(rows): ~0 means flat."""
     B, steps = (32, 5) if quick else (128, 30)
     dim = 32
-    rows_small = 1000 if quick else 337_000
-    rows_big = 10_000 if quick else 80_000_000
+    if quick:
+        sizes = [1000, 4000, 10_000]
+    else:
+        sizes = [337_000, 2_600_000, 8_000_000]
+        big = int(os.environ.get("HETU_BENCH_WDL_PS_BIG_ROWS", "0"))
+        if big > sizes[-1]:
+            sizes.append(big)
     rng = np.random.default_rng(0)
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
@@ -532,32 +542,45 @@ def bench_wdl_ps(quick):
         feeds = zipf_feeds(rng, rows, B, 26, ph)
         return ex, ps_emb, feeds
 
-    # both stores resident (0.12 + 28.6 GiB host RAM), timed in
-    # ALTERNATING groups: the PS path is host-CPU-bound, so host load
-    # drift must hit both sizes for the flatness ratio to mean anything
-    ex_s, _, feeds_s = build_at(rows_small)
-    ex_b, emb_b, feeds_b = build_at(rows_big)
-    small_v, big_v = [], []
-    for _ in range(5):
-        # groups=1: the median over rounds IS the robustness; best-of-3
-        # inside each round would triple the work and push the
-        # small/big groups apart in time
-        small_v.append(1.0 / time_steps(ex_s, feeds_s, steps, groups=1))
-        big_v.append(1.0 / time_steps(ex_b, feeds_b, steps, groups=1))
-    ratios = sorted(b / s for s, b in zip(small_v, big_v))
+    # all stores resident (0.12 + 0.93 + 2.86 GiB host RAM at defaults),
+    # timed in ROTATING sweeps: the PS path is host-CPU-bound, so host
+    # load drift must hit every size for the flatness ratio to mean
+    # anything.  groups=1 per sweep: the median over sweeps IS the
+    # robustness; best-of-3 inside each sweep would triple the work and
+    # push the groups apart in time.
+    built = [build_at(r) for r in sizes]
+    rounds = 3 if quick else 7
+    sps = {r: [] for r in sizes}
+    for _ in range(rounds):
+        for r, (ex, _, feeds) in zip(sizes, built):
+            sps[r].append(1.0 / time_steps(ex, feeds, steps, groups=1))
+    ratios = sorted(sps[sizes[-1]][i] / sps[sizes[0]][i]
+                    for i in range(rounds))
     flatness = ratios[len(ratios) // 2]
-    sps_small, sps_big = max(small_v), max(big_v)
-    hit_big = emb_b.stats().get("hit_rate", 0.0)
+    med = [sorted(sps[r])[rounds // 2] for r in sizes]
+    slope = float(np.polyfit(np.log(np.asarray(sizes, np.float64)),
+                             np.log(np.asarray(med, np.float64)), 1)[0])
+    hit_big = built[-1][1].stats().get("hit_rate", 0.0)
+    rows_big = sizes[-1]
     in_graph_gib = rows_big * dim * 4 * 3 / 1024 ** 3  # params + adam m,v
     return {"metric": "wdl_ps_het_scale_train_steps_per_sec",
-            "value": round(sps_big, 2), "unit": "steps/sec",
+            "value": round(max(sps[rows_big]), 2), "unit": "steps/sec",
             "vs_baseline": round(flatness, 3),
-            "protocol": "flatness_vs_337k_interleaved_median_of_5",
+            "protocol": f"flatness_{len(sizes)}pt_rotating_median_of_"
+                        f"{rounds}",
             "table_rows": rows_big,
+            "table_sizes": sizes,
+            "steps_per_sec_by_size":
+                {str(r): round(m, 2) for r, m in zip(sizes, med)},
+            "flatness_slope": round(slope, 4),
+            "flatness_min_med_max": [round(ratios[0], 3),
+                                     round(flatness, 3),
+                                     round(ratios[-1], 3)],
             "host_store_gib": round(in_graph_gib, 2),
             "in_graph_feasible": bool(in_graph_gib < 16.0),
             "cache_hit_rate": round(hit_big, 4),
-            "baseline": {"ps_steps_per_sec_at_337k": round(sps_small, 2),
+            "baseline": {"ps_steps_per_sec_at_smallest":
+                             round(max(sps[sizes[0]]), 2),
                          "in_graph_adam_gib_at_scale":
                              round(in_graph_gib, 2),
                          "hbm_gib_v5e": 16.0}}
@@ -567,6 +590,46 @@ STAGES = {"bert": bench_bert, "gpt": bench_gpt_layer,
           "gpt_e2e": bench_gpt_e2e, "llama": bench_llama,
           "resnet": bench_resnet, "moe": bench_moe, "wdl": bench_wdl,
           "wdl_ps": bench_wdl_ps}
+
+# run order: headline first, then the contested perf metrics (VERDICT r4
+# items 2-4), then the rest — so a driver timeout preserves the numbers
+# that matter most.  extra_metrics keeps the historical order regardless.
+STAGE_ORDER = ["bert", "wdl", "resnet", "gpt", "gpt_e2e", "llama", "moe",
+               "wdl_ps"]
+EXTRA_ORDER = ["gpt", "gpt_e2e", "llama", "resnet", "moe", "wdl",
+               "wdl_ps"]
+
+# per-stage wall-clock ceilings (seconds, one attempt).  Round 4's
+# uniform 1500 s x 2 attempts x 8 stages had a 6.5 h worst case — the
+# driver budget fired first and, with output only at the very end,
+# captured NOTHING (BENCH_r04 rc=124, empty tail).  These are sized
+# ~2-3x the observed stage times.
+STAGE_TIMEOUTS = {"bert": 900, "wdl": 900, "resnet": 700, "gpt": 700,
+                  "gpt_e2e": 600, "llama": 600, "moe": 500,
+                  "wdl_ps": 700}
+
+
+def _emit(results, cpu_fallback=False, budget_note=None):
+    """Print ONE complete, parseable headline JSON line reflecting every
+    stage's current state (finished value, FAILED, SKIPPED_BUDGET, or
+    PENDING).  Called after EVERY stage: the driver records the tail of
+    stdout, so the latest line always carries everything measured so
+    far and a timeout can no longer erase the round's evidence
+    (VERDICT r4 item 1)."""
+    def get(stage):
+        r = results.get(stage)
+        if r is None:
+            return {"metric": stage, "value": None, "unit": "PENDING",
+                    "vs_baseline": None}
+        return r
+
+    headline = dict(get("bert"))
+    headline["extra_metrics"] = [get(s) for s in EXTRA_ORDER]
+    if cpu_fallback:
+        headline["platform"] = "cpu_fallback_tunnel_down"
+    if budget_note:
+        headline["budget"] = budget_note
+    print(json.dumps(headline), flush=True)
 
 
 def main():
@@ -589,13 +652,18 @@ def main():
     # each stage in its own process: ours + the flax baseline together
     # exceed one chip's HBM at the BERT headline shapes, and a fresh
     # process returns the chip clean for the next stage.  One retry per
-    # stage (the dev tunnel's remote_compile can fail transiently); a
-    # non-headline stage that still fails is reported as failed rather
-    # than sinking the whole benchmark.
+    # stage (the dev tunnel's remote_compile can fail transiently) if the
+    # budget allows; a stage that still fails is reported as FAILED
+    # rather than sinking the whole benchmark.
     import subprocess
+    t0 = time.time()
+    # global wall-clock budget: once exceeded, remaining stages are
+    # marked SKIPPED_BUDGET instead of run — a bounded, fully-reported
+    # run beats an unbounded one the driver kills mid-flight
+    budget = float(os.environ.get("HETU_BENCH_BUDGET_S", "3300"))
     # pre-flight: probe the device backend in a SHORT-timeout subprocess.
     # With the axon tunnel down, every device call blocks forever; without
-    # this probe the run would burn 2 x 1500s per stage and print nothing.
+    # this probe the run would burn the whole budget and print nothing.
     # Fallback: run the whole bench on CPU (stages auto-quick there) and
     # say so in the output — an honest ratio on the wrong platform beats
     # silence.
@@ -607,7 +675,7 @@ def main():
         # JAX_PLATFORMS=axon the driver environment sets — gets probed:
         # the probe child inherits the env, so it initializes the same
         # backend the stages would, and a dead tunnel surfaces here as a
-        # 120s timeout instead of a 25-minute hang per stage.
+        # 120s timeout instead of a silent budget burn.
         try:
             # select the platform the same way stage children do (config
             # update — a pre-registered plugin wins over the env var), so
@@ -624,20 +692,30 @@ def main():
             sys.stderr.write("device backend unreachable (dead tunnel?) — "
                              "falling back to CPU quick mode\n")
     results = {}
-    for stage in STAGES:
+    _emit(results, cpu_fallback)    # parseable line exists from second 0
+    for stage in STAGE_ORDER:
+        remaining = budget - (time.time() - t0)
+        if remaining < 90:
+            results[stage] = {"metric": stage, "value": None,
+                              "unit": "SKIPPED_BUDGET",
+                              "vs_baseline": None}
+            continue
         cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage]
         if quick:
             cmd.append("--quick")
         for attempt in (0, 1):
-            # hard per-attempt timeout: a WEDGED dev tunnel (observed: the
-            # relay dies and device calls block forever) must surface as a
-            # failed stage, not hang the whole benchmark run
+            # per-attempt timeout clamped to the REMAINING budget: a
+            # WEDGED dev tunnel (observed: the relay dies and device
+            # calls block forever) must surface as a failed stage, and a
+            # retry must not push the run past the budget it promises
+            timeout = min(STAGE_TIMEOUTS.get(stage, 700),
+                          max(90, budget - (time.time() - t0)))
             try:
                 proc = subprocess.run(cmd, capture_output=True, text=True,
-                                      timeout=1500, env=env)
+                                      timeout=timeout, env=env)
             except subprocess.TimeoutExpired:
                 sys.stderr.write(f"stage {stage} timed out\n")
-                break   # timeouts aren't transient; don't burn another 25m
+                break   # timeouts aren't transient; don't burn another slot
             if proc.returncode == 0:
                 results[stage] = json.loads(
                     proc.stdout.strip().splitlines()[-1])
@@ -645,17 +723,19 @@ def main():
                     results[stage]["platform"] = "cpu_fallback_tunnel_down"
                 break
             sys.stderr.write(proc.stderr[-2000:])
+            if budget - (time.time() - t0) < timeout * 0.5:
+                break   # not enough budget left for a meaningful retry
         if stage not in results:
-            if stage == "bert":
-                raise RuntimeError("bench headline stage failed")
             results[stage] = {"metric": stage, "value": None,
                               "unit": "FAILED", "vs_baseline": None}
-    headline = dict(results["bert"])
-    headline["extra_metrics"] = [results["gpt"], results["gpt_e2e"],
-                                 results["llama"],
-                                 results["resnet"], results["moe"],
-                                 results["wdl"], results["wdl_ps"]]
-    print(json.dumps(headline))
+        _emit(results, cpu_fallback)
+    elapsed = round(time.time() - t0, 1)
+    skipped = [s for s in STAGE_ORDER
+               if results[s].get("unit") == "SKIPPED_BUDGET"]
+    _emit(results, cpu_fallback,
+          {"budget_s": budget, "elapsed_s": elapsed,
+           "skipped_stages": skipped} if skipped else
+          {"budget_s": budget, "elapsed_s": elapsed})
 
 
 if __name__ == "__main__":

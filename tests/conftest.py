@@ -19,8 +19,42 @@ import jax  # noqa: E402
 # which takes effect as long as no backend has been initialized yet.
 jax.config.update("jax_platforms", "cpu")
 
+import signal  # noqa: E402
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Enforce ``@pytest.mark.timeout(seconds)`` with SIGALRM.
+
+    pytest-timeout is not installed in this environment, so without this
+    the mark was a silent no-op (VERDICT r4 item 7) — and the PS
+    transport kill/restart tests it guards are exactly the ones that can
+    hang on a wedged socket, wedging the whole gate with them.  SIGALRM
+    interrupts the blocking call in the main thread and surfaces as a
+    plain test failure."""
+    marker = item.get_closest_marker("timeout")
+    use_alarm = (marker is not None and hasattr(signal, "SIGALRM")
+                 and threading.current_thread() is threading.main_thread())
+    if not use_alarm:
+        return (yield)
+    seconds = int(marker.args[0] if marker.args
+                  else marker.kwargs["seconds"])
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded its {seconds}s @pytest.mark.timeout watchdog")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture

@@ -1,0 +1,59 @@
+"""The @pytest.mark.timeout watchdog must FAIL a hung test, not hang.
+
+VERDICT r4 item 7: pytest-timeout isn't installed, so the mark used to
+be a silent no-op ("Unknown pytest.mark.timeout" warning, no
+enforcement).  conftest.py now enforces it via SIGALRM; this test runs a
+deliberately-hung test in a subprocess pytest and asserts it fails
+within the mark's limit instead of wedging the gate.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+
+def test_hung_test_fails_within_watchdog(tmp_path):
+    test_file = tmp_path / "test_hang.py"
+    test_file.write_text(textwrap.dedent("""
+        import socket
+        import pytest
+
+        @pytest.mark.timeout(3)
+        def test_deliberate_hang():
+            # a blocking syscall, the realistic hang mode for the PS
+            # transport tests the watchdog guards
+            a, b = socket.socketpair()
+            a.recv(1)  # never returns without the watchdog
+    """))
+    # reuse the repo conftest (the watchdog lives there)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    conftest_src = open(os.path.join(repo, "tests", "conftest.py")).read()
+    (tmp_path / "conftest.py").write_text(conftest_src)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", str(test_file), "-q", "-p",
+         "no:cacheprovider"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(tmp_path))
+    dt = time.time() - t0
+    assert proc.returncode != 0, "hung test must FAIL, not pass"
+    assert "watchdog" in proc.stdout, proc.stdout[-2000:]
+    assert dt < 60, f"watchdog took {dt:.0f}s (limit was 3s)"
+
+
+def test_no_unknown_mark_warnings():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(repo, "tests", "test_rpc_launch.py"),
+         "--collect-only", "-q"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Unknown pytest.mark" not in proc.stdout
+    assert "Unknown pytest.mark" not in proc.stderr
