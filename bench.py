@@ -376,8 +376,11 @@ def bench_resnet(quick):
     import jax.numpy as jnp
 
     # large batch: CIFAR steps are tiny, and through the dev tunnel a
-    # small-batch measurement times dispatch, not the chip
-    B, steps = (128, 5) if quick else (2048, 20)
+    # small-batch measurement times dispatch, not the chip.  Quick mode
+    # (CPU fallback) must stay under the stage timeout: tiny batch, few
+    # rounds.
+    B, steps = (32, 3) if quick else (2048, 20)
+    rounds = 3 if quick else 7
     rng = np.random.default_rng(0)
     x = ht.placeholder_op("rn_x", (B, 3, 32, 32))
     y = ht.placeholder_op("rn_y", (B,), dtype=np.int32)
@@ -398,7 +401,7 @@ def bench_resnet(quick):
     ours_sps, base, ratio, round_ratios = _interleaved(
         lambda: ex.run("train", feed_dict=feed),
         lambda: base_group(steps) / B,
-        steps, rounds=7)
+        steps, rounds=rounds)
     ours, base = ours_sps * B, base * B
     return {"metric": "resnet18_cifar_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",

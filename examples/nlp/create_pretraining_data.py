@@ -31,7 +31,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--input", required=True,
                     help="text file(s), comma-separated")
-    ap.add_argument("--vocab", required=True)
+    ap.add_argument("--vocab", required=True, help="vocab file path OR a registered name like bert-base-uncased (resolved locally via hetu_tpu.tokenizers.resolve_vocab)")
     ap.add_argument("--output", required=True)
     ap.add_argument("--max_seq_length", type=int, default=128)
     ap.add_argument("--dupe_factor", type=int, default=2)
@@ -45,7 +45,7 @@ def main():
                                    documents_from_text_file)
     from hetu_tpu.tokenizers import BertTokenizer
 
-    tok = BertTokenizer(vocab_file=args.vocab)
+    tok = BertTokenizer.from_pretrained(args.vocab)
     docs = []
     for path in args.input.split(","):
         docs.extend(documents_from_text_file(path, tok))

@@ -31,7 +31,7 @@ def main():
     ap.add_argument("--task", default="sst-2",
                     choices=["sst-2", "mrpc", "cola", "mnli"])
     ap.add_argument("--data_dir", required=True)
-    ap.add_argument("--vocab", required=True)
+    ap.add_argument("--vocab", required=True, help="vocab file path OR a registered name like bert-base-uncased (resolved locally via hetu_tpu.tokenizers.resolve_vocab)")
     ap.add_argument("--hf_weights", default=None,
                     help="torch state_dict file of a HF BertModel/"
                          "BertForSequenceClassification")
@@ -51,7 +51,7 @@ def main():
     from hetu_tpu.models import BertConfig, BertForSequenceClassification
     from hetu_tpu.tokenizers import BertTokenizer
 
-    tok = BertTokenizer(vocab_file=args.vocab)
+    tok = BertTokenizer.from_pretrained(args.vocab)
     proc = GLUE_PROCESSORS[args.task]()
     labels = proc.labels()
     train = convert_examples_to_arrays(

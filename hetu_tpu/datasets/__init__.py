@@ -12,10 +12,16 @@ from .glue import (GlueExample, GlueFeatures, GLUE_PROCESSORS,
                    MnliProcessor, convert_examples_to_arrays)
 from .pretraining import (create_pretraining_arrays,
                           documents_from_text_file, mask_tokens)
+from .criteo import (read_criteo_tsv, process_criteo, read_avazu_csv,
+                     process_avazu, process_dense_feats,
+                     encode_sparse_feats, make_sample_shard)
 
 __all__ = [
     "GlueExample", "GlueFeatures", "GLUE_PROCESSORS", "MrpcProcessor",
     "Sst2Processor", "ColaProcessor", "MnliProcessor",
     "convert_examples_to_arrays", "create_pretraining_arrays",
     "documents_from_text_file", "mask_tokens",
+    "read_criteo_tsv", "process_criteo", "read_avazu_csv",
+    "process_avazu", "process_dense_feats", "encode_sparse_feats",
+    "make_sample_shard",
 ]

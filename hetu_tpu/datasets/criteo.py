@@ -1,0 +1,226 @@
+"""Real-format CTR dataset ingestion: Criteo display-advertising TSV and
+Avazu click-through CSV.
+
+Reference: examples/ctr/models/load_data.py (download_criteo /
+process_dense_feats / process_sparse_feats / process_all_criteo_data —
+the raw-TSV → dense[N,13] + global-id sparse[N,26] + labels contract)
+and tools/EmbeddingMemoryCompression/models/load_data.py (Avazu).  The
+published preprocessing recipe is reimplemented numpy-only (no
+pandas/sklearn):
+
+- dense I1..I13: missing → 0, then ``log(x+1) if x > -1 else -1``;
+- sparse C14..C39: missing → "-1", per-field label encoding over the
+  SORTED unique values (sklearn LabelEncoder's order), then each field
+  offset by the cumulative unique counts so ids index ONE unified
+  embedding table (full Criteo: 33.76M features — the scale documented
+  in tools/EmbeddingMemoryCompression/README.md);
+- shuffled split with the last 10% held out for evaluation.
+
+Download steps are intentionally absent (zero-egress environment); point
+the loaders at a local ``train.txt``/``train.gz`` shard.  A vendored
+sample shard ships at examples/ctr/datasets/criteo_sample.txt so the
+pipeline is exercisable offline end-to-end.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+
+import numpy as np
+
+CRITEO_NUM_DENSE = 13
+CRITEO_NUM_SPARSE = 26
+AVAZU_NUM_SPARSE = 22      # all columns but id/click are categorical
+
+_CACHE_FILES = ["train_dense_feats.npy", "train_sparse_feats.npy",
+                "train_labels.npy", "test_dense_feats.npy",
+                "test_sparse_feats.npy", "test_labels.npy"]
+
+
+def _open_text(path):
+    if str(path).endswith(".gz"):
+        return gzip.open(path, "rt", encoding="utf-8", errors="replace")
+    return open(path, encoding="utf-8", errors="replace")
+
+
+def read_criteo_tsv(path, nrows=None):
+    """Parse the raw Criteo TSV (``label\\tI1..I13\\tC14..C39``, no
+    header, empty fields for missing values; .gz transparent).
+
+    Returns (labels[N] float32, dense_raw[N,13] float64 with NaN for
+    missing, sparse_raw[N,26] '<U8' with '-1' for missing)."""
+    labels, dense, sparse = [], [], []
+    with _open_text(path) as f:
+        for i, line in enumerate(f):
+            if nrows is not None and i >= nrows:
+                break
+            cols = line.rstrip("\n").split("\t")
+            if len(cols) != 1 + CRITEO_NUM_DENSE + CRITEO_NUM_SPARSE:
+                continue        # malformed/truncated line
+            labels.append(np.float32(cols[0]))
+            dense.append([float(c) if c else np.nan
+                          for c in cols[1:1 + CRITEO_NUM_DENSE]])
+            sparse.append([c if c else "-1"
+                           for c in cols[1 + CRITEO_NUM_DENSE:]])
+    return (np.asarray(labels, np.float32),
+            np.asarray(dense, np.float64),
+            np.asarray(sparse))
+
+
+def process_dense_feats(dense_raw):
+    """Reference recipe: missing → 0, then log1p for x > -1 else -1."""
+    d = np.nan_to_num(dense_raw, nan=0.0)
+    out = np.full_like(d, -1.0)
+    np.log1p(d, where=d > -1, out=out)      # masked: no warning at x<=-1
+    return out.astype(np.float32)
+
+
+def encode_sparse_feats(sparse_raw):
+    """Per-field label encoding (sorted unique, sklearn order) + field
+    offsets by cumulative unique counts → GLOBAL ids into one table.
+
+    Returns (ids[N,F] int32, field_dims list[int], num_features)."""
+    n, num_fields = sparse_raw.shape
+    ids = np.empty((n, num_fields), np.int64)
+    field_dims = []
+    offset = 0
+    for f in range(num_fields):
+        uniq, inv = np.unique(sparse_raw[:, f], return_inverse=True)
+        ids[:, f] = inv + offset
+        field_dims.append(len(uniq))
+        offset += len(uniq)
+    return ids.astype(np.int32), field_dims, offset
+
+
+def process_criteo(path, nrows=None, return_val=True, seed=0,
+                   cache_dir=None):
+    """Raw TSV → the reference's processed-array contract.
+
+    With ``return_val`` (the default):
+    ``((train_dense, test_dense), (train_sparse, test_sparse),
+    (train_labels, test_labels)), num_features`` — a shuffled 90/10
+    split, matching process_all_criteo_data's return shape.  Without:
+    ``(dense, sparse, labels), num_features``.
+
+    ``cache_dir``: reuse/write the reference's .npy cache file set
+    (train_dense_feats.npy, ...) so repeated runs skip the parse."""
+    if cache_dir and all(os.path.exists(os.path.join(cache_dir, f))
+                         for f in _CACHE_FILES + ["num_features.npy"]):
+        a = [np.load(os.path.join(cache_dir, f)) for f in _CACHE_FILES]
+        num_features = int(np.load(os.path.join(cache_dir,
+                                                "num_features.npy")))
+        if return_val:
+            return ((a[0], a[3]), (a[1], a[4]), (a[2], a[5])), num_features
+        dense = np.concatenate([a[0], a[3]])
+        sparse = np.concatenate([a[1], a[4]])
+        labels = np.concatenate([a[2], a[5]])
+        return (dense, sparse, labels), num_features
+
+    labels, dense_raw, sparse_raw = read_criteo_tsv(path, nrows)
+    dense = process_dense_feats(dense_raw)
+    sparse, _, num_features = encode_sparse_feats(sparse_raw)
+    if not return_val:
+        return (dense, sparse, labels), num_features
+    n = len(labels)
+    perm = np.random.default_rng(seed).permutation(n)
+    n_test = max(1, n // 10)
+    tr, te = perm[:-n_test], perm[-n_test:]
+    split = ((dense[tr], dense[te]), (sparse[tr], sparse[te]),
+             (labels[tr], labels[te]))
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+        arrays = [split[0][0], split[1][0], split[2][0],
+                  split[0][1], split[1][1], split[2][1]]
+        for fname, arr in zip(_CACHE_FILES, arrays):
+            np.save(os.path.join(cache_dir, fname), arr)
+        np.save(os.path.join(cache_dir, "num_features.npy"),
+                np.int64(num_features))
+    return split, num_features
+
+
+def read_avazu_csv(path, nrows=None):
+    """Parse the raw Avazu CSV (header ``id,click,hour,C1,...``; all
+    feature columns categorical; .gz transparent).
+
+    Returns (labels[N] float32, sparse_raw[N,22] strings)."""
+    labels, sparse = [], []
+    with _open_text(path) as f:
+        header = f.readline().rstrip("\n").split(",")
+        assert header[:2] == ["id", "click"], \
+            f"not an Avazu CSV (header starts {header[:2]})"
+        n_fields = len(header) - 2
+        for i, line in enumerate(f):
+            if nrows is not None and i >= nrows:
+                break
+            cols = line.rstrip("\n").split(",")
+            if len(cols) != len(header):
+                continue
+            labels.append(np.float32(cols[1]))
+            sparse.append([c if c else "-1" for c in cols[2:]])
+    out = np.asarray(sparse)
+    assert out.shape[1] == n_fields
+    return np.asarray(labels, np.float32), out
+
+
+def process_avazu(path, nrows=None, return_val=True, seed=0):
+    """Raw Avazu CSV → global-id sparse arrays (no dense features).
+
+    Returns ``((train_sparse, test_sparse), (train_labels,
+    test_labels)), num_features`` (or unsplit without return_val)."""
+    labels, sparse_raw = read_avazu_csv(path, nrows)
+    sparse, _, num_features = encode_sparse_feats(sparse_raw)
+    if not return_val:
+        return (sparse, labels), num_features
+    n = len(labels)
+    perm = np.random.default_rng(seed).permutation(n)
+    n_test = max(1, n // 10)
+    tr, te = perm[:-n_test], perm[-n_test:]
+    return ((sparse[tr], sparse[te]),
+            (labels[tr], labels[te])), num_features
+
+
+def make_sample_shard(path, n=2000, seed=0, kind="criteo"):
+    """Write a synthetic shard in the EXACT raw format (for offline
+    pipelines/tests; the vendored examples/ctr/datasets/criteo_sample.txt
+    came from this with the default seed).  Labels carry real signal —
+    a logistic model over latent feature effects — so held-out AUC is a
+    meaningful pipeline check, and missing values appear exactly as in
+    the wild (empty TSV fields / empty CSV cells)."""
+    rng = np.random.default_rng(seed)
+    if kind == "criteo":
+        n_dense, n_sparse = CRITEO_NUM_DENSE, CRITEO_NUM_SPARSE
+        card = rng.integers(4, 40, n_sparse)
+    else:
+        n_dense, n_sparse = 0, AVAZU_NUM_SPARSE
+        card = rng.integers(4, 30, n_sparse)
+    w_dense = rng.normal(0, 0.6, n_dense)
+    effects = [rng.normal(0, 0.8, c) for c in card]
+    lines = []
+    if kind == "avazu":
+        lines.append("id,click,hour," + ",".join(
+            f"C{i}" for i in range(1, n_sparse)))
+    for i in range(n):
+        dense_raw = rng.poisson(3.0, n_dense).astype(np.float64)
+        cats = [int((rng.zipf(1.5) - 1) % c) for c in card]
+        logit = (np.log1p(dense_raw) @ w_dense * 0.5
+                 + sum(e[c] for e, c in zip(effects, cats)) * 0.4
+                 - 1.0)
+        y = int(rng.random() < 1.0 / (1.0 + np.exp(-logit)))
+        dmiss = rng.random(n_dense) < 0.1
+        smiss = rng.random(n_sparse) < 0.05
+        if kind == "criteo":
+            dcols = ["" if m else str(int(v))
+                     for v, m in zip(dense_raw, dmiss)]
+            scols = ["" if m else format(0x10000 + c * 97 + f * 7919,
+                                         "08x")
+                     for f, (c, m) in enumerate(zip(cats, smiss))]
+            lines.append("\t".join([str(y)] + dcols + scols))
+        else:
+            scols = ["" if m else f"v{c:04d}"
+                     for c, m in zip(cats, smiss)]
+            lines.append(",".join([format(i, "019d"), str(y),
+                                   f"{14102100 + cats[0]:d}"] + scols[1:]))
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    return path

@@ -1,4 +1,6 @@
 from .bert_tokenizer import (BasicTokenizer, WordpieceTokenizer,
-                             BertTokenizer)
+                             BertTokenizer, register_vocab, resolve_vocab,
+                             PRETRAINED_VOCAB_NAMES)
 
-__all__ = ["BasicTokenizer", "WordpieceTokenizer", "BertTokenizer"]
+__all__ = ["BasicTokenizer", "WordpieceTokenizer", "BertTokenizer",
+           "register_vocab", "resolve_vocab", "PRETRAINED_VOCAB_NAMES"]

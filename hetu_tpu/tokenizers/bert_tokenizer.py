@@ -23,6 +23,72 @@ def load_vocab(vocab_file):
     return vocab
 
 
+# ---------------------------------------------------------------------------
+# Named-vocabulary registry (reference: python/hetu/tokenizers/
+# bert_tokenizer.py:11-29 PRETRAINED_VOCAB_ARCHIVE_MAP + cached_path).
+# The reference resolves well-known names to S3 URLs with a download
+# cache; this environment has no egress, so the registry resolves names
+# to LOCAL files instead: explicit `register_vocab` calls, a
+# `HETU_VOCAB_DIR` directory of `<name>-vocab.txt` / `<name>/vocab.txt`
+# files, and the ~/.cache/hetu_tpu/vocabs default cache dir.  The
+# per-name tokenizer defaults (casing, positional size) ARE carried over
+# — they are part of the public BERT contract, not code.
+
+PRETRAINED_VOCAB_NAMES = (
+    "bert-base-uncased", "bert-large-uncased", "bert-base-cased",
+    "bert-large-cased", "bert-base-multilingual-uncased",
+    "bert-base-multilingual-cased", "bert-base-chinese")
+
+# every public BERT vocab pairs with 512 positions; only the "uncased"
+# variants lowercase (bert-base-chinese's published config keeps case)
+PRETRAINED_DEFAULTS = {
+    name: {"max_len": 512, "do_lower_case": "uncased" in name}
+    for name in PRETRAINED_VOCAB_NAMES}
+
+_REGISTRY = {}
+
+
+def register_vocab(name, path):
+    """Map a vocabulary name to a local vocab.txt path (no network)."""
+    _REGISTRY[name] = path
+
+
+def _vocab_search_dirs():
+    import os
+    dirs = []
+    env = os.environ.get("HETU_VOCAB_DIR")
+    if env:
+        dirs.extend(env.split(os.pathsep))
+    dirs.append(os.path.join(os.path.expanduser("~"), ".cache",
+                             "hetu_tpu", "vocabs"))
+    return dirs
+
+
+def resolve_vocab(name_or_path):
+    """Resolve a vocab NAME (e.g. 'bert-base-uncased') or file path to a
+    local vocab file.  Resolution order: existing path > register_vocab
+    entries > HETU_VOCAB_DIR / default cache dir (``<name>-vocab.txt``,
+    ``<name>.txt`` or ``<name>/vocab.txt``)."""
+    import os
+    if os.path.isfile(name_or_path):
+        return name_or_path
+    if name_or_path in _REGISTRY:
+        return _REGISTRY[name_or_path]
+    for d in _vocab_search_dirs():
+        for cand in (os.path.join(d, name_or_path + "-vocab.txt"),
+                     os.path.join(d, name_or_path + ".txt"),
+                     os.path.join(d, name_or_path, "vocab.txt")):
+            if os.path.isfile(cand):
+                return cand
+    known = ", ".join(sorted(set(list(_REGISTRY)
+                                 + list(PRETRAINED_VOCAB_NAMES))))
+    raise FileNotFoundError(
+        f"vocabulary {name_or_path!r} is neither a file nor a registered "
+        f"name; register_vocab() it, or drop <name>-vocab.txt under "
+        f"$HETU_VOCAB_DIR or ~/.cache/hetu_tpu/vocabs (known names: "
+        f"{known})")
+
+
 def _is_whitespace(ch):
     return ch in (" ", "\t", "\n", "\r") or unicodedata.category(ch) == "Zs"
 
@@ -157,6 +223,16 @@ class BertTokenizer:
         self.unk_token, self.cls_token = unk_token, cls_token
         self.sep_token, self.pad_token = sep_token, pad_token
         self.mask_token = mask_token
+
+    @classmethod
+    def from_pretrained(cls, name_or_path, **kw):
+        """Build a tokenizer from a vocab NAME or file path (reference:
+        bert_tokenizer.py from_pretrained — minus the download; names
+        resolve locally via `resolve_vocab`).  Known names contribute
+        their casing/max_len defaults unless overridden."""
+        defaults = dict(PRETRAINED_DEFAULTS.get(name_or_path, {}))
+        defaults.update(kw)
+        return cls(vocab_file=resolve_vocab(name_or_path), **defaults)
 
     @classmethod
     def from_vocab_list(cls, words, **kw):

@@ -125,6 +125,39 @@ def test_unknown_word_maps_to_unk():
     assert tok.tokenize("zzz") == ["[UNK]"]
 
 
+def test_vocab_registry_resolution(tmp_path, monkeypatch):
+    """Name→path registry (reference bert_tokenizer.py:11-29, minus the
+    download): register_vocab, HETU_VOCAB_DIR scan, per-name defaults."""
+    from hetu_tpu.tokenizers import register_vocab, resolve_vocab
+    from hetu_tpu.tokenizers.bert_tokenizer import _REGISTRY
+    vocab = tmp_path / "bert-base-uncased-vocab.txt"
+    vocab.write_text("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]",
+                                "[MASK]", "the", "fox"]))
+    # 1) a real file path resolves to itself
+    assert resolve_vocab(str(vocab)) == str(vocab)
+    # 2) an unknown name raises with guidance
+    with pytest.raises(FileNotFoundError, match="register_vocab"):
+        resolve_vocab("no-such-vocab")
+    # 3) HETU_VOCAB_DIR scan picks up <name>-vocab.txt
+    monkeypatch.setenv("HETU_VOCAB_DIR", str(tmp_path))
+    assert resolve_vocab("bert-base-uncased") == str(vocab)
+    tok = BertTokenizer.from_pretrained("bert-base-uncased")
+    assert tok.basic.do_lower_case and tok.max_len == 512  # name defaults
+    assert tok.tokenize("The fox") == ["the", "fox"]
+    # 4) explicit registration wins over the dir scan
+    other = tmp_path / "custom.txt"
+    other.write_text("[UNK]\na\n")
+    monkeypatch.setitem(_REGISTRY, "bert-base-uncased", str(other))
+    assert resolve_vocab("bert-base-uncased") == str(other)
+    # 5) cased names default to do_lower_case=False
+    register_vocab("bert-base-cased", str(vocab))
+    try:
+        tok_c = BertTokenizer.from_pretrained("bert-base-cased")
+        assert not tok_c.basic.do_lower_case
+    finally:
+        _REGISTRY.pop("bert-base-cased", None)
+
+
 def test_encode_pair_and_decode():
     tok = _toy_tokenizer()
     ids, types, mask = tok.encode("the quick fox", "lazy dog", max_len=12)
