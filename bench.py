@@ -448,22 +448,46 @@ def bench_moe(quick):
             "baseline": {"flax_same_chip": round(base, 2)}}
 
 
+def _device_us_per_step(run_one, steps, trace_dir):
+    """Per-step DEVICE time from a jax.profiler trace: the stable
+    measurement on this link (the tunnel's per-call RTT drifts 30%+
+    minute-to-minute and its while-loops pay ~2 ms/iteration, so both
+    wall protocols carry a large constant identical on both sides;
+    device op totals reproduce within ~2%)."""
+    import jax
+    from hetu_tpu.timeline import write_aggregates
+
+    with jax.profiler.trace(trace_dir):
+        out = None
+        for _ in range(steps):
+            out = run_one()
+        _sync(out)
+    aggs = write_aggregates(trace_dir, extra={})
+    return sum(v["total_us"] for v in aggs.values()) / steps
+
+
 def bench_wdl(quick):
-    """Ours: graph-API Wide&Deep, in-graph embedding (the TPU-preferred
-    path when the table fits HBM), Adam."""
+    """Ours: graph-API Wide&Deep with the PACKED embedding table
+    (ops/pallas/sparse_densify.py — [rows/8, 128] storage, scatter-free
+    gradient via the Pallas pack-write kernel, single-pass Adam).
+
+    Reported both ways (VERDICT r4 items 2/5): ``vs_baseline`` is the
+    interleaved per-call wall ratio (honest end-to-end, but the dev
+    tunnel contributes a ~0.7 ms identical constant to both sides, so
+    it cannot exceed ~1.0 here no matter the chip-level win), and
+    ``vs_baseline_device`` is the trace-measured device-time ratio —
+    packed removes XLA's 194 us scatter (59% of flax's step) and fuses
+    the table update into one pass."""
     import hetu_tpu as ht
     from hetu_tpu.models import WDL
 
     B, rows = (32, 5000) if quick else (128, 337000)
-    # ~2 ms/step: 50-step groups x 31 rounds — the tunnel's slow windows
-    # last tens of seconds, so MANY short adjacent pairs beat few long
-    # ones (captures have swung 0.83-1.19 with 5-7 x 100-step rounds)
     steps = 10 if quick else 50
     rng = np.random.default_rng(0)
     dense = ht.placeholder_op("dense", (B, 13))
     sparse = ht.placeholder_op("sparse", (B, 26), dtype=np.int32)
     labels = ht.placeholder_op("labels", (B,))
-    model = WDL(rows, embedding_dim=16)
+    model = WDL(rows, embedding_dim=16, packed_embedding=True)
     loss = model.loss(dense, sparse, labels)
     ex = ht.Executor(
         {"train": [loss, ht.AdamOptimizer(0.01).minimize(loss)]})
@@ -473,37 +497,40 @@ def bench_wdl(quick):
             labels: jnp.asarray(rng.integers(0, 2, (B,)), jnp.float32)}
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0])
-    # interleaved ours/baseline groups: both fit HBM at these shapes, and
-    # tunnel drift between sequential measurements has swung this stage's
-    # ratio 0.69-1.09 across otherwise-identical runs (VERDICT r3 item 1)
     from benchmarks.flax_baselines import wdl_train_group
     base_group = wdl_train_group(batch=B, rows=rows)  # built+warmed ONCE
+    base_group(3)
     ours, base, ratio, round_ratios = _interleaved(
         lambda: ex.run("train", feed_dict=feed),
         lambda: base_group(steps),
         steps, rounds=7 if quick else 31)
-    import gc
-    del ex          # each timed executor runs alone (bench_moe discipline)
-    gc.collect()
-
-    # informational: the same model with LAZY sparse table updates
-    # (minimize(sparse_vars=...) — reference OptimizersSparse.cu).  Not
-    # the headline number: the flax baseline uses dense optax adam, and
-    # lazy adam is a different (reference-faithful) update rule.
-    model_s = WDL(rows, embedding_dim=16)
-    loss_s = model_s.loss(dense, sparse, labels)
-    ex_s = ht.Executor({"train": [loss_s, ht.AdamOptimizer(0.01).minimize(
-        loss_s, sparse_vars=[model_s.emb.table])]})
-    out_s = ex_s.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
-    assert np.isfinite(out_s[0])
-    dt_s, _ = _timeit(lambda: ex_s.run("train", feed_dict=feed), steps)
+    # device-time ratio from traces — TPU only: on CPU the trace has no
+    # device lanes and the aggregator would report host/dispatch events,
+    # a misleading stand-in for "device time"
+    import jax
+    dev_ratio = dev_ours = dev_base = None
+    try:
+        if jax.default_backend() != "tpu":
+            raise RuntimeError("device ratio requires a TPU trace")
+        dev_ours = _device_us_per_step(
+            lambda: ex.run("train", feed_dict=feed), 30, "/tmp/bench_wdl_o")
+        dev_base = _device_us_per_step(
+            lambda: base_group(1), 30, "/tmp/bench_wdl_b")
+        if dev_ours and dev_base:
+            dev_ratio = round(dev_base / dev_ours, 3)
+    except Exception:
+        pass
     return {"metric": "wdl_criteo_train_steps_per_sec",
             "value": round(ours, 2), "unit": "steps/sec",
             "vs_baseline": round(ratio, 3),
-            "protocol": "interleaved_median",
+            "vs_baseline_device": dev_ratio,
+            "device_us_per_step": {
+                "ours_packed": round(dev_ours, 1) if dev_ours else None,
+                "flax": round(dev_base, 1) if dev_base else None},
+            "protocol": "interleaved_median+device_trace",
             "round_ratios": round_ratios,
-            "baseline": {"flax_same_chip": round(base, 2)},
-            "lazy_sparse_opt_steps_per_sec": round(1.0 / dt_s, 2)}
+            "packed_table": True,
+            "baseline": {"flax_same_chip": round(base, 2)}}
 
 
 def bench_wdl_ps(quick):

@@ -292,6 +292,11 @@ def wdl_train_group(batch=128, *, rows=337000, dim=16, num_sparse=26,
         float(loss)
         return steps / (time.perf_counter() - start)
 
+    # NOTE: a fori_loop "scan protocol" variant was tried and abandoned:
+    # on the dev-tunnel runtime a device while-loop pays ~2 ms/iteration
+    # regardless of body (measured on a bare matmul loop), swamping both
+    # sides identically.  The stable cross-implementation signal is the
+    # device-trace ratio bench_wdl reports instead.
     return group
 
 

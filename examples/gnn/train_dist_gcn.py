@@ -89,6 +89,10 @@ def build_train_fn(mesh, lr):
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None,
+                    help="prefix of a Cora-format graph (<prefix>.content"
+                         " + <prefix>.cites, e.g. examples/gnn/datasets/"
+                         "cora_sample) — omit for a synthetic graph")
     ap.add_argument("--nodes", type=int, default=256)
     ap.add_argument("--edges", type=int, default=1536)
     ap.add_argument("--features", type=int, default=16)
@@ -101,22 +105,37 @@ def main():
     args = ap.parse_args()
 
     rng = np.random.default_rng(0)
-    n = args.nodes
-    # planted-partition graph (communities => the partitioner has
-    # structure to find, and labels correlate with features)
-    comm = rng.integers(0, args.classes, n)
-    src, dst = [], []
-    while len(src) < args.edges:
-        u, v = rng.integers(0, n, 2)
-        if comm[u] == comm[v] or rng.random() < 0.1:
-            src.append(u)
-            dst.append(v)
-    src, dst = np.asarray(src), np.asarray(dst)
-    labels = comm.astype(np.int32)
-    feats = (rng.standard_normal((n, args.features)).astype(np.float32)
-             + np.eye(args.classes, args.features,
-                      dtype=np.float32)[comm] * 2.0)
-    train_mask = (rng.random(n) < 0.7).astype(np.float32)
+    if args.data:
+        # real-format ingestion (reference sparse_datasets.py role):
+        # citation files -> arrays -> partitioner input
+        from hetu_tpu.gnn import load_cora
+        ds = load_cora(args.data).to_undirected().normalize_features()
+        n = ds.num_nodes
+        src, dst = ds.src, ds.dst
+        labels = ds.y
+        feats = ds.x
+        train_mask = ds.train_mask.astype(np.float32)
+        args.features = feats.shape[1]
+        args.classes = ds.num_classes
+        print(f"{ds.name}: {n} nodes, {ds.num_edges} edges, "
+              f"{args.features} features, {args.classes} classes")
+    else:
+        n = args.nodes
+        # planted-partition graph (communities => the partitioner has
+        # structure to find, and labels correlate with features)
+        comm = rng.integers(0, args.classes, n)
+        src, dst = [], []
+        while len(src) < args.edges:
+            u, v = rng.integers(0, n, 2)
+            if comm[u] == comm[v] or rng.random() < 0.1:
+                src.append(u)
+                dst.append(v)
+        src, dst = np.asarray(src), np.asarray(dst)
+        labels = comm.astype(np.int32)
+        feats = (rng.standard_normal((n, args.features)).astype(np.float32)
+                 + np.eye(args.classes, args.features,
+                          dtype=np.float32)[comm] * 2.0)
+        train_mask = (rng.random(n) < 0.7).astype(np.float32)
 
     gp = partition_graph(src, dst, n, args.block, seed=0)
     rand_part = rng.integers(0, args.block, n)

@@ -1,0 +1,12 @@
+"""Shared text-ingestion helpers for the dataset loaders."""
+
+from __future__ import annotations
+
+import gzip
+
+
+def open_text(path, errors="strict"):
+    """Open a text file, transparently gunzipping ``*.gz``."""
+    if str(path).endswith(".gz"):
+        return gzip.open(path, "rt", encoding="utf-8", errors=errors)
+    return open(path, encoding="utf-8", errors=errors)

@@ -24,10 +24,13 @@ pipeline is exercisable offline end-to-end.
 
 from __future__ import annotations
 
-import gzip
+import itertools
+import json
 import os
 
 import numpy as np
+
+from ._io import open_text
 
 CRITEO_NUM_DENSE = 13
 CRITEO_NUM_SPARSE = 26
@@ -39,33 +42,53 @@ _CACHE_FILES = ["train_dense_feats.npy", "train_sparse_feats.npy",
 
 
 def _open_text(path):
-    if str(path).endswith(".gz"):
-        return gzip.open(path, "rt", encoding="utf-8", errors="replace")
-    return open(path, encoding="utf-8", errors="replace")
+    return open_text(path, errors="replace")
 
 
-def read_criteo_tsv(path, nrows=None):
+def _read_blocks(f, sep, ncols, nrows, block):
+    """Yield [k, ncols] fixed-width numpy string arrays from a line
+    iterator, ``block`` lines at a time.
+
+    Chunking bounds the transient Python-object overhead to one block:
+    the full Criteo train.txt is 45.8M rows, and accumulating per-row
+    Python lists for all of it costs tens of GB before any array
+    exists.  Each block's list-of-lists is converted by ``np.array``
+    into a compact fixed-width string matrix and freed."""
+    remaining = nrows if nrows is not None else float("inf")
+    while remaining > 0:
+        lines = list(itertools.islice(f, int(min(block, remaining))))
+        if not lines:
+            return
+        rows = [cols for cols in (ln.rstrip("\n").split(sep)
+                                  for ln in lines)
+                if len(cols) == ncols]     # drop malformed lines
+        if rows:
+            yield np.array(rows)
+        remaining -= len(lines)
+
+
+def read_criteo_tsv(path, nrows=None, block=524_288):
     """Parse the raw Criteo TSV (``label\\tI1..I13\\tC14..C39``, no
-    header, empty fields for missing values; .gz transparent).
+    header, empty fields for missing values; .gz transparent), in
+    bounded-memory blocks.
 
     Returns (labels[N] float32, dense_raw[N,13] float64 with NaN for
-    missing, sparse_raw[N,26] '<U8' with '-1' for missing)."""
+    missing, sparse_raw[N,26] strings with '-1' for missing)."""
+    ncols = 1 + CRITEO_NUM_DENSE + CRITEO_NUM_SPARSE
     labels, dense, sparse = [], [], []
     with _open_text(path) as f:
-        for i, line in enumerate(f):
-            if nrows is not None and i >= nrows:
-                break
-            cols = line.rstrip("\n").split("\t")
-            if len(cols) != 1 + CRITEO_NUM_DENSE + CRITEO_NUM_SPARSE:
-                continue        # malformed/truncated line
-            labels.append(np.float32(cols[0]))
-            dense.append([float(c) if c else np.nan
-                          for c in cols[1:1 + CRITEO_NUM_DENSE]])
-            sparse.append([c if c else "-1"
-                           for c in cols[1 + CRITEO_NUM_DENSE:]])
-    return (np.asarray(labels, np.float32),
-            np.asarray(dense, np.float64),
-            np.asarray(sparse))
+        for a in _read_blocks(f, "\t", ncols, nrows, block):
+            labels.append(a[:, 0].astype(np.float32))
+            d = a[:, 1:1 + CRITEO_NUM_DENSE]
+            dense.append(np.where(d == "", "nan", d).astype(np.float64))
+            s = a[:, 1 + CRITEO_NUM_DENSE:]
+            sparse.append(np.where(s == "", "-1", s))
+    if not labels:
+        return (np.empty(0, np.float32),
+                np.empty((0, CRITEO_NUM_DENSE), np.float64),
+                np.empty((0, CRITEO_NUM_SPARSE), "U2"))
+    return (np.concatenate(labels), np.concatenate(dense),
+            np.concatenate(sparse))
 
 
 def process_dense_feats(dense_raw):
@@ -93,6 +116,36 @@ def encode_sparse_feats(sparse_raw):
     return ids.astype(np.int32), field_dims, offset
 
 
+def _cache_key(path, nrows, seed):
+    mtime = None
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        pass
+    return {"path": os.path.abspath(path), "mtime": mtime,
+            "nrows": nrows, "seed": seed}
+
+
+def _cache_matches(cache_dir, path, nrows, seed):
+    manifest_p = os.path.join(cache_dir, "manifest.json")
+    if not all(os.path.exists(os.path.join(cache_dir, f))
+               for f in _CACHE_FILES + ["num_features.npy"]):
+        return False
+    try:
+        with open(manifest_p) as f:
+            have = json.load(f)
+    except (OSError, ValueError):
+        return False    # missing/truncated manifest: re-parse, don't crash
+    want = _cache_key(path, nrows, seed)
+    if (have.get("path") != want["path"]
+            or have.get("nrows") != want["nrows"]
+            or have.get("seed") != want["seed"]):
+        return False
+    # source gone (cache copied to another box): trust the manifest;
+    # source changed underneath: re-parse
+    return want["mtime"] is None or have.get("mtime") == want["mtime"]
+
+
 def process_criteo(path, nrows=None, return_val=True, seed=0,
                    cache_dir=None):
     """Raw TSV → the reference's processed-array contract.
@@ -104,9 +157,11 @@ def process_criteo(path, nrows=None, return_val=True, seed=0,
     ``(dense, sparse, labels), num_features``.
 
     ``cache_dir``: reuse/write the reference's .npy cache file set
-    (train_dense_feats.npy, ...) so repeated runs skip the parse."""
-    if cache_dir and all(os.path.exists(os.path.join(cache_dir, f))
-                         for f in _CACHE_FILES + ["num_features.npy"]):
+    (train_dense_feats.npy, ...) so repeated runs skip the parse.  The
+    cache carries a manifest keyed on (source path, mtime, nrows, seed)
+    and is bypassed — re-parsed — when the request doesn't match it, so
+    a stale cache can't silently substitute the wrong data."""
+    if cache_dir and _cache_matches(cache_dir, path, nrows, seed):
         a = [np.load(os.path.join(cache_dir, f)) for f in _CACHE_FILES]
         num_features = int(np.load(os.path.join(cache_dir,
                                                 "num_features.npy")))
@@ -136,31 +191,32 @@ def process_criteo(path, nrows=None, return_val=True, seed=0,
             np.save(os.path.join(cache_dir, fname), arr)
         np.save(os.path.join(cache_dir, "num_features.npy"),
                 np.int64(num_features))
+        tmp = os.path.join(cache_dir, "manifest.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(_cache_key(path, nrows, seed), f)
+        os.replace(tmp, os.path.join(cache_dir, "manifest.json"))
     return split, num_features
 
 
-def read_avazu_csv(path, nrows=None):
+def read_avazu_csv(path, nrows=None, block=524_288):
     """Parse the raw Avazu CSV (header ``id,click,hour,C1,...``; all
-    feature columns categorical; .gz transparent).
+    feature columns categorical; .gz transparent), in bounded-memory
+    blocks (the full set is 40.4M rows).
 
-    Returns (labels[N] float32, sparse_raw[N,22] strings)."""
+    Returns (labels[N] float32, sparse_raw[N,F] strings)."""
     labels, sparse = [], []
     with _open_text(path) as f:
         header = f.readline().rstrip("\n").split(",")
         assert header[:2] == ["id", "click"], \
             f"not an Avazu CSV (header starts {header[:2]})"
         n_fields = len(header) - 2
-        for i, line in enumerate(f):
-            if nrows is not None and i >= nrows:
-                break
-            cols = line.rstrip("\n").split(",")
-            if len(cols) != len(header):
-                continue
-            labels.append(np.float32(cols[1]))
-            sparse.append([c if c else "-1" for c in cols[2:]])
-    out = np.asarray(sparse)
-    assert out.shape[1] == n_fields
-    return np.asarray(labels, np.float32), out
+        for a in _read_blocks(f, ",", len(header), nrows, block):
+            labels.append(a[:, 1].astype(np.float32))
+            s = a[:, 2:]
+            sparse.append(np.where(s == "", "-1", s))
+    if not labels:
+        return (np.empty(0, np.float32), np.empty((0, n_fields), "U2"))
+    return np.concatenate(labels), np.concatenate(sparse)
 
 
 def process_avazu(path, nrows=None, return_val=True, seed=0):

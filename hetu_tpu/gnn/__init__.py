@@ -10,6 +10,12 @@ streaming sampled subgraph batches.
 from .partition import GraphPartition, partition_graph, save_partition, \
     load_partition
 from .sampling import NeighborSampler, GNNDataLoader
+from .datasets import (GraphDataset, read_edge_list, load_cora,
+                       load_graph_npz, save_graph_npz, make_split,
+                       make_cora_sample)
 
 __all__ = ["GraphPartition", "partition_graph", "save_partition",
-           "load_partition", "NeighborSampler", "GNNDataLoader"]
+           "load_partition", "NeighborSampler", "GNNDataLoader",
+           "GraphDataset", "read_edge_list", "load_cora",
+           "load_graph_npz", "save_graph_npz", "make_split",
+           "make_cora_sample"]

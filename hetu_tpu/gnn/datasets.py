@@ -1,0 +1,211 @@
+"""GNN dataset ingestion: edge lists, the classic Cora/Citeseer citation
+format, and the reference's ``graph.npz`` array convention.
+
+Reference: examples/gnn/gnn_tools/sparse_datasets.py (AmazonSparse
+``graph.npz`` with edge/y/train_map arrays; undirected doubling) and
+part_graph.py (dataset → partitioner input).  The download/ogb steps are
+absent by design (zero-egress environment): these loaders ingest LOCAL
+files in the public formats into plain numpy arrays that feed
+``partition_graph`` / ``NeighborSampler`` / the DistGCN example
+directly.  A vendored Cora-format sample graph ships under
+examples/gnn/datasets/ so the pipeline runs offline.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from ..datasets._io import open_text as _open_text
+
+
+@dataclass
+class GraphDataset:
+    """Arrays the rest of the GNN tier consumes (partitioner input)."""
+
+    src: np.ndarray          # [E] int64 edge sources
+    dst: np.ndarray          # [E] int64 edge destinations
+    x: np.ndarray            # [N, F] float32 node features
+    y: np.ndarray            # [N] int32 labels (-1 = unlabeled)
+    train_mask: np.ndarray   # [N] bool
+    val_mask: np.ndarray     # [N] bool
+    test_mask: np.ndarray    # [N] bool
+    num_classes: int
+    name: str = "graph"
+
+    @property
+    def num_nodes(self):
+        return len(self.y)
+
+    @property
+    def num_edges(self):
+        return len(self.src)
+
+    def to_undirected(self):
+        """Add reverse edges and drop duplicates/self-loops (the
+        reference doubles directed edges the same way)."""
+        s = np.concatenate([self.src, self.dst])
+        d = np.concatenate([self.dst, self.src])
+        keep = s != d
+        s, d = s[keep], d[keep]
+        key = s.astype(np.int64) * self.num_nodes + d
+        _, first = np.unique(key, return_index=True)
+        return replace(self, src=s[first], dst=d[first])
+
+    def normalize_features(self):
+        """Row-normalize features (standard citation-network recipe)."""
+        rs = self.x.sum(1, keepdims=True)
+        rs[rs == 0] = 1.0
+        return replace(self, x=(self.x / rs).astype(np.float32))
+
+
+def read_edge_list(path, comments="#", delimiter=None, num_nodes=None):
+    """Parse a plain edge-list text file (``src dst`` per line; SNAP
+    style ``#`` comments; .gz transparent).  Returns (src, dst,
+    num_nodes)."""
+    src, dst = [], []
+    with _open_text(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(comments):
+                continue
+            parts = line.split(delimiter)
+            src.append(int(parts[0]))
+            dst.append(int(parts[1]))
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    n = num_nodes or (int(max(src.max(), dst.max())) + 1 if len(src)
+                      else 0)
+    return src, dst, n
+
+
+def make_split(n, seed=0, train=0.6, val=0.2):
+    """Deterministic train/val/test node split by fractions."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_tr, n_val = int(n * train), int(n * val)
+    tr = np.zeros(n, bool)
+    va = np.zeros(n, bool)
+    te = np.zeros(n, bool)
+    tr[perm[:n_tr]] = True
+    va[perm[n_tr:n_tr + n_val]] = True
+    te[perm[n_tr + n_val:]] = True
+    return tr, va, te
+
+
+def load_cora(prefix, seed=0):
+    """Load the classic Cora/Citeseer citation distribution format:
+
+    - ``<prefix>.content``: ``<paper_id> <f_1..f_F> <class_label>`` per
+      line (string ids, binary word features, string labels);
+    - ``<prefix>.cites``: ``<cited> <citing>`` per line.
+
+    Paper ids and labels are densely re-indexed; citations touching
+    unknown papers are dropped (the classic files contain a few).
+    Returns a GraphDataset with a deterministic 60/20/20 split."""
+    ids, feats, labels = [], [], []
+    with _open_text(prefix + ".content") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) == 1:
+                parts = line.split()
+            ids.append(parts[0])
+            feats.append(np.asarray(parts[1:-1], np.float32))
+            labels.append(parts[-1])
+    id_map = {p: i for i, p in enumerate(ids)}
+    classes = {c: i for i, c in enumerate(sorted(set(labels)))}
+    x = np.stack(feats)
+    y = np.asarray([classes[c] for c in labels], np.int32)
+    src, dst = [], []
+    with _open_text(prefix + ".cites") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) != 2:
+                continue
+            a, b = parts
+            if a in id_map and b in id_map:
+                # "<cited> <citing>": edge direction citing -> cited
+                src.append(id_map[b])
+                dst.append(id_map[a])
+    tr, va, te = make_split(len(ids), seed)
+    return GraphDataset(np.asarray(src, np.int64),
+                        np.asarray(dst, np.int64), x, y, tr, va, te,
+                        num_classes=len(classes),
+                        name=os.path.basename(prefix))
+
+
+def load_graph_npz(path, features_path=None):
+    """Load the reference's ``graph.npz`` convention
+    (sparse_datasets.py AmazonSparse): ``edge`` [E,2], ``y`` [N],
+    ``train_map`` [N] bool; optional dense features and — our
+    extension, written by save_graph_npz — a ``val_map`` so the
+    val/test split survives the round trip (reference files carry only
+    train_map; without val_map, val nodes land in the test mask)."""
+    data = np.load(path)
+    edge = data["edge"]
+    if edge.shape[0] == 2 and edge.shape[1] != 2:
+        edge = edge.T
+    y = data["y"].reshape(-1).astype(np.int32)
+    n = len(y)
+    tr = data["train_map"].astype(bool) if "train_map" in data \
+        else np.ones(n, bool)
+    x = (np.load(features_path).astype(np.float32)
+         if features_path else
+         data["x"].astype(np.float32) if "x" in data
+         else np.empty((n, 0), np.float32))
+    va = data["val_map"].astype(bool) if "val_map" in data \
+        else np.zeros(n, bool)
+    return GraphDataset(edge[:, 0].astype(np.int64),
+                        edge[:, 1].astype(np.int64), x, y, tr, va,
+                        ~tr & ~va, num_classes=int(y.max()) + 1,
+                        name=os.path.basename(os.path.dirname(path))
+                        or "npz")
+
+
+def save_graph_npz(ds, path):
+    """Write the graph.npz convention (round-trips load_graph_npz,
+    including the val/test split via the val_map extension)."""
+    np.savez(path,
+             edge=np.stack([ds.src, ds.dst], 1),
+             y=ds.y, train_map=ds.train_mask, val_map=ds.val_mask,
+             **({"x": ds.x} if ds.x.size else {}))
+
+
+def make_cora_sample(out_prefix, n=300, n_feat=64, n_classes=7,
+                     avg_degree=4, seed=0):
+    """Write a synthetic graph in the EXACT Cora distribution format
+    (string paper ids, tab-separated binary features, string labels,
+    .cites pairs) — the vendored examples/gnn/datasets/cora_sample.*
+    came from this with the default seed.  Communities make both the
+    partitioner and the classifier learn something real."""
+    rng = np.random.default_rng(seed)
+    comm = rng.integers(0, n_classes, n)
+    paper_ids = [str(100000 + 7 * i) for i in range(n)]
+    class_names = [f"Topic_{c}" for c in range(n_classes)]
+    lines = []
+    for i in range(n):
+        # class-correlated sparse binary word features
+        base = np.zeros(n_feat, np.int64)
+        on = rng.random(n_feat) < 0.05
+        base[on] = 1
+        span = n_feat // n_classes
+        block = slice(comm[i] * span, comm[i] * span + span)
+        base[block] |= (rng.random(span) < 0.4).astype(np.int64)
+        lines.append("\t".join([paper_ids[i]]
+                               + [str(v) for v in base]
+                               + [class_names[comm[i]]]))
+    with open(out_prefix + ".content", "w") as f:
+        f.write("\n".join(lines) + "\n")
+    cites = set()
+    target = n * avg_degree // 2
+    while len(cites) < target:
+        u, v = rng.integers(0, n, 2)
+        if u == v:
+            continue
+        if comm[u] == comm[v] or rng.random() < 0.1:
+            cites.add((paper_ids[u], paper_ids[v]))
+    with open(out_prefix + ".cites", "w") as f:
+        f.write("\n".join(f"{a}\t{b}" for a, b in sorted(cites)) + "\n")
+    return out_prefix

@@ -76,6 +76,7 @@ class SubExecutor:
             self.topo = find_topo_sort(self._all_eval)
         self._ps_pending = []
         self._jitted = None
+        self._multi_jitted = None   # lazily-built run_steps program
         # fast-path cache for steady-state training loops: when run() is
         # called repeatedly with the SAME feed_dict object holding
         # device arrays (the common loop shape), the per-call feed
@@ -199,6 +200,7 @@ class SubExecutor:
             new_opt_state.update(ctx.new_opt_state)
             return vals, new_params, new_opt_state, step + 1
 
+        self._step_fn = step_fn   # run_steps builds its scan over this
         donate = ((0, 1, 4) if self.training and self._should_donate()
                   else (4,))
         in_shardings = self.executor._input_shardings(self)
@@ -226,9 +228,14 @@ class SubExecutor:
         fast = self._fast_feed
         if fast is not None and fast[0] is feed_dict:
             feeds = {}
-            for node, name in fast[1]:
+            for node, name, want in fast[1]:
                 v = feed_dict.get(node)
-                if not isinstance(v, jax.Array):
+                # dtype guard: a wrong-dtype device array swapped into
+                # the cached dict would silently retrace a new program
+                # variant instead of being cast — disarm and take the
+                # casting walk below
+                if not isinstance(v, jax.Array) or (
+                        want is not None and v.dtype != want):
                     feeds = None               # value class/keys changed:
                     self._fast_feed = None     # fall back to the full path
                     break
@@ -295,20 +302,29 @@ class SubExecutor:
         feeds = {k: v for k, v in feeds.items() if k in names}
         # cast feeds to declared dtypes (reference DataloaderOp feeds float32)
         all_device = True
+        dtypes = {}
         for p in self.placeholders:
             v = feeds[p.name]
+            want = np.dtype(p.dtype) if p.dtype is not None else None
+            dtypes[p.name] = want
             if not isinstance(v, jax.Array):
                 all_device = False
                 feeds[p.name] = jnp.asarray(v, dtype=p.dtype)
-        # arm the fast path: same dict object + pure device-array feeds +
-        # no PS/dataloader involvement means next call can skip this walk
+            elif want is not None and v.dtype != want:
+                # wrong-dtype DEVICE array: cast (device-side) instead of
+                # silently retracing a second program variant
+                all_device = False
+                feeds[p.name] = v.astype(want)
+        # arm the fast path: same dict object + pure device-array feeds
+        # in declared dtypes + no PS/dataloader involvement means next
+        # call can skip this walk
         if (feed_dict and all_device and not self.ps_rows
                 and len(feed_dict) == len(feeds)):
             pairs = []
             for node in feed_dict:
                 name = node.name if isinstance(node, Op) else node
                 if name in feeds:
-                    pairs.append((node, name))
+                    pairs.append((node, name, dtypes.get(name)))
             if len(pairs) == len(feeds):
                 self._fast_feed = (feed_dict, pairs)
         return self._dispatch(ex, feeds, ps_ids,
@@ -359,6 +375,81 @@ class SubExecutor:
                 f.result()
                 self._ps_pending.remove(f)
             vals = vals[:n_user]
+        if convert_to_numpy_ret_vals:
+            vals = [None if v is None else np.asarray(v) for v in vals]
+        return vals
+
+    def run_steps(self, feed_dict, n, convert_to_numpy_ret_vals=False):
+        """Run ``n`` consecutive training steps on the SAME feeds in ONE
+        device dispatch: an in-graph ``lax.fori_loop`` over the step
+        function, returning the LAST step's values.
+
+        Per-step host dispatch costs a device round trip (~0.5 ms over
+        a remote link, tens of us locally) — for small models that
+        dwarfs the step itself, so this amortizes it n-fold.  The
+        device-resident step counter keeps per-step RNG identical to n
+        ``run()`` calls; checkpoint state advances the same way.
+        Requires pure device-side feeds (no PS embeddings / dataloader
+        placeholders — those interact with the host every step) and an
+        unsharded executor."""
+        if n < 1:
+            raise ValueError(f"run_steps needs n >= 1, got {n}")
+        if self._jitted is None:
+            self._build()
+        if self.ps_rows:
+            raise ValueError("run_steps: PS-embedding subgraphs interact "
+                             "with the host store every step; use run()")
+        if any(hasattr(p, "auto_feed") for p in self.placeholders):
+            raise ValueError("run_steps: dataloader placeholders pull a "
+                             "new batch per step; use run()")
+        if self.executor._input_shardings(self) is not None:
+            raise ValueError("run_steps is not supported on sharded "
+                             "executors yet; use run()")
+        ex = self.executor
+        feeds = {}
+        for node, value in (feed_dict or {}).items():
+            name = node.name if isinstance(node, Op) else node
+            feeds[name] = value
+        names = {p.name for p in self.placeholders}
+        feeds = {k: v for k, v in feeds.items() if k in names}
+        missing = [p.name for p in self.placeholders
+                   if p.name not in feeds]
+        if missing:
+            raise ValueError(f"missing feeds for placeholders: {missing}")
+        for p in self.placeholders:
+            v = feeds[p.name]
+            want = np.dtype(p.dtype) if p.dtype is not None else None
+            if not isinstance(v, jax.Array) or (
+                    want is not None and v.dtype != want):
+                feeds[p.name] = jnp.asarray(v, dtype=p.dtype)
+        if self._multi_jitted is None:
+            step_fn = self._step_fn
+            donate = ((0, 1, 4) if self.training
+                      and self._should_donate() else (4,))
+
+            def multi_fn(params, opt_state, feeds, base_key, step,
+                         n_steps):
+                def body(_, carry):
+                    params, opt_state, step = carry
+                    _, params, opt_state, step = step_fn(
+                        params, opt_state, feeds, base_key, step)
+                    return (params, opt_state, step)
+
+                params, opt_state, step = jax.lax.fori_loop(
+                    0, n_steps - 1, body, (params, opt_state, step))
+                # last step outside the loop so its values are returned
+                return step_fn(params, opt_state, feeds, base_key, step)
+
+            self._multi_jitted = jax.jit(multi_fn, donate_argnums=donate)
+        if ex._step_arr is None:
+            ex._step_arr = jnp.uint32(ex._global_step)
+        ex._global_step += n
+        vals, ex.params, ex.opt_state, ex._step_arr = self._multi_jitted(
+            ex.params, ex.opt_state, feeds, ex._base_key, ex._step_arr,
+            jnp.int32(n))
+        self._runs += n
+        if self._monitor_vars:
+            self.check_monitors()
         if convert_to_numpy_ret_vals:
             vals = [None if v is None else np.asarray(v) for v in vals]
         return vals
@@ -571,6 +662,14 @@ class Executor:
             feed_dict=feed_dict,
             convert_to_numpy_ret_vals=convert_to_numpy_ret_vals)
 
+    def run_steps(self, name, feed_dict, n,
+                  convert_to_numpy_ret_vals=False):
+        """Run ``n`` steps of subgraph ``name`` on the same feeds in ONE
+        device dispatch (see SubExecutor.run_steps)."""
+        return self.subexecutor[name].run_steps(
+            feed_dict, n,
+            convert_to_numpy_ret_vals=convert_to_numpy_ret_vals)
+
     def ps_synchronize(self):
         """Drain in-flight PS embedding traffic across all subgraphs
         (reference worker barriers before SaveParam, executor.py:589)."""
@@ -587,13 +686,14 @@ class Executor:
         ``jax.profiler.trace`` and per-op aggregates (the
         timer_subexecutor.logOut role) are written to
         ``<trace_dir>/op_aggregates.json`` — see hetu_tpu/timeline.py.
-        Returns avg seconds/step (and with trace_dir, the aggregates
-        dict as a second value)."""
+        Returns ``(avg_seconds_per_step, aggregates_or_None)`` —
+        always a pair, so callers passing trace_dir conditionally
+        don't have to switch on the return shape."""
         if name is None:
             name = next(iter(self.subexecutor))
         sub = self.subexecutor[name]
         if trace_dir is None:
-            return sub.profile(feed_dict, repeats=repeats)
+            return sub.profile(feed_dict, repeats=repeats), None
         # compile + warm OUTSIDE the capture — and BLOCK, so no async
         # warmup work leaks in: the aggregates cover exactly `repeats`
         # steps (matching meta)
@@ -631,6 +731,12 @@ class Executor:
                 for i, (name, op) in enumerate(self._opt_ops.items())
                 if hasattr(op, "optimizer")}
         return {"params": host, "opt_state": opt, "opt_meta": meta,
+                # machine-checkable layout tag: 4-D conv kernels are
+                # HWIO (TPU-native).  Without it, an OIHW-era checkpoint
+                # whose kernel dims are all equal (e.g. a 3x3 conv with
+                # 3->3 channels) would load silently transposed — the
+                # shape guard in load_state_dict can't see those.
+                "format": {"conv_layout": "HWIO", "version": 1},
                 "global_step": self._global_step,
                 "base_key": np.asarray(jax.random.key_data(self._base_key))}
 
@@ -644,6 +750,22 @@ class Executor:
         self.load_state_dict(state)
 
     def load_state_dict(self, state):
+        fmt = state.get("format")
+        layout = (fmt or {}).get("conv_layout")
+        if layout not in (None, "HWIO"):
+            raise ValueError(
+                f"checkpoint declares conv_layout={layout!r}; this "
+                "executor expects HWIO kernels — convert with "
+                "Conv2d.load_oihw (see MIGRATION.md)")
+        if fmt is None and any(
+                np.ndim(v) == 4 for v in state["params"].values()):
+            import warnings
+            warnings.warn(
+                "checkpoint predates the conv-layout tag: 4-D kernels "
+                "are assumed HWIO; an OIHW-era checkpoint whose kernel "
+                "dims are all equal cannot be shape-detected — if this "
+                "is one, convert with Conv2d.load_oihw (MIGRATION.md)",
+                stacklevel=2)
         var_by_name = {v.name: v for v in self.variables}
         for name, value in state["params"].items():
             if name in var_by_name:

@@ -21,17 +21,63 @@ from ..ops import (array_reshape_op, concat_op, relu_op, sigmoid_op,
 
 
 class SparseFeatureEmbedding:
-    """One shared table over hashed/offset sparse slots: ids [B, F] -> [B, F*D]."""
+    """One shared table over hashed/offset sparse slots: ids [B, F] -> [B, F*D].
 
-    def __init__(self, num_embeddings, dim, num_fields, name="sparse_emb"):
-        self.table = VariableOp(fresh_name(name), (num_embeddings, dim),
-                                init.normal(0.0, 0.01))
+    ``packed=True`` (or "auto") stores the table in the TPU-native
+    PACKED layout — ``[num_rows/q, 128]`` with q = 128/dim logical rows
+    per lane-line (ops/pallas/sparse_densify.py): the gradient needs no
+    XLA scatter (194 us -> 44 us at W&D bench shapes) and the dense
+    Adam update fuses into a single pass over the table (294 -> 164 us).
+    Same math, different storage: use ``host_table``/``load_rows`` to
+    exchange standard [num_rows, dim] weights."""
+
+    def __init__(self, num_embeddings, dim, num_fields, name="sparse_emb",
+                 packed=False):
+        from ..ops.pallas.sparse_densify import pack_factor, packed_rows
+        if packed == "auto":
+            packed = pack_factor(dim) > 0
+        if packed and not pack_factor(dim):
+            raise ValueError(f"embedding dim {dim} does not pack into "
+                             "128 lanes (needs dim | 128)")
+        self.packed = bool(packed)
+        self.num_embeddings = num_embeddings
         self.dim = dim
         self.num_fields = num_fields
+        if self.packed:
+            self.table = VariableOp(
+                fresh_name(f"{name}_packed"),
+                (packed_rows(num_embeddings, dim), 128),
+                init.normal(0.0, 0.01))
+        else:
+            self.table = VariableOp(fresh_name(name),
+                                    (num_embeddings, dim),
+                                    init.normal(0.0, 0.01))
 
     def __call__(self, ids):
+        if self.packed:
+            from ..ops.embedding import packed_embedding_lookup_op
+            return packed_embedding_lookup_op(self.table, ids, self.dim)
         e = embedding_lookup_op(self.table, ids)  # [B, F, D]
         return e
+
+    def host_table(self, params):
+        """Standard [num_rows, dim] numpy view of the table from an
+        executor's params (unpacks the packed layout)."""
+        w = np.asarray(params[self.table.name])
+        if not self.packed:
+            return w
+        return w.reshape(-1, self.dim)[:self.num_embeddings]
+
+    def load_rows(self, params, weights):
+        """Install standard [num_rows, dim] weights into an executor's
+        params (packs them when the table is packed)."""
+        import jax.numpy as jnp
+        weights = np.asarray(weights, np.float32)
+        if not self.packed:
+            params[self.table.name] = jnp.asarray(weights)
+            return
+        from ..ops.pallas.sparse_densify import pack_table
+        params[self.table.name] = pack_table(weights)
 
 
 class WDL:
@@ -40,12 +86,13 @@ class WDL:
     @scoped_init
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, hidden=(256, 256, 256), name="wdl",
-                 ps_embedding=None):
+                 ps_embedding=None, packed_embedding=False):
         # ps_embedding: a ps.PSEmbedding — the HET cached-PS path for tables
         # that don't fit HBM (reference examples/ctr hybrid_wdl: embeddings
         # via PS + cache, dense params via the device optimizer)
         self.emb = ps_embedding or SparseFeatureEmbedding(
-            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb")
+            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb",
+            packed=packed_embedding)
         # wide part: linear over dense features
         self.wide = Linear(num_dense, 1, name=f"{name}_wide")
         dims = [num_sparse * embedding_dim + num_dense] + list(hidden)
@@ -90,9 +137,10 @@ class DeepFM:
     @scoped_init
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, hidden=(256, 256), name="dfm",
-                 ps_embedding=None):
+                 ps_embedding=None, packed_embedding=False):
         self.emb = ps_embedding or SparseFeatureEmbedding(
-            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb")
+            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb",
+            packed=packed_embedding)
         self.first_order = VariableOp(f"{name}_fo", (num_embeddings, 1),
                                       init.normal(0.0, 0.01))
         dims = [num_sparse * embedding_dim + num_dense] + list(hidden)
@@ -137,9 +185,10 @@ class DCN:
     @scoped_init
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, num_cross=3, hidden=(256, 256), name="dcn",
-                 ps_embedding=None):
+                 ps_embedding=None, packed_embedding=False):
         self.emb = ps_embedding or SparseFeatureEmbedding(
-            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb")
+            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb",
+            packed=packed_embedding)
         d = num_sparse * embedding_dim + num_dense
         self.cross_w = [VariableOp(f"{name}_cw{i}", (d,),
                                    init.normal(0.0, 0.01))
@@ -188,9 +237,10 @@ class DLRM:
     @scoped_init
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, bottom=(512, 256), top=(512, 256),
-                 name="dlrm", ps_embedding=None):
+                 name="dlrm", ps_embedding=None, packed_embedding=False):
         self.emb = ps_embedding or SparseFeatureEmbedding(
-            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb")
+            num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb",
+            packed=packed_embedding)
         bd = [num_dense] + list(bottom) + [embedding_dim]
         self.bottom = [Linear(bd[i], bd[i + 1], name=f"{name}_bot{i}")
                        for i in range(len(bd) - 1)]

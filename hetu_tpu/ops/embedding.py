@@ -89,3 +89,28 @@ class IndexedSlices:
         safe_ids = jnp.maximum(self.indices.reshape(-1), 0)
         return table.at[safe_ids].add(
             vals.reshape((-1,) + self.dense_shape[1:]))
+
+
+from ..graph.node import Op as _Op  # noqa: E402
+
+
+class _PackedLookupOp(_Op):
+    """Lookup from a PACKED [p_rows, 128] embedding table (see
+    ops/pallas/sparse_densify.py — the TPU-native storage for narrow
+    embedding dims whose vjp needs no XLA scatter).  The Pallas write
+    kernel engages only off-mesh on TPU; the jnp fallback is
+    numerically identical (CPU tests, sharded programs)."""
+
+    def _compute(self, input_vals, ctx):
+        from .pallas.sparse_densify import packed_lookup
+        table, ids = input_vals
+        use_pallas = ctx is None or ctx.mesh is None
+        return packed_lookup(table, ids, self.attrs["dim"], use_pallas)
+
+
+def packed_embedding_lookup_op(table, ids, dim, name=None):
+    """Graph op: rows [..., dim] from a packed [p_rows, 128] table."""
+    from .base import _peek_id
+    return _PackedLookupOp(table, ids,
+                           name=name or f"packed_lookup_{_peek_id()}",
+                           dim=dim)

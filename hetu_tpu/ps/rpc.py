@@ -302,6 +302,24 @@ class PSServer:
         self._srv.server_close()
 
 
+class PartialBulkError(ConnectionError):
+    """A sliced bulk mutation died mid-sequence: chunks covering rows
+    ``[0, applied_rows)`` were CONFIRMED applied; the chunk starting at
+    ``applied_rows`` is uncertain (its reply may have been lost after
+    the server applied it); everything after it was never sent.  Callers
+    can resume idempotently with ``set_rows(keys[applied_rows:],
+    values[applied_rows:])`` (per-row idempotent), which re-covers the
+    uncertain chunk safely."""
+
+    def __init__(self, verb, applied_rows, total_rows, cause):
+        super().__init__(
+            f"bulk {verb} failed after {applied_rows}/{total_rows} rows "
+            f"confirmed: {cause}")
+        self.verb = verb
+        self.applied_rows = applied_rows
+        self.total_rows = total_rows
+
+
 class _Conn:
     """One pooled connection: socket + in-flight bookkeeping."""
 
@@ -495,10 +513,11 @@ class RemoteTable:
         Failure granularity: a ConnectionError past retry_deadline can
         leave a PREFIX of chunks applied.  This is the same uncertainty
         class as the unsliced call (whose reply can be lost after the
-        server applied it) at finer granularity; callers that retry a
-        RAISED push at the application level double-apply in either
-        design — checkpoint-restore style writers should prefer
-        set_rows, which is idempotent per row."""
+        server applied it) at finer granularity — so the failure is
+        surfaced as ``PartialBulkError`` carrying the confirmed-applied
+        row count, letting callers (checkpoint writers especially)
+        resume idempotently via ``set_rows`` from ``applied_rows``
+        instead of blindly re-applying the whole mutation."""
         step = max(1, self.bulk_chunk_rows)
         if keys.size == 0:
             # still round-trip once: surfaces dead-server / bad-table
@@ -506,8 +525,11 @@ class RemoteTable:
             self._call({"verb": verb}, keys, vals)
             return
         for i in range(0, keys.size, step):
-            self._call({"verb": verb}, keys[i:i + step],
-                       vals[i:i + step])
+            try:
+                self._call({"verb": verb}, keys[i:i + step],
+                           vals[i:i + step])
+            except ConnectionError as e:
+                raise PartialBulkError(verb, i, int(keys.size), e) from e
 
     def push(self, keys, grads):
         keys = np.asarray(keys).reshape(-1).astype("<i8")

@@ -109,3 +109,36 @@ def test_fingerprint_stable(rng):
     assert f1 == f2
     tree["a"] = tree["a"] + 1.0
     assert debug.fingerprint(tree) != f1
+
+
+def test_checkpoint_carries_conv_layout_tag(rng):
+    """ADVICE r4: state_dict embeds a machine-checkable conv-layout tag;
+    loading an untagged checkpoint with 4-D params warns, and a non-HWIO
+    tag is rejected with a pointer at the converter."""
+    import warnings as _warnings
+    import hetu_tpu as ht
+    from hetu_tpu.layers import Conv2d
+    x = ht.placeholder_op("clt_x", (2, 3, 8, 8))
+    conv = Conv2d(3, 3, kernel_size=3, padding=1)   # 3->3 3x3: all-equal
+    s = ht.reduce_sum_op(ht.reduce_sum_op(ht.reduce_sum_op(
+        ht.reduce_sum_op(conv(x), axes=3), axes=2), axes=1), axes=0)
+    ex = ht.Executor({"eval": [s]}, training=False)
+    state = ex.state_dict()
+    assert state["format"]["conv_layout"] == "HWIO"
+
+    # tagged checkpoint loads silently
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        ex.load_state_dict(state)
+
+    # untagged (pre-r5) checkpoint with a 4-D param warns
+    legacy = dict(state)
+    legacy.pop("format")
+    with pytest.warns(UserWarning, match="conv-layout tag"):
+        ex.load_state_dict(legacy)
+
+    # declared OIHW is refused with the converter named
+    bad = dict(state)
+    bad["format"] = {"conv_layout": "OIHW"}
+    with pytest.raises(ValueError, match="load_oihw"):
+        ex.load_state_dict(bad)

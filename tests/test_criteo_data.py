@@ -66,9 +66,9 @@ def test_process_criteo_split_and_cache_roundtrip(tmp_path):
     split1, nf1 = process_criteo(SAMPLE, cache_dir=str(tmp_path))
     assert all(os.path.exists(tmp_path / f) for f in
                ["train_dense_feats.npy", "test_sparse_feats.npy",
-                "test_labels.npy"])
-    # second call must come from the .npy cache, byte-identical
-    split2, nf2 = process_criteo("/nonexistent", cache_dir=str(tmp_path))
+                "test_labels.npy", "manifest.json"])
+    # same request must come from the .npy cache, byte-identical
+    split2, nf2 = process_criteo(SAMPLE, cache_dir=str(tmp_path))
     assert nf1 == nf2
     for a, b in zip(split1, split2):
         np.testing.assert_array_equal(a[0], b[0])
@@ -77,6 +77,22 @@ def test_process_criteo_split_and_cache_roundtrip(tmp_path):
     assert len(lte) == 200 and len(ltr) == 1800  # 10% held out
     assert dtr.shape[1] == CRITEO_NUM_DENSE
     assert strn.shape[1] == CRITEO_NUM_SPARSE
+
+
+def test_criteo_cache_is_keyed_on_request(tmp_path):
+    """A stale cache must not silently answer a DIFFERENT request: the
+    manifest keys on (path, mtime, nrows, seed) and mismatches
+    re-parse."""
+    _, nf_full = process_criteo(SAMPLE, cache_dir=str(tmp_path))
+    # different nrows -> cache bypassed, smaller arrays parsed fresh
+    ((dtr, _), _, (ltr, lte)), _ = process_criteo(
+        SAMPLE, nrows=500, cache_dir=str(tmp_path))
+    assert len(ltr) + len(lte) == 500
+    # the cache now holds the nrows=500 parse; the full request must
+    # NOT reuse it
+    split3, nf3 = process_criteo(SAMPLE, cache_dir=str(tmp_path))
+    assert len(split3[2][0]) + len(split3[2][1]) == 2000
+    assert nf3 == nf_full
 
 
 def test_gzip_transparency(tmp_path):

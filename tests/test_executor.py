@@ -537,3 +537,45 @@ def test_fast_feed_cache_semantics():
     v4 = float(ex.run("eval", feed_dict={x: a},
                       convert_to_numpy_ret_vals=True)[0])
     assert v4 == 64.0
+
+
+def test_fast_feed_dtype_guard_disarms_and_casts():
+    """ADVICE r4: a wrong-dtype DEVICE array swapped into the cached
+    feed dict must not silently retrace a new program variant — the
+    fast path disarms and the slow path casts it to the declared
+    dtype."""
+    import jax.numpy as jnp
+    x = ht.placeholder_op("ffd_x", (4, 8))
+    w = ht.Variable("ffd_w", value=np.ones((8, 2), np.float32))
+    s = ht.reduce_sum_op(ht.reduce_sum_op(ht.matmul_op(x, w), axes=1),
+                         axes=0)
+    ex = ht.Executor({"eval": [s]}, training=False)
+    sub = ex.subexecutor["eval"]
+    feed = {x: jnp.ones((4, 8), jnp.float32)}
+    assert float(ex.run("eval", feed_dict=feed,
+                        convert_to_numpy_ret_vals=True)[0]) == 64.0
+    assert sub._fast_feed is not None
+    # swap in a bf16 device array under the SAME dict object
+    feed[x] = jnp.full((4, 8), 2.0, jnp.bfloat16)
+    v = float(ex.run("eval", feed_dict=feed,
+                     convert_to_numpy_ret_vals=True)[0])
+    assert v == 128.0
+    # the guard disarmed the fast path for that call, and re-arming only
+    # happens for clean declared-dtype device feeds
+    feed[x] = jnp.full((4, 8), 3.0, jnp.float32)
+    assert float(ex.run("eval", feed_dict=feed,
+                        convert_to_numpy_ret_vals=True)[0]) == 192.0
+
+
+def test_profile_returns_consistent_pair():
+    """ADVICE r4: Executor.profile returns (dt, aggs_or_None) with and
+    without trace_dir — no type-switching return."""
+    x = ht.placeholder_op("pr_x", (2, 4))
+    s = ht.reduce_sum_op(ht.reduce_sum_op(x * 2.0, axes=1), axes=0)
+    ex = ht.Executor({"eval": [s]}, training=False)
+    out = ex.profile("eval", feed_dict={x: np.ones((2, 4), np.float32)},
+                     repeats=2)
+    assert isinstance(out, tuple) and len(out) == 2
+    dt, aggs = out
+    assert isinstance(dt, float) and dt > 0
+    assert aggs is None

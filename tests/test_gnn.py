@@ -1,6 +1,8 @@
 """GNN / DistGCN-1.5D tests (reference: tests/test_DistGCN — parallel vs
 single-device GCN propagation equivalence)."""
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -8,6 +10,7 @@ from jax.sharding import Mesh, PartitionSpec as Pspec
 import pytest
 
 import hetu_tpu as ht
+from hetu_tpu.gnn import partition_graph
 from hetu_tpu.models.gnn import (normalized_adjacency, DistGCN15D,
                                  distgcn_15d_op, _gcn_conv)
 
@@ -249,3 +252,88 @@ def test_partitioned_distgcn_loss_parity(rng):
         ps, ls = single_step(ps)
         np.testing.assert_allclose(float(ld), float(ls), rtol=2e-4,
                                    atol=2e-5)
+
+
+# ---------------- dataset ingestion (gnn/datasets.py) ----------------
+# Reference contract: examples/gnn/gnn_tools/sparse_datasets.py (graph.npz
+# arrays, undirected doubling) + the classic Cora citation format.
+
+from hetu_tpu.gnn import (GraphDataset, read_edge_list, load_cora,  # noqa: E402
+                          load_graph_npz, save_graph_npz, make_split,
+                          make_cora_sample)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORA_SAMPLE = os.path.join(_REPO, "examples", "gnn", "datasets",
+                           "cora_sample")
+
+
+def test_cora_format_ingestion():
+    ds = load_cora(CORA_SAMPLE)
+    assert ds.num_nodes == 300 and ds.x.shape == (300, 64)
+    assert ds.num_classes == 7
+    assert ds.y.min() >= 0 and ds.y.max() == 6
+    assert ds.src.max() < 300 and ds.dst.max() < 300
+    # deterministic split partitions the node set
+    assert (ds.train_mask.astype(int) + ds.val_mask.astype(int)
+            + ds.test_mask.astype(int) == 1).all()
+    ds2 = load_cora(CORA_SAMPLE)
+    np.testing.assert_array_equal(ds.train_mask, ds2.train_mask)
+
+
+def test_to_undirected_dedups_and_symmetrizes():
+    ds = load_cora(CORA_SAMPLE)
+    u = ds.to_undirected()
+    # every edge has its reverse
+    fwd = set(zip(u.src.tolist(), u.dst.tolist()))
+    assert all((d, s) in fwd for s, d in fwd)
+    assert all(s != d for s, d in fwd)          # no self loops
+    assert len(fwd) == u.num_edges              # no duplicates
+
+
+def test_normalize_features_rows_sum_to_one():
+    ds = load_cora(CORA_SAMPLE).normalize_features()
+    rs = ds.x.sum(1)
+    nz = rs > 0
+    np.testing.assert_allclose(rs[nz], 1.0, rtol=1e-5)
+
+
+def test_edge_list_parse(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# SNAP-style comment\n0 1\n1 2\n4 0\n")
+    src, dst, n = read_edge_list(str(p))
+    np.testing.assert_array_equal(src, [0, 1, 4])
+    np.testing.assert_array_equal(dst, [1, 2, 0])
+    assert n == 5
+
+
+def test_graph_npz_roundtrip(tmp_path):
+    ds = load_cora(CORA_SAMPLE)
+    path = str(tmp_path / "graph.npz")
+    save_graph_npz(ds, path)
+    back = load_graph_npz(path)
+    np.testing.assert_array_equal(back.src, ds.src)
+    np.testing.assert_array_equal(back.dst, ds.dst)
+    np.testing.assert_array_equal(back.y, ds.y)
+    np.testing.assert_array_equal(back.train_mask, ds.train_mask)
+    # the val/test split survives too (val_map extension)
+    np.testing.assert_array_equal(back.val_mask, ds.val_mask)
+    np.testing.assert_array_equal(back.test_mask, ds.test_mask)
+    np.testing.assert_allclose(back.x, ds.x)
+    assert back.num_classes == ds.num_classes
+
+
+def test_cora_sample_regenerates_identically(tmp_path):
+    make_cora_sample(str(tmp_path / "cora_sample"), seed=0)
+    for ext in (".content", ".cites"):
+        assert (open(str(tmp_path / "cora_sample") + ext).read()
+                == open(CORA_SAMPLE + ext).read()), ext
+
+
+def test_real_format_graph_feeds_partitioner():
+    ds = load_cora(CORA_SAMPLE).to_undirected()
+    gp = partition_graph(ds.src, ds.dst, ds.num_nodes, 4, seed=0)
+    sizes = np.bincount(gp.part, minlength=4)
+    assert sizes.max() - sizes.min() <= ds.num_nodes // 8  # balanced
+    rand_part = np.random.default_rng(0).integers(0, 4, ds.num_nodes)
+    rand_cut = int((rand_part[ds.src] != rand_part[ds.dst]).sum())
+    assert gp.edge_cut < rand_cut  # beats random assignment

@@ -382,3 +382,36 @@ def test_sharded_table_accepts_exact_tail_shards():
     assert rows.shape == (10, 4)
     with pytest.raises(ValueError, match="rows <"):
         ShardedTable(10, 4, tables=[EmbeddingTable(3, 4)] * 3)
+
+
+def test_partial_bulk_error_reports_applied_rows(monkeypatch):
+    """ADVICE r4: a sliced bulk mutation that dies mid-sequence raises
+    PartialBulkError carrying the confirmed-applied row count so callers
+    can resume idempotently from that offset with set_rows."""
+    from hetu_tpu.ps import PartialBulkError
+    from hetu_tpu.ps.rpc import RemoteTable
+
+    t = RemoteTable.__new__(RemoteTable)   # no live server needed
+    t.dim = 4
+    t.bulk_chunk_rows = 10
+    calls = []
+
+    def fake_call(header, *arrays):
+        calls.append(len(arrays[0]) if arrays else 0)
+        if len(calls) == 3:
+            raise ConnectionError("server died")
+        return {}, []
+
+    t._call = fake_call
+    keys = np.arange(35, dtype="<i8")
+    vals = np.zeros((35, 4), "<f4")
+    with pytest.raises(PartialBulkError) as ei:
+        t.set_rows(keys, vals)
+    err = ei.value
+    assert err.applied_rows == 20       # two confirmed chunks of 10
+    assert err.total_rows == 35
+    assert err.verb == "set_rows"
+    assert isinstance(err, ConnectionError)   # old handlers still catch
+    # resume contract: set_rows(keys[applied_rows:]) re-covers the
+    # uncertain chunk and the unsent tail exactly
+    assert calls == [10, 10, 10]
