@@ -185,6 +185,12 @@ def process_criteo(path, nrows=None, return_val=True, seed=0,
              (labels[tr], labels[te]))
     if cache_dir:
         os.makedirs(cache_dir, exist_ok=True)
+        # invalidate FIRST: if this rewrite dies midway, a stale
+        # manifest must not validate the new/partial arrays
+        try:
+            os.remove(os.path.join(cache_dir, "manifest.json"))
+        except OSError:
+            pass
         arrays = [split[0][0], split[1][0], split[2][0],
                   split[0][1], split[1][1], split[2][1]]
         for fname, arr in zip(_CACHE_FILES, arrays):

@@ -111,6 +111,8 @@ def load_cora(prefix, seed=0):
             parts = line.rstrip("\n").split("\t")
             if len(parts) == 1:
                 parts = line.split()
+            if len(parts) < 3:
+                continue        # blank/malformed line (id, >=1 feat, label)
             ids.append(parts[0])
             feats.append(np.asarray(parts[1:-1], np.float32))
             labels.append(parts[-1])

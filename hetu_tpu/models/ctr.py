@@ -90,6 +90,10 @@ class WDL:
         # ps_embedding: a ps.PSEmbedding — the HET cached-PS path for tables
         # that don't fit HBM (reference examples/ctr hybrid_wdl: embeddings
         # via PS + cache, dense params via the device optimizer)
+        if ps_embedding is not None and packed_embedding:
+            raise ValueError("packed_embedding applies to the in-graph "
+                             "table; it cannot combine with ps_embedding "
+                             "(the PS store owns the row layout)")
         self.emb = ps_embedding or SparseFeatureEmbedding(
             num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb",
             packed=packed_embedding)
@@ -138,6 +142,10 @@ class DeepFM:
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, hidden=(256, 256), name="dfm",
                  ps_embedding=None, packed_embedding=False):
+        if ps_embedding is not None and packed_embedding:
+            raise ValueError("packed_embedding applies to the in-graph "
+                             "table; it cannot combine with ps_embedding "
+                             "(the PS store owns the row layout)")
         self.emb = ps_embedding or SparseFeatureEmbedding(
             num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb",
             packed=packed_embedding)
@@ -186,6 +194,10 @@ class DCN:
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, num_cross=3, hidden=(256, 256), name="dcn",
                  ps_embedding=None, packed_embedding=False):
+        if ps_embedding is not None and packed_embedding:
+            raise ValueError("packed_embedding applies to the in-graph "
+                             "table; it cannot combine with ps_embedding "
+                             "(the PS store owns the row layout)")
         self.emb = ps_embedding or SparseFeatureEmbedding(
             num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb",
             packed=packed_embedding)
@@ -238,6 +250,10 @@ class DLRM:
     def __init__(self, num_embeddings, embedding_dim=16, num_sparse=26,
                  num_dense=13, bottom=(512, 256), top=(512, 256),
                  name="dlrm", ps_embedding=None, packed_embedding=False):
+        if ps_embedding is not None and packed_embedding:
+            raise ValueError("packed_embedding applies to the in-graph "
+                             "table; it cannot combine with ps_embedding "
+                             "(the PS store owns the row layout)")
         self.emb = ps_embedding or SparseFeatureEmbedding(
             num_embeddings, embedding_dim, num_sparse, name=f"{name}_emb",
             packed=packed_embedding)

@@ -135,48 +135,73 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, offs_ref,
            + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
 
     nk = k_len // block_k
+    nk_causal = nk
     if causal:
         # kv blocks strictly above the diagonal contribute nothing; with
         # offsets the bound is dynamic (clamped below), without it's static
         hi = (q_off + (qi + 1) * bq - 1 - k_off) // block_k + 1
-        nk = jax.lax.clamp(0, hi, nk) if offs_ref is not None \
+        nk_causal = jax.lax.clamp(0, hi, nk) if offs_ref is not None \
             else jax.lax.min(nk, hi)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
-        # scores tracked in BASE-2 units (s2 = s * log2(e)): exp2 is the
-        # VPU's native exponential; lse converts back to natural units at
-        # the end so the backward's exp(s - lse) contract is unchanged
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) \
-            * (scale * _LOG2E)
-        if mask_ref is not None:
-            s = s + (mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
-                     * _LOG2E)
-        if causal:
-            col = (k_off + j * block_k
-                   + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
-            s = jnp.where(row >= col, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp2(s - m_new[:, None])
-        alpha = jnp.exp2(m - m_new)
-        # l accumulates UN-dropped sums: O = dropout(P_normalized) @ V
-        l_new = l * alpha + jnp.sum(p, axis=1)
-        if keep_prob < 1.0:
-            nq, nk_tot = q_len // bq, k_len // block_k
-            p = _drop_tile(p, seed_ref,
-                           _tile_index(bh, qi, j, nq, nk_tot), keep_prob)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+    def make_body(masked):
+        def body(j, carry):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+            # scores tracked in BASE-2 units (s2 = s * log2(e)): exp2 is
+            # the VPU's native exponential; lse converts back to natural
+            # units at the end so the backward's exp(s - lse) contract is
+            # unchanged
+            s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * (scale * _LOG2E)
+            if mask_ref is not None:
+                s = s + (mask_ref[0, 0,
+                                  pl.ds(j * block_k, block_k)][None, :]
+                         * _LOG2E)
+            if causal and masked:
+                col = (k_off + j * block_k
+                       + jax.lax.broadcasted_iota(jnp.int32,
+                                                  (bq, block_k), 1))
+                s = jnp.where(row >= col, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            p = jnp.exp2(s - m_new[:, None])
+            alpha = jnp.exp2(m - m_new)
+            # l accumulates UN-dropped sums: O = dropout(P_norm) @ V
+            l_new = l * alpha + jnp.sum(p, axis=1)
+            if keep_prob < 1.0:
+                nq, nk_tot = q_len // bq, k_len // block_k
+                p = _drop_tile(p, seed_ref,
+                               _tile_index(bh, qi, j, nq, nk_tot),
+                               keep_prob)
+            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+        return body
 
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    if causal and k_len // block_k > 8:
+        # split loop: kv blocks fully below the diagonal need no mask —
+        # the where+iota per tile is pure VPU overhead on ~(nk-1)/nk of
+        # the causal work, alternating with the exp2 on the critical
+        # path.  Only worth it when there are MANY kv blocks (long
+        # context / ring shards); at nk <= ~8 the second loop's
+        # bookkeeping outweighs the saved masking (measured +0.1
+        # ms/layer on GPT-2.7B S=2048 with 512-blocks, -12% kernel time
+        # at S=8192).
+        lo = (q_off + qi * bq - k_off) // block_k
+        n_full = (jax.lax.clamp(0, lo, nk) if offs_ref is not None
+                  else jax.lax.max(0, jax.lax.min(nk, lo)))
+        carry = jax.lax.fori_loop(0, n_full, make_body(False),
+                                  (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(n_full, nk_causal, make_body(True),
+                                      carry)
+    else:
+        m, l, acc = jax.lax.fori_loop(0, nk_causal, make_body(True),
+                                      (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     m = m * _LN2    # back to natural-log units for the stored lse

@@ -306,10 +306,16 @@ class PartialBulkError(ConnectionError):
     """A sliced bulk mutation died mid-sequence: chunks covering rows
     ``[0, applied_rows)`` were CONFIRMED applied; the chunk starting at
     ``applied_rows`` is uncertain (its reply may have been lost after
-    the server applied it); everything after it was never sent.  Callers
-    can resume idempotently with ``set_rows(keys[applied_rows:],
-    values[applied_rows:])`` (per-row idempotent), which re-covers the
-    uncertain chunk safely."""
+    the server applied it); everything after it was never sent.
+
+    Resume recipe — ONLY when ``verb == "set_rows"``: call
+    ``set_rows(keys[applied_rows:], values[applied_rows:])``; set is
+    per-row idempotent, so re-covering the uncertain chunk is safe.  A
+    failed ``push`` carries GRADIENTS, which are neither idempotent nor
+    row contents — re-pushing the uncertain chunk may double-apply it,
+    and set_rows-ing gradients would corrupt the table outright;
+    push callers should treat the tail as lost (the SSP/bounded-
+    staleness model already tolerates dropped updates) or re-derive."""
 
     def __init__(self, verb, applied_rows, total_rows, cause):
         super().__init__(
