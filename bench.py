@@ -517,6 +517,28 @@ def bench_wdl(quick):
             labels: jnp.asarray(rng.integers(0, 2, (B,)), jnp.float32)}
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0])
+    # the test suite runs on forced-CPU (jnp fallback); this stage is
+    # the per-round TPU correctness witness for the pack-write KERNEL:
+    # same gradient through the kernel and the fallback, same inputs
+    import jax
+    if jax.default_backend() == "tpu":
+        from hetu_tpu.ops.pallas.sparse_densify import packed_lookup
+        tbl = ex.params[model.emb.table.name]
+        idsv = feed[sparse]
+        # distinct per-row cotangents: an all-ones ct would make every
+        # same-lane-offset line identical and let a misrouted write-DMA
+        # pass the check byte-identically
+        ct = jnp.asarray(rng.standard_normal((idsv.size, 16)),
+                         jnp.float32)
+
+        def g(t, pallas):
+            return jax.grad(lambda t_: jnp.sum(
+                packed_lookup(t_, idsv.reshape(-1), 16, pallas) * ct))(t)
+
+        gk = np.asarray(g(tbl, True))
+        gf = np.asarray(g(tbl, False))
+        err = np.abs(gk - gf).max()
+        assert err < 1e-4, f"pack-write kernel diverges from fallback: {err}"
     from benchmarks.flax_baselines import wdl_train_group
     base_group = wdl_train_group(batch=B, rows=rows)  # built+warmed ONCE
     base_group(3)
