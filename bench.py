@@ -549,7 +549,6 @@ def bench_wdl(quick):
     # device-time ratio from traces — TPU only: on CPU the trace has no
     # device lanes and the aggregator would report host/dispatch events,
     # a misleading stand-in for "device time"
-    import jax
     dev_ratio = dev_ours = dev_base = None
     try:
         if jax.default_backend() != "tpu":
