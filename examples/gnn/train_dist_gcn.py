@@ -32,7 +32,9 @@ force_platform_from_env()
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from hetu_tpu.platform import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from hetu_tpu.gnn import partition_graph
@@ -54,7 +56,7 @@ def build_train_fn(mesh, lr):
         # rows of z1 are block-sharded; re-gather to rep-sharded rows
         z1_rows = lax.all_gather(z1, "block", tiled=True)
         idx = lax.axis_index("rep")
-        n_rep = lax.axis_size("rep")
+        n_rep = lax.psum(1, "rep")    # axis size, any jax version
         rows = z1_rows.shape[0] // n_rep
         z1_mine = lax.dynamic_slice_in_dim(z1_rows, idx * rows, rows)
         return layer(z1_mine, params["w2"])

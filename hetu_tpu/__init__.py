@@ -24,6 +24,7 @@ from .optim import lr_scheduler
 from . import ps
 from . import metrics
 from .dataloader import Dataloader, DataloaderOp, dataloader_op
+from .datasets.prefetch import DevicePrefetcher, prefetch_feeds
 from .logger import HetuLogger, WandbLogger
 from .profiler import HetuProfiler, HetuSimulator
 from . import timeline

@@ -170,7 +170,7 @@ class Dataloader:
     def __init__(self, raw_data, batch_size, shuffle=False, drop_last=True,
                  dp_rank=0, dp_nrank=1, seed=0, prefetch=2, name="data",
                  device_prefetch=False, dtype=None, transform=None,
-                 num_workers=0):
+                 num_workers=0, sharding=None):
         data = np.asarray(raw_data)
         if dp_nrank > 1:
             # contiguous equal shards; tail dropped so every rank agrees
@@ -185,8 +185,13 @@ class Dataloader:
         # jax.device_put as soon as it's sliced, so the host->device copy
         # overlaps the previous step instead of landing on the critical
         # path (on a remote-tunnel chip a per-step synchronous upload
-        # costs a full link round trip; on TPU-VM it's PCIe time)
+        # costs a full link round trip; on TPU-VM it's PCIe time).
+        # ``sharding``: the committed layout for the batch (a
+        # jax.sharding.Sharding) — under a dp/tp mesh the upload lands
+        # sharded exactly as the compiled step's in_shardings expect,
+        # instead of single-device + GSPMD reshard.
         self.device_prefetch = device_prefetch
+        self.sharding = sharding
         self.dtype = dtype
         # transform: per-batch augmentation/tokenization callable.  Pure
         # Python transforms are GIL-bound — pair with num_workers>0 to
@@ -236,10 +241,7 @@ class Dataloader:
                 if self.transform is not None:
                     batch = np.asarray(self.transform(batch))
                 if self.device_prefetch:
-                    import jax
-                    import jax.numpy as jnp
-                    batch = jax.device_put(
-                        jnp.asarray(batch, dtype=self.dtype))
+                    batch = self._to_device(batch)
                 while not self._stop.is_set():
                     try:
                         self._queue.put(batch, timeout=0.1)
@@ -261,14 +263,20 @@ class Dataloader:
             self._thread.start()
         return self
 
+    def _to_device(self, batch):
+        import jax
+        import jax.numpy as jnp
+        batch = jnp.asarray(batch, dtype=self.dtype)
+        if self.sharding is not None:
+            return jax.device_put(batch, self.sharding)
+        return jax.device_put(batch)
+
     def next_batch(self):
         self.start()
         if self._engine is not None:
             batch = self._engine.next_batch()
             if self.device_prefetch:
-                import jax
-                import jax.numpy as jnp
-                batch = jax.device_put(jnp.asarray(batch, dtype=self.dtype))
+                batch = self._to_device(batch)
             return batch
         return self._queue.get()
 

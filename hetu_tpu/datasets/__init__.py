@@ -15,6 +15,7 @@ from .pretraining import (create_pretraining_arrays,
 from .criteo import (read_criteo_tsv, process_criteo, read_avazu_csv,
                      process_avazu, process_dense_feats,
                      encode_sparse_feats, make_sample_shard)
+from .prefetch import DevicePrefetcher, prefetch_feeds
 
 __all__ = [
     "GlueExample", "GlueFeatures", "GLUE_PROCESSORS", "MrpcProcessor",
@@ -23,5 +24,5 @@ __all__ = [
     "documents_from_text_file", "mask_tokens",
     "read_criteo_tsv", "process_criteo", "read_avazu_csv",
     "process_avazu", "process_dense_feats", "encode_sparse_feats",
-    "make_sample_shard",
+    "make_sample_shard", "DevicePrefetcher", "prefetch_feeds",
 ]

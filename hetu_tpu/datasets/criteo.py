@@ -167,10 +167,18 @@ def process_criteo(path, nrows=None, return_val=True, seed=0,
                                                 "num_features.npy")))
         if return_val:
             return ((a[0], a[3]), (a[1], a[4]), (a[2], a[5])), num_features
+        # the cache stores the SHUFFLED 90/10 split (train ++ test ==
+        # raw[perm]); invert the split permutation so a cache-served
+        # return_val=False read yields raw-file row order, identical to
+        # a fresh parse (ADVICE r5: row order must not depend on
+        # whether a prior return_val=True run populated the cache)
         dense = np.concatenate([a[0], a[3]])
         sparse = np.concatenate([a[1], a[4]])
         labels = np.concatenate([a[2], a[5]])
-        return (dense, sparse, labels), num_features
+        perm = np.random.default_rng(seed).permutation(len(labels))
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(len(perm))
+        return (dense[inv], sparse[inv], labels[inv]), num_features
 
     labels, dense_raw, sparse_raw = read_criteo_tsv(path, nrows)
     dense = process_dense_feats(dense_raw)

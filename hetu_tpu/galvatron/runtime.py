@@ -183,7 +183,7 @@ class TransformerHPLayer:
         dp = int(np.prod([mesh.shape[a] for a in sh.dp_axes] or [1]))
         if (t >= 128 and hd <= 512 and nh % tp == 0 and b % dp == 0):
             from ..ops.pallas.flash_attention import flash_attention
-            from jax import shard_map
+            from ..platform import shard_map
             spec = P(sh._axes(sh.dp_axes) if sh.dp_axes else None,
                      sh._axes(sh.tp_axes) if sh.tp_axes else None,
                      None, None)

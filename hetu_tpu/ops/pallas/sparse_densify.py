@@ -176,8 +176,13 @@ def packed_lookup(table, ids, dim, use_pallas=True):
     ``pack_write`` — no XLA scatter anywhere."""
     q = 128 // dim
     flat = ids.reshape(-1).astype(jnp.int32)
-    lines = jnp.take(table, flat // q, axis=0)                 # [M, 128]
-    onehot = jax.nn.one_hot(flat % q, q, dtype=table.dtype)    # [M, q]
+    # negative (padding) ids clamp to logical row 0, matching the
+    # unpacked embedding_lookup/IndexedSlices path — without the clamp,
+    # flat // q clips to line 0 but flat % q picks slot q-1, gathering
+    # an arbitrary row (ADVICE r5).  The vjp drops negatives either way.
+    safe = jnp.maximum(flat, 0)
+    lines = jnp.take(table, safe // q, axis=0)                 # [M, 128]
+    onehot = jax.nn.one_hot(safe % q, q, dtype=table.dtype)    # [M, q]
     rows = jnp.sum(lines.reshape(-1, q, dim) * onehot[:, :, None],
                    axis=1)
     return rows.reshape(ids.shape + (dim,))

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..platform import shard_map
 
 
 # -- primitive wrappers (valid inside shard_map/pmapped code) --------------

@@ -23,7 +23,9 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..platform import shard_map
 from jax.sharding import PartitionSpec as P
 
 

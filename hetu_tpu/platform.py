@@ -3,6 +3,34 @@
 from __future__ import annotations
 
 
+def _compat_shard_map():
+    """`jax.shard_map` across jax versions: the top-level export (and its
+    ``check_vma`` kwarg) arrived in 0.6; older jax ships the same
+    function as ``jax.experimental.shard_map.shard_map`` with the kwarg
+    named ``check_rep``.  Import ``shard_map`` from here, not jax."""
+    try:
+        from jax import shard_map as sm
+        return sm
+    except ImportError:
+        import functools
+        from jax.experimental.shard_map import shard_map as sm
+
+        @functools.wraps(sm)
+        def wrapper(f, *args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            # the old replication checker false-positives on scan
+            # carries ("mismatched replication types ... pass
+            # check_rep=False" is jax's own suggested workaround), so
+            # default it off; callers can still opt back in
+            kwargs.setdefault("check_rep", False)
+            return sm(f, *args, **kwargs)
+        return wrapper
+
+
+shard_map = _compat_shard_map()
+
+
 
 def force_platform_from_env():
     """Honor JAX_PLATFORMS through jax.config BEFORE any device use.

@@ -177,7 +177,7 @@ class CommProfiler:
     def bench_collective(self, kind="psum", nbytes=1 << 20, axis=None,
                          repeats=5):
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from .platform import shard_map
         import jax.numpy as jnp
         mesh = self.mesh
         if mesh is None:

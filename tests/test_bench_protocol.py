@@ -1,12 +1,15 @@
-"""The driver-bench output contract (VERDICT r4 item 1).
+"""The driver-bench output contract (VERDICT r4 item 1 + ADVICE r5).
 
 Round 4's bench printed its single JSON line only after ALL stages
 finished; the driver's timeout fired first and `BENCH_r04.json` captured
-nothing (rc=124, empty tail).  These tests pin the restructured
-contract: bench.py emits a COMPLETE, parseable headline line after every
-stage, honors a global wall-clock budget, and therefore any prefix of a
-run — however the driver kills it — ends in a line that parses with all
-eight stages present (values or explicit FAILED/SKIPPED markers).
+nothing (rc=124, empty tail).  Round 5 emitted after every stage — but
+the full 8-stage headline line outgrew the driver's ~2000-byte stdout
+tail and `BENCH_r05.json` parsed null.  These tests pin the layered
+contract: after every stage bench.py prints the FULL headline (also
+written to BENCH_FULL.json) followed by a COMPACT per-stage summary as
+the final line, sized to always fit the capture window — so any prefix
+of a run, however the driver kills it, ends in parseable evidence for
+all eight stages (values or explicit FAILED/SKIPPED markers).
 """
 
 import json
@@ -23,37 +26,48 @@ ALL_STAGES = {"bert", "gpt", "gpt_e2e", "llama", "resnet", "moe", "wdl",
               "wdl_ps"}
 
 
-def _cpu_env(budget):
+def _cpu_env(budget, tmp_path=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["HETU_BENCH_BUDGET_S"] = str(budget)
+    if tmp_path is not None:
+        env["HETU_BENCH_JSON"] = str(tmp_path / "full.json")
     return env
 
 
-def _parse_headline(stdout):
+def _parse_tail(stdout):
+    """Final line: compact summary covering all 8 stages, under the
+    driver's capture window.  Second-to-last: the full headline."""
     lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
     assert lines, "bench printed nothing"
-    headline = json.loads(lines[-1])
-    # the headline line must carry the bert slot plus 7 extra_metrics
-    assert "metric" in headline and "vs_baseline" in headline
-    extras = headline["extra_metrics"]
-    assert len(extras) == 7
-    for e in extras:
+    compact = json.loads(lines[-1])
+    assert "metric" in compact and "vs_baseline" in compact
+    assert set(compact["stages"]) == ALL_STAGES
+    assert len(lines[-1].encode()) < 2000, \
+        "compact line must fit the driver's stdout tail"
+    full = json.loads(lines[-2])
+    assert len(full["extra_metrics"]) == 7
+    for e in full["extra_metrics"]:
         assert "metric" in e and "unit" in e
-    return headline, lines
+    return compact, full, lines
 
 
-def test_zero_budget_run_emits_complete_parseable_tail():
+def test_zero_budget_run_emits_complete_parseable_tail(tmp_path):
     """With an exhausted budget every stage is SKIPPED_BUDGET — and the
     tail still parses with all eight stages explicitly marked."""
     proc = subprocess.run([sys.executable, BENCH], capture_output=True,
-                          text=True, timeout=120, env=_cpu_env(0))
+                          text=True, timeout=120,
+                          env=_cpu_env(0, tmp_path))
     assert proc.returncode == 0, proc.stderr[-2000:]
-    headline, lines = _parse_headline(proc.stdout)
-    assert headline["unit"] == "SKIPPED_BUDGET"
-    units = {e["unit"] for e in headline["extra_metrics"]}
+    compact, full, lines = _parse_tail(proc.stdout)
+    assert compact["unit"] == "SKIPPED_BUDGET"
+    units = {e["unit"] for e in compact["stages"].values()}
     assert units == {"SKIPPED_BUDGET"}
-    assert set(headline["budget"]["skipped_stages"]) == ALL_STAGES
+    assert set(compact["budget"]["skipped_stages"]) == ALL_STAGES
+    # the full detail JSON landed on disk for humans / the next session
+    with open(tmp_path / "full.json") as f:
+        detail = json.load(f)
+    assert len(detail["extra_metrics"]) == 7
     # a parseable line existed from second 0 (pending placeholders)
     first = json.loads(lines[0])
     assert first["unit"] == "PENDING"
@@ -62,7 +76,7 @@ def test_zero_budget_run_emits_complete_parseable_tail():
 def test_killed_mid_run_tail_still_parses():
     """Kill the bench the moment its first line appears (simulating the
     driver's timeout): whatever stdout exists must already end in a
-    complete parseable headline."""
+    complete parseable line covering every stage."""
     proc = subprocess.Popen([sys.executable, BENCH],
                             stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True,
@@ -76,26 +90,35 @@ def test_killed_mid_run_tail_still_parses():
     finally:
         if proc.poll() is None:
             proc.kill()
-    headline, _ = _parse_headline(out)
     # nothing has run yet at line 1: every slot is a PENDING placeholder,
-    # which is exactly the "explicit marker" contract
-    assert headline["unit"] == "PENDING"
+    # which is exactly the "explicit marker" contract.  The kill may land
+    # between the full and compact prints, so accept either as the tail.
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    tail = json.loads(lines[-1])
+    if "stages" in tail:
+        assert set(tail["stages"]) == ALL_STAGES
+        units = {e["unit"] for e in tail["stages"].values()}
+    else:
+        assert len(tail["extra_metrics"]) == 7
+        units = {tail["unit"]} | {e["unit"]
+                                  for e in tail["extra_metrics"]}
+    assert units == {"PENDING"}
 
 
 @pytest.mark.slow
-def test_one_stage_budget_preserves_finished_stage():
+def test_one_stage_budget_preserves_finished_stage(tmp_path):
     """A budget that admits roughly one stage: the tail must carry that
     stage's measured value AND explicit SKIPPED_BUDGET markers for the
     rest (this is the r04-failure regression test: partial progress
     survives)."""
     proc = subprocess.run([sys.executable, BENCH, "--quick"],
                           capture_output=True, text=True, timeout=600,
-                          env=_cpu_env(95))
+                          env=_cpu_env(95, tmp_path))
     assert proc.returncode == 0, proc.stderr[-2000:]
-    headline, _ = _parse_headline(proc.stdout)
-    all_units = [headline["unit"]] + [e["unit"]
-                                     for e in headline["extra_metrics"]]
+    compact, full, _ = _parse_tail(proc.stdout)
+    all_units = [compact["unit"]] + [e["unit"]
+                                     for e in compact["stages"].values()]
     assert "SKIPPED_BUDGET" in all_units
     # at least the headline stage (bert, first in run order) completed
     # or explicitly failed — it may not be PENDING in the final line
-    assert headline["unit"] != "PENDING"
+    assert compact["unit"] != "PENDING"

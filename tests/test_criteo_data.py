@@ -95,6 +95,22 @@ def test_criteo_cache_is_keyed_on_request(tmp_path):
     assert nf3 == nf_full
 
 
+def test_criteo_cache_preserves_raw_order_without_split(tmp_path):
+    """ADVICE r5: a return_val=False read must yield raw-file row order
+    whether or not a prior return_val=True run populated the cache (the
+    cached arrays store the shuffled split; the read path inverts the
+    permutation)."""
+    (fresh_d, fresh_s, fresh_l), nf = process_criteo(
+        SAMPLE, return_val=False)
+    process_criteo(SAMPLE, cache_dir=str(tmp_path))   # warm the cache
+    (cd, cs, cl), nf2 = process_criteo(SAMPLE, return_val=False,
+                                       cache_dir=str(tmp_path))
+    assert nf == nf2
+    np.testing.assert_array_equal(fresh_d, cd)
+    np.testing.assert_array_equal(fresh_s, cs)
+    np.testing.assert_array_equal(fresh_l, cl)
+
+
 def test_gzip_transparency(tmp_path):
     gz = tmp_path / "shard.txt.gz"
     with open(SAMPLE, "rb") as src, gzip.open(gz, "wb") as dst:

@@ -77,6 +77,29 @@ def test_packed_lookup_vjp_matches_take_vjp(rng):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_packed_lookup_negative_ids_clamp_like_indexed_slices(rng):
+    """Padding ids (-1) follow the IndexedSlices convention: forward
+    clamps to row 0, backward drops them (ADVICE r5 — unclamped, the
+    forward gathered slot q-1 of line 0, an arbitrary row)."""
+    rows, dim = 64, 16
+    w = rng.standard_normal((rows, dim)).astype(np.float32)
+    tbl = pack_table(w)
+    ids = np.array([3, -1, 7, -5, 0], np.int32)
+    out = np.asarray(packed_lookup(tbl, jnp.asarray(ids), dim))
+    ref = w[np.maximum(ids, 0)]
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    # backward: negative ids contribute NO gradient anywhere
+    ct = rng.standard_normal((len(ids), dim)).astype(np.float32)
+    g = jax.grad(lambda t: jnp.sum(
+        packed_lookup(t, jnp.asarray(ids), dim) * jnp.asarray(ct)))(tbl)
+    gu = np.asarray(unpack_table(g, rows, dim))
+    ref_g = np.zeros_like(w)
+    for i, r in zip(ids, ct):
+        if i >= 0:
+            ref_g[i] += r
+    np.testing.assert_allclose(gu, ref_g, rtol=1e-6, atol=1e-7)
+
+
 def test_pack_write_fallback_semantics(rng):
     p_rows = 40
     ids = np.array([3, 3, 7, -1, 0], np.int32)      # dup + invalid
