@@ -782,11 +782,17 @@ def _emit(results, cpu_fallback=False, budget_note=None):
     if budget_note:
         headline["budget"] = budget_note
     full = json.dumps(headline)
-    try:
-        with open(DETAIL_PATH, "w") as f:
-            f.write(full + "\n")
-    except OSError:
-        pass
+    # Never clobber BENCH_FULL.json with the all-PENDING placeholder: the
+    # second-0 emit (and an aborted run that never finishes a stage) must
+    # not destroy the previous round's committed evidence.  The detail
+    # file is written only once at least one stage has reported; until
+    # then the parseable line lives on stdout alone.
+    if results:
+        try:
+            with open(DETAIL_PATH, "w") as f:
+                f.write(full + "\n")
+        except OSError:
+            pass
     print(full, flush=True)
     compact = {"metric": headline.get("metric"),
                "value": headline.get("value"),

@@ -105,6 +105,30 @@ def test_killed_mid_run_tail_still_parses():
     assert units == {"PENDING"}
 
 
+def test_aborted_run_preserves_prior_detail_file(tmp_path):
+    """A run killed before any stage reports must NOT overwrite the
+    detail JSON with the all-PENDING placeholder: that file is the
+    previous round's committed evidence (REVIEW r6), and only an emit
+    with at least one real stage result may replace it."""
+    detail = tmp_path / "full.json"
+    sentinel = {"metric": "bert", "value": 2.66, "unit": "samples/sec"}
+    detail.write_text(json.dumps(sentinel))
+    proc = subprocess.Popen([sys.executable, BENCH],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            env=_cpu_env(3600, tmp_path),
+                            start_new_session=True)
+    try:
+        first = proc.stdout.readline()
+        os.killpg(os.getpgid(proc.pid), 9)
+        proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert json.loads(first)["unit"] == "PENDING"
+    assert json.loads(detail.read_text()) == sentinel
+
+
 @pytest.mark.slow
 def test_one_stage_budget_preserves_finished_stage(tmp_path):
     """A budget that admits roughly one stage: the tail must carry that
