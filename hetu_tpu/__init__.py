@@ -22,6 +22,9 @@ from .optim import (SGDOptimizer, MomentumOptimizer, AdaGradOptimizer,
                     LambOptimizer)
 from .optim import lr_scheduler
 from . import ps
+from . import resilience
+from .resilience import (CheckpointError, GuardTripped,
+                         RollingCheckpointManager, StepGuard, retry)
 from . import metrics
 from .dataloader import Dataloader, DataloaderOp, dataloader_op
 from .datasets.prefetch import DevicePrefetcher, prefetch_feeds

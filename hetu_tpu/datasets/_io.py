@@ -1,8 +1,12 @@
-"""Shared text-ingestion helpers for the dataset loaders."""
+"""Shared ingestion helpers for the dataset loaders: transparent-gzip
+text open and a resilient, atomic ``fetch``."""
 
 from __future__ import annotations
 
 import gzip
+import os
+import shutil
+import urllib.request
 
 
 def open_text(path, errors="strict"):
@@ -10,3 +14,49 @@ def open_text(path, errors="strict"):
     if str(path).endswith(".gz"):
         return gzip.open(path, "rt", encoding="utf-8", errors=errors)
     return open(path, encoding="utf-8", errors=errors)
+
+
+def fetch(url, dest, *, attempts=4, backoff=0.5, timeout=30.0,
+          expected_bytes=None, overwrite=False):
+    """Download ``url`` to ``dest`` atomically with retry/backoff.
+
+    Transient I/O errors (reset connections, timeouts, 5xx) back off
+    through ``resilience.retry`` instead of failing the run; the bytes
+    land in a same-directory ``.part`` file and only an intact transfer
+    is ``os.replace``d into place, so a torn download never masquerades
+    as the dataset.  ``expected_bytes`` (when the mirror publishes it)
+    turns a truncated transfer into a retryable error.  An existing
+    ``dest`` short-circuits unless ``overwrite``.  Returns ``dest``.
+    """
+    from ..resilience.retry import retry
+
+    dest = str(dest)
+    if not overwrite and os.path.exists(dest):
+        return dest
+    parent = os.path.dirname(os.path.abspath(dest))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{dest}.part.{os.getpid()}"
+
+    def _once():
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            size = os.path.getsize(tmp)
+            if expected_bytes is not None and size != int(expected_bytes):
+                raise OSError(
+                    f"{url}: got {size} bytes, expected {expected_bytes} "
+                    "— truncated transfer")
+            os.replace(tmp, dest)
+            return dest
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass    # partial already gone; nothing to clean
+
+    # URLError/HTTPError/TimeoutError are all OSError subclasses
+    return retry(_once, attempts=attempts, backoff=backoff, factor=2.0,
+                 max_backoff=30.0, jitter=0.25,
+                 retry_on=(OSError, ConnectionError))

@@ -45,6 +45,16 @@ def _open_text(path):
     return open_text(path, errors="replace")
 
 
+def fetch_criteo(dest, url, **kw):
+    """Download a raw Criteo/Avazu shard to ``dest`` through the
+    resilient fetch path (atomic ``.part`` + ``os.replace`` write,
+    retry/backoff via ``resilience.retry``).  Zero-egress by default:
+    the caller supplies the mirror URL; an existing ``dest`` is reused.
+    Point the loaders above at the returned path."""
+    from ._io import fetch
+    return fetch(url, dest, **kw)
+
+
 def _read_blocks(f, sep, ncols, nrows, block):
     """Yield [k, ncols] fixed-width numpy string arrays from a line
     iterator, ``block`` lines at a time.

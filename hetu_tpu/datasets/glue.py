@@ -61,6 +61,22 @@ class GlueFeatures:
                    "label_ids": self.label_ids[sl]}
 
 
+def fetch_glue_task(data_dir, task, base_url, files=None, **kw):
+    """Download one GLUE task's TSVs into ``data_dir`` through the
+    resilient fetch path (atomic write + retry/backoff via
+    ``resilience.retry``), using the task processor's declared file
+    names so the layout matches ``train_examples``/``dev_examples``.
+    The caller supplies the mirror ``base_url`` (zero-egress default);
+    existing files are reused.  Returns the downloaded paths."""
+    from ._io import fetch
+    proc = GLUE_PROCESSORS[task.lower()]()
+    names = tuple(files) if files else (proc.train_file, proc.dev_file)
+    os.makedirs(data_dir, exist_ok=True)
+    return [fetch(f"{base_url.rstrip('/')}/{name}",
+                  os.path.join(data_dir, name), **kw)
+            for name in names]
+
+
 def _read_tsv(path, quotechar=None):
     with open(path, "r", encoding="utf-8") as f:
         return list(csv.reader(f, delimiter="\t", quotechar=quotechar))

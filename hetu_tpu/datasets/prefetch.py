@@ -143,7 +143,27 @@ class DevicePrefetcher:
                 self._exhausted = True
                 raise
         self.start()
-        kind, val = self._queue.get()
+        # bounded wait + liveness check: a producer that died WITHOUT
+        # enqueuing a sentinel (killed worker, OOM, SystemExit escaping
+        # the except Exception) must surface here within one step, not
+        # hang the training loop forever on queue.get()
+        while True:
+            try:
+                kind, val = self._queue.get(timeout=0.2)
+                break
+            except queue.Empty:
+                t = self._thread
+                if t is not None and t.is_alive():
+                    continue
+                try:    # it may have enqueued between timeout and check
+                    kind, val = self._queue.get_nowait()
+                    break
+                except queue.Empty:
+                    self._exhausted = True
+                    raise RuntimeError(
+                        "prefetch producer thread died without a result "
+                        "or error sentinel (killed worker?) — restart "
+                        "the prefetcher to resume") from None
         if kind == "stop":
             self._exhausted = True
             raise StopIteration

@@ -10,8 +10,105 @@ checkpoints must work (a 100B-param state never materializes on one host).
 
 from __future__ import annotations
 
+import os
+import pickle
+
 import jax
 import jax.numpy as jnp
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file or payload is torn, corrupt, or structurally
+    invalid.  Raised instead of the opaque ``KeyError``/unpickle crash a
+    garbage or stale file used to produce, so callers (and the rolling
+    checkpoint manager's fallback scan) can tell "bad file" from "bug"."""
+
+
+# the single-file checkpoint contract (Executor.state_dict); "format" /
+# "opt_meta" are optional so pre-tag checkpoints keep loading
+REQUIRED_STATE_KEYS = frozenset(
+    {"params", "opt_state", "global_step", "base_key"})
+SUPPORTED_FORMAT_VERSIONS = (1,)
+
+
+def validate_state(state, source="checkpoint"):
+    """Check a checkpoint payload against the state_dict contract.
+
+    Raises :class:`CheckpointError` naming exactly what is wrong
+    (non-dict payload, missing required keys, format version from a
+    newer writer) instead of letting ``load_state_dict`` die on an
+    arbitrary ``KeyError`` deep inside the restore."""
+    if not isinstance(state, dict):
+        raise CheckpointError(
+            f"{source}: payload is {type(state).__name__}, expected the "
+            "dict produced by Executor.state_dict()")
+    missing = sorted(REQUIRED_STATE_KEYS - set(state))
+    if missing:
+        raise CheckpointError(
+            f"{source}: missing required keys {missing} — not an "
+            "Executor checkpoint (or a torn/stale file)")
+    if not isinstance(state["params"], dict):
+        raise CheckpointError(
+            f"{source}: 'params' is {type(state['params']).__name__}, "
+            "expected a name->array dict")
+    fmt = state.get("format")
+    if fmt is not None:
+        if not isinstance(fmt, dict):
+            raise CheckpointError(
+                f"{source}: 'format' is {type(fmt).__name__}, expected a "
+                "dict tag")
+        version = fmt.get("version")
+        if version is not None and version not in SUPPORTED_FORMAT_VERSIONS:
+            raise CheckpointError(
+                f"{source}: format version {version} is newer than this "
+                f"build supports ({SUPPORTED_FORMAT_VERSIONS}); upgrade "
+                "hetu_tpu or re-save the checkpoint from the old version")
+    return state
+
+
+def atomic_write_bytes(blob, path):
+    """Write ``blob`` to ``path`` via a same-directory temp file +
+    ``os.replace``: a kill mid-write leaves the previous file intact and
+    never a half-written one under the final name."""
+    path = str(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return path
+
+
+def atomic_pickle(state, path):
+    """Pickle ``state`` to ``path`` torn-proof (tmp + ``os.replace``)."""
+    return atomic_write_bytes(
+        pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL), path)
+
+
+def read_checkpoint(path):
+    """Read + unpickle + validate a single-file checkpoint.
+
+    Garbage, truncated, or non-checkpoint pickles surface as
+    :class:`CheckpointError` with the path named; a missing file stays a
+    ``FileNotFoundError`` (a different operator mistake)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    try:
+        state = pickle.loads(blob)
+    except Exception as e:  # pickle raises a zoo of types on garbage
+        raise CheckpointError(
+            f"{path}: not a readable checkpoint "
+            f"({type(e).__name__}: {e}) — torn write or corrupt file?"
+        ) from e
+    return validate_state(state, source=str(path))
 
 
 def _state_tree(executor):

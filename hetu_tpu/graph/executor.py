@@ -20,7 +20,6 @@ materialized by hand in its graph-rewrite pass (context.py:1469).
 
 from __future__ import annotations
 
-import pickle
 import time
 import zlib
 
@@ -162,6 +161,12 @@ class SubExecutor:
         training = self.training
         mesh = self.executor.mesh
         compute_dtype = self.executor.compute_dtype
+        # resilience.StepGuard: traced INTO the step when attached, so
+        # the sentinel reductions fuse with the updates they check
+        guard = self.executor.config.get("step_guard")
+        guard_losses = ([op.loss for op in self.opt_ops
+                         if getattr(op, "loss", None) is not None]
+                        if guard is not None else [])
 
         def cast(x):
             if compute_dtype is not None and jnp.issubdtype(
@@ -202,6 +207,44 @@ class SubExecutor:
                 new_params[var.name] = val.astype(params[var.name].dtype)
             new_opt_state = dict(opt_state)
             new_opt_state.update(ctx.new_opt_state)
+            if guard is not None:
+                # fused guard sentinel: one scalar conjunction over loss
+                # finiteness and every parameter update written this step
+                # (optimizer slots are poisoned iff the param is, so
+                # checking params covers both at half the reads).  The
+                # loss sum doubles as the host-side spike signal.
+                gloss = jnp.float32(0)
+                seen = False
+                for lnode in guard_losses:
+                    if lnode in env:
+                        gloss = gloss + jnp.sum(env[lnode]).astype(
+                            jnp.float32)
+                        seen = True
+                if not seen:
+                    # eval-only subgraph: guard its floating outputs
+                    for v in vals:
+                        if v is not None and jnp.issubdtype(
+                                jnp.result_type(v), jnp.floating):
+                            gloss = gloss + jnp.sum(v).astype(jnp.float32)
+                gfin = jnp.isfinite(gloss)
+                for var, val in ctx.updates.items():
+                    if jnp.issubdtype(jnp.result_type(
+                            new_params[var.name]), jnp.floating):
+                        gfin = jnp.logical_and(
+                            gfin, jnp.all(jnp.isfinite(
+                                new_params[var.name])))
+                if guard.policy == "skip":
+                    # discard the poisoned update IN-GRAPH: params and
+                    # opt-state roll forward only on a clean sentinel, so
+                    # a NaN step can never corrupt persistent state
+                    for var in ctx.updates:
+                        new_params[var.name] = jnp.where(
+                            gfin, new_params[var.name], params[var.name])
+                    for k in ctx.new_opt_state:
+                        new_opt_state[k] = jax.tree_util.tree_map(
+                            lambda nv, ov: jnp.where(gfin, nv, ov),
+                            new_opt_state[k], opt_state[k])
+                vals = list(vals) + [gfin, gloss]
             return vals, new_params, new_opt_state, step + 1
 
         self._step_fn = step_fn   # run_steps builds its scan over this
@@ -375,6 +418,11 @@ class SubExecutor:
             ex.params, ex.opt_state, feeds, ex._base_key, ex._step_arr)
         ex.params = new_params
         ex.opt_state = new_opt_state
+        # guard sentinel scalars ride as the two trailing hidden outputs
+        guard = ex.config.get("step_guard")
+        guard_out = None
+        if guard is not None:
+            guard_out, vals = vals[-2:], vals[:-2]
         # poll monitor counters after this SUBGRAPH's first step and
         # every interval of ITS runs (a global-step schedule can
         # permanently miss a subgraph under alternating train/validate);
@@ -412,6 +460,10 @@ class SubExecutor:
                 f.result()
                 self._ps_pending.remove(f)
             vals = vals[:n_user]
+        if guard_out is not None:
+            # after PS pushes so a rollback can't orphan in-flight grads;
+            # may restore executor state or raise GuardTripped (abort)
+            guard.on_step(ex, guard_out[0], guard_out[1])
         if convert_to_numpy_ret_vals:
             vals = [None if v is None else np.asarray(v) for v in vals]
         return vals
@@ -497,6 +549,13 @@ class SubExecutor:
         vals, ex.params, ex.opt_state, ex._step_arr = self._multi_jitted(
             ex.params, ex.opt_state, feeds, ex._base_key, ex._step_arr,
             jnp.int32(n))
+        guard = ex.config.get("step_guard")
+        if guard is not None:
+            # the returned sentinel covers the FINAL inner step; the
+            # 'skip' policy's in-graph select still protects every inner
+            # step, and rollback/abort detect at the call boundary
+            guard_out, vals = vals[-2:], vals[:-2]
+            guard.on_step(ex, guard_out[0], guard_out[1], n=n)
         self._runs += n
         if self._monitor_vars:
             self.check_monitors()
@@ -672,6 +731,10 @@ class Executor:
         else:
             self.subexecutor = {name: SubExecutor(name, nodes, self)
                                 for name, nodes in self.eval_node_dict.items()}
+        # resilience.StepGuard passed as Executor(..., step_guard=guard):
+        # bind it so policy actions (rollback/abort) can reach this state
+        if self.config.get("step_guard") is not None:
+            self.config["step_guard"]._bind(self)
 
     # -- sharding hooks (filled in by parallel layer) ----------------------
     def _place(self, var, value):
@@ -801,15 +864,20 @@ class Executor:
                 "base_key": np.asarray(jax.random.key_data(self._base_key))}
 
     def save(self, path):
-        with open(path, "wb") as f:
-            pickle.dump(self.state_dict(), f)
+        # atomic: tmp in the same directory + os.replace, so a kill
+        # mid-save (preemption!) never destroys the previous checkpoint
+        from .checkpoint import atomic_pickle
+        atomic_pickle(self.state_dict(), path)
 
     def load(self, path):
-        with open(path, "rb") as f:
-            state = pickle.load(f)
-        self.load_state_dict(state)
+        # read_checkpoint turns garbage/truncated/stale files into a
+        # CheckpointError naming the path, not an opaque unpickle crash
+        from .checkpoint import read_checkpoint
+        self.load_state_dict(read_checkpoint(path))
 
     def load_state_dict(self, state):
+        from .checkpoint import validate_state
+        validate_state(state, source="state_dict payload")
         fmt = state.get("format")
         layout = (fmt or {}).get("conv_layout")
         if layout not in (None, "HWIO"):
@@ -827,6 +895,22 @@ class Executor:
                 "is one, convert with Conv2d.load_oihw (MIGRATION.md)",
                 stacklevel=2)
         var_by_name = {v.name: v for v in self.variables}
+        extra = sorted(set(state["params"]) - set(var_by_name))
+        absent = sorted(set(var_by_name) - set(state["params"]))
+        if extra or absent:
+            # loading only the intersection is legitimate (fine-tuning a
+            # new head) but must never be SILENT: a "restored" run that
+            # actually re-initialized half its params diverges quietly.
+            # Classic cause: rebuilding the same model outside
+            # ht.name_scope(), which suffixes every name with _1.
+            import warnings
+            warnings.warn(
+                f"partial restore: {len(absent)} graph param(s) not in "
+                f"the checkpoint (keep their init: {absent[:4]}...), "
+                f"{len(extra)} checkpoint param(s) unused "
+                f"({extra[:4]}...) — if a full restore was intended, "
+                "check that the model was rebuilt under the same "
+                "ht.name_scope()", stacklevel=2)
         for name, value in state["params"].items():
             if name in var_by_name:
                 v = var_by_name[name]

@@ -432,9 +432,12 @@ class RemoteTable:
 
     def _call(self, header, *arrays, conn=None):
         """Send with (cid, seq), await the matching reply; on socket
-        failure reconnect with backoff and RETRANSMIT (the server's dedup
-        cache absorbs double-applied mutations) until the deadline.
-        ``conn`` bypasses the pool (the heartbeat's dedicated channel)."""
+        failure reconnect and RETRANSMIT (the server's dedup cache
+        absorbs double-applied mutations) until the deadline — the
+        backoff loop is ``resilience.retry``, the one policy every
+        transient-failure path shares.  ``conn`` bypasses the pool (the
+        heartbeat's dedicated channel)."""
+        from ..resilience.retry import retry
         header = dict(header, cid=self._cid, seq=self._next_seq())
         if self._table:
             header.setdefault("table", self._table)
@@ -444,34 +447,34 @@ class RemoteTable:
                 header.get("verb") in self._PRIORITY_VERBS)
         else:
             conn.lock.acquire()
+
+        def _attempt():
+            try:
+                if conn.sock is None:
+                    conn.sock = self._connect()
+                send_msg(conn.sock, header, *arrays)
+                return recv_msg(conn.sock)
+            except (ConnectionError, socket.timeout, OSError):
+                if conn.sock is not None:
+                    try:
+                        conn.sock.close()
+                    except OSError:
+                        pass    # already torn down; reconnect handles it
+                    conn.sock = None
+                raise
+
         try:
-            deadline = time.monotonic() + self._deadline
-            backoff = 0.05
-            last_err = None
-            while time.monotonic() < deadline:
-                try:
-                    if conn.sock is None:
-                        conn.sock = self._connect()
-                    send_msg(conn.sock, header, *arrays)
-                    reply, payloads = recv_msg(conn.sock)
-                    break
-                except (ConnectionError, socket.timeout, OSError) as e:
-                    last_err = e
-                    if conn.sock is not None:
-                        try:
-                            conn.sock.close()
-                        except OSError:
-                            pass
-                        conn.sock = None
-                    if self._closed:
-                        raise
-                    time.sleep(min(backoff, max(
-                        0.0, deadline - time.monotonic())))
-                    backoff = min(backoff * 2, 2.0)
-            else:
-                raise ConnectionError(
-                    f"PS {self._addr} unreachable for {self._deadline}s "
-                    f"(last error: {last_err})")
+            reply, payloads = retry(
+                _attempt, deadline=self._deadline, backoff=0.05,
+                factor=2.0, max_backoff=2.0,
+                retry_on=(ConnectionError, socket.timeout, OSError),
+                giveup=lambda e: self._closed)
+        except (ConnectionError, socket.timeout, OSError) as e:
+            if self._closed:
+                raise
+            raise ConnectionError(
+                f"PS {self._addr} unreachable for {self._deadline}s "
+                f"(last error: {e})") from e
         finally:
             if pooled:
                 self._release(conn, prio)

@@ -1,0 +1,197 @@
+"""Deterministic, seed-driven fault injection for tests and the chaos
+bench.
+
+Every injector here is a thin, composable wrapper that makes ONE
+specific failure happen at a KNOWN place, reproducibly:
+
+* batch corruption   — ``nan_stream`` / ``corrupt_batch`` poison float
+  leaves of the k-th batch (what the StepGuard's fused sentinel must
+  catch);
+* iterator failure   — ``raising_stream`` raises ``InjectedFault`` from
+  the dataloader iterator (the prefetcher's err channel must carry it
+  to the consumer);
+* producer death     — ``killer_stream`` raises ``PrefetcherKilled``
+  (``SystemExit``) INSIDE the prefetch producer thread: it escapes the
+  producer's ``except Exception`` and threading swallows it silently,
+  so the thread dies with no sentinel on the queue — the honest
+  simulation of a segfaulted/OOM-killed worker, which the consumer's
+  liveness check must surface within one step;
+* PS RPC faults      — ``delay_rpc`` stalls calls, ``drop_rpc`` closes
+  the client's pooled sockets mid-conversation so the transport's
+  reconnect+retransmit (and the server's dedup cache) must absorb it;
+* torn files         — ``tear_file`` truncates a checkpoint the way a
+  killed writer would have (only possible pre-atomic-write; the
+  restore path must skip it);
+* preemption         — ``simulate_preemption`` raises SIGTERM in the
+  current process, exercising the checkpoint manager's flush hook.
+
+``FaultInjector`` adds seed-driven *placement*: the same seed always
+injects at the same steps, so a chaos run is replayable.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """An error deliberately raised by a fault injector."""
+
+
+# Kills a prefetch producer thread SILENTLY when raised from the wrapped
+# source iterator: SystemExit escapes the producer's `except Exception`
+# and threading discards it with no traceback, so no error sentinel is
+# enqueued — the consumer sees only a dead thread, like after a real
+# worker crash.  Must be EXACTLY SystemExit (an alias, not a subclass):
+# threading.excepthook silences only the exact class.
+PrefetcherKilled = SystemExit
+
+
+# -- batch corruption ------------------------------------------------------
+
+def corrupt_batch(batch, keys=None, value=np.nan):
+    """Return a copy of ``batch`` (dict / tuple / array) with float
+    leaves poisoned by ``value`` in element 0.  Integer leaves (ids)
+    are left alone — NaN has no integer encoding, and real corruption
+    enters through the float path (labels, dense features, activations).
+    ``keys`` restricts which dict leaves are hit."""
+    def _poison(arr):
+        arr = np.array(arr, copy=True)
+        if np.issubdtype(arr.dtype, np.floating) and arr.size:
+            arr.reshape(-1)[0] = value
+        return arr
+
+    if isinstance(batch, dict):
+        return {k: (_poison(v) if keys is None or k in keys
+                    or getattr(k, "name", None) in (keys or ()) else v)
+                for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_poison(v) for v in batch)
+    return _poison(batch)
+
+
+def nan_stream(iterator, at, keys=None, value=np.nan):
+    """Yield ``iterator``'s batches, poisoning the ones at 0-based
+    indices in ``at`` (an int or a collection of ints)."""
+    steps = {int(at)} if np.isscalar(at) else {int(a) for a in at}
+    for i, batch in enumerate(iterator):
+        yield corrupt_batch(batch, keys, value) if i in steps else batch
+
+
+def raising_stream(iterator, at, exc=None):
+    """Yield batches until index ``at``, then raise (default
+    :class:`InjectedFault`) — a dataloader that dies mid-epoch."""
+    for i, batch in enumerate(iterator):
+        if i == int(at):
+            raise exc if exc is not None else InjectedFault(
+                f"injected dataloader failure at batch {at}")
+        yield batch
+
+
+def killer_stream(iterator, at):
+    """Yield batches until index ``at``, then kill the consuming thread
+    silently (see :class:`PrefetcherKilled`)."""
+    for i, batch in enumerate(iterator):
+        if i == int(at):
+            raise PrefetcherKilled(
+                f"injected producer death at batch {at}")
+        yield batch
+
+
+# -- PS RPC faults ---------------------------------------------------------
+
+def delay_rpc(table, seconds, calls=1):
+    """Stall the next ``calls`` RPCs of a ``RemoteTable`` by ``seconds``
+    (a congested or GC-pausing server).  Returns an undo callable."""
+    orig = table._call
+    state = {"left": int(calls)}
+
+    def wrapped(header, *arrays, **kw):
+        if state["left"] > 0:
+            state["left"] -= 1
+            time.sleep(float(seconds))
+        return orig(header, *arrays, **kw)
+
+    table._call = wrapped
+    return lambda: setattr(table, "_call", orig)
+
+
+def drop_rpc(table, calls=1):
+    """Close the client's pooled sockets immediately before each of the
+    next ``calls`` RPCs: the request dies mid-wire and the transport's
+    reconnect + retransmit path (with the server's dedup cache for
+    non-idempotent verbs) must absorb it.  Returns an undo callable."""
+    orig = table._call
+    state = {"left": int(calls)}
+
+    def wrapped(header, *arrays, **kw):
+        if state["left"] > 0:
+            state["left"] -= 1
+            for c in table._pool:
+                sock = c.sock
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass    # socket already dead — the goal anyway
+        return orig(header, *arrays, **kw)
+
+    table._call = wrapped
+    return lambda: setattr(table, "_call", orig)
+
+
+# -- files & process -------------------------------------------------------
+
+def tear_file(path, frac=0.5, keep_bytes=None):
+    """Truncate ``path`` the way a killed non-atomic writer would have:
+    keep the first ``keep_bytes`` (or ``frac`` of the file)."""
+    size = os.path.getsize(path)
+    keep = int(size * float(frac)) if keep_bytes is None else int(keep_bytes)
+    with open(path, "r+b") as f:
+        f.truncate(max(0, min(keep, size)))
+    return path
+
+
+def simulate_preemption(sig=signal.SIGTERM):
+    """Deliver the pod scheduler's preemption notice to THIS process
+    (synchronously, in the main thread)."""
+    signal.raise_signal(sig)
+
+
+# -- seeded placement ------------------------------------------------------
+
+class FaultInjector:
+    """Seed-driven fault placement: the same seed plans the same faults
+    at the same steps, so chaos runs replay exactly."""
+
+    def __init__(self, seed=0):
+        self.seed = int(seed)
+        self.rng = np.random.default_rng(self.seed)
+
+    def pick_steps(self, n_steps, n_faults=1, low=1):
+        """``n_faults`` distinct 0-based step indices in
+        ``[low, n_steps)``, sorted (deterministic per seed)."""
+        lo, hi = int(low), int(n_steps)
+        if hi - lo < int(n_faults):
+            raise ValueError(
+                f"cannot place {n_faults} faults in [{lo}, {hi})")
+        picks = self.rng.choice(np.arange(lo, hi), size=int(n_faults),
+                                replace=False)
+        return sorted(int(p) for p in picks)
+
+    # stream wrappers bound to this injector's plan
+    def nan_batches(self, iterator, n_steps, n_faults=1, keys=None):
+        at = self.pick_steps(n_steps, n_faults)
+        return at, nan_stream(iterator, at, keys=keys)
+
+    def kill_producer(self, iterator, n_steps):
+        (at,) = self.pick_steps(n_steps, 1)
+        return at, killer_stream(iterator, at)
+
+    def raise_in_loader(self, iterator, n_steps, exc=None):
+        (at,) = self.pick_steps(n_steps, 1)
+        return at, raising_stream(iterator, at, exc=exc)

@@ -1,0 +1,203 @@
+"""Step guard: fused non-finite sentinel + loss-spike watchdog.
+
+Long runs hit non-finite steps in practice (a bad batch, an overflowed
+bf16 reduction, a poisoned embedding row) and the reference framework
+simply trains on: the NaN propagates into every parameter within one
+step and the run is dead from that point even though it keeps printing
+losses.  ``StepGuard`` makes the step itself defensive at ~zero cost:
+
+* **Fused sentinel** — the executor's jitted step computes ONE scalar
+  conjunction inside the program: finiteness of the summed loss and of
+  every parameter update written this step (each ``isfinite``-reduce
+  fuses with the update computation that produced the tensor, so the
+  guard reads nothing twice).  The sentinel and the summed loss come
+  back as two hidden scalar outputs.
+* **Policies** —
+  - ``skip``: the poisoned update is discarded *in-graph* (a scalar
+    select between new and old params/opt-state, fused into the update
+    writes), so parameters are never corrupted and training continues
+    on the next batch;
+  - ``rollback``: parameters did take the hit (or a loss spike means
+    the update was finite but suspect) — restore the last good rolling
+    checkpoint via the attached
+    :class:`~hetu_tpu.resilience.checkpointer.RollingCheckpointManager`
+    and keep going, losing at most the checkpoint cadence;
+  - ``abort``: raise :class:`GuardTripped` and let the caller decide.
+* **Deferred checking** — reading a device scalar costs a host
+  round-trip, so by default the guard holds the sentinel as a device
+  array and materializes it one step later (by then the step has long
+  finished and the read is a ready-buffer fetch, not a sync).
+  ``check_interval=k`` batches the reads further: detection lags at
+  most ``k+1`` steps, amortizing the round-trip k-fold — rollback
+  semantics already tolerate that lag by construction.  ``flush()``
+  drains whatever is still pending (call it after the loop).
+* **Loss-spike watchdog** — host-side EMA over confirmed-finite
+  losses; ``spike_factor=s`` trips when a loss exceeds ``s x`` the EMA
+  after ``spike_warmup`` steps.  A spike's update is finite and already
+  applied, so under ``skip`` it only warns+counts; ``rollback``/
+  ``abort`` treat it like any other trip.
+"""
+
+from __future__ import annotations
+
+import collections
+import warnings
+
+import numpy as np
+
+
+class GuardTripped(RuntimeError):
+    """The step guard detected a fault it was told not to absorb."""
+
+    def __init__(self, reason, step, loss=None):
+        msg = f"step guard tripped at step {step}: {reason}"
+        if loss is not None:
+            msg += f" (loss={loss!r})"
+        super().__init__(msg)
+        self.reason = reason
+        self.step = step
+        self.loss = loss
+
+
+class StepGuard:
+    """Attach with ``Executor(..., step_guard=guard)`` or
+    ``guard.attach(executor)`` (the latter invalidates already-compiled
+    step programs so the sentinel gets traced in)."""
+
+    POLICIES = ("skip", "rollback", "abort")
+
+    def __init__(self, policy="skip", manager=None, spike_factor=None,
+                 spike_warmup=10, ema_decay=0.9, defer=True,
+                 check_interval=1, max_rollbacks=8):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"policy must be one of {self.POLICIES}, got {policy!r}")
+        if policy == "rollback" and manager is None:
+            raise ValueError(
+                "rollback policy needs a RollingCheckpointManager "
+                "(manager=) to restore from")
+        self.policy = policy
+        self.manager = manager
+        self.spike_factor = spike_factor
+        self.spike_warmup = int(spike_warmup)
+        self.ema_decay = float(ema_decay)
+        self.defer = bool(defer)
+        self.check_interval = max(1, int(check_interval))
+        self.max_rollbacks = int(max_rollbacks)
+        self._pending = collections.deque()  # (step, ok_arr, loss_arr, n)
+        self._ema = None
+        self._executor = None
+        self.stats = {"steps": 0, "nonfinite": 0, "spikes": 0,
+                      "skipped": 0, "rollbacks": 0, "trip_steps": [],
+                      "restored_steps": []}
+
+    # -- wiring ------------------------------------------------------------
+    def attach(self, executor):
+        """Install on an already-built executor: compiled step programs
+        are invalidated so the next run traces the sentinel in."""
+        executor.config["step_guard"] = self
+        self._bind(executor)
+        for sub in executor.subexecutor.values():
+            if hasattr(sub, "_jitted"):
+                sub._jitted = None
+            if hasattr(sub, "_multi_jitted"):
+                sub._multi_jitted = None
+        return self
+
+    def detach(self, executor):
+        """Remove the guard (and the sentinel from the compiled step)."""
+        self.flush()
+        executor.config.pop("step_guard", None)
+        for sub in executor.subexecutor.values():
+            if hasattr(sub, "_jitted"):
+                sub._jitted = None
+            if hasattr(sub, "_multi_jitted"):
+                sub._multi_jitted = None
+        return self
+
+    def _bind(self, executor):
+        self._executor = executor
+        unguarded = [name for name, sub in executor.subexecutor.items()
+                     if not hasattr(sub, "_jitted")]
+        if unguarded:
+            # e.g. PipelineSubExecutor compiles per-stage programs the
+            # sentinel isn't traced into — say so instead of silently
+            # guarding nothing
+            warnings.warn(
+                f"StepGuard has no effect on subgraph(s) {unguarded}: "
+                "their executor type does not trace the guard sentinel "
+                "(pipeline executors are not guarded yet)")
+
+    # -- per-step hook (called by SubExecutor) -----------------------------
+    def on_step(self, executor, ok_arr, loss_arr, n=1):
+        """Receive the step's DEVICE sentinel scalars.  Materialization
+        is deferred per ``defer``/``check_interval`` (see module doc);
+        a trip executes the policy — which may raise ``GuardTripped`` or
+        restore executor state in place."""
+        self._executor = executor
+        self._pending.append((executor._global_step, ok_arr, loss_arr, n))
+        keep = 1 if self.defer else 0
+        if len(self._pending) >= self.check_interval + keep:
+            while len(self._pending) > keep:
+                self._process(*self._pending.popleft())
+
+    def flush(self):
+        """Materialize and check every pending sentinel (call after the
+        training loop, and before checkpointing state you must trust).
+        Returns the stats dict."""
+        while self._pending:
+            self._process(*self._pending.popleft())
+        return self.stats
+
+    # -- internals ---------------------------------------------------------
+    def _process(self, step, ok_arr, loss_arr, n):
+        ok = bool(np.asarray(ok_arr))
+        loss = float(np.asarray(loss_arr))
+        self.stats["steps"] += int(n)
+        if not ok:
+            self.stats["nonfinite"] += 1
+            self._trip("non-finite loss or parameter update", step, loss)
+            return
+        if self.spike_factor is not None and np.isfinite(loss):
+            ema = self._ema
+            if (ema is not None and self.stats["steps"] > self.spike_warmup
+                    and loss > self.spike_factor * abs(ema) + 1e-12):
+                self.stats["spikes"] += 1
+                self._trip(
+                    f"loss spike ({loss:.4g} > {self.spike_factor} x "
+                    f"EMA {ema:.4g})", step, loss)
+                return
+            self._ema = (loss if ema is None
+                         else self.ema_decay * ema
+                         + (1.0 - self.ema_decay) * loss)
+
+    def _trip(self, reason, step, loss):
+        self.stats["trip_steps"].append(int(step))
+        if self.policy == "abort":
+            raise GuardTripped(reason, step, loss)
+        if self.policy == "skip":
+            self.stats["skipped"] += 1
+            if "spike" in reason:
+                # a spike's update was finite and is already applied —
+                # skip cannot un-apply it; only rollback can
+                warnings.warn(
+                    f"StepGuard(policy='skip') saw a {reason} at step "
+                    f"{step}: the update is already applied (use "
+                    "policy='rollback' to undo spikes)")
+            return
+        # rollback
+        if self.stats["rollbacks"] >= self.max_rollbacks:
+            raise GuardTripped(
+                f"{reason} — exceeded max_rollbacks={self.max_rollbacks} "
+                "(the fault is recurring; aborting instead of looping)",
+                step, loss)
+        # sentinels still queued describe the now-discarded timeline
+        self._pending.clear()
+        self._ema = None
+        restored = self.manager.restore_latest(self._executor)
+        self.stats["rollbacks"] += 1
+        self.stats["restored_steps"].append(int(restored))
+        warnings.warn(
+            f"StepGuard rolled back: {reason} at step {step}; restored "
+            f"checkpoint of step {restored} — batches in between replay "
+            "from the data pipeline (skip the offending one)")

@@ -1,0 +1,75 @@
+"""One retry policy for every transient-failure loop in the tree.
+
+The seed grew ad-hoc backoff loops wherever I/O could flake — the PS
+transport's reconnect-and-retransmit (ps/rpc.py), dataset fetches, the
+bench's per-stage retry.  Each had its own idea of backoff, deadline,
+and when to give up, and none had jitter (synchronized retries from a
+pod's worth of workers hammer a recovering server in lockstep —
+ps-lite's resender staggers for the same reason).  ``retry`` is the one
+shared policy: exponential backoff with a cap, optional multiplicative
+jitter, bounded by attempts and/or a wall-clock deadline, with a
+``giveup`` escape hatch for errors that retrying cannot fix.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+def retry(fn, *, attempts=None, deadline=None, backoff=0.05, factor=2.0,
+          max_backoff=2.0, jitter=0.0, retry_on=(Exception,), giveup=None,
+          on_retry=None, sleep=time.sleep, clock=time.monotonic, rng=None):
+    """Call ``fn()`` until it returns, retrying failures with backoff.
+
+    * ``attempts`` — max calls to ``fn`` (None = unbounded in count).
+    * ``deadline`` — wall-clock seconds from now after which the last
+      error is raised instead of retried (None = unbounded in time).
+      At least one of ``attempts``/``deadline`` must be set: an
+      unbounded retry loop turns an outage into a silent hang.
+    * ``backoff``/``factor``/``max_backoff`` — first pause, growth, cap.
+    * ``jitter`` — pause is scaled by ``1 + jitter * U[0, 1)`` so a
+      fleet of clients desynchronizes (``rng`` overrides the source for
+      deterministic tests).
+    * ``retry_on`` — exception classes worth retrying; anything else
+      propagates immediately.
+    * ``giveup(exc) -> bool`` — per-error veto (e.g. "the client was
+      closed underneath us"): a True return re-raises immediately.
+    * ``on_retry(exc, attempt, pause)`` — hook between attempts
+      (cleanup, logging).
+
+    On exhaustion the LAST exception is re-raised, so callers keep their
+    original error type (and can wrap it with context of their own).
+    """
+    if attempts is None and deadline is None:
+        raise ValueError(
+            "retry() needs attempts= and/or deadline= — an unbounded "
+            "retry loop hides outages as hangs")
+    if rng is None:
+        rng = random
+    deadline_t = None if deadline is None else clock() + float(deadline)
+    delay = float(backoff)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except retry_on as e:
+            if giveup is not None and giveup(e):
+                raise
+            remaining = (None if deadline_t is None
+                         else deadline_t - clock())
+            if attempts is not None and attempt >= attempts:
+                raise
+            if remaining is not None and remaining <= 0:
+                raise
+            pause = delay
+            if jitter:
+                pause *= 1.0 + jitter * rng.random()
+            if remaining is not None:
+                pause = min(pause, remaining)
+            if on_retry is not None:
+                on_retry(e, attempt, pause)
+            if pause > 0:
+                sleep(pause)
+            delay = min(delay * factor, max_backoff)
