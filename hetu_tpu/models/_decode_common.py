@@ -1,4 +1,5 @@
-"""Shared pieces of the KV-cache decoders (llama_decode / gpt_decode)."""
+"""Shared pieces of the KV-cache decoders (llama_decode / gpt_decode /
+transformer_decode) and their executor-facing wrappers."""
 
 from __future__ import annotations
 
@@ -6,6 +7,46 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+
+def param_prefix(executor, suffix):
+    """Infer a model's parameter-name prefix from an Executor's params by
+    the unique variable ending in ``suffix`` (e.g. ``_embed_table``).
+    The three decode wrappers used to each hand-roll this lookup."""
+    try:
+        return next(k for k in executor.params
+                    if k.endswith(suffix)).rsplit(suffix, 1)[0]
+    except StopIteration:
+        raise KeyError(
+            f"no executor param ends with {suffix!r} — pass name= "
+            "explicitly") from None
+
+
+def executor_generate(fn, executor, arrays, seed=0):
+    """Shared tail of every ``*_generate`` wrapper: call the jitted
+    decode program on the executor's params with a seeded PRNG key and
+    materialize the tokens to numpy."""
+    return np.asarray(fn(executor.params, *arrays, jax.random.key(seed)))
+
+
+def pad_prompts(prompts, pad_to=None, pad_id=0):
+    """Right-pad variable-length prompts into one [B, P] int32 batch.
+
+    Returns ``(ids, lengths)`` with ``lengths`` the true prompt lengths.
+    ``pad_to`` fixes P (serving's static prefill bucket); by default P is
+    the longest prompt."""
+    lens = np.asarray([len(np.asarray(p).reshape(-1)) for p in prompts],
+                      np.int32)
+    if lens.size and lens.min() < 1:
+        raise ValueError("empty prompt")
+    p_len = int(pad_to) if pad_to is not None else int(lens.max())
+    if lens.size and int(lens.max()) > p_len:
+        raise ValueError(
+            f"prompt of length {int(lens.max())} exceeds pad_to={p_len}")
+    ids = np.full((len(prompts), p_len), pad_id, np.int32)
+    for i, p in enumerate(prompts):
+        ids[i, :lens[i]] = np.asarray(p).reshape(-1)
+    return ids, lens
 
 
 def layer_norm(x, g, b, eps=1e-5):

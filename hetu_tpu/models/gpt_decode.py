@@ -13,21 +13,18 @@ build the model with seq_len >= prompt + max_new.
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 
 from ._decode_common import layer_norm as _ln
-from ._decode_common import make_picker, make_attend, assemble
+from ._decode_common import (make_picker, make_attend, assemble,
+                             executor_generate)
 
 
-def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,
-                        top_k=0):
-    """Returns jitted ``fn(params, prompt_ids [B, P][, key]) ->
-    [B, P+max_new]`` for a GPTModel (pre-norm, tied head)."""
-    c = config
-    hd = c.hidden_size // c.num_heads
+def make_layer_params(config, name):
+    """Per-layer param lookup by the GPTModel naming contract; returns
+    ``layer_params(params, i) -> dict`` (shared with serving)."""
+    del config
 
     def layer_params(params, i):
         our = f"{name}_h{i}"
@@ -42,6 +39,15 @@ def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,
             "w2": "ffn_out_weight", "b2": "ffn_out_bias",
         }.items()}
 
+    return layer_params
+
+
+def make_block(config):
+    """One GPT decoder layer over an explicit K/V cache; same signature
+    family as llama_decode.make_block minus rotary (GPT positions are a
+    learned table added at embedding time)."""
+    c = config
+    hd = c.hidden_size // c.num_heads
     attend = make_attend(hd)
 
     def block(lp, x, ck, cv, pos_mask, write_at):
@@ -60,11 +66,30 @@ def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,
         f = jax.nn.gelu(f @ lp["w1"] + lp["b1"])   # approximate, as gelu_op
         return x + f @ lp["w2"] + lp["b2"], ck, cv
 
+    return block
+
+
+def make_logits(config, name):
+    del config
+
     def logits_of(params, h_last):
         h = _ln(h_last, params[f"{name}_ln_f_scale"],
                 params[f"{name}_ln_f_bias"])
         return h @ params[f"{name}_wte_table"].T     # tied head
 
+    return logits_of
+
+
+def build_greedy_decode(config, max_new, name="gpt", temperature=0.0,
+                        top_k=0):
+    """Returns jitted ``fn(params, prompt_ids [B, P][, key]) ->
+    [B, P+max_new]`` for a GPTModel (pre-norm, tied head)."""
+    c = config
+    hd = c.hidden_size // c.num_heads
+
+    layer_params = make_layer_params(c, name)
+    block = make_block(c)
+    logits_of = make_logits(c, name)
     pick = make_picker(temperature, top_k)
 
     @jax.jit
@@ -120,6 +145,5 @@ def greedy_generate(executor, model, prompt_ids, max_new, name="gpt",
                     temperature=0.0, top_k=0, seed=0):
     fn = build_greedy_decode(model.config, max_new, name=name,
                              temperature=temperature, top_k=top_k)
-    return np.asarray(fn(executor.params,
-                         jnp.asarray(prompt_ids, jnp.int32),
-                         jax.random.key(seed)))
+    return executor_generate(fn, executor,
+                             [jnp.asarray(prompt_ids, jnp.int32)], seed)

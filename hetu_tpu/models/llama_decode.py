@@ -21,15 +21,12 @@ token-for-token (tests/test_torch_parity.py).
 
 from __future__ import annotations
 
-import functools
-
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 
 from ..ops.rotary import _rope_tables
-from ._decode_common import make_picker, make_attend, assemble
+from ._decode_common import (make_picker, make_attend, assemble,
+                             param_prefix, executor_generate)
 
 
 def _rms(x, g, eps):
@@ -47,19 +44,10 @@ def _rotate(x, cos, sin):
     return (xf * cos + rot * sin).astype(x.dtype)
 
 
-def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
-                        top_k=0, moe_names=None):
-    """Returns jitted ``fn(params, prompt_ids [B, P][, key]) ->
-    [B, P+max_new]``.
-
-    ``temperature`` 0 = greedy argmax; > 0 samples from
-    softmax(logits/temperature), restricted to the ``top_k`` largest
-    logits when top_k > 0 (pass a jax PRNG key as the third argument).
-    The prompt length is baked at first call (a new P retraces, the
-    executor's usual static-shape contract)."""
+def make_layer_params(config, name, moe_names=None):
+    """Per-layer param lookup by the canonical models/llama.py naming;
+    returns ``layer_params(params, i) -> dict`` (shared with serving)."""
     c = config
-    hd = c.hidden_size // c.num_heads
-    n_rep = c.num_heads // c.num_kv_heads
 
     def layer_params(params, i):
         our = f"{name}_layer{i}"
@@ -84,6 +72,18 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
                        down=params[f"{our}_mlp_out_weight"])
         return out
 
+    return layer_params
+
+
+def make_block(config):
+    """One Llama decoder layer over an explicit K/V cache; returns
+    ``block(lp, x [B, Sq, H], cache_k, cache_v [B, KV, T, D], cos, sin,
+    pos_mask, write_at) -> (x', cache_k', cache_v')``.  Used by both the
+    one-shot greedy decoder and the slot-batched serving engine."""
+    c = config
+    hd = c.hidden_size // c.num_heads
+    attend = make_attend(hd, c.num_heads // c.num_kv_heads)
+
     def moe_ffn(lp, f):
         """Dense-combine top-k MoE for decode: every expert computes, the
         router's top-k renormalized weights combine.  Correct for any
@@ -99,8 +99,6 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
              * jnp.einsum("bsh,ehf->bsef", f, lp["ew3"]))
         y = jnp.einsum("bsef,efh->bseh", a, lp["ew2"])
         return jnp.einsum("bse,bseh->bsh", e_w.astype(y.dtype), y)
-
-    attend = make_attend(hd, n_rep)
 
     def block(lp, x, cache_k, cache_v, cos, sin, pos_mask, write_at):
         """x [B, Sq, H]; returns (x', cache_k', cache_v')."""
@@ -125,12 +123,38 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
         return (x + (jax.nn.silu(f @ lp["gate"]) * (f @ lp["up"]))
                 @ lp["down"], cache_k, cache_v)
 
+    return block
+
+
+def make_logits(config, name):
+    """Final-norm + LM-head projection shared by decode paths."""
+    c = config
+
     def logits_of(params, h_last):
         h = _rms(h_last, params[f"{name}_norm_scale"], c.rms_eps)
         if c.tie_embeddings:
             return h @ params[f"{name}_embed_table"].T
         return h @ params[f"{name}_lm_head_weight"]
 
+    return logits_of
+
+
+def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
+                        top_k=0, moe_names=None):
+    """Returns jitted ``fn(params, prompt_ids [B, P][, key]) ->
+    [B, P+max_new]``.
+
+    ``temperature`` 0 = greedy argmax; > 0 samples from
+    softmax(logits/temperature), restricted to the ``top_k`` largest
+    logits when top_k > 0 (pass a jax PRNG key as the third argument).
+    The prompt length is baked at first call (a new P retraces, the
+    executor's usual static-shape contract)."""
+    c = config
+    hd = c.hidden_size // c.num_heads
+
+    layer_params = make_layer_params(c, name, moe_names)
+    block = make_block(c)
+    logits_of = make_logits(c, name)
     pick = make_picker(temperature, top_k)
 
     @jax.jit
@@ -183,22 +207,24 @@ def build_greedy_decode(config, max_new, name="llama", temperature=0.0,
     return decode
 
 
+def moe_param_names(model):
+    """Router/expert variable names per layer, resolved from the live
+    layer objects (fresh_name may suffix the router gate)."""
+    if not model.config.num_experts:
+        return None
+    return [{"wg": l.mlp.gate.wg.name, "w1": l.mlp.w1.name,
+             "w2": l.mlp.w2.name, "w3": l.mlp.w3.name}
+            for l in model.model.layers]
+
+
 def greedy_generate(executor, model, prompt_ids, max_new, name=None,
                     temperature=0.0, top_k=0, seed=0):
     """Convenience wrapper: decode from an Executor's params.
 
     ``model``: the LlamaForCausalLM whose config/naming to use."""
-    name = name or next(k for k in executor.params
-                        if k.endswith("_embed_table")).rsplit(
-        "_embed_table", 1)[0]
-    moe_names = None
-    if model.config.num_experts:
-        moe_names = [{"wg": l.mlp.gate.wg.name, "w1": l.mlp.w1.name,
-                      "w2": l.mlp.w2.name, "w3": l.mlp.w3.name}
-                     for l in model.model.layers]
+    name = name or param_prefix(executor, "_embed_table")
     fn = build_greedy_decode(model.config, max_new, name=name,
                              temperature=temperature, top_k=top_k,
-                             moe_names=moe_names)
-    return np.asarray(fn(executor.params,
-                         jnp.asarray(prompt_ids, jnp.int32),
-                         jax.random.key(seed)))
+                             moe_names=moe_param_names(model))
+    return executor_generate(fn, executor,
+                             [jnp.asarray(prompt_ids, jnp.int32)], seed)

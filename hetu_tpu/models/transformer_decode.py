@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ._decode_common import layer_norm as _ln
-from ._decode_common import make_attend, make_picker
+from ._decode_common import make_attend, make_picker, executor_generate
 
 
 def build_seq2seq_decode(config, max_new, name="transformer",
@@ -171,7 +171,6 @@ def seq2seq_generate(executor, model, src_ids, src_keep, max_new,
     fn = build_seq2seq_decode(model.config, max_new, name=name,
                               temperature=temperature, top_k=top_k,
                               bos_id=bos_id)
-    return np.asarray(fn(executor.params,
-                         jnp.asarray(src_ids, jnp.int32),
-                         jnp.asarray(src_keep, jnp.float32),
-                         jax.random.key(seed)))
+    return executor_generate(
+        fn, executor, [jnp.asarray(src_ids, jnp.int32),
+                       jnp.asarray(src_keep, jnp.float32)], seed)

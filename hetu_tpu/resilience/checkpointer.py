@@ -15,7 +15,12 @@ total loss.  ``RollingCheckpointManager`` closes that whole class:
 * keep-last-K retention bounds disk;
 * ``install_preemption_hook`` flushes a final checkpoint from the
   SIGTERM handler, so a preempted run resumes bitwise (params, opt
-  state, RNG key, and step counter all ride ``Executor.state_dict``).
+  state, RNG key, and step counter all ride ``Executor.state_dict``);
+* host-store PS embedding tables registered via ``register_ps_table``
+  are snapshotted next to every checkpoint and restored with it, so a
+  rollback rewinds the PS rows too — without this, ``restore_latest``
+  rewound device state while the host store kept its post-fault rows
+  and the "restored" model silently mixed two points in time.
 """
 
 from __future__ import annotations
@@ -47,7 +52,7 @@ class RollingCheckpointManager:
     ``graph.checkpoint.save_sharded``-style writers.
     """
 
-    def __init__(self, directory, keep=3, prefix="ckpt"):
+    def __init__(self, directory, keep=3, prefix="ckpt", ps_tables=None):
         if int(keep) < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.directory = str(directory)
@@ -57,6 +62,20 @@ class RollingCheckpointManager:
         self.preempted = False
         self.last_saved_step = None
         self._prev_handlers = {}
+        # host-store embedding tables (ps/store.py) snapshotted alongside
+        # every checkpoint; anything with .save(path)/.load(path) works
+        self.ps_tables = dict(ps_tables or {})
+
+    def register_ps_table(self, name, table):
+        """Snapshot ``table`` (``save(path)``/``load(path)``, e.g. a
+        ps.EmbeddingTable) with every checkpoint under key ``name``, and
+        restore it in ``restore_latest`` — PS rows rewind with the
+        device state."""
+        for attr in ("save", "load"):
+            if not callable(getattr(table, attr, None)):
+                raise TypeError(
+                    f"ps table {name!r} lacks a callable .{attr}(path)")
+        self.ps_tables[str(name)] = table
 
     # -- manifest ----------------------------------------------------------
     def _manifest_path(self):
@@ -106,8 +125,33 @@ class RollingCheckpointManager:
         return int(ents[0].get("step", -1)) if ents else None
 
     # -- save --------------------------------------------------------------
+    def _save_ps_snapshots(self, step):
+        """Write each registered PS table next to the checkpoint
+        (atomic: native save into a temp file + os.replace) and return
+        the per-table manifest evidence."""
+        out = {}
+        for nm, tbl in self.ps_tables.items():
+            fname = f"{self.prefix}-{int(step):010d}-ps-{nm}.bin"
+            path = os.path.join(self.directory, fname)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                tbl.save(tmp)
+                with open(tmp, "rb") as f:
+                    blob = f.read()
+                os.replace(tmp, path)
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+            out[nm] = {"file": fname, "bytes": len(blob),
+                       "crc32": zlib.crc32(blob) & 0xFFFFFFFF}
+        return out
+
     def save(self, executor, step=None):
-        """Atomically checkpoint the executor; returns the file path."""
+        """Atomically checkpoint the executor (plus any registered PS
+        tables); returns the file path."""
         state = executor.state_dict()
         if step is None:
             step = int(state.get("global_step", 0))
@@ -115,22 +159,28 @@ class RollingCheckpointManager:
         fname = f"{self.prefix}-{int(step):010d}.pkl"
         path = os.path.join(self.directory, fname)
         atomic_write_bytes(blob, path)
+        entry = {"step": int(step), "file": fname,
+                 "bytes": len(blob),
+                 "crc32": zlib.crc32(blob) & 0xFFFFFFFF}
+        if self.ps_tables:
+            entry["ps"] = self._save_ps_snapshots(step)
         entries = [e for e in self._read_manifest()
                    if e.get("file") != fname]
-        entries.append({"step": int(step), "file": fname,
-                        "bytes": len(blob),
-                        "crc32": zlib.crc32(blob) & 0xFFFFFFFF})
+        entries.append(entry)
         entries.sort(key=lambda e: (e.get("step", -1), e.get("file", "")))
         kept, dropped = entries[-self.keep:], entries[:-self.keep]
         # manifest first: a crash between the two steps leaves an extra
         # file on disk (harmless), never a manifest pointing at nothing
         self._write_manifest(kept)
         for e in dropped:
-            try:
-                os.remove(os.path.join(self.directory, e["file"]))
-            except OSError:
-                pass    # already gone / shared-fs race: retention is
-                # best-effort, correctness lives in the manifest
+            victims = [e["file"]] + [p["file"]
+                                     for p in e.get("ps", {}).values()]
+            for vf in victims:
+                try:
+                    os.remove(os.path.join(self.directory, vf))
+                except OSError:
+                    pass    # already gone / shared-fs race: retention is
+                    # best-effort, correctness lives in the manifest
         self.last_saved_step = int(step)
         return path
 
@@ -170,22 +220,57 @@ class RollingCheckpointManager:
                         "checkpoint captured an already-corrupted run")
         return state
 
+    def _verify_ps_snapshots(self, entry):
+        """Prove every registered table's snapshot for ``entry`` intact
+        BEFORE anything is mutated; returns {name: path}.  A registered
+        table with no snapshot in the entry (checkpoint predates
+        registration) restores nothing for that table — warned, not
+        fatal; a snapshot that is missing or corrupt on disk fails the
+        whole candidate so restore falls back to an older one."""
+        ps_meta = entry.get("ps", {})
+        paths = {}
+        for nm in self.ps_tables:
+            meta = ps_meta.get(nm)
+            if meta is None:
+                warnings.warn(
+                    f"checkpoint {entry['file']} has no PS snapshot for "
+                    f"table {nm!r} (saved before registration?) — its "
+                    "rows are NOT rewound")
+                continue
+            path = os.path.join(self.directory, meta["file"])
+            with open(path, "rb") as f:     # OSError -> candidate fails
+                blob = f.read()
+            if "bytes" in meta and len(blob) != meta["bytes"]:
+                raise CheckpointError(
+                    f"PS snapshot {meta['file']} size mismatch "
+                    f"({len(blob)} != {meta['bytes']}) — torn write")
+            if ("crc32" in meta
+                    and zlib.crc32(blob) & 0xFFFFFFFF != meta["crc32"]):
+                raise CheckpointError(
+                    f"PS snapshot {meta['file']} CRC mismatch — corrupt")
+            paths[nm] = path
+        return paths
+
     def restore_latest(self, executor, check_finite=True):
-        """Restore the newest INTACT checkpoint into ``executor`` and
-        return its step.  Torn, corrupt, structurally invalid, or (by
-        default) non-finite checkpoints are skipped with a warning;
-        raises :class:`CheckpointError` when nothing survives."""
+        """Restore the newest INTACT checkpoint into ``executor`` (and
+        its PS snapshots into the registered tables) and return its
+        step.  Torn, corrupt, structurally invalid, or (by default)
+        non-finite checkpoints are skipped with a warning; raises
+        :class:`CheckpointError` when nothing survives."""
         tried = []
         for entry in self.entries():
             path = os.path.join(self.directory, entry["file"])
             try:
                 state = self._read_verified(path, entry, check_finite)
+                ps_paths = self._verify_ps_snapshots(entry)
             except (CheckpointError, OSError) as e:
                 tried.append(f"{entry['file']}: {e}")
                 warnings.warn(
                     f"skipping bad checkpoint {entry['file']}: {e}")
                 continue
             executor.load_state_dict(state)
+            for nm, ps_path in ps_paths.items():
+                self.ps_tables[nm].load(ps_path)
             return int(state["global_step"])
         detail = ("; ".join(tried) if tried
                   else "directory has no checkpoints")

@@ -1,0 +1,15 @@
+"""Continuous-batching inference serving over the KV-cache decoders.
+
+Slot-pooled K/V cache (kv_cache.py) + iteration-level FIFO scheduler
+(scheduler.py) + slot-batched model adapters (adapters.py) + the
+engine tying them together (engine.py).  ``bench.py --serve`` replays a
+Poisson arrival trace through the engine and its static-batch twin.
+"""
+
+from .kv_cache import SlotKVCache
+from .scheduler import Request, Scheduler
+from .adapters import (LlamaSlotAdapter, GPTSlotAdapter, adapter_for)
+from .engine import InferenceEngine
+
+__all__ = ["SlotKVCache", "Request", "Scheduler", "LlamaSlotAdapter",
+           "GPTSlotAdapter", "adapter_for", "InferenceEngine"]

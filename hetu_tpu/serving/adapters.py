@@ -1,0 +1,175 @@
+"""Slot-batched views of the KV-cache decoders for the serving engine.
+
+The one-shot decoders (models/llama_decode.py, gpt_decode.py) carry
+their K/V cache through a ``lax.scan`` with ONE shared position for the
+whole batch — fine for a fixed batch, useless for continuous batching
+where every slot sits at a different sequence position.  An adapter
+re-hosts the SAME per-layer block math (imported from those modules, not
+copied) in slot-batched form:
+
+* ``decode(params, tokens [S], positions [S], k, v)`` — one token per
+  slot, each at its own position, against the pooled cache
+  ``[S, L, KV, T, D]``.  The per-slot position plumbing (rotary angles,
+  attention mask, cache write offset) is vmapped over the slot axis, so
+  per-slot ``dynamic_update_slice`` writes lower to one batched scatter.
+* ``prefill(params, prompt [1, P])`` — a whole prompt through all
+  layers at once, returning the per-layer K/V to deposit into one slot
+  plus the logits row that seeds the first generated token.
+
+Both are pure functions of static shapes: the engine jits them once.
+
+Pad-safety: prefill pads prompts to the engine's fixed bucket P and
+also returns K/V for the pad tail.  That tail is harmless — decode
+masks attention to ``col <= position`` and every cache row between the
+true prompt length and the current position has been overwritten by a
+decode step before it first becomes attendable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.rotary import _rope_tables
+from ..models import llama_decode as _ld
+from ..models import gpt_decode as _gd
+
+
+def _causal(p_len):
+    return (jnp.arange(p_len)[None, :] <= jnp.arange(p_len)[:, None])
+
+
+class LlamaSlotAdapter:
+    """Rotary/GQA (Llama-family, incl. sparse-MoE) slot-batched decode."""
+
+    def __init__(self, config, name, moe_names=None):
+        c = config
+        self.config = c
+        self.name = name
+        self.layers = c.num_layers
+        self.kv_heads = c.num_kv_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.position_cap = None          # rotary: no learned-table limit
+        self.embed_param = f"{name}_embed_table"
+        self._layer_params = _ld.make_layer_params(c, name, moe_names)
+        self._block = _ld.make_block(c)
+        self._logits = _ld.make_logits(c, name)
+
+    @classmethod
+    def for_model(cls, model, name):
+        return cls(model.config, name,
+                   moe_names=_ld.moe_param_names(model))
+
+    def decode(self, params, tokens, positions, k, v):
+        c, hd = self.config, self.head_dim
+        emb = params[self.embed_param]
+        lps = [self._layer_params(params, i) for i in range(self.layers)]
+        max_len = k.shape[3]
+        cos_t, sin_t = _rope_tables(max_len, hd, c.rope_theta)
+        x = emb[tokens][:, None, None, :]            # [S, 1, 1, H]
+        cos = cos_t[positions][:, None, :]           # [S, 1, hd]
+        sin = sin_t[positions][:, None, :]
+        mask = (jnp.arange(max_len)[None, :]
+                <= positions[:, None])[:, None, :]   # [S, 1, T]
+        vblock = jax.vmap(self._block,
+                          in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
+        ks, vs = [], []
+        for i, lp in enumerate(lps):
+            ck, cv = k[:, i][:, None], v[:, i][:, None]  # [S, 1, KV, T, D]
+            x, ck, cv = vblock(lp, x, ck, cv, cos, sin, mask, positions)
+            ks.append(ck[:, 0])
+            vs.append(cv[:, 0])
+        logits = self._logits(params, x[:, 0, 0, :])     # [S, V]
+        return logits, jnp.stack(ks, 1), jnp.stack(vs, 1)
+
+    def prefill(self, params, prompt):
+        c, hd = self.config, self.head_dim
+        emb = params[self.embed_param]
+        lps = [self._layer_params(params, i) for i in range(self.layers)]
+        _, p_len = prompt.shape
+        cos_t, sin_t = _rope_tables(p_len, hd, c.rope_theta)
+        x = emb[prompt]
+        mask = _causal(p_len)
+        kshape = (1, self.kv_heads, p_len, hd)
+        ks, vs = [], []
+        for lp in lps:
+            ck = jnp.zeros(kshape, emb.dtype)
+            cv = jnp.zeros(kshape, emb.dtype)
+            x, ck, cv = self._block(lp, x, ck, cv, cos_t, sin_t, mask, 0)
+            ks.append(ck[0])
+            vs.append(cv[0])
+        logits = self._logits(params, x[0])              # [P, V]
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+class GPTSlotAdapter:
+    """Learned-positions GPT slot-batched decode.  The position table
+    caps total sequence length at ``config.seq_len`` — the engine
+    enforces ``max_len <= seq_len`` via ``position_cap``."""
+
+    def __init__(self, config, name):
+        c = config
+        self.config = c
+        self.name = name
+        self.layers = c.num_layers
+        self.kv_heads = c.num_heads       # no GQA in the GPT tier
+        self.head_dim = c.hidden_size // c.num_heads
+        self.position_cap = c.seq_len
+        self.embed_param = f"{name}_wte_table"
+        self._layer_params = _gd.make_layer_params(c, name)
+        self._block = _gd.make_block(c)
+        self._logits = _gd.make_logits(c, name)
+
+    @classmethod
+    def for_model(cls, model, name):
+        return cls(model.config, name)
+
+    def decode(self, params, tokens, positions, k, v):
+        emb = params[self.embed_param]
+        wpe = params[f"{self.name}_wpe"]
+        lps = [self._layer_params(params, i) for i in range(self.layers)]
+        max_len = k.shape[3]
+        x = (emb[tokens] + wpe[positions])[:, None, None, :]  # [S, 1, 1, H]
+        mask = (jnp.arange(max_len)[None, :]
+                <= positions[:, None])[:, None, :]            # [S, 1, T]
+        vblock = jax.vmap(self._block, in_axes=(None, 0, 0, 0, 0, 0))
+        ks, vs = [], []
+        for i, lp in enumerate(lps):
+            ck, cv = k[:, i][:, None], v[:, i][:, None]
+            x, ck, cv = vblock(lp, x, ck, cv, mask, positions)
+            ks.append(ck[:, 0])
+            vs.append(cv[:, 0])
+        logits = self._logits(params, x[:, 0, 0, :])
+        return logits, jnp.stack(ks, 1), jnp.stack(vs, 1)
+
+    def prefill(self, params, prompt):
+        emb = params[self.embed_param]
+        wpe = params[f"{self.name}_wpe"]
+        lps = [self._layer_params(params, i) for i in range(self.layers)]
+        _, p_len = prompt.shape
+        x = emb[prompt] + wpe[None, :p_len]
+        mask = _causal(p_len)
+        kshape = (1, self.kv_heads, p_len, self.head_dim)
+        ks, vs = [], []
+        for lp in lps:
+            ck = jnp.zeros(kshape, emb.dtype)
+            cv = jnp.zeros(kshape, emb.dtype)
+            x, ck, cv = self._block(lp, x, ck, cv, mask, 0)
+            ks.append(ck[0])
+            vs.append(cv[0])
+        logits = self._logits(params, x[0])
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def adapter_for(model, name):
+    """Pick the slot adapter matching a model instance by its config
+    family (rotary Llama-likes vs learned-position GPTs)."""
+    c = model.config
+    if hasattr(c, "rope_theta"):
+        return LlamaSlotAdapter.for_model(model, name)
+    if hasattr(c, "seq_len") and hasattr(c, "num_layers"):
+        return GPTSlotAdapter.for_model(model, name)
+    raise TypeError(
+        f"no slot adapter for {type(model).__name__} "
+        f"(config {type(c).__name__}) — serving supports the Llama and "
+        "GPT KV-cache decoder tiers")

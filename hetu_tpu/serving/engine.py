@@ -1,0 +1,286 @@
+"""TPU-native continuous-batching inference engine.
+
+``InferenceEngine`` wraps an Executor-trained (or HF-imported) decode
+model into exactly TWO jitted programs whose shapes never change:
+
+* ``prefill(params, k, v, prompt [1, P], p_len, slot, key)`` — run one
+  prompt (padded to the fixed bucket P = ``max_prompt_len``) through all
+  layers, deposit its K/V into ``slot`` of the pooled cache, and emit
+  the request's first token from the true last prompt row;
+* ``step(params, k, v, tokens [S], positions [S], active [S], key)`` —
+  ONE decode iteration for every slot at once, each slot at its own
+  position (adapters.py vmaps the per-layer block over slots).  Inactive
+  slots compute masked garbage — the price of a static shape — and
+  their outputs are discarded host-side.
+
+Because every call sees identical shapes, XLA compiles each program
+once; ``trace_counts`` exposes the engine's own retrace counters and
+the compile-once test pins them at 1 after warmup.
+
+The scheduler (scheduler.py) interleaves admission-prefill with decode
+at iteration granularity, and the slot pool (kv_cache.py) recycles a
+retired request's slot on the next iteration.  Per-request TTFT / TPOT /
+queue-wait land in ``records`` as plain dicts; summarize with
+``hetu_tpu.metrics.request_latency_summary``.
+
+Usage::
+
+    engine = InferenceEngine(ex, model, n_slots=8, max_len=256)
+    outs = engine.generate_many(prompts, max_new=64)      # batch API
+    h = engine.submit(prompt, max_new=64,
+                      stream=lambda tok, req: print(tok)) # callback API
+    for tok in engine.stream(prompt, max_new=64):         # generator API
+        ...
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models._decode_common import make_picker, param_prefix, pad_prompts
+from .adapters import adapter_for
+from .kv_cache import SlotKVCache
+from .scheduler import Request, Scheduler
+
+
+class InferenceEngine:
+    """Continuous-batching generation over a slot-pooled KV cache.
+
+    ``gang=True`` degrades scheduling to static batching (admit only
+    when every slot is free) — the serve bench's baseline twin; the
+    numerics and jitted programs are identical, only admission differs.
+    """
+
+    def __init__(self, executor, model, n_slots=4, max_len=128,
+                 max_prompt_len=None, prefill_budget=2, eos_id=None,
+                 temperature=0.0, top_k=0, seed=0, name=None,
+                 gang=False):
+        self.params = executor.params
+        name = name or param_prefix(
+            executor, "_embed_table"
+            if hasattr(model.config, "rope_theta") else "_wte_table")
+        self.adapter = adapter_for(model, name)
+        cap = self.adapter.position_cap
+        if cap is not None and max_len > cap:
+            raise ValueError(
+                f"max_len={max_len} exceeds the model's learned-position "
+                f"table ({cap}); build the model with a longer seq_len")
+        self.max_len = int(max_len)
+        self.max_prompt_len = int(max_prompt_len or max(1, max_len // 2))
+        if self.max_prompt_len > self.max_len:
+            raise ValueError(
+                f"max_prompt_len={self.max_prompt_len} > max_len="
+                f"{self.max_len}")
+        emb = self.params[self.adapter.embed_param]
+        self.cache = SlotKVCache(
+            n_slots, self.adapter.layers, self.adapter.kv_heads,
+            self.max_len, self.adapter.head_dim, dtype=emb.dtype)
+        self.scheduler = Scheduler(self.cache,
+                                   prefill_budget=prefill_budget,
+                                   gang=gang)
+        self.eos_id = eos_id
+        self._pick = make_picker(temperature, top_k)
+        self._key = jax.random.key(seed)
+        self._last_tokens = np.zeros(n_slots, np.int32)
+        # per-request latency records + per-iteration occupancy log
+        self.records = []
+        self.occupancy = []
+        self.decode_steps = 0
+        self.prefills = 0
+        self._prefill_traces = 0
+        self._step_traces = 0
+        self._build()
+
+    # -- jitted programs ---------------------------------------------------
+    def _build(self):
+        adapter, pick = self.adapter, self._pick
+
+        def prefill(params, k, v, prompt, p_len, slot, key):
+            self._prefill_traces += 1      # host-side retrace witness
+            logits, kn, vn = adapter.prefill(params, prompt)
+            k = jax.lax.dynamic_update_slice(k, kn[None],
+                                             (slot, 0, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(v, vn[None],
+                                             (slot, 0, 0, 0, 0))
+            row = jax.lax.dynamic_slice_in_dim(logits, p_len - 1, 1, 0)
+            tok = pick(row, key)[0].astype(jnp.int32)
+            return k, v, tok
+
+        def step(params, k, v, tokens, positions, active, key):
+            self._step_traces += 1         # host-side retrace witness
+            logits, k, v = adapter.decode(params, tokens, positions, k, v)
+            nxt = pick(logits, key).astype(jnp.int32)
+            return k, v, jnp.where(active, nxt, 0)
+
+        # donate the cache buffers so the pool is updated in place on
+        # accelerator backends (on CPU jax cannot donate; skip the
+        # per-call warning)
+        donate = () if jax.default_backend() == "cpu" else (1, 2)
+        self._prefill_fn = jax.jit(prefill, donate_argnums=donate)
+        self._step_fn = jax.jit(step, donate_argnums=donate)
+
+    @property
+    def trace_counts(self):
+        """{'prefill': n, 'step': n} — times each program was traced."""
+        return {"prefill": self._prefill_traces,
+                "step": self._step_traces}
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- request API -------------------------------------------------------
+    def submit(self, prompt, max_new, stream=None, eos_id=None,
+               arrival=None):
+        """Queue one generation request; returns its Request handle.
+        ``stream(token, request)`` is called per generated token."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds max_prompt_len="
+                f"{self.max_prompt_len}")
+        max_new = int(max_new)
+        if prompt.size + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new ({max_new}) exceeds "
+                f"max_len={self.max_len}")
+        req = Request(prompt, max_new,
+                      arrival=self._now() if arrival is None else arrival,
+                      stream=stream,
+                      eos_id=self.eos_id if eos_id is None else eos_id)
+        return self.scheduler.submit(req)
+
+    @staticmethod
+    def _now():
+        return time.perf_counter()
+
+    def _emit(self, req, tok, now):
+        req.tokens.append(int(tok))
+        if req.t_first is None:
+            req.t_first = now
+        if req.stream is not None:
+            req.stream(int(tok), req)
+
+    def _maybe_retire(self, req, tok, now):
+        done_eos = req.eos_id is not None and int(tok) == req.eos_id
+        if done_eos or len(req.tokens) >= req.max_new:
+            req.t_done = now
+            self.scheduler.retire(req, "eos" if done_eos else "max_new")
+            self.records.append({
+                "id": req.rid, "prompt_len": int(req.prompt.size),
+                "n_tokens": len(req.tokens),
+                "queue_wait": req.queue_wait, "ttft": req.ttft,
+                "tpot": req.tpot, "finish_reason": req.finish_reason})
+
+    # -- the iteration -----------------------------------------------------
+    def step(self):
+        """One scheduler iteration: admit + prefill new requests, then
+        one fused decode step for everything in flight.  Returns the
+        number of tokens produced."""
+        produced = 0
+        # 1) admission: prefill up to the budget into free slots
+        for req, slot in self.scheduler.admit():
+            req.t_admit = self._now()
+            padded, _ = pad_prompts([req.prompt],
+                                    pad_to=self.max_prompt_len)
+            k, v, tok = self._prefill_fn(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(padded), req.prompt.size, slot,
+                self._next_key())
+            self.cache.update(k, v)
+            self.cache.positions[slot] = req.prompt.size
+            self.prefills += 1
+            tok = int(np.asarray(tok))
+            self._last_tokens[slot] = tok
+            now = self._now()
+            self._emit(req, tok, now)
+            produced += 1
+            self._maybe_retire(req, tok, now)
+        # 2) one decode iteration over every active slot
+        slots = self.scheduler.active_slots()
+        if slots:
+            active = np.zeros(self.cache.n_slots, bool)
+            active[slots] = True
+            self.occupancy.append(len(slots) / self.cache.n_slots)
+            k, v, nxt = self._step_fn(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(self._last_tokens),
+                self.cache.device_positions(), jnp.asarray(active),
+                self._next_key())
+            self.cache.update(k, v)
+            self.cache.advance(slots)
+            self.decode_steps += 1
+            nxt = np.asarray(nxt)
+            now = self._now()
+            for slot in slots:
+                req = self.scheduler.running[slot]
+                tok = int(nxt[slot])
+                self._last_tokens[slot] = tok
+                self._emit(req, tok, now)
+                produced += 1
+                self._maybe_retire(req, tok, now)
+        return produced
+
+    def run(self, max_iterations=None):
+        """Step until queue and slots drain; returns iterations used."""
+        it = 0
+        while not self.scheduler.idle:
+            if max_iterations is not None and it >= max_iterations:
+                raise RuntimeError(
+                    f"engine did not drain in {max_iterations} iterations")
+            self.step()
+            it += 1
+        return it
+
+    def generate_many(self, prompts, max_new, eos_id=None):
+        """Synchronous batch API: submit all, drain, return each
+        request's generated ids (prompt excluded)."""
+        reqs = [self.submit(p, max_new, eos_id=eos_id) for p in prompts]
+        # worst case every request runs alone to max_len
+        self.run(max_iterations=(len(reqs) + 1) * (self.max_len + 2))
+        return [r.result() for r in reqs]
+
+    def stream(self, prompt, max_new, eos_id=None):
+        """Generator API: yields tokens as the engine produces them
+        (pumping the engine between yields; other in-flight requests
+        advance too)."""
+        req = self.submit(prompt, max_new, eos_id=eos_id)
+        emitted = 0
+        guard = (self.max_len + 2) * (len(self.scheduler.queue)
+                                      + self.cache.n_slots + 1)
+        it = 0
+        while emitted < len(req.tokens) or not req.finished:
+            if emitted < len(req.tokens):
+                emitted += 1
+                yield req.tokens[emitted - 1]
+                continue
+            if it >= guard:
+                raise RuntimeError("stream did not make progress")
+            self.step()
+            it += 1
+
+    def reset_stats(self):
+        """Clear per-request records and step counters (NOT the trace
+        counters — retraces after a warmup are exactly what the
+        compile-once guard must still see)."""
+        self.records = []
+        self.occupancy = []
+        self.decode_steps = 0
+        self.prefills = 0
+
+    # -- reporting ---------------------------------------------------------
+    def stats(self):
+        occ = float(np.mean(self.occupancy)) if self.occupancy else 0.0
+        return {"n_slots": self.cache.n_slots,
+                "mean_occupancy": round(occ, 4),
+                "decode_steps": self.decode_steps,
+                "prefills": self.prefills,
+                "requests_finished": len(self.records),
+                "slot_allocs": self.cache.alloc_count,
+                "slot_frees": self.cache.free_count,
+                "trace_counts": self.trace_counts}

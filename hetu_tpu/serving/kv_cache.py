@@ -1,0 +1,104 @@
+"""Preallocated slot pool for serving K/V caches.
+
+The whole cache is ONE pair of static-shaped device arrays,
+
+    k, v : [n_slots, layers, kv_heads, max_len, head_dim]
+
+allocated once at engine construction and never reshaped: every jitted
+step sees the same shapes regardless of which requests occupy which
+slots, so XLA compiles the slot-batched decode step exactly once (the
+engine's compile-once guard asserts this).  A slot is the unit of
+admission — one in-flight request owns one slot; retiring a request
+returns its slot to the free list immediately, and the next queued
+request reuses it mid-flight without touching the other slots.
+
+Per-slot write positions (== current sequence length) are tracked
+host-side in numpy and shipped into the step as a [n_slots] int32
+operand; stale rows beyond a slot's position are never attended (the
+step's mask is ``col <= position``) and are overwritten in order by
+subsequent decode writes, so freeing/reusing a slot needs no cache
+zeroing."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class SlotKVCache:
+    """Fixed pool of ``n_slots`` K/V cache slots on device."""
+
+    def __init__(self, n_slots, layers, kv_heads, max_len, head_dim,
+                 dtype=jnp.float32):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.n_slots = int(n_slots)
+        self.layers = int(layers)
+        self.kv_heads = int(kv_heads)
+        self.max_len = int(max_len)
+        self.head_dim = int(head_dim)
+        shape = (self.n_slots, self.layers, self.kv_heads, self.max_len,
+                 self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # host mirrors: next write position (== tokens cached) per slot
+        self.positions = np.zeros(self.n_slots, np.int32)
+        self._free = list(range(self.n_slots - 1, -1, -1))  # pop() -> slot 0 first
+        self._owner = [None] * self.n_slots
+        self.alloc_count = 0
+        self.free_count = 0
+
+    # -- allocation --------------------------------------------------------
+    @property
+    def n_free(self):
+        return len(self._free)
+
+    @property
+    def n_active(self):
+        return self.n_slots - len(self._free)
+
+    def alloc(self, owner=None):
+        """Claim a free slot (lowest id first); None when the pool is
+        exhausted — admission control, not an error."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._owner[slot] = owner
+        self.positions[slot] = 0
+        self.alloc_count += 1
+        return slot
+
+    def free(self, slot):
+        """Return ``slot`` to the pool.  Double-free is a bug in the
+        scheduler and raises — a silently re-listed slot would be handed
+        to two requests at once and corrupt both."""
+        slot = int(slot)
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range")
+        if slot in self._free:
+            raise RuntimeError(f"double free of slot {slot}")
+        self._owner[slot] = None
+        self.positions[slot] = 0
+        self._free.append(slot)
+        self.free_count += 1
+
+    def owner(self, slot):
+        return self._owner[slot]
+
+    # -- step plumbing -----------------------------------------------------
+    def device_positions(self):
+        return jnp.asarray(self.positions)
+
+    def advance(self, slots):
+        """Bump the write position of ``slots`` after a decode step wrote
+        one token each."""
+        for s in slots:
+            if self.positions[s] >= self.max_len:
+                raise RuntimeError(
+                    f"slot {s} overran max_len={self.max_len}")
+            self.positions[s] += 1
+
+    def update(self, k, v):
+        """Adopt the cache arrays a jitted step returned."""
+        self.k, self.v = k, v

@@ -1,0 +1,139 @@
+"""Iteration-level (continuous-batching) request scheduler.
+
+One scheduler iteration == one engine step: first ADMIT queued requests
+into free KV slots (FIFO, at most ``prefill_budget`` prefills per
+iteration so admission can't starve in-flight decode latency), then the
+engine runs ONE slot-batched decode step for everything in flight.  A
+request that finishes (EOS or max_new) retires immediately and its slot
+goes back to the pool, so the next queued request is admitted on the
+very next iteration — mid-flight, without waiting for the rest of the
+batch.  This is the orca/vLLM iteration-level scheduling idea with the
+TPU twist that the step shape never changes (empty slots are masked
+no-ops, not absent).
+
+``gang=True`` turns the same machinery into the static-batching
+baseline twin the serve bench compares against: admission waits until
+EVERY slot is free, then fills the whole pool at once — requests that
+finish early leave their slots idle until the stragglers drain, exactly
+the occupancy collapse continuous batching removes."""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+
+import numpy as np
+
+
+class Request:
+    """One generation request and its lifecycle timestamps."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new, arrival=None, stream=None,
+                 eos_id=None):
+        self.rid = next(self._ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        self.max_new = int(max_new)
+        self.stream = stream
+        self.eos_id = eos_id
+        self.tokens = []          # generated ids, prompt excluded
+        self.slot = None
+        self.finished = False
+        self.finish_reason = None   # "eos" | "max_new"
+        # lifecycle clocks (engine fills these from its monotonic clock)
+        self.t_arrival = arrival
+        self.t_admit = None       # prefill start == queue exit
+        self.t_first = None       # first token produced (prefill end)
+        self.t_done = None
+
+    # -- latency views (None until the corresponding edge has passed) ------
+    @property
+    def queue_wait(self):
+        if self.t_admit is None or self.t_arrival is None:
+            return None
+        return self.t_admit - self.t_arrival
+
+    @property
+    def ttft(self):
+        if self.t_first is None or self.t_arrival is None:
+            return None
+        return self.t_first - self.t_arrival
+
+    @property
+    def tpot(self):
+        """Mean time per output token AFTER the first (the decode-rate
+        metric); 0.0 for single-token requests."""
+        if self.t_done is None or self.t_first is None:
+            return None
+        n = len(self.tokens)
+        return (self.t_done - self.t_first) / (n - 1) if n > 1 else 0.0
+
+    def result(self):
+        return np.asarray(self.tokens, np.int32)
+
+    def __repr__(self):
+        state = ("done" if self.finished
+                 else "running" if self.slot is not None else "queued")
+        return (f"Request(id={self.rid}, prompt={self.prompt.size}, "
+                f"max_new={self.max_new}, {state})")
+
+
+class Scheduler:
+    """FIFO admission over a SlotKVCache pool."""
+
+    def __init__(self, cache, prefill_budget=2, gang=False):
+        if prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be >= 1, got {prefill_budget}")
+        self.cache = cache
+        self.prefill_budget = int(prefill_budget)
+        self.gang = bool(gang)
+        self.queue = deque()
+        self.running = {}           # slot -> Request
+        self.admitted_order = []    # rids in prefill order (FIFO witness)
+
+    def submit(self, request):
+        self.queue.append(request)
+        return request
+
+    @property
+    def idle(self):
+        return not self.queue and not self.running
+
+    def admit(self):
+        """Move queued requests into free slots; returns the admitted
+        [(request, slot)] for the engine to prefill, FIFO order."""
+        out = []
+        if self.gang and self.cache.n_active > 0:
+            return out   # static batching: wait for the batch to drain
+        budget = self.cache.n_slots if self.gang else self.prefill_budget
+        while self.queue and len(out) < budget:
+            req = self.queue[0]
+            slot = self.cache.alloc(owner=req.rid)
+            if slot is None:
+                break
+            self.queue.popleft()
+            req.slot = slot
+            self.running[slot] = req
+            self.admitted_order.append(req.rid)
+            out.append((req, slot))
+        return out
+
+    def retire(self, request, reason):
+        """Release a finished request's slot back to the pool."""
+        slot = request.slot
+        if slot is None or self.running.get(slot) is not request:
+            raise RuntimeError(f"retire of non-running {request!r}")
+        request.finished = True
+        request.finish_reason = reason
+        del self.running[slot]
+        request.slot = None
+        self.cache.free(slot)
+
+    def active_slots(self):
+        return sorted(self.running)

@@ -92,6 +92,41 @@ def test_rmse_mae_ndcg():
     assert metrics.ndcg_at_k([3, 2, 1], [1, 0, 0], k=3) == pytest.approx(1.0)
 
 
+def test_percentile_matches_numpy_and_validates():
+    vals = [5.0, 1.0, 3.0, 2.0, 4.0]
+    for q in (0, 50, 95, 99, 100):
+        assert metrics.percentile(vals, q) == pytest.approx(
+            np.percentile(vals, q))
+    assert np.isnan(metrics.percentile([], 50))
+    with pytest.raises(ValueError):
+        metrics.percentile(vals, 101)
+
+
+def test_latency_stats_summary():
+    s = metrics.latency_stats([0.1, 0.2, 0.3, 0.4], percentiles=(50, 99))
+    assert set(s) == {"p50", "p99", "mean", "max", "count"}
+    assert s["count"] == 4
+    assert s["p50"] == pytest.approx(0.25)
+    assert s["mean"] == pytest.approx(0.25)
+    assert s["max"] == pytest.approx(0.4)
+    # None entries (edge never reached) are dropped, not crashed on
+    s2 = metrics.latency_stats([0.1, None, 0.3])
+    assert s2["count"] == 2
+    empty = metrics.latency_stats([])
+    assert empty["count"] == 0 and np.isnan(empty["p50"])
+
+
+def test_request_latency_summary_keys():
+    records = [{"ttft": 0.05, "tpot": 0.01, "queue_wait": 0.02},
+               {"ttft": 0.07, "tpot": 0.02, "queue_wait": None}]
+    out = metrics.request_latency_summary(records)
+    assert set(out) == {"ttft", "tpot", "queue_wait"}
+    assert out["ttft"]["count"] == 2
+    assert out["queue_wait"]["count"] == 1
+    assert out["ttft"]["p99"] == pytest.approx(
+        np.percentile([0.05, 0.07], 99))
+
+
 # ---------------- logger ----------------
 
 def test_logger_jsonl(tmp_path):

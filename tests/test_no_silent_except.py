@@ -47,6 +47,9 @@ ALLOWED = {
     # is the correctness step; removing a leftover .tmp cannot fail it)
     "graph/checkpoint.py::atomic_write_bytes",
     "resilience/checkpointer.py::save",     # retention prune best-effort
+    "resilience/checkpointer.py::_save_ps_snapshots",  # .tmp cleanup
+    # after the os.replace (or on a failed native save, where the
+    # original error is already propagating)
     "resilience/faults.py::wrapped",        # closing a dead socket (goal)
     "datasets/_io.py::_once",               # .part cleanup post-replace
     "datasets/criteo.py::_cache_key",       # mtime probe, cache key only

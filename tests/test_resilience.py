@@ -231,6 +231,66 @@ def test_restore_latest_without_manifest(tmp_path):
     assert RollingCheckpointManager(tmp_path, keep=2).restore_latest(ex) == 1
 
 
+def test_ps_tables_rewind_with_rollback(tmp_path):
+    """The ROADMAP PS-path gap: host-store embedding rows snapshotted at
+    checkpoint cadence must rewind with the device state — post-fault
+    pushes to the PS table disappear on restore_latest."""
+    from hetu_tpu.ps import EmbeddingTable
+
+    mgr = RollingCheckpointManager(tmp_path, keep=2)
+    tbl = EmbeddingTable(16, 4, optimizer="sgd", lr=1.0, init_scale=0.0)
+    mgr.register_ps_table("emb", tbl)
+    ex, x, y, X, Y, _ = _toy("psr")
+    rng = np.random.default_rng(3)
+    good_rows = rng.standard_normal((16, 4)).astype(np.float32)
+    tbl.set_rows(np.arange(16), good_rows)
+    ex.run("train", feed_dict={x: X, y: Y})
+    mgr.save(ex)
+    good_dev = _params_host(ex)
+    # "post-fault" work: both device params and PS rows move on
+    ex.run("train", feed_dict={x: X, y: Y})
+    tbl.push(np.arange(16), np.ones((16, 4), np.float32))
+    assert not np.allclose(tbl.to_numpy(), good_rows)
+    assert mgr.restore_latest(ex) == 1
+    _assert_bitwise(good_dev, ex.params)
+    np.testing.assert_array_equal(tbl.to_numpy(), good_rows)
+    # snapshot files obey keep-K retention alongside their checkpoints
+    ex.run("train", feed_dict={x: X, y: Y})
+    for _ in range(3):
+        ex._global_step += 1
+        mgr.save(ex)
+    ps_files = [f for f in os.listdir(tmp_path) if "-ps-" in f]
+    assert len(ps_files) == 2
+
+
+def test_torn_ps_snapshot_fails_over_to_older_checkpoint(tmp_path):
+    """A torn PS snapshot invalidates its WHOLE checkpoint candidate:
+    restoring device state from step N with PS rows from step N-1 would
+    silently mix two points in time."""
+    from hetu_tpu.ps import EmbeddingTable
+
+    mgr = RollingCheckpointManager(tmp_path, keep=3)
+    tbl = EmbeddingTable(8, 4, optimizer="sgd", lr=1.0, init_scale=0.0)
+    mgr.register_ps_table("emb", tbl)
+    ex, x, y, X, Y, _ = _toy("pst")
+    rng = np.random.default_rng(4)
+    older_rows = rng.standard_normal((8, 4)).astype(np.float32)
+    tbl.set_rows(np.arange(8), older_rows)
+    ex.run("train", feed_dict={x: X, y: Y})
+    mgr.save(ex)
+    older_dev = _params_host(ex)
+    ex.run("train", feed_dict={x: X, y: Y})
+    tbl.push(np.arange(8), np.ones((8, 4), np.float32))
+    mgr.save(ex)
+    newest = [e for e in mgr.entries()][0]
+    faults.tear_file(os.path.join(tmp_path,
+                                  newest["ps"]["emb"]["file"]), frac=0.5)
+    with pytest.warns(UserWarning, match="skipping bad checkpoint"):
+        assert mgr.restore_latest(ex) == 1
+    _assert_bitwise(older_dev, ex.params)
+    np.testing.assert_array_equal(tbl.to_numpy(), older_rows)
+
+
 def test_preemption_resumes_identical_loss_trajectory(tmp_path):
     """SIGTERM mid-run -> hook flushes a checkpoint -> a FRESH executor
     restores and replays the remaining steps bitwise."""

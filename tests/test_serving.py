@@ -1,0 +1,303 @@
+"""Continuous-batching serving engine invariants (hetu_tpu/serving/).
+
+The contracts pinned here:
+* scheduling never changes WHAT is generated — engine output ==
+  single-request greedy_generate, continuous == static gang twin;
+* the slot pool never leaks across mixed-length request churn;
+* admission is FIFO;
+* a fixed seed reproduces the exact token streams;
+* the two jitted programs trace exactly once (static slot shapes) —
+  the TPU compile-once guarantee the slot design exists for.
+"""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models import (GPTConfig, GPTModel, LlamaConfig,
+                             LlamaForCausalLM)
+from hetu_tpu.serving import InferenceEngine, SlotKVCache
+
+V = 64
+
+
+def _llama(name, seq_len=16):
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=seq_len)
+    model = LlamaForCausalLM(c, name=name)
+    ids = ht.placeholder_op(f"{name}_ids", (1, 4), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    return ex, model
+
+
+def _prompts(rng, n, lo=3, hi=9):
+    return [rng.integers(1, V, (int(L),))
+            for L in rng.integers(lo, hi, n)]
+
+
+# -- slot pool --------------------------------------------------------------
+
+def test_slot_pool_alloc_free_cycle():
+    pool = SlotKVCache(3, layers=2, kv_heads=2, max_len=8, head_dim=4)
+    a, b = pool.alloc(owner=1), pool.alloc(owner=2)
+    assert {a, b} == {0, 1} and pool.n_free == 1
+    pool.free(a)
+    assert pool.n_free == 2 and pool.owner(a) is None
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.free(a)
+    c = pool.alloc()
+    assert c == a    # freed slot is reused
+    assert pool.alloc() is not None
+    assert pool.alloc() is None          # exhausted -> None, not raise
+
+
+def test_slot_pool_position_overrun_raises():
+    pool = SlotKVCache(1, layers=1, kv_heads=1, max_len=2, head_dim=2)
+    s = pool.alloc()
+    pool.advance([s])
+    pool.advance([s])
+    with pytest.raises(RuntimeError, match="overran"):
+        pool.advance([s])
+
+
+# -- output correctness -----------------------------------------------------
+
+def test_engine_matches_single_request_greedy_generate(rng):
+    """Continuous batching is a scheduling change, not a semantics
+    change: every request's tokens equal what the one-shot decoder
+    produces for that prompt alone."""
+    from hetu_tpu.models.llama_decode import greedy_generate
+
+    ex, model = _llama("srv_eq")
+    prompts = _prompts(rng, 6)
+    eng = InferenceEngine(ex, model, n_slots=3, max_len=32,
+                          max_prompt_len=8, name="srv_eq")
+    outs = eng.generate_many(prompts, max_new=6)
+    for p, o in zip(prompts, outs):
+        want = greedy_generate(ex, model, p[None], 6,
+                               name="srv_eq")[0, len(p):]
+        np.testing.assert_array_equal(o, want)
+
+
+def test_gpt_engine_matches_greedy_generate(rng):
+    from hetu_tpu.models.gpt_decode import greedy_generate
+
+    c = GPTConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                  num_heads=4, seq_len=32, dropout_prob=0.0)
+    model = GPTModel(c, name="srv_gpt")
+    ids = ht.placeholder_op("srv_gpt_ids", (1, 4), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    prompts = _prompts(rng, 4)
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=8, name="srv_gpt")
+    outs = eng.generate_many(prompts, max_new=5)
+    for p, o in zip(prompts, outs):
+        want = greedy_generate(ex, model, p[None], 5,
+                               name="srv_gpt")[0, len(p):]
+        np.testing.assert_array_equal(o, want)
+
+
+def test_gang_twin_produces_identical_outputs(rng):
+    """The static-batch twin runs the same programs — only admission
+    differs, so the generated tokens must be identical."""
+    ex, model = _llama("srv_tw")
+    prompts = _prompts(rng, 6)
+    max_news = [int(m) for m in rng.integers(2, 9, 6)]
+
+    def run(gang):
+        e = InferenceEngine(ex, model, n_slots=3, max_len=32,
+                            max_prompt_len=8, name="srv_tw", gang=gang)
+        reqs = [e.submit(p, m) for p, m in zip(prompts, max_news)]
+        e.run(max_iterations=2000)
+        return e, [r.result() for r in reqs]
+
+    e_cont, outs_c = run(False)
+    e_gang, outs_g = run(True)
+    for a, b in zip(outs_c, outs_g):
+        np.testing.assert_array_equal(a, b)
+    # and the continuous schedule is at least as tight (mixed max_new)
+    assert e_cont.decode_steps <= e_gang.decode_steps
+
+
+def test_eos_retires_slot_early(rng):
+    """A request whose decode emits eos_id stops there; the others run
+    to their max_new."""
+    ex, model = _llama("srv_eos")
+    prompts = _prompts(rng, 4)
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=8, name="srv_eos")
+    probe = eng.generate_many(prompts, max_new=8)
+    # pick a token the first request actually emits mid-stream as "EOS"
+    eos = int(probe[0][3])
+    eng2 = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                           max_prompt_len=8, name="srv_eos", eos_id=eos)
+    outs = eng2.generate_many(prompts, max_new=8)
+    for full, out in zip(probe, outs):
+        want = list(full)
+        if eos in want:
+            want = want[:want.index(eos) + 1]
+        np.testing.assert_array_equal(out, np.asarray(want))
+    finished_eos = [r for r in eng2.records
+                    if r["finish_reason"] == "eos"]
+    assert finished_eos, "no request hit the planted EOS"
+    assert eng2.cache.n_free == eng2.cache.n_slots
+
+
+# -- scheduling invariants --------------------------------------------------
+
+def test_fifo_admission_order(rng):
+    """Requests prefill strictly in submission order even as slots churn
+    (prefill_budget=1 so admissions serialize)."""
+    ex, model = _llama("srv_fifo")
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=8, prefill_budget=1,
+                          name="srv_fifo")
+    reqs = [eng.submit(p, int(m)) for p, m in
+            zip(_prompts(rng, 8), rng.integers(1, 9, 8))]
+    eng.run(max_iterations=2000)
+    assert eng.scheduler.admitted_order == [r.rid for r in reqs]
+
+
+def test_no_slot_leak_mixed_churn(rng):
+    """Mixed-length churn through a small pool: every slot returns to
+    the free list, alloc/free balance, and every request finishes."""
+    ex, model = _llama("srv_leak")
+    eng = InferenceEngine(ex, model, n_slots=3, max_len=32,
+                          max_prompt_len=8, name="srv_leak")
+    n = 30
+    reqs = [eng.submit(p, int(m)) for p, m in
+            zip(_prompts(rng, n), rng.integers(1, 13, n))]
+    eng.run(max_iterations=5000)
+    assert all(r.finished for r in reqs)
+    assert eng.cache.n_free == eng.cache.n_slots
+    assert eng.cache.alloc_count == eng.cache.free_count == n
+    assert len(eng.records) == n
+
+
+@pytest.mark.slow
+def test_no_slot_leak_soak_200_requests(rng):
+    """Serving soak: 220 mixed-length requests through 4 slots — the
+    pool must come back fully free with alloc/free balanced, and every
+    request must produce exactly the tokens it asked for (or stop at
+    planted EOS)."""
+    ex, model = _llama("srv_soak")
+    eng = InferenceEngine(ex, model, n_slots=4, max_len=32,
+                          max_prompt_len=8, name="srv_soak", eos_id=V - 1)
+    n = 220
+    max_news = rng.integers(1, 13, n)
+    reqs = [eng.submit(p, int(m)) for p, m in
+            zip(_prompts(rng, n), max_news)]
+    eng.run(max_iterations=50000)
+    assert all(r.finished for r in reqs)
+    assert eng.cache.n_free == eng.cache.n_slots
+    assert eng.cache.alloc_count == eng.cache.free_count == n
+    for r, m in zip(reqs, max_news):
+        assert 1 <= len(r.tokens) <= int(m)
+        if r.finish_reason == "max_new":
+            assert len(r.tokens) == int(m)
+        else:
+            assert r.tokens[-1] == V - 1
+    assert eng.trace_counts == {"prefill": 1, "step": 1}
+
+
+def test_deterministic_under_fixed_seed(rng):
+    """Same trace + same engine seed => identical token streams, both
+    greedy and sampled."""
+    ex, model = _llama("srv_det")
+    prompts = _prompts(rng, 5)
+    for temp in (0.0, 0.8):
+        outs = []
+        for _ in range(2):
+            eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                                  max_prompt_len=8, name="srv_det",
+                                  temperature=temp, top_k=8, seed=7)
+            outs.append(eng.generate_many(prompts, max_new=6))
+        for a, b in zip(*outs):
+            np.testing.assert_array_equal(a, b)
+
+
+# -- compile-once guard -----------------------------------------------------
+
+def test_compile_once_after_warmup(rng):
+    """The slot-batched prefill and decode step are each traced exactly
+    ONCE across prompt lengths, occupancy changes, admissions and
+    retirements — the static-shape contract the slot pool exists for."""
+    ex, model = _llama("srv_c1")
+    eng = InferenceEngine(ex, model, n_slots=3, max_len=32,
+                          max_prompt_len=8, name="srv_c1")
+    # warmup: first request compiles both programs
+    eng.generate_many([_prompts(rng, 1)[0]], 2)
+    assert eng.trace_counts == {"prefill": 1, "step": 1}
+    # churn: varying prompt lengths, batch sizes, max_new
+    n = 12
+    eng.generate_many(_prompts(rng, n), 5)
+    for p, m in zip(_prompts(rng, 3), (1, 4, 9)):
+        eng.submit(p, m)
+    eng.run(max_iterations=2000)
+    assert eng.trace_counts == {"prefill": 1, "step": 1}, \
+        "slot-batched programs retraced after warmup"
+
+
+# -- streaming --------------------------------------------------------------
+
+def test_stream_yields_tokens_incrementally(rng):
+    ex, model = _llama("srv_str")
+    p = _prompts(rng, 1)[0]
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=8, name="srv_str")
+    seen = list(eng.stream(p, max_new=6))
+    assert len(seen) == 6
+    from hetu_tpu.models.llama_decode import greedy_generate
+    want = greedy_generate(ex, model, p[None], 6,
+                           name="srv_str")[0, len(p):]
+    np.testing.assert_array_equal(np.asarray(seen), want)
+
+
+def test_stream_callback_fires_per_token(rng):
+    ex, model = _llama("srv_cb")
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=8, name="srv_cb")
+    got = []
+    req = eng.submit(_prompts(rng, 1)[0], 5,
+                     stream=lambda tok, r: got.append((tok, r.rid)))
+    eng.run(max_iterations=2000)
+    assert [t for t, _ in got] == req.tokens
+    assert {r for _, r in got} == {req.rid}
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_request_records_carry_latencies(rng):
+    ex, model = _llama("srv_met")
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=8, name="srv_met")
+    eng.generate_many(_prompts(rng, 4), 4)
+    assert len(eng.records) == 4
+    for rec in eng.records:
+        assert rec["ttft"] >= 0.0
+        assert rec["queue_wait"] >= 0.0
+        assert rec["ttft"] >= rec["queue_wait"]
+        assert rec["tpot"] >= 0.0
+        assert rec["n_tokens"] == 4
+    occ = eng.stats()["mean_occupancy"]
+    assert 0.0 < occ <= 1.0
+
+
+# -- guard rails ------------------------------------------------------------
+
+def test_oversize_requests_rejected(rng):
+    ex, model = _llama("srv_rej")
+    eng = InferenceEngine(ex, model, n_slots=1, max_len=16,
+                          max_prompt_len=8, name="srv_rej")
+    with pytest.raises(ValueError, match="max_prompt_len"):
+        eng.submit(rng.integers(1, V, (9,)), 2)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(rng.integers(1, V, (8,)), 9)
+    with pytest.raises(ValueError, match="learned-position"):
+        c = GPTConfig(vocab_size=V, hidden_size=32, num_layers=1,
+                      num_heads=4, seq_len=16, dropout_prob=0.0)
+        m = GPTModel(c, name="srv_cap")
+        ids = ht.placeholder_op("srv_cap_ids", (1, 4), dtype=np.int32)
+        ex2 = ht.Executor([m(ids)])
+        InferenceEngine(ex2, m, n_slots=1, max_len=32, name="srv_cap")
