@@ -939,6 +939,57 @@ def _chaos_overhead(steps, check_interval=4):
     return out
 
 
+def _telemetry_on():
+    """Enable the unified runtime telemetry for this process (bench
+    --telemetry): registry + tracer live, plus the /metrics exporter
+    when HETU_METRICS_PORT is set (curl localhost:$PORT/metrics during
+    the run for live executor/prefetch/guard/serving metrics)."""
+    from hetu_tpu import telemetry
+
+    port = os.environ.get("HETU_METRICS_PORT")
+    telemetry.enable(http_port=int(port) if port else None)
+    return telemetry
+
+
+def _telemetry_report():
+    """Registry snapshot + step-phase breakdown for a detail JSON."""
+    from hetu_tpu import telemetry
+
+    return telemetry.report()
+
+
+def run_telemetry_overhead(quick=False, rounds=6):
+    """Measured cost of telemetry-on vs -off on the SAME warmed step
+    (interleaved groups, median of ratios — the chaos-overhead
+    protocol): the proof that the disabled fast path is free and the
+    enabled path is cheap."""
+    import jax
+    from hetu_tpu import telemetry
+
+    steps = 15 if quick else 40
+    ex, batch = _chaos_build("tel")
+    import jax.numpy as jnp
+    feed = {k: jnp.asarray(v) for k, v in batch(0).items()}
+    run = lambda: ex.run("train", feed_dict=feed)     # noqa: E731
+    run()                                             # compile + warm
+    ratios, on_best, off_best = [], 0.0, 0.0
+    for r in range(rounds):
+        telemetry.enable() if r % 2 else telemetry.disable()
+        a = 1.0 / _time_group(run, steps)
+        telemetry.disable() if r % 2 else telemetry.enable()
+        b = 1.0 / _time_group(run, steps)
+        on, off = (a, b) if r % 2 else (b, a)
+        ratios.append(on / off)
+        on_best, off_best = max(on_best, on), max(off_best, off)
+    telemetry.disable()
+    ratio = sorted(ratios)[len(ratios) // 2]
+    return {"metric": "telemetry_overhead",
+            "telemetry_on_steps_per_sec": round(on_best, 2),
+            "telemetry_off_steps_per_sec": round(off_best, 2),
+            "overhead_frac": round(max(0.0, 1.0 - ratio), 4),
+            "platform": jax.default_backend(), "steps": steps}
+
+
 def run_chaos(quick=False, seed=0):
     import tempfile
     import jax
@@ -985,6 +1036,9 @@ def _emit_chaos(out):
                              f"{v['faults_injected']}"
                           for k, v in out["stages"].items()},
                "detail": os.path.basename(CHAOS_DETAIL_PATH)}
+    if "telemetry_overhead" in out:
+        compact["telemetry_overhead_frac"] = \
+            out["telemetry_overhead"]["overhead_frac"]
     print(json.dumps(compact), flush=True)
 
 
@@ -1140,6 +1194,9 @@ def _emit_serve(out):
                "tpot_s": {"p50": lat_c["tpot"]["p50"],
                           "p99": lat_c["tpot"]["p99"]},
                "detail": os.path.basename(SERVE_DETAIL_PATH)}
+    if "telemetry_overhead" in out:
+        compact["telemetry_overhead_frac"] = \
+            out["telemetry_overhead"]["overhead_frac"]
     print(json.dumps(compact), flush=True)
 
 
@@ -1172,7 +1229,8 @@ DETAIL_PATH = os.environ.get(
                  "BENCH_FULL.json"))
 
 
-def _emit(results, cpu_fallback=False, budget_note=None):
+def _emit(results, cpu_fallback=False, budget_note=None,
+          telemetry_overhead=None):
     """Emit the round's evidence in layers sized to the driver's
     ~2000-byte stdout tail (ADVICE r5: the full 8-stage headline
     overflows it and r05 parsed null).  Called after EVERY stage, so any
@@ -1196,6 +1254,8 @@ def _emit(results, cpu_fallback=False, budget_note=None):
         headline["platform"] = "cpu_fallback_tunnel_down"
     if budget_note:
         headline["budget"] = budget_note
+    if telemetry_overhead is not None:
+        headline["telemetry_overhead"] = telemetry_overhead
     full = json.dumps(headline)
     # Never clobber BENCH_FULL.json with the all-PENDING placeholder: the
     # second-0 emit (and an aborted run that never finishes a stage) must
@@ -1226,12 +1286,26 @@ def _emit(results, cpu_fallback=False, budget_note=None):
         compact["platform"] = "cpu_fallback_tunnel_down"
     if budget_note:
         compact["budget"] = budget_note
+    if telemetry_overhead is not None:
+        compact["telemetry_overhead_frac"] = telemetry_overhead.get(
+            "overhead_frac")
     compact["detail"] = os.path.basename(DETAIL_PATH)
     print(json.dumps(compact), flush=True)
 
 
 def main():
     quick = "--quick" in sys.argv
+    telemetry_on = "--telemetry" in sys.argv
+    if "--telemetry-overhead" in sys.argv:
+        # measured-overhead twin as its own child process (the parent
+        # never touches jax in stage mode)
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        quick = quick or jax.default_backend() == "cpu"
+        print(json.dumps(run_telemetry_overhead(quick)), flush=True)
+        return
     if "--chaos" in sys.argv:
         # chaos mode runs in-process (small shapes; no per-stage HBM
         # pressure): inject faults mid-stage, report recovery + guard
@@ -1241,7 +1315,13 @@ def main():
             jax.config.update("jax_platforms",
                               os.environ["JAX_PLATFORMS"])
         quick = quick or jax.default_backend() == "cpu"
-        _emit_chaos(run_chaos(quick))
+        if telemetry_on:
+            _telemetry_on()
+        out = run_chaos(quick)
+        if telemetry_on:
+            out["telemetry"] = _telemetry_report()
+            out["telemetry_overhead"] = run_telemetry_overhead(quick)
+        _emit_chaos(out)
         return
     if "--serve" in sys.argv:
         # serve mode runs in-process (small decode shapes): replay the
@@ -1251,7 +1331,13 @@ def main():
             jax.config.update("jax_platforms",
                               os.environ["JAX_PLATFORMS"])
         quick = quick or jax.default_backend() == "cpu"
-        _emit_serve(run_serve(quick))
+        if telemetry_on:
+            _telemetry_on()
+        out = run_serve(quick)
+        if telemetry_on:
+            out["telemetry"] = _telemetry_report()
+            out["telemetry_overhead"] = run_telemetry_overhead(quick)
+        _emit_serve(out)
         return
     if "--stage" in sys.argv:
         # only stage children may touch jax: the backend check in the
@@ -1266,7 +1352,13 @@ def main():
                               os.environ["JAX_PLATFORMS"])
         quick = quick or jax.default_backend() == "cpu"
         stage = sys.argv[sys.argv.index("--stage") + 1]
-        print(json.dumps(STAGES[stage](quick)))
+        if telemetry_on:
+            _telemetry_on()
+            out = STAGES[stage](quick)
+            out["telemetry"] = _telemetry_report()
+        else:
+            out = STAGES[stage](quick)
+        print(json.dumps(out))
         return
     # each stage in its own process: ours + the flax baseline together
     # exceed one chip's HBM at the BERT headline shapes, and a fresh
@@ -1322,6 +1414,8 @@ def main():
         cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage]
         if quick:
             cmd.append("--quick")
+        if telemetry_on:
+            cmd.append("--telemetry")
         for attempt in (0, 1):
             # per-attempt timeout clamped to the REMAINING budget: a
             # WEDGED dev tunnel (observed: the relay dies and device
@@ -1348,13 +1442,34 @@ def main():
             results[stage] = {"metric": stage, "value": None,
                               "unit": "FAILED", "vs_baseline": None}
         _emit(results, cpu_fallback)
+    overhead = None
+    if telemetry_on and budget - (time.time() - t0) > 60:
+        # the measured-overhead line: telemetry-on vs -off twin in its
+        # own child (same platform selection as the stages)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--telemetry-overhead"]
+        if quick:
+            cmd.append("--quick")
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, env=env,
+                timeout=min(600, max(60, budget - (time.time() - t0))))
+            if proc.returncode == 0:
+                overhead = json.loads(
+                    proc.stdout.strip().splitlines()[-1])
+                print(json.dumps(overhead), flush=True)
+            else:
+                sys.stderr.write(proc.stderr[-2000:])
+        except subprocess.TimeoutExpired:
+            sys.stderr.write("telemetry-overhead twin timed out\n")
     elapsed = round(time.time() - t0, 1)
     skipped = [s for s in STAGE_ORDER
                if results[s].get("unit") == "SKIPPED_BUDGET"]
     _emit(results, cpu_fallback,
           {"budget_s": budget, "elapsed_s": elapsed,
            "skipped_stages": skipped} if skipped else
-          {"budget_s": budget, "elapsed_s": elapsed})
+          {"budget_s": budget, "elapsed_s": elapsed},
+          telemetry_overhead=overhead)
 
 
 if __name__ == "__main__":

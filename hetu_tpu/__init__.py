@@ -26,6 +26,7 @@ from . import resilience
 from .resilience import (CheckpointError, GuardTripped,
                          RollingCheckpointManager, StepGuard, retry)
 from . import metrics
+from . import telemetry
 from .dataloader import Dataloader, DataloaderOp, dataloader_op
 from .datasets.prefetch import DevicePrefetcher, prefetch_feeds
 from .logger import HetuLogger, WandbLogger

@@ -28,8 +28,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
+
+from .. import telemetry as _telemetry
 
 _STOP = ("stop", None)
 
@@ -63,6 +66,26 @@ class DevicePrefetcher:
         self._thread = None
         self._stop = threading.Event()
         self._exhausted = False
+        # runtime telemetry: queue depth was invisible through three
+        # bench rounds ("does the producer keep up?") — now it's a live
+        # gauge, with wait-time counters on both sides of the queue
+        reg = _telemetry.get_registry()
+        self._m_depth = reg.gauge(
+            "hetu_prefetch_queue_depth",
+            "Device batches ready ahead of the consumer")
+        self._m_consumer_wait = reg.counter(
+            "hetu_prefetch_consumer_wait_seconds_total",
+            "Time the training loop spent waiting on the prefetch queue")
+        self._m_producer_wait = reg.counter(
+            "hetu_prefetch_producer_wait_seconds_total",
+            "Time the producer thread spent blocked on a full queue")
+        self._m_starved = reg.counter(
+            "hetu_prefetch_starvation_total",
+            "Consumer arrivals that found the queue empty (producer "
+            "behind — the input pipeline is on the critical path)")
+        self._m_batches = reg.counter(
+            "hetu_prefetch_batches_total", "Batches handed to consumers")
+        self._tr = _telemetry.get_tracer()
 
     # -- leaf placement ---------------------------------------------------
     @staticmethod
@@ -97,9 +120,11 @@ class DevicePrefetcher:
 
     # -- producer ---------------------------------------------------------
     def _enqueue(self, item):
+        t0 = time.perf_counter()
         while not self._stop.is_set():
             try:
                 self._queue.put(item, timeout=0.1)
+                self._m_producer_wait.inc(time.perf_counter() - t0)
                 return
             except queue.Full:
                 continue
@@ -137,39 +162,53 @@ class DevicePrefetcher:
         if self._exhausted:
             raise StopIteration
         if self.sync:
-            try:
-                return self._put(next(self._it))
-            except StopIteration:
-                self._exhausted = True
-                raise
+            # sync fallback: the iterator pull is data_wait, the
+            # device_put is an honest host->device phase of its own
+            with self._tr.span("data_wait"):
+                try:
+                    batch = next(self._it)
+                except StopIteration:
+                    self._exhausted = True
+                    raise
+            with self._tr.span("prefetch_h2d"):
+                dev = self._put(batch)
+            self._m_batches.inc()
+            return dev
         self.start()
+        if self._queue.empty():
+            self._m_starved.inc()
+        t0 = time.perf_counter()
         # bounded wait + liveness check: a producer that died WITHOUT
         # enqueuing a sentinel (killed worker, OOM, SystemExit escaping
         # the except Exception) must surface here within one step, not
         # hang the training loop forever on queue.get()
-        while True:
-            try:
-                kind, val = self._queue.get(timeout=0.2)
-                break
-            except queue.Empty:
-                t = self._thread
-                if t is not None and t.is_alive():
-                    continue
-                try:    # it may have enqueued between timeout and check
-                    kind, val = self._queue.get_nowait()
+        with self._tr.span("data_wait"):
+            while True:
+                try:
+                    kind, val = self._queue.get(timeout=0.2)
                     break
                 except queue.Empty:
-                    self._exhausted = True
-                    raise RuntimeError(
-                        "prefetch producer thread died without a result "
-                        "or error sentinel (killed worker?) — restart "
-                        "the prefetcher to resume") from None
+                    t = self._thread
+                    if t is not None and t.is_alive():
+                        continue
+                    try:  # it may have enqueued between timeout and check
+                        kind, val = self._queue.get_nowait()
+                        break
+                    except queue.Empty:
+                        self._exhausted = True
+                        raise RuntimeError(
+                            "prefetch producer thread died without a "
+                            "result or error sentinel (killed worker?) — "
+                            "restart the prefetcher to resume") from None
+        self._m_consumer_wait.inc(time.perf_counter() - t0)
+        self._m_depth.set(self._queue.qsize())
         if kind == "stop":
             self._exhausted = True
             raise StopIteration
         if kind == "err":
             self._exhausted = True
             raise val
+        self._m_batches.inc()
         return val
 
     next_batch = __next__    # Dataloader-style alias

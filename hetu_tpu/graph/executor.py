@@ -30,6 +30,7 @@ import numpy as np
 from .node import (Op, PlaceholderOp, VariableOp, find_topo_sort,
                    graph_variables)
 from .trace import TraceContext, evaluate
+from .. import telemetry as _telemetry
 
 
 class SubExecutor:
@@ -95,6 +96,29 @@ class SubExecutor:
         self._monitor_interval = int(
             executor.config.get("monitor_interval", 200))
         self._runs = 0  # per-subgraph step count (monitor poll schedule)
+        # runtime telemetry (telemetry/): instruments are near-free
+        # no-ops until telemetry.enable() — the step path carries them
+        # unconditionally (cost pinned by tests/test_telemetry.py)
+        reg = _telemetry.get_registry()
+        self._m_steps = reg.counter(
+            "hetu_executor_steps_total",
+            "Executor steps dispatched (run() calls + run_steps inner "
+            "steps)", labels=("subgraph",)).labels(subgraph=name)
+        self._m_step_time = reg.histogram(
+            "hetu_executor_step_seconds",
+            "Wall time of one run() call (feed prep + dispatch + guard "
+            "check; device completion is asynchronous)",
+            labels=("subgraph",)).labels(subgraph=name)
+        self._m_multi = reg.counter(
+            "hetu_executor_run_steps_calls_total",
+            "run_steps() multi-step dispatches",
+            labels=("subgraph",)).labels(subgraph=name)
+        self._m_retrace = reg.counter(
+            "hetu_executor_retraces_total",
+            "Step-program (re)traces — >1 per subgraph after warmup "
+            "means a shape/dtype change recompiled the step",
+            labels=("subgraph",)).labels(subgraph=name)
+        self._tr = _telemetry.get_tracer()
 
     def ps_synchronize(self):
         """Wait for all in-flight PS pushes (call before reading tables
@@ -181,6 +205,9 @@ class SubExecutor:
         needs_rng = any(getattr(n, "needs_rng", False) for n in topo)
 
         def step_fn(params, opt_state, feeds, base_key, step):
+            # host-side retrace witness: runs at TRACE time only, so the
+            # counter ticks once per compiled program variant
+            self._m_retrace.inc()
             # the per-step key derives INSIDE the program from a
             # device-resident step counter — an eager fold_in per run()
             # would dispatch a separate device op each step (several ms
@@ -298,14 +325,33 @@ class SubExecutor:
         return feeds
 
     def run(self, feed_dict=None, convert_to_numpy_ret_vals=False):
+        if not _telemetry.enabled():
+            return self._run_impl(feed_dict, convert_to_numpy_ret_vals)
+        t0 = time.perf_counter()
+        try:
+            return self._run_impl(feed_dict, convert_to_numpy_ret_vals)
+        finally:
+            self._m_steps.inc()
+            self._m_step_time.observe(time.perf_counter() - t0)
+
+    def _run_impl(self, feed_dict, convert_to_numpy_ret_vals):
         if self._jitted is None:
             self._build()
         ex = self.executor
-        if self._fast_feed is not None:
-            feeds = self._fast_resolve(feed_dict)
-            if feeds is not None:
-                return self._dispatch(ex, feeds, None,
-                                      convert_to_numpy_ret_vals)
+        # "h2d" phase: everything between entry and the jitted call —
+        # feed canonicalization, casts, uploads, PS row gathers
+        with self._tr.span("h2d"):
+            ps_ids = None
+            feeds = (self._fast_resolve(feed_dict)
+                     if self._fast_feed is not None else None)
+            if feeds is None:
+                feeds, ps_ids = self._slow_feeds(feed_dict)
+        return self._dispatch(ex, feeds, ps_ids,
+                              convert_to_numpy_ret_vals)
+
+    def _slow_feeds(self, feed_dict):
+        """Full per-call feed canonicalization walk; returns
+        ``(feeds, ps_ids)`` and may arm the fast path for next step."""
         feeds = {}
         feed_dict = feed_dict or {}
         for node, value in feed_dict.items():
@@ -384,8 +430,7 @@ class SubExecutor:
                 feeds[p.name] = v.astype(want)
         self._arm_fast(feed_dict, feeds, names, dtypes, auto_names,
                        all_device)
-        return self._dispatch(ex, feeds, ps_ids,
-                              convert_to_numpy_ret_vals)
+        return feeds, ps_ids
 
     def _arm_fast(self, feed_dict, feeds, names, dtypes, auto_names,
                   all_device):
@@ -414,8 +459,13 @@ class SubExecutor:
         if ex._step_arr is None:
             ex._step_arr = jnp.uint32(ex._global_step)
         ex._global_step += 1
-        vals, new_params, new_opt_state, ex._step_arr = self._jitted(
-            ex.params, ex.opt_state, feeds, ex._base_key, ex._step_arr)
+        # "dispatch" phase: the jitted call itself — asynchronous on
+        # accelerators, so time spent HERE past the enqueue cost is
+        # runtime back-pressure (in-flight queue full ≈ device-bound)
+        with self._tr.span("dispatch"):
+            vals, new_params, new_opt_state, ex._step_arr = self._jitted(
+                ex.params, ex.opt_state, feeds, ex._base_key,
+                ex._step_arr)
         ex.params = new_params
         ex.opt_state = new_opt_state
         # guard sentinel scalars ride as the two trailing hidden outputs
@@ -463,7 +513,8 @@ class SubExecutor:
         if guard_out is not None:
             # after PS pushes so a rollback can't orphan in-flight grads;
             # may restore executor state or raise GuardTripped (abort)
-            guard.on_step(ex, guard_out[0], guard_out[1])
+            with self._tr.span("guard_check"):
+                guard.on_step(ex, guard_out[0], guard_out[1])
         if convert_to_numpy_ret_vals:
             vals = [None if v is None else np.asarray(v) for v in vals]
         return vals
@@ -528,34 +579,58 @@ class SubExecutor:
             step_fn = self._step_fn
             donate = ((0, 1, 4) if self.training
                       and self._should_donate() else (4,))
+            # guard state at build time matches _build's: attach/detach
+            # invalidate both compiled programs together
+            guarded = ex.config.get("step_guard") is not None
 
             def multi_fn(params, opt_state, feeds, base_key, step,
                          n_steps):
+                # per-inner-step guard-trip accounting: the sentinel of
+                # every inner step accumulates into a carried counter,
+                # so trips are EXACT across the fori_loop instead of
+                # detected only at the call boundary (ROADMAP item).
+                # vals[-2] is the step's fused gfin sentinel.
                 def body(_, carry):
-                    params, opt_state, step = carry
-                    _, params, opt_state, step = step_fn(
+                    params, opt_state, step, trips = carry
+                    vals, params, opt_state, step = step_fn(
                         params, opt_state, feeds, base_key, step)
-                    return (params, opt_state, step)
+                    if guarded:
+                        trips = trips + jnp.where(vals[-2], 0, 1).astype(
+                            jnp.int32)
+                    return (params, opt_state, step, trips)
 
-                params, opt_state, step = jax.lax.fori_loop(
-                    0, n_steps - 1, body, (params, opt_state, step))
+                params, opt_state, step, trips = jax.lax.fori_loop(
+                    0, n_steps - 1, body,
+                    (params, opt_state, step, jnp.int32(0)))
                 # last step outside the loop so its values are returned
-                return step_fn(params, opt_state, feeds, base_key, step)
+                vals, params, opt_state, step = step_fn(
+                    params, opt_state, feeds, base_key, step)
+                if guarded:
+                    trips = trips + jnp.where(vals[-2], 0, 1).astype(
+                        jnp.int32)
+                return vals, params, opt_state, step, trips
 
             self._multi_jitted = jax.jit(multi_fn, donate_argnums=donate)
         if ex._step_arr is None:
             ex._step_arr = jnp.uint32(ex._global_step)
         ex._global_step += n
-        vals, ex.params, ex.opt_state, ex._step_arr = self._multi_jitted(
-            ex.params, ex.opt_state, feeds, ex._base_key, ex._step_arr,
-            jnp.int32(n))
+        with self._tr.span("dispatch"):
+            (vals, ex.params, ex.opt_state, ex._step_arr,
+             trips_arr) = self._multi_jitted(
+                ex.params, ex.opt_state, feeds, ex._base_key,
+                ex._step_arr, jnp.int32(n))
+        self._m_steps.inc(n)
+        self._m_multi.inc()
         guard = ex.config.get("step_guard")
         if guard is not None:
             # the returned sentinel covers the FINAL inner step; the
-            # 'skip' policy's in-graph select still protects every inner
-            # step, and rollback/abort detect at the call boundary
+            # carried counter reports every inner step's trip exactly
+            # (the 'skip' policy's in-graph select still protects every
+            # inner step; rollback/abort act at the call boundary)
             guard_out, vals = vals[-2:], vals[:-2]
-            guard.on_step(ex, guard_out[0], guard_out[1], n=n)
+            with self._tr.span("guard_check"):
+                guard.on_step(ex, guard_out[0], guard_out[1], n=n,
+                              inner_trips=trips_arr)
         self._runs += n
         if self._monitor_vars:
             self.check_monitors()

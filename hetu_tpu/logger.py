@@ -10,13 +10,23 @@ runs are always recorded.
 
 from __future__ import annotations
 
-import json
-import os
 import time
+
+from .telemetry.registry import JsonlWriter
 
 
 class HetuLogger:
-    """Scalar logger: accumulate per-step values, emit per-interval means."""
+    """Scalar logger: accumulate per-step values, emit per-interval means.
+
+    JSONL records go through :class:`telemetry.registry.JsonlWriter` —
+    the one append-a-JSON-line path in the tree — and the elapsed
+    ``time`` field is monotonic (``perf_counter``), so a wall-clock jump
+    (NTP step mid-run) can't produce negative intervals.  Context-
+    manager use closes the file deterministically::
+
+        with HetuLogger(path="run.jsonl") as lg:
+            lg.log(loss=...)
+    """
 
     def __init__(self, path=None, print_interval=10, printer=print):
         self.path = path
@@ -24,8 +34,8 @@ class HetuLogger:
         self.printer = printer
         self._acc = {}
         self._step = 0
-        self._t0 = time.time()
-        self._file = open(path, "a") if path else None
+        self._t0 = time.perf_counter()
+        self._writer = JsonlWriter(path) if path else None
 
     def log(self, **scalars):
         self._step += 1
@@ -39,21 +49,26 @@ class HetuLogger:
             return
         means = {k: sum(v) / len(v) for k, v in self._acc.items()}
         rec = {"step": self._step,
-               "time": round(time.time() - self._t0, 3), **means}
+               "time": round(time.perf_counter() - self._t0, 3), **means}
         if self.printer is not None:
             self.printer(" ".join(
                 [f"step {self._step}"]
                 + [f"{k}={v:.6g}" for k, v in means.items()]))
-        if self._file is not None:
-            self._file.write(json.dumps(rec) + "\n")
-            self._file.flush()
+        if self._writer is not None:
+            self._writer.write(rec)
         self._acc = {}
 
     def close(self):
         self.flush()
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class WandbLogger(HetuLogger):

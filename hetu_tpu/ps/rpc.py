@@ -383,6 +383,15 @@ class RemoteTable:
         self._closed = False
         self.last_pong = None
         self._hb_thread = None
+        from .. import telemetry as _telemetry
+        reg = _telemetry.get_registry()
+        self._m_retries = reg.counter(
+            "hetu_ps_rpc_retries_total",
+            "RPC attempts retransmitted after a transport failure",
+            labels=("verb",))
+        self._m_reconnects = reg.counter(
+            "hetu_ps_rpc_reconnects_total",
+            "Sockets torn down after an error (next attempt reconnects)")
         if fetch_meta:
             meta = self._call({"verb": "meta"})[0]
             self.rows, self.dim = meta["rows"], meta["dim"]
@@ -461,14 +470,17 @@ class RemoteTable:
                     except OSError:
                         pass    # already torn down; reconnect handles it
                     conn.sock = None
+                    self._m_reconnects.inc()
                 raise
 
+        retries = self._m_retries.labels(verb=header.get("verb", ""))
         try:
             reply, payloads = retry(
                 _attempt, deadline=self._deadline, backoff=0.05,
                 factor=2.0, max_backoff=2.0,
                 retry_on=(ConnectionError, socket.timeout, OSError),
-                giveup=lambda e: self._closed)
+                giveup=lambda e: self._closed,
+                on_retry=lambda e, attempt, pause: retries.inc())
         except (ConnectionError, socket.timeout, OSError) as e:
             if self._closed:
                 raise

@@ -29,11 +29,13 @@ import json
 import os
 import pickle
 import signal
+import time
 import warnings
 import zlib
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from ..graph.checkpoint import (CheckpointError, atomic_write_bytes,
                                 validate_state)
 
@@ -65,6 +67,16 @@ class RollingCheckpointManager:
         # host-store embedding tables (ps/store.py) snapshotted alongside
         # every checkpoint; anything with .save(path)/.load(path) works
         self.ps_tables = dict(ps_tables or {})
+        reg = _telemetry.get_registry()
+        self._m_saves = reg.counter(
+            "hetu_checkpoint_saves_total", "Rolling checkpoints written")
+        self._m_save_time = reg.histogram(
+            "hetu_checkpoint_save_seconds",
+            "Wall time of one rolling checkpoint save (incl. PS "
+            "snapshots + manifest + retention)")
+        self._m_restore_time = reg.histogram(
+            "hetu_checkpoint_restore_seconds",
+            "Wall time of restore_latest (incl. verify + fallbacks)")
 
     def register_ps_table(self, name, table):
         """Snapshot ``table`` (``save(path)``/``load(path)``, e.g. a
@@ -152,6 +164,7 @@ class RollingCheckpointManager:
     def save(self, executor, step=None):
         """Atomically checkpoint the executor (plus any registered PS
         tables); returns the file path."""
+        t0 = time.perf_counter()
         state = executor.state_dict()
         if step is None:
             step = int(state.get("global_step", 0))
@@ -182,6 +195,8 @@ class RollingCheckpointManager:
                     pass    # already gone / shared-fs race: retention is
                     # best-effort, correctness lives in the manifest
         self.last_saved_step = int(step)
+        self._m_saves.inc()
+        self._m_save_time.observe(time.perf_counter() - t0)
         return path
 
     def maybe_save(self, executor, every):
@@ -257,6 +272,7 @@ class RollingCheckpointManager:
         step.  Torn, corrupt, structurally invalid, or (by default)
         non-finite checkpoints are skipped with a warning; raises
         :class:`CheckpointError` when nothing survives."""
+        t0 = time.perf_counter()
         tried = []
         for entry in self.entries():
             path = os.path.join(self.directory, entry["file"])
@@ -271,6 +287,7 @@ class RollingCheckpointManager:
             executor.load_state_dict(state)
             for nm, ps_path in ps_paths.items():
                 self.ps_tables[nm].load(ps_path)
+            self._m_restore_time.observe(time.perf_counter() - t0)
             return int(state["global_step"])
         detail = ("; ".join(tried) if tried
                   else "directory has no checkpoints")

@@ -45,6 +45,8 @@ import warnings
 
 import numpy as np
 
+from .. import telemetry as _telemetry
+
 
 class GuardTripped(RuntimeError):
     """The step guard detected a fault it was told not to absorb."""
@@ -84,12 +86,25 @@ class StepGuard:
         self.defer = bool(defer)
         self.check_interval = max(1, int(check_interval))
         self.max_rollbacks = int(max_rollbacks)
-        self._pending = collections.deque()  # (step, ok_arr, loss_arr, n)
+        # (step, ok_arr, loss_arr, n, inner_trips_arr_or_None)
+        self._pending = collections.deque()
         self._ema = None
         self._executor = None
         self.stats = {"steps": 0, "nonfinite": 0, "spikes": 0,
-                      "skipped": 0, "rollbacks": 0, "trip_steps": [],
-                      "restored_steps": []}
+                      "skipped": 0, "rollbacks": 0, "inner_trips": 0,
+                      "trip_steps": [], "restored_steps": []}
+        reg = _telemetry.get_registry()
+        self._m_trips = reg.counter(
+            "hetu_guard_trips_total",
+            "StepGuard trips (non-finite sentinel or loss spike)",
+            labels=("policy",)).labels(policy=policy)
+        self._m_rollbacks = reg.counter(
+            "hetu_guard_rollbacks_total",
+            "Checkpoint rollbacks executed by the guard")
+        self._m_inner = reg.counter(
+            "hetu_guard_inner_trips_total",
+            "Per-inner-step trips counted through the run_steps "
+            "fori_loop carry (exact, not call-boundary)")
 
     # -- wiring ------------------------------------------------------------
     def attach(self, executor):
@@ -129,13 +144,16 @@ class StepGuard:
                 "(pipeline executors are not guarded yet)")
 
     # -- per-step hook (called by SubExecutor) -----------------------------
-    def on_step(self, executor, ok_arr, loss_arr, n=1):
+    def on_step(self, executor, ok_arr, loss_arr, n=1, inner_trips=None):
         """Receive the step's DEVICE sentinel scalars.  Materialization
         is deferred per ``defer``/``check_interval`` (see module doc);
         a trip executes the policy — which may raise ``GuardTripped`` or
-        restore executor state in place."""
+        restore executor state in place.  ``inner_trips``: run_steps'
+        carried per-inner-step trip count (device scalar), materialized
+        alongside the sentinel into ``stats['inner_trips']``."""
         self._executor = executor
-        self._pending.append((executor._global_step, ok_arr, loss_arr, n))
+        self._pending.append((executor._global_step, ok_arr, loss_arr, n,
+                              inner_trips))
         keep = 1 if self.defer else 0
         if len(self._pending) >= self.check_interval + keep:
             while len(self._pending) > keep:
@@ -150,10 +168,15 @@ class StepGuard:
         return self.stats
 
     # -- internals ---------------------------------------------------------
-    def _process(self, step, ok_arr, loss_arr, n):
+    def _process(self, step, ok_arr, loss_arr, n, inner_trips=None):
         ok = bool(np.asarray(ok_arr))
         loss = float(np.asarray(loss_arr))
         self.stats["steps"] += int(n)
+        if inner_trips is not None:
+            trips = int(np.asarray(inner_trips))
+            self.stats["inner_trips"] += trips
+            if trips:
+                self._m_inner.inc(trips)
         if not ok:
             self.stats["nonfinite"] += 1
             self._trip("non-finite loss or parameter update", step, loss)
@@ -173,6 +196,7 @@ class StepGuard:
 
     def _trip(self, reason, step, loss):
         self.stats["trip_steps"].append(int(step))
+        self._m_trips.inc()
         if self.policy == "abort":
             raise GuardTripped(reason, step, loss)
         if self.policy == "skip":
@@ -196,6 +220,7 @@ class StepGuard:
         self._ema = None
         restored = self.manager.restore_latest(self._executor)
         self.stats["rollbacks"] += 1
+        self._m_rollbacks.inc()
         self.stats["restored_steps"].append(int(restored))
         warnings.warn(
             f"StepGuard rolled back: {reason} at step {step}; restored "

@@ -14,8 +14,12 @@ model into exactly TWO jitted programs whose shapes never change:
   their outputs are discarded host-side.
 
 Because every call sees identical shapes, XLA compiles each program
-once; ``trace_counts`` exposes the engine's own retrace counters and
-the compile-once test pins them at 1 after warmup.
+once — and the compiled pair is SHARED across engine instances with the
+same (model, sampling) signature, so twins/rebuilds reuse the same
+executable (no recompile, and bitwise-identical token streams across
+engines — XLA:CPU recompiles of the same program are not bit-stable).
+``trace_counts`` exposes the shared retrace counters; the compile-once
+test pins them at 1 after warmup.
 
 The scheduler (scheduler.py) interleaves admission-prefill with decode
 at iteration granularity, and the slot pool (kv_cache.py) recycles a
@@ -42,6 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry as _telemetry
 from ..models._decode_common import make_picker, param_prefix, pad_prompts
 from .adapters import adapter_for
 from .kv_cache import SlotKVCache
@@ -84,51 +89,116 @@ class InferenceEngine:
                                    prefill_budget=prefill_budget,
                                    gang=gang)
         self.eos_id = eos_id
+        self._sampling = (float(temperature), int(top_k))
         self._pick = make_picker(temperature, top_k)
         self._key = jax.random.key(seed)
         self._last_tokens = np.zeros(n_slots, np.int32)
         # per-request latency records + per-iteration occupancy log
+        # (the per-request API; the registry mirrors below are the LIVE
+        # surface — same numbers, scrapeable mid-run via /metrics)
         self.records = []
         self.occupancy = []
         self.decode_steps = 0
         self.prefills = 0
-        self._prefill_traces = 0
-        self._step_traces = 0
+        mode = "gang" if gang else "continuous"
+        reg = _telemetry.get_registry()
+
+        def _m(kind, name, help, **kw):
+            return getattr(reg, kind)(name, help, labels=("scheduler",),
+                                      **kw).labels(scheduler=mode)
+
+        self._m_occ = _m("gauge", "hetu_serving_slot_occupancy",
+                         "Active-slot fraction of the last decode "
+                         "iteration")
+        self._m_tokens = _m("counter", "hetu_serving_tokens_total",
+                            "Generated tokens emitted")
+        self._m_prefill_iters = _m(
+            "counter", "hetu_serving_prefill_total",
+            "Prompt prefills run (admissions)")
+        self._m_decode_iters = _m(
+            "counter", "hetu_serving_decode_iterations_total",
+            "Slot-batched decode iterations run")
+        self._m_finished = _m("counter", "hetu_serving_requests_total",
+                              "Requests retired (eos or max_new)")
+        self._m_ttft = _m("histogram", "hetu_serving_ttft_seconds",
+                          "Time to first token (arrival -> first emit)")
+        self._m_tpot = _m("histogram", "hetu_serving_tpot_seconds",
+                          "Mean time per output token after the first")
+        self._m_qwait = _m("histogram", "hetu_serving_queue_wait_seconds",
+                           "Arrival -> slot admission wait")
+        self._tr = _telemetry.get_tracer()
         self._build()
 
     # -- jitted programs ---------------------------------------------------
+    # ONE compiled (prefill, step) pair per (adapter signature, sampling)
+    # in the process, shared across engine instances.  Two reasons:
+    # * the gang twin and any engine rebuild reuse the executable
+    #   instead of recompiling it (the serve bench builds two engines);
+    # * XLA:CPU compilation is not bitwise-reproducible across compiles
+    #   of the same program in one process (observed: near-tie argmax
+    #   flips between two freshly-built engines on identical inputs,
+    #   tier-1 flakes in the serving determinism/twin tests), so "the
+    #   twin runs the same programs" must mean the same EXECUTABLE, not
+    #   a byte-equivalent recompile.
+    _PROGRAMS = {}
+
+    def _program_key(self):
+        cfg = tuple(sorted((k, repr(v)) for k, v in
+                           vars(self.adapter.config).items()))
+        return (type(self.adapter).__name__, self.adapter.name, cfg,
+                self._sampling, jax.default_backend())
+
     def _build(self):
-        adapter, pick = self.adapter, self._pick
+        entry = self._PROGRAMS.get(self._program_key())
+        if entry is None:
+            adapter, pick = self.adapter, self._pick
+            from .. import telemetry as _tel
+            retrace = _tel.get_registry().counter(
+                "hetu_serving_retraces_total",
+                "Times each jitted serving program was traced — >1 "
+                "after warmup breaks the compile-once contract",
+                labels=("program",))
+            traces = {"prefill": 0, "step": 0}
 
-        def prefill(params, k, v, prompt, p_len, slot, key):
-            self._prefill_traces += 1      # host-side retrace witness
-            logits, kn, vn = adapter.prefill(params, prompt)
-            k = jax.lax.dynamic_update_slice(k, kn[None],
-                                             (slot, 0, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(v, vn[None],
-                                             (slot, 0, 0, 0, 0))
-            row = jax.lax.dynamic_slice_in_dim(logits, p_len - 1, 1, 0)
-            tok = pick(row, key)[0].astype(jnp.int32)
-            return k, v, tok
+            def prefill(params, k, v, prompt, p_len, slot, key):
+                traces["prefill"] += 1     # host-side retrace witness
+                retrace.labels(program="prefill").inc()
+                logits, kn, vn = adapter.prefill(params, prompt)
+                k = jax.lax.dynamic_update_slice(k, kn[None],
+                                                 (slot, 0, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(v, vn[None],
+                                                 (slot, 0, 0, 0, 0))
+                row = jax.lax.dynamic_slice_in_dim(logits, p_len - 1, 1,
+                                                   0)
+                tok = pick(row, key)[0].astype(jnp.int32)
+                return k, v, tok
 
-        def step(params, k, v, tokens, positions, active, key):
-            self._step_traces += 1         # host-side retrace witness
-            logits, k, v = adapter.decode(params, tokens, positions, k, v)
-            nxt = pick(logits, key).astype(jnp.int32)
-            return k, v, jnp.where(active, nxt, 0)
+            def step(params, k, v, tokens, positions, active, key):
+                traces["step"] += 1        # host-side retrace witness
+                retrace.labels(program="step").inc()
+                logits, k, v = adapter.decode(params, tokens, positions,
+                                              k, v)
+                nxt = pick(logits, key).astype(jnp.int32)
+                return k, v, jnp.where(active, nxt, 0)
 
-        # donate the cache buffers so the pool is updated in place on
-        # accelerator backends (on CPU jax cannot donate; skip the
-        # per-call warning)
-        donate = () if jax.default_backend() == "cpu" else (1, 2)
-        self._prefill_fn = jax.jit(prefill, donate_argnums=donate)
-        self._step_fn = jax.jit(step, donate_argnums=donate)
+            # donate the cache buffers so the pool is updated in place
+            # on accelerator backends (on CPU jax cannot donate; skip
+            # the per-call warning)
+            donate = () if jax.default_backend() == "cpu" else (1, 2)
+            entry = {"prefill": jax.jit(prefill, donate_argnums=donate),
+                     "step": jax.jit(step, donate_argnums=donate),
+                     "traces": traces}
+            self._PROGRAMS[self._program_key()] = entry
+        self._prefill_fn = entry["prefill"]
+        self._step_fn = entry["step"]
+        self._traces = entry["traces"]
 
     @property
     def trace_counts(self):
-        """{'prefill': n, 'step': n} — times each program was traced."""
-        return {"prefill": self._prefill_traces,
-                "step": self._step_traces}
+        """{'prefill': n, 'step': n} — times the (shared) program was
+        traced; 1 after warmup means every engine with this signature
+        runs the same executable at the same shapes."""
+        return dict(self._traces)
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
@@ -161,6 +231,7 @@ class InferenceEngine:
 
     def _emit(self, req, tok, now):
         req.tokens.append(int(tok))
+        self._m_tokens.inc()
         if req.t_first is None:
             req.t_first = now
         if req.stream is not None:
@@ -176,6 +247,14 @@ class InferenceEngine:
                 "n_tokens": len(req.tokens),
                 "queue_wait": req.queue_wait, "ttft": req.ttft,
                 "tpot": req.tpot, "finish_reason": req.finish_reason})
+            # registry mirror of the record: the same latencies land in
+            # scrape-able histograms without changing records' shape
+            self._m_finished.inc()
+            for m, v in ((self._m_qwait, req.queue_wait),
+                         (self._m_ttft, req.ttft),
+                         (self._m_tpot, req.tpot)):
+                if v is not None:
+                    m.observe(v)
 
     # -- the iteration -----------------------------------------------------
     def step(self):
@@ -188,14 +267,16 @@ class InferenceEngine:
             req.t_admit = self._now()
             padded, _ = pad_prompts([req.prompt],
                                     pad_to=self.max_prompt_len)
-            k, v, tok = self._prefill_fn(
-                self.params, self.cache.k, self.cache.v,
-                jnp.asarray(padded), req.prompt.size, slot,
-                self._next_key())
-            self.cache.update(k, v)
-            self.cache.positions[slot] = req.prompt.size
+            with self._tr.span("serve_prefill"):
+                k, v, tok = self._prefill_fn(
+                    self.params, self.cache.k, self.cache.v,
+                    jnp.asarray(padded), req.prompt.size, slot,
+                    self._next_key())
+                self.cache.update(k, v)
+                self.cache.positions[slot] = req.prompt.size
+                tok = int(np.asarray(tok))
             self.prefills += 1
-            tok = int(np.asarray(tok))
+            self._m_prefill_iters.inc()
             self._last_tokens[slot] = tok
             now = self._now()
             self._emit(req, tok, now)
@@ -206,16 +287,27 @@ class InferenceEngine:
         if slots:
             active = np.zeros(self.cache.n_slots, bool)
             active[slots] = True
-            self.occupancy.append(len(slots) / self.cache.n_slots)
-            k, v, nxt = self._step_fn(
-                self.params, self.cache.k, self.cache.v,
-                jnp.asarray(self._last_tokens),
-                self.cache.device_positions(), jnp.asarray(active),
-                self._next_key())
-            self.cache.update(k, v)
-            self.cache.advance(slots)
+            occ = len(slots) / self.cache.n_slots
+            self.occupancy.append(occ)
+            self._m_occ.set(occ)
+            with self._tr.span("serve_decode"):
+                # _last_tokens is mutated in place per emitted token, so
+                # upload a SNAPSHOT: on the CPU backend jnp.asarray may
+                # alias the host buffer / defer the copy, and the
+                # post-dispatch mutation raced the pending read
+                # (nondeterministic streams — the tier-1 serving flake)
+                k, v, nxt = self._step_fn(
+                    self.params, self.cache.k, self.cache.v,
+                    jnp.asarray(self._last_tokens.copy()),
+                    self.cache.device_positions(), jnp.asarray(active),
+                    self._next_key())
+                self.cache.update(k, v)
+                self.cache.advance(slots)
+                # materialize INSIDE the span: this is where the host
+                # actually waits for the decode iteration
+                nxt = np.asarray(nxt)
             self.decode_steps += 1
-            nxt = np.asarray(nxt)
+            self._m_decode_iters.inc()
             now = self._now()
             for slot in slots:
                 req = self.scheduler.running[slot]

@@ -88,7 +88,12 @@ class SlotKVCache:
 
     # -- step plumbing -----------------------------------------------------
     def device_positions(self):
-        return jnp.asarray(self.positions)
+        # SNAPSHOT, not view: on the CPU backend jnp.asarray may alias
+        # the host buffer (or defer the copy), and ``advance``/``alloc``
+        # mutate ``positions`` in place right after the decode dispatch
+        # — uploading the live buffer raced the pending read and made
+        # token streams nondeterministic (tier-1 serving flakes)
+        return jnp.asarray(self.positions.copy())
 
     def advance(self, slots):
         """Bump the write position of ``slots`` after a decode step wrote

@@ -24,6 +24,8 @@ from collections import deque
 
 import numpy as np
 
+from .. import telemetry as _telemetry
+
 
 class Request:
     """One generation request and its lifecycle timestamps."""
@@ -96,9 +98,20 @@ class Scheduler:
         self.queue = deque()
         self.running = {}           # slot -> Request
         self.admitted_order = []    # rids in prefill order (FIFO witness)
+        mode = "gang" if self.gang else "continuous"
+        reg = _telemetry.get_registry()
+        self._m_queue = reg.gauge(
+            "hetu_serving_queue_depth",
+            "Requests waiting for a KV slot",
+            labels=("scheduler",)).labels(scheduler=mode)
+        self._m_admitted = reg.counter(
+            "hetu_serving_admissions_total",
+            "Requests admitted into a slot",
+            labels=("scheduler",)).labels(scheduler=mode)
 
     def submit(self, request):
         self.queue.append(request)
+        self._m_queue.set(len(self.queue))
         return request
 
     @property
@@ -122,6 +135,9 @@ class Scheduler:
             self.running[slot] = req
             self.admitted_order.append(req.rid)
             out.append((req, slot))
+        if out:
+            self._m_queue.set(len(self.queue))
+            self._m_admitted.inc(len(out))
         return out
 
     def retire(self, request, reason):

@@ -1,0 +1,144 @@
+"""Unified runtime telemetry: one registry + one tracer per process.
+
+The executor, prefetcher, resilience guard/checkpointer, serving engine,
+and PS transport all instrument themselves against the singletons here.
+Everything is DISABLED by default — the no-op instrument path costs
+~100 ns per call (pinned by ``tests/test_telemetry.py``), so the hot
+paths carry their probes unconditionally and a training run pays
+nothing until someone calls :func:`enable`.
+
+Typical wiring::
+
+    from hetu_tpu import telemetry
+    telemetry.enable(http_port=9100)      # /metrics + /healthz live
+    ... train / serve ...
+    print(telemetry.report())             # snapshot + phase breakdown
+    telemetry.shutdown()
+
+``bench.py --telemetry`` drives exactly this around every stage and
+appends :func:`report` to the stage's detail JSON.
+"""
+
+from __future__ import annotations
+
+from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                       JsonlWriter, MetricsRegistry, MetricsServer,
+                       start_http_server)
+from .tracing import NULL_SPAN, SpanTracer
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "JsonlWriter", "MetricsServer", "SpanTracer", "NULL_SPAN",
+           "DEFAULT_BUCKETS", "start_http_server", "get_registry",
+           "get_tracer", "enabled", "enable", "disable", "shutdown",
+           "report", "step_phase_report"]
+
+_registry = MetricsRegistry(enabled=False)
+_tracer = SpanTracer(capacity=65536, enabled=False)
+_server = None
+
+
+def get_registry():
+    """The process-wide :class:`MetricsRegistry`."""
+    return _registry
+
+
+def get_tracer():
+    """The process-wide :class:`SpanTracer`."""
+    return _tracer
+
+
+def enabled():
+    return _registry.enabled
+
+
+def enable(http_port=None, host="127.0.0.1"):
+    """Turn instruments live; optionally start the HTTP exporter
+    (``http_port=0`` binds an ephemeral port).  Returns the
+    :class:`MetricsServer` when one is (already) running, else None."""
+    global _server
+    _registry.enable()
+    _tracer.enabled = True
+    if http_port is not None and _server is None:
+        _server = start_http_server(port=http_port, host=host,
+                                    registry=_registry)
+    return _server
+
+
+def disable():
+    """Freeze instruments (references stay valid; state is retained)."""
+    _registry.disable()
+    _tracer.enabled = False
+
+
+def shutdown():
+    """Disable + stop the exporter (if any).  State is retained."""
+    global _server
+    disable()
+    if _server is not None:
+        _server.close()
+        _server = None
+
+
+# span names recorded INSIDE SubExecutor.run()'s wall time; everything
+# else host-side (data_wait, prefetch_h2d) happens between run() calls
+_RUN_PHASES = ("h2d", "dispatch", "guard_check")
+_LOOP_PHASES = ("data_wait", "prefetch_h2d")
+
+
+def step_phase_report(registry=None, tracer=None):
+    """Per-step host_gap decomposition from the executor step histogram
+    + the tracer's phase spans.
+
+    Returns ``{"steps", "wall_s_per_step", "phases": {...}}`` where the
+    phases are ``data_wait`` / ``prefetch_h2d`` (between run() calls),
+    ``h2d`` / ``dispatch`` / ``guard_check`` (inside run()), and
+    ``device_and_wait`` — the residual of the run() wall time not
+    attributable to host work, i.e. time spent inside the jitted call
+    (device compute and runtime queue back-pressure).  The phases sum to
+    ``wall_s_per_step`` by construction, so the breakdown IS the
+    decomposition of the wall step time (host_gap's numerator).
+    ``{"steps": 0}`` when no instrumented step has run."""
+    reg = registry if registry is not None else _registry
+    tr = tracer if tracer is not None else _tracer
+    snap = reg.snapshot()
+    hist = snap.get("hetu_executor_step_seconds")
+    steps = 0
+    wall_total = 0.0
+    for sample in (hist or {}).get("samples", ()):
+        steps += sample["count"]
+        wall_total += sample["sum"]
+    if steps == 0:
+        return {"steps": 0}
+    agg = tr.aggregate()
+    phases = {}
+    run_host = 0.0
+    for name in _RUN_PHASES:
+        t = agg.get(name, {}).get("total_s", 0.0) / steps
+        phases[name] = t
+        run_host += t
+    run_wall = wall_total / steps
+    phases["device_and_wait"] = max(0.0, run_wall - run_host)
+    loop_extra = 0.0
+    for name in _LOOP_PHASES:
+        t = agg.get(name, {}).get("total_s", 0.0) / steps
+        phases[name] = t
+        loop_extra += t
+    wall = max(run_wall, run_host) + loop_extra
+    return {"steps": int(steps),
+            "wall_s_per_step": round(wall, 9),
+            "phases": {k: round(v, 9) for k, v in phases.items()},
+            "spans_dropped": tr.dropped}
+
+
+def report(registry=None, tracer=None):
+    """Everything ``--telemetry`` appends to a bench detail JSON: the
+    registry snapshot, the step-phase breakdown, and the raw per-span
+    aggregates (serving phases etc. that aren't executor steps)."""
+    reg = registry if registry is not None else _registry
+    tr = tracer if tracer is not None else _tracer
+    return {"registry": reg.snapshot(),
+            "phases": step_phase_report(reg, tr),
+            "spans": {k: {"total_s": round(v["total_s"], 6),
+                          "count": v["count"],
+                          "mean_s": round(v["mean_s"], 9)}
+                      for k, v in tr.aggregate().items()}}

@@ -1,0 +1,437 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Hetu's reference stack treats measurement as a subsystem (per-op replay
+profiling + logger aggregation feed experiment tracking and the
+auto-parallel search, SURVEY §5 P16/P18); this is the RUNTIME half of
+that role for the TPU port.  The offline half (``timeline.py``,
+``profiler.py``) answers "what did that trace contain"; the registry
+answers "what is the process doing right now" — executor step counters,
+prefetch queue depth, guard trips, serving occupancy — through one
+surface with three faces:
+
+* ``snapshot()`` — a JSON-safe dict (bench detail files, tests);
+* ``to_prometheus()`` — text exposition v0.0.4, served by the
+  stdlib-only HTTP exporter (``start_http_server``: ``/metrics`` +
+  ``/healthz``) so a TPU VM exposes live metrics with zero extra deps;
+* ``JsonlWriter`` — the one append-a-JSON-line serialization path,
+  shared with ``hetu_tpu.logger.HetuLogger``.
+
+Cost model: the registry is DISABLED by default and every instrument
+checks the registry flag before touching state, so an un-enabled
+``counter.inc()`` is two attribute loads and a branch (~100 ns) — cheap
+enough to leave in executor/prefetch/serving hot paths unconditionally.
+Instruments are cached by name: two subsystems asking for the same
+metric share one time series (label sets distinguish them).
+
+Durations everywhere in this module come from ``time.perf_counter()``
+(monotonic); wall-clock ``time.time()`` is banned for timing by the
+AST gate in ``tests/test_no_wallclock_timing.py``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "JsonlWriter", "start_http_server", "MetricsServer",
+           "DEFAULT_BUCKETS"]
+
+# seconds-scale latency buckets: 100 us .. 10 s covers everything from a
+# no-op dispatch to a slow checkpoint restore
+DEFAULT_BUCKETS = (1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
+                   5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt(v):
+    """Prometheus sample value: integral floats print as integers."""
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _esc(s):
+    return (str(s).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+class _Child:
+    """One labeled time series of a metric (pre-resolved label values,
+    so the hot-path call is flag-check + locked update only)."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric, key):
+        self._metric = metric
+        self._key = key
+
+
+class _CounterChild(_Child):
+    def inc(self, n=1):
+        m = self._metric
+        if not m._registry.enabled:
+            return
+        if n < 0:
+            raise ValueError(f"counter {m.name} cannot decrease (n={n})")
+        with m._lock:
+            m._values[self._key] += n
+
+
+class _GaugeChild(_Child):
+    def set(self, v):
+        m = self._metric
+        if not m._registry.enabled:
+            return
+        with m._lock:
+            m._values[self._key] = float(v)
+
+    def inc(self, n=1):
+        m = self._metric
+        if not m._registry.enabled:
+            return
+        with m._lock:
+            m._values[self._key] += n
+
+    def dec(self, n=1):
+        self.inc(-n)
+
+
+class _HistogramChild(_Child):
+    def observe(self, v):
+        m = self._metric
+        if not m._registry.enabled:
+            return
+        v = float(v)
+        i = bisect.bisect_left(m.buckets, v)
+        with m._lock:
+            slot = m._values[self._key]
+            if i < len(m.buckets):
+                slot["buckets"][i] += 1
+            slot["sum"] += v
+            slot["count"] += 1
+
+
+class _Metric:
+    kind = None
+    _child_cls = _Child
+
+    def __init__(self, name, help, label_names, registry):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._values = {}       # label-values tuple -> value/state
+        self._children = {}
+        self._default_child = None
+
+    def _zero(self):
+        return 0.0
+
+    def labels(self, **labelvals):
+        if set(labelvals) != set(self.label_names):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.label_names}, "
+                f"got {tuple(sorted(labelvals))}")
+        key = tuple(str(labelvals[k]) for k in self.label_names)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._child_cls(self, key)
+                self._children[key] = child
+                self._values[key] = self._zero()
+        return child
+
+    def _default(self):
+        """The unlabeled series (metrics declared without label names);
+        cached so hot-path ``metric.inc()`` skips label resolution."""
+        child = self._default_child
+        if child is None:
+            if self.label_names:
+                raise ValueError(
+                    f"metric {self.name} has labels {self.label_names}; "
+                    "resolve a series with .labels(...) first")
+            child = self._default_child = self.labels()
+        return child
+
+    def _samples(self):
+        with self._lock:
+            return [(dict(zip(self.label_names, key)), value)
+                    for key, value in sorted(self._values.items())]
+
+
+class Counter(_Metric):
+    kind = "counter"
+    _child_cls = _CounterChild
+
+    def inc(self, n=1):
+        self._default().inc(n)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+    _child_cls = _GaugeChild
+
+    def set(self, v):
+        self._default().set(v)
+
+    def inc(self, n=1):
+        self._default().inc(n)
+
+    def dec(self, n=1):
+        self._default().dec(n)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+    _child_cls = _HistogramChild
+
+    def __init__(self, name, help, label_names, registry,
+                 buckets=DEFAULT_BUCKETS):
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(
+                f"histogram {name} buckets must be sorted and unique, "
+                f"got {buckets!r}")
+        self.buckets = tuple(float(b) for b in buckets)
+        super().__init__(name, help, label_names, registry)
+
+    def _zero(self):
+        return {"buckets": [0] * len(self.buckets), "sum": 0.0,
+                "count": 0}
+
+    def observe(self, v):
+        self._default().observe(v)
+
+    def _samples(self):
+        with self._lock:
+            out = []
+            for key, slot in sorted(self._values.items()):
+                out.append((dict(zip(self.label_names, key)),
+                            {"buckets": list(slot["buckets"]),
+                             "sum": slot["sum"],
+                             "count": slot["count"]}))
+            return out
+
+
+class MetricsRegistry:
+    """Named metric instruments + the three export faces.
+
+    ``enabled=False`` (the default for the process-wide registry) makes
+    every instrument a near-free no-op; flip with ``enable()`` /
+    ``disable()`` at any point — call sites keep their references.
+    """
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self, enabled=False):
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._metrics = {}
+        self._t0 = time.perf_counter()
+
+    def enable(self):
+        self.enabled = True
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    # -- instrument constructors (cached by name) -------------------------
+    def _get(self, cls, name, help, labels, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labels, self, **kw)
+                self._metrics[name] = m
+                return m
+        if type(m) is not cls or m.label_names != tuple(labels):
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind} with "
+                f"labels {m.label_names}; cannot re-register as "
+                f"{cls.kind} with labels {tuple(labels)}")
+        return m
+
+    def counter(self, name, help="", labels=()):
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name, help="", labels=()):
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name, help="", labels=(),
+                  buckets=DEFAULT_BUCKETS):
+        return self._get(Histogram, name, help, labels, buckets=buckets)
+
+    def reset(self):
+        """Drop every registered metric (tests; NOT the enabled flag)."""
+        with self._lock:
+            self._metrics = {}
+
+    # -- export faces ------------------------------------------------------
+    def snapshot(self):
+        """JSON-safe deep copy: {name: {type, help, samples: [{labels,
+        value|count/sum/buckets}]}}.  Isolated — later updates do not
+        mutate an already-taken snapshot."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = {}
+        for m in metrics:
+            samples = []
+            for labels, value in m._samples():
+                entry = {"labels": labels}
+                if m.kind == "histogram":
+                    entry["count"] = value["count"]
+                    entry["sum"] = value["sum"]
+                    entry["buckets"] = [
+                        [le, n] for le, n in zip(m.buckets,
+                                                 value["buckets"])]
+                else:
+                    entry["value"] = value
+                samples.append(entry)
+            out[m.name] = {"type": m.kind, "help": m.help,
+                           "samples": samples}
+        return out
+
+    def to_prometheus(self):
+        """Text exposition format v0.0.4 (what Prometheus scrapes)."""
+        lines = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(),
+                             key=lambda m: m.name)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {_esc(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for labels, value in m._samples():
+                base = ",".join(f'{k}="{_esc(v)}"'
+                                for k, v in labels.items())
+                if m.kind == "histogram":
+                    cum = 0
+                    for le, n in zip(m.buckets, value["buckets"]):
+                        cum += n
+                        lb = (base + "," if base else "") + \
+                            f'le="{_fmt(float(le))}"'
+                        lines.append(
+                            f"{m.name}_bucket{{{lb}}} {cum}")
+                    lb = (base + "," if base else "") + 'le="+Inf"'
+                    lines.append(
+                        f"{m.name}_bucket{{{lb}}} {value['count']}")
+                    suffix = f"{{{base}}}" if base else ""
+                    lines.append(f"{m.name}_sum{suffix} "
+                                 f"{_fmt(value['sum'])}")
+                    lines.append(f"{m.name}_count{suffix} "
+                                 f"{value['count']}")
+                else:
+                    suffix = f"{{{base}}}" if base else ""
+                    lines.append(f"{m.name}{suffix} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+    def write_jsonl(self, writer):
+        """Append one snapshot record through a :class:`JsonlWriter`
+        (or any object with ``write(record)``)."""
+        writer.write({"kind": "metrics_snapshot",
+                      "uptime_s": round(time.perf_counter() - self._t0,
+                                        3),
+                      "metrics": self.snapshot()})
+
+
+class JsonlWriter:
+    """THE append-a-JSON-line path (logger records, registry snapshots):
+    one place that owns the file handle, flush policy, and close —
+    ``HetuLogger`` delegates here instead of keeping its own ``open``.
+    Context-manager; ``close()`` is idempotent."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._f = open(self.path, "a")
+        self._lock = threading.Lock()
+
+    def write(self, record):
+        with self._lock:
+            if self._f is None:
+                raise ValueError(f"JsonlWriter({self.path}) is closed")
+            self._f.write(json.dumps(record) + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class MetricsServer:
+    """Handle for a running exporter: ``.port``, ``.url``, ``close()``."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.host, self.port = httpd.server_address[:2]
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def start_http_server(port=0, host="127.0.0.1", registry=None):
+    """Serve ``/metrics`` (Prometheus text) + ``/healthz`` (JSON) from a
+    daemon thread — stdlib only, so it runs on a bare TPU VM.  Returns a
+    :class:`MetricsServer` (``port=0`` binds an ephemeral port)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry
+    t0 = time.perf_counter()
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                body = reg.to_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/healthz":
+                body = json.dumps(
+                    {"status": "ok", "telemetry_enabled": reg.enabled,
+                     "uptime_s": round(time.perf_counter() - t0, 3)}
+                ).encode()
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):   # keep scrapes off stderr
+            pass
+
+    if reg is None:
+        raise ValueError("start_http_server needs a registry= (use "
+                         "hetu_tpu.telemetry.enable(http_port=...) for "
+                         "the process-wide one)")
+    httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="hetu-metrics-exporter")
+    thread.start()
+    return MetricsServer(httpd, thread)

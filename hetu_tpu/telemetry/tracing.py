@@ -1,0 +1,160 @@
+"""Host-side step-phase span tracer: ring buffer + Chrome-trace export.
+
+``jax.profiler`` answers "what did the DEVICE do"; this tracer answers
+the question three bench rounds stalled on — "where does the HOST step
+time go" (``host_gap`` reported as a bare ratio since r05).  Hot paths
+open named spans around their phases (prefetch ``data_wait``/
+``prefetch_h2d``, executor ``h2d``/``dispatch``/``guard_check``,
+serving ``serve_prefill``/``serve_decode``); each span is two
+``time.perf_counter()`` reads and one slot write into a fixed ring
+buffer, so steady-state tracing never allocates unboundedly and never
+syncs the device.
+
+Disabled (the default), ``span()`` hands back a shared no-op context
+manager — the whole per-span cost is one flag check plus the ``with``
+protocol (~a hundred ns), cheap enough to leave in the executor step
+path unconditionally (pinned by the micro-benchmark in
+``tests/test_telemetry.py``).
+
+Export: ``aggregate()`` for per-phase totals (the bench's host_gap
+decomposition) and ``chrome_trace()`` for chrome://tracing /
+Perfetto — optionally MERGED with a ``jax.profiler.trace`` capture's
+events, so host phases and XLA device ops land in one viewer.  The two
+event sets keep their own clock bases (jax's capture epoch is not
+recoverable host-side); lanes align per step by span boundaries, not by
+absolute timestamp.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+__all__ = ["SpanTracer", "NULL_SPAN"]
+
+
+class _NullSpan:
+    """Shared do-nothing span (disabled tracer / allocation-free)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "_t0")
+
+    def __init__(self, tracer, name):
+        self._tracer = tracer
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tracer._record(self.name, self._t0, t1 - self._t0)
+        return False
+
+
+class SpanTracer:
+    """Fixed-capacity ring of (name, start_s, dur_s) host spans."""
+
+    def __init__(self, capacity=16384, enabled=False):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._buf = [None] * self.capacity
+        self._n = 0                      # total spans ever recorded
+        self._epoch = time.perf_counter()
+
+    def span(self, name):
+        """Context manager timing one phase; no-op while disabled."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name)
+
+    def _record(self, name, t0, dur):
+        with self._lock:
+            self._buf[self._n % self.capacity] = (name, t0, dur)
+            self._n += 1
+
+    def __len__(self):
+        return min(self._n, self.capacity)
+
+    @property
+    def dropped(self):
+        """Spans that fell off the ring (total recorded - retained)."""
+        return max(0, self._n - self.capacity)
+
+    def clear(self):
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._n = 0
+            self._epoch = time.perf_counter()
+
+    def spans(self):
+        """Retained spans, oldest first: [(name, start_s, dur_s)]."""
+        with self._lock:
+            n, cap = self._n, self.capacity
+            if n <= cap:
+                return [s for s in self._buf[:n]]
+            i = n % cap
+            return self._buf[i:] + self._buf[:i]
+
+    def aggregate(self):
+        """{name: {total_s, count, mean_s}} over the retained spans."""
+        agg = {}
+        for name, _, dur in self.spans():
+            slot = agg.setdefault(name, [0.0, 0])
+            slot[0] += dur
+            slot[1] += 1
+        return {name: {"total_s": t, "count": c, "mean_s": t / c}
+                for name, (t, c) in sorted(agg.items())}
+
+    # -- Chrome-trace export ----------------------------------------------
+    def chrome_trace(self, jax_trace_dir=None, pid=1 << 20):
+        """Trace-event JSON (``{"traceEvents": [...]}``) of the retained
+        spans — complete ``X`` events in microseconds relative to the
+        tracer epoch, on one process lane named ``hetu host spans``.
+
+        ``jax_trace_dir``: a ``jax.profiler.trace`` output directory
+        whose newest capture's events are merged in ahead of ours, so
+        one chrome://tracing load shows XLA device lanes next to the
+        host phases (clock bases differ; see module doc)."""
+        events = [
+            {"ph": "M", "pid": pid, "name": "process_name",
+             "args": {"name": "hetu host spans"}},
+            {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
+             "args": {"name": "step phases"}},
+        ]
+        for name, t0, dur in self.spans():
+            events.append({"ph": "X", "pid": pid, "tid": 0,
+                           "name": name,
+                           "ts": (t0 - self._epoch) * 1e6,
+                           "dur": dur * 1e6})
+        if jax_trace_dir is not None:
+            import gzip
+            from ..timeline import _latest_trace_json
+            captured = json.loads(
+                gzip.open(_latest_trace_json(jax_trace_dir)).read())
+            events = list(captured.get("traceEvents", [])) + events
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path, jax_trace_dir=None):
+        """Write :meth:`chrome_trace` to ``path``; returns the path."""
+        doc = self.chrome_trace(jax_trace_dir=jax_trace_dir)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path

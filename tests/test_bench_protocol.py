@@ -192,6 +192,109 @@ def test_serve_aborted_run_preserves_prior_detail_file(tmp_path):
     assert json.loads(detail.read_text()) == sentinel
 
 
+def _assert_telemetry_block(tel):
+    """The --telemetry emission contract shared by BENCH_FULL /
+    CHAOS_FULL / SERVE_FULL: a registry snapshot plus the step-phase
+    breakdown (phases summing to the wall step time when steps ran)."""
+    assert set(tel) >= {"registry", "phases", "spans"}
+    reg = tel["registry"]
+    assert isinstance(reg, dict) and reg, "empty registry snapshot"
+    for name, metric in reg.items():
+        assert metric["type"] in {"counter", "gauge", "histogram"}, name
+        assert "samples" in metric, name
+    phases = tel["phases"]
+    if phases.get("steps", 0) > 0:
+        total = sum(phases["phases"].values())
+        assert total == pytest.approx(phases["wall_s_per_step"],
+                                      rel=1e-6)
+
+
+def test_serve_telemetry_emission(tmp_path):
+    """`--serve --quick --telemetry`: SERVE_FULL.json carries the
+    registry snapshot (serving counters included), the span aggregates,
+    and the measured telemetry-overhead twin — and the compact tail
+    still fits the driver's window."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_SERVE_JSON"] = str(tmp_path / "serve.json")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--serve", "--quick", "--telemetry"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    compact = json.loads(lines[-1])
+    assert len(lines[-1].encode()) < 2000
+    assert "telemetry_overhead_frac" in compact
+    with open(tmp_path / "serve.json") as f:
+        full = json.load(f)
+    _assert_telemetry_block(full["telemetry"])
+    reg = full["telemetry"]["registry"]
+    assert "hetu_serving_tokens_total" in reg
+    assert "hetu_serving_slot_occupancy" in reg
+    assert "hetu_serving_queue_depth" in reg
+    by_sched = {s["labels"]["scheduler"]: s["value"]
+                for s in reg["hetu_serving_tokens_total"]["samples"]}
+    assert by_sched["continuous"] > 0 and by_sched["gang"] > 0
+    # prefill-vs-decode split is visible per scheduler
+    assert "hetu_serving_decode_iterations_total" in reg
+    assert {"serve_prefill", "serve_decode"} <= set(
+        full["telemetry"]["spans"])
+    overhead = full["telemetry_overhead"]
+    assert overhead["metric"] == "telemetry_overhead"
+    assert 0.0 <= overhead["overhead_frac"] < 1.0
+    # the baseline serve fields are UNCHANGED by the migration to
+    # registry instruments (records/latency_stats consumers intact)
+    for s in full["stages"].values():
+        assert {"tokens_per_sec", "mean_occupancy", "decode_steps",
+                "latency_s", "trace_counts"} <= set(s)
+
+
+def test_chaos_telemetry_emission(tmp_path):
+    """`--chaos --quick --telemetry`: CHAOS_FULL.json carries the same
+    telemetry block, including guard trip counters."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_CHAOS_JSON"] = str(tmp_path / "chaos.json")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--chaos", "--quick", "--telemetry"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(tmp_path / "chaos.json") as f:
+        full = json.load(f)
+    _assert_telemetry_block(full["telemetry"])
+    reg = full["telemetry"]["registry"]
+    assert "hetu_guard_trips_total" in reg
+    trips = sum(s["value"]
+                for s in reg["hetu_guard_trips_total"]["samples"])
+    assert trips >= 1          # the injected faults tripped the guard
+    assert "hetu_executor_steps_total" in reg
+    assert "hetu_prefetch_queue_depth" in reg
+    assert full["telemetry"]["phases"]["steps"] > 0
+    assert "overhead_frac" in full["telemetry_overhead"]
+
+
+def test_stage_telemetry_emission():
+    """A train stage child with --telemetry appends the telemetry block
+    to its result line — the exact object the parent commits into
+    BENCH_FULL.json's per-stage entries."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--stage", "wdl", "--quick",
+         "--telemetry"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "wdl_criteo_train_steps_per_sec"
+    _assert_telemetry_block(out["telemetry"])
+    phases = out["telemetry"]["phases"]
+    assert phases["steps"] > 0
+    # the wdl stage runs through the prefetcher: data_wait + h2d +
+    # dispatch + device_and_wait all present in the breakdown
+    assert {"data_wait", "h2d", "dispatch",
+            "device_and_wait"} <= set(phases["phases"])
+
+
 @pytest.mark.slow
 def test_one_stage_budget_preserves_finished_stage(tmp_path):
     """A budget that admits roughly one stage: the tail must carry that

@@ -1,0 +1,117 @@
+"""Tier-1 static check: no wall-clock ``time.time()`` timing in hetu_tpu.
+
+Durations measured with ``time.time()`` break under NTP steps and leap
+smears — a wall-clock jump mid-interval yields negative or wildly wrong
+"latencies", which then land in telemetry histograms, bench JSON, and
+guard heuristics as if they were real.  Every duration in ``hetu_tpu/``
+must come from a monotonic clock (``time.perf_counter()`` or
+``time.monotonic()``).  This gate (the ``test_no_silent_except.py``
+pattern) scans the AST of every module for calls to ``time.time`` —
+including ``from time import time`` aliases — and each hit must be on
+the reviewed allowlist of legitimately-wall-clock uses (timestamps sent
+to a peer, not durations).
+"""
+
+import ast
+import os
+
+import pytest
+
+HETU_ROOT = os.path.join(os.path.dirname(__file__), "..", "hetu_tpu")
+
+# Reviewed wall-clock sites, as "relative/path.py::enclosing_function".
+# Every entry SENDS a timestamp (or labels a record with one) — none
+# subtracts two wall-clock reads to produce a duration.
+ALLOWED = {
+    "ps/rpc.py::_heartbeat",   # ping payload echoed by the server; the
+                               # liveness DELTA uses time.monotonic()
+}
+
+
+def _walltime_call_sites(root):
+    sites = []
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    sites.append((f"{rel}::<syntax-error>", e.lineno))
+                    continue
+            # names that alias the wall clock via `from time import time`
+            aliases = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom) and \
+                        node.module == "time":
+                    for al in node.names:
+                        if al.name == "time":
+                            aliases.add(al.asname or "time")
+
+            def is_walltime(call):
+                f = call.func
+                if isinstance(f, ast.Attribute) and f.attr == "time" \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "time":
+                    return True
+                return isinstance(f, ast.Name) and f.id in aliases
+
+            def walk(node, funcname):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    funcname = node.name
+                if isinstance(node, ast.Call) and is_walltime(node):
+                    sites.append((f"{rel}::{funcname}", node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, funcname)
+
+            walk(tree, "<module>")
+    return sites
+
+
+def test_no_walltime_duration_measurement():
+    sites = _walltime_call_sites(HETU_ROOT)
+    new = [f"{key} (line {line})" for key, line in sites
+           if key not in ALLOWED]
+    assert not new, (
+        "wall-clock time.time() call(s) in hetu_tpu/ — use the "
+        "monotonic time.perf_counter() (durations) or time.monotonic() "
+        "(deadlines); a genuinely-wall-clock timestamp needs a reviewed "
+        "entry in tests/test_no_wallclock_timing.py:\n  "
+        + "\n  ".join(new))
+
+
+def test_allowlist_not_stale():
+    """Entries whose site disappeared must leave the allowlist."""
+    present = {key for key, _ in _walltime_call_sites(HETU_ROOT)}
+    stale = sorted(ALLOWED - present)
+    assert not stale, (
+        "allowlist entries with no matching time.time() site — remove "
+        "them from tests/test_no_wallclock_timing.py:\n  "
+        + "\n  ".join(stale))
+
+
+def test_scanner_detects_both_call_forms(tmp_path):
+    """The scanner must flag `time.time()` AND a `from time import
+    time` alias, and must NOT flag monotonic clocks (guards against the
+    gate silently going blind)."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import time\n"
+        "from time import time as walltime\n"
+        "def a():\n"
+        "    return time.time()\n"
+        "def b():\n"
+        "    return walltime()\n"
+        "def ok():\n"
+        "    return time.perf_counter() + time.monotonic()\n")
+    sites = sorted(k for k, _ in _walltime_call_sites(str(tmp_path)))
+    assert sites == ["m.py::a", "m.py::b"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
