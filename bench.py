@@ -1200,6 +1200,330 @@ def _emit_serve(out):
     print(json.dumps(compact), flush=True)
 
 
+# -- chaos-serve mode (bench.py --chaos --serve) ---------------------------
+# Serving-side resilience evidence: inject every serving fault class
+# (poisoned decode, raising step, slot leak, stalled/raising consumer,
+# arrival-burst overload, deadline/cancel churn) through
+# hetu_tpu.resilience.faults into the PROTECTED engine and prove it
+# recovers — engine loop alive, slot audit balanced (allocs == frees),
+# partial results with the right finish_reason — while the UNPROTECTED
+# twin (watchdog off, queue unbounded) demonstrably dies, wedges, or
+# leaks under the same seed.  Reported into CHAOS_FULL.json under the
+# same no-clobber contract as --chaos.
+
+
+def _chaos_serve_prompts(rng, n, vocab, lo=3, hi=9):
+    return [rng.integers(1, vocab, (int(L),))
+            for L in rng.integers(lo, hi, n)]
+
+
+def _chaos_serve_nan_decode(ex, model, c, seed):
+    """Poison one running slot's KV mid-flight: the protected engine
+    quarantines exactly that request (finish_reason="error") and the
+    other streams stay bitwise identical to a clean run; the
+    unprotected twin serves NaN-derived tokens as if healthy."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = _chaos_serve_prompts(rng, 3, c.vocab_size)
+    kw = dict(n_slots=3, max_len=32, max_prompt_len=8, prefill_budget=3,
+              name="serve", seed=seed)
+    clean = InferenceEngine(ex, model, **kw)
+    baseline = clean.generate_many(prompts, 8)
+
+    def poisoned_run(watchdog):
+        eng = InferenceEngine(ex, model, watchdog=watchdog, **kw)
+        reqs = [eng.submit(p, 8) for p in prompts]
+        eng.step()
+        faults.poison_slot_kv(eng, reqs[1].slot)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            eng.run(max_iterations=500)
+        return eng, reqs
+
+    eng, reqs = poisoned_run(watchdog=True)
+    others_bitwise = (np.array_equal(reqs[0].result(), baseline[0])
+                      and np.array_equal(reqs[2].result(), baseline[2]))
+    audit = eng.cache.audit()
+    recovered = (reqs[1].finish_reason == "error" and others_bitwise
+                 and eng.watchdog_trips >= 1
+                 and audit["allocs"] == audit["frees"])
+    ueng, ureqs = poisoned_run(watchdog=False)
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "poisoned_finish_reason": reqs[1].finish_reason,
+            "unaffected_streams_bitwise": bool(others_bitwise),
+            "watchdog_trips": eng.watchdog_trips,
+            "slot_audit": audit,
+            "unprotected_served_poisoned_as_healthy": bool(
+                ureqs[1].finish_reason in ("eos", "max_new"))}
+
+
+def _chaos_serve_raising_step(ex, model, c, seed):
+    """A decode step that RAISES: the protected engine retires the
+    in-flight batch with "error" and keeps serving new requests; the
+    unprotected twin dies on the spot."""
+    import warnings
+    from hetu_tpu.resilience import faults, InjectedFault
+    from hetu_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(seed + 1)
+    prompts = _chaos_serve_prompts(rng, 2, c.vocab_size)
+    kw = dict(n_slots=2, max_len=32, max_prompt_len=8, name="serve",
+              seed=seed)
+    eng = InferenceEngine(ex, model, **kw)
+    reqs = [eng.submit(p, 8) for p in prompts]
+    faults.raising_engine_step(eng, at=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng.run(max_iterations=500)
+        after = eng.generate_many([prompts[0]], 6)
+    audit = eng.cache.audit()
+    recovered = (all(r.finish_reason == "error" for r in reqs)
+                 and len(after[0]) == 6
+                 and audit["allocs"] == audit["frees"])
+    # unprotected twin: the same injected exception propagates and the
+    # engine (process, in production) is gone
+    ueng = InferenceEngine(ex, model, watchdog=False, **kw)
+    for p in prompts:
+        ueng.submit(p, 8)
+    faults.raising_engine_step(ueng, at=2)
+    died = False
+    try:
+        ueng.run(max_iterations=500)
+    except InjectedFault:
+        died = True
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "in_flight_finish_reasons":
+                [r.finish_reason for r in reqs],
+            "served_after_fault": int(len(after[0])),
+            "slot_audit": audit,
+            "unprotected_engine_died": bool(died)}
+
+
+def _chaos_serve_slot_leak(ex, model, c, seed):
+    """Leak EVERY free slot: the protected engine's reconcile sweep
+    reclaims them within one iteration and the queue drains; the
+    unprotected twin starves — queued requests are never admitted."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(seed + 2)
+    prompts = _chaos_serve_prompts(rng, 3, c.vocab_size)
+    kw = dict(n_slots=2, max_len=32, max_prompt_len=8, name="serve",
+              seed=seed)
+    eng = InferenceEngine(ex, model, **kw)
+    leaked = []
+    while True:
+        s = faults.leak_slot(eng)
+        if s is None:
+            break
+        leaked.append(s)
+    reqs = [eng.submit(p, 6) for p in prompts]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng.run(max_iterations=500)
+    audit = eng.cache.audit()
+    recovered = (all(r.finished for r in reqs)
+                 and eng.slot_leaks_reclaimed >= len(leaked)
+                 and audit["allocs"] == audit["frees"])
+    ueng = InferenceEngine(ex, model, watchdog=False, **kw)
+    while faults.leak_slot(ueng) is not None:
+        pass
+    for p in prompts:
+        ueng.submit(p, 6)
+    wedged = False
+    try:
+        ueng.run(max_iterations=50)
+    except RuntimeError:
+        wedged = True       # never drains: every slot leaked away
+    uaudit = ueng.cache.audit()
+    return {"faults_injected": len(leaked),
+            "faults_recovered": int(recovered) * len(leaked),
+            "slots_leaked": len(leaked),
+            "slots_reclaimed": eng.slot_leaks_reclaimed,
+            "slot_audit": audit,
+            "unprotected_wedged": bool(wedged),
+            "unprotected_slot_audit": uaudit}
+
+
+def _chaos_serve_stalled_consumer(ex, model, c, seed, quick):
+    """A stream consumer that stalls (and later raises): the protected
+    engine detaches it after one bounded delivery and finishes the
+    request; its tokens still land in result()."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(seed + 3)
+    prompts = _chaos_serve_prompts(rng, 2, c.vocab_size)
+    stall = 0.05 if quick else 0.2
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=8, name="serve", seed=seed,
+                          stream_stall_timeout=stall / 4)
+    got = []
+    stalled_cb = faults.stalling_consumer(stall, collect=got)
+    raising_cb = faults.stalling_consumer(0, fail_after=1)
+    r1 = eng.submit(prompts[0], 6, stream=stalled_cb)
+    r2 = eng.submit(prompts[1], 6, stream=raising_cb)
+    t0 = time.perf_counter()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng.run(max_iterations=500)
+    wall = time.perf_counter() - t0
+    audit = eng.cache.audit()
+    recovered = (eng.streams_detached >= 2
+                 and len(r1.tokens) == 6 and len(r2.tokens) == 6
+                 and audit["allocs"] == audit["frees"])
+    return {"faults_injected": 2,
+            "faults_recovered": (2 if recovered else
+                                 min(2, eng.streams_detached)),
+            "streams_detached": eng.streams_detached,
+            "stalled_deliveries_paid": len(got),
+            "wall_s": round(wall, 3),
+            "slot_audit": audit}
+
+
+def _chaos_serve_overload(ex, model, c, seed, quick):
+    """Arrival burst 4x the queue bound: the protected engine sheds with
+    typed EngineOverloaded rejections at a bounded depth and finishes
+    everything it admitted; the unprotected twin queues the whole burst
+    (unbounded growth — the OOM path in production)."""
+    import warnings
+    from hetu_tpu.serving import EngineOverloaded, InferenceEngine
+
+    rng = np.random.default_rng(seed + 4)
+    n_burst = 24 if quick else 48
+    max_queue = 6
+    prompts = _chaos_serve_prompts(rng, n_burst, c.vocab_size)
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=8, name="serve", seed=seed,
+                          max_queue=max_queue)
+    accepted, rejected = [], 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i, p in enumerate(prompts):
+            try:
+                accepted.append(eng.submit(p, 4))
+            except EngineOverloaded:
+                rejected += 1
+            if i % 4 == 3:
+                # the burst outruns decode 4:1 — admission must stay
+                # closed until the queue drains to the low watermark,
+                # then reopen (the hysteresis cycle, not one hard edge)
+                eng.step()
+        eng.run(max_iterations=2000)
+    audit = eng.cache.audit()
+    recovered = (rejected > 0
+                 and eng.scheduler.queue_depth_peak <= max_queue
+                 and all(r.finished for r in accepted)
+                 and audit["allocs"] == audit["frees"])
+    ueng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                           max_prompt_len=8, name="serve", seed=seed,
+                           watchdog=False)
+    for p in prompts:
+        ueng.submit(p, 4)
+    unbounded_peak = ueng.scheduler.queue_depth_peak
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ueng.run(max_iterations=5000)
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "burst_size": n_burst, "max_queue": max_queue,
+            "rejections": rejected,
+            "queue_depth_peak": eng.scheduler.queue_depth_peak,
+            "accepted_finished": int(sum(r.finished for r in accepted)),
+            "goodput_tokens": int(sum(len(r.tokens) for r in accepted)),
+            "slot_audit": audit,
+            "unprotected_queue_depth_peak": int(unbounded_peak)}
+
+
+def _chaos_serve_deadline_cancel(ex, model, c, seed):
+    """Deadline expiry (queued AND mid-flight) + mid-flight cancel: all
+    three return partial results with the right finish_reason and free
+    their slots immediately."""
+    import warnings
+    from hetu_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(seed + 5)
+    prompts = _chaos_serve_prompts(rng, 4, c.vocab_size)
+    eng = InferenceEngine(ex, model, n_slots=1, max_len=32,
+                          max_prompt_len=8, name="serve", seed=seed)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ra = eng.submit(prompts[0], 20)              # hogs the one slot
+        rb = eng.submit(prompts[1], 8, ttl=1e-6)     # expires queued
+        eng.step(); eng.step()
+        rc = eng.submit(prompts[2], 20)
+        rd = eng.submit(prompts[3], 20)
+        # drive ra out, let rc get the slot and produce a few tokens
+        eng.cancel(ra.rid)
+        eng.step(); eng.step(); eng.step()
+        # mid-flight expiry: force rc's deadline into the past
+        rc.deadline = eng._now() - 1.0
+        eng.step()
+        eng.cancel(rd.rid)
+        eng.run(max_iterations=500)
+    audit = eng.cache.audit()
+    checks = {
+        "queued_expired": (rb.finish_reason == "deadline"
+                           and len(rb.tokens) == 0),
+        "midflight_expired_partial": (rc.finish_reason == "deadline"
+                                      and 0 < len(rc.tokens) < 20),
+        "cancelled_partial": (ra.finish_reason == "cancelled"
+                              and 0 < len(ra.tokens) < 20
+                              and rd.finish_reason == "cancelled"),
+    }
+    recovered = all(checks.values()) and audit["allocs"] == audit["frees"]
+    return {"faults_injected": 3,
+            "faults_recovered": 3 if recovered else
+                sum(bool(v) for v in checks.values()),
+            **{k: bool(v) for k, v in checks.items()},
+            "finish_reasons": {"expired_queued": rb.finish_reason,
+                               "expired_midflight": rc.finish_reason,
+                               "cancelled": [ra.finish_reason,
+                                             rd.finish_reason]},
+            "partial_tokens": {"midflight_expired": len(rc.tokens),
+                               "cancelled": len(ra.tokens)},
+            "slot_audit": audit}
+
+
+def run_chaos_serve(quick=False, seed=0):
+    import jax
+
+    ex, model, c = _serve_build(True)   # tiny decode model: the faults,
+    # not the shapes, are the thing measured — full mode only widens the
+    # burst
+    stages = {}
+    stages["nan_decode"] = _chaos_serve_nan_decode(ex, model, c, seed)
+    stages["raising_step"] = _chaos_serve_raising_step(ex, model, c,
+                                                       seed)
+    stages["slot_leak"] = _chaos_serve_slot_leak(ex, model, c, seed)
+    stages["stalled_consumer"] = _chaos_serve_stalled_consumer(
+        ex, model, c, seed, quick)
+    stages["overload_burst"] = _chaos_serve_overload(ex, model, c, seed,
+                                                     quick)
+    stages["deadline_cancel"] = _chaos_serve_deadline_cancel(ex, model,
+                                                             c, seed)
+    audits = [s["slot_audit"] for s in stages.values()
+              if "slot_audit" in s]
+    out = {"metric": "chaos_serve_resilience",
+           "value": sum(s["faults_recovered"] for s in stages.values()),
+           "unit": "faults_recovered",
+           "seed": seed,
+           "quick": bool(quick),
+           "platform": jax.default_backend(),
+           "stages": stages,
+           "slot_audit_balanced": all(
+               a["allocs"] == a["frees"] and a["in_use"] == 0
+               for a in audits)}
+    out["all_stages_recovered"] = all(
+        s["faults_recovered"] >= s["faults_injected"]
+        for s in stages.values())
+    return out
+
+
 STAGES = {"bert": bench_bert, "gpt": bench_gpt_layer,
           "gpt_e2e": bench_gpt_e2e, "llama": bench_llama,
           "resnet": bench_resnet, "moe": bench_moe, "wdl": bench_wdl,
@@ -1310,6 +1634,9 @@ def main():
         # chaos mode runs in-process (small shapes; no per-stage HBM
         # pressure): inject faults mid-stage, report recovery + guard
         # overhead.  Same platform selection as stage children.
+        # --chaos --serve injects the SERVING fault classes through the
+        # continuous-batching engine instead (same CHAOS_FULL.json
+        # contract).
         import jax
         if os.environ.get("JAX_PLATFORMS"):
             jax.config.update("jax_platforms",
@@ -1317,7 +1644,10 @@ def main():
         quick = quick or jax.default_backend() == "cpu"
         if telemetry_on:
             _telemetry_on()
-        out = run_chaos(quick)
+        if "--serve" in sys.argv:
+            out = run_chaos_serve(quick)
+        else:
+            out = run_chaos(quick)
         if telemetry_on:
             out["telemetry"] = _telemetry_report()
             out["telemetry_overhead"] = run_telemetry_overhead(quick)

@@ -141,18 +141,27 @@ def save_sharded(executor, path):
     ckptr.wait_until_finished()
 
 
-def load_sharded(executor, path):
-    """Restore a sharded checkpoint into the executor, preserving each
-    value's current device placement/sharding."""
+def restore_sharded_state(executor, path):
+    """Read a sharded (orbax) checkpoint back into a
+    ``Executor.state_dict``-shaped payload WITHOUT mutating the
+    executor — so callers (the rolling checkpoint manager) can validate
+    the restored state and still fall back to an older checkpoint with
+    the live executor untouched."""
     import orbax.checkpoint as ocp
     ckptr = ocp.StandardCheckpointer()
     template = jax.tree_util.tree_map(_abstract, _state_tree(executor))
     state = ckptr.restore(str(path), template)
-    # reuse the single restore contract (Executor.load_state_dict)
-    executor.load_state_dict({
+    return {
         "params": state["params"],
         "opt_state": state["opt_state"],
         "global_step": int(state["meta"]["global_step"]),
         "base_key": state["meta"]["base_key"],
-    })
+    }
+
+
+def load_sharded(executor, path):
+    """Restore a sharded checkpoint into the executor, preserving each
+    value's current device placement/sharding."""
+    # reuse the single restore contract (Executor.load_state_dict)
+    executor.load_state_dict(restore_sharded_state(executor, path))
     return executor

@@ -6,10 +6,10 @@ from .cstable import CacheSparseTable
 from .embedding import PSEmbedding, PSRowsOp
 from .preduce import (PReduceScheduler, PartialReduce, partner_mask,
                       masked_mean_allreduce)
-from .rpc import PSServer, RemoteTable, PartialBulkError
+from .rpc import PSServer, RemoteTable, PartialBulkError, PSUnavailable
 
 __all__ = ["EmbeddingTable", "CacheTable", "ShardedTable", "SSPController",
            "CacheSparseTable", "PSEmbedding", "PSRowsOp",
            "PReduceScheduler", "PartialReduce", "partner_mask",
            "masked_mean_allreduce", "PSServer", "RemoteTable",
-           "PartialBulkError"]
+           "PartialBulkError", "PSUnavailable"]

@@ -80,6 +80,25 @@ def recv_msg(sock):
     return header, payloads
 
 
+class PSUnavailable(ConnectionError):
+    """The PS shard stayed unreachable for the client's whole retry
+    deadline: every reconnect+retransmit attempt failed, so the server
+    is gone (crashed shard, dead network), not congested.  A TYPED
+    terminal error — callers can tell "give up / fail over" from the
+    transient ``ConnectionError``s the retry loop absorbs, instead of
+    string-matching a generic message.  Subclasses ``ConnectionError``
+    so existing handlers keep working."""
+
+    def __init__(self, addr, deadline, attempts, last_error):
+        super().__init__(
+            f"PS {addr} unreachable for {deadline}s "
+            f"({attempts} attempt(s); last error: {last_error})")
+        self.addr = addr
+        self.deadline = deadline
+        self.attempts = int(attempts)
+        self.last_error = last_error
+
+
 # verbs whose re-execution on retransmit is WRONG: push double-applies a
 # gradient, tick double-advances an SSP clock, reduce re-opens a completed
 # group slot (which would then wait forever).  Their REPLIES are cached by
@@ -392,6 +411,11 @@ class RemoteTable:
         self._m_reconnects = reg.counter(
             "hetu_ps_rpc_reconnects_total",
             "Sockets torn down after an error (next attempt reconnects)")
+        self._m_exhausted = reg.counter(
+            "hetu_ps_rpc_exhausted_total",
+            "RPCs whose whole retry deadline elapsed without a reply "
+            "(raised as PSUnavailable)",
+            labels=("verb",))
         if fetch_meta:
             meta = self._call({"verb": "meta"})[0]
             self.rows, self.dim = meta["rows"], meta["dim"]
@@ -473,20 +497,30 @@ class RemoteTable:
                     self._m_reconnects.inc()
                 raise
 
-        retries = self._m_retries.labels(verb=header.get("verb", ""))
+        verb = header.get("verb", "")
+        retries = self._m_retries.labels(verb=verb)
+        attempts = [1]
+
+        def _on_retry(e, attempt, pause):
+            attempts[0] = attempt + 1
+            retries.inc()
+
         try:
             reply, payloads = retry(
                 _attempt, deadline=self._deadline, backoff=0.05,
                 factor=2.0, max_backoff=2.0,
                 retry_on=(ConnectionError, socket.timeout, OSError),
                 giveup=lambda e: self._closed,
-                on_retry=lambda e, attempt, pause: retries.inc())
+                on_retry=_on_retry)
         except (ConnectionError, socket.timeout, OSError) as e:
             if self._closed:
                 raise
-            raise ConnectionError(
-                f"PS {self._addr} unreachable for {self._deadline}s "
-                f"(last error: {e})") from e
+            # every attempt inside the wall-clock deadline failed: the
+            # shard is GONE, not slow — surface the typed terminal error
+            # (and count it) instead of backing off forever
+            self._m_exhausted.labels(verb=verb).inc()
+            raise PSUnavailable(self._addr, self._deadline, attempts[0],
+                                f"{type(e).__name__}: {e}") from e
         finally:
             if pooled:
                 self._release(conn, prio)

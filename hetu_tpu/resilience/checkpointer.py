@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import shutil
 import signal
 import time
 import warnings
@@ -40,6 +41,7 @@ from ..graph.checkpoint import (CheckpointError, atomic_write_bytes,
                                 validate_state)
 
 MANIFEST_NAME = "MANIFEST.json"
+SHARDED_SUFFIX = ".orbax"
 
 
 class RollingCheckpointManager:
@@ -49,18 +51,31 @@ class RollingCheckpointManager:
     and prunes beyond ``keep``; ``restore_latest(executor)`` walks the
     manifest newest-first (plus any on-disk checkpoints a lost manifest
     forgot), skips torn/corrupt/non-finite files with a warning, and
-    loads the first good one.  All paths are single-host pickles — for
-    multi-host sharded state, point ``save_fn``/``restore_fn`` at
-    ``graph.checkpoint.save_sharded``-style writers.
+    loads the first good one.
+
+    ``sharded=True`` switches the payload from a single-host pickle to
+    an orbax SHARD DIRECTORY (``<prefix>-<step>.orbax/``) written via
+    ``graph.checkpoint.save_sharded`` — each host of a multi-host pod
+    writes only its addressable shards, so a 100B-param state never
+    materializes on one machine.  The manifest entry then covers the
+    WHOLE shard set (every file in the directory, with bytes + CRC32),
+    and ``restore_latest`` proves the full set intact before touching
+    the executor: a torn set (file missing, truncated, or corrupt —
+    e.g. a host preempted mid-save) fails that candidate over to an
+    older checkpoint exactly like a torn pickle does.  Rolling
+    retention, the preemption flush hook, and registered PS-table
+    snapshots all work identically in both modes.
     """
 
-    def __init__(self, directory, keep=3, prefix="ckpt", ps_tables=None):
+    def __init__(self, directory, keep=3, prefix="ckpt", ps_tables=None,
+                 sharded=False):
         if int(keep) < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep = int(keep)
         self.prefix = str(prefix)
+        self.sharded = bool(sharded)
         self.preempted = False
         self.last_saved_step = None
         self._prev_handlers = {}
@@ -109,7 +124,12 @@ class RollingCheckpointManager:
         atomic_write_bytes(blob, self._manifest_path())
 
     def _step_of(self, fname):
-        stem = fname[len(self.prefix) + 1:-len(".pkl")]
+        for suffix in (".pkl", SHARDED_SUFFIX):
+            if fname.endswith(suffix):
+                stem = fname[len(self.prefix) + 1:-len(suffix)]
+                break
+        else:
+            return -1
         try:
             return int(stem)
         except ValueError:
@@ -117,16 +137,18 @@ class RollingCheckpointManager:
 
     def entries(self):
         """Known checkpoints, NEWEST first.  Manifest entries carry
-        byte/CRC evidence; bare files found on disk (manifest lost or
-        stale) are still candidates, just unverifiable before unpickle."""
+        byte/CRC evidence; bare files (or shard dirs) found on disk
+        (manifest lost or stale) are still candidates, just unverifiable
+        before unpickle/restore."""
         by_file = {e["file"]: e for e in self._read_manifest()}
         try:
             names = os.listdir(self.directory)
         except OSError:
             names = []
         for n in names:
-            if (n.startswith(self.prefix + "-") and n.endswith(".pkl")
-                    and n not in by_file):
+            if (n.startswith(self.prefix + "-") and n not in by_file
+                    and (n.endswith(".pkl")
+                         or n.endswith(SHARDED_SUFFIX))):
                 by_file[n] = {"file": n, "step": self._step_of(n)}
         return sorted(by_file.values(),
                       key=lambda e: (e.get("step", -1), e["file"]),
@@ -161,20 +183,47 @@ class RollingCheckpointManager:
                        "crc32": zlib.crc32(blob) & 0xFFFFFFFF}
         return out
 
+    def _shard_files(self, path):
+        """Per-file bytes + CRC32 evidence for every file under a shard
+        directory — the manifest entry that lets ``restore_latest``
+        prove a whole shard SET intact before restoring it."""
+        out = {}
+        for dirpath, _dirnames, files in os.walk(path):
+            for fn in sorted(files):
+                fp = os.path.join(dirpath, fn)
+                rel = os.path.relpath(fp, path).replace(os.sep, "/")
+                with open(fp, "rb") as f:
+                    blob = f.read()
+                out[rel] = {"bytes": len(blob),
+                            "crc32": zlib.crc32(blob) & 0xFFFFFFFF}
+        return out
+
     def save(self, executor, step=None):
         """Atomically checkpoint the executor (plus any registered PS
-        tables); returns the file path."""
+        tables); returns the file (or shard-directory) path."""
         t0 = time.perf_counter()
-        state = executor.state_dict()
-        if step is None:
-            step = int(state.get("global_step", 0))
-        blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
-        fname = f"{self.prefix}-{int(step):010d}.pkl"
-        path = os.path.join(self.directory, fname)
-        atomic_write_bytes(blob, path)
-        entry = {"step": int(step), "file": fname,
-                 "bytes": len(blob),
-                 "crc32": zlib.crc32(blob) & 0xFFFFFFFF}
+        if self.sharded:
+            if step is None:
+                step = int(executor._global_step)
+            fname = f"{self.prefix}-{int(step):010d}{SHARDED_SUFFIX}"
+            # orbax requires an absolute target path
+            path = os.path.abspath(os.path.join(self.directory, fname))
+            from ..graph.checkpoint import save_sharded
+            save_sharded(executor, path)
+            entry = {"step": int(step), "file": fname,
+                     "kind": "sharded",
+                     "files": self._shard_files(path)}
+        else:
+            state = executor.state_dict()
+            if step is None:
+                step = int(state.get("global_step", 0))
+            blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+            fname = f"{self.prefix}-{int(step):010d}.pkl"
+            path = os.path.join(self.directory, fname)
+            atomic_write_bytes(blob, path)
+            entry = {"step": int(step), "file": fname,
+                     "bytes": len(blob),
+                     "crc32": zlib.crc32(blob) & 0xFFFFFFFF}
         if self.ps_tables:
             entry["ps"] = self._save_ps_snapshots(step)
         entries = [e for e in self._read_manifest()
@@ -189,8 +238,12 @@ class RollingCheckpointManager:
             victims = [e["file"]] + [p["file"]
                                      for p in e.get("ps", {}).values()]
             for vf in victims:
+                vp = os.path.join(self.directory, vf)
                 try:
-                    os.remove(os.path.join(self.directory, vf))
+                    if os.path.isdir(vp):
+                        shutil.rmtree(vp, ignore_errors=True)
+                    else:
+                        os.remove(vp)
                 except OSError:
                     pass    # already gone / shared-fs race: retention is
                     # best-effort, correctness lives in the manifest
@@ -209,6 +262,16 @@ class RollingCheckpointManager:
         return None
 
     # -- restore -----------------------------------------------------------
+    @staticmethod
+    def _check_finite_params(state):
+        for name, v in state["params"].items():
+            arr = np.asarray(v)
+            if (np.issubdtype(arr.dtype, np.floating)
+                    and not np.isfinite(arr).all()):
+                raise CheckpointError(
+                    f"param {name!r} has non-finite values — "
+                    "checkpoint captured an already-corrupted run")
+
     def _read_verified(self, path, entry, check_finite):
         with open(path, "rb") as f:
             blob = f.read()
@@ -226,13 +289,52 @@ class RollingCheckpointManager:
                 f"unreadable pickle ({type(e).__name__}: {e})") from e
         validate_state(state, source=path)
         if check_finite:
-            for name, v in state["params"].items():
-                arr = np.asarray(v)
-                if (np.issubdtype(arr.dtype, np.floating)
-                        and not np.isfinite(arr).all()):
+            self._check_finite_params(state)
+        return state
+
+    def _read_verified_sharded(self, executor, path, entry,
+                               check_finite):
+        """Prove the whole shard SET intact against the manifest (every
+        file present, byte-exact, CRC-clean), then restore it to a
+        host-side state WITHOUT touching the executor — a torn set
+        (preempted host mid-save) fails this candidate over to an older
+        checkpoint with the live state unharmed."""
+        if not os.path.isdir(path):
+            raise CheckpointError("shard directory missing")
+        files = entry.get("files")
+        if files:
+            for rel, meta in files.items():
+                fp = os.path.join(path, rel)
+                try:
+                    with open(fp, "rb") as f:
+                        blob = f.read()
+                except OSError as e:
                     raise CheckpointError(
-                        f"param {name!r} has non-finite values — "
-                        "checkpoint captured an already-corrupted run")
+                        f"shard file {rel} unreadable ({e}) — torn "
+                        "shard set") from e
+                if "bytes" in meta and len(blob) != meta["bytes"]:
+                    raise CheckpointError(
+                        f"shard file {rel} size mismatch ({len(blob)} "
+                        f"!= {meta['bytes']}) — torn shard set")
+                if ("crc32" in meta and zlib.crc32(blob) & 0xFFFFFFFF
+                        != meta["crc32"]):
+                    raise CheckpointError(
+                        f"shard file {rel} CRC mismatch — corrupt "
+                        "shard")
+        else:
+            warnings.warn(
+                f"shard dir {entry['file']} has no manifest evidence "
+                "(manifest lost?) — restoring unverified")
+        from ..graph.checkpoint import restore_sharded_state
+        try:
+            state = restore_sharded_state(executor, path)
+        except Exception as e:   # orbax raises a zoo on torn/invalid sets
+            raise CheckpointError(
+                f"unrestorable shard set "
+                f"({type(e).__name__}: {e})") from e
+        validate_state(state, source=path)
+        if check_finite:
+            self._check_finite_params(state)
         return state
 
     def _verify_ps_snapshots(self, entry):
@@ -276,8 +378,15 @@ class RollingCheckpointManager:
         tried = []
         for entry in self.entries():
             path = os.path.join(self.directory, entry["file"])
+            sharded = (entry.get("kind") == "sharded"
+                       or entry["file"].endswith(SHARDED_SUFFIX))
             try:
-                state = self._read_verified(path, entry, check_finite)
+                if sharded:
+                    state = self._read_verified_sharded(
+                        executor, path, entry, check_finite)
+                else:
+                    state = self._read_verified(path, entry,
+                                                check_finite)
                 ps_paths = self._verify_ps_snapshots(entry)
             except (CheckpointError, OSError) as e:
                 tried.append(f"{entry['file']}: {e}")

@@ -144,6 +144,76 @@ def drop_rpc(table, calls=1):
     return lambda: setattr(table, "_call", orig)
 
 
+# -- serving faults --------------------------------------------------------
+# The serving-engine counterparts of the training-path faults: each one
+# makes a production failure of the continuous-batching engine happen at
+# a KNOWN place (bench.py --chaos --serve and tests/test_serving_
+# robustness.py drive them).
+
+def poison_slot_kv(engine, slot, value=np.nan):
+    """Poison one slot's K/V cache rows with ``value`` — a corrupted HBM
+    row / overflowed activation deposited into the pooled cache.  The
+    next decode step's logits for THAT slot (and only that slot — slots
+    attend their own rows) go non-finite, which is exactly what the
+    engine's in-graph watchdog sentinel must flag."""
+    import jax.numpy as jnp
+
+    slot = int(slot)
+    engine.cache.k = engine.cache.k.at[slot].set(value)
+    engine.cache.v = engine.cache.v.at[slot].set(value)
+    return slot
+
+
+def raising_engine_step(engine, at, exc=None):
+    """Make the engine's ``at``-th decode-step CALL (0-based, counted
+    from now) raise (default :class:`InjectedFault`) BEFORE dispatch —
+    a poisoned executable / runtime failure the host sees as an
+    exception, not a sentinel.  Returns an undo callable."""
+    orig = engine._step_fn
+    state = {"n": 0}
+
+    def wrapped(*args, **kw):
+        n = state["n"]
+        state["n"] += 1
+        if n == int(at):
+            raise exc if exc is not None else InjectedFault(
+                f"injected decode-step failure at call {at}")
+        return orig(*args, **kw)
+
+    engine._step_fn = wrapped
+    return lambda: setattr(engine, "_step_fn", orig)
+
+
+def leak_slot(engine):
+    """Allocate a KV slot that NO request owns — the accounting leak a
+    crashed request path leaves behind.  Without the engine's reconcile
+    sweep the slot never returns to the pool and admission eventually
+    starves; with it, the sweep frees the orphan within one iteration.
+    Returns the leaked slot id (None if the pool is already full)."""
+    return engine.cache.alloc(owner="__injected_leak__")
+
+
+def stalling_consumer(seconds, collect=None, fail_after=None):
+    """A stream callback that STALLS ``seconds`` on every delivery (a
+    slow/blocked client holding the decode loop hostage) and, when
+    ``fail_after`` is set, raises :class:`InjectedFault` from the
+    ``fail_after``-th call onward (a disconnected client).  ``collect``
+    (a list) receives the tokens that were delivered."""
+    state = {"n": 0}
+
+    def cb(tok, req):
+        state["n"] += 1
+        if collect is not None:
+            collect.append(int(tok))
+        if fail_after is not None and state["n"] > int(fail_after):
+            raise InjectedFault(
+                f"injected consumer failure at delivery {state['n']}")
+        if seconds:
+            time.sleep(float(seconds))
+
+    return cb
+
+
 # -- files & process -------------------------------------------------------
 
 def tear_file(path, frac=0.5, keep_bytes=None):

@@ -1,15 +1,21 @@
 """Continuous-batching inference serving over the KV-cache decoders.
 
 Slot-pooled K/V cache (kv_cache.py) + iteration-level FIFO scheduler
-(scheduler.py) + slot-batched model adapters (adapters.py) + the
-engine tying them together (engine.py).  ``bench.py --serve`` replays a
-Poisson arrival trace through the engine and its static-batch twin.
+with bounded-queue admission control (scheduler.py) + slot-batched
+model adapters (adapters.py) + the engine tying them together with
+per-request deadlines, cancellation, and a decode watchdog (engine.py).
+``bench.py --serve`` replays a Poisson arrival trace through the engine
+and its static-batch twin; ``bench.py --chaos --serve`` injects serving
+faults (poisoned decode, raising step, slot leaks, stalled consumers,
+arrival bursts) and proves the engine survives them.
 """
 
 from .kv_cache import SlotKVCache
-from .scheduler import Request, Scheduler
+from .scheduler import (EngineOverloaded, Request, Scheduler,
+                        FINISH_REASONS, SHED_POLICIES)
 from .adapters import (LlamaSlotAdapter, GPTSlotAdapter, adapter_for)
 from .engine import InferenceEngine
 
-__all__ = ["SlotKVCache", "Request", "Scheduler", "LlamaSlotAdapter",
+__all__ = ["SlotKVCache", "Request", "Scheduler", "EngineOverloaded",
+           "FINISH_REASONS", "SHED_POLICIES", "LlamaSlotAdapter",
            "GPTSlotAdapter", "adapter_for", "InferenceEngine"]

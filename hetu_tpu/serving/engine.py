@@ -13,6 +13,44 @@ model into exactly TWO jitted programs whose shapes never change:
   slots compute masked garbage — the price of a static shape — and
   their outputs are discarded host-side.
 
+Both programs also return a FINITENESS SENTINEL computed in-graph (the
+StepGuard idea from the training path, re-hosted per slot): ``prefill``
+returns one ok scalar for its logits row, ``step`` returns a per-slot
+ok vector.  The sentinel rides the same fusion as the logits reduction,
+so the protected and unprotected engines run the SAME executable — the
+watchdog is a host-side decision about what to do with the bit, not a
+different program.
+
+Failure surface (all enabled by default, see the ctor):
+
+* **admission control** — ``max_queue`` bounds the waiting line;
+  ``submit`` raises :class:`~.scheduler.EngineOverloaded` (with a
+  queue-depth hint) once the high watermark is hit, reopening at the
+  low watermark (scheduler.py documents the shed policies);
+* **deadlines** — ``submit(..., ttl=)`` / ``deadline=`` attaches a TTL
+  checked at admission and once per iteration; an expired request
+  frees its KV slot immediately mid-flight and finishes with
+  ``finish_reason="deadline"`` carrying its partial tokens;
+* **cancellation** — ``cancel(rid)`` removes a queued request or
+  retires a running one mid-flight (``finish_reason="cancelled"``,
+  partial tokens, slot freed on the spot);
+* **decode watchdog** — a slot whose logits go non-finite (poisoned
+  KV, overflowed activation) is QUARANTINED: retired with
+  ``finish_reason="error"``, its slot reclaimed, the other slots'
+  token streams untouched — the engine loop survives the fault the
+  way the training path survives a NaN batch.  A RAISING jitted step
+  cannot be attributed to one slot, so it retires everything in
+  flight with "error" and keeps the engine alive for new work.
+  ``watchdog=False`` builds the unprotected twin the chaos bench
+  wedges for contrast;
+* **slot-leak reconcile** — any cache slot owned by nobody (a leak,
+  however induced) is swept back to the free list each iteration;
+* **consumer protection** — a stream callback that raises is detached
+  (the request keeps decoding, tokens land in ``result()``); with
+  ``stream_stall_timeout`` set, a callback that stalls longer than the
+  bound is detached too, so one stuck client can't hold the whole
+  batch hostage more than once.
+
 Because every call sees identical shapes, XLA compiles each program
 once — and the compiled pair is SHARED across engine instances with the
 same (model, sampling) signature, so twins/rebuilds reuse the same
@@ -29,10 +67,12 @@ queue-wait land in ``records`` as plain dicts; summarize with
 
 Usage::
 
-    engine = InferenceEngine(ex, model, n_slots=8, max_len=256)
+    engine = InferenceEngine(ex, model, n_slots=8, max_len=256,
+                             max_queue=64)
     outs = engine.generate_many(prompts, max_new=64)      # batch API
-    h = engine.submit(prompt, max_new=64,
+    h = engine.submit(prompt, max_new=64, ttl=2.0,
                       stream=lambda tok, req: print(tok)) # callback API
+    engine.cancel(h.rid)                                  # mid-flight
     for tok in engine.stream(prompt, max_new=64):         # generator API
         ...
 """
@@ -40,6 +80,7 @@ Usage::
 from __future__ import annotations
 
 import time
+import warnings
 
 import numpy as np
 
@@ -59,12 +100,17 @@ class InferenceEngine:
     ``gang=True`` degrades scheduling to static batching (admit only
     when every slot is free) — the serve bench's baseline twin; the
     numerics and jitted programs are identical, only admission differs.
+    ``watchdog=False`` disables every host-side protection (quarantine,
+    exception containment, leak reconcile) — the chaos bench's
+    unprotected twin; the jitted programs are still identical.
     """
 
     def __init__(self, executor, model, n_slots=4, max_len=128,
                  max_prompt_len=None, prefill_budget=2, eos_id=None,
                  temperature=0.0, top_k=0, seed=0, name=None,
-                 gang=False):
+                 gang=False, max_queue=None, low_watermark=None,
+                 shed_policy="reject_newest", watchdog=True,
+                 stream_stall_timeout=None, clock=None):
         self.params = executor.params
         name = name or param_prefix(
             executor, "_embed_table"
@@ -87,8 +133,15 @@ class InferenceEngine:
             self.max_len, self.adapter.head_dim, dtype=emb.dtype)
         self.scheduler = Scheduler(self.cache,
                                    prefill_budget=prefill_budget,
-                                   gang=gang)
+                                   gang=gang, max_queue=max_queue,
+                                   low_watermark=low_watermark,
+                                   shed_policy=shed_policy)
         self.eos_id = eos_id
+        self.watchdog = bool(watchdog)
+        self.stream_stall_timeout = (
+            None if stream_stall_timeout is None
+            else float(stream_stall_timeout))
+        self._clock = clock if clock is not None else time.perf_counter
         self._sampling = (float(temperature), int(top_k))
         self._pick = make_picker(temperature, top_k)
         self._key = jax.random.key(seed)
@@ -100,6 +153,11 @@ class InferenceEngine:
         self.occupancy = []
         self.decode_steps = 0
         self.prefills = 0
+        self.cancellations = 0
+        self.expirations = 0
+        self.watchdog_trips = 0
+        self.slot_leaks_reclaimed = 0
+        self.streams_detached = 0
         mode = "gang" if gang else "continuous"
         reg = _telemetry.get_registry()
 
@@ -119,7 +177,24 @@ class InferenceEngine:
             "counter", "hetu_serving_decode_iterations_total",
             "Slot-batched decode iterations run")
         self._m_finished = _m("counter", "hetu_serving_requests_total",
-                              "Requests retired (eos or max_new)")
+                              "Requests retired (any finish_reason)")
+        self._m_cancelled = _m(
+            "counter", "hetu_serving_cancellations_total",
+            "Requests cancelled via engine.cancel (queued or running)")
+        self._m_expired = _m(
+            "counter", "hetu_serving_deadline_expired_total",
+            "Requests retired because their deadline passed")
+        self._m_watchdog = _m(
+            "counter", "hetu_serving_watchdog_trips_total",
+            "Decode watchdog quarantines (non-finite logits or a "
+            "raising step)")
+        self._m_leaks = _m(
+            "counter", "hetu_serving_slot_leaks_reclaimed_total",
+            "Orphaned KV slots swept back to the free list")
+        self._m_detached = _m(
+            "counter", "hetu_serving_streams_detached_total",
+            "Stream callbacks detached (raised or stalled past the "
+            "bound)")
         self._m_ttft = _m("histogram", "hetu_serving_ttft_seconds",
                           "Time to first token (arrival -> first emit)")
         self._m_tpot = _m("histogram", "hetu_serving_tpot_seconds",
@@ -140,6 +215,9 @@ class InferenceEngine:
     #   tier-1 flakes in the serving determinism/twin tests), so "the
     #   twin runs the same programs" must mean the same EXECUTABLE, not
     #   a byte-equivalent recompile.
+    # The watchdog sentinel is part of the program for EVERY engine
+    # (protected and unprotected alike) for the same reason: the
+    # executable must be identical so protection is a host-side choice.
     _PROGRAMS = {}
 
     def _program_key(self):
@@ -170,16 +248,23 @@ class InferenceEngine:
                                                  (slot, 0, 0, 0, 0))
                 row = jax.lax.dynamic_slice_in_dim(logits, p_len - 1, 1,
                                                    0)
+                # watchdog sentinel: finiteness of the row that seeds
+                # the request (fuses with the logits reduction)
+                ok = jnp.all(jnp.isfinite(row))
                 tok = pick(row, key)[0].astype(jnp.int32)
-                return k, v, tok
+                return k, v, tok, ok
 
             def step(params, k, v, tokens, positions, active, key):
                 traces["step"] += 1        # host-side retrace witness
                 retrace.labels(program="step").inc()
                 logits, k, v = adapter.decode(params, tokens, positions,
                                               k, v)
+                # per-slot watchdog sentinel: a poisoned slot flags ONLY
+                # itself (slots attend their own cache rows only), so
+                # the host can quarantine it without touching the rest
+                slot_ok = jnp.all(jnp.isfinite(logits), axis=-1)
                 nxt = pick(logits, key).astype(jnp.int32)
-                return k, v, jnp.where(active, nxt, 0)
+                return k, v, jnp.where(active, nxt, 0), slot_ok
 
             # donate the cache buffers so the pool is updated in place
             # on accelerator backends (on CPU jax cannot donate; skip
@@ -206,9 +291,15 @@ class InferenceEngine:
 
     # -- request API -------------------------------------------------------
     def submit(self, prompt, max_new, stream=None, eos_id=None,
-               arrival=None):
+               arrival=None, deadline=None, ttl=None):
         """Queue one generation request; returns its Request handle.
-        ``stream(token, request)`` is called per generated token."""
+        ``stream(token, request)`` is called per generated token.
+        ``ttl`` (seconds from now) or ``deadline`` (absolute, on the
+        engine's monotonic clock) bounds the request's lifetime: past
+        it, the request finishes with ``finish_reason="deadline"`` and
+        whatever tokens it produced.  Raises
+        :class:`~.scheduler.EngineOverloaded` when the bounded queue
+        refuses admission."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size > self.max_prompt_len:
             raise ValueError(
@@ -219,15 +310,52 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new ({max_new}) exceeds "
                 f"max_len={self.max_len}")
+        now = self._now()
+        if ttl is not None:
+            if deadline is not None:
+                raise ValueError("pass ttl= or deadline=, not both")
+            if ttl <= 0:
+                raise ValueError(f"ttl must be > 0, got {ttl}")
+            deadline = now + float(ttl)
         req = Request(prompt, max_new,
-                      arrival=self._now() if arrival is None else arrival,
+                      arrival=now if arrival is None else arrival,
                       stream=stream,
-                      eos_id=self.eos_id if eos_id is None else eos_id)
-        return self.scheduler.submit(req)
+                      eos_id=self.eos_id if eos_id is None else eos_id,
+                      deadline=deadline)
+        try:
+            self.scheduler.submit(req, now=now)
+        finally:
+            # drop_expired_first may have shed dead seats even when the
+            # newcomer was still refused — their records must not be lost
+            for shed in self.scheduler.drain_shed():
+                self.expirations += 1
+                self._m_expired.inc()
+                self._finalize_unadmitted(shed, "deadline", now)
+        return req
 
-    @staticmethod
-    def _now():
-        return time.perf_counter()
+    def cancel(self, rid):
+        """Cancel the live request with this rid: a queued request
+        leaves the queue, a running one is retired MID-FLIGHT (slot
+        freed immediately).  Either way it finishes with
+        ``finish_reason="cancelled"`` and its partial tokens in
+        ``result()``.  Returns True if a live request was cancelled,
+        False if the rid is unknown or already finished."""
+        req = self.scheduler.find(rid)
+        if req is None:
+            return False
+        now = self._now()
+        req.cancel_requested = True
+        if req.slot is not None:
+            self._finalize_active(req, "cancelled", now)
+        else:
+            self.scheduler.remove_queued(req)
+            self._finalize_unadmitted(req, "cancelled", now)
+        self.cancellations += 1
+        self._m_cancelled.inc()
+        return True
+
+    def _now(self):
+        return self._clock()
 
     def _emit(self, req, tok, now):
         req.tokens.append(int(tok))
@@ -235,50 +363,140 @@ class InferenceEngine:
         if req.t_first is None:
             req.t_first = now
         if req.stream is not None:
-            req.stream(int(tok), req)
+            t0 = self._clock()
+            try:
+                req.stream(int(tok), req)
+            except Exception as e:
+                if not self.watchdog:
+                    raise
+                # a raising consumer is the CLIENT's fault — detach it
+                # and keep decoding; the tokens still land in result()
+                req.stream = None
+                self.streams_detached += 1
+                self._m_detached.inc()
+                warnings.warn(
+                    f"stream callback for request {req.rid} raised "
+                    f"{type(e).__name__}: {e} — detached (decode "
+                    "continues, tokens land in result())")
+                return
+            if (self.stream_stall_timeout is not None
+                    and self._clock() - t0 > self.stream_stall_timeout):
+                # one stalled delivery already cost a full iteration for
+                # every slot; don't let it happen again
+                req.stream = None
+                self.streams_detached += 1
+                self._m_detached.inc()
+                warnings.warn(
+                    f"stream callback for request {req.rid} stalled "
+                    f"longer than {self.stream_stall_timeout}s — "
+                    "detached (decode continues)")
+
+    def _record(self, req):
+        self.records.append({
+            "id": req.rid, "prompt_len": int(req.prompt.size),
+            "n_tokens": len(req.tokens),
+            "queue_wait": req.queue_wait, "ttft": req.ttft,
+            "tpot": req.tpot, "finish_reason": req.finish_reason})
+        # registry mirror of the record: the same latencies land in
+        # scrape-able histograms without changing records' shape
+        self._m_finished.inc()
+        for m, v in ((self._m_qwait, req.queue_wait),
+                     (self._m_ttft, req.ttft),
+                     (self._m_tpot, req.tpot)):
+            if v is not None:
+                m.observe(v)
+
+    def _finalize_active(self, req, reason, now):
+        """Retire a RUNNING request (slot freed immediately)."""
+        req.t_done = now
+        self.scheduler.retire(req, reason)
+        self._record(req)
+
+    def _finalize_unadmitted(self, req, reason, now):
+        """Finish a request that never held a slot (expired or
+        cancelled while queued): zero tokens, ttft None."""
+        req.t_done = now
+        req.finished = True
+        req.finish_reason = reason
+        self._record(req)
 
     def _maybe_retire(self, req, tok, now):
         done_eos = req.eos_id is not None and int(tok) == req.eos_id
         if done_eos or len(req.tokens) >= req.max_new:
-            req.t_done = now
-            self.scheduler.retire(req, "eos" if done_eos else "max_new")
-            self.records.append({
-                "id": req.rid, "prompt_len": int(req.prompt.size),
-                "n_tokens": len(req.tokens),
-                "queue_wait": req.queue_wait, "ttft": req.ttft,
-                "tpot": req.tpot, "finish_reason": req.finish_reason})
-            # registry mirror of the record: the same latencies land in
-            # scrape-able histograms without changing records' shape
-            self._m_finished.inc()
-            for m, v in ((self._m_qwait, req.queue_wait),
-                         (self._m_ttft, req.ttft),
-                         (self._m_tpot, req.tpot)):
-                if v is not None:
-                    m.observe(v)
+            self._finalize_active(req, "eos" if done_eos else "max_new",
+                                  now)
+
+    def _expire(self, now):
+        """Deadline sweep: queued requests past their deadline finish
+        without ever taking a slot; running ones retire mid-flight with
+        their partial tokens."""
+        for req in self.scheduler.take_expired(now):
+            self.expirations += 1
+            self._m_expired.inc()
+            self._finalize_unadmitted(req, "deadline", now)
+        expired = [r for r in self.scheduler.running.values()
+                   if r.expired(now)]
+        for req in expired:
+            self.expirations += 1
+            self._m_expired.inc()
+            self._finalize_active(req, "deadline", now)
+
+    def _quarantine_all(self, reason, now):
+        """A fault that cannot be attributed to one slot (the jitted
+        step itself raised): retire everything in flight with "error"
+        and keep the engine alive for new work."""
+        for req in list(self.scheduler.running.values()):
+            self._finalize_active(req, "error", now)
+        self.watchdog_trips += 1
+        self._m_watchdog.inc()
+        warnings.warn(
+            f"decode watchdog: {reason} — all in-flight requests "
+            "retired with finish_reason='error'; engine continues")
 
     # -- the iteration -----------------------------------------------------
     def step(self):
-        """One scheduler iteration: admit + prefill new requests, then
-        one fused decode step for everything in flight.  Returns the
-        number of tokens produced."""
+        """One scheduler iteration: expire/admit/prefill, then one fused
+        decode step for everything in flight.  Returns the number of
+        tokens produced."""
         produced = 0
+        self._expire(self._now())
         # 1) admission: prefill up to the budget into free slots
         for req, slot in self.scheduler.admit():
             req.t_admit = self._now()
             padded, _ = pad_prompts([req.prompt],
                                     pad_to=self.max_prompt_len)
-            with self._tr.span("serve_prefill"):
-                k, v, tok = self._prefill_fn(
-                    self.params, self.cache.k, self.cache.v,
-                    jnp.asarray(padded), req.prompt.size, slot,
-                    self._next_key())
-                self.cache.update(k, v)
-                self.cache.positions[slot] = req.prompt.size
-                tok = int(np.asarray(tok))
+            try:
+                with self._tr.span("serve_prefill"):
+                    k, v, tok, ok = self._prefill_fn(
+                        self.params, self.cache.k, self.cache.v,
+                        jnp.asarray(padded), req.prompt.size, slot,
+                        self._next_key())
+                    self.cache.update(k, v)
+                    self.cache.positions[slot] = req.prompt.size
+                    tok = int(np.asarray(tok))
+                    ok = bool(np.asarray(ok))
+            except Exception as e:
+                if not self.watchdog:
+                    raise
+                self.watchdog_trips += 1
+                self._m_watchdog.inc()
+                warnings.warn(
+                    f"decode watchdog: prefill of request {req.rid} "
+                    f"raised {type(e).__name__}: {e} — quarantined")
+                self._finalize_active(req, "error", self._now())
+                continue
             self.prefills += 1
             self._m_prefill_iters.inc()
-            self._last_tokens[slot] = tok
             now = self._now()
+            if self.watchdog and not ok:
+                self.watchdog_trips += 1
+                self._m_watchdog.inc()
+                warnings.warn(
+                    f"decode watchdog: non-finite prefill logits for "
+                    f"request {req.rid} — quarantined")
+                self._finalize_active(req, "error", now)
+                continue
+            self._last_tokens[slot] = tok
             self._emit(req, tok, now)
             produced += 1
             self._maybe_retire(req, tok, now)
@@ -290,32 +508,66 @@ class InferenceEngine:
             occ = len(slots) / self.cache.n_slots
             self.occupancy.append(occ)
             self._m_occ.set(occ)
-            with self._tr.span("serve_decode"):
-                # _last_tokens is mutated in place per emitted token, so
-                # upload a SNAPSHOT: on the CPU backend jnp.asarray may
-                # alias the host buffer / defer the copy, and the
-                # post-dispatch mutation raced the pending read
-                # (nondeterministic streams — the tier-1 serving flake)
-                k, v, nxt = self._step_fn(
-                    self.params, self.cache.k, self.cache.v,
-                    jnp.asarray(self._last_tokens.copy()),
-                    self.cache.device_positions(), jnp.asarray(active),
-                    self._next_key())
-                self.cache.update(k, v)
-                self.cache.advance(slots)
-                # materialize INSIDE the span: this is where the host
-                # actually waits for the decode iteration
-                nxt = np.asarray(nxt)
+            try:
+                with self._tr.span("serve_decode"):
+                    # _last_tokens is mutated in place per emitted token,
+                    # so upload a SNAPSHOT: on the CPU backend
+                    # jnp.asarray may alias the host buffer / defer the
+                    # copy, and the post-dispatch mutation raced the
+                    # pending read (nondeterministic streams — the
+                    # tier-1 serving flake)
+                    k, v, nxt, slot_ok = self._step_fn(
+                        self.params, self.cache.k, self.cache.v,
+                        jnp.asarray(self._last_tokens.copy()),
+                        self.cache.device_positions(),
+                        jnp.asarray(active), self._next_key())
+                    self.cache.update(k, v)
+                    self.cache.advance(slots)
+                    # materialize INSIDE the span: this is where the
+                    # host actually waits for the decode iteration
+                    nxt = np.asarray(nxt)
+                    slot_ok = np.asarray(slot_ok)
+            except Exception as e:
+                if not self.watchdog:
+                    raise
+                self._quarantine_all(
+                    f"decode step raised {type(e).__name__}: {e}",
+                    self._now())
+                return produced
             self.decode_steps += 1
             self._m_decode_iters.inc()
             now = self._now()
             for slot in slots:
                 req = self.scheduler.running[slot]
+                if self.watchdog and not slot_ok[slot]:
+                    # quarantine: only THIS slot is poisoned (slots
+                    # attend their own cache rows only); the bad token
+                    # is never emitted, the slot is reclaimed, and the
+                    # other streams stay bitwise identical
+                    self.watchdog_trips += 1
+                    self._m_watchdog.inc()
+                    warnings.warn(
+                        f"decode watchdog: non-finite logits in slot "
+                        f"{slot} (request {req.rid}) — quarantined")
+                    self._finalize_active(req, "error", now)
+                    continue
                 tok = int(nxt[slot])
                 self._last_tokens[slot] = tok
                 self._emit(req, tok, now)
                 produced += 1
                 self._maybe_retire(req, tok, now)
+        # 3) leak sweep: a slot owned by nobody can never be retired
+        # through the request path — reclaim it so the pool cannot
+        # starve (cheap: one int comparison in the healthy case)
+        if (self.watchdog
+                and self.cache.n_active != len(self.scheduler.running)):
+            reclaimed = self.scheduler.reconcile()
+            if reclaimed:
+                self.slot_leaks_reclaimed += reclaimed
+                self._m_leaks.inc(reclaimed)
+                warnings.warn(
+                    f"slot reconcile: reclaimed {reclaimed} leaked KV "
+                    "slot(s)")
         return produced
 
     def run(self, max_iterations=None):
@@ -337,11 +589,11 @@ class InferenceEngine:
         self.run(max_iterations=(len(reqs) + 1) * (self.max_len + 2))
         return [r.result() for r in reqs]
 
-    def stream(self, prompt, max_new, eos_id=None):
+    def stream(self, prompt, max_new, eos_id=None, ttl=None):
         """Generator API: yields tokens as the engine produces them
         (pumping the engine between yields; other in-flight requests
         advance too)."""
-        req = self.submit(prompt, max_new, eos_id=eos_id)
+        req = self.submit(prompt, max_new, eos_id=eos_id, ttl=ttl)
         emitted = 0
         guard = (self.max_len + 2) * (len(self.scheduler.queue)
                                       + self.cache.n_slots + 1)
@@ -364,6 +616,11 @@ class InferenceEngine:
         self.occupancy = []
         self.decode_steps = 0
         self.prefills = 0
+        self.cancellations = 0
+        self.expirations = 0
+        self.watchdog_trips = 0
+        self.slot_leaks_reclaimed = 0
+        self.streams_detached = 0
 
     # -- reporting ---------------------------------------------------------
     def stats(self):
@@ -375,4 +632,11 @@ class InferenceEngine:
                 "requests_finished": len(self.records),
                 "slot_allocs": self.cache.alloc_count,
                 "slot_frees": self.cache.free_count,
+                "rejections": self.scheduler.rejected,
+                "queue_depth_peak": self.scheduler.queue_depth_peak,
+                "cancellations": self.cancellations,
+                "expirations": self.expirations,
+                "watchdog_trips": self.watchdog_trips,
+                "slot_leaks_reclaimed": self.slot_leaks_reclaimed,
+                "streams_detached": self.streams_detached,
                 "trace_counts": self.trace_counts}

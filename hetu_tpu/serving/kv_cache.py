@@ -86,6 +86,20 @@ class SlotKVCache:
     def owner(self, slot):
         return self._owner[slot]
 
+    def allocated_slots(self):
+        """Slots currently claimed (not on the free list), sorted."""
+        free = set(self._free)
+        return [s for s in range(self.n_slots) if s not in free]
+
+    def audit(self):
+        """Lifetime alloc/free accounting for the no-leak invariant the
+        chaos bench asserts: after a drain, ``allocs == frees`` and
+        ``in_use == 0`` — anything else means a slot leaked (lost to a
+        crashed request) and the pool will eventually starve."""
+        return {"allocs": self.alloc_count,
+                "frees": self.free_count,
+                "in_use": self.n_active}
+
     # -- step plumbing -----------------------------------------------------
     def device_positions(self):
         # SNAPSHOT, not view: on the CPU backend jnp.asarray may alias

@@ -15,7 +15,30 @@ no-ops, not absent).
 baseline twin the serve bench compares against: admission waits until
 EVERY slot is free, then fills the whole pool at once — requests that
 finish early leave their slots idle until the stragglers drain, exactly
-the occupancy collapse continuous batching removes."""
+the occupancy collapse continuous batching removes.
+
+Admission control (``max_queue``): production engines die by queue, not
+by compute — an arrival burst that outruns decode grows the waiting
+line without bound until every queued request is past its deadline and
+the host is out of memory.  A bounded queue with watermark hysteresis
+sheds load at the door instead: once depth hits ``max_queue`` the
+scheduler REJECTS new work (typed :class:`EngineOverloaded`, carrying
+the depth so clients can back off) until the queue drains to
+``low_watermark`` — the hysteresis stops the accept/reject flapping a
+single hard bound produces at saturation.  Two documented shed
+policies:
+
+* ``"reject_newest"`` (default) — the incoming request is refused;
+  everything already queued keeps its FIFO position.  Predictable for
+  clients (admission is decided at submit time, never revoked) and the
+  right default when requests have no deadlines.
+* ``"drop_expired_first"`` — before refusing, queued requests whose
+  deadline has already passed are shed (they would be expired at
+  admission anyway and are only holding seats); the incoming request is
+  refused only if the queue is still full.  Strictly better goodput
+  when deadlines are in play — a seat held by a dead request serves
+  nobody.
+"""
 
 from __future__ import annotations
 
@@ -26,15 +49,41 @@ import numpy as np
 
 from .. import telemetry as _telemetry
 
+#: every terminal state a request can reach.  "eos"/"max_new" are the
+#: healthy ones; "deadline" (TTL passed — at admission or mid-flight),
+#: "cancelled" (engine.cancel / scheduler shed), and "error" (decode
+#: watchdog quarantined the slot) all return whatever tokens were
+#: produced so far as a PARTIAL result.
+FINISH_REASONS = ("eos", "max_new", "deadline", "cancelled", "error")
+
+SHED_POLICIES = ("reject_newest", "drop_expired_first")
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission refused: the request queue is at (or draining from) its
+    bound.  Carries ``queue_depth``/``max_queue`` so a client can size
+    its backoff instead of guessing."""
+
+    def __init__(self, queue_depth, max_queue):
+        super().__init__(
+            f"engine overloaded: {queue_depth} requests queued "
+            f"(max_queue={max_queue}) — retry after the queue drains")
+        self.queue_depth = int(queue_depth)
+        self.max_queue = int(max_queue)
+
 
 class Request:
-    """One generation request and its lifecycle timestamps."""
+    """One generation request and its lifecycle timestamps.
 
-    _ids = itertools.count()
+    ``rid`` is assigned by the scheduler at submit time (ids are scoped
+    PER SCHEDULER, not process-global: two engines each number their
+    requests 0, 1, 2, …, so id-keyed records are deterministic per run
+    and never collide across engines or leak across tests).
+    """
 
     def __init__(self, prompt, max_new, arrival=None, stream=None,
-                 eos_id=None):
-        self.rid = next(self._ids)
+                 eos_id=None, deadline=None):
+        self.rid = None           # scheduler-scoped, set on submit
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError("empty prompt")
@@ -43,15 +92,21 @@ class Request:
         self.max_new = int(max_new)
         self.stream = stream
         self.eos_id = eos_id
+        # absolute deadline on the engine's monotonic clock; None = no TTL
+        self.deadline = None if deadline is None else float(deadline)
         self.tokens = []          # generated ids, prompt excluded
         self.slot = None
         self.finished = False
-        self.finish_reason = None   # "eos" | "max_new"
+        self.finish_reason = None   # one of FINISH_REASONS
+        self.cancel_requested = False
         # lifecycle clocks (engine fills these from its monotonic clock)
         self.t_arrival = arrival
         self.t_admit = None       # prefill start == queue exit
         self.t_first = None       # first token produced (prefill end)
         self.t_done = None
+
+    def expired(self, now):
+        return self.deadline is not None and now >= self.deadline
 
     # -- latency views (None until the corresponding edge has passed) ------
     @property
@@ -86,33 +141,133 @@ class Request:
 
 
 class Scheduler:
-    """FIFO admission over a SlotKVCache pool."""
+    """FIFO admission over a SlotKVCache pool, with an optional bounded
+    queue (``max_queue`` + watermark hysteresis, see module doc)."""
 
-    def __init__(self, cache, prefill_budget=2, gang=False):
+    def __init__(self, cache, prefill_budget=2, gang=False,
+                 max_queue=None, low_watermark=None,
+                 shed_policy="reject_newest"):
         if prefill_budget < 1:
             raise ValueError(
                 f"prefill_budget must be >= 1, got {prefill_budget}")
+        if shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"shed_policy must be one of {SHED_POLICIES}, got "
+                f"{shed_policy!r}")
         self.cache = cache
         self.prefill_budget = int(prefill_budget)
         self.gang = bool(gang)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1, got {self.max_queue}")
+        if low_watermark is None:
+            # drain to half before reopening — enough hysteresis to stop
+            # flapping without holding the door shut for a full drain
+            self.low_watermark = (None if self.max_queue is None
+                                  else max(0, self.max_queue // 2))
+        else:
+            self.low_watermark = int(low_watermark)
+            if (self.max_queue is not None
+                    and not 0 <= self.low_watermark < self.max_queue):
+                raise ValueError(
+                    f"low_watermark={self.low_watermark} must be in "
+                    f"[0, max_queue={self.max_queue})")
+        self.shed_policy = shed_policy
         self.queue = deque()
         self.running = {}           # slot -> Request
         self.admitted_order = []    # rids in prefill order (FIFO witness)
+        self._ids = itertools.count()   # rid source, scoped to THIS scheduler
+        self._shedding = False      # watermark hysteresis state
+        self.shed = []              # expired requests shed at submit
+        self.rejected = 0
+        self.queue_depth_peak = 0
         mode = "gang" if self.gang else "continuous"
         reg = _telemetry.get_registry()
         self._m_queue = reg.gauge(
             "hetu_serving_queue_depth",
             "Requests waiting for a KV slot",
             labels=("scheduler",)).labels(scheduler=mode)
+        self._m_queue_peak = reg.gauge(
+            "hetu_serving_queue_depth_peak",
+            "High watermark of the request queue depth",
+            labels=("scheduler",)).labels(scheduler=mode)
         self._m_admitted = reg.counter(
             "hetu_serving_admissions_total",
             "Requests admitted into a slot",
             labels=("scheduler",)).labels(scheduler=mode)
+        self._m_rejected = reg.counter(
+            "hetu_serving_rejections_total",
+            "Requests refused at admission (EngineOverloaded)",
+            labels=("scheduler",)).labels(scheduler=mode)
 
-    def submit(self, request):
+    # -- admission control --------------------------------------------------
+    def _admission_open(self):
+        """Bounded-queue watermark hysteresis: closed from the moment
+        depth hits ``max_queue`` until it drains to ``low_watermark``."""
+        if self.max_queue is None:
+            return True
+        depth = len(self.queue)
+        if self._shedding:
+            if depth <= self.low_watermark:
+                self._shedding = False
+                return True
+            return False
+        if depth >= self.max_queue:
+            self._shedding = True
+            return False
+        return True
+
+    def take_expired(self, now):
+        """Remove and return every QUEUED request whose deadline has
+        passed (the engine finalizes them with reason "deadline" —
+        partial result: zero tokens, never admitted)."""
+        if not self.queue:
+            return []
+        expired = [r for r in self.queue if r.expired(now)]
+        if expired:
+            self.queue = deque(r for r in self.queue
+                               if not r.expired(now))
+            self._m_queue.set(len(self.queue))
+        return expired
+
+    def submit(self, request, now=None):
+        """Assign a scheduler-scoped rid and enqueue, or raise
+        :class:`EngineOverloaded` when the bounded queue refuses it
+        (after shedding expired seats under ``drop_expired_first``)."""
+        if not self._admission_open():
+            if (self.shed_policy == "drop_expired_first"
+                    and now is not None):
+                # expired seats serve nobody: shed them before refusing
+                # live work (the engine collects them via drain_shed and
+                # records them with reason "deadline").  Freed seats
+                # reopen admission immediately — the hysteresis exists
+                # to stop flapping under LIVE load, not to refuse work
+                # while dead seats are being vacated.
+                dropped = self.take_expired(now)
+                if dropped:
+                    self.shed.extend(dropped)
+                    if len(self.queue) < self.max_queue:
+                        self._shedding = False
+            if not self._admission_open():
+                self.rejected += 1
+                self._m_rejected.inc()
+                raise EngineOverloaded(len(self.queue), self.max_queue)
+        if request.rid is None:
+            request.rid = next(self._ids)
         self.queue.append(request)
-        self._m_queue.set(len(self.queue))
+        depth = len(self.queue)
+        self._m_queue.set(depth)
+        if depth > self.queue_depth_peak:
+            self.queue_depth_peak = depth
+            self._m_queue_peak.set(depth)
         return request
+
+    def drain_shed(self):
+        """Requests ``submit`` shed under ``drop_expired_first`` since
+        the last call — the engine finalizes + records them."""
+        shed, self.shed = self.shed, []
+        return shed
 
     @property
     def idle(self):
@@ -150,6 +305,38 @@ class Scheduler:
         del self.running[slot]
         request.slot = None
         self.cache.free(slot)
+
+    def remove_queued(self, request):
+        """Drop a still-queued request (cancellation); False if it was
+        not in the queue (already admitted or finished)."""
+        try:
+            self.queue.remove(request)
+        except ValueError:
+            return False
+        self._m_queue.set(len(self.queue))
+        return True
+
+    def find(self, rid):
+        """The live (queued or running) request with this rid, or None."""
+        for req in self.running.values():
+            if req.rid == rid:
+                return req
+        for req in self.queue:
+            if req.rid == rid:
+                return req
+        return None
+
+    def reconcile(self):
+        """Free cache slots owned by nobody (a leaked slot: allocated
+        but absent from ``running``).  A healthy scheduler never has
+        any; after a fault (or injected leak) this returns the pool to
+        balance instead of letting the engine starve.  Returns the
+        number of slots reclaimed."""
+        leaked = [s for s in self.cache.allocated_slots()
+                  if s not in self.running]
+        for s in leaked:
+            self.cache.free(s)
+        return len(leaked)
 
     def active_slots(self):
         return sorted(self.running)

@@ -1,0 +1,173 @@
+"""Tier-1 static check: no unbounded retry loops in hetu_tpu.
+
+An unbounded retry turns an outage into a silent hang: the caller backs
+off forever against a server that is gone, and the run wedges instead
+of failing over (the PS transport's typed ``PSUnavailable`` exists
+precisely because of this).  Two patterns are gated (the
+``test_no_silent_except.py`` / ``test_no_wallclock_timing.py`` AST-scan
+pattern):
+
+* every call to ``retry(...)`` (resilience/retry.py — the one shared
+  policy) must pass an explicit ``attempts=`` and/or ``deadline=``
+  bound at the CALL SITE.  The runtime also raises on neither, but the
+  gate catches it at review time, before the path ever runs;
+* every ``while True:`` loop whose body swallows an exception without
+  any escape (no ``raise``/``return``/``break`` anywhere in the
+  handler) is a hand-rolled retry loop that can spin forever — it must
+  either gain a bound or a reviewed allowlist entry explaining why it
+  is legitimately unbounded (e.g. a server's per-connection serve
+  loop, bounded by the connection's lifetime).
+"""
+
+import ast
+import os
+
+import pytest
+
+HETU_ROOT = os.path.join(os.path.dirname(__file__), "..", "hetu_tpu")
+
+# Reviewed sites, as "relative/path.py::enclosing_function".  Every
+# entry must be bounded by something the scanner cannot see — say what.
+ALLOWED = {
+    # (none today — new entries need a review note here)
+}
+
+
+def _loop_handler_has_escape(handler):
+    """True if the except handler can end the loop: any raise, return,
+    or break anywhere in its body (incl. nested)."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return True
+    return False
+
+
+def _unbounded_retry_sites(root):
+    sites = []
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    sites.append((f"{rel}::<syntax-error>", e.lineno))
+                    continue
+
+            def is_retry_call(call):
+                f = call.func
+                name = (f.id if isinstance(f, ast.Name)
+                        else f.attr if isinstance(f, ast.Attribute)
+                        else None)
+                return name == "retry"
+
+            def call_is_bounded(call):
+                for kw in call.keywords:
+                    if kw.arg in ("attempts", "deadline"):
+                        # an explicit None bound is no bound
+                        if (isinstance(kw.value, ast.Constant)
+                                and kw.value.value is None):
+                            continue
+                        return True
+                return False
+
+            def is_unbounded_while(node):
+                test = node.test
+                infinite = (isinstance(test, ast.Constant)
+                            and bool(test.value))
+                if not infinite:
+                    return False
+                for child in ast.walk(node):
+                    if isinstance(child, ast.Try):
+                        for h in child.handlers:
+                            if not _loop_handler_has_escape(h):
+                                return True
+                return False
+
+            def walk(node, funcname):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    funcname = node.name
+                if (isinstance(node, ast.Call) and is_retry_call(node)
+                        and not call_is_bounded(node)):
+                    sites.append((f"{rel}::{funcname}", node.lineno))
+                if isinstance(node, ast.While) \
+                        and is_unbounded_while(node):
+                    sites.append((f"{rel}::{funcname}", node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, funcname)
+
+            walk(tree, "<module>")
+    return sites
+
+
+def test_no_unbounded_retry():
+    sites = _unbounded_retry_sites(HETU_ROOT)
+    new = [f"{key} (line {line})" for key, line in sites
+           if key not in ALLOWED]
+    assert not new, (
+        "unbounded retry site(s) in hetu_tpu/ — pass attempts= and/or "
+        "deadline= to retry(), or bound the hand-rolled loop (an "
+        "unbounded retry hides an outage as a hang); a legitimately "
+        "unbounded loop needs a reviewed entry in "
+        "tests/test_no_unbounded_retry.py:\n  " + "\n  ".join(new))
+
+
+def test_allowlist_not_stale():
+    """Entries whose site disappeared must leave the allowlist."""
+    present = {key for key, _ in _unbounded_retry_sites(HETU_ROOT)}
+    stale = sorted(set(ALLOWED) - present)
+    assert not stale, (
+        "allowlist entries with no matching retry site — remove them "
+        "from tests/test_no_unbounded_retry.py:\n  " + "\n  ".join(stale))
+
+
+def test_scanner_detects_unbounded_patterns(tmp_path):
+    """The scanner must flag an unbounded retry() call (incl. the
+    attribute form and an explicit None bound) and an escape-free
+    swallow loop, and must NOT flag bounded/escaping forms (guards
+    against the gate silently going blind)."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "from hetu_tpu.resilience import retry\n"
+        "from hetu_tpu import resilience\n"
+        "def bad_call():\n"
+        "    return retry(lambda: 1, backoff=0.1)\n"
+        "def bad_attr_call():\n"
+        "    return resilience.retry(lambda: 1)\n"
+        "def bad_none_bound():\n"
+        "    return retry(lambda: 1, attempts=None, deadline=None)\n"
+        "def bad_loop():\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return connect()\n"
+        "        except OSError:\n"
+        "            pass\n"
+        "def ok_attempts():\n"
+        "    return retry(lambda: 1, attempts=3)\n"
+        "def ok_deadline():\n"
+        "    return retry(lambda: 1, deadline=5.0)\n"
+        "def ok_loop_escape():\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return connect()\n"
+        "        except OSError:\n"
+        "            if done():\n"
+        "                raise\n"
+        "def ok_bounded_loop():\n"
+        "    for _ in range(3):\n"
+        "        try:\n"
+        "            return connect()\n"
+        "        except OSError:\n"
+        "            pass\n")
+    sites = sorted(k for k, _ in _unbounded_retry_sites(str(tmp_path)))
+    assert sites == ["m.py::bad_attr_call", "m.py::bad_call",
+                     "m.py::bad_loop", "m.py::bad_none_bound"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -324,6 +324,129 @@ def test_preemption_resumes_identical_loss_trajectory(tmp_path):
     np.testing.assert_array_equal(rest, ref[cut:])
 
 
+# -- sharded (multi-host) rolling checkpoints ------------------------------
+
+def _run_steps(ex, x, y, X, Y, n):
+    for _ in range(n):
+        ex.run("train", feed_dict={x: X, y: Y})
+
+
+def test_sharded_rolling_save_restore_bitwise(tmp_path):
+    """sharded=True writes orbax shard DIRECTORIES under rolling
+    retention, the manifest covers every shard file with bytes+CRC, and
+    restore_latest round-trips bitwise."""
+    ex, x, y, X, Y, _ = _toy("shr")
+    mgr = RollingCheckpointManager(tmp_path, keep=2, sharded=True)
+    for i in range(4):
+        _run_steps(ex, x, y, X, Y, 1)
+        mgr.save(ex)
+    ents = mgr.entries()
+    assert len(ents) == 2                       # keep-2 pruned the rest
+    assert all(e["kind"] == "sharded" for e in ents)
+    assert all(e["file"].endswith(".orbax") for e in ents)
+    on_disk = [n for n in os.listdir(tmp_path) if n.endswith(".orbax")]
+    assert sorted(on_disk) == sorted(e["file"] for e in ents)
+    # the manifest's shard-set evidence matches the bytes on disk
+    for e in ents:
+        assert e["files"], "manifest entry covers no shard files"
+        for rel, meta in e["files"].items():
+            p = os.path.join(tmp_path, e["file"], rel)
+            assert os.path.getsize(p) == meta["bytes"]
+    saved = _params_host(ex)
+    _run_steps(ex, x, y, X, Y, 2)               # diverge past the save
+    restored = mgr.restore_latest(ex)
+    assert restored == mgr.entries()[0]["step"]
+    _assert_bitwise(saved, ex.params)
+
+
+def test_sharded_restore_fails_over_torn_shard_set(tmp_path):
+    """A shard set with one torn (truncated) file fails verification
+    BEFORE the executor is touched and restore falls back to the
+    previous intact set — the multi-host version of the torn-pickle
+    failover."""
+    ex, x, y, X, Y, _ = _toy("shr_torn")
+    mgr = RollingCheckpointManager(tmp_path, keep=3, sharded=True)
+    want = {}
+    for i in range(3):
+        _run_steps(ex, x, y, X, Y, 1)
+        mgr.save(ex)
+        want[mgr.entries()[0]["step"]] = _params_host(ex)
+    newest, second = mgr.entries()[0], mgr.entries()[1]
+    # tear the largest shard file of the newest set (a host preempted
+    # mid-write)
+    rel = max(newest["files"],
+              key=lambda r: newest["files"][r]["bytes"])
+    faults.tear_file(os.path.join(tmp_path, newest["file"], rel),
+                     frac=0.4)
+    with pytest.warns(UserWarning, match="skipping bad checkpoint"):
+        restored = mgr.restore_latest(ex)
+    assert restored == second["step"]
+    _assert_bitwise(want[second["step"]], ex.params)
+
+
+def test_sharded_restore_fails_over_missing_shard_dir(tmp_path):
+    import shutil
+
+    ex, x, y, X, Y, _ = _toy("shr_gone")
+    mgr = RollingCheckpointManager(tmp_path, keep=3, sharded=True)
+    for i in range(2):
+        _run_steps(ex, x, y, X, Y, 1)
+        mgr.save(ex)
+    newest, second = mgr.entries()[0], mgr.entries()[1]
+    shutil.rmtree(os.path.join(tmp_path, newest["file"]))
+    _run_steps(ex, x, y, X, Y, 1)
+    with pytest.warns(UserWarning, match="skipping bad checkpoint"):
+        restored = mgr.restore_latest(ex)
+    assert restored == second["step"]
+
+
+def test_sharded_preemption_hook_flushes_shard_set(tmp_path):
+    """SIGTERM under sharded mode flushes a full shard-set checkpoint
+    (manifest included) exactly like the pickle path."""
+    ex, x, y, X, Y, _ = _toy("shr_pre")
+    mgr = RollingCheckpointManager(tmp_path, keep=2, sharded=True)
+    mgr.install_preemption_hook(ex, exit_on_save=False)
+    try:
+        _run_steps(ex, x, y, X, Y, 3)
+        saved = _params_host(ex)
+        faults.simulate_preemption()
+        assert mgr.preempted
+        _run_steps(ex, x, y, X, Y, 2)     # post-preemption work, lost
+        mgr.restore_latest(ex)
+        _assert_bitwise(saved, ex.params)
+    finally:
+        mgr.uninstall_preemption_hook()
+
+
+# -- typed PS exhaustion ---------------------------------------------------
+
+@pytest.mark.timeout(60)
+def test_ps_unreachable_raises_typed_psunavailable():
+    """A RemoteTable whose server is gone exhausts its wall-clock retry
+    deadline and raises PSUnavailable (a typed terminal error carrying
+    addr/deadline/attempts), not a generic ConnectionError — and it
+    still IS a ConnectionError for existing handlers."""
+    import socket
+    from hetu_tpu.ps import PSUnavailable
+    from hetu_tpu.ps.rpc import RemoteTable
+
+    # grab a port nothing listens on
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t = RemoteTable("127.0.0.1", port, timeout=0.5, retry_deadline=1.0,
+                    pool_size=1, fetch_meta=False)
+    try:
+        with pytest.raises(PSUnavailable) as ei:
+            t.lookup(np.array([0]))
+        assert ei.value.attempts >= 1
+        assert ei.value.deadline == 1.0
+        assert isinstance(ei.value, ConnectionError)
+    finally:
+        t.close()
+
+
 # -- fault injection ------------------------------------------------------
 
 @pytest.mark.timeout(30)
