@@ -1019,10 +1019,11 @@ def run_chaos(quick=False, seed=0):
     return out
 
 
-def _emit_chaos(out):
+def _emit_chaos(out, detail_path=None):
+    detail_path = CHAOS_DETAIL_PATH if detail_path is None else detail_path
     full = json.dumps(out)
     try:
-        with open(CHAOS_DETAIL_PATH, "w") as f:
+        with open(detail_path, "w") as f:
             f.write(full + "\n")
     except OSError:
         pass
@@ -1035,7 +1036,10 @@ def _emit_chaos(out):
                "stages": {k: f"{v['faults_recovered']}/"
                              f"{v['faults_injected']}"
                           for k, v in out["stages"].items()},
-               "detail": os.path.basename(CHAOS_DETAIL_PATH)}
+               "detail": os.path.basename(detail_path)}
+    for k in ("zero_accepted_loss", "single_engine_twin_lost_streams"):
+        if k in out:
+            compact[k] = out[k]
     if "telemetry_overhead" in out:
         compact["telemetry_overhead_frac"] = \
             out["telemetry_overhead"]["overhead_frac"]
@@ -1489,6 +1493,312 @@ def _chaos_serve_deadline_cancel(ex, model, c, seed):
             "slot_audit": audit}
 
 
+# -- fleet chaos mode (bench.py --chaos --serve --fleet) -------------------
+# Cluster-level resilience evidence: run the EngineFleet (N supervised
+# engine replicas behind the failover router) through whole-replica
+# failures — crash, wedge, straggler, rolling restart, burst + crash —
+# and prove ZERO accepted-request loss: every accepted rid reaches a
+# terminal finish_reason, greedy streams that failed over mid-decode are
+# BITWISE identical to an uninterrupted single-engine run, and every
+# live replica's slot audit balances.  The single-engine twin run under
+# the same seed demonstrably LOSES its in-flight streams when the
+# engine dies — the gap the fleet layer closes.  Reported into
+# FLEET_FULL.json under the same no-clobber contract.
+
+FLEET_DETAIL_PATH = os.environ.get(
+    "HETU_FLEET_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "FLEET_FULL.json"))
+
+_FLEET_EKW = dict(n_slots=2, max_len=32, max_prompt_len=8, name="serve")
+
+
+def _fleet_baseline(ex, model, prompts, max_new, seed):
+    """Uninterrupted single-engine greedy streams — the parity oracle
+    every failover stage compares against (shared compile-once programs
+    make the comparison bitwise)."""
+    from hetu_tpu.serving import InferenceEngine
+
+    eng = InferenceEngine(ex, model, seed=seed, **_FLEET_EKW)
+    return eng.generate_many(prompts, max_new)
+
+
+def _fleet_checks(fleet, reqs, baseline=None):
+    """The zero-loss contract: every accepted rid terminal, healthy
+    reasons only, per-replica audits balanced, greedy parity when an
+    oracle is given."""
+    terminal = all(r.finished for r in reqs)
+    reasons = sorted({r.finish_reason for r in reqs if r.finished})
+    healthy = all(r.finish_reason in ("eos", "max_new") for r in reqs
+                  if r.finished)
+    audits = fleet.audit()
+    balanced = all(a["allocs"] == a["frees"] and a["in_use"] == 0
+                   for a in audits.values())
+    parity = None
+    if baseline is not None:
+        parity = all(np.array_equal(r.result(), b)
+                     for r, b in zip(reqs, baseline))
+    ok = bool(terminal and healthy and balanced
+              and (parity is None or parity))
+    return ok, {"all_terminal": bool(terminal),
+                "finish_reasons": reasons,
+                "token_parity": parity,
+                "slot_audit": audits,
+                "slot_audit_balanced": bool(balanced)}
+
+
+def _chaos_fleet_engine_crash(ex, model, c, seed):
+    """Kill one replica mid-decode: its in-flight requests fail over
+    (replayed bitwise) and the supervisor restarts it from the shared
+    program cache; the SINGLE-ENGINE twin loses every in-flight stream
+    on the same seed."""
+    import warnings
+    from hetu_tpu.resilience import faults, InjectedFault
+    from hetu_tpu.serving import EngineFleet, InferenceEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = _chaos_serve_prompts(rng, 6, c.vocab_size)
+    baseline = _fleet_baseline(ex, model, prompts, 10, seed)
+    fleet = EngineFleet(ex, model, n_engines=3, engine_kwargs=_FLEET_EKW,
+                        threaded=False, breaker_base=1e-4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reqs = [fleet.submit(p, 10) for p in prompts]
+        fleet.pump(3)
+        victim = max(fleet._replicas, key=lambda r: len(r.inflight))
+        in_flight = len(victim.inflight)
+        faults.crash_engine(victim.engine)
+        fleet.wait(reqs, timeout=120)
+    trace = fleet.trace_counts()
+    s = fleet.stats()
+    ok, detail = _fleet_checks(fleet, reqs, baseline)
+    restarted = s["engines"][victim.name]["incarnation"] >= 1
+    recovered = (ok and s["failovers"] >= in_flight and restarted
+                 and trace == {"prefill": 1, "step": 1})
+    fleet.stop()
+    # single-engine twin: the same crash with no fleet above it — the
+    # process survives (it's an exception) but every in-flight stream is
+    # LOST: no terminal finish_reason, no more tokens, ever
+    twin = InferenceEngine(ex, model, seed=seed, **_FLEET_EKW)
+    treqs = [twin.submit(p, 10) for p in prompts]
+    for _ in range(3):
+        twin.step()
+    faults.crash_engine(twin)
+    died = False
+    try:
+        twin.run(max_iterations=500)
+    except InjectedFault:
+        died = True
+    lost = sum(1 for r in treqs if not r.finished)
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "in_flight_at_crash": in_flight,
+            "failovers": s["failovers"],
+            "victim_restarted": bool(restarted),
+            "trace_counts": trace, **detail,
+            "single_engine_twin": {
+                "engine_died": bool(died),
+                "lost_in_flight_streams": int(lost)}}
+
+
+def _chaos_fleet_engine_wedge(ex, model, c, seed, quick):
+    """Wedge one replica's decode step (hung device call): the driver
+    thread is stuck, the heartbeat goes stale, and the SUPERVISOR must
+    quarantine from outside, fail the streams over, and restart."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import EngineFleet
+
+    rng = np.random.default_rng(seed + 11)
+    prompts = _chaos_serve_prompts(rng, 4, c.vocab_size)
+    baseline = _fleet_baseline(ex, model, prompts, 10, seed)
+    wedge_s = 1.0 if quick else 2.5
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fleet = EngineFleet(ex, model, n_engines=2,
+                            engine_kwargs=_FLEET_EKW, threaded=True,
+                            wedge_timeout=0.25, breaker_base=0.01)
+        # route one warm request everywhere so EWMAs exist
+        fleet.generate_many(prompts[:2], 4, timeout=60)
+        victim = fleet._replicas[0]
+        faults.wedge_engine(victim.engine, wedge_s)
+        reqs = [fleet.submit(p, 10) for p in prompts]
+        fleet.wait(reqs, timeout=120)
+        # let the supervisor finish the breaker-gated restart so the
+        # report shows the replica back in service
+        fleet._wait_for(lambda: victim.incarnation >= 1, 60, "restart")
+        s = fleet.stats()
+        ok, detail = _fleet_checks(fleet, reqs, baseline)
+        fleet.stop()
+    recovered = ok and s["failovers"] >= 1
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "failovers": s["failovers"],
+            "victim_incarnation":
+                s["engines"][victim.name]["incarnation"],
+            "wedge_seconds": wedge_s, **detail}
+
+
+def _chaos_fleet_slow_engine(ex, model, c, seed, quick):
+    """One straggler replica (every step sleeps): not a fault — the
+    latency-aware router must LEARN to route around it from the TPOT
+    EWMAs, while the straggler still finishes what it holds."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import EngineFleet
+
+    rng = np.random.default_rng(seed + 22)
+    n = 12 if quick else 24
+    prompts = _chaos_serve_prompts(rng, n + 3, c.vocab_size)
+    # threaded: in manual pump mode every replica shares the caller's
+    # wall clock, so a straggler's sleeps inflate EVERYONE's TPOT and
+    # the EWMAs never separate — with one driver thread each, the
+    # straggler's latency is its own
+    fleet = EngineFleet(ex, model, n_engines=3, engine_kwargs=_FLEET_EKW,
+                        threaded=True, wedge_timeout=30.0)
+    slow = fleet._replicas[0]
+    # straggler is many healthy steps per step so the TPOT EWMAs
+    # separate decisively from one seed round
+    faults.slow_engine(slow.engine, 0.05 if quick else 0.08)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        # seed round: one request per replica so every EWMA is measured
+        fleet.generate_many(prompts[:3], 4, timeout=120)
+        reqs = []
+        for p in prompts[3:]:
+            reqs.append(fleet.submit(p, 6))
+            time.sleep(0.02 if quick else 0.03)
+        fleet.wait(reqs, timeout=120)
+    disp = {r.name: r.dispatches for r in fleet._replicas}
+    ewma = {r.name: r.tpot_ewma for r in fleet._replicas}
+    ok, detail = _fleet_checks(fleet, reqs)
+    # "routed around": the straggler draws no more work than any fast
+    # replica AND well under a fair share (a fast sibling may absorb
+    # nearly everything — that is the router working, not failing)
+    fast_min = min(v for k, v in disp.items() if k != slow.name)
+    total = sum(disp.values())
+    routed_around = (disp[slow.name] <= fast_min
+                     and disp[slow.name] < total / len(disp))
+    recovered = ok and routed_around
+    fleet.stop()
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "dispatches": disp,
+            "tpot_ewma": {k: (None if v is None else round(v, 5))
+                          for k, v in ewma.items()},
+            "straggler": slow.name,
+            "routed_around_straggler": bool(routed_around), **detail}
+
+
+def _chaos_fleet_rolling_restart(ex, model, c, seed):
+    """Drain + restart every replica in turn while requests keep
+    arriving: zero accepted-rid loss, retrace counters flat (restarts
+    reuse the shared compile-once program cache)."""
+    import warnings
+    from hetu_tpu.serving import EngineFleet
+
+    rng = np.random.default_rng(seed + 33)
+    prompts = _chaos_serve_prompts(rng, 9, c.vocab_size)
+    baseline = _fleet_baseline(ex, model, prompts, 8, seed)
+    fleet = EngineFleet(ex, model, n_engines=3, engine_kwargs=_FLEET_EKW,
+                        threaded=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reqs = [fleet.submit(p, 8) for p in prompts[:5]]
+        fleet.pump(2)
+        fleet.rolling_restart()
+        reqs += [fleet.submit(p, 8) for p in prompts[5:]]
+        fleet.wait(reqs, timeout=120)
+    trace = fleet.trace_counts()
+    s = fleet.stats()
+    ok, detail = _fleet_checks(fleet, reqs, baseline)
+    incs = {k: v["incarnation"] for k, v in s["engines"].items()}
+    recovered = (ok and all(v >= 1 for v in incs.values())
+                 and trace == {"prefill": 1, "step": 1})
+    fleet.stop()
+    return {"faults_injected": 3, "faults_recovered":
+                3 * int(recovered),
+            "incarnations": incs, "trace_counts": trace,
+            "failovers": s["failovers"], **detail}
+
+
+def _chaos_fleet_burst_failover(ex, model, c, seed, quick):
+    """Arrival burst against bounded per-replica queues, then kill the
+    replica with the deepest backlog: queued AND running requests all
+    fail over; rejected requests were never accepted (honest shed, not
+    loss)."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import EngineFleet, EngineOverloaded
+
+    rng = np.random.default_rng(seed + 44)
+    n_burst = 18 if quick else 36
+    prompts = _chaos_serve_prompts(rng, n_burst, c.vocab_size)
+    ekw = dict(_FLEET_EKW, max_queue=4)
+    fleet = EngineFleet(ex, model, n_engines=3, engine_kwargs=ekw,
+                        threaded=False, breaker_base=1e-4,
+                        max_failovers=5)
+    accepted, rejected = [], 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for p in prompts:
+            try:
+                accepted.append(fleet.submit(p, 6))
+            except EngineOverloaded:
+                rejected += 1
+        fleet.pump(2)
+        victim = max(fleet._replicas,
+                     key=lambda r: len(r.engine.scheduler.queue)
+                     + len(r.inflight))
+        backlog = len(victim.inflight) \
+            + len(victim.engine.scheduler.queue)
+        faults.crash_engine(victim.engine)
+        fleet.wait(accepted, timeout=240)
+    s = fleet.stats()
+    ok, detail = _fleet_checks(fleet, accepted)
+    recovered = ok and s["failovers"] >= 1
+    fleet.stop()
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "burst_size": n_burst, "accepted": len(accepted),
+            "rejected": rejected,
+            "victim_backlog_at_crash": backlog,
+            "failovers": s["failovers"], **detail}
+
+
+def run_chaos_fleet(quick=False, seed=0):
+    import jax
+
+    ex, model, c = _serve_build(True)   # tiny decode model: replica
+    # lifecycle, not shapes, is the thing measured
+    stages = {}
+    stages["engine_crash"] = _chaos_fleet_engine_crash(ex, model, c,
+                                                       seed)
+    stages["engine_wedge"] = _chaos_fleet_engine_wedge(ex, model, c,
+                                                       seed, quick)
+    stages["slow_engine"] = _chaos_fleet_slow_engine(ex, model, c,
+                                                     seed, quick)
+    stages["rolling_restart"] = _chaos_fleet_rolling_restart(ex, model,
+                                                             c, seed)
+    stages["burst_failover"] = _chaos_fleet_burst_failover(ex, model, c,
+                                                           seed, quick)
+    out = {"metric": "chaos_fleet_resilience",
+           "value": sum(s["faults_recovered"] for s in stages.values()),
+           "unit": "faults_recovered",
+           "seed": seed,
+           "quick": bool(quick),
+           "platform": jax.default_backend(),
+           "stages": stages,
+           "slot_audit_balanced": all(
+               s.get("slot_audit_balanced", True)
+               for s in stages.values()),
+           "zero_accepted_loss": all(
+               s.get("all_terminal", True) for s in stages.values()),
+           "single_engine_twin_lost_streams":
+               stages["engine_crash"]["single_engine_twin"]
+               ["lost_in_flight_streams"]}
+    out["all_stages_recovered"] = all(
+        s["faults_recovered"] >= s["faults_injected"]
+        for s in stages.values())
+    return out
+
+
 def run_chaos_serve(quick=False, seed=0):
     import jax
 
@@ -1644,14 +1954,20 @@ def main():
         quick = quick or jax.default_backend() == "cpu"
         if telemetry_on:
             _telemetry_on()
-        if "--serve" in sys.argv:
+        detail_path = None
+        if "--fleet" in sys.argv:
+            # --chaos --serve --fleet: whole-replica failures through
+            # the EngineFleet (FLEET_FULL.json, same no-clobber rules)
+            out = run_chaos_fleet(quick)
+            detail_path = FLEET_DETAIL_PATH
+        elif "--serve" in sys.argv:
             out = run_chaos_serve(quick)
         else:
             out = run_chaos(quick)
         if telemetry_on:
             out["telemetry"] = _telemetry_report()
             out["telemetry_overhead"] = run_telemetry_overhead(quick)
-        _emit_chaos(out)
+        _emit_chaos(out, detail_path)
         return
     if "--serve" in sys.argv:
         # serve mode runs in-process (small decode shapes): replay the

@@ -214,6 +214,70 @@ def stalling_consumer(seconds, collect=None, fail_after=None):
     return cb
 
 
+# -- fleet faults ----------------------------------------------------------
+# Replica-level failures for the fleet layer (bench.py --chaos --serve
+# --fleet and tests/test_fleet.py): where the serving faults above hit
+# one slot/consumer, these take out a WHOLE engine — the blast radius
+# the EngineFleet's quarantine/failover/restart machinery must contain.
+
+def crash_engine(engine, at=0, exc=None):
+    """Make the engine's ``at``-th ``step()`` CALL (0-based, counted
+    from now) raise OUTSIDE the watchdog's try blocks — the engine-loop
+    bug / runtime abort that kills the whole engine, not one slot.  The
+    fleet driver sees the exception escape ``step()`` and quarantines
+    the replica.  Returns an undo callable."""
+    orig = engine.step
+    state = {"n": 0}
+
+    def wrapped(*args, **kw):
+        n = state["n"]
+        state["n"] += 1
+        if n == int(at):
+            raise exc if exc is not None else InjectedFault(
+                f"injected engine crash at step call {at}")
+        return orig(*args, **kw)
+
+    engine.step = wrapped
+    return lambda: setattr(engine, "step", orig)
+
+
+def wedge_engine(engine, seconds, at=0):
+    """Make the engine's ``at``-th decode-step call STALL ``seconds``
+    before dispatch — a hung device call / deadlocked runtime.  The
+    driver thread is stuck inside ``step()``, so the replica's
+    heartbeat goes stale and the fleet supervisor must quarantine it
+    from OUTSIDE (it cannot get the lock).  Bounded, so the zombie
+    daemon thread eventually exits.  Returns an undo callable."""
+    orig = engine._step_fn
+    state = {"n": 0}
+
+    def wrapped(*args, **kw):
+        n = state["n"]
+        state["n"] += 1
+        if n == int(at):
+            time.sleep(float(seconds))
+        return orig(*args, **kw)
+
+    engine._step_fn = wrapped
+    return lambda: setattr(engine, "_step_fn", orig)
+
+
+def slow_engine(engine, seconds):
+    """Make EVERY decode-step call of this engine take an extra
+    ``seconds`` — the straggler replica (thermal throttling, a noisy
+    neighbor).  Not a fault the health machine trips on; the fleet's
+    latency-aware dispatch must simply learn to route around it.
+    Returns an undo callable."""
+    orig = engine._step_fn
+
+    def wrapped(*args, **kw):
+        time.sleep(float(seconds))
+        return orig(*args, **kw)
+
+    engine._step_fn = wrapped
+    return lambda: setattr(engine, "_step_fn", orig)
+
+
 # -- files & process -------------------------------------------------------
 
 def tear_file(path, frac=0.5, keep_bytes=None):

@@ -4,10 +4,16 @@ Slot-pooled K/V cache (kv_cache.py) + iteration-level FIFO scheduler
 with bounded-queue admission control (scheduler.py) + slot-batched
 model adapters (adapters.py) + the engine tying them together with
 per-request deadlines, cancellation, and a decode watchdog (engine.py).
+Above the single engine sits the FLEET layer (fleet.py + health.py): N
+supervised engine replicas behind a latency-aware router with health
+state machines, circuit-broken quarantine, failover of in-flight
+requests (bitwise-identical greedy streams via teacher-forced replay),
+and supervised restarts over the shared compile-once program cache.
 ``bench.py --serve`` replays a Poisson arrival trace through the engine
 and its static-batch twin; ``bench.py --chaos --serve`` injects serving
-faults (poisoned decode, raising step, slot leaks, stalled consumers,
-arrival bursts) and proves the engine survives them.
+faults and proves one engine survives them; ``bench.py --chaos --serve
+--fleet`` kills, wedges, and rolls whole replicas and proves the fleet
+loses nothing.
 """
 
 from .kv_cache import SlotKVCache
@@ -15,7 +21,13 @@ from .scheduler import (EngineOverloaded, Request, Scheduler,
                         FINISH_REASONS, SHED_POLICIES)
 from .adapters import (LlamaSlotAdapter, GPTSlotAdapter, adapter_for)
 from .engine import InferenceEngine
+from .health import (CircuitBreaker, ReplicaHealth, HEALTH_STATES,
+                     HEALTH_STATE_CODES)
+from .fleet import EngineFleet, FleetRequest, FleetUnavailable
 
 __all__ = ["SlotKVCache", "Request", "Scheduler", "EngineOverloaded",
            "FINISH_REASONS", "SHED_POLICIES", "LlamaSlotAdapter",
-           "GPTSlotAdapter", "adapter_for", "InferenceEngine"]
+           "GPTSlotAdapter", "adapter_for", "InferenceEngine",
+           "CircuitBreaker", "ReplicaHealth", "HEALTH_STATES",
+           "HEALTH_STATE_CODES", "EngineFleet", "FleetRequest",
+           "FleetUnavailable"]

@@ -110,8 +110,16 @@ class InferenceEngine:
                  temperature=0.0, top_k=0, seed=0, name=None,
                  gang=False, max_queue=None, low_watermark=None,
                  shed_policy="reject_newest", watchdog=True,
-                 stream_stall_timeout=None, clock=None):
+                 stream_stall_timeout=None, clock=None, instance=None,
+                 latency_buckets=None, device=None):
         self.params = executor.params
+        self.instance = None if instance is None else str(instance)
+        self.device = device
+        if device is not None:
+            # fleet replica pinning: park THIS engine's params + cache on
+            # one device so N replicas split the chips instead of
+            # contending for device 0 (jit follows the operands' device)
+            self.params = jax.device_put(self.params, device)
         name = name or param_prefix(
             executor, "_embed_table"
             if hasattr(model.config, "rope_theta") else "_wte_table")
@@ -131,11 +139,15 @@ class InferenceEngine:
         self.cache = SlotKVCache(
             n_slots, self.adapter.layers, self.adapter.kv_heads,
             self.max_len, self.adapter.head_dim, dtype=emb.dtype)
+        if device is not None:
+            self.cache.k = jax.device_put(self.cache.k, device)
+            self.cache.v = jax.device_put(self.cache.v, device)
         self.scheduler = Scheduler(self.cache,
                                    prefill_budget=prefill_budget,
                                    gang=gang, max_queue=max_queue,
                                    low_watermark=low_watermark,
-                                   shed_policy=shed_policy)
+                                   shed_policy=shed_policy,
+                                   rid_prefix=self.instance)
         self.eos_id = eos_id
         self.watchdog = bool(watchdog)
         self.stream_stall_timeout = (
@@ -158,8 +170,17 @@ class InferenceEngine:
         self.watchdog_trips = 0
         self.slot_leaks_reclaimed = 0
         self.streams_detached = 0
+        self.replayed_tokens = 0
         mode = "gang" if gang else "continuous"
         reg = _telemetry.get_registry()
+        # per-deployment histogram bucket overrides: real TPU TTFT/TPOT
+        # shapes may not fit the default 100us..10s ladder (ROADMAP
+        # carry-over).  The registry caches instruments by NAME and
+        # rejects a bucket mismatch, so every engine in one process must
+        # agree on the ladder — pass the same latency_buckets to each
+        # (EngineFleet threads one value through all replicas).
+        hkw = ({} if latency_buckets is None
+               else {"buckets": tuple(latency_buckets)})
 
         def _m(kind, name, help, **kw):
             return getattr(reg, kind)(name, help, labels=("scheduler",),
@@ -195,12 +216,18 @@ class InferenceEngine:
             "counter", "hetu_serving_streams_detached_total",
             "Stream callbacks detached (raised or stalled past the "
             "bound)")
+        self._m_replayed = _m(
+            "counter", "hetu_serving_replayed_tokens_total",
+            "Tokens teacher-forced during failover replay (rebuilt, "
+            "never re-emitted)")
         self._m_ttft = _m("histogram", "hetu_serving_ttft_seconds",
-                          "Time to first token (arrival -> first emit)")
+                          "Time to first token (arrival -> first emit)",
+                          **hkw)
         self._m_tpot = _m("histogram", "hetu_serving_tpot_seconds",
-                          "Mean time per output token after the first")
+                          "Mean time per output token after the first",
+                          **hkw)
         self._m_qwait = _m("histogram", "hetu_serving_queue_wait_seconds",
-                           "Arrival -> slot admission wait")
+                           "Arrival -> slot admission wait", **hkw)
         self._tr = _telemetry.get_tracer()
         self._build()
 
@@ -291,13 +318,17 @@ class InferenceEngine:
 
     # -- request API -------------------------------------------------------
     def submit(self, prompt, max_new, stream=None, eos_id=None,
-               arrival=None, deadline=None, ttl=None):
+               arrival=None, deadline=None, ttl=None, replay=None,
+               rid=None):
         """Queue one generation request; returns its Request handle.
         ``stream(token, request)`` is called per generated token.
         ``ttl`` (seconds from now) or ``deadline`` (absolute, on the
         engine's monotonic clock) bounds the request's lifetime: past
         it, the request finishes with ``finish_reason="deadline"`` and
-        whatever tokens it produced.  Raises
+        whatever tokens it produced.  ``replay=`` (fleet failover)
+        teacher-forces a previous attempt's tokens to rebuild the KV
+        state without re-emitting them, and ``rid=`` keeps the failed
+        attempt's cluster-level id.  Raises
         :class:`~.scheduler.EngineOverloaded` when the bounded queue
         refuses admission."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -321,7 +352,7 @@ class InferenceEngine:
                       arrival=now if arrival is None else arrival,
                       stream=stream,
                       eos_id=self.eos_id if eos_id is None else eos_id,
-                      deadline=deadline)
+                      deadline=deadline, replay=replay, rid=rid)
         try:
             self.scheduler.submit(req, now=now)
         finally:
@@ -356,6 +387,15 @@ class InferenceEngine:
 
     def _now(self):
         return self._clock()
+
+    def _absorb_replay(self, req, tok):
+        """Book a teacher-forced replay token: it lands in ``tokens``
+        (so eos/max_new accounting and ``result()`` see the full stream)
+        but is never re-emitted — the client already received it from
+        the previous attempt."""
+        req.tokens.append(int(tok))
+        self.replayed_tokens += 1
+        self._m_replayed.inc()
 
     def _emit(self, req, tok, now):
         req.tokens.append(int(tok))
@@ -441,6 +481,34 @@ class InferenceEngine:
             self._m_expired.inc()
             self._finalize_active(req, "deadline", now)
 
+    def harvest(self):
+        """Remove every live request for fleet failover: running ones
+        retire with the attempt-level ``finish_reason="failover"`` (slot
+        freed on the spot, so this engine's alloc/free audit stays
+        balanced), queued ones leave the queue the same way.  Returns
+        the harvested requests, running (admission order) before queued
+        (FIFO) — the order a sibling should re-admit them in.  The
+        cluster-level request is NOT finished by this: the fleet
+        re-submits the same rid elsewhere with ``replay=`` carrying each
+        request's tokens-so-far."""
+        now = self._now()
+        out = []
+        for rid in self.scheduler.admitted_order:
+            req = next((r for r in self.scheduler.running.values()
+                        if r.rid == rid), None)
+            if req is not None:
+                self._finalize_active(req, "failover", now)
+                out.append(req)
+        # defensive: any running request not in admitted_order
+        for req in list(self.scheduler.running.values()):
+            self._finalize_active(req, "failover", now)
+            out.append(req)
+        while self.scheduler.queue:
+            req = self.scheduler.queue.popleft()
+            self._finalize_unadmitted(req, "failover", now)
+            out.append(req)
+        return out
+
     def _quarantine_all(self, reason, now):
         """A fault that cannot be attributed to one slot (the jitted
         step itself raised): retire everything in flight with "error"
@@ -496,9 +564,21 @@ class InferenceEngine:
                     f"request {req.rid} — quarantined")
                 self._finalize_active(req, "error", now)
                 continue
-            self._last_tokens[slot] = tok
-            self._emit(req, tok, now)
-            produced += 1
+            forced = req.next_replay()
+            if forced is not None:
+                # failover replay: the first generated token is already
+                # known (and was already delivered) — force it instead
+                # of emitting.  For a greedy request the computed ``tok``
+                # equals ``forced`` (same executable, same prompt); for
+                # sampled requests the sibling's key stream differs and
+                # forcing is what keeps the stream consistent.
+                tok = forced
+                self._last_tokens[slot] = tok
+                self._absorb_replay(req, tok)
+            else:
+                self._last_tokens[slot] = tok
+                self._emit(req, tok, now)
+                produced += 1
             self._maybe_retire(req, tok, now)
         # 2) one decode iteration over every active slot
         slots = self.scheduler.active_slots()
@@ -550,6 +630,17 @@ class InferenceEngine:
                         f"decode watchdog: non-finite logits in slot "
                         f"{slot} (request {req.rid}) — quarantined")
                     self._finalize_active(req, "error", now)
+                    continue
+                forced = req.next_replay()
+                if forced is not None:
+                    # teacher-forced replay step: the cache row written
+                    # by this iteration is a function of the FED token,
+                    # so forcing the known token rebuilds the exact KV
+                    # state of the original run
+                    tok = forced
+                    self._last_tokens[slot] = tok
+                    self._absorb_replay(req, tok)
+                    self._maybe_retire(req, tok, now)
                     continue
                 tok = int(nxt[slot])
                 self._last_tokens[slot] = tok
@@ -621,6 +712,7 @@ class InferenceEngine:
         self.watchdog_trips = 0
         self.slot_leaks_reclaimed = 0
         self.streams_detached = 0
+        self.replayed_tokens = 0
 
     # -- reporting ---------------------------------------------------------
     def stats(self):
@@ -639,4 +731,5 @@ class InferenceEngine:
                 "watchdog_trips": self.watchdog_trips,
                 "slot_leaks_reclaimed": self.slot_leaks_reclaimed,
                 "streams_detached": self.streams_detached,
+                "replayed_tokens": self.replayed_tokens,
                 "trace_counts": self.trace_counts}

@@ -1,0 +1,977 @@
+"""Fleet serving: N supervised engine replicas behind a failover router.
+
+PR 5 made one ``InferenceEngine`` survive poisoned slots, deadline
+churn, and overload — but one engine is still one blast radius: a
+crashed or wedged engine takes every in-flight stream with it.
+``EngineFleet`` is the cluster-level robustness layer:
+
+* **replicas** — N in-process :class:`~.engine.InferenceEngine`\\ s,
+  one driver thread each (``threaded=True``; ``threaded=False`` gives a
+  deterministic ``pump()`` loop for tests and seeded benches), pinned
+  one-per-device when the backend has multiple devices;
+* **latency-aware dispatch** — ``submit`` routes to the replica with
+  the lowest ``(queue_depth + in_flight + 1) * TPOT_EWMA`` score, the
+  telemetry signals PR 5 left as the "latency-aware admission"
+  follow-up.  Request ids are CLUSTER-level: ``"e0-7"`` names the
+  engine instance that admitted the request and stays with the request
+  across failover;
+* **health state machine** — each replica runs
+  HEALTHY → DEGRADED → QUARANTINED → RESTARTING (health.py), driven by
+  heartbeats (a wedged ``step()`` shows as a stale heartbeat) and
+  watchdog-trip deltas; DRAINING/STOPPED support rolling restarts;
+* **circuit breaker** — quarantine opens a per-replica breaker with
+  exponential backoff; the supervisor restarts the replica only after
+  the backoff elapses, and the breaker resets only after clean ticks —
+  a crash-looping replica backs off geometrically;
+* **failover of in-flight requests** — the headline property.  When a
+  replica crashes, wedges, or is quarantined mid-decode, its unfinished
+  requests are harvested and re-submitted on a sibling with
+  ``replay=tokens_so_far``: the sibling re-prefills the prompt through
+  the SAME shared executable and teacher-forces the already-delivered
+  tokens (one decode step each, fused into its normal iteration), so a
+  greedy stream continues BITWISE identically to an uninterrupted run
+  and is never re-delivered.  Every accepted rid reaches a terminal
+  ``finish_reason``;
+* **supervised restart** — dead replicas are rebuilt cheaply: the
+  compile-once program cache (``InferenceEngine._PROGRAMS``) is shared
+  process-wide, so a restart allocates a fresh KV pool but never
+  retraces (retrace counters stay flat — the bench asserts it).
+
+Failure containment ladder: a poisoned SLOT is the engine watchdog's
+job (that request alone retires "error" — and then the fleet retries it
+on a sibling); a sick ENGINE is the fleet's job (quarantine + failover
++ supervised restart); only losing the whole process is left to the
+layer above.
+
+Hedged dispatch (``submit(..., hedge=True)``) duplicates a request onto
+the two best replicas; the first terminal success wins and the loser is
+cancelled — tail-latency insurance for critical requests (greedy
+streams are identical on both, so the race is benign).
+
+Usage::
+
+    fleet = EngineFleet(ex, model, n_engines=3,
+                        engine_kwargs=dict(n_slots=4, max_len=128))
+    h = fleet.submit(prompt, max_new=64)       # -> FleetRequest
+    fleet.wait([h]); print(h.result())
+    fleet.rolling_restart()                    # zero accepted-rid loss
+    fleet.stop()
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from collections import deque
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+from .engine import InferenceEngine
+from .health import (CircuitBreaker, DEGRADED, DISPATCHABLE, DRAINING,
+                     HEALTH_STATE_CODES, HEALTHY, QUARANTINED,
+                     ReplicaHealth, RESTARTING, STOPPED)
+from .scheduler import EngineOverloaded
+
+__all__ = ["EngineFleet", "FleetRequest", "FleetUnavailable"]
+
+
+class FleetUnavailable(RuntimeError):
+    """No replica can take the request: every engine is circuit-broken,
+    quarantined, draining, or stopped.  Mirrors ``ps.PSUnavailable`` —
+    a TYPED terminal error carrying enough state to act on: ``states``
+    maps each engine to its health state, and ``retry_after`` (seconds,
+    or None when no breaker is counting down) aggregates the breaker
+    backoffs into the soonest moment a retry could succeed."""
+
+    def __init__(self, states, retry_after=None):
+        hint = ("no restart pending" if retry_after is None
+                else f"retry after ~{retry_after:.2f}s")
+        super().__init__(
+            f"fleet unavailable: no dispatchable replica ({states}; "
+            f"{hint})")
+        self.states = dict(states)
+        self.retry_after = (None if retry_after is None
+                            else float(retry_after))
+
+
+class FleetRequest:
+    """Cluster-level request handle.
+
+    The engine-level :class:`~.scheduler.Request` is one ATTEMPT; this
+    handle survives failover (same ``rid``, new attempt on a sibling)
+    and is what client code holds.  ``tokens``/``result()`` always show
+    the full stream from token 0 — a failed-over attempt replays its
+    predecessor's tokens, so the latest attempt's token list IS the
+    stream.  ``stream`` callbacks fire exactly once per token: replayed
+    tokens are never re-delivered, and late emits from a superseded
+    (wedged) attempt are fenced off."""
+
+    def __init__(self, prompt, max_new, stream=None, eos_id=None,
+                 deadline=None, arrival=None, hedge=False):
+        self.rid = None             # set at first dispatch
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.stream_cb = stream
+        self.eos_id = eos_id
+        self.deadline = None if deadline is None else float(deadline)
+        self.hedge = bool(hedge)
+        self.attempt = None         # current engine-level Request
+        self.engine = None          # replica name serving the attempt
+        self.engines = []           # replica names tried, in order
+        self.failovers = 0
+        self.hedge_attempt = None   # (replica_name, Request) secondary
+        self.cancel_requested = False
+        self.t_arrival = arrival
+        self.t_done = None
+        self._finished = False
+        self._finish_reason = None
+        self._tokens_snapshot = []  # last harvest fence (see fleet)
+
+    @property
+    def finished(self):
+        return self._finished
+
+    @property
+    def finish_reason(self):
+        return self._finish_reason
+
+    @property
+    def tokens(self):
+        att = self.attempt
+        return list(att.tokens) if att is not None \
+            else list(self._tokens_snapshot)
+
+    def result(self):
+        return np.asarray(self.tokens, np.int32)
+
+    def __repr__(self):
+        state = ("done" if self._finished
+                 else "live" if self.attempt is not None else "pending")
+        return (f"FleetRequest(id={self.rid}, engine={self.engine}, "
+                f"failovers={self.failovers}, {state})")
+
+
+class _Replica:
+    """One supervised engine slot: the engine, its driver thread, its
+    health + breaker, and the fleet requests in flight on it."""
+
+    def __init__(self, index, name, engine, health, breaker):
+        self.index = index
+        self.name = name
+        self.engine = engine
+        self.health = health
+        self.breaker = breaker
+        self.lock = threading.RLock()
+        self.thread = None
+        self.generation = 0        # bumped to fence a zombie driver
+        self.incarnation = 0       # restarts survived (rid uniqueness)
+        self.inflight = {}         # rid -> (FleetRequest, attempt)
+        self.dispatches = 0
+        self.last_trips = 0        # engine.watchdog_trips at last tick
+        self.last_error = None
+        self.ttft_ewma = None
+        self.tpot_ewma = None
+
+
+class EngineFleet:
+    """Health-checked multi-engine router with failover and supervised
+    restart (see module doc).
+
+    ``engine_kwargs`` is passed to every replica's
+    :class:`~.engine.InferenceEngine` (n_slots, max_len, max_queue, …);
+    the fleet itself supplies ``instance`` (cluster rids), ``clock``,
+    ``latency_buckets``, and per-replica ``device`` pinning when the
+    backend has multiple devices.  ``threaded=False`` disables the
+    driver/supervisor threads: drive the fleet deterministically with
+    :meth:`pump` (wedge detection needs real threads and is off in this
+    mode)."""
+
+    def __init__(self, executor, model, n_engines=2, engine_kwargs=None,
+                 *, threaded=True, clock=None, name="fleet",
+                 degraded_after=1, quarantine_after=3, recover_after=8,
+                 breaker_base=0.25, breaker_cap=30.0, max_failovers=3,
+                 wedge_timeout=5.0, supervise_interval=0.02,
+                 idle_sleep=0.001, auto_restart=True, ewma_alpha=0.3,
+                 latency_buckets=None):
+        if n_engines < 1:
+            raise ValueError(f"n_engines must be >= 1, got {n_engines}")
+        self._executor = executor
+        self._model = model
+        self._ekw = dict(engine_kwargs or {})
+        self._ekw.pop("instance", None)
+        self._ekw.pop("clock", None)
+        self.name = str(name)
+        self.threaded = bool(threaded)
+        self._clock = clock if clock is not None else time.perf_counter
+        self._hp = dict(degraded_after=degraded_after,
+                        quarantine_after=quarantine_after,
+                        recover_after=recover_after)
+        self._bp = dict(base=breaker_base, cap=breaker_cap)
+        self.max_failovers = int(max_failovers)
+        self.wedge_timeout = float(wedge_timeout)
+        self.supervise_interval = float(supervise_interval)
+        self.idle_sleep = float(idle_sleep)
+        self.auto_restart = bool(auto_restart)
+        self.ewma_alpha = float(ewma_alpha)
+        self._latency_buckets = latency_buckets
+        # one replica per device when the mesh offers several (ROADMAP
+        # direction 1's scale-out shape); on one device they time-share
+        import jax
+        devs = jax.devices()
+        self._devices = devs if len(devs) > 1 else [None] * n_engines
+        self._requests = {}        # rid -> FleetRequest (accepted ever)
+        self._flock = threading.Lock()
+        self._failover = deque()   # (FleetRequest, tokens) to re-home
+        self._cancels = deque()    # (replica_name, rid) deferred cancels
+        self._running = False
+        self._sup_thread = None
+        self.submitted = 0
+        self.completed = 0
+        self.failovers_done = 0
+        self.hedged = 0
+        self.hedges_skipped = 0
+        reg = _telemetry.get_registry()
+        self._m_health = reg.gauge(
+            "hetu_fleet_engine_health_state",
+            "Replica health (0 healthy, 1 degraded, 2 quarantined, "
+            "3 restarting, 4 draining, 5 stopped)", labels=("engine",))
+        self._m_dispatch = reg.counter(
+            "hetu_fleet_dispatches_total",
+            "Requests routed to each replica", labels=("engine",))
+        self._m_failovers = reg.counter(
+            "hetu_fleet_failovers_total",
+            "In-flight requests re-homed onto a sibling replica")
+        self._m_breaker = reg.counter(
+            "hetu_fleet_breaker_opens_total",
+            "Circuit-breaker opens (quarantines)", labels=("engine",))
+        self._m_restarts = reg.counter(
+            "hetu_fleet_restarts_total",
+            "Supervised replica restarts", labels=("engine",))
+        self._m_drains = reg.counter(
+            "hetu_fleet_drains_total",
+            "Replica drains requested", labels=("engine",))
+        self._m_crashes = reg.counter(
+            "hetu_fleet_engine_crashes_total",
+            "Driver-observed engine exceptions", labels=("engine",))
+        self._m_wedges = reg.counter(
+            "hetu_fleet_engine_wedges_total",
+            "Stale-heartbeat quarantines (wedged step)",
+            labels=("engine",))
+        self._m_hedged = reg.counter(
+            "hetu_fleet_hedged_dispatches_total",
+            "Requests duplicated onto a second replica")
+        self._m_unavail = reg.counter(
+            "hetu_fleet_unavailable_total",
+            "Submits refused with FleetUnavailable")
+        self._replicas = [self._make_replica(i) for i in range(n_engines)]
+        self.start()
+
+    # -- construction ------------------------------------------------------
+    def _instance_name(self, index, incarnation):
+        base = f"e{index}"
+        return base if incarnation == 0 else f"{base}.{incarnation}"
+
+    def _build_engine(self, index, incarnation):
+        return InferenceEngine(
+            self._executor, self._model,
+            instance=self._instance_name(index, incarnation),
+            clock=self._clock,
+            latency_buckets=self._latency_buckets,
+            device=self._devices[index % len(self._devices)],
+            **self._ekw)
+
+    def _make_replica(self, index):
+        name = f"e{index}"
+        rep = _Replica(
+            index, name, self._build_engine(index, 0),
+            ReplicaHealth(name, clock=self._clock, **self._hp),
+            CircuitBreaker(clock=self._clock, **self._bp))
+        self._m_health.labels(engine=name).set(HEALTH_STATE_CODES[HEALTHY])
+        return rep
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Start driver + supervisor threads (no-op when already running
+        or ``threaded=False``)."""
+        if self._running:
+            return self
+        self._running = True
+        if self.threaded:
+            for rep in self._replicas:
+                self._start_driver(rep)
+            self._sup_thread = threading.Thread(
+                target=self._supervise_loop, daemon=True,
+                name=f"{self.name}-supervisor")
+            self._sup_thread.start()
+        return self
+
+    def _start_driver(self, rep):
+        rep.thread = threading.Thread(
+            target=self._drive, args=(rep, rep.generation), daemon=True,
+            name=f"{self.name}-{rep.name}-driver")
+        rep.thread.start()
+
+    def stop(self, finalize_pending=True):
+        """Stop drivers + supervisor (joined; wedged zombies are fenced
+        and abandoned as daemons).  Pending failovers that never found a
+        home finalize with ``finish_reason="error"`` unless told not
+        to."""
+        self._running = False
+        threads = [self._sup_thread] + [r.thread for r in self._replicas]
+        for rep in self._replicas:
+            rep.generation += 1       # fence every driver
+        for t in threads:
+            if t is not None:
+                t.join(timeout=2.0)
+        self._sup_thread = None
+        if finalize_pending:
+            with self._flock:
+                pending, self._failover = list(self._failover), deque()
+            for freq, _ in pending:
+                self._finalize(freq, "error")
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- dispatch ----------------------------------------------------------
+    def _score(self, rep):
+        """Latency-aware routing score: expected time for a NEW request
+        to clear this replica — (waiting + running + itself) iterations
+        at the replica's observed decode rate.  Unknown TPOT borrows the
+        fleet mean so cold replicas aren't shunned."""
+        sch = rep.engine.scheduler
+        depth = len(sch.queue) + len(sch.running)
+        known = [r.tpot_ewma for r in self._replicas
+                 if r.tpot_ewma]
+        default = sum(known) / len(known) if known else 1.0
+        tpot = rep.tpot_ewma if rep.tpot_ewma else default
+        return (depth + 1.0) * tpot
+
+    def _candidates(self):
+        return [r for r in self._replicas
+                if r.health.dispatchable and r.engine is not None]
+
+    def _choose(self, prefer_not=None, exclude=()):
+        cands = [r for r in self._candidates() if r.name not in exclude]
+        if not cands:
+            return None
+        if prefer_not is not None and len(cands) > 1:
+            others = [r for r in cands if r.name != prefer_not]
+            cands = others or cands
+        return min(cands,
+                   key=lambda r: (self._score(r), r.dispatches, r.name))
+
+    def _unavailable(self, now=None, count=True):
+        now = self._clock() if now is None else now
+        states = {r.name: r.health.state for r in self._replicas}
+        waits = [r.breaker.retry_after(now) for r in self._replicas
+                 if r.health.state in (QUARANTINED, RESTARTING)]
+        if count:
+            self._m_unavail.inc()
+        return FleetUnavailable(states,
+                                min(waits) if waits else None)
+
+    def _wrap_stream(self, freq):
+        if freq.stream_cb is None:
+            return None
+
+        def cb(tok, attempt_req):
+            # fence: only the CURRENT attempt delivers — a superseded
+            # (wedged/failed-over) attempt's late emits are dropped, and
+            # replayed tokens never reach here (the engine absorbs them)
+            if freq.finished or freq.attempt is not attempt_req:
+                return
+            freq.stream_cb(int(tok), freq)
+
+        return cb
+
+    def _submit_on(self, rep, freq, replay=None, secondary=False):
+        """Dispatch (or re-dispatch) one fleet request onto a replica.
+        Caller picked ``rep``; raises EngineOverloaded through."""
+        with rep.lock:
+            attempt = rep.engine.submit(
+                freq.prompt, freq.max_new,
+                stream=self._wrap_stream(freq), eos_id=freq.eos_id,
+                deadline=freq.deadline, replay=replay, rid=freq.rid)
+            rep.inflight[attempt.rid] = (freq, attempt)
+            rep.dispatches += 1
+        if secondary:
+            freq.hedge_attempt = (rep.name, attempt)
+        else:
+            freq.attempt = attempt
+            freq.engine = rep.name
+            if freq.rid is None:
+                freq.rid = attempt.rid
+        freq.engines.append(rep.name)
+        self._m_dispatch.labels(engine=rep.name).inc()
+        return attempt
+
+    def submit(self, prompt, max_new, stream=None, eos_id=None,
+               ttl=None, deadline=None, hedge=False):
+        """Route one request to the best replica; returns its
+        :class:`FleetRequest`.  Raises :class:`FleetUnavailable` when no
+        replica is dispatchable, or the last replica's
+        :class:`~.scheduler.EngineOverloaded` when every dispatchable
+        replica refused admission (the cluster is full, not down).
+        ``hedge=True`` duplicates onto the second-best replica too —
+        first terminal success wins, the loser is cancelled."""
+        now = self._clock()
+        if ttl is not None:
+            if deadline is not None:
+                raise ValueError("pass ttl= or deadline=, not both")
+            if ttl <= 0:
+                raise ValueError(f"ttl must be > 0, got {ttl}")
+            deadline = now + float(ttl)
+        freq = FleetRequest(prompt, max_new, stream=stream,
+                            eos_id=eos_id, deadline=deadline,
+                            arrival=now, hedge=hedge)
+        rep = self._place(freq, now=now)
+        self._requests[freq.rid] = freq
+        self.submitted += 1
+        if hedge:
+            second = self._choose(exclude={rep.name})
+            if second is not None:
+                try:
+                    self._submit_on(second, freq, secondary=True)
+                    self.hedged += 1
+                    self._m_hedged.inc()
+                except EngineOverloaded:
+                    # hedging is best-effort insurance: the primary is
+                    # already placed, so a full second replica only
+                    # costs the duplicate — record and move on
+                    self.hedges_skipped += 1
+        return freq
+
+    def _place(self, freq, now=None, prefer_not=None, replay=None,
+               count_unavailable=True):
+        """Dispatch onto the best replica, falling through overloaded
+        ones (each replica is tried at most once — the loop is bounded
+        by the fleet size).  Raises the last EngineOverloaded when every
+        dispatchable replica is full, FleetUnavailable when none is
+        dispatchable at all; returns the replica on success.
+        ``count_unavailable=False`` keeps internal retries (failover
+        parking) out of the client-facing refusal counter."""
+        tried, last_overload = set(), None
+        for _ in range(len(self._replicas)):
+            rep = self._choose(prefer_not=prefer_not, exclude=tried)
+            if rep is None:
+                break
+            try:
+                self._submit_on(rep, freq, replay=replay)
+                return rep
+            except EngineOverloaded as e:
+                tried.add(rep.name)
+                last_overload = e
+        if last_overload is not None:
+            raise last_overload
+        raise self._unavailable(now, count=count_unavailable)
+
+    def cancel(self, rid):
+        """Cancel the live fleet request with this rid on whichever
+        replica(s) hold an attempt (or in the failover queue).  Returns
+        True if a live request was found."""
+        freq = self._requests.get(rid)
+        if freq is None or freq.finished:
+            return False
+        freq.cancel_requested = True
+        hit = False
+        for rep in self._replicas:
+            if rid in rep.inflight and rep.engine is not None:
+                with rep.lock:
+                    hit = rep.engine.cancel(rid) or hit
+        with self._flock:
+            for i, (f, _) in enumerate(self._failover):
+                if f is freq:
+                    del self._failover[i]
+                    self._finalize(freq, "cancelled")
+                    hit = True
+                    break
+        if not self.threaded:
+            self._reap_all()
+        return hit
+
+    # -- the drive loop ----------------------------------------------------
+    def _drive(self, rep, gen):
+        while self._running and rep.generation == gen:
+            busy = self._tick(rep, gen)
+            if not busy:
+                time.sleep(self.idle_sleep)
+
+    def _tick(self, rep, gen=None):
+        """One driver pass over a replica: heartbeat, one engine
+        iteration, then fault/terminal bookkeeping.  Returns True when
+        the replica did work."""
+        gen = rep.generation if gen is None else gen
+        rep.health.heartbeat()
+        actions = None
+        with rep.lock:
+            if rep.generation != gen:
+                return False
+            state = rep.health.state
+            if state in (QUARANTINED, RESTARTING, STOPPED):
+                return False
+            if rep.engine.scheduler.idle:
+                if state == DRAINING:
+                    rep.health.to(STOPPED, "drained")
+                    self._set_health(rep)
+                busy = False
+                actions = self._reap_locked(rep)
+            else:
+                try:
+                    rep.engine.step()
+                except Exception as e:      # engine crash
+                    if rep.generation != gen:
+                        return False
+                    actions = self._on_crash_locked(rep, e)
+                    busy = False
+                else:
+                    if rep.generation != gen:
+                        return False
+                    busy = True
+                    actions = self._after_step_locked(rep)
+        if actions:
+            self._queue_failovers(actions)
+        self._run_cancels()
+        return busy
+
+    def _set_health(self, rep):
+        self._m_health.labels(engine=rep.name).set(
+            HEALTH_STATE_CODES[rep.health.state])
+
+    def _after_step_locked(self, rep):
+        """Post-step bookkeeping under the replica lock: feed the health
+        machine, quarantine on a trip streak (harvest + failover), and
+        map finished attempts onto fleet terminals.  Returns requests
+        needing a new home (dispatched OUTSIDE the lock — two drivers
+        failing over toward each other must not deadlock)."""
+        trips = rep.engine.watchdog_trips
+        delta = trips - rep.last_trips
+        rep.last_trips = trips
+        state = rep.health.observe(delta)
+        self._set_health(rep)
+        if state == QUARANTINED:
+            return self._quarantine_locked(
+                rep, rep.health.last_reason or "watchdog trips")
+        if (state == HEALTHY and rep.breaker.failures
+                and rep.health.clean_ticks >= rep.health.recover_after):
+            rep.breaker.close()     # probation served: reset the backoff
+        return self._reap_locked(rep)
+
+    def _reap_locked(self, rep):
+        """Map finished engine-level attempts to fleet-level outcomes."""
+        failovers = []
+        for rid in [r for r, (_, a) in rep.inflight.items()
+                    if a.finished]:
+            freq, attempt = rep.inflight.pop(rid)
+            reason = attempt.finish_reason
+            if freq.finished or reason == "failover":
+                continue    # hedge loser / already harvested
+            if reason in ("eos", "max_new"):
+                if freq.attempt is not attempt:
+                    # hedge secondary finished first: promote it
+                    freq.attempt = attempt
+                    freq.engine = rep.name
+                self._update_ewma(rep, attempt)
+                self._finalize(freq, reason, cancel_others=True)
+            elif reason in ("deadline", "cancelled"):
+                if freq.attempt is attempt:
+                    if reason == "cancelled" and not freq.cancel_requested:
+                        # engine-side cancel the fleet didn't ask for
+                        # (shouldn't happen) — treat as an error attempt
+                        failovers.extend(
+                            self._failover_or_fail(freq, attempt))
+                    else:
+                        self._finalize(freq, reason, cancel_others=True)
+                # a cancelled hedge loser needs nothing
+            elif reason == "error":
+                other = self._promote_survivor(freq, attempt)
+                if not other:
+                    failovers.extend(
+                        self._failover_or_fail(freq, attempt))
+        return failovers
+
+    def _promote_survivor(self, freq, dead_attempt):
+        """Hedged request lost one attempt: bind to the live one."""
+        if freq.attempt is dead_attempt and freq.hedge_attempt:
+            name, att = freq.hedge_attempt
+            if not att.finished:
+                freq.attempt, freq.engine = att, name
+                freq.hedge_attempt = None
+                return True
+        if (freq.hedge_attempt
+                and freq.hedge_attempt[1] is dead_attempt):
+            freq.hedge_attempt = None
+            return freq.attempt is not None \
+                and not freq.attempt.finished
+        return False
+
+    def _failover_or_fail(self, freq, attempt):
+        """The attempt died: queue a re-home, or give up past the cap."""
+        freq.failovers += 1
+        tokens = list(attempt.tokens)
+        freq._tokens_snapshot = tokens
+        freq.attempt = None         # fence late emits from the old one
+        if freq.failovers > self.max_failovers:
+            self._finalize(freq, "error")
+            return []
+        return [(freq, tokens)]
+
+    def _quarantine_locked(self, rep, reason, harvest=True):
+        """Open the breaker and (when the engine is still callable)
+        harvest every live request for failover."""
+        rep.health.to(QUARANTINED, reason)
+        self._set_health(rep)
+        rep.breaker.open_()
+        self._m_breaker.labels(engine=rep.name).inc()
+        out = []
+        if harvest and rep.engine is not None:
+            harvested = rep.engine.harvest()
+            for req in harvested:
+                entry = rep.inflight.pop(req.rid, None)
+                if entry is None:
+                    continue
+                freq, attempt = entry
+                if freq.finished:
+                    continue
+                if self._promote_survivor(freq, attempt):
+                    continue    # hedged twin still live elsewhere
+                out.extend(self._failover_or_fail(freq, attempt))
+            # anything else finished in the same iteration
+            out.extend(self._reap_locked(rep))
+        return out
+
+    def _on_crash_locked(self, rep, exc):
+        rep.last_error = exc
+        self._m_crashes.labels(engine=rep.name).inc()
+        warnings.warn(
+            f"fleet {self.name}: engine {rep.name} crashed with "
+            f"{type(exc).__name__}: {exc} — quarantined, in-flight "
+            "requests failing over")
+        return self._quarantine_locked(
+            rep, f"engine crashed: {type(exc).__name__}")
+
+    def _update_ewma(self, rep, attempt):
+        a = self.ewma_alpha
+        for field, val in (("ttft_ewma", attempt.ttft),
+                           ("tpot_ewma", attempt.tpot)):
+            if val is None:
+                continue
+            cur = getattr(rep, field)
+            setattr(rep, field,
+                    float(val) if cur is None
+                    else (1.0 - a) * cur + a * float(val))
+
+    def _finalize(self, freq, reason, cancel_others=False):
+        if freq.finished:
+            return
+        freq._finished = True
+        freq._finish_reason = reason
+        freq.t_done = self._clock()
+        self.completed += 1
+        if cancel_others and freq.hedge_attempt is not None:
+            name, att = freq.hedge_attempt
+            freq.hedge_attempt = None
+            if not att.finished:
+                self._cancels.append((name, att.rid))
+
+    def _run_cancels(self):
+        """Deferred cross-replica cancels (hedge losers): issued outside
+        any other replica's lock to keep lock order acyclic."""
+        while self._cancels:
+            try:
+                name, rid = self._cancels.popleft()
+            except IndexError:
+                return
+            rep = self._by_name(name)
+            if rep is None or rep.engine is None:
+                continue
+            with rep.lock:
+                rep.inflight.pop(rid, None)
+                rep.engine.cancel(rid)
+
+    # -- failover + supervision --------------------------------------------
+    def _queue_failovers(self, items):
+        if not items:
+            return
+        with self._flock:
+            self._failover.extend(items)
+        if not self.threaded:
+            self._dispatch_failovers()
+
+    def _dispatch_failovers(self):
+        """Re-home harvested requests: replay their tokens-so-far on the
+        best sibling.  Requests that cannot be placed yet stay queued
+        (the supervisor retries each pass); expired ones finalize.  One
+        bounded pass over the queue snapshot per call."""
+        with self._flock:
+            pending, self._failover = list(self._failover), deque()
+        for i, (freq, tokens) in enumerate(pending):
+            if freq.finished:
+                continue
+            now = self._clock()
+            if freq.deadline is not None and now >= freq.deadline:
+                self._finalize(freq, "deadline")
+                continue
+            try:
+                self._place(freq, now=now,
+                            prefer_not=(freq.engines[-1]
+                                        if freq.engines else None),
+                            replay=tokens or None,
+                            count_unavailable=False)
+            except (EngineOverloaded, FleetUnavailable):
+                # no home right now: park this and everything behind it
+                # (order preserved) until capacity or a restart returns
+                with self._flock:
+                    self._failover.extendleft(reversed(pending[i:]))
+                return
+            self.failovers_done += 1
+            self._m_failovers.inc()
+
+    def _supervise_loop(self):
+        while self._running:
+            try:
+                self._supervise_once()
+            except Exception as e:      # supervisor must never die
+                warnings.warn(
+                    f"fleet {self.name}: supervisor error "
+                    f"{type(e).__name__}: {e}")
+            time.sleep(self.supervise_interval)
+
+    def _supervise_once(self):
+        """One supervision pass: wedge detection (threaded only),
+        breaker-gated restarts, failover dispatch, deferred cancels."""
+        now = self._clock()
+        for rep in self._replicas:
+            if (self.threaded and rep.thread is not None
+                    and rep.thread.is_alive()
+                    and rep.health.state in (HEALTHY, DEGRADED)
+                    and rep.engine is not None
+                    and not rep.engine.scheduler.idle
+                    and rep.health.heartbeat_age(now)
+                    > self.wedge_timeout):
+                self._on_wedge(rep, rep.health.heartbeat_age(now))
+            if (rep.health.state == QUARANTINED and self.auto_restart
+                    and rep.breaker.allow(now)):
+                self.restart(rep.name)
+        self._dispatch_failovers()
+        self._run_cancels()
+
+    def _on_wedge(self, rep, age):
+        """A driver stuck inside ``step()`` (hung device call, stalled
+        callback): fence it, harvest from a SNAPSHOT (the zombie holds
+        the lock, so no clean retire — the engine is abandoned and
+        replaced at restart), fail the requests over."""
+        rep.generation += 1         # zombie exits when step returns
+        self._m_wedges.labels(engine=rep.name).inc()
+        warnings.warn(
+            f"fleet {self.name}: engine {rep.name} heartbeat stale "
+            f"{age:.2f}s — wedged; quarantining and failing over")
+        inflight, rep.inflight = rep.inflight, {}
+        out = []
+        for rid, (freq, attempt) in inflight.items():
+            if freq.finished:
+                continue
+            if self._promote_survivor(freq, attempt):
+                continue
+            out.extend(self._failover_or_fail(freq, attempt))
+        # lockless state flip: the zombie only touches the engine, and
+        # every post-step path re-checks the generation fence
+        rep.health.to(QUARANTINED, f"heartbeat stale {age:.2f}s")
+        self._set_health(rep)
+        rep.breaker.open_()
+        self._m_breaker.labels(engine=rep.name).inc()
+        rep.engine = None           # abandoned with the zombie
+        self._queue_failovers(out)
+
+    # -- restart / drain ---------------------------------------------------
+    def restart(self, name):
+        """Supervised restart: fence any old driver, rebuild the engine
+        (fresh KV pool; the compile-once program cache is shared, so no
+        retrace), and return the replica to HEALTHY.  The breaker keeps
+        its failure streak until the replica proves itself with clean
+        ticks — a crash loop backs off exponentially."""
+        rep = self._by_name(name, required=True)
+        if rep.inflight and rep.engine is not None \
+                and rep.health.state not in (QUARANTINED, RESTARTING):
+            # operator restart of a LIVE replica: fail its work over
+            # first (an imposed quarantine), never drop bookkeeping
+            with rep.lock:
+                actions = self._quarantine_locked(rep,
+                                                  "operator restart")
+            self._queue_failovers(actions)
+        rep.generation += 1
+        rep.health.to(RESTARTING, "supervised restart")
+        self._set_health(rep)
+        rep.incarnation += 1
+        # a wedged zombie may hold the old lock forever: new lock too
+        rep.lock = threading.RLock()
+        rep.engine = self._build_engine(rep.index, rep.incarnation)
+        rep.last_trips = 0
+        rep.inflight = {}
+        rep.health.to(HEALTHY, "restarted")
+        self._set_health(rep)
+        self._m_restarts.labels(engine=rep.name).inc()
+        if self.threaded and self._running:
+            self._start_driver(rep)
+        return rep.name
+
+    def drain(self, name=None, wait=True, timeout=60.0):
+        """Stop dispatching to the replica(s) but finish what they hold;
+        DRAINING flips to STOPPED at idle.  ``wait=True`` blocks (or
+        pumps, when ``threaded=False``) until drained."""
+        reps = ([self._by_name(name, required=True)] if name is not None
+                else list(self._replicas))
+        for rep in reps:
+            if rep.health.state in (QUARANTINED, RESTARTING, STOPPED):
+                continue
+            rep.health.to(DRAINING, "drain requested")
+            self._set_health(rep)
+            self._m_drains.labels(engine=rep.name).inc()
+        if wait:
+            self._wait_for(
+                lambda: all(r.health.state != DRAINING for r in reps),
+                timeout, "drain")
+        return self
+
+    def rolling_restart(self, timeout=60.0):
+        """Zero-accepted-loss rolling restart: drain each replica in
+        turn (siblings keep serving), restart it, move on."""
+        for rep in list(self._replicas):
+            self.drain(rep.name, wait=True, timeout=timeout)
+            self.restart(rep.name)
+        return self
+
+    # -- pumping / waiting -------------------------------------------------
+    def pump(self, iterations=1):
+        """Deterministic manual drive (``threaded=False`` fleets): one
+        tick per replica per iteration, then one supervision pass."""
+        if self.threaded:
+            raise RuntimeError(
+                "pump() drives threaded=False fleets; this one runs "
+                "driver threads")
+        for _ in range(int(iterations)):
+            for rep in self._replicas:
+                self._tick(rep)
+            self._supervise_once()
+        return self
+
+    def _reap_all(self):
+        """Manual-mode bookkeeping sweep without stepping engines."""
+        for rep in self._replicas:
+            if rep.engine is None:
+                continue
+            with rep.lock:
+                actions = self._reap_locked(rep)
+            self._queue_failovers(actions)
+
+    @property
+    def idle(self):
+        with self._flock:
+            if self._failover:
+                return False
+        for rep in self._replicas:
+            if rep.health.state in (QUARANTINED, RESTARTING):
+                continue        # harvested; nothing of ours runs there
+            if rep.engine is not None \
+                    and not rep.engine.scheduler.idle:
+                return False
+        return True
+
+    def _wait_for(self, cond, timeout, what):
+        if not self.threaded:
+            it = 0
+            while not cond():
+                if it >= 100000:
+                    raise RuntimeError(
+                        f"fleet {what} did not complete in {it} pumps")
+                self.pump()
+                it += 1
+            return
+        deadline = time.perf_counter() + timeout
+        while not cond():
+            if time.perf_counter() >= deadline:
+                raise TimeoutError(
+                    f"fleet {what} did not complete within {timeout}s")
+            time.sleep(self.idle_sleep)
+
+    def wait(self, reqs=None, timeout=60.0):
+        """Block (threaded) or pump (manual) until ``reqs`` (default:
+        every accepted request) all reach a terminal finish_reason."""
+        reqs = list(self._requests.values()) if reqs is None else reqs
+        self._wait_for(lambda: all(r.finished for r in reqs), timeout,
+                       "wait")
+        return reqs
+
+    def generate_many(self, prompts, max_new, eos_id=None, timeout=60.0):
+        """Synchronous batch API across the fleet."""
+        reqs = [self.submit(p, max_new, eos_id=eos_id) for p in prompts]
+        self.wait(reqs, timeout=timeout)
+        return [r.result() for r in reqs]
+
+    # -- introspection -----------------------------------------------------
+    def _by_name(self, name, required=False):
+        for rep in self._replicas:
+            if rep.name == name:
+                return rep
+        if required:
+            raise KeyError(f"no replica named {name!r}")
+        return None
+
+    def health(self):
+        """{engine: health snapshot} for every replica."""
+        return {r.name: r.health.snapshot() for r in self._replicas}
+
+    def audit(self):
+        """Per-replica slot audit of every LIVE engine (a wedged
+        engine's pool is abandoned with it and replaced at restart)."""
+        return {r.name: r.engine.cache.audit()
+                for r in self._replicas if r.engine is not None}
+
+    def trace_counts(self):
+        """The shared compile-once witness (max over live replicas —
+        they share the program cache, so these are the same entry)."""
+        out = {}
+        for r in self._replicas:
+            if r.engine is None:
+                continue
+            for k, v in r.engine.trace_counts.items():
+                out[k] = max(out.get(k, 0), v)
+        return out
+
+    def stats(self):
+        with self._flock:
+            pending = len(self._failover)
+        reasons = {}
+        for freq in self._requests.values():
+            if freq.finished:
+                reasons[freq.finish_reason] = \
+                    reasons.get(freq.finish_reason, 0) + 1
+        return {
+            "n_engines": len(self._replicas),
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failovers": self.failovers_done,
+            "hedged": self.hedged,
+            "hedges_skipped": self.hedges_skipped,
+            "pending_failovers": pending,
+            "finish_reasons": reasons,
+            "trace_counts": self.trace_counts(),
+            "engines": {
+                r.name: {
+                    "state": r.health.state,
+                    "incarnation": r.incarnation,
+                    "dispatches": r.dispatches,
+                    "ttft_ewma": r.ttft_ewma,
+                    "tpot_ewma": r.tpot_ewma,
+                    "breaker_opens": r.breaker.opens,
+                    "breaker_failures": r.breaker.failures,
+                    "engine": (None if r.engine is None
+                               else r.engine.stats()),
+                } for r in self._replicas},
+        }

@@ -1,0 +1,211 @@
+"""Replica health: the per-engine state machine + circuit breaker.
+
+A fleet replica is not "up or down" — production engines degrade before
+they die (watchdog trips piling up, iterations slowing, heartbeats going
+stale) and the router must stop feeding a replica BEFORE it takes new
+streams down with it.  Two small, clock-injectable pieces:
+
+* :class:`ReplicaHealth` — the state machine
+
+      HEALTHY -> DEGRADED -> QUARANTINED -> RESTARTING -> HEALTHY
+                     |                          ^
+                     +-- (clean ticks) ---------+--- DRAINING -> STOPPED
+
+  driven by per-iteration observations (watchdog-trip deltas from the
+  engine's registry-mirrored counters) and heartbeats (the driver bumps
+  one per loop pass, so a wedged ``step()`` shows up as a stale
+  heartbeat while the thread is stuck inside the jitted call).
+  DEGRADED replicas still serve (the router just prefers others);
+  QUARANTINED replicas serve nothing and their in-flight requests are
+  failed over; DRAINING replicas finish what they hold but admit
+  nothing new (rolling restarts); STOPPED is a drained replica waiting
+  for restart or teardown.
+
+* :class:`CircuitBreaker` — gates READMISSION after quarantine with
+  exponential backoff: each consecutive open doubles the wait (capped),
+  and the breaker only resets once the replica has proven itself with
+  clean ticks after restart — a crash-looping replica backs off
+  geometrically instead of flapping through restart cycles.
+
+Neither class touches the engine: the fleet observes engine counters and
+feeds them in, so health logic is testable with a hand clock and no jax.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["HEALTH_STATES", "HEALTH_STATE_CODES", "HEALTHY", "DEGRADED",
+           "QUARANTINED", "RESTARTING", "DRAINING", "STOPPED",
+           "CircuitBreaker", "ReplicaHealth"]
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+QUARANTINED = "quarantined"
+RESTARTING = "restarting"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+#: every state a replica can be in, in severity order
+HEALTH_STATES = (HEALTHY, DEGRADED, QUARANTINED, RESTARTING, DRAINING,
+                 STOPPED)
+
+#: numeric encoding for the ``hetu_fleet_engine_health_state`` gauge
+#: (Prometheus gauges are floats; dashboards map code -> name)
+HEALTH_STATE_CODES = {s: i for i, s in enumerate(HEALTH_STATES)}
+
+#: states the router may dispatch new requests to
+DISPATCHABLE = (HEALTHY, DEGRADED)
+
+
+class CircuitBreaker:
+    """Exponential-backoff gate on replica readmission.
+
+    ``open_()`` records a failure and closes the gate for
+    ``base * 2^(failures-1)`` seconds (capped); ``allow()`` reports
+    whether the gate has re-opened (the half-open trial: the supervisor
+    restarts the replica and watches it); ``close()`` resets after the
+    replica proves healthy.  ``retry_after()`` is the remaining backoff
+    — what :class:`~.fleet.FleetUnavailable` aggregates into its hint.
+    """
+
+    def __init__(self, base=0.25, cap=30.0, clock=None):
+        if base <= 0 or cap < base:
+            raise ValueError(
+                f"need 0 < base <= cap, got base={base} cap={cap}")
+        self.base = float(base)
+        self.cap = float(cap)
+        self._clock = clock if clock is not None else time.perf_counter
+        self.failures = 0          # consecutive opens since last close
+        self.opens = 0             # lifetime opens (telemetry)
+        self._open_until = None
+
+    @property
+    def backoff(self):
+        """The wait the NEXT open would impose (current: see
+        ``retry_after``)."""
+        return min(self.cap, self.base * 2 ** self.failures)
+
+    def open_(self):
+        """Record a failure; returns the backoff now in force."""
+        wait = min(self.cap, self.base * 2 ** self.failures)
+        self.failures += 1
+        self.opens += 1
+        self._open_until = self._clock() + wait
+        return wait
+
+    def allow(self, now=None):
+        """True when the gate is closed or the backoff has elapsed."""
+        if self._open_until is None:
+            return True
+        now = self._clock() if now is None else now
+        return now >= self._open_until
+
+    def retry_after(self, now=None):
+        """Seconds until the gate re-opens (0.0 when it already has)."""
+        if self._open_until is None:
+            return 0.0
+        now = self._clock() if now is None else now
+        return max(0.0, self._open_until - now)
+
+    def close(self):
+        """The replica proved itself: reset the failure streak."""
+        self.failures = 0
+        self._open_until = None
+
+    def __repr__(self):
+        state = "closed" if self._open_until is None else \
+            f"open({self.retry_after():.3f}s left)"
+        return (f"CircuitBreaker({state}, failures={self.failures}, "
+                f"opens={self.opens})")
+
+
+class ReplicaHealth:
+    """One replica's health state + the counters that drive it.
+
+    ``observe(trips_delta)`` is called once per driver tick with the
+    change in the engine's watchdog-trip count (slot quarantines AND
+    raising steps both land there); ``heartbeat()`` once per driver loop
+    pass.  Transitions the fleet imposes from outside (crash, wedge,
+    drain, restart) go through :meth:`to`.
+    """
+
+    def __init__(self, name, degraded_after=1, quarantine_after=3,
+                 recover_after=8, clock=None):
+        if not 1 <= degraded_after <= quarantine_after:
+            raise ValueError(
+                f"need 1 <= degraded_after <= quarantine_after, got "
+                f"{degraded_after} / {quarantine_after}")
+        self.name = str(name)
+        self.degraded_after = int(degraded_after)
+        self.quarantine_after = int(quarantine_after)
+        self.recover_after = int(recover_after)
+        self._clock = clock if clock is not None else time.perf_counter
+        self.state = HEALTHY
+        self.consecutive_faults = 0
+        self.clean_ticks = 0
+        self.last_heartbeat = self._clock()
+        self.last_reason = None     # why the last transition happened
+        self.transitions = []       # [(state, reason)] history
+
+    @property
+    def dispatchable(self):
+        return self.state in DISPATCHABLE
+
+    def heartbeat(self):
+        self.last_heartbeat = self._clock()
+
+    def heartbeat_age(self, now=None):
+        now = self._clock() if now is None else now
+        return now - self.last_heartbeat
+
+    def to(self, state, reason=None):
+        """Externally-imposed transition (crash/wedge/drain/restart)."""
+        if state not in HEALTH_STATES:
+            raise ValueError(f"unknown health state {state!r}")
+        if state != self.state:
+            self.state = state
+            self.last_reason = reason
+            self.transitions.append((state, reason))
+        if state in (HEALTHY, RESTARTING):
+            self.consecutive_faults = 0
+            self.clean_ticks = 0
+        return self.state
+
+    def observe(self, trips_delta):
+        """Feed one tick's fault evidence; returns the (possibly new)
+        state.  Only HEALTHY<->DEGRADED->QUARANTINED moves happen here —
+        draining/restarting replicas are under external control."""
+        if self.state not in (HEALTHY, DEGRADED):
+            return self.state
+        if trips_delta > 0:
+            self.consecutive_faults += int(trips_delta)
+            self.clean_ticks = 0
+            if self.consecutive_faults >= self.quarantine_after:
+                return self.to(
+                    QUARANTINED,
+                    f"{self.consecutive_faults} consecutive watchdog "
+                    "trips")
+            if self.consecutive_faults >= self.degraded_after:
+                return self.to(
+                    DEGRADED,
+                    f"{self.consecutive_faults} watchdog trip(s)")
+            return self.state
+        self.clean_ticks += 1
+        if (self.state == DEGRADED
+                and self.clean_ticks >= self.recover_after):
+            self.consecutive_faults = 0
+            return self.to(HEALTHY,
+                           f"{self.clean_ticks} clean iterations")
+        return self.state
+
+    def snapshot(self):
+        return {"state": self.state,
+                "code": HEALTH_STATE_CODES[self.state],
+                "consecutive_faults": self.consecutive_faults,
+                "clean_ticks": self.clean_ticks,
+                "last_reason": self.last_reason}
+
+    def __repr__(self):
+        return (f"ReplicaHealth({self.name}, {self.state}, "
+                f"faults={self.consecutive_faults})")

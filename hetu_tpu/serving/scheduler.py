@@ -53,8 +53,12 @@ from .. import telemetry as _telemetry
 #: healthy ones; "deadline" (TTL passed — at admission or mid-flight),
 #: "cancelled" (engine.cancel / scheduler shed), and "error" (decode
 #: watchdog quarantined the slot) all return whatever tokens were
-#: produced so far as a PARTIAL result.
-FINISH_REASONS = ("eos", "max_new", "deadline", "cancelled", "error")
+#: produced so far as a PARTIAL result.  "failover" is terminal only for
+#: the ENGINE-LEVEL attempt: the fleet harvested the request off this
+#: engine (crash/quarantine/wedge) and the same rid continues on a
+#: sibling — cluster-level, the request is still live.
+FINISH_REASONS = ("eos", "max_new", "deadline", "cancelled", "error",
+                  "failover")
 
 SHED_POLICIES = ("reject_newest", "drop_expired_first")
 
@@ -78,12 +82,22 @@ class Request:
     ``rid`` is assigned by the scheduler at submit time (ids are scoped
     PER SCHEDULER, not process-global: two engines each number their
     requests 0, 1, 2, …, so id-keyed records are deterministic per run
-    and never collide across engines or leak across tests).
+    and never collide across engines or leak across tests).  A scheduler
+    built with ``rid_prefix=`` mints CLUSTER-LEVEL ids ("e0-0", "e0-1",
+    …) so a fleet's records name the engine instance that admitted each
+    request; a pre-assigned ``rid=`` (a fleet failing a request over to
+    a sibling) is kept as-is.
+
+    ``replay=`` carries tokens a previous attempt already generated (and
+    delivered): the engine rebuilds the KV state by teacher-forcing them
+    — prefill + one decode step per replayed token through the SAME
+    shared executables — without re-emitting them, so a failed-over
+    greedy stream continues bitwise identically where it left off.
     """
 
     def __init__(self, prompt, max_new, arrival=None, stream=None,
-                 eos_id=None, deadline=None):
-        self.rid = None           # scheduler-scoped, set on submit
+                 eos_id=None, deadline=None, replay=None, rid=None):
+        self.rid = rid            # scheduler-scoped, set on submit
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError("empty prompt")
@@ -94,6 +108,16 @@ class Request:
         self.eos_id = eos_id
         # absolute deadline on the engine's monotonic clock; None = no TTL
         self.deadline = None if deadline is None else float(deadline)
+        if replay is None:
+            self.replay = None
+        else:
+            self.replay = np.asarray(replay, np.int32).reshape(-1)
+            if self.replay.size >= self.max_new:
+                raise ValueError(
+                    f"replay carries {self.replay.size} tokens but "
+                    f"max_new={self.max_new} — the request was already "
+                    "complete")
+        self._replay_pos = 0
         self.tokens = []          # generated ids, prompt excluded
         self.slot = None
         self.finished = False
@@ -107,6 +131,22 @@ class Request:
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
+
+    # -- failover replay ----------------------------------------------------
+    @property
+    def replaying(self):
+        """True while tokens from a previous attempt remain to rebuild."""
+        return (self.replay is not None
+                and self._replay_pos < self.replay.size)
+
+    def next_replay(self):
+        """The next token to teacher-force (consuming it), or None once
+        the replay is exhausted and decoding continues live."""
+        if not self.replaying:
+            return None
+        tok = int(self.replay[self._replay_pos])
+        self._replay_pos += 1
+        return tok
 
     # -- latency views (None until the corresponding edge has passed) ------
     @property
@@ -146,7 +186,7 @@ class Scheduler:
 
     def __init__(self, cache, prefill_budget=2, gang=False,
                  max_queue=None, low_watermark=None,
-                 shed_policy="reject_newest"):
+                 shed_policy="reject_newest", rid_prefix=None):
         if prefill_budget < 1:
             raise ValueError(
                 f"prefill_budget must be >= 1, got {prefill_budget}")
@@ -178,6 +218,8 @@ class Scheduler:
         self.running = {}           # slot -> Request
         self.admitted_order = []    # rids in prefill order (FIFO witness)
         self._ids = itertools.count()   # rid source, scoped to THIS scheduler
+        # cluster-level ids: "e0-0", "e0-1", … name the engine instance
+        self.rid_prefix = None if rid_prefix is None else str(rid_prefix)
         self._shedding = False      # watermark hysteresis state
         self.shed = []              # expired requests shed at submit
         self.rejected = 0
@@ -254,7 +296,9 @@ class Scheduler:
                 self._m_rejected.inc()
                 raise EngineOverloaded(len(self.queue), self.max_queue)
         if request.rid is None:
-            request.rid = next(self._ids)
+            n = next(self._ids)
+            request.rid = (n if self.rid_prefix is None
+                           else f"{self.rid_prefix}-{n}")
         self.queue.append(request)
         depth = len(self.queue)
         self._m_queue.set(depth)

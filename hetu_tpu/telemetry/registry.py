@@ -252,6 +252,18 @@ class MetricsRegistry:
                 f"metric {name!r} already registered as {m.kind} with "
                 f"labels {m.label_names}; cannot re-register as "
                 f"{cls.kind} with labels {tuple(labels)}")
+        if "buckets" in kw:
+            want = tuple(float(b) for b in kw["buckets"])
+            if m.buckets != want:
+                # instruments are cached by name, so two callers asking
+                # for one histogram with different ladders would
+                # SILENTLY share whichever registered first — the
+                # per-deployment override (buckets= threaded through
+                # InferenceEngine/EngineFleet) must instead fail loudly
+                raise ValueError(
+                    f"histogram {name!r} already registered with "
+                    f"buckets {m.buckets}; cannot re-register with "
+                    f"{want} — pick one ladder per deployment")
         return m
 
     def counter(self, name, help="", labels=()):
@@ -262,6 +274,8 @@ class MetricsRegistry:
 
     def histogram(self, name, help="", labels=(),
                   buckets=DEFAULT_BUCKETS):
+        """``buckets=`` sets the ladder at FIRST registration (the
+        per-deployment override path); later registrations must agree."""
         return self._get(Histogram, name, help, labels, buckets=buckets)
 
     def reset(self):

@@ -20,9 +20,10 @@ Export: ``aggregate()`` for per-phase totals (the bench's host_gap
 decomposition) and ``chrome_trace()`` for chrome://tracing /
 Perfetto — optionally MERGED with a ``jax.profiler.trace`` capture's
 events, so host phases and XLA device ops land in one viewer.  The two
-event sets keep their own clock bases (jax's capture epoch is not
-recoverable host-side); lanes align per step by span boundaries, not by
-absolute timestamp.
+event sets keep their own clock bases by default (jax's capture epoch
+is not recoverable host-side); ``align_steps=True`` makes the merged
+view time-accurate by shifting the k-th host step group onto the k-th
+device step's clock base (anchor span k ↔ k-th jitted-step execution).
 """
 
 from __future__ import annotations
@@ -124,7 +125,9 @@ class SpanTracer:
                 for name, (t, c) in sorted(agg.items())}
 
     # -- Chrome-trace export ----------------------------------------------
-    def chrome_trace(self, jax_trace_dir=None, pid=1 << 20):
+    def chrome_trace(self, jax_trace_dir=None, pid=1 << 20,
+                     align_steps=False, step_span="dispatch",
+                     device_step_regex=r"jit"):
         """Trace-event JSON (``{"traceEvents": [...]}``) of the retained
         spans — complete ``X`` events in microseconds relative to the
         tracer epoch, on one process lane named ``hetu host spans``.
@@ -132,29 +135,65 @@ class SpanTracer:
         ``jax_trace_dir``: a ``jax.profiler.trace`` output directory
         whose newest capture's events are merged in ahead of ours, so
         one chrome://tracing load shows XLA device lanes next to the
-        host phases (clock bases differ; see module doc)."""
+        host phases.
+
+        The two event sets keep separate clock bases (jax's capture
+        epoch is not recoverable host-side) — UNLESS ``align_steps=True``
+        maps them per step: the k-th occurrence of the ``step_span``
+        host span is shifted onto the k-th device-lane event whose name
+        matches ``device_step_regex`` (the jitted step executions,
+        sorted by timestamp), and every other host span takes the
+        offset of its step's anchor.  With that, the merged view is
+        TIME-ACCURATE per step: host ``dispatch`` k starts exactly where
+        device step k starts, and the surrounding phases sit on the
+        same per-step clock base.  Host steps beyond the captured device
+        steps reuse the last known offset."""
+        spans = self.spans()
+        captured_events = []
+        if jax_trace_dir is not None:
+            import gzip
+            from ..timeline import _latest_trace_json
+            captured = json.loads(
+                gzip.open(_latest_trace_json(jax_trace_dir)).read())
+            captured_events = list(captured.get("traceEvents", []))
+        offsets = None
+        if align_steps and captured_events:
+            import re
+            pat = re.compile(device_step_regex)
+            dev = sorted(
+                (e for e in captured_events
+                 if e.get("ph") == "X" and "ts" in e
+                 and pat.search(str(e.get("name", "")))),
+                key=lambda e: e["ts"])
+            anchors = [(t0 - self._epoch) * 1e6
+                       for name, t0, _ in spans if name == step_span]
+            if dev and anchors:
+                offsets = [dev[min(k, len(dev) - 1)]["ts"] - a
+                           for k, a in enumerate(anchors)]
         events = [
             {"ph": "M", "pid": pid, "name": "process_name",
              "args": {"name": "hetu host spans"}},
             {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
              "args": {"name": "step phases"}},
         ]
-        for name, t0, dur in self.spans():
-            events.append({"ph": "X", "pid": pid, "tid": 0,
-                           "name": name,
-                           "ts": (t0 - self._epoch) * 1e6,
-                           "dur": dur * 1e6})
-        if jax_trace_dir is not None:
-            import gzip
-            from ..timeline import _latest_trace_json
-            captured = json.loads(
-                gzip.open(_latest_trace_json(jax_trace_dir)).read())
-            events = list(captured.get("traceEvents", [])) + events
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        k = -1      # step anchors passed so far
+        for name, t0, dur in spans:
+            ts = (t0 - self._epoch) * 1e6
+            ev = {"ph": "X", "pid": pid, "tid": 0, "name": name,
+                  "ts": ts, "dur": dur * 1e6}
+            if offsets is not None:
+                if name == step_span:
+                    k += 1
+                step = max(0, min(k, len(offsets) - 1))
+                ev["ts"] = ts + offsets[step]
+                ev["args"] = {"aligned_step": step}
+            events.append(ev)
+        return {"traceEvents": captured_events + events,
+                "displayTimeUnit": "ms"}
 
-    def export_chrome(self, path, jax_trace_dir=None):
+    def export_chrome(self, path, jax_trace_dir=None, **kw):
         """Write :meth:`chrome_trace` to ``path``; returns the path."""
-        doc = self.chrome_trace(jax_trace_dir=jax_trace_dir)
+        doc = self.chrome_trace(jax_trace_dir=jax_trace_dir, **kw)
         with open(path, "w") as f:
             json.dump(doc, f)
         return path

@@ -57,6 +57,31 @@ def pytest_runtest_call(item):
         signal.signal(signal.SIGALRM, old)
 
 
+@pytest.fixture(autouse=True)
+def no_leaked_nondaemon_threads(request):
+    """Runtime half of the thread-leak gate (the static half is
+    tests/test_no_leaked_threads.py): after every serving/fleet test,
+    no NEW non-daemon thread may still be alive — a leaked driver or
+    exporter thread would wedge interpreter shutdown.  Scoped to the
+    thread-spawning suites so the rest of tier-1 pays nothing."""
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    if not (mod.startswith("test_serving") or mod.startswith("test_fleet")
+            or mod == "test_telemetry"):
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t not in before and t.is_alive() and not t.daemon]
+    if leaked:        # give wind-down joins a beat before failing
+        import time
+        time.sleep(0.2)
+        leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        f"non-daemon thread(s) leaked by {request.node.nodeid}: "
+        f"{[t.name for t in leaked]}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,127 @@
+"""Tier-1 static check: no leakable threads in hetu_tpu.
+
+A non-daemon thread that is never joined keeps the interpreter alive
+after main() returns — a serving process that "exits" but hangs on a
+forgotten driver thread, a test suite that wedges at shutdown.  The
+fleet layer multiplies thread creation sites (one driver per replica +
+a supervisor), so the rule is now enforced statically (the
+``test_no_silent_except.py`` / ``test_no_unbounded_retry.py`` AST-scan
+pattern):
+
+* every ``threading.Thread(...)`` constructed in ``hetu_tpu/`` must
+  pass ``daemon=True`` at the CONSTRUCTOR — the one form the scanner
+  (and a reviewer) can verify locally.  A thread that must be
+  non-daemon needs a reviewed allowlist entry naming where it is
+  provably joined.
+
+The runtime half of the contract lives in ``tests/conftest.py``: an
+autouse fixture asserts that no non-daemon thread outlives any
+serving/fleet test.
+"""
+
+import ast
+import os
+
+import pytest
+
+HETU_ROOT = os.path.join(os.path.dirname(__file__), "..", "hetu_tpu")
+
+# Reviewed non-daemon sites, as "relative/path.py::enclosing_function".
+# Every entry must say WHERE the thread is joined.
+ALLOWED = {
+    # (none today — every thread in hetu_tpu/ is a daemon)
+}
+
+
+def _is_thread_ctor(call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "Thread"
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Thread"
+    return False
+
+
+def _daemon_true(call):
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True)
+    return False
+
+
+def _nondaemon_thread_sites(root):
+    sites = []
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    sites.append((f"{rel}::<syntax-error>", e.lineno))
+                    continue
+
+            def walk(node, funcname):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    funcname = node.name
+                if (isinstance(node, ast.Call) and _is_thread_ctor(node)
+                        and not _daemon_true(node)):
+                    sites.append((f"{rel}::{funcname}", node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, funcname)
+
+            walk(tree, "<module>")
+    return sites
+
+
+def test_every_thread_is_daemon_or_allowlisted():
+    sites = _nondaemon_thread_sites(HETU_ROOT)
+    new = [f"{key} (line {line})" for key, line in sites
+           if key not in ALLOWED]
+    assert not new, (
+        "threading.Thread constructed without daemon=True in hetu_tpu/ "
+        "— a leaked non-daemon thread wedges process shutdown; pass "
+        "daemon=True (and join where lifecycle matters), or add a "
+        "reviewed allowlist entry in tests/test_no_leaked_threads.py "
+        "naming where the thread is joined:\n  " + "\n  ".join(new))
+
+
+def test_allowlist_not_stale():
+    present = {key for key, _ in _nondaemon_thread_sites(HETU_ROOT)}
+    stale = sorted(set(ALLOWED) - present)
+    assert not stale, (
+        "allowlist entries with no matching thread site — remove them "
+        "from tests/test_no_leaked_threads.py:\n  " + "\n  ".join(stale))
+
+
+def test_scanner_detects_nondaemon_threads(tmp_path):
+    """The scanner must flag missing/False/computed daemon kwargs in
+    both the attribute and bare-name constructor forms, and must NOT
+    flag daemon=True (guards against the gate silently going blind)."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import threading\n"
+        "from threading import Thread\n"
+        "def bad_missing():\n"
+        "    return threading.Thread(target=work)\n"
+        "def bad_false():\n"
+        "    return Thread(target=work, daemon=False)\n"
+        "def bad_computed():\n"
+        "    return Thread(target=work, daemon=flag)\n"
+        "def ok_daemon():\n"
+        "    return threading.Thread(target=work, daemon=True)\n"
+        "def ok_bare_daemon():\n"
+        "    return Thread(target=work, daemon=True)\n")
+    sites = sorted(k for k, _ in _nondaemon_thread_sites(str(tmp_path)))
+    assert sites == ["m.py::bad_computed", "m.py::bad_false",
+                     "m.py::bad_missing"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -234,6 +234,70 @@ def test_chrome_trace_merges_jax_capture(tmp_path):
         tr.chrome_trace(jax_trace_dir=str(tmp_path / "nope"))
 
 
+def test_chrome_trace_per_step_alignment(tmp_path):
+    """align_steps=True shifts host span group k onto the k-th device
+    step's clock base: host ``dispatch`` k starts exactly at device
+    step k's ts, and the step's other spans keep their relative offsets
+    on that base — the merged view is time-accurate per step (ROADMAP
+    carry-over gap)."""
+    import gzip
+    cap = tmp_path / "plugins" / "profile" / "2026_08_04"
+    cap.mkdir(parents=True)
+    device_steps = [
+        {"ph": "X", "pid": 7, "tid": 1, "name": "jit_step.2",
+         "ts": 1_000_000.0, "dur": 400.0},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "jit_step.2",
+         "ts": 2_000_000.0, "dur": 400.0},
+        # a non-step device event must not become an anchor
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.9",
+         "ts": 1_500_000.0, "dur": 10.0},
+    ]
+    with gzip.open(cap / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": device_steps}, f)
+    tr = SpanTracer(capacity=16, enabled=True)
+    for _ in range(2):              # two host steps: h2d then dispatch
+        with tr.span("h2d"):
+            pass
+        with tr.span("dispatch"):
+            pass
+    doc = tr.chrome_trace(jax_trace_dir=str(tmp_path),
+                          align_steps=True)
+    host = [e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e.get("pid") == 1 << 20]
+    dispatches = [e for e in host if e["name"] == "dispatch"]
+    assert len(dispatches) == 2
+    # anchor k sits exactly on device step k's clock base
+    assert dispatches[0]["ts"] == pytest.approx(1_000_000.0)
+    assert dispatches[1]["ts"] == pytest.approx(2_000_000.0)
+    assert dispatches[0]["args"]["aligned_step"] == 0
+    assert dispatches[1]["args"]["aligned_step"] == 1
+    # the step's other spans ride the same per-step offset (h2d_k
+    # precedes dispatch_k on the shifted base)
+    h2ds = [e for e in host if e["name"] == "h2d"]
+    assert h2ds[0]["ts"] <= dispatches[0]["ts"]
+    assert h2ds[1]["args"]["aligned_step"] in (0, 1)
+    # default stays unaligned (separate clock bases, old behavior)
+    doc2 = tr.chrome_trace(jax_trace_dir=str(tmp_path))
+    d2 = [e for e in doc2["traceEvents"]
+          if e.get("ph") == "X" and e.get("pid") == 1 << 20
+          and e["name"] == "dispatch"]
+    assert d2[0]["ts"] < 1_000_000.0
+
+
+def test_histogram_bucket_override_and_mismatch_guard():
+    """buckets= at first registration wins; a later registration with a
+    DIFFERENT ladder fails loudly instead of silently sharing (the
+    per-deployment override contract InferenceEngine/EngineFleet thread
+    through)."""
+    reg = MetricsRegistry(enabled=True)
+    h = reg.histogram("ttft_s", "ttft", buckets=(0.01, 0.1, 1.0))
+    assert h.buckets == (0.01, 0.1, 1.0)
+    # same ladder re-registers fine (instrument cache)
+    assert reg.histogram("ttft_s", buckets=(0.01, 0.1, 1.0)) is h
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("ttft_s", buckets=(0.5, 5.0))
+
+
 # ---------------- JSONL writer ----------------
 
 def test_jsonl_writer_and_registry_emission(tmp_path):
