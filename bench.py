@@ -1204,6 +1204,248 @@ def _emit_serve(out):
     print(json.dumps(compact), flush=True)
 
 
+# -- embedding-serve mode (bench.py --serve-embed) -------------------------
+# Tiered-embedding serving evidence (ROADMAP direction 5): replay one
+# seeded Zipfian key trace (Criteo-shaped skew) through the
+# EmbeddingServer's device hot-row cache and through an UNCACHED
+# host-tier twin that gathers every batch's rows from host RAM — the
+# DLRM-inference bottleneck path ("Dissecting Embedding Bag
+# Performance", PAPERS.md).  Host-table update churn runs during the
+# replay so the staleness machinery is exercised, and the bitwise
+# parity witness (staleness bound 0: served rows == host table rows,
+# exactly) is asserted mid-flight.  Reported: rows/s cached vs
+# uncached, device hit rate, p50/p99 lookup latency per tier, parity,
+# compile-once.  Detail -> EMBED_FULL.json under the BENCH_FULL
+# no-clobber contract.
+
+EMBED_DETAIL_PATH = os.environ.get(
+    "HETU_EMBED_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "EMBED_FULL.json"))
+
+
+def _embed_build(quick):
+    """WDL scorer + PS cold tier sized for the platform; name-seeded
+    init (deterministic) — serving perf does not depend on trained
+    weights."""
+    import hetu_tpu as ht
+    from hetu_tpu.models.ctr import WDL
+    from hetu_tpu.ps import CacheSparseTable
+
+    if quick:
+        rows, dim, F, nd, hidden = 4096, 16, 8, 4, (32, 32)
+    else:
+        rows, dim, F, nd, hidden = 131072, 16, 26, 13, (256, 256)
+    model = WDL(rows, embedding_dim=dim, num_sparse=F, num_dense=nd,
+                hidden=hidden, name="embsrv")
+    dense_ph = ht.placeholder_op("embsrv_dense", (1, nd))
+    ids_ph = ht.placeholder_op("embsrv_ids", (1, F), dtype=np.int32)
+    ex = ht.Executor([model(dense_ph, ids_ph)])
+    # cold tier: the HET-cached PS host table (pull_bound=0 so the
+    # device tier's staleness bound is exact); seeded from the model's
+    # in-graph table so both serving paths read identical bytes
+    cst = CacheSparseTable(rows, dim, cache_limit=rows // 4,
+                           pull_bound=0, optimizer="sgd", lr=0.1,
+                           name="embed_bench")
+    cst.table.set_rows(np.arange(rows),
+                       model.emb.host_table(ex.params))
+    return ex, model, cst, rows, F, nd
+
+
+def _embed_trace(seed, n_requests, rows, num_sparse, num_dense,
+                 alpha=1.2, mean_gap=0.4):
+    """Seeded open-loop arrival trace with Criteo-shaped key skew:
+    bounded-Zipf ids over a seeded key permutation (so the hot set is
+    not ids 0..k), dense features standard normal, Poisson-process
+    arrivals measured in scheduler iterations."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, rows + 1, dtype=np.float64)
+    p = ranks ** -float(alpha)
+    p /= p.sum()
+    perm = rng.permutation(rows)
+    gaps = rng.exponential(mean_gap, n_requests)
+    arrivals = np.floor(np.cumsum(gaps)).astype(np.int64)
+    trace = []
+    for i in range(n_requests):
+        ids = perm[rng.choice(rows, size=num_sparse, p=p)].astype(
+            np.int32)
+        dense = rng.standard_normal(num_dense).astype(np.float32)
+        trace.append((int(arrivals[i]), ids, dense))
+    return trace
+
+
+def _embed_replay(server, trace, cst, update_every=0, update_seed=1,
+                  parity_every=0):
+    """Drive one server through the trace (arrival clock = iteration
+    index), interleaving host-table update churn and — for the cached
+    server — the bitwise parity witness."""
+    from hetu_tpu.metrics import percentile, request_latency_summary
+    from hetu_tpu.resilience import faults
+
+    urng = np.random.default_rng(update_seed)
+    server.reset_stats()
+    if server.hot is not None:
+        server.hot.reset_stats()
+    parity, parity_checks = True, 0
+    t0 = time.perf_counter()
+    submitted, it, reqs = 0, 0, []
+    while submitted < len(trace) or not server.scheduler.idle:
+        while submitted < len(trace) and trace[submitted][0] <= it:
+            _, ids, dense = trace[submitted]
+            reqs.append(server.submit(ids, dense=dense))
+            submitted += 1
+        server.step()
+        it += 1
+        if update_every and it % update_every == 0:
+            # churn: update rows the trace just touched, so cached
+            # copies go stale under load (the staleness bound must
+            # force refreshes, not serve old bytes)
+            hot_ids = trace[max(0, submitted - 1)][1]
+            faults.stale_rows(cst, urng.choice(hot_ids, 4))
+        if (parity_every and server.hot is not None and submitted
+                and it % parity_every == 0):
+            keys = trace[max(0, submitted - 2)][1]
+            served = server.hot.gather_host(keys)
+            parity = parity and np.array_equal(
+                served, server.host.lookup(keys))
+            parity_checks += 1
+    wall = time.perf_counter() - t0
+    assert all(r.finished for r in reqs), "replay left unfinished requests"
+    scored = sum(1 for r in reqs if r.finish_reason == "scored")
+    rows_served = scored * server.num_sparse
+    lat = request_latency_summary(server.records)
+
+    def pct(vals):
+        return {"p50": round(percentile(vals, 50), 9),
+                "p99": round(percentile(vals, 99), 9),
+                "mean": round(float(np.mean(vals)), 9) if vals else None}
+
+    out = {"rows_per_sec": round(rows_served / wall, 1),
+           "requests_per_sec": round(scored / wall, 1),
+           "total_requests": len(reqs),
+           "requests_scored": scored,
+           "wall_s": round(wall, 3),
+           "iterations": it,
+           "lookup_s": pct(server.lookup_seconds),
+           "score_s": pct(server.score_seconds),
+           "latency_s": {k: {q: (round(x, 9)
+                                 if isinstance(x, float) else x)
+                             for q, x in v.items()}
+                         for k, v in lat.items()},
+           "trace_counts": server.trace_counts}
+    if server.hot is not None:
+        out["hot_cache"] = server.hot.stats()
+        out["parity_staleness0"] = bool(parity)
+        out["parity_checks"] = parity_checks
+    return out
+
+
+def run_serve_embed(quick=False, seed=0):
+    import jax
+    from hetu_tpu.serving import EmbeddingServer
+
+    ex, model, cst, rows, F, nd = _embed_build(quick)
+    if quick:
+        n_slots, cache_rows, n_requests = 8, 1024, 160
+        update_every, parity_every = 6, 5
+    else:
+        n_slots, cache_rows, n_requests = 16, 16384, 1500
+        update_every, parity_every = 6, 10
+    trace = _embed_trace(seed, n_requests, rows, F, nd)
+    kw = dict(host_table=cst, own_host_table=False, n_slots=n_slots,
+              staleness_bound=0)
+    results = {}
+    try:
+        for mode, crows in (("cached", cache_rows), ("uncached", None)):
+            srv = EmbeddingServer(ex, model, cache_rows=crows,
+                                  name=mode, **kw)
+            # warm the scoring program outside the timed replay; the
+            # trace counters keep counting, so a retrace DURING the
+            # replay still shows up as trace_counts > 1
+            srv.score_many([trace[0][1]], [trace[0][2]])
+            if srv.hot is not None:
+                # warm every power-of-two scatter bucket the replay can
+                # hit (fetch batches are <= n_slots * F unique rows) so
+                # no scatter compile lands inside the timed window
+                m = n_slots * F
+                b = 8
+                while b <= m:
+                    srv.hot.lookup_slots(
+                        np.arange(rows - b, rows, dtype=np.int64))
+                    b *= 2
+            results[mode] = _embed_replay(
+                srv, trace, cst, update_every=update_every,
+                update_seed=seed + 1, parity_every=parity_every)
+            srv.close()
+        ps_perf = cst.perf()
+    finally:
+        cst.close()
+    cached, uncached = results["cached"], results["uncached"]
+    vs = round(cached["rows_per_sec"]
+               / max(uncached["rows_per_sec"], 1e-9), 3)
+    note = None
+    if jax.default_backend() == "cpu":
+        # on CPU "device" memory IS host memory: the uncached twin's
+        # gather pays no H2D transfer, so the hot tier only shows its
+        # bookkeeping cost here.  The win this bench exists to measure
+        # (skipping the host->HBM row stream) needs the TPU round —
+        # same caveat as every CPU-quick number (ROADMAP bench debt).
+        note = "cpu_twin_pays_no_h2d"
+    return {"metric": "embed_serve_rows_per_sec",
+            **({"platform_note": note} if note else {}),
+            "value": cached["rows_per_sec"], "unit": "rows/sec",
+            "vs_uncached": vs,       # > 1 iff the hot tier pays off
+            "cached_wins": bool(vs > 1.0),
+            "hit_rate": cached["hot_cache"]["hit_rate"],
+            "parity_staleness0": cached["parity_staleness0"],
+            "compile_once": bool(
+                cached["trace_counts"].get("cached") == 1
+                and uncached["trace_counts"].get("direct") == 1),
+            "platform": jax.default_backend(),
+            "seed": seed, "quick": bool(quick),
+            "n_requests": len(trace), "n_slots": n_slots,
+            "table_rows": rows, "cache_rows": cache_rows,
+            "num_sparse": F,
+            "ps_cache_perf": {k: (round(v, 4) if isinstance(v, float)
+                                  else v) for k, v in ps_perf.items()},
+            "stages": results}
+
+
+def _emit_embed(out):
+    """Embedding-serve evidence in the same layered shape as --serve:
+    full headline to an early line + EMBED_FULL.json, compact tail line
+    that fits the driver's stdout window.  The detail file is written
+    only now — after the run has real results — so an aborted run never
+    clobbers the previous round's committed evidence (the
+    BENCH_FULL.json contract)."""
+    full = json.dumps(out)
+    try:
+        with open(EMBED_DETAIL_PATH, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass
+    print(full, flush=True)
+    compact = {"metric": out["metric"], "value": out["value"],
+               "unit": out["unit"], "vs_uncached": out["vs_uncached"],
+               "cached_wins": out["cached_wins"],
+               "hit_rate": out["hit_rate"],
+               "parity_staleness0": out["parity_staleness0"],
+               "compile_once": out["compile_once"],
+               "lookup_p50_s": {
+                   "cached": out["stages"]["cached"]["lookup_s"]["p50"],
+                   "uncached":
+                       out["stages"]["uncached"]["lookup_s"]["p50"]},
+               "lookup_p99_s": {
+                   "cached": out["stages"]["cached"]["lookup_s"]["p99"],
+                   "uncached":
+                       out["stages"]["uncached"]["lookup_s"]["p99"]},
+               "detail": os.path.basename(EMBED_DETAIL_PATH)}
+    if "telemetry_overhead" in out:
+        compact["telemetry_overhead_frac"] = \
+            out["telemetry_overhead"]["overhead_frac"]
+    print(json.dumps(compact), flush=True)
+
+
 # -- chaos-serve mode (bench.py --chaos --serve) ---------------------------
 # Serving-side resilience evidence: inject every serving fault class
 # (poisoned decode, raising step, slot leak, stalled/raising consumer,
@@ -1968,6 +2210,23 @@ def main():
             out["telemetry"] = _telemetry_report()
             out["telemetry_overhead"] = run_telemetry_overhead(quick)
         _emit_chaos(out, detail_path)
+        return
+    if "--serve-embed" in sys.argv:
+        # embedding-serve mode runs in-process (host tables + a tiny
+        # dense scorer): replay the Zipfian key trace through the
+        # tiered EmbeddingServer + uncached host-tier twin.
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        quick = quick or jax.default_backend() == "cpu"
+        if telemetry_on:
+            _telemetry_on()
+        out = run_serve_embed(quick)
+        if telemetry_on:
+            out["telemetry"] = _telemetry_report()
+            out["telemetry_overhead"] = run_telemetry_overhead(quick)
+        _emit_embed(out)
         return
     if "--serve" in sys.argv:
         # serve mode runs in-process (small decode shapes): replay the

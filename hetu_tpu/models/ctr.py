@@ -80,6 +80,38 @@ class SparseFeatureEmbedding:
         params[self.table.name] = pack_table(weights)
 
 
+def make_wdl_scorer(model):
+    """Pure-jax WDL forward over PRE-GATHERED embedding rows.
+
+    The serving path (serving/embedding/) gathers rows from the tiered
+    host/device store instead of the in-graph table, so the dense half
+    of the model must run WITHOUT the graph executor.  This pulls the
+    wide/deep/out layer weights out of an executor's params by their
+    canonical names (the adapters.py pattern for the decode tiers) and
+    returns ``score(params, rows [B, F, D], dense [B, num_dense]) ->
+    logits [B]`` — the same math as ``WDL.__call__`` with the embedding
+    lookup replaced by the ``rows`` operand."""
+    import jax.numpy as jnp
+
+    wide_w, wide_b = model.wide.weight.name, model.wide.bias.name
+    deep = [(l.weight.name, l.bias.name) for l in model.deep]
+    out_w, out_b = model.out.weight.name, model.out.bias.name
+    num_sparse, dim = model.num_sparse, model.embedding_dim
+    names = ([wide_w, wide_b, out_w, out_b]
+             + [n for pair in deep for n in pair])
+
+    def score(params, rows, dense):
+        flat = rows.reshape(rows.shape[0], num_sparse * dim)
+        x = jnp.concatenate([flat, dense], axis=1)
+        for wn, bn in deep:
+            x = jnp.maximum(jnp.dot(x, params[wn]) + params[bn], 0.0)
+        logit = (jnp.dot(x, params[out_w]) + params[out_b]
+                 + jnp.dot(dense, params[wide_w]) + params[wide_b])
+        return logit.reshape(-1)
+
+    return score, tuple(names)
+
+
 class WDL:
     """Wide & Deep (reference wdl_criteo: 13 dense + 26 sparse slots)."""
 

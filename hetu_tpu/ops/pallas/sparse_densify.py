@@ -19,7 +19,9 @@ TPU-native redesign — pack the table to the 128-lane quantum:
   XLA emits the single-pass multi-output fusion (164 us vs 294 us at
   W&D shapes);
 - ``packed_lookup`` gathers whole lane-lines and extracts the target
-  row by a fused multiply-sum (no strided 16-byte accesses);
+  row by a fused masked select-sum (no strided 16-byte accesses, and a
+  non-finite co-resident row cannot leak through a 0·NaN product —
+  serving's watchdog containment depends on that);
 - its vjp positions each gradient row inside its lane-line, merges
   duplicates with a sort + cumsum difference (NOT segment_sum, whose
   XLA lowering is the very scatter being replaced), and DMAs each
@@ -182,9 +184,15 @@ def packed_lookup(table, ids, dim, use_pallas=True):
     # an arbitrary row (ADVICE r5).  The vjp drops negatives either way.
     safe = jnp.maximum(flat, 0)
     lines = jnp.take(table, safe // q, axis=0)                 # [M, 128]
-    onehot = jax.nn.one_hot(safe % q, q, dtype=table.dtype)    # [M, q]
-    rows = jnp.sum(lines.reshape(-1, q, dim) * onehot[:, :, None],
-                   axis=1)
+    # masked select-sum, NOT a one-hot multiply-sum: 0 * NaN = NaN, so
+    # the multiply form let one non-finite row poison every row sharing
+    # its lane-line (the serving watchdog's per-request containment
+    # depends on a poisoned row flagging only itself).  Bitwise
+    # identical for finite rows — same summation order, x + 0 terms —
+    # and the same single elementwise+reduce fusion.
+    mask = (safe % q)[:, None] == jnp.arange(q, dtype=jnp.int32)
+    rows = jnp.sum(jnp.where(mask[:, :, None],
+                             lines.reshape(-1, q, dim), 0.0), axis=1)
     return rows.reshape(ids.shape + (dim,))
 
 

@@ -4,47 +4,158 @@ API parity with reference python/hetu/cstable.py:19 — `embedding_lookup` /
 `embedding_update` / `embedding_push_pull` return wait handles (futures) so
 host cache traffic overlaps device compute, and perf counters report
 hit/miss/transfer rates (reference cstable.py:126-187).
+
+Lifecycle: the single worker thread is non-daemon (ThreadPoolExecutor),
+so a table that is never closed blocks interpreter teardown on its
+atexit join — call :meth:`close` (or use the table as a context
+manager); the serving-side owner is ``EmbeddingServer.close()``.  The
+AST gate in ``tests/test_no_leaked_threads.py`` tracks every
+ThreadPoolExecutor construction site against a shutdown-ownership
+allowlist.
+
+Telemetry: the native cache's hit/miss/push/eviction counts are
+mirrored onto the process :class:`~hetu_tpu.telemetry.MetricsRegistry`
+(counters, plus sub-millisecond latency histograms for the lookup and
+update paths), so ``--telemetry`` snapshots cover the embedding path
+with no side-channel stats dict — ``perf()`` still returns the same
+dict it always did, now sourced through the same sync.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from .store import EmbeddingTable, CacheTable
+
+#: embedding cache ops are microsecond-scale host work — the serving
+#: DEFAULT_BUCKETS' 100us floor would blind the histogram (the ladder
+#: mirrors serving/embedding/hot_cache.py EMBED_BUCKETS)
+_CSTABLE_BUCKETS = (1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4,
+                    2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 0.1, 1.0)
+
+_COUNT = [0]
 
 
 class CacheSparseTable:
     def __init__(self, rows, dim, cache_limit, policy="lru", pull_bound=0,
-                 push_bound=1, optimizer="sgd", lr=0.01, seed=0, **opt_kw):
+                 push_bound=1, optimizer="sgd", lr=0.01, seed=0,
+                 name=None, **opt_kw):
         self.table = EmbeddingTable(rows, dim, optimizer=optimizer, lr=lr,
                                     seed=seed, **opt_kw)
         self.cache = CacheTable(self.table, cache_limit, policy=policy,
                                 pull_bound=pull_bound, push_bound=push_bound)
         self.rows, self.dim = rows, dim
+        _COUNT[0] += 1
+        self.name = name or f"cstable_{_COUNT[0]}"
         # single worker thread preserves lookup/update ordering (the
-        # reference's async client pushes through one agent thread too)
-        self._pool = ThreadPoolExecutor(max_workers=1)
+        # reference's async client pushes through one agent thread too);
+        # shut down by close() — see the thread-leak gate's allowlist
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"{self.name}_worker")
+        # registry mirror of the native perf counters: deltas are synced
+        # after every cache op (and on perf()), guarded by a lock since
+        # perf() may run on a different thread than the worker
+        self._stats_lock = threading.Lock()
+        self._last = {"hits": 0, "misses": 0, "pushes": 0,
+                      "evictions": 0}
+        reg = _telemetry.get_registry()
 
+        def _c(suffix, help):
+            return reg.counter(f"hetu_ps_cstable_{suffix}", help,
+                               labels=("table",)).labels(table=self.name)
+
+        self._m = {"hits": _c("hits_total",
+                              "HET host-cache lookup hits"),
+                   "misses": _c("misses_total",
+                                "HET host-cache lookup misses "
+                                "(fetched from the backing table)"),
+                   "pushes": _c("pushes_total",
+                                "Gradient pushes applied through the "
+                                "cache"),
+                   "evictions": _c("evictions_total",
+                                   "Host-cache rows evicted")}
+        self._m_lookup = reg.histogram(
+            "hetu_ps_cstable_lookup_seconds",
+            "Host-cache lookup latency", labels=("table",),
+            buckets=_CSTABLE_BUCKETS).labels(table=self.name)
+        self._m_update = reg.histogram(
+            "hetu_ps_cstable_update_seconds",
+            "Host-cache update latency", labels=("table",),
+            buckets=_CSTABLE_BUCKETS).labels(table=self.name)
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def closed(self):
+        return self._pool is None
+
+    def close(self):
+        """Shut down the worker thread (pending ops complete first).
+        Idempotent; further ops raise RuntimeError."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _submit(self, fn, *args):
+        if self._pool is None:
+            raise RuntimeError(
+                f"CacheSparseTable {self.name} is closed")
+        return self._pool.submit(fn, *args)
+
+    # -- telemetry sync -----------------------------------------------------
+    def _sync_registry(self):
+        """Push the native counters' DELTAS since the last sync onto the
+        registry mirror; returns the absolute stats dict."""
+        stats = self.cache.stats()
+        with self._stats_lock:
+            for key, m in self._m.items():
+                delta = stats[key] - self._last[key]
+                if delta > 0:
+                    m.inc(delta)
+                self._last[key] = stats[key]
+        return stats
+
+    def _timed(self, hist, fn, *args):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        hist.observe(time.perf_counter() - t0)
+        self._sync_registry()
+        return out
+
+    # -- async cache API ----------------------------------------------------
     def embedding_lookup(self, keys):
         """Async lookup; returns a future whose result is [n, dim] f32."""
         keys = np.asarray(keys)
-        return self._pool.submit(self.cache.lookup, keys)
+        return self._submit(self._timed, self._m_lookup,
+                            self.cache.lookup, keys)
 
     def embedding_update(self, keys, grads):
         keys = np.asarray(keys)
         grads = np.asarray(grads, np.float32)
-        return self._pool.submit(self.cache.update, keys, grads)
+        return self._submit(self._timed, self._m_update,
+                            self.cache.update, keys, grads)
 
     def embedding_push_pull(self, push_keys, grads, pull_keys):
         def work():
-            self.cache.update(push_keys, grads)
-            return self.cache.lookup(pull_keys)
-        return self._pool.submit(work)
+            self._timed(self._m_update, self.cache.update, push_keys,
+                        grads)
+            return self._timed(self._m_lookup, self.cache.lookup,
+                               pull_keys)
+        return self._submit(work)
 
     def flush(self):
-        self._pool.submit(self.cache.flush).result()
+        self._submit(self.cache.flush).result()
+        self._sync_registry()
 
     def perf(self):
-        return self.cache.stats()
+        return self._sync_registry()

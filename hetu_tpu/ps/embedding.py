@@ -133,9 +133,14 @@ class PSEmbedding:
     def push_grad(self, keys, grads, deduped=False):
         self.push_grad_async(keys, grads, deduped).result()
 
+    def _require_open(self):
+        if self._worker is None:
+            raise RuntimeError(f"PSEmbedding {self.name} is closed")
+
     def lookup_async(self, keys):
         """Future of the row gather.  Ordered after pending pushes (BSP),
         unless ``stale_reads`` routes it to the concurrent reader."""
+        self._require_open()
         keys = np.asarray(keys)
         pool = self._reader if self._reader is not None else self._worker
         return pool.submit(self._lookup_sync, keys)
@@ -146,6 +151,7 @@ class PSEmbedding:
         critical path (the executor's step N push overlaps its step N+1
         dispatch).  ``deduped=True`` skips the host-side duplicate-id
         reduction (keys already unique, e.g. from the unique-feed path)."""
+        self._require_open()
         keys = np.asarray(keys)
         return self._worker.submit(
             lambda: self._push_sync(keys, np.asarray(grads, np.float32),
@@ -153,9 +159,27 @@ class PSEmbedding:
 
     def synchronize(self):
         """Drain the worker queue (all issued lookups/pushes applied)."""
+        self._require_open()
         self._worker.submit(lambda: None).result()
         if self._reader is not None:
             self._reader.submit(lambda: None).result()
+
+    def close(self):
+        """Shut down the worker (and reader) threads after draining
+        pending ops — the shutdown ownership the thread-leak gate's
+        allowlist names.  Idempotent; further async ops raise."""
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.shutdown(wait=True)
+        reader, self._reader = self._reader, None
+        if reader is not None:
+            reader.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def flush(self):
         self.synchronize()

@@ -214,6 +214,49 @@ def stalling_consumer(seconds, collect=None, fail_after=None):
     return cb
 
 
+# -- embedding-serving faults ----------------------------------------------
+# The tiered embedding path's failure classes (serving/embedding/):
+# where a slot fault poisons one LLM stream, these attack the HOT-ROW
+# CACHE contract — rows going stale under it, and admission churn
+# defeating it.
+
+def stale_rows(table, keys, value=1.0):
+    """Apply an update to ``keys`` on the HOST embedding tier, bumping
+    their row versions — every device-cached copy of those rows is now
+    stale, and a staleness-bounded cache must refresh them within its
+    bound (bound 0: on the very next lookup).  Accepts a
+    ``ps.EmbeddingTable`` (push) or ``ps.CacheSparseTable`` (update
+    through the HET cache, then flushed so the backing table moves
+    too).  Returns the updated keys."""
+    keys = np.asarray(keys).reshape(-1).astype(np.int64)
+    dim = table.dim
+    grads = np.full((keys.size, dim), float(value), np.float32)
+    if hasattr(table, "embedding_update"):       # CacheSparseTable
+        table.embedding_update(keys, grads).result()
+        table.flush()
+    else:
+        table.push(keys, grads)
+    return keys
+
+
+def thrash_cache(cache, n_keys, seed=0, lo=0, hi=None):
+    """Flood a :class:`~hetu_tpu.serving.embedding.DeviceHotRowCache`
+    with one-shot COLD keys — the adversarial anti-Zipf workload that
+    defeats LFU/LRU admission and forces eviction churn (every flood
+    key is a miss, and each one evicts a resident row once the cache is
+    full).  Keys are drawn seeded from ``[lo, hi)`` (``hi`` defaults to
+    10x the cache so floods barely repeat) in batches the cache can
+    hold.  Returns the number of evictions the flood caused."""
+    rng = np.random.default_rng(seed)
+    hi = int(hi) if hi is not None else lo + 10 * cache.cache_rows
+    ev0 = cache.evictions
+    batch = max(1, cache.cache_rows // 2)
+    keys = rng.integers(int(lo), hi, int(n_keys))
+    for i in range(0, keys.size, batch):
+        cache.lookup_slots(keys[i:i + batch])
+    return cache.evictions - ev0
+
+
 # -- fleet faults ----------------------------------------------------------
 # Replica-level failures for the fleet layer (bench.py --chaos --serve
 # --fleet and tests/test_fleet.py): where the serving faults above hit

@@ -14,20 +14,32 @@ and its static-batch twin; ``bench.py --chaos --serve`` injects serving
 faults and proves one engine survives them; ``bench.py --chaos --serve
 --fleet`` kills, wedges, and rolls whole replicas and proves the fleet
 loses nothing.
+
+A second production workload rides the same lifecycle: the embedding
+subpackage (embedding/) serves batched sparse-feature lookups + CTR
+scoring through the identical Scheduler — a HET-style device hot-row
+cache over the PS table tier, packed-lookup scoring, and
+``EngineFleet(engine_factory=EmbeddingServer)`` for cluster routing.
+``bench.py --serve-embed`` replays a seeded Zipfian key trace against
+an uncached host-tier twin.
 """
 
 from .kv_cache import SlotKVCache
 from .scheduler import (EngineOverloaded, Request, Scheduler,
-                        FINISH_REASONS, SHED_POLICIES)
+                        FINISH_REASONS, SHED_POLICIES, TERMINAL_OK)
 from .adapters import (LlamaSlotAdapter, GPTSlotAdapter, adapter_for)
 from .engine import InferenceEngine
 from .health import (CircuitBreaker, ReplicaHealth, HEALTH_STATES,
                      HEALTH_STATE_CODES)
 from .fleet import EngineFleet, FleetRequest, FleetUnavailable
+from .embedding import (BatchSlotPool, DeviceHotRowCache, EmbedRequest,
+                        EmbeddingServer, EMBED_BUCKETS)
 
 __all__ = ["SlotKVCache", "Request", "Scheduler", "EngineOverloaded",
-           "FINISH_REASONS", "SHED_POLICIES", "LlamaSlotAdapter",
-           "GPTSlotAdapter", "adapter_for", "InferenceEngine",
-           "CircuitBreaker", "ReplicaHealth", "HEALTH_STATES",
-           "HEALTH_STATE_CODES", "EngineFleet", "FleetRequest",
-           "FleetUnavailable"]
+           "FINISH_REASONS", "SHED_POLICIES", "TERMINAL_OK",
+           "LlamaSlotAdapter", "GPTSlotAdapter", "adapter_for",
+           "InferenceEngine", "CircuitBreaker", "ReplicaHealth",
+           "HEALTH_STATES", "HEALTH_STATE_CODES", "EngineFleet",
+           "FleetRequest", "FleetUnavailable", "BatchSlotPool",
+           "DeviceHotRowCache", "EmbedRequest", "EmbeddingServer",
+           "EMBED_BUCKETS"]

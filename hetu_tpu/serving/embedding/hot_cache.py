@@ -1,0 +1,365 @@
+"""Device-resident hot-row embedding cache (HET-style, bounded stale).
+
+"Dissecting Embedding Bag Performance in DLRM Inference" (PAPERS.md)
+shows the host-side gather path dominating DLRM inference latency; the
+fix here is the HET client-cache idea (PAPER.md, reference
+src/hetu_cache) re-hosted on the accelerator: the hot rows of a
+host-RAM embedding table live in ONE preallocated ``[cache_rows, dim]``
+HBM array, and the per-batch lookup becomes a host-side id→cache-slot
+translation (numpy dict/array work, microseconds) plus an on-device
+packed gather inside the scoring program — no per-request host↔device
+row traffic at all on a cache hit.
+
+Contracts:
+
+* **admission/eviction** — LFU (default) or LRU over cache slots; a
+  batch's own rows are pinned and never evicted by that batch.  Rows
+  enter and refresh through ONE batched scatter per lookup call
+  (``rows_dev.at[slots].set(rows)``, donated off-CPU) — never per-row
+  transfers.
+* **staleness bound** — the host table versions every row (bumped per
+  push/set_rows, ``ps/native``).  A cached row is served only while
+  ``host_version - cached_version <= staleness_bound``; past the bound
+  the lookup forces a refresh.  Bound 0 ⇒ every served row is bitwise
+  identical to the host table at serve time (the HET pull-bound
+  semantics, measured in row updates, not wall time).  Versions are
+  read BEFORE rows on fetch, so a racing update can only make the
+  cache refresh EARLIER than the bound requires, never later.
+* **layout** — rows are stored ``[padded_rows, dim]`` where
+  ``padded_rows = packed_rows(cache_rows, dim) * (128 // dim)``: a free
+  device-side reshape to ``[p_rows, 128]`` is exactly the packed-table
+  layout, so the scoring program gathers through ``packed_lookup``
+  (the scatter-free pallas path) with cache SLOTS as the ids.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ... import telemetry as _telemetry
+from ...ops.pallas.sparse_densify import pack_factor, packed_rows
+
+#: default histogram ladder for the embedding path: serving latencies
+#: here are MICROsecond-scale (host dict work + one device gather), so
+#: the serving DEFAULT_BUCKETS' 100us floor would fold every sample
+#: into its first bucket.  Override per deployment with the
+#: ``latency_buckets=`` threading (PR 6) on EmbeddingServer.
+EMBED_BUCKETS = (1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4,
+                 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 0.1, 1.0)
+
+POLICIES = ("lfu", "lru")
+
+
+def as_host_tier(obj):
+    """Adapt a cold-tier object to the ``lookup(keys)`` /
+    ``versions(keys)`` surface the cache needs.
+
+    Accepts a ``ps.EmbeddingTable`` (has both), a ``ps.CacheSparseTable``
+    (lookups go through its HET host cache — synchronously, the cache
+    owns the ordering; versions come from the authoritative table), or
+    anything already exposing both methods.  Note the staleness bounds
+    COMPOSE: a CacheSparseTable cold tier adds its own ``pull_bound``
+    on top of the device cache's ``staleness_bound`` (use
+    ``pull_bound=0`` when the device bound must be exact)."""
+    if hasattr(obj, "lookup") and hasattr(obj, "versions"):
+        return obj
+
+    class _CSTTier:
+        def __init__(self, cst):
+            self._cst = cst
+
+        def lookup(self, keys):
+            return self._cst.embedding_lookup(keys).result()
+
+        def versions(self, keys):
+            return self._cst.table.versions(keys)
+
+    if hasattr(obj, "embedding_lookup") and hasattr(obj, "table"):
+        return _CSTTier(obj)
+    raise TypeError(
+        f"host tier {type(obj).__name__} exposes neither lookup/versions "
+        "nor the CacheSparseTable surface")
+
+
+# one jitted scatter per (donate,) — jit caches per shape underneath;
+# the fetch batch is padded to the next power of two (min 8) so a
+# steady workload compiles a handful of variants, not one per distinct
+# refresh count (padding repeats row 0: duplicate writes of identical
+# bytes are benign under .at[].set)
+_SCATTERS = {}
+
+
+def _scatter_fn(donate):
+    fn = _SCATTERS.get(donate)
+    if fn is None:
+        def scatter(rows_dev, slots, rows):
+            return rows_dev.at[slots].set(rows)
+        fn = jax.jit(scatter, donate_argnums=(0,) if donate else ())
+        _SCATTERS[donate] = fn
+    return fn
+
+
+def _pad_pow2(arr, floor=8):
+    m = arr.shape[0]
+    b = floor
+    while b < m:
+        b *= 2
+    if b == m:
+        return arr
+    return np.concatenate([arr, np.repeat(arr[:1], b - m, axis=0)])
+
+
+class DeviceHotRowCache:
+    """Hot-row tier over a host embedding table (see module doc).
+
+    ``lookup_slots(ids)`` is the whole API surface the server needs: it
+    returns the CACHE SLOT of every id (admitting/refreshing as needed,
+    one host fetch + one device scatter per call), and
+    ``packed_view()`` is the device array the jitted scorer gathers
+    from with those slots."""
+
+    def __init__(self, host_tier, cache_rows, dim, policy="lfu",
+                 staleness_bound=0, name="hot", device=None,
+                 dtype=jnp.float32):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"policy must be one of {POLICIES}, got {policy!r}")
+        q = pack_factor(dim)
+        if not q:
+            raise ValueError(
+                f"embedding dim {dim} does not pack into 128 lanes "
+                "(the packed-lookup scoring path needs dim | 128)")
+        if cache_rows < 1:
+            raise ValueError(f"cache_rows must be >= 1, got {cache_rows}")
+        if staleness_bound < 0:
+            raise ValueError(
+                f"staleness_bound must be >= 0, got {staleness_bound}")
+        self.host = as_host_tier(host_tier)
+        self.cache_rows = int(cache_rows)
+        self.dim = int(dim)
+        self.policy = policy
+        self.staleness_bound = int(staleness_bound)
+        self.name = str(name)
+        self.device = device
+        self.p_rows = packed_rows(self.cache_rows, self.dim)
+        self.padded_rows = self.p_rows * q
+        self.rows_dev = jnp.zeros((self.padded_rows, self.dim), dtype)
+        if device is not None:
+            self.rows_dev = jax.device_put(self.rows_dev, device)
+        self._donate = jax.default_backend() != "cpu"
+        # host-side index: slot -> key/version/usage, key -> slot
+        self.key_at = np.full(self.cache_rows, -1, np.int64)
+        self.version_at = np.zeros(self.cache_rows, np.uint64)
+        self.freq = np.zeros(self.cache_rows, np.int64)      # LFU
+        self.stamp = np.zeros(self.cache_rows, np.int64)     # LRU
+        self.slot_of = {}
+        self._free = list(range(self.cache_rows - 1, -1, -1))
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.refreshes = 0
+        self.evictions = 0
+        self.host_rows_fetched = 0
+        self.scatters = 0
+        reg = _telemetry.get_registry()
+
+        def _c(suffix, help):
+            return reg.counter(f"hetu_embed_cache_{suffix}",
+                               help, labels=("cache",)).labels(
+                                   cache=self.name)
+
+        self._m_hits = _c("hits_total",
+                          "Rows served from the device hot tier")
+        self._m_misses = _c("misses_total",
+                            "Rows absent from the hot tier (admitted "
+                            "from the host table)")
+        self._m_refreshes = _c(
+            "refreshes_total",
+            "Cached rows past the staleness bound, force-refreshed")
+        self._m_evictions = _c("evictions_total",
+                               "Cache slots reclaimed from a colder row")
+        self._m_occ = reg.gauge(
+            "hetu_embed_cache_occupancy",
+            "Occupied fraction of the device hot-row cache",
+            labels=("cache",)).labels(cache=self.name)
+        self._m_fetch = reg.histogram(
+            "hetu_embed_host_fetch_seconds",
+            "Host-tier row fetch latency (cold-tier reads on "
+            "miss/refresh)", labels=("cache",),
+            buckets=EMBED_BUCKETS).labels(cache=self.name)
+
+    # -- views --------------------------------------------------------------
+    def packed_view(self):
+        """The device rows operand for the jitted scorer, which
+        reshapes it in-graph to the packed ``[p_rows, 128]`` table
+        ``packed_lookup`` gathers from (a free reshape — same bytes,
+        ``[padded_rows, dim]`` row i IS packed logical row i)."""
+        return self.rows_dev
+
+    @property
+    def occupancy(self):
+        return 1.0 - len(self._free) / self.cache_rows
+
+    @property
+    def lookups(self):
+        return self.hits + self.misses + self.refreshes
+
+    @property
+    def hit_rate(self):
+        n = self.lookups
+        return self.hits / n if n else 0.0
+
+    def reset_stats(self):
+        """Zero the hit/miss/refresh/eviction counters (NOT the cache
+        contents or the registry mirror) — benches reset after warmup
+        so the reported rates are steady-state serving, not compile
+        and cold-fill."""
+        self.hits = 0
+        self.misses = 0
+        self.refreshes = 0
+        self.evictions = 0
+        self.host_rows_fetched = 0
+        self.scatters = 0
+
+    def stats(self):
+        return {"cache_rows": self.cache_rows,
+                "hits": self.hits, "misses": self.misses,
+                "refreshes": self.refreshes, "evictions": self.evictions,
+                "hit_rate": round(self.hit_rate, 4),
+                "occupancy": round(self.occupancy, 4),
+                "host_rows_fetched": self.host_rows_fetched,
+                "scatters": self.scatters,
+                "policy": self.policy,
+                "staleness_bound": self.staleness_bound}
+
+    # -- the lookup ---------------------------------------------------------
+    def _pick_victims(self, n, pinned):
+        """``n`` occupied slots to reclaim, coldest first, never one of
+        ``pinned`` (the slots this very batch will serve from)."""
+        occupied = np.flatnonzero(self.key_at >= 0)
+        if pinned:
+            mask = np.ones(occupied.size, bool)
+            pin = np.fromiter(pinned, np.int64, len(pinned))
+            mask &= ~np.isin(occupied, pin)
+            occupied = occupied[mask]
+        if occupied.size < n:
+            raise ValueError(
+                f"batch needs {n} more cache slots but only "
+                f"{occupied.size} are evictable — size the cache to at "
+                "least one batch of unique ids (cache_rows >= "
+                "n_slots * num_sparse)")
+        if self.policy == "lfu":
+            # least-frequently-used, oldest stamp breaking ties
+            order = np.lexsort((self.stamp[occupied],
+                                self.freq[occupied]))
+        else:
+            order = np.argsort(self.stamp[occupied], kind="stable")
+        return occupied[order[:n]]
+
+    def lookup_slots(self, ids):
+        """Translate feature ids to cache slots, admitting misses and
+        refreshing over-stale rows through one batched host fetch + one
+        batched device scatter.  Returns int32 slots, same shape as
+        ``ids``."""
+        ids = np.asarray(ids)
+        flat = np.ascontiguousarray(ids.reshape(-1), np.int64)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        slots = np.empty(uniq.size, np.int64)
+        cached_idx, missing_idx = [], []
+        for i, key in enumerate(uniq):
+            s = self.slot_of.get(int(key))
+            if s is None:
+                missing_idx.append(i)
+            else:
+                slots[i] = s
+                cached_idx.append(i)
+        stale_idx = []
+        if cached_idx:
+            c = np.asarray(cached_idx, np.int64)
+            cur = self.host.versions(uniq[c])
+            lag = cur - self.version_at[slots[c]]
+            stale = lag > np.uint64(self.staleness_bound)
+            stale_idx = list(c[stale])
+            fresh = c[~stale]
+            if fresh.size:
+                self.hits += int(fresh.size)
+                self._m_hits.inc(int(fresh.size))
+                self.freq[slots[fresh]] += 1
+                self.stamp[slots[fresh]] = self._tick
+        pinned = set(int(s) for s in slots[np.asarray(cached_idx,
+                                                      np.int64)]) \
+            if cached_idx else set()
+        if missing_idx:
+            if len(missing_idx) > self.cache_rows:
+                raise ValueError(
+                    f"batch carries {len(missing_idx)} distinct uncached "
+                    f"ids but the cache holds {self.cache_rows} rows — "
+                    "size the cache to at least one batch of unique ids")
+            need = []
+            for i in missing_idx:
+                if self._free:
+                    need.append(self._free.pop())
+                else:
+                    need.append(None)
+            short = sum(1 for s in need if s is None)
+            if short:
+                victims = self._pick_victims(short, pinned)
+                self.evictions += int(victims.size)
+                self._m_evictions.inc(int(victims.size))
+                vi = iter(victims)
+                for j, s in enumerate(need):
+                    if s is None:
+                        v = int(next(vi))
+                        del self.slot_of[int(self.key_at[v])]
+                        # the new tenant starts cold: inheriting the
+                        # evictee's frequency would make every recycled
+                        # slot look hot to LFU
+                        self.freq[v] = 0
+                        need[j] = v
+            for i, s in zip(missing_idx, need):
+                slots[i] = s
+                pinned.add(int(s))
+            self.misses += len(missing_idx)
+            self._m_misses.inc(len(missing_idx))
+        if stale_idx:
+            self.refreshes += len(stale_idx)
+            self._m_refreshes.inc(len(stale_idx))
+        fetch_idx = list(missing_idx) + list(stale_idx)
+        if fetch_idx:
+            f = np.asarray(fetch_idx, np.int64)
+            keys = uniq[f]
+            t0 = time.perf_counter()
+            # versions FIRST: a push landing between the two reads can
+            # only leave version_at too old (earlier refresh), never
+            # too new (a silently-overstale row)
+            vers = self.host.versions(keys)
+            rows = self.host.lookup(keys)
+            self._m_fetch.observe(time.perf_counter() - t0)
+            self.host_rows_fetched += int(keys.size)
+            tgt = slots[f]
+            self.rows_dev = _scatter_fn(self._donate)(
+                self.rows_dev,
+                jnp.asarray(_pad_pow2(tgt.astype(np.int32))),
+                jnp.asarray(_pad_pow2(np.asarray(rows, np.float32))))
+            self.scatters += 1
+            self.key_at[tgt] = keys
+            self.version_at[tgt] = vers
+            for key, s in zip(keys, tgt):
+                self.slot_of[int(key)] = int(s)
+            self.freq[tgt] += 1
+            self.stamp[tgt] = self._tick
+        self._tick += 1
+        self._m_occ.set(self.occupancy)
+        return slots[inv].astype(np.int32).reshape(ids.shape)
+
+    def gather_host(self, ids):
+        """Serve ``ids`` and read the served rows back to the host —
+        the bitwise-parity witness path (at ``staleness_bound=0`` the
+        result must equal ``host.lookup(ids)`` exactly)."""
+        slots = self.lookup_slots(ids).reshape(-1)
+        return np.asarray(self.rows_dev)[slots]

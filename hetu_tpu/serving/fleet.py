@@ -72,7 +72,7 @@ from .engine import InferenceEngine
 from .health import (CircuitBreaker, DEGRADED, DISPATCHABLE, DRAINING,
                      HEALTH_STATE_CODES, HEALTHY, QUARANTINED,
                      ReplicaHealth, RESTARTING, STOPPED)
-from .scheduler import EngineOverloaded
+from .scheduler import EngineOverloaded, TERMINAL_OK
 
 __all__ = ["EngineFleet", "FleetRequest", "FleetUnavailable"]
 
@@ -186,7 +186,15 @@ class EngineFleet:
     backend has multiple devices.  ``threaded=False`` disables the
     driver/supervisor threads: drive the fleet deterministically with
     :meth:`pump` (wedge detection needs real threads and is off in this
-    mode)."""
+    mode).
+
+    ``engine_factory=`` swaps the replica type for any engine speaking
+    the same surface (``submit``/``step``/``cancel``/``harvest``/
+    ``scheduler``/``cache.audit``/``watchdog_trips``/``trace_counts``)
+    — ``serving.embedding.EmbeddingServer`` rides the whole
+    routing/health/failover machinery unchanged this way (a harvested
+    embedding attempt delivered nothing, so it re-homes with an empty
+    replay; read scores from ``freq.attempt.result()``)."""
 
     def __init__(self, executor, model, n_engines=2, engine_kwargs=None,
                  *, threaded=True, clock=None, name="fleet",
@@ -194,11 +202,13 @@ class EngineFleet:
                  breaker_base=0.25, breaker_cap=30.0, max_failovers=3,
                  wedge_timeout=5.0, supervise_interval=0.02,
                  idle_sleep=0.001, auto_restart=True, ewma_alpha=0.3,
-                 latency_buckets=None):
+                 latency_buckets=None, engine_factory=None):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
         self._executor = executor
         self._model = model
+        self._engine_factory = (InferenceEngine if engine_factory is None
+                                else engine_factory)
         self._ekw = dict(engine_kwargs or {})
         self._ekw.pop("instance", None)
         self._ekw.pop("clock", None)
@@ -274,7 +284,7 @@ class EngineFleet:
         return base if incarnation == 0 else f"{base}.{incarnation}"
 
     def _build_engine(self, index, incarnation):
-        return InferenceEngine(
+        return self._engine_factory(
             self._executor, self._model,
             instance=self._instance_name(index, incarnation),
             clock=self._clock,
@@ -572,7 +582,7 @@ class EngineFleet:
             reason = attempt.finish_reason
             if freq.finished or reason == "failover":
                 continue    # hedge loser / already harvested
-            if reason in ("eos", "max_new"):
+            if reason in TERMINAL_OK:
                 if freq.attempt is not attempt:
                     # hedge secondary finished first: promote it
                     freq.attempt = attempt

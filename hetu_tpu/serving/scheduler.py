@@ -50,15 +50,21 @@ import numpy as np
 from .. import telemetry as _telemetry
 
 #: every terminal state a request can reach.  "eos"/"max_new" are the
-#: healthy ones; "deadline" (TTL passed — at admission or mid-flight),
-#: "cancelled" (engine.cancel / scheduler shed), and "error" (decode
-#: watchdog quarantined the slot) all return whatever tokens were
-#: produced so far as a PARTIAL result.  "failover" is terminal only for
-#: the ENGINE-LEVEL attempt: the fleet harvested the request off this
-#: engine (crash/quarantine/wedge) and the same rid continues on a
-#: sibling — cluster-level, the request is still live.
-FINISH_REASONS = ("eos", "max_new", "deadline", "cancelled", "error",
-                  "failover")
+#: healthy LLM terminals and "scored" the healthy EMBEDDING one (an
+#: EmbeddingServer request completes in a single batched
+#: lookup+score iteration); "deadline" (TTL passed — at admission or
+#: mid-flight), "cancelled" (engine.cancel / scheduler shed), and
+#: "error" (decode watchdog quarantined the slot) all return whatever
+#: tokens were produced so far as a PARTIAL result.  "failover" is
+#: terminal only for the ENGINE-LEVEL attempt: the fleet harvested the
+#: request off this engine (crash/quarantine/wedge) and the same rid
+#: continues on a sibling — cluster-level, the request is still live.
+FINISH_REASONS = ("eos", "max_new", "scored", "deadline", "cancelled",
+                  "error", "failover")
+
+#: the healthy terminals — what a fleet treats as "this attempt
+#: SUCCEEDED" (everything else is a partial, a refusal, or a fault)
+TERMINAL_OK = ("eos", "max_new", "scored")
 
 SHED_POLICIES = ("reject_newest", "drop_expired_first")
 

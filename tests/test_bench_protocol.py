@@ -192,6 +192,74 @@ def test_serve_aborted_run_preserves_prior_detail_file(tmp_path):
     assert json.loads(detail.read_text()) == sentinel
 
 
+def test_serve_embed_stage_emits_full_and_compact(tmp_path):
+    """`--serve-embed --quick` must end in a compact parseable line
+    carrying rows/s, hit rate, the staleness-0 bitwise-parity witness,
+    and p50/p99 lookup latency for the cached vs uncached twin, with
+    the full headline on the line above AND mirrored to
+    EMBED_FULL.json."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_EMBED_JSON"] = str(tmp_path / "embed.json")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--serve-embed", "--quick"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    compact = json.loads(lines[-1])
+    assert len(lines[-1].encode()) < 2000, \
+        "compact embed line must fit the driver's stdout tail"
+    assert compact["metric"] == "embed_serve_rows_per_sec"
+    assert compact["value"] > 0
+    assert {"vs_uncached", "hit_rate", "parity_staleness0",
+            "compile_once", "lookup_p50_s", "lookup_p99_s"} <= \
+        set(compact)
+    assert compact["parity_staleness0"] is True
+    assert compact["compile_once"] is True
+    assert 0.0 < compact["hit_rate"] <= 1.0
+    for pct in ("lookup_p50_s", "lookup_p99_s"):
+        assert set(compact[pct]) == {"cached", "uncached"}
+        assert all(v > 0 for v in compact[pct].values())
+    full = json.loads(lines[-2])
+    stages = full["stages"]
+    assert set(stages) == {"cached", "uncached"}
+    for s in stages.values():
+        assert {"rows_per_sec", "requests_per_sec", "lookup_s",
+                "score_s", "latency_s", "trace_counts"} <= set(s)
+    hc = stages["cached"]["hot_cache"]
+    assert hc["hits"] > 0 and hc["refreshes"] > 0   # churn ran
+    assert stages["cached"]["parity_checks"] > 0
+    # the cstable mirror reports the cold tier's own hit accounting
+    assert full["ps_cache_perf"]["hits"] >= 0
+    with open(tmp_path / "embed.json") as f:
+        assert json.load(f) == full
+
+
+def test_serve_embed_aborted_run_preserves_prior_detail_file(tmp_path):
+    """EMBED_FULL.json follows the BENCH_FULL.json contract: written
+    only once the run has real results, so a run killed early leaves
+    the previous round's committed evidence intact."""
+    detail = tmp_path / "embed.json"
+    sentinel = {"metric": "embed_serve_rows_per_sec", "value": 99.9}
+    detail.write_text(json.dumps(sentinel))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_EMBED_JSON"] = str(detail)
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--serve-embed", "--quick"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=env, start_new_session=True)
+    try:
+        import time
+        time.sleep(1.0)        # inside jax import / table build
+        os.killpg(os.getpgid(proc.pid), 9)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert json.loads(detail.read_text()) == sentinel
+
+
 def _assert_telemetry_block(tel):
     """The --telemetry emission contract shared by BENCH_FULL /
     CHAOS_FULL / SERVE_FULL: a registry snapshot plus the step-phase
@@ -247,6 +315,49 @@ def test_serve_telemetry_emission(tmp_path):
     for s in full["stages"].values():
         assert {"tokens_per_sec", "mean_occupancy", "decode_steps",
                 "latency_s", "trace_counts"} <= set(s)
+
+
+def test_serve_embed_telemetry_emission(tmp_path):
+    """`--serve-embed --quick --telemetry`: EMBED_FULL.json carries the
+    shared telemetry block with the embedding cache counters, the
+    cstable mirror, and the per-tier latency histograms — no
+    side-channel stats dict."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_EMBED_JSON"] = str(tmp_path / "embed.json")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--serve-embed", "--quick",
+         "--telemetry"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    compact = json.loads(lines[-1])
+    assert len(lines[-1].encode()) < 2000
+    assert "telemetry_overhead_frac" in compact
+    with open(tmp_path / "embed.json") as f:
+        full = json.load(f)
+    _assert_telemetry_block(full["telemetry"])
+    reg = full["telemetry"]["registry"]
+    for name in ("hetu_embed_cache_hits_total",
+                 "hetu_embed_cache_misses_total",
+                 "hetu_embed_cache_refreshes_total",
+                 "hetu_embed_requests_total",
+                 "hetu_embed_lookup_seconds",
+                 "hetu_embed_score_seconds",
+                 "hetu_ps_cstable_hits_total",
+                 "hetu_ps_cstable_lookup_seconds",
+                 "hetu_serving_queue_depth"):
+        assert name in reg, name
+    hits = sum(s["value"]
+               for s in reg["hetu_embed_cache_hits_total"]["samples"])
+    assert hits > 0
+    # per-tier lookup histograms: device_hot for the cached server,
+    # host_table for the uncached twin
+    tiers = {s["labels"]["tier"]
+             for s in reg["hetu_embed_lookup_seconds"]["samples"]}
+    assert {"device_hot", "host_table"} <= tiers
+    assert {"embed_lookup", "embed_score"} <= set(
+        full["telemetry"]["spans"])
 
 
 def test_chaos_telemetry_emission(tmp_path):

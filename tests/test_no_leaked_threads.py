@@ -12,7 +12,15 @@ pattern):
   pass ``daemon=True`` at the CONSTRUCTOR — the one form the scanner
   (and a reviewer) can verify locally.  A thread that must be
   non-daemon needs a reviewed allowlist entry naming where it is
-  provably joined.
+  provably joined;
+* every ``ThreadPoolExecutor(...)`` constructed in ``hetu_tpu/`` needs
+  a reviewed SHUTDOWN-OWNERSHIP allowlist entry naming who calls
+  ``shutdown()``/``close()`` — pool workers are non-daemon but live in
+  ``threading._DummyThread``-adjacent bookkeeping the plain Thread scan
+  (and the runtime fixture's enumerate diff at construction time)
+  misses, so an unshutdown pool silently evades the gate while still
+  blocking interpreter teardown on its atexit join (the
+  ``CacheSparseTable`` leak this rule was added for).
 
 The runtime half of the contract lives in ``tests/conftest.py``: an
 autouse fixture asserts that no non-daemon thread outlives any
@@ -30,6 +38,17 @@ HETU_ROOT = os.path.join(os.path.dirname(__file__), "..", "hetu_tpu")
 # Every entry must say WHERE the thread is joined.
 ALLOWED = {
     # (none today — every thread in hetu_tpu/ is a daemon)
+}
+
+# Reviewed ThreadPoolExecutor sites, as "relative/path.py::function" ->
+# note naming the shutdown owner.  A new pool without an entry here
+# fails the gate: name who shuts it down, get it reviewed, add it.
+POOL_ALLOWED = {
+    "ps/cstable.py::__init__":
+        "shut down by CacheSparseTable.close() (context manager; "
+        "EmbeddingServer.close() closes an owned cold tier)",
+    "ps/embedding.py::__init__":
+        "both pools shut down by PSEmbedding.close() (context manager)",
 }
 
 
@@ -50,7 +69,19 @@ def _daemon_true(call):
     return False
 
 
-def _nondaemon_thread_sites(root):
+def _is_pool_ctor(call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "ThreadPoolExecutor"
+    if isinstance(f, ast.Attribute):
+        return f.attr == "ThreadPoolExecutor"
+    return False
+
+
+def _scan(root, flag):
+    """Walk every module under ``root`` collecting
+    ``("rel/path.py::enclosing_function", lineno)`` for each Call node
+    ``flag`` selects."""
     sites = []
     for dirpath, dirnames, files in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
@@ -70,14 +101,22 @@ def _nondaemon_thread_sites(root):
                 if isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                     funcname = node.name
-                if (isinstance(node, ast.Call) and _is_thread_ctor(node)
-                        and not _daemon_true(node)):
+                if isinstance(node, ast.Call) and flag(node):
                     sites.append((f"{rel}::{funcname}", node.lineno))
                 for child in ast.iter_child_nodes(node):
                     walk(child, funcname)
 
             walk(tree, "<module>")
     return sites
+
+
+def _nondaemon_thread_sites(root):
+    return _scan(root, lambda call: (_is_thread_ctor(call)
+                                     and not _daemon_true(call)))
+
+
+def _threadpool_sites(root):
+    return _scan(root, _is_pool_ctor)
 
 
 def test_every_thread_is_daemon_or_allowlisted():
@@ -98,6 +137,46 @@ def test_allowlist_not_stale():
     assert not stale, (
         "allowlist entries with no matching thread site — remove them "
         "from tests/test_no_leaked_threads.py:\n  " + "\n  ".join(stale))
+
+
+def test_every_threadpool_has_a_shutdown_owner():
+    sites = _threadpool_sites(HETU_ROOT)
+    new = [f"{key} (line {line})" for key, line in sites
+           if key not in POOL_ALLOWED]
+    assert not new, (
+        "ThreadPoolExecutor constructed in hetu_tpu/ without a reviewed "
+        "shutdown-ownership entry — an unshutdown pool blocks "
+        "interpreter teardown on its atexit join and evades the "
+        "Thread scan; add close()/shutdown ownership and an entry to "
+        "POOL_ALLOWED in tests/test_no_leaked_threads.py naming it:\n  "
+        + "\n  ".join(new))
+
+
+def test_pool_allowlist_not_stale():
+    present = {key for key, _ in _threadpool_sites(HETU_ROOT)}
+    stale = sorted(set(POOL_ALLOWED) - present)
+    assert not stale, (
+        "POOL_ALLOWED entries with no matching ThreadPoolExecutor site "
+        "— remove them from tests/test_no_leaked_threads.py:\n  "
+        + "\n  ".join(stale))
+
+
+def test_scanner_detects_threadpools(tmp_path):
+    """The pool scanner must flag both constructor forms regardless of
+    kwargs (shutdown ownership cannot be seen at the constructor, so
+    EVERY site needs an allowlist entry)."""
+    mod = tmp_path / "p.py"
+    mod.write_text(
+        "import concurrent.futures\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def site_attr():\n"
+        "    return concurrent.futures.ThreadPoolExecutor(max_workers=1)\n"
+        "def site_bare():\n"
+        "    return ThreadPoolExecutor(max_workers=2)\n"
+        "def not_a_pool():\n"
+        "    return ProcessPoolExecutor()\n")
+    sites = sorted(k for k, _ in _threadpool_sites(str(tmp_path)))
+    assert sites == ["p.py::site_attr", "p.py::site_bare"]
 
 
 def test_scanner_detects_nondaemon_threads(tmp_path):

@@ -1,0 +1,472 @@
+"""Tiered embedding serving contracts (hetu_tpu/serving/embedding/).
+
+Pinned here:
+* hot-row cache — hit/miss accounting, LFU/LRU eviction under skew,
+  batched-scatter refresh, and the STALENESS BOUND: bound 0 serves rows
+  bitwise identical to the host table under update churn, bound k
+  serves a row at most k updates stale (and really does serve stale
+  bytes inside the bound — it is a bound, not always-refresh);
+* the WDL scorer — the pure-jax dense path matches the graph executor's
+  forward, and the packed-lookup cached path matches the uncached
+  host-gather twin;
+* the serving lifecycle for sub-millisecond requests — typed
+  EngineOverloaded with queue hints, TTL expiry and cancel() with
+  terminal finish_reasons, watchdog quarantine of non-finite scores,
+  slot-audit balance (the ManualClock pattern from
+  test_serving_robustness.py);
+* fleet compatibility — EngineFleet(engine_factory=EmbeddingServer)
+  routes, completes, and fails embedding traffic over unchanged;
+* teardown — CacheSparseTable.close() / context manager, and
+  EmbeddingServer closing an owned cold tier (the thread-leak gate's
+  shutdown-ownership contract);
+* telemetry — cache counters and the cstable perf mirror land in
+  registry snapshots.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import telemetry
+from hetu_tpu.models.ctr import WDL, make_wdl_scorer
+from hetu_tpu.ps import CacheSparseTable, EmbeddingTable
+from hetu_tpu.resilience import InjectedFault, faults
+from hetu_tpu.serving import (DeviceHotRowCache, EmbeddingServer,
+                              EngineFleet, EngineOverloaded,
+                              FINISH_REASONS)
+
+ROWS, DIM, F, ND = 256, 16, 4, 3
+
+
+class ManualClock:
+    """Deterministic server clock (the test_serving_robustness.py
+    pattern): deadline tests advance time by hand."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+@pytest.fixture(scope="module")
+def scored():
+    model = WDL(ROWS, embedding_dim=DIM, num_sparse=F, num_dense=ND,
+                hidden=(16, 16), name="srv_emb")
+    dense = ht.placeholder_op("srv_emb_dense", (1, ND))
+    ids = ht.placeholder_op("srv_emb_ids", (1, F), dtype=np.int32)
+    ex = ht.Executor([model(dense, ids)])
+    return ex, model, dense, ids
+
+
+def _table_from(ex, model):
+    rows = model.emb.host_table(ex.params)
+    t = EmbeddingTable(rows.shape[0], DIM, lr=1.0, init_scale=0.0)
+    t.set_rows(np.arange(rows.shape[0]), rows)
+    return t
+
+
+def _server(scored, **kw):
+    ex, model, _, _ = scored
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("cache_rows", 64)
+    return EmbeddingServer(ex, model, **kw)
+
+
+def _ids(rng, n, lo=0, hi=ROWS):
+    return rng.integers(lo, hi, (n, F)).astype(np.int32)
+
+
+# -- the hot-row cache -------------------------------------------------------
+
+def test_hot_cache_hits_misses_and_bitwise_rows(rng):
+    t = EmbeddingTable(ROWS, DIM, seed=3)
+    cache = DeviceHotRowCache(t, 32, DIM)
+    keys = rng.integers(0, ROWS, 12)
+    first = cache.gather_host(keys)
+    assert np.array_equal(first, t.lookup(keys))
+    uniq = np.unique(keys).size
+    assert cache.misses == uniq and cache.hits == 0
+    again = cache.gather_host(keys)
+    assert np.array_equal(again, first)
+    assert cache.hits == keys.size   # every key resident now
+    assert cache.host_rows_fetched == uniq
+
+
+def test_staleness_zero_is_bitwise_parity_under_churn(rng):
+    t = EmbeddingTable(ROWS, DIM, lr=0.5, seed=4)
+    cache = DeviceHotRowCache(t, 32, DIM, staleness_bound=0)
+    keys = rng.integers(0, ROWS, 8)
+    for round_ in range(5):
+        served = cache.gather_host(keys)
+        assert np.array_equal(served, t.lookup(keys)), round_
+        faults.stale_rows(t, keys[:3], value=float(round_ + 1))
+    assert cache.refreshes >= 4 * 3 - 1   # churned keys re-fetched
+
+
+def test_staleness_bound_k_serves_stale_only_inside_bound():
+    t = EmbeddingTable(ROWS, DIM, lr=1.0, seed=5)
+    k = 3
+    cache = DeviceHotRowCache(t, 16, DIM, staleness_bound=k)
+    key = np.arange(F)
+    cache.lookup_slots(key)
+    frozen = cache.gather_host(key)        # bytes now resident
+    for i in range(k):
+        faults.stale_rows(t, key)
+        served = cache.gather_host(key)
+        # inside the bound: STALE bytes are served (it is a bound, not
+        # an always-refresh), and the lag never exceeds k updates
+        assert np.array_equal(served, frozen)
+        assert not np.array_equal(served, t.lookup(key))
+        slots = cache.lookup_slots(key).reshape(-1)
+        lag = t.versions(key) - cache.version_at[slots]
+        assert (lag <= np.uint64(k)).all()
+    faults.stale_rows(t, key)              # lag k+1: past the bound
+    served = cache.gather_host(key)
+    assert np.array_equal(served, t.lookup(key))
+    assert cache.refreshes >= 1
+
+
+def test_lru_evicts_oldest_lfu_evicts_coldest():
+    t = EmbeddingTable(ROWS, DIM, seed=6)
+    lru = DeviceHotRowCache(t, 2, DIM, policy="lru")
+    lru.lookup_slots([0])
+    lru.lookup_slots([1])
+    lru.lookup_slots([0])          # 0 most recent
+    lru.lookup_slots([2])          # evicts 1 (oldest)
+    assert set(lru.slot_of) == {0, 2}
+    lfu = DeviceHotRowCache(t, 2, DIM, policy="lfu")
+    lfu.lookup_slots([0])
+    lfu.lookup_slots([0])
+    lfu.lookup_slots([1])
+    lfu.lookup_slots([2])          # evicts 1 (freq 1 < freq 2)
+    assert set(lfu.slot_of) == {0, 2}
+    assert lru.evictions == 1 and lfu.evictions == 1
+
+
+def test_eviction_under_zipf_skew_stays_correct(rng):
+    """Cache far smaller than the key universe, Criteo-shaped skew:
+    the hot set stays resident (hit rate well above the uniform
+    baseline) and every served row is still bitwise right after
+    arbitrary eviction churn."""
+    t = EmbeddingTable(ROWS, DIM, seed=7)
+    cache = DeviceHotRowCache(t, 24, DIM, policy="lfu")
+    ranks = np.arange(1, ROWS + 1, dtype=np.float64)
+    p = ranks ** -1.3
+    p /= p.sum()
+    perm = rng.permutation(ROWS)
+    for _ in range(60):
+        keys = perm[rng.choice(ROWS, size=8, p=p)]
+        assert np.array_equal(cache.gather_host(keys), t.lookup(keys))
+    assert cache.evictions > 0
+    assert cache.hit_rate > 0.5
+
+
+def test_thrash_injector_forces_eviction_churn(rng):
+    t = EmbeddingTable(ROWS, DIM, seed=8)
+    cache = DeviceHotRowCache(t, 16, DIM)
+    hot = rng.integers(0, 8, 8)
+    cache.lookup_slots(hot)
+    evicted = faults.thrash_cache(cache, 64, seed=1, lo=32, hi=ROWS)
+    assert evicted > 0
+    # correctness survives the churn
+    keys = rng.integers(0, ROWS, 8)
+    assert np.array_equal(cache.gather_host(keys), t.lookup(keys))
+
+
+def test_cache_rejects_unpackable_dim_and_oversize_batch():
+    t = EmbeddingTable(64, 10)
+    with pytest.raises(ValueError, match="pack"):
+        DeviceHotRowCache(t, 8, 10)
+    t16 = EmbeddingTable(64, DIM)
+    cache = DeviceHotRowCache(t16, 4, DIM)
+    with pytest.raises(ValueError, match="cache"):
+        cache.lookup_slots(np.arange(5))
+
+
+# -- the scorer --------------------------------------------------------------
+
+def test_wdl_scorer_matches_graph_forward(scored, rng):
+    ex, model, dense_ph, ids_ph = scored
+    score, names = make_wdl_scorer(model)
+    assert all(n in ex.params for n in names)
+    idv = _ids(rng, 1)
+    dv = rng.standard_normal((1, ND)).astype(np.float32)
+    (graph_out,) = ex.run(feed_dict={dense_ph: dv, ids_ph: idv},
+                          convert_to_numpy_ret_vals=True)
+    rows = model.emb.host_table(ex.params)[idv]       # [1, F, D]
+    ours = np.asarray(score(ex.params, rows, dv))
+    np.testing.assert_allclose(ours, graph_out, rtol=1e-5, atol=1e-6)
+
+
+def test_cached_scores_match_uncached_twin(scored, rng):
+    ex, model, _, _ = scored
+    table = _table_from(ex, model)
+    idv = _ids(rng, 10)
+    dv = rng.standard_normal((10, ND)).astype(np.float32)
+    with EmbeddingServer(ex, model, host_table=table,
+                         own_host_table=False, cache_rows=64,
+                         n_slots=4, name="twin_c") as cached, \
+         EmbeddingServer(ex, model, host_table=table,
+                         own_host_table=False, cache_rows=None,
+                         n_slots=4, name="twin_u") as uncached:
+        sc = cached.score_many(idv, dv)
+        su = uncached.score_many(idv, dv)
+    np.testing.assert_allclose(sc, su, rtol=1e-5, atol=1e-6)
+    assert np.isfinite(sc).all()
+
+
+# -- lifecycle: overload / deadline / cancel / watchdog ----------------------
+
+def test_overload_raises_typed_with_queue_depth_hint(scored, rng):
+    srv = _server(scored, max_queue=2)
+    srv.submit(_ids(rng, 1)[0])
+    srv.submit(_ids(rng, 1)[0])
+    with pytest.raises(EngineOverloaded) as ei:
+        srv.submit(_ids(rng, 1)[0])
+    assert ei.value.queue_depth == 2
+    assert ei.value.max_queue == 2
+    assert srv.scheduler.rejected == 1
+    srv.run(max_iterations=50)
+    audit = srv.pool.audit()
+    assert audit["allocs"] == audit["frees"] and audit["in_use"] == 0
+    srv.close()
+
+
+def test_ttl_expiry_and_cancel_reach_terminal_reasons(scored, rng):
+    clk = ManualClock()
+    srv = _server(scored, clock=clk)
+    doomed = srv.submit(_ids(rng, 1)[0], ttl=1.0)
+    clk.advance(2.0)                       # expires while queued
+    victim = srv.submit(_ids(rng, 1)[0])
+    assert srv.cancel(victim.rid) is True
+    assert victim.finish_reason == "cancelled"
+    live = srv.submit(_ids(rng, 1)[0])
+    srv.run(max_iterations=50)
+    assert doomed.finish_reason == "deadline"
+    assert doomed.result().size == 0       # never scored
+    assert live.finish_reason == "scored"
+    assert len(live.scores) == 1 and np.isfinite(live.scores[0])
+    assert srv.cancel(live.rid) is False   # already terminal
+    reasons = {r["id"]: r["finish_reason"] for r in srv.records}
+    assert reasons[doomed.rid] == "deadline"
+    assert reasons[victim.rid] == "cancelled"
+    for reason in ("scored", "deadline", "cancelled"):
+        assert reason in FINISH_REASONS
+    assert srv.expirations == 1 and srv.cancellations == 1
+    srv.close()
+
+
+def test_watchdog_quarantines_nonfinite_score(scored, rng):
+    ex, model, _, _ = scored
+    table = _table_from(ex, model)
+    bad_key = 7
+    table.set_rows([bad_key], np.full((1, DIM), np.nan, np.float32))
+    srv = EmbeddingServer(ex, model, host_table=table, cache_rows=64,
+                          n_slots=2, name="wd")
+    poisoned = srv.submit(np.full(F, bad_key, np.int32))
+    healthy = srv.submit(np.arange(F, dtype=np.int32) + 20)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv.run(max_iterations=50)
+    assert poisoned.finish_reason == "error"
+    assert healthy.finish_reason == "scored"
+    assert srv.watchdog_trips == 1
+    # the engine keeps serving after the quarantine
+    after = srv.submit(np.arange(F, dtype=np.int32) + 40)
+    srv.run(max_iterations=50)
+    assert after.finish_reason == "scored"
+    audit = srv.pool.audit()
+    assert audit["allocs"] == audit["frees"] and audit["in_use"] == 0
+    srv.close()
+
+
+def test_raising_score_step_contained_protected_dies_unprotected(
+        scored, rng):
+    ex, model, _, _ = scored
+    for watchdog in (True, False):
+        srv = _server(scored, watchdog=watchdog, name=f"rs{watchdog}")
+        req = srv.submit(_ids(rng, 1)[0])
+        orig, state = srv._score_fn, {"n": 0}
+
+        def boom(*a, _orig=orig, _state=state, **kw):
+            if _state["n"] == 0:
+                _state["n"] += 1
+                raise InjectedFault("injected scoring failure")
+            return _orig(*a, **kw)
+
+        srv._score_fn = boom
+        if watchdog:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                srv.step()
+            assert req.finish_reason == "error"
+            late = srv.submit(_ids(rng, 1)[0])
+            srv.run(max_iterations=50)       # engine survives
+            assert late.finish_reason == "scored"
+        else:
+            with pytest.raises(InjectedFault):
+                srv.step()
+        srv.close()
+
+
+def test_stream_callback_fires_once_and_detaches_on_raise(scored, rng):
+    got = []
+    srv = _server(scored)
+    ok = srv.submit(_ids(rng, 1)[0],
+                    stream=lambda s, r: got.append((s, r.rid)))
+    bad = srv.submit(_ids(rng, 1)[0],
+                     stream=faults.stalling_consumer(0, fail_after=0))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv.run(max_iterations=50)
+    assert [rid for _, rid in got] == [ok.rid]
+    assert got[0][0] == pytest.approx(ok.scores[0])
+    assert bad.finish_reason == "scored"     # detached, not killed
+    assert srv.streams_detached == 1
+    srv.close()
+
+
+def test_harvest_retires_attempts_for_failover(scored, rng):
+    srv = _server(scored, n_slots=1)
+    reqs = [srv.submit(_ids(rng, 1)[0]) for _ in range(3)]
+    out = srv.harvest()
+    assert [r.rid for r in out] == [r.rid for r in reqs]
+    assert all(r.finish_reason == "failover" for r in out)
+    assert srv.scheduler.idle
+    audit = srv.pool.audit()
+    assert audit["allocs"] == audit["frees"] and audit["in_use"] == 0
+    srv.close()
+
+
+# -- fleet compatibility -----------------------------------------------------
+
+def test_fleet_routes_embedding_traffic_unchanged(scored, rng):
+    ex, model, _, _ = scored
+    table = _table_from(ex, model)
+    fleet = EngineFleet(
+        ex, model, n_engines=2, threaded=False,
+        engine_factory=EmbeddingServer,
+        engine_kwargs=dict(host_table=table, own_host_table=False,
+                           cache_rows=64, n_slots=2))
+    try:
+        reqs = [fleet.submit(ids, 1) for ids in _ids(rng, 6)]
+        fleet.wait(reqs)
+        assert all(r.finish_reason == "scored" for r in reqs)
+        assert {r.rid.split("-")[0] for r in reqs} <= {"e0", "e1"}
+        for r in reqs:
+            assert r.attempt.result().size == 1
+            assert np.isfinite(r.attempt.result()).all()
+        for audit in fleet.audit().values():
+            assert audit["allocs"] == audit["frees"]
+    finally:
+        fleet.stop()
+
+
+def test_fleet_fails_over_crashed_embedding_replica(scored, rng):
+    ex, model, _, _ = scored
+    table = _table_from(ex, model)
+    fleet = EngineFleet(
+        ex, model, n_engines=2, threaded=False,
+        engine_factory=EmbeddingServer,
+        engine_kwargs=dict(host_table=table, own_host_table=False,
+                           cache_rows=64, n_slots=2))
+    try:
+        faults.crash_engine(fleet._replicas[0].engine, at=0)
+        faults.crash_engine(fleet._replicas[1].engine, at=0)
+        reqs = [fleet.submit(ids, 1) for ids in _ids(rng, 4)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fleet.wait(reqs)
+        assert all(r.finished for r in reqs)
+        assert all(r.finish_reason == "scored" for r in reqs)
+        assert fleet.failovers_done >= 1
+    finally:
+        fleet.stop()
+
+
+# -- teardown ownership ------------------------------------------------------
+
+def test_cstable_close_is_idempotent_and_refuses_new_work():
+    cst = CacheSparseTable(64, DIM, cache_limit=16, name="close_t")
+    cst.embedding_lookup([1, 2]).result()
+    cst.close()
+    cst.close()                               # idempotent
+    assert cst.closed
+    with pytest.raises(RuntimeError, match="closed"):
+        cst.embedding_lookup([1])
+    with pytest.raises(RuntimeError, match="closed"):
+        cst.flush()
+
+
+def test_cstable_context_manager_closes():
+    with CacheSparseTable(64, DIM, cache_limit=16, name="ctx_t") as cst:
+        assert cst.embedding_lookup([3]).result().shape == (1, DIM)
+    assert cst.closed
+
+
+def test_server_close_owns_cstable_teardown(scored):
+    ex, model, _, _ = scored
+    cst = CacheSparseTable(ROWS, DIM, cache_limit=64, name="owned_t")
+    srv = EmbeddingServer(ex, model, host_table=cst, cache_rows=64,
+                          n_slots=2, name="owner")
+    srv.close()
+    assert cst.closed                          # owned by default
+    with pytest.raises(RuntimeError, match="closed"):
+        srv.submit(np.arange(F))
+    shared = CacheSparseTable(ROWS, DIM, cache_limit=64, name="shared_t")
+    with EmbeddingServer(ex, model, host_table=shared,
+                         own_host_table=False, cache_rows=64,
+                         n_slots=2, name="guest"):
+        pass
+    assert not shared.closed                   # shared: left open
+    shared.close()
+
+
+def test_psembedding_close_shuts_worker_threads():
+    from hetu_tpu.ps import PSEmbedding
+    with PSEmbedding(64, DIM, stale_reads=True) as emb:
+        assert emb.lookup([1, 2]).shape == (2, DIM)
+    with pytest.raises(RuntimeError, match="closed"):
+        emb.lookup([1])
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_embed_counters_and_cstable_mirror_in_snapshot(scored, rng):
+    ex, model, _, _ = scored
+    reg = telemetry.get_registry()
+    reg.enable()
+    try:
+        cst = CacheSparseTable(ROWS, DIM, cache_limit=64, name="tel_t")
+        srv = EmbeddingServer(ex, model, host_table=cst, cache_rows=64,
+                              n_slots=2, name="tel_srv")
+        srv.score_many(_ids(rng, 6))
+        srv.score_many(_ids(rng, 6))           # hits this time
+        perf = cst.perf()
+        snap = reg.snapshot()
+        by_cache = {s["labels"]["cache"]: s["value"]
+                    for s in snap["hetu_embed_cache_hits_total"]
+                    ["samples"]}
+        assert by_cache["tel_srv_hot"] == srv.hot.hits > 0
+        by_srv = {s["labels"]["server"]: s["value"]
+                  for s in snap["hetu_embed_requests_total"]["samples"]}
+        assert by_srv["tel_srv"] == 12
+        by_table = {s["labels"]["table"]: s["value"]
+                    for s in snap["hetu_ps_cstable_misses_total"]
+                    ["samples"]}
+        assert by_table["tel_t"] == perf["misses"] > 0
+        hist = {s["labels"]["table"]: s
+                for s in snap["hetu_ps_cstable_lookup_seconds"]
+                ["samples"]}
+        assert hist["tel_t"]["count"] > 0
+        # sub-millisecond ladder: the first bucket edge is 1 us
+        assert hist["tel_t"]["buckets"][0][0] == pytest.approx(1e-6)
+        srv.close()
+    finally:
+        reg.disable()
