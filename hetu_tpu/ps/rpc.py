@@ -519,6 +519,13 @@ class RemoteTable:
             # shard is GONE, not slow — surface the typed terminal error
             # (and count it) instead of backing off forever
             self._m_exhausted.labels(verb=verb).inc()
+            from .. import telemetry as _telemetry
+            _telemetry.get_flight().incident(
+                "ps_unavailable",
+                extra={"addr": f"{self._addr[0]}:{self._addr[1]}",
+                       "verb": verb, "attempts": attempts[0],
+                       "deadline_s": self._deadline,
+                       "error": f"{type(e).__name__}: {e}"})
             raise PSUnavailable(self._addr, self._deadline, attempts[0],
                                 f"{type(e).__name__}: {e}") from e
         finally:

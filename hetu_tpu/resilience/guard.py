@@ -197,6 +197,12 @@ class StepGuard:
     def _trip(self, reason, step, loss):
         self.stats["trip_steps"].append(int(step))
         self._m_trips.inc()
+        _telemetry.get_flight().incident(
+            "guard_trip",
+            extra={"reason": reason, "step": int(step),
+                   "loss": (float(loss) if loss is not None
+                            and np.isfinite(loss) else None),
+                   "policy": self.policy})
         if self.policy == "abort":
             raise GuardTripped(reason, step, loss)
         if self.policy == "skip":

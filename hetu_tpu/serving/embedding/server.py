@@ -231,6 +231,8 @@ class EmbeddingServer:
         self._m_ttft = _m("histogram", "hetu_embed_ttft_seconds",
                           "Arrival -> score latency per request", **hkw)
         self._tr = _telemetry.get_tracer()
+        self._rt = _telemetry.get_request_trace()
+        self._fl = _telemetry.get_flight()
         self._build()
 
     # -- jitted scoring program --------------------------------------------
@@ -385,6 +387,16 @@ class EmbeddingServer:
             "n_tokens": len(req.scores),
             "queue_wait": req.queue_wait, "ttft": req.ttft,
             "tpot": req.tpot, "finish_reason": req.finish_reason})
+        # same timeline vocabulary as the LLM engine (request_trace.py)
+        reason = req.finish_reason
+        if reason == "deadline":
+            self._rt.event(req.rid, "expired", engine=self.instance)
+        elif reason == "cancelled":
+            self._rt.event(req.rid, "cancelled", engine=self.instance)
+        elif reason == "failover":
+            self._rt.event(req.rid, "harvested", engine=self.instance)
+        self._rt.event(req.rid, "finish", engine=self.instance,
+                       reason=reason, scores=len(req.scores))
         self._m_scored.inc()
         if req.ttft is not None:
             self._m_ttft.observe(req.ttft)
@@ -412,6 +424,10 @@ class EmbeddingServer:
         warnings.warn(
             f"embedding watchdog: {why} for request {req.rid} — "
             "quarantined (finish_reason='error')")
+        self._rt.event(req.rid, "watchdog_trip", engine=self.instance,
+                       why=why)
+        self._fl.incident("watchdog", rid=req.rid,
+                          extra={"engine": self.instance, "why": why})
         self._finalize_active(req, "error", now)
 
     def _emit(self, req, value, now):
@@ -441,6 +457,8 @@ class EmbeddingServer:
         self._expire(now)
         for req, slot in self.scheduler.admit():
             req.t_admit = now
+            self._rt.event(req.rid, "admitted", engine=self.instance,
+                           slot=slot)
             if req.expired(now):
                 # mid-flight expiry: admitted this very iteration but
                 # already past deadline — partial terminal, seat freed
@@ -459,6 +477,8 @@ class EmbeddingServer:
         active[slots] = True
         tier = "device_hot" if self.hot is not None else "host_table"
         t0 = time.perf_counter()
+        hot0 = ((self.hot.hits, self.hot.misses + self.hot.refreshes)
+                if self.hot is not None else (0, 0))
         try:
             with self._tr.span("embed_lookup"):
                 if self.hot is not None:
@@ -480,6 +500,32 @@ class EmbeddingServer:
             dt = time.perf_counter() - t0
             self.lookup_seconds.append(dt)
             self._m_lookup.labels(server=self.name, tier=tier).observe(dt)
+            # per-tier lookup events, batch-attributed: the tier gather
+            # is ONE batched op, so every live request gets one event
+            # naming where its iteration's rows came from (cache hits
+            # vs host pulls for misses+stale; the uncached twin always
+            # pulls from the host table)
+            if self._rt.enabled:
+                if self.hot is not None:
+                    d_hits = self.hot.hits - hot0[0]
+                    d_pulls = (self.hot.misses + self.hot.refreshes
+                               - hot0[1])
+                    for req in reqs:
+                        if d_hits:
+                            self._rt.event(req.rid, "hot_hit",
+                                           engine=self.instance,
+                                           tier=tier,
+                                           batch_rows=d_hits)
+                        if d_pulls:
+                            self._rt.event(req.rid, "host_pull",
+                                           engine=self.instance,
+                                           tier=tier,
+                                           batch_rows=d_pulls)
+                else:
+                    for req in reqs:
+                        self._rt.event(req.rid, "host_pull",
+                                       engine=self.instance, tier=tier,
+                                       batch_rows=int(ids.size))
             t1 = time.perf_counter()
             with self._tr.span("embed_score"):
                 scores, ok = self._score_fn(

@@ -229,6 +229,8 @@ class InferenceEngine:
         self._m_qwait = _m("histogram", "hetu_serving_queue_wait_seconds",
                            "Arrival -> slot admission wait", **hkw)
         self._tr = _telemetry.get_tracer()
+        self._rt = _telemetry.get_request_trace()
+        self._fl = _telemetry.get_flight()
         self._build()
 
     # -- jitted programs ---------------------------------------------------
@@ -437,6 +439,19 @@ class InferenceEngine:
             "n_tokens": len(req.tokens),
             "queue_wait": req.queue_wait, "ttft": req.ttft,
             "tpot": req.tpot, "finish_reason": req.finish_reason})
+        # timeline: the marker event for HOW the attempt ended, then the
+        # terminal itself ("failover" is attempt-terminal only — the
+        # fleet continues the same rid on a sibling, so the timeline
+        # stays live past a "harvested"+finish(failover) pair)
+        reason = req.finish_reason
+        if reason == "deadline":
+            self._rt.event(req.rid, "expired", engine=self.instance)
+        elif reason == "cancelled":
+            self._rt.event(req.rid, "cancelled", engine=self.instance)
+        elif reason == "failover":
+            self._rt.event(req.rid, "harvested", engine=self.instance)
+        self._rt.event(req.rid, "finish", engine=self.instance,
+                       reason=reason, tokens=len(req.tokens))
         # registry mirror of the record: the same latencies land in
         # scrape-able histograms without changing records' shape
         self._m_finished.inc()
@@ -514,9 +529,14 @@ class InferenceEngine:
         step itself raised): retire everything in flight with "error"
         and keep the engine alive for new work."""
         for req in list(self.scheduler.running.values()):
+            self._rt.event(req.rid, "watchdog_trip",
+                           engine=self.instance, why="step_raise")
             self._finalize_active(req, "error", now)
         self.watchdog_trips += 1
         self._m_watchdog.inc()
+        self._fl.incident("watchdog",
+                          extra={"engine": self.instance,
+                                 "why": reason})
         warnings.warn(
             f"decode watchdog: {reason} — all in-flight requests "
             "retired with finish_reason='error'; engine continues")
@@ -531,8 +551,13 @@ class InferenceEngine:
         # 1) admission: prefill up to the budget into free slots
         for req, slot in self.scheduler.admit():
             req.t_admit = self._now()
+            self._rt.event(req.rid, "admitted", engine=self.instance,
+                           slot=slot)
             padded, _ = pad_prompts([req.prompt],
                                     pad_to=self.max_prompt_len)
+            self._rt.event(req.rid, "prefill_start",
+                           engine=self.instance, slot=slot,
+                           prompt_len=int(req.prompt.size))
             try:
                 with self._tr.span("serve_prefill"):
                     k, v, tok, ok = self._prefill_fn(
@@ -548,20 +573,35 @@ class InferenceEngine:
                     raise
                 self.watchdog_trips += 1
                 self._m_watchdog.inc()
+                why = (f"prefill of request {req.rid} raised "
+                       f"{type(e).__name__}: {e}")
                 warnings.warn(
-                    f"decode watchdog: prefill of request {req.rid} "
-                    f"raised {type(e).__name__}: {e} — quarantined")
+                    f"decode watchdog: {why} — quarantined")
+                self._rt.event(req.rid, "watchdog_trip",
+                               engine=self.instance, why="prefill_raise")
+                self._fl.incident("watchdog", rid=req.rid,
+                                  extra={"engine": self.instance,
+                                         "why": why})
                 self._finalize_active(req, "error", self._now())
                 continue
             self.prefills += 1
             self._m_prefill_iters.inc()
             now = self._now()
+            self._rt.event(req.rid, "prefill_end", engine=self.instance,
+                           slot=slot, ok=bool(ok))
             if self.watchdog and not ok:
                 self.watchdog_trips += 1
                 self._m_watchdog.inc()
                 warnings.warn(
                     f"decode watchdog: non-finite prefill logits for "
                     f"request {req.rid} — quarantined")
+                self._rt.event(req.rid, "watchdog_trip",
+                               engine=self.instance,
+                               why="nonfinite_prefill")
+                self._fl.incident(
+                    "watchdog", rid=req.rid,
+                    extra={"engine": self.instance,
+                           "why": "non-finite prefill logits"})
                 self._finalize_active(req, "error", now)
                 continue
             forced = req.next_replay()
@@ -629,6 +669,13 @@ class InferenceEngine:
                     warnings.warn(
                         f"decode watchdog: non-finite logits in slot "
                         f"{slot} (request {req.rid}) — quarantined")
+                    self._rt.event(req.rid, "watchdog_trip",
+                                   engine=self.instance, slot=slot,
+                                   why="nonfinite_decode")
+                    self._fl.incident(
+                        "watchdog", rid=req.rid,
+                        extra={"engine": self.instance, "slot": slot,
+                               "why": "non-finite decode logits"})
                     self._finalize_active(req, "error", now)
                     continue
                 forced = req.next_replay()
@@ -640,12 +687,20 @@ class InferenceEngine:
                     tok = forced
                     self._last_tokens[slot] = tok
                     self._absorb_replay(req, tok)
+                    # ONE timeline event per iteration per request —
+                    # slot + running token count, never per-token spam
+                    self._rt.event(req.rid, "decode_iter",
+                                   engine=self.instance, slot=slot,
+                                   tokens=len(req.tokens), replayed=True)
                     self._maybe_retire(req, tok, now)
                     continue
                 tok = int(nxt[slot])
                 self._last_tokens[slot] = tok
                 self._emit(req, tok, now)
                 produced += 1
+                self._rt.event(req.rid, "decode_iter",
+                               engine=self.instance, slot=slot,
+                               tokens=len(req.tokens))
                 self._maybe_retire(req, tok, now)
         # 3) leak sweep: a slot owned by nobody can never be retired
         # through the request path — reclaim it so the pool cannot

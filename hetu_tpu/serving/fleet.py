@@ -275,6 +275,8 @@ class EngineFleet:
         self._m_unavail = reg.counter(
             "hetu_fleet_unavailable_total",
             "Submits refused with FleetUnavailable")
+        self._rt = _telemetry.get_request_trace()
+        self._fl = _telemetry.get_flight()
         self._replicas = [self._make_replica(i) for i in range(n_engines)]
         self.start()
 
@@ -384,6 +386,8 @@ class EngineFleet:
                  if r.health.state in (QUARANTINED, RESTARTING)]
         if count:
             self._m_unavail.inc()
+            self._fl.incident("fleet_unavailable", health=self.health(),
+                              extra={"states": dict(states)})
         return FleetUnavailable(states,
                                 min(waits) if waits else None)
 
@@ -639,6 +643,8 @@ class EngineFleet:
         self._set_health(rep)
         rep.breaker.open_()
         self._m_breaker.labels(engine=rep.name).inc()
+        self._fl.incident("breaker_open", health=self.health(),
+                          extra={"engine": rep.name, "why": reason})
         out = []
         if harvest and rep.engine is not None:
             harvested = rep.engine.harvest()
@@ -659,6 +665,10 @@ class EngineFleet:
     def _on_crash_locked(self, rep, exc):
         rep.last_error = exc
         self._m_crashes.labels(engine=rep.name).inc()
+        self._fl.incident(
+            "engine_crash", health=self.health(),
+            extra={"engine": rep.name,
+                   "error": f"{type(exc).__name__}: {exc}"})
         warnings.warn(
             f"fleet {self.name}: engine {rep.name} crashed with "
             f"{type(exc).__name__}: {exc} — quarantined, in-flight "
@@ -684,6 +694,13 @@ class EngineFleet:
         freq._finish_reason = reason
         freq.t_done = self._clock()
         self.completed += 1
+        if freq.rid is not None:
+            # cluster-level terminal (idempotent over the engine-level
+            # finish for healthy completions; the ONLY terminal for
+            # requests that died in the failover queue)
+            self._rt.event(freq.rid, "finish", engine=freq.engine,
+                           reason=reason, cluster=True,
+                           failovers=freq.failovers)
         if cancel_others and freq.hedge_attempt is not None:
             name, att = freq.hedge_attempt
             freq.hedge_attempt = None
@@ -742,6 +759,14 @@ class EngineFleet:
                 return
             self.failovers_done += 1
             self._m_failovers.inc()
+            # the stitching seam: same cluster rid continues on the
+            # sibling that _place just chose, replaying tokens-so-far
+            self._rt.event(
+                freq.rid, "failover_replay",
+                engine=freq.engines[-1] if freq.engines else None,
+                replayed=len(tokens),
+                from_engine=(freq.engines[-2]
+                             if len(freq.engines) > 1 else None))
 
     def _supervise_loop(self):
         while self._running:
@@ -779,6 +804,9 @@ class EngineFleet:
         replaced at restart), fail the requests over."""
         rep.generation += 1         # zombie exits when step returns
         self._m_wedges.labels(engine=rep.name).inc()
+        self._fl.incident(
+            "engine_wedge", health=self.health(),
+            extra={"engine": rep.name, "heartbeat_age_s": round(age, 4)})
         warnings.warn(
             f"fleet {self.name}: engine {rep.name} heartbeat stale "
             f"{age:.2f}s — wedged; quarantining and failing over")
@@ -789,6 +817,10 @@ class EngineFleet:
                 continue
             if self._promote_survivor(freq, attempt):
                 continue
+            # no clean engine-side harvest exists (the zombie driver
+            # owns the engine) — mark the seam from the fleet side
+            self._rt.event(rid, "harvested", engine=rep.name,
+                           why="wedge")
             out.extend(self._failover_or_fail(freq, attempt))
         # lockless state flip: the zombie only touches the engine, and
         # every post-step path re-checks the generation fence

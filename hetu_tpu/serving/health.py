@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import time
 
+from .. import telemetry as _telemetry
+
 __all__ = ["HEALTH_STATES", "HEALTH_STATE_CODES", "HEALTHY", "DEGRADED",
            "QUARANTINED", "RESTARTING", "DRAINING", "STOPPED",
            "CircuitBreaker", "ReplicaHealth"]
@@ -167,6 +169,13 @@ class ReplicaHealth:
             self.state = state
             self.last_reason = reason
             self.transitions.append((state, reason))
+            # health transitions land in the flight-recorder ring so an
+            # incident dump shows the replica's path into the fault
+            fl = _telemetry.get_flight()
+            if fl.enabled:
+                fl.record({"e": "health", "engine": self.name,
+                           "state": state, "reason": reason,
+                           "t": time.perf_counter()})
         if state in (HEALTHY, RESTARTING):
             self.consecutive_faults = 0
             self.clean_ticks = 0

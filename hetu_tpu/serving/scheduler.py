@@ -248,6 +248,7 @@ class Scheduler:
             "hetu_serving_rejections_total",
             "Requests refused at admission (EngineOverloaded)",
             labels=("scheduler",)).labels(scheduler=mode)
+        self._rt = _telemetry.get_request_trace()
 
     # -- admission control --------------------------------------------------
     def _admission_open(self):
@@ -307,6 +308,10 @@ class Scheduler:
                            else f"{self.rid_prefix}-{n}")
         self.queue.append(request)
         depth = len(self.queue)
+        # accepted: the timeline for this rid starts (or, on a fleet
+        # failover re-submit of the same rid, CONTINUES) here
+        self._rt.event(request.rid, "queued", engine=self.rid_prefix,
+                       deadline=request.deadline, depth=depth)
         self._m_queue.set(depth)
         if depth > self.queue_depth_peak:
             self.queue_depth_peak = depth

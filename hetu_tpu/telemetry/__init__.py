@@ -7,10 +7,17 @@ Everything is DISABLED by default — the no-op instrument path costs
 paths carry their probes unconditionally and a training run pays
 nothing until someone calls :func:`enable`.
 
+Four singletons: the :class:`MetricsRegistry` (counters/gauges/
+histograms), the :class:`SpanTracer` (host step-phase spans), the
+:class:`RequestTrace` (per-rid lifecycle timelines, stitched across
+fleet failover), and the :class:`FlightRecorder` (recent-event ring +
+incident dumps on any trip).
+
 Typical wiring::
 
     from hetu_tpu import telemetry
-    telemetry.enable(http_port=9100)      # /metrics + /healthz live
+    telemetry.enable(http_port=9100)      # /metrics /healthz /requests
+                                          # /incidents live
     ... train / serve ...
     print(telemetry.report())             # snapshot + phase breakdown
     telemetry.shutdown()
@@ -21,19 +28,29 @@ appends :func:`report` to the stage's detail JSON.
 
 from __future__ import annotations
 
+from .flight import FlightRecorder, INCIDENT_KINDS
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        JsonlWriter, MetricsRegistry, MetricsServer,
                        start_http_server)
+from .request_trace import EVENT_TYPES, RequestTrace
 from .tracing import NULL_SPAN, SpanTracer
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "JsonlWriter", "MetricsServer", "SpanTracer", "NULL_SPAN",
-           "DEFAULT_BUCKETS", "start_http_server", "get_registry",
-           "get_tracer", "enabled", "enable", "disable", "shutdown",
-           "report", "step_phase_report"]
+           "RequestTrace", "FlightRecorder", "EVENT_TYPES",
+           "INCIDENT_KINDS", "DEFAULT_BUCKETS", "start_http_server",
+           "get_registry", "get_tracer", "get_request_trace",
+           "get_flight", "enabled", "enable", "disable", "shutdown",
+           "report", "step_phase_report", "chrome_trace"]
 
 _registry = MetricsRegistry(enabled=False)
 _tracer = SpanTracer(capacity=65536, enabled=False)
+_request_trace = RequestTrace(enabled=False)
+_flight = FlightRecorder(registry=_registry, enabled=False)
+# every request event also lands in the flight ring (bounded; the
+# recorder gates on its own enabled flag)
+_request_trace._sink = _flight.record
+_flight.configure(request_trace=_request_trace)
 _server = None
 
 
@@ -47,20 +64,39 @@ def get_tracer():
     return _tracer
 
 
+def get_request_trace():
+    """The process-wide :class:`RequestTrace`."""
+    return _request_trace
+
+
+def get_flight():
+    """The process-wide :class:`FlightRecorder`."""
+    return _flight
+
+
 def enabled():
     return _registry.enabled
 
 
-def enable(http_port=None, host="127.0.0.1"):
+def enable(http_port=None, host="127.0.0.1", incident_dir=None):
     """Turn instruments live; optionally start the HTTP exporter
-    (``http_port=0`` binds an ephemeral port).  Returns the
+    (``http_port=0`` binds an ephemeral port) and point the flight
+    recorder at an incident-dump directory.  Returns the
     :class:`MetricsServer` when one is (already) running, else None."""
     global _server
     _registry.enable()
     _tracer.enabled = True
+    _request_trace.enabled = True
+    _flight.enabled = True
+    if incident_dir is not None:
+        _flight.configure(incident_dir=incident_dir)
     if http_port is not None and _server is None:
-        _server = start_http_server(port=http_port, host=host,
-                                    registry=_registry)
+        _server = start_http_server(
+            port=http_port, host=host, registry=_registry,
+            debug_providers={
+                "/requests": _request_trace.inflight,
+                "/incidents": _flight.incidents,
+            })
     return _server
 
 
@@ -68,6 +104,8 @@ def disable():
     """Freeze instruments (references stay valid; state is retained)."""
     _registry.disable()
     _tracer.enabled = False
+    _request_trace.enabled = False
+    _flight.enabled = False
 
 
 def shutdown():
@@ -77,6 +115,36 @@ def shutdown():
     if _server is not None:
         _server.close()
         _server = None
+
+
+def _sync_loss_gauges(reg=None, tr=None, rt=None, fl=None):
+    """Mirror ring occupancy + drop counts into registry gauges so
+    silent span/event loss shows up in every snapshot and scrape."""
+    reg = reg if reg is not None else _registry
+    tr = tr if tr is not None else _tracer
+    rt = rt if rt is not None else _request_trace
+    fl = fl if fl is not None else _flight
+    reg.gauge("hetu_tracer_ring_spans",
+              "Host spans retained in the SpanTracer ring").set(len(tr))
+    reg.gauge("hetu_tracer_ring_capacity",
+              "SpanTracer ring capacity").set(tr.capacity)
+    reg.gauge("hetu_tracer_spans_dropped",
+              "Host spans that fell off the SpanTracer ring"
+              ).set(tr.dropped)
+    reg.gauge("hetu_trace_rids_tracked",
+              "Request timelines currently retained").set(len(rt))
+    reg.gauge("hetu_trace_events_dropped",
+              "Request-trace events refused by the per-rid cap"
+              ).set(rt.dropped_events)
+    reg.gauge("hetu_trace_rids_dropped",
+              "Whole request timelines evicted by the rid cap"
+              ).set(rt.dropped_rids)
+    reg.gauge("hetu_flight_ring_events",
+              "Events retained in the flight-recorder ring"
+              ).set(len(fl))
+    reg.gauge("hetu_flight_events_dropped",
+              "Events that fell off the flight-recorder ring"
+              ).set(fl.dropped)
 
 
 # span names recorded INSIDE SubExecutor.run()'s wall time; everything
@@ -132,13 +200,38 @@ def step_phase_report(registry=None, tracer=None):
 
 def report(registry=None, tracer=None):
     """Everything ``--telemetry`` appends to a bench detail JSON: the
-    registry snapshot, the step-phase breakdown, and the raw per-span
-    aggregates (serving phases etc. that aren't executor steps)."""
+    registry snapshot (with ring-occupancy/drop gauges synced first),
+    the step-phase breakdown, the raw per-span aggregates (serving
+    phases etc. that aren't executor steps), and the request-trace /
+    incident summary."""
     reg = registry if registry is not None else _registry
     tr = tracer if tracer is not None else _tracer
+    if reg is _registry:
+        _sync_loss_gauges(reg, tr)
     return {"registry": reg.snapshot(),
             "phases": step_phase_report(reg, tr),
             "spans": {k: {"total_s": round(v["total_s"], 6),
                           "count": v["count"],
                           "mean_s": round(v["mean_s"], 9)}
-                      for k, v in tr.aggregate().items()}}
+                      for k, v in tr.aggregate().items()},
+            "requests": {"tracked": len(_request_trace),
+                         "events_dropped": _request_trace.dropped_events,
+                         "rids_dropped": _request_trace.dropped_rids},
+            "incidents": {"total": _flight.incident_count(),
+                          "by_kind": {
+                              k: _flight.incident_count(k)
+                              for k in INCIDENT_KINDS
+                              if _flight.incident_count(k)}}}
+
+
+def chrome_trace(jax_trace_dir=None, **kw):
+    """The merged Chrome-trace view: the SpanTracer's host phase lanes
+    (optionally merged + step-aligned with a ``jax.profiler.trace``
+    capture, see :meth:`SpanTracer.chrome_trace`) PLUS the per-rid
+    request lifecycle lanes — one pid per engine, one tid per rid — on
+    the tracer's clock base, so one Perfetto load shows device ops,
+    host phases, and request lifecycles together."""
+    doc = _tracer.chrome_trace(jax_trace_dir=jax_trace_dir, **kw)
+    doc["traceEvents"].extend(
+        _request_trace.chrome_rows(epoch=_tracer._epoch))
+    return doc

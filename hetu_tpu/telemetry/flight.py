@@ -1,0 +1,182 @@
+"""Flight recorder: a crash black box for the serving stack.
+
+A bounded process-wide ring holds the most recent request lifecycle
+events (fed by :class:`~.request_trace.RequestTrace`) plus replica
+health transitions.  On any TRIP — GuardTripped, a watchdog
+quarantine, an engine crash or wedge, a circuit-breaker open,
+FleetUnavailable, PSUnavailable — :meth:`incident` dumps what a
+post-mortem needs while it is still true:
+
+* the full registry snapshot at the moment of the trip,
+* the last-N ring events (what the process was doing just before),
+* per-replica health states (when the tripping layer knows them),
+* the tripping rid's complete timeline (when a rid is implicated).
+
+Dumps go through the shared :class:`~.registry.JsonlWriter` path, one
+NEW file per incident under the no-clobber contract (an existing path
+is never overwritten — the sequence number advances past it), and every
+trip counts in ``hetu_incidents_total{kind=}``.  With no incident
+directory configured the dump is kept in the in-memory index only —
+tests and the ``/incidents`` endpoint read the index either way.
+
+Like every PR 4 instrument this is disabled by default: ``record()``
+and ``incident()`` are one flag check while disabled, so the trip
+paths (guard, fleet, RPC client) carry their hooks unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .registry import JsonlWriter
+
+__all__ = ["FlightRecorder", "INCIDENT_KINDS"]
+
+#: every trip kind a dump can carry (documented in docs/INCIDENTS.md)
+INCIDENT_KINDS = ("guard_trip", "watchdog", "engine_crash",
+                  "engine_wedge", "breaker_open", "fleet_unavailable",
+                  "ps_unavailable")
+
+
+class FlightRecorder:
+    """Bounded ring of recent events + incident dumps (see module doc).
+
+    ``registry=`` supplies the :class:`~.registry.MetricsRegistry` the
+    ``hetu_incidents_total`` counter and dump snapshots come from
+    (``hetu_tpu.telemetry`` wires the process-wide one)."""
+
+    def __init__(self, capacity=2048, registry=None, enabled=False):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=self.capacity)
+        self._epoch = time.perf_counter()
+        self.recorded = 0           # events ever recorded
+        self.incident_dir = None    # None: index-only (no files)
+        self._seq = 0
+        self._incidents = []        # index entries, oldest first
+        self._request_trace = None  # wired by telemetry.enable()
+        self._m_incidents = None
+
+    # -- configuration -----------------------------------------------------
+    def configure(self, incident_dir=None, request_trace=None):
+        """Set (or clear) the dump directory and the RequestTrace the
+        tripping rid's timeline is pulled from."""
+        if incident_dir is not None:
+            incident_dir = str(incident_dir)
+            os.makedirs(incident_dir, exist_ok=True)
+        self.incident_dir = incident_dir
+        if request_trace is not None:
+            self._request_trace = request_trace
+        return self
+
+    @property
+    def dropped(self):
+        """Events that fell off the ring (total recorded - retained)."""
+        return max(0, self.recorded - self.capacity)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self.recorded = 0
+            self._incidents = []
+            self._seq = 0
+            self._epoch = time.perf_counter()
+
+    # -- the ring ----------------------------------------------------------
+    def record(self, ev):
+        """Append one event dict to the ring; no-op while disabled."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(ev)
+            self.recorded += 1
+
+    def ring(self):
+        """Retained events, oldest first (copies)."""
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    # -- incidents ---------------------------------------------------------
+    def incident(self, kind, rid=None, health=None, extra=None):
+        """Dump the black box for one trip.  Returns the index entry
+        (or None while disabled).  ``health`` is the tripping layer's
+        per-replica health snapshot when it has one (``fleet.health()``);
+        ``extra`` is any JSON-safe context (exception text, step, loss).
+        """
+        if not self.enabled:
+            return None
+        reg = self._registry
+        if reg is not None:
+            if self._m_incidents is None:
+                self._m_incidents = reg.counter(
+                    "hetu_incidents_total",
+                    "Flight-recorder incident dumps, by trip kind",
+                    labels=("kind",))
+            self._m_incidents.labels(kind=str(kind)).inc()
+        now = time.perf_counter()
+        rt = self._request_trace
+        dump = {"kind": str(kind),
+                "t": round(now - self._epoch, 9),
+                "rid": rid,
+                "events": self.ring(),
+                "health": health,
+                "timeline": (rt.timeline(rid)
+                             if rt is not None and rid is not None
+                             else None),
+                "registry": reg.snapshot() if reg is not None else None,
+                "extra": extra}
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        path = self._write_dump(seq, kind, dump)
+        entry = {"seq": seq, "kind": str(kind), "rid": rid,
+                 "t": dump["t"], "n_events": len(dump["events"]),
+                 "path": path}
+        with self._lock:
+            self._incidents.append(entry)
+        return entry
+
+    def _write_dump(self, seq, kind, dump):
+        if self.incident_dir is None:
+            return None
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in str(kind))
+        while True:
+            path = os.path.join(self.incident_dir,
+                                f"incident-{seq:04d}-{safe}.jsonl")
+            if not os.path.exists(path):
+                break
+            seq += 1    # no-clobber: never overwrite an existing dump
+        with JsonlWriter(path) as w:
+            w.write(dump)
+        return path
+
+    def incidents(self):
+        """The incident index (the ``/incidents`` endpoint), oldest
+        first: seq, kind, rid, t, n_events, path."""
+        with self._lock:
+            return [dict(e) for e in self._incidents]
+
+    def incident_count(self, kind=None):
+        with self._lock:
+            if kind is None:
+                return len(self._incidents)
+            return sum(1 for e in self._incidents if e["kind"] == kind)
+
+    @staticmethod
+    def load_dump(path):
+        """Read one incident file back (single-record JSONL)."""
+        with open(path, encoding="utf-8") as f:
+            return json.loads(f.readline())

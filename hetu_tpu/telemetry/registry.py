@@ -405,13 +405,21 @@ class MetricsServer:
         self.close()
 
 
-def start_http_server(port=0, host="127.0.0.1", registry=None):
+def start_http_server(port=0, host="127.0.0.1", registry=None,
+                      debug_providers=None):
     """Serve ``/metrics`` (Prometheus text) + ``/healthz`` (JSON) from a
     daemon thread — stdlib only, so it runs on a bare TPU VM.  Returns a
-    :class:`MetricsServer` (``port=0`` binds an ephemeral port)."""
+    :class:`MetricsServer` (``port=0`` binds an ephemeral port).
+
+    ``debug_providers``: ``{path: callable}`` extra JSON endpoints —
+    each callable returns a JSON-safe value, rendered on GET.  This is
+    how ``telemetry.enable()`` mounts ``/requests`` (the live in-flight
+    request table) and ``/incidents`` (the flight-recorder dump index)
+    without this module importing them."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry
+    providers = dict(debug_providers or {})
     t0 = time.perf_counter()
 
     class _Handler(BaseHTTPRequestHandler):
@@ -425,6 +433,16 @@ def start_http_server(port=0, host="127.0.0.1", registry=None):
                     {"status": "ok", "telemetry_enabled": reg.enabled,
                      "uptime_s": round(time.perf_counter() - t0, 3)}
                 ).encode()
+                ctype = "application/json"
+            elif path in providers:
+                try:
+                    body = json.dumps(providers[path]()).encode()
+                except Exception as e:
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(
+                        f"{type(e).__name__}: {e}".encode())
+                    return
                 ctype = "application/json"
             else:
                 self.send_response(404)

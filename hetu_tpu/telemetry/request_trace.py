@@ -1,0 +1,264 @@
+"""Per-request lifecycle timelines, stitched across fleet failover.
+
+The registry answers "how many requests finished"; this module answers
+the question the chaos/fleet benches kept re-deriving from scattered
+records — "what happened to request e0-17, and why was it slow?".
+Every rid accumulates a typed, monotonic-clock event timeline:
+
+    queued, admitted, prefill_start, prefill_end, decode_iter,
+    hot_hit, host_pull, watchdog_trip, harvested, failover_replay,
+    expired, cancelled, finish
+
+``decode_iter`` is ONE event per engine iteration per request (slot +
+token count), not one per token emission call, so a 64-token request
+costs 64 small events, not a flood.  Timelines are keyed by the
+CLUSTER rid: a fleet failover re-submits the same rid on a sibling,
+so its events (tagged with the sibling's engine instance) append to
+the same timeline — one stitched history per accepted request, with
+``failover_replay`` marking the seam.  Embedding requests reuse the
+same vocabulary with per-tier ``hot_hit``/``host_pull`` lookup events.
+
+Cost model (the PR 4 contract): disabled by default, and ``event()``
+is one flag check + return while disabled, so the serving hot paths
+carry their probes unconditionally.  Storage is bounded twice over —
+per-rid event cap and a total-rid cap with oldest-terminal-first
+eviction — and every drop is counted (surfaced as registry gauges by
+``telemetry.report()``; silent loss is invisible loss).
+
+Export faces: ``export_jsonl`` (one record per rid through the shared
+:class:`~.registry.JsonlWriter` path), ``inflight()`` (the ``/requests``
+debug endpoint's live table), and ``chrome_rows()`` — trace-event rows
+(one pid per engine, one tid per rid) that merge into the
+``SpanTracer.chrome_trace`` view so request lifecycles land next to the
+host phase spans in one Perfetto load.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+__all__ = ["RequestTrace", "EVENT_TYPES"]
+
+#: the full event vocabulary (tests pin additions to the doc)
+EVENT_TYPES = ("queued", "admitted", "prefill_start", "prefill_end",
+               "decode_iter", "hot_hit", "host_pull", "watchdog_trip",
+               "harvested", "failover_replay", "expired", "cancelled",
+               "finish")
+
+#: attempt-level finish reasons that do NOT end the cluster timeline
+#: (the fleet re-homes the rid; more events follow)
+_NONTERMINAL_FINISH = ("failover",)
+
+
+class _Timeline:
+    __slots__ = ("events", "engine", "deadline", "dropped")
+
+    def __init__(self):
+        self.events = []
+        self.engine = None      # last engine instance seen
+        self.deadline = None    # absolute, on the serving monotonic clock
+        self.dropped = 0
+
+
+class RequestTrace:
+    """Bounded per-rid event timelines (see module doc)."""
+
+    def __init__(self, max_rids=4096, events_per_rid=512, enabled=False):
+        if max_rids < 1 or events_per_rid < 2:
+            raise ValueError(
+                f"need max_rids >= 1 and events_per_rid >= 2, got "
+                f"{max_rids}/{events_per_rid}")
+        self.max_rids = int(max_rids)
+        self.events_per_rid = int(events_per_rid)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._timelines = OrderedDict()     # rid -> _Timeline
+        self._epoch = time.perf_counter()
+        self.dropped_events = 0     # events refused by the per-rid cap
+        self.dropped_rids = 0       # whole timelines evicted by max_rids
+        self._sink = None           # FlightRecorder.record, when wired
+
+    # -- recording ---------------------------------------------------------
+    def event(self, rid, etype, engine=None, **fields):
+        """Append one typed event to ``rid``'s timeline.  No-op while
+        disabled (one flag check).  ``engine`` tags the event with the
+        engine instance that produced it — a failed-over rid's timeline
+        carries every instance it touched."""
+        if not self.enabled:
+            return
+        ev = {"e": etype, "t": time.perf_counter()}
+        if engine is not None:
+            ev["engine"] = engine
+        if fields:
+            # None-valued fields carry no information — keep events lean
+            ev.update({k: v for k, v in fields.items()
+                       if v is not None})
+        with self._lock:
+            tl = self._timelines.get(rid)
+            if tl is None:
+                if len(self._timelines) >= self.max_rids:
+                    self._evict_locked()
+                tl = self._timelines[rid] = _Timeline()
+            if engine is not None:
+                tl.engine = engine
+            if etype == "queued" and fields.get("deadline") is not None:
+                tl.deadline = float(fields["deadline"])
+            if (len(tl.events) >= self.events_per_rid
+                    and etype != "finish"):
+                # keep the terminal event no matter what: completeness
+                # ("did every accepted rid reach a terminal?") must
+                # survive a chatty decode; drop the middle, not the end
+                tl.dropped += 1
+                self.dropped_events += 1
+                return
+            tl.events.append(ev)
+        sink = self._sink
+        if sink is not None:
+            sink(dict(ev, rid=rid))
+
+    def _evict_locked(self):
+        """Make room for a new rid: evict the oldest FINISHED timeline,
+        or the oldest outright when nothing has finished."""
+        victim = None
+        for rid, tl in self._timelines.items():
+            if _done(tl.events):
+                victim = rid
+                break
+        if victim is None:
+            victim = next(iter(self._timelines))
+        del self._timelines[victim]
+        self.dropped_rids += 1
+
+    def clear(self):
+        with self._lock:
+            self._timelines = OrderedDict()
+            self.dropped_events = 0
+            self.dropped_rids = 0
+            self._epoch = time.perf_counter()
+
+    # -- inspection --------------------------------------------------------
+    def __len__(self):
+        with self._lock:
+            return len(self._timelines)
+
+    def rids(self):
+        with self._lock:
+            return list(self._timelines)
+
+    def timeline(self, rid):
+        """Copies of ``rid``'s events (oldest first); [] for unknown."""
+        with self._lock:
+            tl = self._timelines.get(rid)
+            return [dict(e) for e in tl.events] if tl else []
+
+    def complete(self, rid):
+        """True when the rid was accepted (timeline starts at queued/
+        admitted) AND reached a cluster-terminal ``finish`` — the
+        property the chaos/fleet benches assert for every accepted rid,
+        stitched across however many failovers it survived."""
+        with self._lock:
+            tl = self._timelines.get(rid)
+            events = tl.events if tl else ()
+            if not events or events[0]["e"] not in ("queued", "admitted"):
+                return False
+            return _done(events)
+
+    def inflight(self, now=None):
+        """Live request table (the ``/requests`` endpoint): one row per
+        un-finished rid — rid, last lifecycle state, age, deadline
+        remaining, and the engine currently holding it."""
+        now = time.perf_counter() if now is None else now
+        rows = []
+        with self._lock:
+            for rid, tl in self._timelines.items():
+                if not tl.events or _done(tl.events):
+                    continue
+                row = {"rid": rid,
+                       "state": tl.events[-1]["e"],
+                       "age_s": round(now - tl.events[0]["t"], 6),
+                       "engine": tl.engine,
+                       "events": len(tl.events)}
+                # deadlines live on the SERVING clock (possibly a test's
+                # ManualClock), not ours — report the raw bound and let
+                # the caller difference it when the clocks coincide
+                row["deadline_remaining_s"] = (
+                    None if tl.deadline is None
+                    else round(tl.deadline - now, 6))
+                rows.append(row)
+        return rows
+
+    # -- export ------------------------------------------------------------
+    def export_jsonl(self, writer, epoch=None):
+        """One ``{"kind": "request_timeline", ...}`` record per rid via
+        any ``write(record)`` object (:class:`~.registry.JsonlWriter`);
+        timestamps relative to ``epoch`` (default: this trace's).
+        Returns the number of records written."""
+        epoch = self._epoch if epoch is None else epoch
+        with self._lock:
+            items = [(rid, tl.engine, tl.dropped,
+                      [dict(e) for e in tl.events])
+                     for rid, tl in self._timelines.items()]
+        for rid, engine, dropped, events in items:
+            for e in events:
+                e["t"] = round(e["t"] - epoch, 9)
+            writer.write({"kind": "request_timeline", "rid": rid,
+                          "engine": engine, "complete": _done(events),
+                          "dropped_events": dropped, "events": events})
+        return len(items)
+
+    def chrome_rows(self, epoch=None, pid_base=(1 << 20) + 1):
+        """Trace-event rows for the merged chrome view: one pid per
+        engine instance (``M`` process_name metadata), one tid per rid
+        (``M`` thread_name), and one ``X`` event per lifecycle event
+        whose duration runs to the rid's next event — so a request reads
+        as a contiguous lane and a failover visibly jumps lanes.
+        ``epoch`` should be the SpanTracer's epoch when merging
+        (``telemetry.chrome_trace`` passes it)."""
+        epoch = self._epoch if epoch is None else epoch
+        with self._lock:
+            items = [(rid, [dict(e) for e in tl.events])
+                     for rid, tl in self._timelines.items()]
+        pids, tids, rows = {}, {}, []
+        for rid, events in items:
+            tid = tids.setdefault(rid, len(tids) + 1)
+            for i, ev in enumerate(events):
+                engine = ev.pop("engine", None) or "engine?"
+                pid = pids.get(engine)
+                if pid is None:
+                    pid = pids[engine] = pid_base + len(pids)
+                    rows.append({"ph": "M", "pid": pid,
+                                 "name": "process_name",
+                                 "args": {"name": f"engine {engine}"}})
+                rows.append({"ph": "M", "pid": pid, "tid": tid,
+                             "name": "thread_name",
+                             "args": {"name": f"rid {rid}"}})
+                t = ev.pop("t")
+                nxt = (events[i + 1]["t"] if i + 1 < len(events) else t)
+                rows.append({"ph": "X", "pid": pid, "tid": tid,
+                             "name": ev.pop("e"),
+                             "ts": (t - epoch) * 1e6,
+                             "dur": max(0.0, (nxt - t) * 1e6),
+                             "args": dict(ev, rid=rid)})
+        return rows
+
+
+def _done(events):
+    """A timeline is finished when its LAST event is a terminal
+    ``finish`` — an attempt-level finish (reason "failover", or an
+    "error" the fleet re-homes) is followed by more events, so the
+    last-event test is exactly the stitched-timeline semantics.  A
+    CLUSTER-level finish (the fleet's ``_finalize``) is authoritative
+    wherever it sits: an abandoned replica's wedged step thread may
+    unblock and append stale events after the fleet already finalized
+    the rid, and those must not un-finish the timeline."""
+    if not events:
+        return False
+    last = events[-1]
+    if (last["e"] == "finish"
+            and last.get("reason") not in _NONTERMINAL_FINISH):
+        return True
+    return any(e["e"] == "finish" and e.get("cluster")
+               and e.get("reason") not in _NONTERMINAL_FINISH
+               for e in reversed(events))

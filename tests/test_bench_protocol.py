@@ -43,8 +43,8 @@ def _parse_tail(stdout):
     compact = json.loads(lines[-1])
     assert "metric" in compact and "vs_baseline" in compact
     assert set(compact["stages"]) == ALL_STAGES
-    assert len(lines[-1].encode()) < 2000, \
-        "compact line must fit the driver's stdout tail"
+    assert len(lines[-1].encode()) <= 1500, \
+        "compact line must fit the driver's ~1500-byte stdout tail"
     full = json.loads(lines[-2])
     assert len(full["extra_metrics"]) == 7
     for e in full["extra_metrics"]:
@@ -61,7 +61,7 @@ def test_zero_budget_run_emits_complete_parseable_tail(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     compact, full, lines = _parse_tail(proc.stdout)
     assert compact["unit"] == "SKIPPED_BUDGET"
-    units = {e["unit"] for e in compact["stages"].values()}
+    units = {e["u"] for e in compact["stages"].values()}
     assert units == {"SKIPPED_BUDGET"}
     assert set(compact["budget"]["skipped_stages"]) == ALL_STAGES
     # the full detail JSON landed on disk for humans / the next session
@@ -97,7 +97,7 @@ def test_killed_mid_run_tail_still_parses():
     tail = json.loads(lines[-1])
     if "stages" in tail:
         assert set(tail["stages"]) == ALL_STAGES
-        units = {e["unit"] for e in tail["stages"].values()}
+        units = {e["u"] for e in tail["stages"].values()}
     else:
         assert len(tail["extra_metrics"]) == 7
         units = {tail["unit"]} | {e["unit"]
@@ -142,7 +142,7 @@ def test_serve_stage_emits_full_and_compact(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     compact = json.loads(lines[-1])
-    assert len(lines[-1].encode()) < 2000, \
+    assert len(lines[-1].encode()) <= 1500, \
         "compact serve line must fit the driver's stdout tail"
     assert compact["metric"] == "serve_continuous_tokens_per_sec"
     assert compact["value"] > 0
@@ -207,7 +207,7 @@ def test_serve_embed_stage_emits_full_and_compact(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     compact = json.loads(lines[-1])
-    assert len(lines[-1].encode()) < 2000, \
+    assert len(lines[-1].encode()) <= 1500, \
         "compact embed line must fit the driver's stdout tail"
     assert compact["metric"] == "embed_serve_rows_per_sec"
     assert compact["value"] > 0
@@ -263,8 +263,14 @@ def test_serve_embed_aborted_run_preserves_prior_detail_file(tmp_path):
 def _assert_telemetry_block(tel):
     """The --telemetry emission contract shared by BENCH_FULL /
     CHAOS_FULL / SERVE_FULL: a registry snapshot plus the step-phase
-    breakdown (phases summing to the wall step time when steps ran)."""
-    assert set(tel) >= {"registry", "phases", "spans"}
+    breakdown (phases summing to the wall step time when steps ran),
+    and — since the request-trace/flight-recorder PR — the per-rid
+    audit block and the incident tallies."""
+    assert set(tel) >= {"registry", "phases", "spans", "requests",
+                        "incidents", "rid_audit"}
+    assert tel["rid_audit"]["all_complete"] is True
+    assert tel["incidents"]["total"] == sum(
+        tel["incidents"]["by_kind"].values())
     reg = tel["registry"]
     assert isinstance(reg, dict) and reg, "empty registry snapshot"
     for name, metric in reg.items():
@@ -310,6 +316,10 @@ def test_serve_telemetry_emission(tmp_path):
     overhead = full["telemetry_overhead"]
     assert overhead["metric"] == "telemetry_overhead"
     assert 0.0 <= overhead["overhead_frac"] < 1.0
+    # every accepted rid reached a terminal event — the request-trace
+    # completeness audit the serve bench now enforces itself
+    audit = full["telemetry"]["rid_audit"]
+    assert audit["audited"] > 0 and audit["complete"] == audit["audited"]
     # the baseline serve fields are UNCHANGED by the migration to
     # registry instruments (records/latency_stats consumers intact)
     for s in full["stages"].values():
@@ -358,6 +368,8 @@ def test_serve_embed_telemetry_emission(tmp_path):
     assert {"device_hot", "host_table"} <= tiers
     assert {"embed_lookup", "embed_score"} <= set(
         full["telemetry"]["spans"])
+    audit = full["telemetry"]["rid_audit"]
+    assert audit["audited"] > 0 and audit["complete"] == audit["audited"]
 
 
 def test_chaos_telemetry_emission(tmp_path):
@@ -382,6 +394,9 @@ def test_chaos_telemetry_emission(tmp_path):
     assert "hetu_prefetch_queue_depth" in reg
     assert full["telemetry"]["phases"]["steps"] > 0
     assert "overhead_frac" in full["telemetry_overhead"]
+    # the guard trips produced flight-recorder incident dumps
+    assert full["telemetry"]["incidents"]["by_kind"].get(
+        "guard_trip", 0) >= 1
 
 
 def test_stage_telemetry_emission():

@@ -1,0 +1,169 @@
+"""chrome_trace against a REAL ``jax.profiler.trace`` capture.
+
+ROADMAP carry-over: ``chrome_trace(align_steps=True)`` was verified
+against a synthetic capture only.  ``tests/data/real_jax_capture.trace
+.json.gz`` is an actual (CPU) ``jax.profiler.trace`` artifact — real
+metadata lanes (``/host:CPU`` process, TFRT + python threads), real
+``PjitFunction(step)`` executions, real ``$file.py:123`` host-python
+frames — checked in so the merge/align/aggregate paths are pinned to
+the format jax actually writes, not to what the synthetic test assumed.
+
+Also covers the PR 9 merge surface: ``telemetry.chrome_trace()`` lays
+per-rid request lanes next to the capture's device lanes and the
+tracer's host-phase lane in one document, without pid collisions.
+"""
+
+import gzip
+import json
+import os
+import re
+import shutil
+
+import pytest
+
+from hetu_tpu import telemetry
+from hetu_tpu.telemetry.tracing import SpanTracer
+from hetu_tpu.timeline import trace_aggregates
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "real_jax_capture.trace.json.gz")
+
+#: the capture's jitted-step executions (3 profiled steps)
+STEP_RE = r"PjitFunction"
+
+
+def _install(tmp_path):
+    """Lay the fixture out as a capture dir: <d>/plugins/profile/
+    <stamp>/*.trace.json.gz — the layout _latest_trace_json globs."""
+    d = tmp_path / "cap" / "plugins" / "profile" / "0001"
+    d.mkdir(parents=True)
+    shutil.copy(FIXTURE, d / "host.trace.json.gz")
+    return str(tmp_path / "cap")
+
+
+def _events(doc_or_path):
+    if isinstance(doc_or_path, dict):
+        return doc_or_path["traceEvents"]
+    with open(doc_or_path) as f:
+        return json.load(f)["traceEvents"]
+
+
+def test_fixture_is_a_real_capture():
+    """Pin the fixture's provenance-critical shape: the jax metadata
+    envelope, M-lane naming, and complete X events with float ts."""
+    data = json.loads(gzip.open(FIXTURE).read())
+    assert set(data) >= {"traceEvents", "displayTimeUnit", "metadata"}
+    evs = data["traceEvents"]
+    pn = [e for e in evs if e.get("ph") == "M"
+          and e.get("name") == "process_name"]
+    assert pn and any("CPU" in e["args"]["name"] for e in pn)
+    steps = [e for e in evs if e.get("ph") == "X"
+             and re.search(STEP_RE, str(e.get("name", "")))]
+    assert len(steps) >= 3
+    assert all("ts" in e and "dur" in e for e in steps)
+    # real captures carry host-python frames ($file.py:123 fn) — the
+    # aggregate path must know to drop them
+    assert any(str(e.get("name", "")).startswith("$") for e in evs)
+
+
+def test_align_steps_against_real_capture(tmp_path):
+    cap = _install(tmp_path)
+    tr = SpanTracer(capacity=64, enabled=True)
+    # three host steps, each h2d -> dispatch, on the tracer's own clock
+    for k in range(3):
+        t = k * 0.010
+        tr._record("h2d", t, 0.001)
+        tr._record("dispatch", t + 0.002, 0.005)
+    doc = tr.chrome_trace(jax_trace_dir=cap, align_steps=True,
+                          device_step_regex=STEP_RE)
+    evs = _events(doc)
+    dev = sorted((e for e in evs if e.get("ph") == "X"
+                  and re.search(STEP_RE, str(e.get("name", "")))),
+                 key=lambda e: e["ts"])
+    host = [e for e in evs if e.get("ph") == "X"
+            and e.get("name") in ("h2d", "dispatch")]
+    assert len(dev) >= 3 and len(host) == 6
+    # every host span is annotated with its step and shifted onto the
+    # capture's clock base (tens of seconds of uptime, not ~0)
+    for e in host:
+        assert "aligned_step" in e["args"]
+        assert e["ts"] > 1e6
+    dispatches = [e for e in host if e["name"] == "dispatch"]
+    for k, e in enumerate(dispatches):
+        assert e["args"]["aligned_step"] == k
+        assert e["ts"] == pytest.approx(dev[k]["ts"])
+    # a span recorded before its step's anchor rides the PREVIOUS
+    # anchor's offset (documented looseness: offsets switch at the
+    # anchor span, and h2d leads its dispatch by 2ms in a 10ms step)
+    h2ds = [e for e in host if e["name"] == "h2d"]
+    assert h2ds[0]["ts"] == pytest.approx(dispatches[0]["ts"] - 2e3)
+    for k in (1, 2):
+        assert h2ds[k]["args"]["aligned_step"] == k - 1
+        assert h2ds[k]["ts"] == pytest.approx(
+            dispatches[k - 1]["ts"] + 8e3)
+
+
+def test_unaligned_merge_keeps_separate_clock_bases(tmp_path):
+    cap = _install(tmp_path)
+    tr = SpanTracer(capacity=16, enabled=True)
+    tr._record("dispatch", 0.001, 0.002)
+    evs = _events(tr.chrome_trace(jax_trace_dir=cap))
+    host = [e for e in evs if e.get("ph") == "X"
+            and e.get("name") == "dispatch" and e.get("pid") == 1 << 20]
+    assert len(host) == 1 and host[0]["ts"] < 1e6
+    assert any(re.search(STEP_RE, str(e.get("name", ""))) for e in evs)
+
+
+def test_trace_aggregates_on_real_capture(tmp_path):
+    cap = _install(tmp_path)
+    agg = trace_aggregates(cap)
+    # the jitted program's fused ops are in there...
+    dot = next(v for name, v in agg.items() if "dot" in name)
+    assert dot["count"] >= 3 and dot["total_us"] > 0
+    # real captures carry zero-duration events too — counts must still
+    # be sane even where total_us rounds to 0
+    for row in agg.values():
+        assert row["count"] >= 1 and row["total_us"] >= 0
+    # ...and host-python tracer frames are not (unless asked for)
+    assert not any(name.startswith("$") for name in agg)
+    agg2 = trace_aggregates(cap, include_host_python=True)
+    assert any(name.startswith("$") for name in agg2)
+
+
+def test_merged_doc_carries_device_host_and_rid_lanes(tmp_path):
+    """telemetry.chrome_trace(): one document, three worlds — capture
+    device/host lanes, tracer phase lane (pid 1<<20), per-rid request
+    lanes (pid >= (1<<20)+1) — with no pid collisions."""
+    cap = _install(tmp_path)
+    tr, rt = telemetry.get_tracer(), telemetry.get_request_trace()
+    tr.clear(), rt.clear()
+    tr.enabled = rt.enabled = True
+    try:
+        with tr.span("dispatch"):
+            pass
+        rt.event("e0-0", "queued", engine="e0")
+        rt.event("e0-0", "admitted", engine="e0")
+        rt.event("e0-0", "finish", engine="e1", reason="stop",
+                 cluster=True)
+        doc = telemetry.chrome_trace(jax_trace_dir=cap)
+    finally:
+        tr.enabled = rt.enabled = False
+        tr.clear(), rt.clear()
+    evs = doc["traceEvents"]
+    cap_pids = {e["pid"] for e in _events(json.loads(gzip.open(
+        FIXTURE).read()))if "pid" in e}
+    pids = {e["pid"] for e in evs if "pid" in e}
+    assert cap_pids <= pids and (1 << 20) in pids
+    rid_pids = {e["pid"] for e in evs if e.get("ph") == "X"
+                and e.get("args", {}).get("rid") == "e0-0"}
+    assert rid_pids and min(rid_pids) >= (1 << 20) + 1
+    assert not rid_pids & cap_pids
+    # both engine instances the rid touched have process lanes
+    lanes = {e["args"]["name"] for e in evs if e.get("ph") == "M"
+             and e.get("name") == "process_name"}
+    assert {"engine e0", "engine e1", "hetu host spans"} <= lanes
+    assert any("CPU" in n for n in lanes)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

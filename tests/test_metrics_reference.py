@@ -1,0 +1,212 @@
+"""Metrics-reference drift gate (ISSUE 9 satellite).
+
+``docs/METRICS.md`` is the operator-facing reference of every
+``hetu_*`` metric the registry can emit.  Reference docs rot silently:
+a new counter lands without a doc row, or a doc row outlives the code
+that emitted it, and dashboards get built against ghosts.  This gate
+scans every registry call site in ``hetu_tpu/`` with the AST (same
+style as the wall-clock gate in test_no_wallclock_timing.py) and fails
+in BOTH directions — metric-in-code-but-not-doc and
+metric-in-doc-but-gone.
+
+The scanner understands the three construction shapes the codebase
+actually uses:
+
+1. direct:   ``reg.counter("hetu_x_total", "help", ...)``
+2. wrapper:  ``def _m(kind, name, ...): getattr(reg, kind)(name, ...)``
+             called as ``_m("counter", "hetu_x_total", ...)``
+3. f-prefix: ``def _c(suffix, ...): reg.counter(f"hetu_x_{suffix}", ..)``
+             called as ``_c("hits_total", ...)``
+
+A scanner self-test synthesizes all three shapes (plus a negative) so
+a silently-broken scanner cannot green-light the gate.
+"""
+
+import ast
+import os
+import re
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "hetu_tpu")
+DOC = os.path.join(ROOT, "docs", "METRICS.md")
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+def _registry_name_expr(call):
+    """The metric-name expression of a registry-factory Call, or None.
+
+    Matches ``<obj>.counter/gauge/histogram(name, ...)`` and the
+    dynamic-kind twin ``getattr(<obj>, kind)(name, ...)``.
+    """
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _KINDS and call.args:
+        return call.args[0]
+    if (isinstance(f, ast.Call) and isinstance(f.func, ast.Name)
+            and f.func.id == "getattr" and call.args):
+        return call.args[0]
+    return None
+
+
+def metric_call_sites(tree):
+    """Every ``hetu_*`` metric name constructible from ``tree``, as
+    ``[(name, lineno)]`` — resolving literal args, name-through-wrapper
+    args, and constant-prefix f-strings filled by wrapper call sites."""
+    found = []
+    # wrapper name -> ("full", param_index) | ("prefix", prefix, index)
+    wrappers = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        params = [a.arg for a in node.args.args]
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            name_expr = _registry_name_expr(call)
+            if name_expr is None:
+                continue
+            if (isinstance(name_expr, ast.Name)
+                    and name_expr.id in params):
+                wrappers[node.name] = ("full",
+                                       params.index(name_expr.id))
+            elif isinstance(name_expr, ast.JoinedStr):
+                parts = name_expr.values
+                if (len(parts) == 2
+                        and isinstance(parts[0], ast.Constant)
+                        and str(parts[0].value).startswith("hetu_")
+                        and isinstance(parts[1], ast.FormattedValue)
+                        and isinstance(parts[1].value, ast.Name)
+                        and parts[1].value.id in params):
+                    wrappers[node.name] = (
+                        "prefix", parts[0].value,
+                        params.index(parts[1].value.id))
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        name_expr = _registry_name_expr(call)
+        if (isinstance(name_expr, ast.Constant)
+                and isinstance(name_expr.value, str)
+                and name_expr.value.startswith("hetu_")):
+            found.append((name_expr.value, call.lineno))
+        if (isinstance(call.func, ast.Name)
+                and call.func.id in wrappers):
+            spec = wrappers[call.func.id]
+            if spec[0] == "full" and len(call.args) > spec[1]:
+                arg = call.args[spec[1]]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.startswith("hetu_")):
+                    found.append((arg.value, call.lineno))
+            elif spec[0] == "prefix" and len(call.args) > spec[2]:
+                arg = call.args[spec[2]]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    found.append((spec[1] + arg.value, call.lineno))
+    return found
+
+
+def _scan_package(pkg=PKG):
+    """{metric_name: "relpath:lineno" of one defining site}."""
+    sites = {}
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                tree = ast.parse(f.read(), path)
+            rel = os.path.relpath(path, ROOT)
+            for name, lineno in metric_call_sites(tree):
+                sites.setdefault(name, f"{rel}:{lineno}")
+    return sites
+
+
+def _documented_metrics(doc_path=DOC):
+    """Metric names from METRICS.md table rows (``| `hetu_...` |``)."""
+    names = set()
+    with open(doc_path) as f:
+        for line in f:
+            m = re.match(r"\|\s*`(hetu_[a-z0-9_]+)`\s*\|", line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+# -- the gate --------------------------------------------------------------
+
+def test_every_emitted_metric_is_documented():
+    code = _scan_package()
+    doc = _documented_metrics()
+    missing = {n: code[n] for n in sorted(set(code) - doc)}
+    assert not missing, (
+        "metrics emitted by hetu_tpu/ but absent from docs/METRICS.md "
+        f"(add a table row for each): {missing}")
+
+
+def test_every_documented_metric_still_exists():
+    code = set(_scan_package())
+    doc = _documented_metrics()
+    stale = sorted(doc - code)
+    assert not stale, (
+        "docs/METRICS.md documents metrics no registry call site emits "
+        f"(delete the rows or restore the code): {stale}")
+
+
+def test_doc_table_is_nonempty_and_well_formed():
+    doc = _documented_metrics()
+    # the reference must cover at least the stable core families — an
+    # empty or mis-parsed table must not vacuously pass the gate
+    assert len(doc) >= 40
+    for family in ("hetu_executor_", "hetu_serving_", "hetu_fleet_",
+                   "hetu_embed_", "hetu_ps_", "hetu_guard_",
+                   "hetu_prefetch_", "hetu_incidents_", "hetu_trace"):
+        assert any(n.startswith(family) for n in doc), family
+
+
+# -- scanner self-test -----------------------------------------------------
+
+_SELF_TEST_SRC = '''
+import collections
+
+class Thing:
+    def __init__(self, reg):
+        self.direct = reg.counter("hetu_direct_total", "direct shape")
+
+        def _m(kind, name, help):
+            return getattr(reg, kind)(name, help)
+
+        def _c(suffix, help):
+            return reg.counter(f"hetu_fam_{suffix}", help)
+
+        self.wrapped = _m("gauge", "hetu_wrapped_depth", "wrapper shape")
+        self.fam = _c("hits_total", "prefix shape")
+        # negatives: not registry factories, or dynamic beyond reach
+        self.queue = collections.deque("hetu_not_a_metric")
+        self.other = reg.widget("hetu_not_a_factory", "unknown method")
+'''
+
+
+def test_scanner_self_test():
+    found = dict(metric_call_sites(ast.parse(_SELF_TEST_SRC)))
+    assert set(found) == {"hetu_direct_total", "hetu_wrapped_depth",
+                          "hetu_fam_hits_total"}
+
+
+def test_scanner_sees_the_known_construction_sites():
+    """Pin the scanner against the real package: one representative of
+    each shape must resolve, so a refactor that blinds the scanner
+    fails here rather than silently shrinking the gate."""
+    code = _scan_package()
+    for probe in ("hetu_executor_steps_total",       # direct literal
+                  "hetu_serving_tokens_total",       # _m name wrapper
+                  "hetu_embed_cache_hits_total",     # f-prefix wrapper
+                  "hetu_ps_cstable_hits_total",      # f-prefix wrapper
+                  "hetu_incidents_total"):           # flight recorder
+        assert probe in code, probe
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -1,0 +1,314 @@
+"""Request-scoped tracing + flight recorder (hetu_tpu/telemetry/
+request_trace.py, flight.py): per-rid lifecycle timelines stitched
+across fleet failover, the bounded incident black box, and the
+``/requests`` + ``/incidents`` debug endpoints.  Serving-stack
+integration (engines actually emitting these events) is covered in
+test_serving*/test_fleet; here the semantics are pinned in isolation —
+especially the stitching rules the chaos benches' completeness audit
+stands on."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from hetu_tpu import telemetry
+from hetu_tpu.telemetry import JsonlWriter, MetricsRegistry, \
+    start_http_server
+from hetu_tpu.telemetry.flight import INCIDENT_KINDS, FlightRecorder
+from hetu_tpu.telemetry.request_trace import EVENT_TYPES, RequestTrace
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs")
+
+
+def _finish(rt, rid, engine, reason="stop", **kw):
+    rt.event(rid, "finish", engine=engine, reason=reason, **kw)
+
+
+# ---------------- RequestTrace semantics ----------------
+
+def test_disabled_trace_records_nothing():
+    rt = RequestTrace()
+    rt.event("r1", "queued", engine="e0")
+    assert len(rt) == 0 and rt.rids() == []
+    assert rt.timeline("r1") == [] and not rt.complete("r1")
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        RequestTrace(max_rids=0)
+    with pytest.raises(ValueError):
+        RequestTrace(events_per_rid=1)
+
+
+def test_lifecycle_timeline_and_completeness():
+    rt = RequestTrace(enabled=True)
+    rt.event("e0-0", "queued", engine="e0", deadline=None, depth=0)
+    rt.event("e0-0", "admitted", engine="e0", slot=3)
+    rt.event("e0-0", "prefill_start", engine="e0")
+    rt.event("e0-0", "prefill_end", engine="e0", prompt_tokens=7)
+    for _ in range(4):
+        rt.event("e0-0", "decode_iter", engine="e0", slot=3, tokens=1)
+    assert not rt.complete("e0-0")          # no terminal yet
+    _finish(rt, "e0-0", "e0", tokens=4)
+    assert rt.complete("e0-0")
+    tl = rt.timeline("e0-0")
+    assert [e["e"] for e in tl] == (
+        ["queued", "admitted", "prefill_start", "prefill_end"]
+        + ["decode_iter"] * 4 + ["finish"])
+    # typed vocabulary only; monotonic, non-decreasing stamps
+    assert all(e["e"] in EVENT_TYPES for e in tl)
+    assert all(b["t"] >= a["t"] for a, b in zip(tl, tl[1:]))
+    # None-valued fields were dropped at record time
+    assert "deadline" not in tl[0] and tl[0]["depth"] == 0
+    # a rid first seen mid-flight (no queued/admitted head) is not
+    # "complete" — the audit wants full admit->terminal evidence
+    rt.event("mystery", "decode_iter", engine="e0")
+    _finish(rt, "mystery", "e0")
+    assert not rt.complete("mystery")
+
+
+def test_failover_stitches_one_timeline():
+    """The cluster rid accumulates events from EVERY replica it
+    touched: an attempt-level finish(reason=failover) is non-terminal,
+    the sibling's replay + terminal finish completes the same
+    timeline."""
+    rt = RequestTrace(enabled=True)
+    rt.event("c-1", "queued", engine="e0")
+    rt.event("c-1", "admitted", engine="e0", slot=0)
+    rt.event("c-1", "decode_iter", engine="e0", slot=0, tokens=1)
+    rt.event("c-1", "harvested", engine="e0")
+    _finish(rt, "c-1", "e0", reason="failover")
+    assert not rt.complete("c-1")           # re-homing, not done
+    rt.event("c-1", "failover_replay", engine="e1", replayed_tokens=1)
+    rt.event("c-1", "decode_iter", engine="e1", slot=5, tokens=1)
+    _finish(rt, "c-1", "e1", cluster=True, failovers=1)
+    assert rt.complete("c-1") and len(rt) == 1
+    engines = [e.get("engine") for e in rt.timeline("c-1")]
+    assert "e0" in engines and "e1" in engines
+
+
+def test_cluster_finish_is_authoritative_over_stale_events():
+    """A wedged replica's stuck step thread can unblock AFTER the fleet
+    finalized the rid and append stale events — those must not
+    un-finish the timeline (the audit would flake)."""
+    rt = RequestTrace(enabled=True)
+    rt.event("w-1", "queued", engine="e0")
+    _finish(rt, "w-1", "e1", cluster=True, failovers=1)
+    rt.event("w-1", "decode_iter", engine="e0", slot=0, tokens=1)
+    _finish(rt, "w-1", "e0", reason="failover")
+    assert rt.complete("w-1")
+
+
+def test_per_rid_cap_drops_middle_keeps_terminal():
+    rt = RequestTrace(enabled=True, events_per_rid=4)
+    rt.event("r", "queued", engine="e0")
+    rt.event("r", "admitted", engine="e0")
+    for _ in range(10):
+        rt.event("r", "decode_iter", engine="e0", tokens=1)
+    _finish(rt, "r", "e0")
+    tl = rt.timeline("r")
+    assert len(tl) == 5                     # 4 cap + the terminal
+    assert tl[-1]["e"] == "finish" and rt.complete("r")
+    assert rt.dropped_events == 8
+
+
+def test_rid_cap_evicts_oldest_done_first():
+    rt = RequestTrace(enabled=True, max_rids=2)
+    rt.event("a", "queued", engine="e0")
+    _finish(rt, "a", "e0")
+    rt.event("b", "queued", engine="e0")    # b still in flight
+    rt.event("c", "queued", engine="e0")    # evicts a (done), not b
+    assert set(rt.rids()) == {"b", "c"} and rt.dropped_rids == 1
+    rt.event("d", "queued", engine="e0")    # nothing done: oldest goes
+    assert set(rt.rids()) == {"c", "d"} and rt.dropped_rids == 2
+
+
+def test_inflight_table_shows_unfinished_only():
+    rt = RequestTrace(enabled=True)
+    t0 = time.perf_counter()
+    rt.event("live", "queued", engine="e0", deadline=t0 + 5.0)
+    rt.event("live", "admitted", engine="e1", slot=2)
+    rt.event("done", "queued", engine="e0")
+    _finish(rt, "done", "e0")
+    rows = rt.inflight()
+    assert [r["rid"] for r in rows] == ["live"]
+    row = rows[0]
+    assert row["state"] == "admitted" and row["engine"] == "e1"
+    assert row["events"] == 2 and row["age_s"] >= 0
+    assert 0 < row["deadline_remaining_s"] <= 5.0
+
+
+def test_export_jsonl_round_trip(tmp_path):
+    rt = RequestTrace(enabled=True)
+    rt.event("r1", "queued", engine="e0")
+    _finish(rt, "r1", "e0")
+    rt.event("r2", "queued", engine="e0")
+    path = tmp_path / "timelines.jsonl"
+    with JsonlWriter(str(path)) as w:
+        assert rt.export_jsonl(w) == 2
+    recs = {r["rid"]: r for r in
+            (json.loads(ln) for ln in path.read_text().splitlines())}
+    assert all(r["kind"] == "request_timeline" for r in recs.values())
+    assert recs["r1"]["complete"] and not recs["r2"]["complete"]
+    assert recs["r1"]["events"][0]["e"] == "queued"
+    assert all(e["t"] >= 0 for e in recs["r1"]["events"])
+
+
+def test_chrome_rows_lane_per_engine_thread_per_rid():
+    rt = RequestTrace(enabled=True)
+    rt.event("c-1", "queued", engine="e0")
+    _finish(rt, "c-1", "e1", cluster=True)  # failover: jumps lanes
+    rt.event("c-2", "queued", engine="e0")
+    rows = rt.chrome_rows(epoch=0.0)
+    procs = {r["args"]["name"]: r["pid"] for r in rows
+             if r.get("ph") == "M" and r["name"] == "process_name"}
+    assert set(procs) == {"engine e0", "engine e1"}
+    assert min(procs.values()) >= (1 << 20) + 1   # clear of SpanTracer
+    xs = [r for r in rows if r["ph"] == "X"]
+    assert {r["args"]["rid"] for r in xs} == {"c-1", "c-2"}
+    by_rid_tid = {r["args"]["rid"]: r["tid"] for r in xs}
+    assert by_rid_tid["c-1"] != by_rid_tid["c-2"]
+    # same rid, different engines -> same tid on two pids (lane jump)
+    c1 = [r for r in xs if r["args"]["rid"] == "c-1"]
+    assert len({r["pid"] for r in c1}) == 2
+    assert len({r["tid"] for r in c1}) == 1
+
+
+# ---------------- FlightRecorder ----------------
+
+def test_disabled_recorder_is_inert():
+    fl = FlightRecorder()
+    fl.record({"e": "queued"})
+    assert len(fl) == 0
+    assert fl.incident("watchdog") is None
+    assert fl.incidents() == [] and fl.incident_count() == 0
+
+
+def test_ring_is_bounded_and_counts_drops():
+    fl = FlightRecorder(capacity=8, enabled=True)
+    for i in range(20):
+        fl.record({"e": "decode_iter", "i": i})
+    assert len(fl) == 8 and fl.dropped == 12
+    assert [e["i"] for e in fl.ring()] == list(range(12, 20))
+
+
+def test_incident_dump_carries_the_black_box(tmp_path):
+    reg = MetricsRegistry(enabled=True)
+    rt = RequestTrace(enabled=True)
+    fl = FlightRecorder(capacity=16, registry=reg, enabled=True)
+    fl.configure(incident_dir=str(tmp_path / "inc"), request_trace=rt)
+    rt._sink = fl.record
+    rt.event("e0-7", "queued", engine="e0")
+    rt.event("e0-7", "watchdog_trip", engine="e0", cause="nonfinite")
+    entry = fl.incident("watchdog", rid="e0-7",
+                        health={"e0": {"state": "quarantined"}},
+                        extra={"cause": "nonfinite_decode"})
+    assert entry["kind"] == "watchdog" and entry["rid"] == "e0-7"
+    assert entry["n_events"] == 2           # the sink fed the ring
+    dump = FlightRecorder.load_dump(entry["path"])
+    assert [e["e"] for e in dump["timeline"]] == ["queued",
+                                                  "watchdog_trip"]
+    assert dump["health"]["e0"]["state"] == "quarantined"
+    assert dump["extra"]["cause"] == "nonfinite_decode"
+    assert "hetu_incidents_total" in dump["registry"]
+    # the counter incremented with the kind label
+    sam = reg.snapshot()["hetu_incidents_total"]["samples"]
+    assert [(s["labels"]["kind"], s["value"]) for s in sam] == \
+        [("watchdog", 1)]
+    assert fl.incident_count() == 1
+    assert fl.incident_count("watchdog") == 1
+    assert fl.incident_count("guard_trip") == 0
+
+
+def test_incident_files_never_clobber(tmp_path):
+    d = tmp_path / "inc"
+    fl = FlightRecorder(enabled=True).configure(incident_dir=str(d))
+    first = fl.incident("guard_trip")["path"]
+    # a pre-existing file at the next seq (say, from a previous process
+    # sharing the dir) must be skipped, not overwritten
+    blocker = d / "incident-0002-engine_crash.jsonl"
+    blocker.write_text("precious evidence\n")
+    second = fl.incident("engine_crash")["path"]
+    assert second != str(blocker) and second != first
+    assert blocker.read_text() == "precious evidence\n"
+    assert os.path.exists(second)
+
+
+def test_index_only_mode_without_incident_dir():
+    fl = FlightRecorder(enabled=True)
+    entry = fl.incident("fleet_unavailable", extra={"retry_after": 0.5})
+    assert entry["path"] is None
+    assert fl.incident_count("fleet_unavailable") == 1
+
+
+# ---------------- debug endpoints + module wiring ----------------
+
+def test_requests_and_incidents_http_endpoints():
+    reg = MetricsRegistry(enabled=True)
+    rt = RequestTrace(enabled=True)
+    fl = FlightRecorder(registry=reg, enabled=True)
+    rt.event("e0-0", "queued", engine="e0")
+    fl.incident("breaker_open", extra={"engine": "e1"})
+    with start_http_server(port=0, registry=reg,
+                           debug_providers={"/requests": rt.inflight,
+                                            "/incidents": fl.incidents}
+                           ) as srv:
+        reqs = json.loads(urllib.request.urlopen(
+            f"{srv.url}/requests", timeout=5).read())
+        assert [r["rid"] for r in reqs] == ["e0-0"]
+        assert reqs[0]["state"] == "queued"
+        incs = json.loads(urllib.request.urlopen(
+            f"{srv.url}/incidents", timeout=5).read())
+        assert len(incs) == 1 and incs[0]["kind"] == "breaker_open"
+
+
+def test_report_carries_request_and_incident_blocks():
+    telemetry.get_registry().reset()
+    telemetry.get_tracer().clear()
+    telemetry.get_request_trace().clear()
+    telemetry.get_flight().clear()
+    telemetry.enable()
+    try:
+        rt, fl = telemetry.get_request_trace(), telemetry.get_flight()
+        rt.event("e0-0", "queued", engine="e0")
+        rt.event("e0-0", "finish", engine="e0", reason="stop")
+        fl.incident("watchdog", rid="e0-0")
+        rep = telemetry.report()
+        assert rep["requests"]["tracked"] == 1
+        assert rep["requests"]["events_dropped"] == 0
+        assert rep["incidents"] == {"total": 1,
+                                    "by_kind": {"watchdog": 1}}
+        # the loss-accounting gauges are registry-visible (satellite:
+        # ring occupancy + drops as real metrics, not report-only)
+        snap = telemetry.get_registry().snapshot()
+        for g in ("hetu_tracer_ring_spans", "hetu_tracer_ring_capacity",
+                  "hetu_tracer_spans_dropped", "hetu_trace_rids_tracked",
+                  "hetu_trace_events_dropped", "hetu_trace_rids_dropped",
+                  "hetu_flight_ring_events",
+                  "hetu_flight_events_dropped"):
+            assert g in snap, g
+        assert (snap["hetu_trace_rids_tracked"]["samples"][0]["value"]
+                == 1)
+    finally:
+        telemetry.disable()
+        telemetry.get_request_trace().clear()
+        telemetry.get_flight().clear()
+
+
+def test_event_vocabulary_and_incident_kinds_are_documented():
+    """docs/INCIDENTS.md is the schema contract for post-mortem
+    tooling: every event type and every trip kind must appear there."""
+    with open(os.path.join(DOCS, "INCIDENTS.md")) as f:
+        doc = f.read()
+    for etype in EVENT_TYPES:
+        assert f"`{etype}`" in doc, etype
+    for kind in INCIDENT_KINDS:
+        assert f"`{kind}`" in doc, kind
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
