@@ -1494,6 +1494,232 @@ def _emit_embed(out):
     _print_compact(compact)
 
 
+# -- profile mode (bench.py --profile) -------------------------------------
+# Performance introspection evidence (ISSUE 10): capture XLA
+# cost/memory for every compiled program the system owns (W&D train
+# step, serving prefill/decode pair, embedding scoring program),
+# attribute flops to model layers, derive MFU/roofline/throughput
+# signals against the chip peak table, snapshot the HBM live-buffer
+# ledger per stage, and append the flattened signal dict to
+# benchmarks/history.jsonl — the feed for tools/perf_diff.py.
+
+PROFILE_DETAIL_PATH = os.environ.get(
+    "HETU_PROFILE_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "PROFILE_FULL.json"))
+
+HISTORY_PATH = os.environ.get(
+    "HETU_PERF_HISTORY",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "benchmarks", "history.jsonl"))
+
+
+def _profile_train(prof, led, quick, seed, slowdown):
+    """Train-step stage: capture + layer attribution on the chaos W&D
+    workload, then a measured window for MFU/steps-per-sec."""
+    B = 32
+    ex, batch = _chaos_build("prof", B=B)
+    try:
+        ex.run("train", feed_dict=batch(0),
+               convert_to_numpy_ret_vals=True)     # compile outside
+        sub = ex.subexecutor["train"]
+        feed = batch(0)
+        prof.capture("train_step", sub.lower_compiled(), kind="train",
+                     eval_nodes=sub.eval_nodes,
+                     feed_shapes={n.name: v.shape
+                                  for n, v in feed.items()})
+        steps = 8 if quick else 40
+        t0 = time.perf_counter()
+        for i in range(steps):
+            ex.run("train", feed_dict=batch(i + 1))
+            if slowdown:
+                time.sleep(slowdown)
+        ex.run("train", feed_dict=batch(0),
+               convert_to_numpy_ret_vals=True)     # sync the window
+        elapsed = time.perf_counter() - t0
+        import jax
+        p = prof.observe("train_step", steps=steps + 1,
+                         elapsed_s=elapsed, tokens=(steps + 1) * B,
+                         items_name="examples",
+                         n_chips=jax.device_count())
+        return {"derived": p["derived"], "layers": p["layers"],
+                "memory": p["memory"], "hbm": led.snapshot()}
+    finally:
+        ex.close()
+
+
+def _profile_serve(prof, led, quick, seed):
+    """Serving stage: replay a short arrival trace, then capture the
+    prefill/decode pair AFTER the replay (AOT lowering re-traces the
+    shared callables, so capture must stay outside any compile-once
+    window) and fold the measured decode window in."""
+    import jax
+    from hetu_tpu.serving import InferenceEngine
+    ex, model, c = _serve_build(quick)
+    n = 12 if quick else 48
+    trace = _serve_trace(seed, n, c.vocab_size, 3, 10, 4, 12)
+    eng = InferenceEngine(ex, model, n_slots=4, max_len=48,
+                          max_prompt_len=12, name="serve", seed=seed,
+                          instance="prof")
+    try:
+        eng.generate_many([trace[0][1]], 2)        # warm the programs
+        replay = _serve_replay(eng, trace)
+        cp = eng.cost_programs()
+        prof.capture("serve_prefill", cp["prefill"], kind="serve")
+        prof.capture("serve_decode", cp["decode"], kind="serve")
+        d = prof.observe("serve_decode", steps=replay["decode_steps"],
+                         elapsed_s=replay["wall_s"],
+                         tokens=replay["total_tokens"],
+                         n_chips=jax.device_count())
+        return {"derived": d["derived"],
+                "prefill": prof.profile("serve_prefill")["cost"],
+                "tokens_per_sec": replay["tokens_per_sec"],
+                "hbm": led.snapshot()}
+    finally:
+        eng.close()
+        ex.close()
+
+
+def _profile_embed(prof, led, quick, seed):
+    """Embedding-scoring stage: the cached (device hot tier) scorer
+    replayed over the Zipfian trace, captured at serving shapes."""
+    import jax
+    from hetu_tpu.serving import EmbeddingServer
+    ex, model, cst, rows, F, nd = _embed_build(quick)
+    n = 60 if quick else 400
+    trace = _embed_trace(seed, n, rows, F, nd)
+    try:
+        srv = EmbeddingServer(ex, model, host_table=cst,
+                              own_host_table=False, n_slots=8,
+                              cache_rows=max(1024, 8 * F),
+                              staleness_bound=0, name="prof_embed",
+                              instance="prof_embed")
+        try:
+            srv.score_many([trace[0][1]], [trace[0][2]])   # warm
+            replay = _embed_replay(srv, trace, cst)
+            cp = srv.cost_programs()
+            prof.capture("embed_score", cp["score"], kind="embed")
+            rows_served = (replay["requests_scored"] * srv.num_sparse)
+            d = prof.observe("embed_score",
+                             steps=replay["iterations"],
+                             elapsed_s=replay["wall_s"],
+                             tokens=rows_served, items_name="rows",
+                             n_chips=jax.device_count())
+            return {"derived": d["derived"],
+                    "rows_per_sec": replay["rows_per_sec"],
+                    "hit_rate": replay["hot_cache"]["hit_rate"],
+                    "hbm": led.snapshot()}
+        finally:
+            srv.close()
+    finally:
+        cst.close()
+        ex.close()
+
+
+def _profile_signals(prof, stages):
+    """Flatten the round into the flat ``{signal: value}`` dict
+    tools/perf_diff.py diffs: per-program static cost + measured
+    throughput/MFU, plus the PEAK per-pool HBM bytes observed across
+    the stage snapshots."""
+    sig = {}
+    for name, p in sorted(prof.profiles().items()):
+        d = p.get("derived") or {}
+        for k in ("flops_per_step", "bytes_per_step", "steps_per_sec",
+                  "mfu", "tokens_per_sec_per_chip",
+                  "examples_per_sec_per_chip", "rows_per_sec_per_chip"):
+            if d.get(k) is not None:
+                sig[f"{name}.{k}"] = d[k]
+    peak = {}
+    for st in stages.values():
+        for pool, b in st["hbm"]["pools"].items():
+            peak[pool] = max(peak.get(pool, 0), int(b))
+    for pool, b in sorted(peak.items()):
+        if b:
+            sig[f"hbm.{pool}_bytes"] = b
+    return sig
+
+
+def run_profile(quick=False, seed=0):
+    from hetu_tpu import telemetry
+    prof = telemetry.get_profiler()
+    led = telemetry.get_hbm_ledger()
+    # seeded degraded rounds: sleep this long per train step, so the
+    # measured signals (steps/s, MFU) drop while static cost holds —
+    # the perf-regression harness must trip on exactly this shape
+    slowdown = float(os.environ.get("HETU_PROFILE_SLOWDOWN_S", "0") or 0)
+    stages = {
+        "train": _profile_train(prof, led, quick, seed, slowdown),
+        "serve": _profile_serve(prof, led, quick, seed),
+        "embed": _profile_embed(prof, led, quick, seed),
+    }
+    signals = _profile_signals(prof, stages)
+    import jax
+    return {"metric": "profile_train_mfu",
+            "value": stages["train"]["derived"].get("mfu"),
+            "unit": "mfu",
+            "vs_baseline": None,
+            "platform": jax.default_backend(),
+            "seed": seed, "quick": bool(quick),
+            "peaks": prof.peaks(),
+            "n_chips": jax.device_count(),
+            **({"slowdown_s": slowdown} if slowdown else {}),
+            "stages": stages,
+            "layer_table": prof.layer_table(),
+            "signals": signals,
+            "hbm_final": led.snapshot()}
+
+
+def _emit_profile(out, history_path=None):
+    """Profile evidence in the bench layered shape: full headline to an
+    early line + PROFILE_FULL.json (written only after the run has real
+    results — the no-clobber contract), one signals entry appended to
+    benchmarks/history.jsonl, compact tail line with the per-stage
+    ``pf`` block."""
+    from hetu_tpu.telemetry import JsonlWriter
+    history_path = HISTORY_PATH if history_path is None else history_path
+    full = json.dumps(out)
+    try:
+        with open(PROFILE_DETAIL_PATH, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass
+    entry = {"t": round(time.time(), 3), "platform": out["platform"],
+             "quick": out["quick"], "seed": out["seed"],
+             "signals": out["signals"]}
+    try:
+        os.makedirs(os.path.dirname(history_path) or ".", exist_ok=True)
+        with JsonlWriter(history_path) as w:     # append, never truncate
+            w.write(entry)
+    except OSError:
+        pass
+    print(full, flush=True)
+    pf = {}
+    for st, d in out["stages"].items():
+        dd = d["derived"]
+        row = {}
+        if dd.get("mfu") is not None:
+            row["mfu"] = dd["mfu"]
+        row["gflops"] = round(dd.get("flops_per_step", 0) / 1e9, 4)
+        for k, short in (("tokens_per_sec_per_chip", "tok_s"),
+                         ("examples_per_sec_per_chip", "ex_s"),
+                         ("rows_per_sec_per_chip", "rows_s")):
+            if dd.get(k) is not None:
+                row[short] = dd[k]
+        ai = (dd.get("roofline") or {}).get("arithmetic_intensity")
+        if ai is not None:
+            row["ai"] = ai
+        pf[st] = row
+    pf["hbm_kib"] = {p: round(b / 1024, 1)
+                     for p, b in out["stages"]["serve"]["hbm"]["pools"]
+                     .items() if b}
+    compact = {"metric": out["metric"], "value": out["value"],
+               "unit": out["unit"], "platform": out["platform"],
+               "pf": pf,
+               "history": os.path.basename(history_path),
+               "detail": os.path.basename(PROFILE_DETAIL_PATH)}
+    _print_compact(compact, drop_order=("history",))
+
+
 # -- chaos-serve mode (bench.py --chaos --serve) ---------------------------
 # Serving-side resilience evidence: inject every serving fault class
 # (poisoned decode, raising step, slot leak, stalled/raising consumer,
@@ -2306,6 +2532,22 @@ def main():
             _assert_rid_audit(out["telemetry"])
             out["telemetry_overhead"] = run_telemetry_overhead(quick)
         _emit_chaos(out, detail_path)
+        return
+    if "--profile" in sys.argv:
+        # profile mode runs in-process: XLA cost/memory capture for the
+        # train/serve/embed programs + derived MFU/roofline/HBM signals
+        # into PROFILE_FULL.json and benchmarks/history.jsonl.
+        # Profiling needs the live registry, so telemetry is enabled
+        # unconditionally here (no separate --telemetry required).
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        quick = quick or jax.default_backend() == "cpu"
+        _telemetry_on()
+        out = run_profile(quick)
+        out["telemetry"] = _telemetry_report()
+        _emit_profile(out)
         return
     if "--serve-embed" in sys.argv:
         # embedding-serve mode runs in-process (host tables + a tiny

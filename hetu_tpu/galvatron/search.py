@@ -469,12 +469,14 @@ def profile_hp_layers(specs, batch=2, seq=128, reps=5, devices=None):
             act_mem = None
             try:
                 def temp_at(b):
+                    from ..platform import compiled_memory_analysis
                     xb = jax.ShapeDtypeStruct((b, seq, spec.hidden),
                                               spec.dtype)
                     vg = jax.jit(jax.value_and_grad(
                         lambda p, x: jnp.sum(spec.apply(p, x, sh))))
-                    ma = vg.lower(params, xb).compile().memory_analysis()
-                    return float(getattr(ma, "temp_size_in_bytes", 0) or 0)
+                    ma = compiled_memory_analysis(
+                        vg.lower(params, xb).compile())
+                    return float(ma.get("temp_size_in_bytes", 0) or 0)
                 t1, t2 = temp_at(batch), temp_at(2 * batch)
                 if t2 > t1 > 0:
                     act_mem = max(act_bytes, (t2 - t1) / batch)

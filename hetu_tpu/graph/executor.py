@@ -655,18 +655,10 @@ class SubExecutor:
         jax.block_until_ready([o for o in out if o is not None])
         return (time.perf_counter() - start) / repeats
 
-    def cost_analysis(self, feed_dict=None):
-        """XLA's static cost model for the compiled step (flops, HBM
-        bytes accessed, ...) — the single-program analogue of the
-        reference's per-op timer_subexecutor breakdown: XLA has already
-        fused across op boundaries, so costs are whole-program.
-
-        Pure analysis: no step executes, no state mutates.  Feed shapes
-        come from ``feed_dict`` values when given, else from the
-        placeholders' declared shapes.
-        """
-        if self._jitted is None:
-            self._build()
+    def _abstract_args(self, feed_dict=None):
+        """The jitted step's argument tree as ShapeDtypeStructs.  Feed
+        shapes come from ``feed_dict`` values when given, else from the
+        placeholders' declared shapes."""
         ex = self.executor
 
         def abstract(a):
@@ -688,15 +680,47 @@ class SubExecutor:
                     f"{p.name}"
                 feeds[p.name] = jax.ShapeDtypeStruct(tuple(p.shape),
                                                      p.dtype)
-        args = (jax.tree_util.tree_map(abstract, ex.params),
+        return (jax.tree_util.tree_map(abstract, ex.params),
                 jax.tree_util.tree_map(abstract, ex.opt_state),
                 feeds,
                 jax.ShapeDtypeStruct((), ex._base_key.dtype),
                 jax.ShapeDtypeStruct((), jnp.uint32))
-        cost = self._jitted.lower(*args).compile().cost_analysis()
-        if isinstance(cost, list):      # older jax wraps the dict
-            cost = cost[0] if cost else {}
-        return cost
+
+    def lower_compiled(self, feed_dict=None):
+        """The compiled (AOT) step program for analysis.  Pure: no step
+        executes, no state mutates; XLA reuses its compilation cache, so
+        after the first ``run()`` this costs a lowering only."""
+        if self._jitted is None:
+            self._build()
+        return self._jitted.lower(*self._abstract_args(feed_dict)).compile()
+
+    def cost_analysis(self, feed_dict=None):
+        """XLA's static cost model for the compiled step (flops, HBM
+        bytes accessed, ...) — the single-program analogue of the
+        reference's per-op timer_subexecutor breakdown: XLA has already
+        fused across op boundaries, so costs are whole-program.
+
+        Pure analysis: no step executes, no state mutates.  Returns the
+        version-normalized dict (see ``platform.compiled_cost_analysis``).
+        """
+        from ..platform import compiled_cost_analysis
+        return compiled_cost_analysis(self.lower_compiled(feed_dict))
+
+    def memory_analysis(self, feed_dict=None):
+        """XLA's memory ledger for the compiled step (argument/output/
+        temp bytes), version-normalized to a plain dict — the workspace
+        side of the HBM accounting in ``telemetry.profiling``."""
+        from ..platform import compiled_memory_analysis
+        return compiled_memory_analysis(self.lower_compiled(feed_dict))
+
+
+def _tree_nbytes(tree):
+    """Total bytes of every array leaf in a pytree (0 for scalars and
+    non-array leaves)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
 
 
 class Executor:
@@ -796,6 +820,18 @@ class Executor:
                 self.opt_state[n.name] = n.init_state(self.params)
                 self._opt_ops[n.name] = n
 
+        # HBM accounting: register the two big live pools this executor
+        # owns with the process-wide ledger (telemetry.profiling).  The
+        # ledger always tracks — the hetu_hbm_bytes{pool=} gauge only
+        # moves once telemetry is enabled — and close() releases both.
+        led = _telemetry.get_hbm_ledger()
+        tag = f"executor:{id(self):x}"
+        self._hbm_handles = [
+            led.alloc("params", _tree_nbytes(self.params),
+                      owner=f"{tag}:params"),
+            led.alloc("opt_state", _tree_nbytes(self.opt_state),
+                      owner=f"{tag}:opt_state")]
+
         if "pipeline" in self.config:
             # graph-driven pipeline over inhomogeneous stages (raw_ctx /
             # `with ht.stage(i)` annotations), reference context.py:1430
@@ -874,6 +910,14 @@ class Executor:
         for sub in self.subexecutor.values():
             if hasattr(sub, "ps_synchronize"):
                 sub.ps_synchronize()
+
+    def close(self):
+        """Release this executor's HBM-ledger entries (params/opt_state
+        pools).  Idempotent; the arrays themselves stay valid and are
+        reclaimed by ordinary GC — this only ends the accounting."""
+        for h in getattr(self, "_hbm_handles", ()):
+            h.free()
+        self._hbm_handles = []
 
     def profile(self, name=None, feed_dict=None, repeats=10,
                 trace_dir=None):

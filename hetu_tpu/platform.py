@@ -31,6 +31,46 @@ def _compat_shard_map():
 shard_map = _compat_shard_map()
 
 
+def compiled_cost_analysis(compiled):
+    """XLA ``cost_analysis`` as a plain ``{str: float}`` dict across jax
+    versions: 0.4.x wraps the per-device dict in a list (one entry per
+    partition), newer jax returns the dict directly.  Returns ``{}``
+    when the backend provides no cost model."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost) if cost else {}
+
+
+#: the CompiledMemoryStats fields the profiling layer consumes, in the
+#: order they are reported (device-side only; host_* mirrors excluded)
+_MEMORY_FIELDS = ("generated_code_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "alias_size_in_bytes",
+                  "temp_size_in_bytes")
+
+
+def compiled_memory_analysis(compiled):
+    """XLA ``memory_analysis`` as a plain ``{str: int}`` dict across jax
+    versions: 0.4.x returns a ``CompiledMemoryStats`` attribute object,
+    newer jax a dict.  Returns ``{}`` when the backend can't say."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    if isinstance(ma, dict):
+        return {k: int(ma[k]) for k in _MEMORY_FIELDS if k in ma}
+    out = {}
+    for field in _MEMORY_FIELDS:
+        v = getattr(ma, field, None)
+        if v is not None:
+            out[field] = int(v)
+    return out
+
 
 def force_platform_from_env():
     """Honor JAX_PLATFORMS through jax.config BEFORE any device use.

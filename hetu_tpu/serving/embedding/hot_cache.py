@@ -152,6 +152,10 @@ class DeviceHotRowCache:
         if device is not None:
             self.rows_dev = jax.device_put(self.rows_dev, device)
         self._donate = jax.default_backend() != "cpu"
+        # HBM accounting: the device hot tier is a fixed-size live buffer
+        self._hbm_handle = _telemetry.get_hbm_ledger().alloc(
+            "hot_cache", int(self.rows_dev.nbytes),
+            owner=f"hot_cache:{self.name}:{id(self):x}")
         # host-side index: slot -> key/version/usage, key -> slot
         self.key_at = np.full(self.cache_rows, -1, np.int64)
         self.version_at = np.zeros(self.cache_rows, np.uint64)
@@ -363,3 +367,8 @@ class DeviceHotRowCache:
         result must equal ``host.lookup(ids)`` exactly)."""
         slots = self.lookup_slots(ids).reshape(-1)
         return np.asarray(self.rows_dev)[slots]
+
+    def close(self):
+        """End the HBM-ledger accounting for the device tier
+        (idempotent; the buffer itself is reclaimed by ordinary GC)."""
+        self._hbm_handle.free()

@@ -296,6 +296,29 @@ class EmbeddingServer:
         executable."""
         return dict(self._traces)
 
+    def cost_programs(self):
+        """AOT-lower + compile the scoring program at this server's
+        exact serving shapes; ``{"score": compiled}`` for the profiling
+        layer.  Pure analysis, but lowering re-traces the shared python
+        callable (the retrace witnesses advance by one) — capture
+        profiles outside any compile-once assertion window."""
+        def ab(x):
+            return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
+
+        params = jax.tree_util.tree_map(ab, self.params)
+        n = self.n_slots
+        dense = jax.ShapeDtypeStruct((n, self.num_dense), jnp.float32)
+        active = jax.ShapeDtypeStruct((n,), jnp.bool_)
+        if self.hot is not None:
+            gathered = (ab(self.hot.packed_view()),
+                        jax.ShapeDtypeStruct((n, self.num_sparse),
+                                             jnp.int32))
+        else:
+            gathered = (jax.ShapeDtypeStruct(
+                (n, self.num_sparse, self.dim), jnp.float32),)
+        return {"score": self._score_fn.lower(
+            params, *gathered, dense, active).compile()}
+
     # -- request API --------------------------------------------------------
     def submit(self, ids, max_new=1, stream=None, eos_id=None,
                arrival=None, deadline=None, ttl=None, replay=None,
@@ -594,6 +617,8 @@ class EmbeddingServer:
         if self._closed:
             return
         self._closed = True
+        if self.hot is not None:
+            self.hot.close()   # ends its hot_cache HBM-ledger entry
         if self.own_host_table and hasattr(self._host_raw, "close"):
             self._host_raw.close()
 

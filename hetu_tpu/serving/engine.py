@@ -314,6 +314,45 @@ class InferenceEngine:
         runs the same executable at the same shapes."""
         return dict(self._traces)
 
+    def cost_programs(self):
+        """AOT-lower + compile the (prefill, decode) pair at this
+        engine's exact serving shapes and return ``{"prefill":
+        compiled, "decode": compiled}`` for the profiling layer
+        (``telemetry.profiling.ProgramProfiler.capture``).
+
+        Pure analysis — nothing executes and no engine state changes —
+        but lowering re-traces the shared python callables, so the
+        retrace witnesses (``hetu_serving_retraces_total``,
+        ``trace_counts``) each advance by one: capture profiles outside
+        any compile-once assertion window."""
+        def ab(x):
+            return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
+
+        params = jax.tree_util.tree_map(ab, self.params)
+        k, v = ab(self.cache.k), ab(self.cache.v)
+        key = ab(self._key)
+        n = self.cache.n_slots
+        prompt = jax.ShapeDtypeStruct((1, self.max_prompt_len), jnp.int32)
+        scalar = jax.ShapeDtypeStruct((), jnp.int32)
+        lane = jax.ShapeDtypeStruct((n,), jnp.int32)
+        active = jax.ShapeDtypeStruct((n,), jnp.bool_)
+        return {"prefill": self._prefill_fn.lower(
+                    params, k, v, prompt, scalar, scalar, key).compile(),
+                "decode": self._step_fn.lower(
+                    params, k, v, lane, lane, active, key).compile()}
+
+    def close(self):
+        """Release engine-owned HBM-ledger accounting (the KV slot
+        pool).  Idempotent; scheduler/stats state stays readable."""
+        self.cache.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub

@@ -48,6 +48,12 @@ class SlotKVCache:
         self._owner = [None] * self.n_slots
         self.alloc_count = 0
         self.free_count = 0
+        # HBM accounting: the pool is the serving stack's dominant live
+        # allocation — register it with the process-wide ledger
+        from .. import telemetry
+        self._hbm_handle = telemetry.get_hbm_ledger().alloc(
+            "kv_cache", int(self.k.nbytes) + int(self.v.nbytes),
+            owner=f"kv_cache:{id(self):x}")
 
     # -- allocation --------------------------------------------------------
     @property
@@ -121,3 +127,8 @@ class SlotKVCache:
     def update(self, k, v):
         """Adopt the cache arrays a jitted step returned."""
         self.k, self.v = k, v
+
+    def close(self):
+        """End the HBM-ledger accounting for this pool (idempotent).
+        The arrays themselves are reclaimed by ordinary GC."""
+        self._hbm_handle.free()

@@ -29,6 +29,7 @@ appends :func:`report` to the stage's detail JSON.
 from __future__ import annotations
 
 from .flight import FlightRecorder, INCIDENT_KINDS
+from .profiling import HBM_POOLS, HbmLedger, ProgramProfiler
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        JsonlWriter, MetricsRegistry, MetricsServer,
                        start_http_server)
@@ -39,18 +40,24 @@ __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "JsonlWriter", "MetricsServer", "SpanTracer", "NULL_SPAN",
            "RequestTrace", "FlightRecorder", "EVENT_TYPES",
            "INCIDENT_KINDS", "DEFAULT_BUCKETS", "start_http_server",
+           "HbmLedger", "ProgramProfiler", "HBM_POOLS",
            "get_registry", "get_tracer", "get_request_trace",
-           "get_flight", "enabled", "enable", "disable", "shutdown",
+           "get_flight", "get_hbm_ledger", "get_profiler",
+           "enabled", "enable", "disable", "shutdown",
            "report", "step_phase_report", "chrome_trace"]
 
 _registry = MetricsRegistry(enabled=False)
 _tracer = SpanTracer(capacity=65536, enabled=False)
 _request_trace = RequestTrace(enabled=False)
 _flight = FlightRecorder(registry=_registry, enabled=False)
+_hbm = HbmLedger(registry=_registry)
+_profiler = ProgramProfiler(registry=_registry, ledger=_hbm)
 # every request event also lands in the flight ring (bounded; the
 # recorder gates on its own enabled flag)
 _request_trace._sink = _flight.record
-_flight.configure(request_trace=_request_trace)
+# incident dumps carry the HBM ledger snapshot (memory forensics for
+# OOM-adjacent trips)
+_flight.configure(request_trace=_request_trace, hbm=_hbm.snapshot)
 _server = None
 
 
@@ -72,6 +79,16 @@ def get_request_trace():
 def get_flight():
     """The process-wide :class:`FlightRecorder`."""
     return _flight
+
+
+def get_hbm_ledger():
+    """The process-wide :class:`HbmLedger` (live-buffer HBM accounting)."""
+    return _hbm
+
+
+def get_profiler():
+    """The process-wide :class:`ProgramProfiler`."""
+    return _profiler
 
 
 def enabled():
@@ -96,6 +113,7 @@ def enable(http_port=None, host="127.0.0.1", incident_dir=None):
             debug_providers={
                 "/requests": _request_trace.inflight,
                 "/incidents": _flight.incidents,
+                "/profile": _profiler.report_block,
             })
     return _server
 
@@ -221,7 +239,8 @@ def report(registry=None, tracer=None):
                           "by_kind": {
                               k: _flight.incident_count(k)
                               for k in INCIDENT_KINDS
-                              if _flight.incident_count(k)}}}
+                              if _flight.incident_count(k)}},
+            "profile": _profiler.report_block()}
 
 
 def chrome_trace(jax_trace_dir=None, **kw):

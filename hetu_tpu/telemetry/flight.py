@@ -63,18 +63,22 @@ class FlightRecorder:
         self._seq = 0
         self._incidents = []        # index entries, oldest first
         self._request_trace = None  # wired by telemetry.enable()
+        self._hbm = None            # callable -> HBM ledger snapshot
         self._m_incidents = None
 
     # -- configuration -----------------------------------------------------
-    def configure(self, incident_dir=None, request_trace=None):
-        """Set (or clear) the dump directory and the RequestTrace the
-        tripping rid's timeline is pulled from."""
+    def configure(self, incident_dir=None, request_trace=None, hbm=None):
+        """Set (or clear) the dump directory, the RequestTrace the
+        tripping rid's timeline is pulled from, and the HBM-ledger
+        snapshot callable included in every dump."""
         if incident_dir is not None:
             incident_dir = str(incident_dir)
             os.makedirs(incident_dir, exist_ok=True)
         self.incident_dir = incident_dir
         if request_trace is not None:
             self._request_trace = request_trace
+        if hbm is not None:
+            self._hbm = hbm
         return self
 
     @property
@@ -136,6 +140,7 @@ class FlightRecorder:
                              if rt is not None and rid is not None
                              else None),
                 "registry": reg.snapshot() if reg is not None else None,
+                "hbm": self._hbm() if self._hbm is not None else None,
                 "extra": extra}
         with self._lock:
             self._seq += 1

@@ -1,0 +1,142 @@
+"""Peak-rate tables + MFU / roofline arithmetic for the profiling layer.
+
+Pure functions over numbers the :mod:`~hetu_tpu.telemetry.profiling`
+capture layer supplies (XLA cost-model flops/bytes, measured steps/s),
+so every derived signal here is unit-testable without a device:
+
+* :func:`chip_peaks` — per-chip peak flop rate and HBM bandwidth, from
+  the device kind (published TPU specs; bf16 dense-matmul peaks), with
+  ``HETU_PEAK_FLOPS`` / ``HETU_PEAK_HBM_BW`` env overrides for chips
+  the table doesn't know (and for pinning CPU-quick rounds to a stable
+  denominator).
+* :func:`mfu` — model flops utilization: achieved flops/s over peak.
+* :func:`roofline` — arithmetic intensity vs the ridge point, i.e.
+  whether the program sits on the compute or the memory roof.
+* :func:`derive` — the full per-program derived block bench/report use.
+
+On CPU the table returns a NOMINAL host peak: the absolute MFU is
+meaningless there (and flagged ``peak_source="nominal_cpu"``), but the
+ratio is stable run-to-run, which is what the perf-regression harness
+(tools/perf_diff.py) diffs.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["CHIP_PEAKS", "chip_peaks", "mfu", "roofline", "derive"]
+
+#: device_kind substring -> (peak flops/s, HBM bytes/s).  Flop peaks are
+#: the published bf16 MXU numbers; substrings are matched in order, so
+#: "v5p" must precede "v5" etc.  The trailing "cpu" entry is nominal.
+CHIP_PEAKS = (
+    ("v6e", (918e12, 1640e9)),          # Trillium
+    ("v5p", (459e12, 2765e9)),
+    ("v5e", (197e12, 819e9)),           # aka v5 lite
+    ("v4", (275e12, 1228e9)),
+    ("v3", (123e12, 900e9)),
+    ("v2", (45e12, 700e9)),
+    ("cpu", (2e11, 5e10)),              # nominal host-order numbers
+)
+
+_DEFAULT_PEAKS = (2e14, 8e11)           # unknown accelerator: v4-order
+
+
+def chip_peaks(device_kind=None):
+    """``{"device_kind", "peak_flops", "peak_hbm_bytes_per_s",
+    "peak_source"}`` for the current (or named) chip.
+
+    ``device_kind=None`` sniffs ``jax.devices()[0].device_kind`` — lazy
+    import, so the module stays importable without jax.  Env overrides
+    ``HETU_PEAK_FLOPS`` / ``HETU_PEAK_HBM_BW`` win over the table.
+    """
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            device_kind = "unknown"
+    kind_l = str(device_kind).lower()
+    flops, bw = _DEFAULT_PEAKS
+    source = "default_unknown_chip"
+    for sub, (f, b) in CHIP_PEAKS:
+        if sub in kind_l:
+            flops, bw = f, b
+            source = "nominal_cpu" if sub == "cpu" else "table"
+            break
+    env_f = os.environ.get("HETU_PEAK_FLOPS")
+    env_b = os.environ.get("HETU_PEAK_HBM_BW")
+    if env_f:
+        flops, source = float(env_f), "env"
+    if env_b:
+        bw = float(env_b)
+        source = source if env_f else "env"
+    return {"device_kind": str(device_kind),
+            "peak_flops": float(flops),
+            "peak_hbm_bytes_per_s": float(bw),
+            "peak_source": source}
+
+
+def mfu(flops_per_step, steps_per_sec, peak_flops):
+    """Model flops utilization: (flops/step x steps/s) / peak flops/s.
+
+    0.0 when any input is missing/non-positive (never raises: profiling
+    must degrade, not break, on backends without a cost model)."""
+    if not flops_per_step or not steps_per_sec or not peak_flops:
+        return 0.0
+    if flops_per_step <= 0 or steps_per_sec <= 0 or peak_flops <= 0:
+        return 0.0
+    return float(flops_per_step) * float(steps_per_sec) / float(peak_flops)
+
+
+def roofline(flops_per_step, bytes_per_step, peaks):
+    """Roofline position of one program: arithmetic intensity (flops per
+    HBM byte accessed) vs the chip's ridge point (peak_flops / peak_bw).
+    ``bound`` is "compute" above the ridge, "memory" below, None when
+    the inputs are missing."""
+    peak_f = peaks["peak_flops"]
+    peak_b = peaks["peak_hbm_bytes_per_s"]
+    ridge = (peak_f / peak_b) if peak_b else None
+    if not flops_per_step or not bytes_per_step or bytes_per_step <= 0:
+        return {"arithmetic_intensity": None, "ridge_intensity": ridge,
+                "bound": None}
+    ai = float(flops_per_step) / float(bytes_per_step)
+    bound = None
+    if ridge is not None:
+        bound = "compute" if ai >= ridge else "memory"
+    return {"arithmetic_intensity": round(ai, 6),
+            "ridge_intensity": round(ridge, 6) if ridge else None,
+            "bound": bound}
+
+
+def derive(cost, steps=None, elapsed_s=None, peaks=None, n_chips=1,
+           tokens=None, items_name="tokens"):
+    """The derived-signal block for one profiled program.
+
+    ``cost`` is the normalized XLA cost dict (flops, "bytes accessed");
+    ``steps``/``elapsed_s`` a measured execution count and wall window
+    (None -> static-only signals); ``tokens`` an optional item count for
+    serving-style throughput (items/s/chip under ``items_name``).
+    Arithmetic is deliberately transparent —
+    ``mfu == flops_per_step * steps_per_sec / peak_flops`` exactly —
+    and pinned by tests/test_profiling.py.
+    """
+    peaks = peaks or chip_peaks()
+    flops = float(cost.get("flops", 0.0) or 0.0)
+    nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+    out = {"flops_per_step": flops, "bytes_per_step": nbytes,
+           "roofline": roofline(flops, nbytes, peaks)}
+    if steps and elapsed_s and elapsed_s > 0:
+        sps = float(steps) / float(elapsed_s)
+        out["steps"] = int(steps)
+        out["elapsed_s"] = round(float(elapsed_s), 6)
+        out["steps_per_sec"] = round(sps, 4)
+        out["achieved_flops_per_sec"] = round(flops * sps, 2)
+        out["achieved_bytes_per_sec"] = round(nbytes * sps, 2)
+        out["mfu"] = round(mfu(flops, sps, peaks["peak_flops"]), 6)
+        bw = peaks["peak_hbm_bytes_per_s"]
+        out["hbm_frac"] = round(nbytes * sps / bw, 6) if bw else None
+        if tokens:
+            per_chip = float(tokens) / float(elapsed_s) / max(1, n_chips)
+            out[f"{items_name}_per_sec_per_chip"] = round(per_chip, 2)
+    return out

@@ -1,0 +1,469 @@
+"""Per-program performance introspection + process-wide HBM accounting.
+
+Two layers, both exported through the ``hetu_tpu.telemetry`` singletons:
+
+**ProgramProfiler** — for every compiled program the system owns (the
+executor train step, the serving prefill/decode pair, the embedding
+scoring program) :meth:`~ProgramProfiler.capture` pulls XLA's
+``cost_analysis`` / ``memory_analysis`` through the version-compat
+helpers in :mod:`~hetu_tpu.platform`, attributes flops/bytes to graph
+ops and model layers via name scopes (:func:`attribute_graph`), and
+:meth:`~ProgramProfiler.observe` folds in measured step counts to
+produce the derived signals of :mod:`~hetu_tpu.telemetry.perf_model`:
+per-step MFU / roofline position, achieved vs peak bytes, serving
+tokens/s-per-chip.  Exported three ways: ``hetu_profile_*`` registry
+gauges, the ``profile`` block in ``telemetry.report()``, and the
+``/profile`` debug endpoint on the metrics HTTP server.
+
+**HbmLedger** — a live-buffer ledger over the device pools the system
+allocates (``hetu_hbm_bytes{pool=params|opt_state|kv_cache|hot_cache|
+workspace}``), fed by the SlotKVCache, the DeviceHotRowCache, and the
+executor's param/opt-state allocations.  Pool totals equal the sum of
+live tracked buffers by construction; ``allocs == frees`` after every
+owner's ``close()`` (pinned by tests/test_profiling.py).  The snapshot
+rides along in every FlightRecorder incident dump so OOM-adjacent
+incidents carry memory forensics.
+
+Disabled-mode contract: like every PR 4 instrument, the per-call paths
+here are ~100 ns no-ops while telemetry is off (ledger alloc/free are
+allocation-TIME bookkeeping — dict writes, never in a step loop — and
+gauge mirrors go through the registry's no-op instruments).  Captures
+are explicit, pull-based analysis: nothing here touches the executor /
+engine hot paths.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import perf_model
+
+__all__ = ["HBM_POOLS", "HbmLedger", "ProgramProfiler",
+           "attribute_graph", "layer_of"]
+
+#: the device pools the ledger accounts (the hetu_hbm_bytes label set)
+HBM_POOLS = ("params", "opt_state", "kv_cache", "hot_cache", "workspace")
+
+
+class _HbmBuffer:
+    """Handle for one tracked allocation; ``free()`` is idempotent."""
+
+    __slots__ = ("pool", "owner", "nbytes", "_ledger", "live")
+
+    def __init__(self, ledger, pool, owner, nbytes):
+        self._ledger = ledger
+        self.pool = pool
+        self.owner = owner
+        self.nbytes = int(nbytes)
+        self.live = True
+
+    def free(self):
+        self._ledger._free(self)
+
+    def close(self):
+        self.free()
+
+
+class HbmLedger:
+    """Live-buffer ledger: who holds how many device bytes, by pool.
+
+    Tracking is unconditional (allocation-time bookkeeping, not a hot
+    path); the ``hetu_hbm_bytes{pool=}`` gauge mirror goes through the
+    registry, so it costs the usual ~100 ns no-op while telemetry is
+    disabled and appears in snapshots/scrapes once enabled."""
+
+    def __init__(self, registry=None):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._buffers = []          # live _HbmBuffer handles
+        self.alloc_count = 0
+        self.free_count = 0
+        self._m_bytes = None
+
+    def _gauge(self):
+        reg = self._registry
+        if reg is None:
+            return None
+        if self._m_bytes is None:
+            self._m_bytes = reg.gauge(
+                "hetu_hbm_bytes",
+                "Live tracked device bytes, by allocation pool",
+                labels=("pool",))
+        return self._m_bytes
+
+    def _sync_pool(self, pool):
+        g = self._gauge()
+        if g is not None:
+            g.labels(pool=pool).set(self.live_bytes(pool))
+
+    # -- tracking ----------------------------------------------------------
+    def alloc(self, pool, nbytes, owner=None):
+        """Track one live device allocation; returns the free() handle.
+        Unknown pools raise — the label set is the documented contract.
+        """
+        if pool not in HBM_POOLS:
+            raise ValueError(f"unknown HBM pool {pool!r}; "
+                             f"one of {HBM_POOLS}")
+        buf = _HbmBuffer(self, pool, owner, nbytes)
+        with self._lock:
+            self._buffers.append(buf)
+            self.alloc_count += 1
+        self._sync_pool(pool)
+        return buf
+
+    def _free(self, buf):
+        with self._lock:
+            if not buf.live:
+                return
+            buf.live = False
+            self._buffers.remove(buf)
+            self.free_count += 1
+        self._sync_pool(buf.pool)
+
+    def replace(self, handle, pool, nbytes, owner=None):
+        """alloc() that first frees ``handle`` (None ok) — for owners
+        that re-measure one logical buffer (workspace re-captures)."""
+        if handle is not None:
+            handle.free()
+        return self.alloc(pool, nbytes, owner=owner)
+
+    # -- views -------------------------------------------------------------
+    def live_bytes(self, pool=None):
+        with self._lock:
+            return sum(b.nbytes for b in self._buffers
+                       if pool is None or b.pool == pool)
+
+    def live_buffers(self, pool=None):
+        with self._lock:
+            return [{"pool": b.pool, "owner": b.owner,
+                     "nbytes": b.nbytes}
+                    for b in self._buffers
+                    if pool is None or b.pool == pool]
+
+    def snapshot(self):
+        """JSON-safe ledger state: per-pool totals (every pool present,
+        0 when empty), the live buffer list, and the alloc/free balance.
+        Pool totals equal the sum of the listed buffers by construction.
+        """
+        bufs = self.live_buffers()
+        pools = {p: 0 for p in HBM_POOLS}
+        for b in bufs:
+            pools[b["pool"]] += b["nbytes"]
+        with self._lock:
+            allocs, frees = self.alloc_count, self.free_count
+        for p in pools:
+            self._sync_pool(p)
+        return {"pools": pools,
+                "total_bytes": sum(pools.values()),
+                "buffers": bufs,
+                "allocs": allocs,
+                "frees": frees,
+                "live": allocs - frees}
+
+    def clear(self):
+        with self._lock:
+            for b in self._buffers:
+                b.live = False
+            self._buffers = []
+            self.alloc_count = 0
+            self.free_count = 0
+
+
+# ---------------------------------------------------------------------------
+# per-op / per-layer attribution
+
+
+#: parameter-name suffixes that identify which LAYER a variable belongs
+#: to (wdl_deep0_weight and wdl_deep0_bias are both layer "wdl_deep0")
+_PARAM_SUFFIXES = ("_weight", "_bias", "_kernel", "_gamma", "_beta",
+                   "_scale", "_wte", "_wpe")
+
+
+def layer_of(var_name):
+    """Layer key of one variable name: the name-scope prefix left after
+    stripping the parameter-role suffix (``wdl_deep0_weight`` ->
+    ``wdl_deep0``; a suffix-less table like ``wdl_emb`` is its own
+    layer)."""
+    name = str(var_name)
+    for suf in _PARAM_SUFFIXES:
+        if name.endswith(suf) and len(name) > len(suf):
+            return name[:-len(suf)]
+    return name
+
+
+def attribute_graph(eval_nodes, feed_shapes=None, totals=None):
+    """Per-layer flops/bytes attribution for one op graph.
+
+    Per-op costs come from the analytic estimators in
+    :mod:`~hetu_tpu.profiler` (shape inference via ``jax.eval_shape``);
+    each compute op is attributed to the layer of its parameter inputs
+    (variables carry the name-scope-stable layer names), ops without a
+    parameter input inherit their first attributed producer, and
+    anything else lands in ``(unattributed)``.  When ``totals`` (the
+    XLA cost dict of the COMPILED program) is given, per-layer estimates
+    are scaled so they sum to XLA's whole-program flops/bytes — the
+    estimators give the split, XLA gives the magnitude (it has already
+    fused across op boundaries, so per-op truth doesn't exist post-
+    compile).
+
+    Returns rows sorted by flops share:
+    ``{"layer", "ops", "flops", "bytes", "flops_frac", "flops_est",
+    "bytes_est"}``.
+    """
+    from ..graph.node import PlaceholderOp, VariableOp, find_topo_sort
+    from ..profiler import estimate_flops, shape_map, tensor_bytes
+
+    eval_nodes = list(eval_nodes)
+    shapes = shape_map(eval_nodes, feed_shapes)
+    topo = find_topo_sort(eval_nodes)
+    layer = {}
+    for node in topo:
+        if isinstance(node, VariableOp):
+            layer[node] = layer_of(node.name)
+        elif isinstance(node, PlaceholderOp):
+            layer[node] = None
+        else:
+            found = None
+            for inp in node.inputs:         # params define the layer
+                if isinstance(inp, VariableOp):
+                    found = layer.get(inp)
+                    break
+            if found is None:
+                for inp in node.inputs:     # else inherit the producer
+                    if layer.get(inp):
+                        found = layer[inp]
+                        break
+            layer[node] = found
+    groups = {}
+    for node in topo:
+        if isinstance(node, (PlaceholderOp, VariableOp)):
+            continue
+        flops = float(estimate_flops(node, shapes))
+        nbytes = float(sum(tensor_bytes(shapes.get(i))
+                           for i in node.inputs)
+                       + tensor_bytes(shapes.get(node)))
+        key = layer.get(node) or "(unattributed)"
+        row = groups.setdefault(key, {"layer": key, "ops": 0,
+                                      "flops_est": 0.0, "bytes_est": 0.0})
+        row["ops"] += 1
+        row["flops_est"] += flops
+        row["bytes_est"] += nbytes
+    est_f = sum(r["flops_est"] for r in groups.values())
+    est_b = sum(r["bytes_est"] for r in groups.values())
+    tot_f = float((totals or {}).get("flops", 0.0) or 0.0)
+    tot_b = float((totals or {}).get("bytes accessed", 0.0) or 0.0)
+    scale_f = (tot_f / est_f) if tot_f > 0 and est_f > 0 else 1.0
+    scale_b = (tot_b / est_b) if tot_b > 0 and est_b > 0 else 1.0
+    rows = []
+    for r in groups.values():
+        rows.append({"layer": r["layer"], "ops": r["ops"],
+                     "flops": round(r["flops_est"] * scale_f, 2),
+                     "bytes": round(r["bytes_est"] * scale_b, 2),
+                     "flops_frac": round(r["flops_est"] / est_f, 6)
+                     if est_f > 0 else 0.0,
+                     "flops_est": round(r["flops_est"], 2),
+                     "bytes_est": round(r["bytes_est"], 2)})
+    rows.sort(key=lambda r: -r["flops"])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# the profiler
+
+
+class ProgramProfiler:
+    """Registry of :meth:`capture`-d program profiles + derived signals.
+
+    Pull-based: owners (bench ``--profile``, tests, a notebook) capture
+    explicitly; nothing instruments the step hot paths.  All state is
+    JSON-safe and served live via ``telemetry.report()["profile"]`` and
+    the ``/profile`` debug endpoint."""
+
+    def __init__(self, registry=None, ledger=None):
+        self._registry = registry
+        self._ledger = ledger
+        self._lock = threading.Lock()
+        self._profiles = {}             # name -> profile dict
+        self._workspace = {}            # name -> ledger handle
+        self._peaks = None
+        self._m = None
+
+    def _metrics(self):
+        """The hetu_profile_* instrument set (lazy; None w/o registry).
+        Plain-name wrapper calls keep these visible to the METRICS.md
+        drift gate's AST scanner."""
+        reg = self._registry
+        if reg is None:
+            return None
+        if self._m is None:
+            def _m(kind, name, help, labels):
+                return getattr(reg, kind)(name, help, labels=labels)
+
+            self._m = {
+                "captures": _m(
+                    "counter", "hetu_profile_captures_total",
+                    "Program profiles captured, by program kind",
+                    ("kind",)),
+                "flops": _m(
+                    "gauge", "hetu_profile_flops_per_step",
+                    "XLA cost-model flops per execution of the "
+                    "profiled program", ("program",)),
+                "bytes": _m(
+                    "gauge", "hetu_profile_bytes_per_step",
+                    "XLA cost-model HBM bytes accessed per execution "
+                    "of the profiled program", ("program",)),
+                "mfu": _m(
+                    "gauge", "hetu_profile_mfu",
+                    "Model flops utilization of the profiled program "
+                    "(achieved / peak flops)", ("program",)),
+                "ai": _m(
+                    "gauge", "hetu_profile_arithmetic_intensity",
+                    "Flops per HBM byte accessed (roofline x-axis)",
+                    ("program",)),
+                "items": _m(
+                    "gauge", "hetu_profile_items_per_sec_per_chip",
+                    "Serving throughput of the profiled program "
+                    "(tokens/rows per second per chip)", ("program",)),
+            }
+        return self._m
+
+    def peaks(self):
+        """The chip peak table (cached after first sniff)."""
+        if self._peaks is None:
+            self._peaks = perf_model.chip_peaks()
+        return self._peaks
+
+    # -- capture -----------------------------------------------------------
+    def capture(self, name, compiled=None, *, kind="program", cost=None,
+                memory=None, eval_nodes=None, feed_shapes=None):
+        """Profile one compiled program.
+
+        ``compiled`` is an XLA compiled object (``jitted.lower(...)
+        .compile()``, ``SubExecutor.lower_compiled()``) analyzed through
+        the :mod:`~hetu_tpu.platform` compat helpers; pre-normalized
+        ``cost``/``memory`` dicts may be passed instead (tests, remote
+        rounds).  ``eval_nodes`` (+ optional ``feed_shapes``) adds the
+        per-layer attribution table.  Re-capturing a name replaces its
+        profile (and its workspace ledger entry)."""
+        from ..platform import (compiled_cost_analysis,
+                                compiled_memory_analysis)
+        if compiled is not None:
+            cost = compiled_cost_analysis(compiled) if cost is None \
+                else cost
+            memory = compiled_memory_analysis(compiled) if memory is None \
+                else memory
+        cost = dict(cost or {})
+        memory = dict(memory or {})
+        layers = (attribute_graph(eval_nodes, feed_shapes, totals=cost)
+                  if eval_nodes is not None else None)
+        profile = {"name": str(name), "kind": str(kind),
+                   "cost": {k: cost[k] for k in
+                            ("flops", "bytes accessed", "transcendentals")
+                            if k in cost},
+                   "memory": memory,
+                   "layers": layers,
+                   "derived": perf_model.derive(cost, peaks=self.peaks())}
+        with self._lock:
+            self._profiles[str(name)] = profile
+        temp = int(memory.get("temp_size_in_bytes", 0) or 0)
+        if self._ledger is not None:
+            self._workspace[str(name)] = self._ledger.replace(
+                self._workspace.get(str(name)), "workspace", temp,
+                owner=f"program:{name}")
+        m = self._metrics()
+        if m is not None:
+            m["captures"].labels(kind=str(kind)).inc()
+            m["flops"].labels(program=str(name)).set(
+                float(cost.get("flops", 0.0) or 0.0))
+            m["bytes"].labels(program=str(name)).set(
+                float(cost.get("bytes accessed", 0.0) or 0.0))
+        return profile
+
+    def observe(self, name, steps=None, elapsed_s=None, tokens=None,
+                items_name="tokens", n_chips=1):
+        """Fold a MEASURED execution window into ``name``'s profile:
+        ``steps`` executions over ``elapsed_s`` seconds (plus an
+        optional item count for serving throughput) turn the static
+        cost into MFU / roofline / achieved-bytes signals."""
+        with self._lock:
+            profile = self._profiles.get(str(name))
+        if profile is None:
+            raise KeyError(f"no captured profile named {name!r}")
+        cost = dict(profile["cost"])
+        profile["derived"] = perf_model.derive(
+            cost, steps=steps, elapsed_s=elapsed_s, peaks=self.peaks(),
+            n_chips=n_chips, tokens=tokens, items_name=items_name)
+        d = profile["derived"]
+        m = self._metrics()
+        if m is not None:
+            if "mfu" in d:
+                m["mfu"].labels(program=str(name)).set(d["mfu"])
+            ai = d["roofline"].get("arithmetic_intensity")
+            if ai is not None:
+                m["ai"].labels(program=str(name)).set(ai)
+            key = f"{items_name}_per_sec_per_chip"
+            if key in d:
+                m["items"].labels(program=str(name)).set(d[key])
+        return profile
+
+    def attach_trace(self, name, trace_dir):
+        """Attach MEASURED per-op device aggregates from a
+        ``jax.profiler.trace`` capture dir to ``name``'s profile.  Uses
+        :func:`~hetu_tpu.timeline.trace_aggregates` — on captures with
+        a device-plane "XLA Ops" lane only those ops aggregate, so the
+        measured table matches the cost model's device-side scope."""
+        from ..timeline import trace_aggregates
+        agg = trace_aggregates(trace_dir)
+        with self._lock:
+            profile = self._profiles.get(str(name))
+        if profile is None:
+            raise KeyError(f"no captured profile named {name!r}")
+        profile["measured_ops"] = agg
+        return agg
+
+    # -- views -------------------------------------------------------------
+    def profile(self, name):
+        with self._lock:
+            return self._profiles.get(str(name))
+
+    def profiles(self):
+        with self._lock:
+            return dict(self._profiles)
+
+    def layer_table(self):
+        """The per-layer cost table across every captured program:
+        ``{"program", "layer", "flops", "bytes", "flops_frac", "ops"}``
+        rows, heaviest first."""
+        rows = []
+        with self._lock:
+            profs = list(self._profiles.values())
+        for p in profs:
+            for r in (p["layers"] or ()):
+                rows.append({"program": p["name"], "layer": r["layer"],
+                             "flops": r["flops"], "bytes": r["bytes"],
+                             "flops_frac": r["flops_frac"],
+                             "ops": r["ops"]})
+        rows.sort(key=lambda r: -r["flops"])
+        return rows
+
+    def report_block(self):
+        """The ``profile`` block of ``telemetry.report()`` (also the
+        ``/profile`` debug endpoint): every program's cost/memory/
+        derived signals, the cross-program layer table, the chip peaks,
+        and the HBM ledger snapshot."""
+        with self._lock:
+            programs = {n: dict(p) for n, p in self._profiles.items()}
+        out = {"programs": programs,
+               "layer_table": self.layer_table(),
+               "peaks": self.peaks() if programs else None}
+        if self._ledger is not None:
+            out["hbm"] = self._ledger.snapshot()
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._profiles = {}
+            workspace, self._workspace = self._workspace, {}
+        for handle in workspace.values():
+            handle.free()
+        self._peaks = None

@@ -421,6 +421,164 @@ def test_stage_telemetry_emission():
             "device_and_wait"} <= set(phases["phases"])
 
 
+def _profile_env(tmp_path, slowdown=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_PROFILE_JSON"] = str(tmp_path / "profile.json")
+    env["HETU_PERF_HISTORY"] = str(tmp_path / "history.jsonl")
+    if slowdown is not None:
+        env["HETU_PROFILE_SLOWDOWN_S"] = str(slowdown)
+    return env
+
+
+def _run_profile_round(tmp_path, slowdown=None):
+    proc = subprocess.run([sys.executable, BENCH, "--profile", "--quick"],
+                          capture_output=True, text=True, timeout=600,
+                          env=_profile_env(tmp_path, slowdown))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
+def test_profile_emits_full_detail_history_and_compact(tmp_path):
+    """`--profile --quick` must end in a compact parseable line with the
+    per-stage ``pf`` block, write PROFILE_FULL.json with per-layer
+    attribution + MFU + the flat signal dict, and append one entry to
+    benchmarks/history.jsonl — the perf_diff feed."""
+    proc = _run_profile_round(tmp_path)
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    compact = json.loads(lines[-1])
+    assert len(lines[-1].encode()) <= 1500, \
+        "compact profile line must fit the driver's stdout tail"
+    assert compact["metric"] == "profile_train_mfu"
+    assert compact["value"] > 0
+    assert set(compact["pf"]) >= {"train", "serve", "embed", "hbm_kib"}
+    assert compact["pf"]["train"]["mfu"] == compact["value"]
+    assert compact["pf"]["serve"]["tok_s"] > 0
+    assert compact["pf"]["embed"]["rows_s"] > 0
+    assert compact["pf"]["hbm_kib"].get("kv_cache", 0) > 0
+    with open(tmp_path / "profile.json") as f:
+        full = json.load(f)
+    assert json.loads(lines[-2]) == full
+    assert set(full["stages"]) == {"train", "serve", "embed"}
+    # per-layer attribution: the W&D train step's layers, fracs ~1
+    layers = {r["layer"] for r in full["stages"]["train"]["layers"]}
+    assert any("deep" in l for l in layers)
+    assert sum(r["flops_frac"]
+               for r in full["stages"]["train"]["layers"]) == \
+        pytest.approx(1.0, abs=1e-3)
+    assert all(r["program"] == "train_step"
+               for r in full["layer_table"])
+    # the flat signal dict carries every program's static + measured side
+    sig = full["signals"]
+    for name in ("train_step.flops_per_step", "train_step.mfu",
+                 "serve_decode.tokens_per_sec_per_chip",
+                 "embed_score.rows_per_sec_per_chip",
+                 "hbm.kv_cache_bytes"):
+        assert name in sig and sig[name] > 0, name
+    # ledger invariant in the committed evidence: pool totals == sum of
+    # the live tracked buffers, and everything drained by round end
+    for st in full["stages"].values():
+        hbm = st["hbm"]
+        assert sum(hbm["pools"].values()) == hbm["total_bytes"]
+        assert hbm["total_bytes"] == sum(b["nbytes"]
+                                         for b in hbm["buffers"])
+    assert full["hbm_final"]["pools"]["kv_cache"] == 0
+    assert full["hbm_final"]["pools"]["hot_cache"] == 0
+    # one history entry, same signals
+    with open(tmp_path / "history.jsonl") as f:
+        entries = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(entries) == 1
+    assert entries[0]["signals"] == sig
+
+
+def test_profile_aborted_run_preserves_prior_detail_file(tmp_path):
+    """PROFILE_FULL.json follows the BENCH_FULL.json contract: written
+    only once the round has real results, so a run killed during the
+    jax import / first compile leaves the committed evidence intact."""
+    detail = tmp_path / "profile.json"
+    sentinel = {"metric": "profile_train_mfu", "value": 0.42}
+    detail.write_text(json.dumps(sentinel))
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--profile", "--quick"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=_profile_env(tmp_path), start_new_session=True)
+    try:
+        import time
+        time.sleep(1.0)        # inside jax import / train-step compile
+        os.killpg(os.getpgid(proc.pid), 9)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert json.loads(detail.read_text()) == sentinel
+    assert not (tmp_path / "history.jsonl").exists()
+
+
+@pytest.mark.slow
+def test_perf_diff_two_identical_rounds_and_degraded_round(tmp_path):
+    """The regression harness end-to-end: two identical `--profile`
+    rounds diff clean (rc 0, no regressions); a third round seeded
+    degraded via HETU_PROFILE_SLOWDOWN_S trips the throughput
+    tolerance (rc 1) while the static cost signals stay equal."""
+    diff = os.path.join(os.path.dirname(BENCH), "tools", "perf_diff.py")
+    _run_profile_round(tmp_path)
+    _run_profile_round(tmp_path)
+    base = [sys.executable, diff,
+            "--current", str(tmp_path / "profile.json"),
+            "--history", str(tmp_path / "history.jsonl")]
+    # round 2 is already appended: the baseline is entry -2
+    proc = subprocess.run(base + ["--history-index", "-2", "--json"],
+                          capture_output=True, text=True, timeout=60)
+    verdict = json.loads(proc.stdout)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    assert verdict["status"] == "ok" and verdict["regressions"] == 0
+    assert verdict["compared"] > 10
+    # degraded round: ~3x slower train steps, same compiled programs
+    _run_profile_round(tmp_path, slowdown=0.25)
+    proc = subprocess.run(base + ["--history-index", "-2", "--json"],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1, proc.stdout[-2000:]
+    verdict = json.loads(proc.stdout)
+    assert verdict["status"] == "regressed"
+    bad = {r["signal"]: r for r in verdict["table"] if r["regressed"]}
+    assert any(s.startswith("train_step.") for s in bad)
+    assert all(r["kind"] == "throughput" for r in bad.values())
+    static = [r for r in verdict["table"]
+              if r["signal"].endswith("flops_per_step")]
+    assert static and all(r["ratio"] == 1.0 for r in static)
+
+
+def test_perf_diff_static_growth_trips_and_no_baseline_passes(tmp_path):
+    """Unit-level perf_diff checks (no bench round): a static cost
+    signal growing past 1% trips rc 1 even when throughput holds; with
+    no baseline anywhere the gate passes rc 0 (first round)."""
+    diff = os.path.join(os.path.dirname(BENCH), "tools", "perf_diff.py")
+    base_doc = {"signals": {"train_step.flops_per_step": 1e9,
+                            "train_step.mfu": 0.05,
+                            "hbm.kv_cache_bytes": 4096}}
+    cur_doc = {"signals": {"train_step.flops_per_step": 1.05e9,
+                           "train_step.mfu": 0.05,
+                           "hbm.kv_cache_bytes": 4096}}
+    (tmp_path / "base.json").write_text(json.dumps(base_doc))
+    (tmp_path / "cur.json").write_text(json.dumps(cur_doc))
+    proc = subprocess.run(
+        [sys.executable, diff, "--current", str(tmp_path / "cur.json"),
+         "--baseline", str(tmp_path / "base.json"), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    verdict = json.loads(proc.stdout)
+    bad = [r for r in verdict["table"] if r["regressed"]]
+    assert [r["signal"] for r in bad] == ["train_step.flops_per_step"]
+    assert bad[0]["kind"] == "static"
+    # no baseline file, empty history -> explicit no_baseline pass
+    proc = subprocess.run(
+        [sys.executable, diff, "--current", str(tmp_path / "cur.json"),
+         "--history", str(tmp_path / "none.jsonl")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["status"] == "no_baseline"
+
+
 @pytest.mark.slow
 def test_one_stage_budget_preserves_finished_stage(tmp_path):
     """A budget that admits roughly one stage: the tail must carry that

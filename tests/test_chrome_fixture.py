@@ -165,5 +165,80 @@ def test_merged_doc_carries_device_host_and_rid_lanes(tmp_path):
     assert any("CPU" in n for n in lanes)
 
 
+def _install_synthetic_device_capture(tmp_path):
+    """A synthetic capture with a DEVICE plane: pid 100 is a
+    "/device:TPU:0" process whose "XLA Ops" lane carries the fused-op
+    executions, next to a host plane with python frames — the shape a
+    real TPU ``jax.profiler.trace`` writes, which the CPU fixture above
+    cannot exercise (``trace_aggregates`` must keep ONLY the device
+    lane there)."""
+    doc = {"displayTimeUnit": "ns", "metadata": {"highres-ticks": True},
+           "traceEvents": [
+               {"ph": "M", "pid": 100, "name": "process_name",
+                "args": {"name": "/device:TPU:0"}},
+               {"ph": "M", "pid": 100, "tid": 1, "name": "thread_name",
+                "args": {"name": "XLA Ops"}},
+               {"ph": "M", "pid": 100, "tid": 2, "name": "thread_name",
+                "args": {"name": "XLA Modules"}},
+               {"ph": "M", "pid": 1, "name": "process_name",
+                "args": {"name": "/host:CPU"}},
+               {"ph": "M", "pid": 1, "tid": 7, "name": "thread_name",
+                "args": {"name": "python"}},
+               # device XLA Ops lane: 2 fusions + 1 dot + 1 copy
+               {"ph": "X", "pid": 100, "tid": 1, "name": "fusion.1",
+                "ts": 10.0, "dur": 100.0},
+               {"ph": "X", "pid": 100, "tid": 1, "name": "fusion.1",
+                "ts": 150.0, "dur": 60.0},
+               {"ph": "X", "pid": 100, "tid": 1, "name": "dot.2",
+                "ts": 250.0, "dur": 300.0},
+               {"ph": "X", "pid": 100, "tid": 1, "name": "copy.3",
+                "ts": 600.0, "dur": 40.0},
+               # a device lane that is NOT XLA Ops (module envelope)
+               {"ph": "X", "pid": 100, "tid": 2, "name": "jit_step",
+                "ts": 5.0, "dur": 700.0},
+               # host lane: dispatch work + a python tracer frame
+               {"ph": "X", "pid": 1, "tid": 7, "name": "ExecuteSharded",
+                "ts": 0.0, "dur": 900.0},
+               {"ph": "X", "pid": 1, "tid": 7, "name": "$bench.py:12 f",
+                "ts": 1.0, "dur": 5.0},
+           ]}
+    d = tmp_path / "devcap" / "plugins" / "profile" / "0001"
+    d.mkdir(parents=True)
+    with gzip.open(d / "dev.trace.json.gz", "wt") as f:
+        json.dump(doc, f)
+    return str(tmp_path / "devcap")
+
+
+def test_synthetic_xla_ops_lane_aggregates_device_only(tmp_path):
+    cap = _install_synthetic_device_capture(tmp_path)
+    agg = trace_aggregates(cap)
+    # only the XLA Ops lane aggregates: no module envelope, no host
+    # dispatch, no python frames
+    assert set(agg) == {"fusion.1", "dot.2", "copy.3"}
+    assert agg["fusion.1"]["count"] == 2
+    assert agg["fusion.1"]["total_us"] == pytest.approx(160.0)
+    assert agg["dot.2"]["total_us"] == pytest.approx(300.0)
+    # pct is over the device-op total only (500us), not the host lanes
+    assert agg["dot.2"]["pct"] == pytest.approx(60.0)
+    # forcing the host view back on still works
+    host = trace_aggregates(cap, device_ops_only=False)
+    assert "ExecuteSharded" in host and "jit_step" in host
+
+
+def test_profiler_attach_trace_matches_trace_aggregates(tmp_path):
+    """ProgramProfiler.attach_trace goes through trace_aggregates: the
+    measured_ops table on the profile must equal the direct call
+    row-for-row on the synthetic device capture."""
+    from hetu_tpu.telemetry.profiling import ProgramProfiler
+    cap = _install_synthetic_device_capture(tmp_path)
+    prof = ProgramProfiler()
+    prof.capture("dev_prog", cost={"flops": 1e6, "bytes accessed": 1e5})
+    agg = prof.attach_trace("dev_prog", cap)
+    assert agg == trace_aggregates(cap)
+    assert prof.profile("dev_prog")["measured_ops"] == agg
+    with pytest.raises(KeyError):
+        prof.attach_trace("never_captured", cap)
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

@@ -1,0 +1,200 @@
+#!/usr/bin/env python3
+"""Perf-regression gate over bench.py --profile signal dicts.
+
+Compares the CURRENT round's flat ``signals`` block (PROFILE_FULL.json,
+or any JSON carrying a ``signals`` key) against a BASELINE — an explicit
+``--baseline`` file, or an entry of ``benchmarks/history.jsonl`` — with
+direction-aware per-signal tolerances:
+
+* throughput signals (``*.mfu``, ``*_per_sec*``): higher is better;
+  a regression is current < baseline * (1 - tol_throughput).  Wall-time
+  signals are noisy (CPU-quick rounds especially), so the default
+  tolerance is loose (25%).
+* static signals (``*.flops_per_step``, ``*.bytes_per_step``,
+  ``hbm.*_bytes``): lower is better and deterministic for one code
+  version + shape set, so the default tolerance is tight (1%) — a
+  compiled program quietly growing flops/bytes or a pool growing live
+  HBM is exactly what this gate exists to catch.
+
+Signals present on only one side are reported as notes, never failures
+(new programs appear, old ones retire).  Exit status: 0 when every
+shared signal is inside tolerance (or no baseline exists yet — first
+round), 1 when anything regressed.  Stdlib only.
+
+Typical use::
+
+    python bench.py --profile --quick
+    python tools/perf_diff.py                       # vs BASELINE.json
+    python tools/perf_diff.py --history-index -2    # vs previous round
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: signal-name fragments that mark a higher-is-better (throughput) signal
+THROUGHPUT_MARKERS = (".mfu", "_per_sec")
+
+
+def classify(name):
+    """'throughput' (higher is better) or 'static' (lower is better)."""
+    return ("throughput"
+            if any(m in name for m in THROUGHPUT_MARKERS) else "static")
+
+
+def extract_signals(doc):
+    """The flat {signal: value} dict from a PROFILE_FULL.json headline,
+    a history.jsonl entry, or an already-flat dict."""
+    if isinstance(doc, dict) and isinstance(doc.get("signals"), dict):
+        return doc["signals"]
+    if isinstance(doc, dict):
+        return {k: v for k, v in doc.items()
+                if isinstance(v, (int, float))}
+    raise SystemExit(f"unrecognized signals document: {type(doc)}")
+
+
+def load_json(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def load_history_entry(path, index):
+    entries = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    if not entries:
+        return None
+    try:
+        return entries[index]
+    except IndexError:
+        return None
+
+
+def diff_signals(current, baseline, tol_throughput, tol_static):
+    """Per-signal verdicts: [{signal, kind, current, baseline, ratio,
+    regressed}] for shared signals, plus the one-sided names."""
+    rows, only_current, only_baseline = [], [], []
+    for name in sorted(set(current) | set(baseline)):
+        if name not in baseline:
+            only_current.append(name)
+            continue
+        if name not in current:
+            only_baseline.append(name)
+            continue
+        cur, base = float(current[name]), float(baseline[name])
+        kind = classify(name)
+        if base == 0:
+            # a zero baseline can't scale a tolerance; only flag a
+            # static signal that became nonzero (new cost from nothing)
+            regressed = kind == "static" and cur > 0
+            ratio = None
+        elif kind == "throughput":
+            ratio = cur / base
+            regressed = ratio < 1.0 - tol_throughput
+        else:
+            ratio = cur / base
+            regressed = ratio > 1.0 + tol_static
+        rows.append({"signal": name, "kind": kind,
+                     "current": cur, "baseline": base,
+                     "ratio": None if ratio is None else round(ratio, 4),
+                     "regressed": bool(regressed)})
+    return rows, only_current, only_baseline
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="diff bench --profile signals against a baseline")
+    ap.add_argument("--current",
+                    default=os.path.join(REPO, "PROFILE_FULL.json"),
+                    help="current round (PROFILE_FULL.json)")
+    ap.add_argument("--baseline",
+                    default=None,
+                    help="explicit baseline JSON (default: "
+                         "benchmarks/BASELINE.json when present, else "
+                         "the --history entry)")
+    ap.add_argument("--history",
+                    default=None,
+                    help="history feed (bench --profile appends here; "
+                         "default benchmarks/history.jsonl).  Passing "
+                         "this explicitly makes the history entry the "
+                         "baseline even when a committed BASELINE.json "
+                         "exists")
+    ap.add_argument("--history-index", type=int, default=-1,
+                    help="which history entry is the baseline when no "
+                         "--baseline file is used (-1 = latest; use -2 "
+                         "when the current round is already appended)")
+    ap.add_argument("--tol-throughput", type=float, default=0.25,
+                    help="allowed fractional DROP of a throughput "
+                         "signal (default 0.25)")
+    ap.add_argument("--tol-static", type=float, default=0.01,
+                    help="allowed fractional GROWTH of a static "
+                         "cost/memory signal (default 0.01)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full verdict table as JSON")
+    args = ap.parse_args(argv)
+
+    current = extract_signals(load_json(args.current))
+    baseline_src = None
+    baseline = None
+    default_baseline = os.path.join(REPO, "benchmarks", "BASELINE.json")
+    history = args.history if args.history is not None else os.path.join(
+        REPO, "benchmarks", "history.jsonl")
+    # precedence: explicit --baseline > explicit --history > the
+    # committed BASELINE.json > the default history feed
+    if args.baseline:
+        baseline = extract_signals(load_json(args.baseline))
+        baseline_src = args.baseline
+    elif args.history is not None and os.path.exists(history):
+        entry = load_history_entry(history, args.history_index)
+        if entry is not None:
+            baseline = extract_signals(entry)
+            baseline_src = f"{history}[{args.history_index}]"
+    elif args.history is None and os.path.exists(default_baseline):
+        baseline = extract_signals(load_json(default_baseline))
+        baseline_src = default_baseline
+    elif args.history is None and os.path.exists(history):
+        entry = load_history_entry(history, args.history_index)
+        if entry is not None:
+            baseline = extract_signals(entry)
+            baseline_src = f"{history}[{args.history_index}]"
+    if baseline is None:
+        print(json.dumps({"status": "no_baseline",
+                          "note": "no baseline/history to diff against "
+                                  "— commit benchmarks/BASELINE.json or "
+                                  "run bench.py --profile twice",
+                          "signals": len(current)}))
+        return 0
+
+    rows, only_cur, only_base = diff_signals(
+        current, baseline, args.tol_throughput, args.tol_static)
+    regressions = [r for r in rows if r["regressed"]]
+    summary = {"status": "regressed" if regressions else "ok",
+               "baseline": baseline_src,
+               "compared": len(rows),
+               "regressions": len(regressions),
+               "tolerances": {"throughput": args.tol_throughput,
+                              "static": args.tol_static},
+               "new_signals": only_cur,
+               "missing_signals": only_base}
+    if args.json:
+        summary["table"] = rows
+        print(json.dumps(summary, indent=2))
+    else:
+        for r in regressions:
+            print(f"REGRESSION {r['signal']} ({r['kind']}): "
+                  f"{r['baseline']:.6g} -> {r['current']:.6g} "
+                  f"(ratio {r['ratio']})")
+        print(json.dumps(summary))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
