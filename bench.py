@@ -1720,6 +1720,286 @@ def _emit_profile(out, history_path=None):
     _print_compact(compact, drop_order=("history",))
 
 
+# -- SLO control-plane mode (bench.py --slo) -------------------------------
+# The ISSUE 11 evidence: a seeded bursty "diurnal" arrival trace driven
+# through a FleetController-supervised fleet and through its static
+# single-replica twin, on a shared VIRTUAL clock (one fixed quantum per
+# pump iteration), so deadlines, EWMAs, cooldowns and the admission
+# estimates are exact functions of the seed — no CPU wall-clock noise.
+# Headline: SLO attainment (healthy finishes / offered work).  The
+# acceptance gates ride along: controller beats the twin on
+# deadline-miss rate, zero accepted-rid loss, every scale/degrade
+# transition visible as incident + metric, admission sheds typed
+# SLOReject before taking a slot.
+
+SLO_DETAIL_PATH = os.environ.get(
+    "HETU_SLO_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "SLO_FULL.json"))
+
+_SLO_EKW = dict(n_slots=2, max_len=32, max_prompt_len=8, name="serve")
+_SLO_DT = 0.05        # virtual seconds per pump iteration
+
+
+class _IterClock:
+    """Deterministic virtual clock for the SLO round: the loop advances
+    it one quantum per iteration; everything time-based downstream
+    (deadlines, EWMAs, breaker backoff, controller cooldowns) sees the
+    same seeded timeline on every run."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _slo_trace(seed, vocab, quick):
+    """Bursty diurnal arrivals in ITERATION time: calm warmup, a heavy
+    "peak hour" burst, a pathological spike, and a recovery tail.
+    ~10% of requests carry no deadline (brownout shed fodder) and ~8%
+    are DOOMED — deadlines shorter than their own decode time, which
+    no capacity can meet; they are the predictive-admission witnesses
+    (the static twin admits-then-expires them)."""
+    rng = np.random.default_rng(seed)
+    phases = [(8, 4.0),                     # warmup: under capacity
+              (36 if quick else 72, 0.4),   # burst: ~8x one replica
+              (40 if quick else 80, 0.05),  # spike: ~60x one replica
+              (6, 4.0)]                     # recovery tail
+    out, it = [], 0.0
+    for phase, (n, gap) in enumerate(phases):
+        for _ in range(n):
+            it += float(rng.exponential(gap))
+            spec = {"arrival_it": it,
+                    "prompt": rng.integers(1, vocab,
+                                           (int(rng.integers(3, 8)),)),
+                    "max_new": int(rng.integers(4, 9)),
+                    "ttl": float(rng.uniform(3.0, 6.0)),
+                    "doomed": False}
+            u = float(rng.random())
+            if u < 0.10:
+                spec["ttl"] = None          # no-deadline traffic
+            elif u < 0.18 and phase in (1, 2):
+                spec["ttl"] = 0.3           # < its own decode time
+                spec["max_new"] = 8
+                spec["doomed"] = True
+            out.append(spec)
+    return out
+
+
+def _slo_run(ex, model, c, trace, controlled, seed):
+    """Replay the trace through one fleet — controller-supervised or
+    static — on a fresh virtual clock.  Returns per-run evidence."""
+    import warnings
+    from hetu_tpu.serving import (EngineFleet, EngineOverloaded,
+                                  FleetController, FleetUnavailable,
+                                  SLO, SLOReject, TERMINAL_OK)
+
+    clk = _IterClock()
+    fleet = EngineFleet(
+        ex, model, n_engines=1, engine_kwargs=_SLO_EKW,
+        threaded=False, clock=clk,
+        name="ctl" if controlled else "static",
+        replica_prefix="c" if controlled else "s")
+    ctl = None
+    if controlled:
+        ctl = FleetController(
+            fleet,
+            SLO(deadline_miss_target=0.05, ttft_p99_s=1.5,
+                max_shed_fraction=0.6),
+            min_engines=1, max_engines=3,
+            scale_up_queue=3.0, scale_down_queue=0.5,
+            cooldown_s=1.5, degrade_enter_ticks=20,
+            degrade_exit_ticks=40, brownout_max_new=4)
+    accepted, sheds, overloaded = [], [], 0
+    i, it, capped_at = 0, 0, 20000
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        while (i < len(trace) or not fleet.idle) and it < capped_at:
+            while i < len(trace) and trace[i]["arrival_it"] <= it:
+                spec = trace[i]
+                i += 1
+                try:
+                    target = ctl if ctl is not None else fleet
+                    freq = target.submit(spec["prompt"],
+                                         spec["max_new"],
+                                         ttl=spec["ttl"])
+                    accepted.append((spec, freq))
+                except SLOReject as e:
+                    sheds.append((spec, e))
+                except (EngineOverloaded, FleetUnavailable):
+                    overloaded += 1
+            fleet.pump()
+            if ctl is not None:
+                ctl.tick()
+            clk.advance(_SLO_DT)
+            it += 1
+        # post-trace cooldown window: the controller walks the ladder
+        # back down and scales in — the exit transitions are evidence
+        # too, not just the entries
+        if ctl is not None:
+            for _ in range(240):
+                fleet.pump()
+                ctl.tick()
+                clk.advance(_SLO_DT)
+                it += 1
+    drained = fleet.idle
+    fc = dict(fleet.finish_counts)
+    finished = sum(fc.values())
+    ok = sum(fc.get(r, 0) for r in TERMINAL_OK)
+    offered = len(trace)
+    shed = len(sheds)
+    miss_rate = fc.get("deadline", 0) / max(1, finished)
+    attainment = ok / max(1, offered)
+    # SLOReject typing: every shed is the typed exception, raised
+    # BEFORE the fleet assigned a rid or took a slot
+    typed = all(isinstance(e, SLOReject) and e.reason
+                for _, e in sheds)
+    doomed_shed = sum(1 for s, e in sheds
+                      if s["doomed"] and e.reason == "infeasible_deadline")
+    out = {"controlled": bool(controlled),
+           "offered": offered,
+           "accepted": len(accepted),
+           "shed": shed,
+           "overloaded": overloaded,
+           "finished": finished,
+           "finish_reasons": fc,
+           "all_accepted_terminal": all(r.finished
+                                        for _, r in accepted),
+           "deadline_miss_rate": round(miss_rate, 4),
+           "attainment": round(attainment, 4),
+           "sheds_typed": bool(typed),
+           "doomed_shed": doomed_shed,
+           "drained": bool(drained),
+           "iterations": it,
+           "virtual_s": round(clk.t, 2)}
+    if ctl is not None:
+        out["controller"] = ctl.report()
+        out["shed_reasons"] = _count_by(e.reason for _, e in sheds)
+    s = fleet.stats()
+    out["n_engines_final"] = s["n_engines"]
+    out["failovers"] = s["failovers"]
+    fleet.stop()
+    return out
+
+
+def _count_by(items):
+    out = {}
+    for x in items:
+        out[x] = out.get(x, 0) + 1
+    return out
+
+
+def run_slo(quick=False, seed=0):
+    """Controller fleet vs static twin on the same seeded bursty trace
+    (run sequentially in one process; rid prefixes keep their records
+    apart).  Asserts the ISSUE 11 acceptance gates inline."""
+    import jax
+    from hetu_tpu import telemetry
+
+    ex, model, c = _serve_build(True)   # tiny decode model: control
+    # decisions, not shapes, are the thing measured
+    trace = _slo_trace(seed, c.vocab_size, quick)
+    fl = telemetry.get_flight()
+    scale0 = fl.incident_count("slo_scale")
+    degrade0 = fl.incident_count("slo_degrade")
+    ctl_out = _slo_run(ex, model, c, trace, True, seed)
+    static_out = _slo_run(ex, model, c, trace, False, seed)
+    ctl = ctl_out["controller"]
+    transitions = {
+        "scale": ctl["counters"]["scale_ups"]
+        + ctl["counters"]["scale_downs"],
+        "degrade": ctl["counters"]["degrade_entries"]
+        + ctl["counters"]["degrade_exits"],
+        "scale_incidents": fl.incident_count("slo_scale") - scale0,
+        "degrade_incidents":
+            fl.incident_count("slo_degrade") - degrade0}
+    wins = (ctl_out["deadline_miss_rate"]
+            < static_out["deadline_miss_rate"]
+            and ctl_out["attainment"] > static_out["attainment"])
+    # acceptance gates (the protocol test re-checks them from stdout)
+    assert ctl_out["all_accepted_terminal"] \
+        and static_out["all_accepted_terminal"], "accepted-rid loss"
+    assert ctl_out["sheds_typed"], "untyped shed"
+    assert ctl_out["shed"] > 0 and ctl_out["doomed_shed"] > 0, \
+        "predictive admission never fired"
+    assert ctl["counters"]["scale_ups"] >= 1, "controller never scaled"
+    if fl.enabled:
+        assert transitions["scale_incidents"] == transitions["scale"], \
+            transitions
+        assert transitions["degrade_incidents"] == \
+            transitions["degrade"], transitions
+    assert wins, (ctl_out["deadline_miss_rate"],
+                  static_out["deadline_miss_rate"])
+    out = {"metric": "slo_attainment",
+           "value": ctl_out["attainment"],
+           "unit": "fraction",
+           "seed": seed,
+           "quick": bool(quick),
+           "platform": jax.default_backend(),
+           "slo": ctl["slo"],
+           "stages": {"controller": ctl_out, "static": static_out},
+           "controller_wins": bool(wins),
+           "transitions": transitions,
+           "signals": {
+               "slo_attainment": ctl_out["attainment"],
+               "shed_fraction": round(ctl["shed_fraction"], 4),
+               "slo_static_attainment": static_out["attainment"]}}
+    return out
+
+
+def _emit_slo(out, history_path=None):
+    """SLO evidence in the bench layered shape: full headline early +
+    SLO_FULL.json (no-clobber: written only after a real run), one
+    flat signals entry into benchmarks/history.jsonl (slo_attainment
+    is a higher-is-better one-sided signal for tools/perf_diff.py),
+    compact tail line under the byte budget."""
+    from hetu_tpu.telemetry import JsonlWriter
+    history_path = HISTORY_PATH if history_path is None else history_path
+    full = json.dumps(out)
+    try:
+        with open(SLO_DETAIL_PATH, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass
+    entry = {"t": round(time.time(), 3), "platform": out["platform"],
+             "quick": out["quick"], "seed": out["seed"],
+             "signals": out["signals"]}
+    try:
+        os.makedirs(os.path.dirname(history_path) or ".", exist_ok=True)
+        with JsonlWriter(history_path) as w:     # append, never truncate
+            w.write(entry)
+    except OSError:
+        pass
+    print(full, flush=True)
+    c, s = out["stages"]["controller"], out["stages"]["static"]
+    ctr = c["controller"]["counters"]
+    compact = {"metric": out["metric"], "value": out["value"],
+               "unit": out["unit"], "platform": out["platform"],
+               "wins": out["controller_wins"],
+               "miss": {"ctl": c["deadline_miss_rate"],
+                        "static": s["deadline_miss_rate"]},
+               "attain": {"ctl": c["attainment"],
+                          "static": s["attainment"]},
+               "shed": {"n": c["shed"],
+                        "frac": c["controller"]["shed_fraction"],
+                        "doomed": c["doomed_shed"]},
+               "scale": {"up": ctr["scale_ups"],
+                         "down": ctr["scale_downs"],
+                         "final": c["n_engines_final"]},
+               "degrade": {"in": ctr["degrade_entries"],
+                           "out": ctr["degrade_exits"],
+                           "max": ctr["max_level_seen"]},
+               "rid_audit": "ok",
+               "history": os.path.basename(history_path),
+               "detail": os.path.basename(SLO_DETAIL_PATH)}
+    _print_compact(compact, drop_order=("history", "rid_audit",
+                                        "degrade", "scale"))
+
+
 # -- chaos-serve mode (bench.py --chaos --serve) ---------------------------
 # Serving-side resilience evidence: inject every serving fault class
 # (poisoned decode, raising step, slot leak, stalled/raising consumer,
@@ -2292,6 +2572,73 @@ def _chaos_fleet_burst_failover(ex, model, c, seed, quick):
             "failovers": s["failovers"], **detail}
 
 
+def _chaos_fleet_slo_controller(ex, model, c, seed):
+    """Replica crash under the SLO controller, mid-burst: predictive
+    admission sheds provably-infeasible work with a typed SLOReject
+    BEFORE it takes a slot, the controller scales up through the same
+    supervised machinery the crash exercises, and every ACCEPTED rid
+    still reaches a terminal finish — the control plane never costs
+    correctness."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import (EngineFleet, FleetController, SLO,
+                                  SLOReject)
+
+    rng = np.random.default_rng(seed)
+    clk = _IterClock()
+    fleet = EngineFleet(ex, model, n_engines=1, engine_kwargs=_SLO_EKW,
+                        threaded=False, clock=clk, breaker_base=1e-4,
+                        name="chaos_slo", replica_prefix="k")
+    ctl = FleetController(fleet, SLO(deadline_miss_target=0.05),
+                          min_engines=1, max_engines=3,
+                          scale_up_queue=2.0, cooldown_s=0.5)
+    prompts = _chaos_serve_prompts(rng, 16, c.vocab_size)
+    reqs, doomed, sheds = [], [], []
+    crashed = False
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for it in range(1200):
+            if it < len(prompts):
+                # one arrival per iteration: a burst one replica can't
+                # absorb, plus two DOOMED deadlines once the cost
+                # model has at least one finished request to learn from
+                is_doomed = it in (11, 13)
+                try:
+                    freq = ctl.submit(prompts[it], 8,
+                                      ttl=0.01 if is_doomed else 30.0)
+                    (doomed if is_doomed else reqs).append(freq)
+                except SLOReject as e:
+                    sheds.append(e)
+            fleet.pump()
+            ctl.tick()
+            clk.advance(_SLO_DT)
+            if not crashed and ctl.scale_ups >= 1 \
+                    and it >= len(prompts):
+                victim = max(fleet._replicas,
+                             key=lambda r: len(r.inflight))
+                if victim.engine is not None:
+                    faults.crash_engine(victim.engine)
+                    crashed = True
+            if crashed and it > len(prompts) + 10 and fleet.idle:
+                break
+    ok, detail = _fleet_checks(fleet, reqs)
+    # a doomed request that slipped past admission must still reach a
+    # TERMINAL state (deadline) — shed-vs-expire changes efficiency,
+    # never bookkeeping
+    doomed_terminal = all(r.finished for r in doomed)
+    recovered = (ok and crashed and doomed_terminal
+                 and ctl.scale_ups >= 1 and len(sheds) >= 1
+                 and all(isinstance(e, SLOReject) for e in sheds))
+    fleet.stop()
+    ctl.stop()
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "crashed_replica": crashed,
+            "scale_ups": ctl.scale_ups,
+            "admission_sheds": len(sheds),
+            "doomed_admitted": len(doomed),
+            "accepted": len(reqs) + len(doomed), **detail}
+
+
 def run_chaos_fleet(quick=False, seed=0):
     import jax
 
@@ -2308,6 +2655,8 @@ def run_chaos_fleet(quick=False, seed=0):
                                         ex, model, c, seed)
     stages["burst_failover"] = _staged(_chaos_fleet_burst_failover, ex,
                                        model, c, seed, quick)
+    stages["slo_controller"] = _staged(_chaos_fleet_slo_controller, ex,
+                                       model, c, seed)
     out = {"metric": "chaos_fleet_resilience",
            "value": sum(s["faults_recovered"] for s in stages.values()),
            "unit": "faults_recovered",
@@ -2548,6 +2897,23 @@ def main():
         out = run_profile(quick)
         out["telemetry"] = _telemetry_report()
         _emit_profile(out)
+        return
+    if "--slo" in sys.argv:
+        # SLO control-plane mode runs in-process: the seeded bursty
+        # diurnal trace through a FleetController-supervised fleet vs
+        # its static twin, on a shared virtual clock.  Telemetry is on
+        # unconditionally — the incident + rid-audit evidence IS the
+        # acceptance criterion.
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        quick = quick or jax.default_backend() == "cpu"
+        _telemetry_on()
+        out = run_slo(quick)
+        out["telemetry"] = _telemetry_report()
+        _assert_rid_audit(out["telemetry"])
+        _emit_slo(out)
         return
     if "--serve-embed" in sys.argv:
         # embedding-serve mode runs in-process (host tables + a tiny

@@ -22,6 +22,13 @@ cache over the PS table tier, packed-lookup scoring, and
 ``EngineFleet(engine_factory=EmbeddingServer)`` for cluster routing.
 ``bench.py --serve-embed`` replays a seeded Zipfian key trace against
 an uncached host-tier twin.
+
+Above the fleet sits the SLO control plane (control.py): a declared
+:class:`~.control.SLO` plus a :class:`~.control.FleetController` that
+autoscales replicas, sheds provably-infeasible work at admission with a
+typed :class:`~.control.SLOReject`, and walks a staged brownout ladder
+under sustained violation.  ``bench.py --slo`` replays a bursty diurnal
+trace through a controlled fleet vs its static twin.
 """
 
 from .kv_cache import SlotKVCache
@@ -32,6 +39,8 @@ from .engine import InferenceEngine
 from .health import (CircuitBreaker, ReplicaHealth, HEALTH_STATES,
                      HEALTH_STATE_CODES)
 from .fleet import EngineFleet, FleetRequest, FleetUnavailable
+from .control import (CostModel, DEGRADE_LEVELS, FleetController, SLO,
+                      SLOReject)
 from .embedding import (BatchSlotPool, DeviceHotRowCache, EmbedRequest,
                         EmbeddingServer, EMBED_BUCKETS)
 
@@ -40,6 +49,7 @@ __all__ = ["SlotKVCache", "Request", "Scheduler", "EngineOverloaded",
            "LlamaSlotAdapter", "GPTSlotAdapter", "adapter_for",
            "InferenceEngine", "CircuitBreaker", "ReplicaHealth",
            "HEALTH_STATES", "HEALTH_STATE_CODES", "EngineFleet",
-           "FleetRequest", "FleetUnavailable", "BatchSlotPool",
-           "DeviceHotRowCache", "EmbedRequest", "EmbeddingServer",
-           "EMBED_BUCKETS"]
+           "FleetRequest", "FleetUnavailable", "CostModel",
+           "DEGRADE_LEVELS", "FleetController", "SLO", "SLOReject",
+           "BatchSlotPool", "DeviceHotRowCache", "EmbedRequest",
+           "EmbeddingServer", "EMBED_BUCKETS"]

@@ -1,0 +1,694 @@
+"""SLO-driven control plane: the fleet that operates itself.
+
+PRs 4/9/10 gave the runtime eyes — queue depth, TPOT EWMAs, per-rid
+timelines, per-program cost capture, incident counts — but nothing
+ACTED on those signals: a bursty trace still rode FIFO-then-expire into
+deadline misses while idle capacity sat undispatched.
+:class:`FleetController` closes the loop.  It supervises one
+:class:`~.fleet.EngineFleet` against a declared :class:`SLO` with three
+actuators, all built on existing machinery:
+
+* **autoscaling** — spawn (:meth:`~.fleet.EngineFleet.add_replica`) and
+  drain (:meth:`~.fleet.EngineFleet.remove_replica`, the PR 6 drain
+  path) replicas, driven by queue-depth and deadline-miss-rate EWMAs
+  with hysteresis (separate up/down thresholds) and a cooldown so
+  breaker flaps don't thrash scale.  Scale-down is two-phase and never
+  blocks a tick: drain first, remove once drained — zero accepted-rid
+  loss by construction.
+* **predictive admission** — estimate each request's cost at
+  ``submit()`` from measured signals (per-token decode cost ×
+  ``max_new`` + bucketed prefill cost + queue wait at the best replica)
+  and shed work that provably cannot meet its deadline at current load
+  with a typed :class:`SLOReject` carrying the estimate, instead of
+  admitting-then-expiring.  The estimator only rejects on EVIDENCE: with
+  no measured decode cost yet, everything is admitted.
+* **brownout degradation** — a staged degrade ladder
+  (``normal → cap_max_new → shed_no_deadline → essential_only``)
+  entered on sustained SLO violation once scale is exhausted and exited
+  on sustained recovery.  ``essential_only`` rejects all external
+  submits; failover/replay traffic re-homes through the fleet's
+  internal ``_place`` path and is never throttled.  Every scale or
+  degrade transition is recorded as a flight-recorder incident
+  (``slo_scale`` / ``slo_degrade``) and a ``hetu_slo_*`` metric.
+
+The controller is clock-injectable (defaults to the fleet's clock) and
+drives the same way the fleet does: call :meth:`FleetController.tick`
+after each ``pump()`` in manual mode, or :meth:`start` a supervisor
+thread next to a threaded fleet.  ``telemetry.enable(debug=True)``
+mounts :func:`slo_report` at ``/slo``.  The bench story is
+``bench.py --slo``: a seeded bursty diurnal trace through a controlled
+fleet vs its static twin, SLO attainment as the headline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+import weakref
+
+from .. import telemetry as _telemetry
+from .health import (DEGRADED, DISPATCHABLE, DRAINING, HEALTHY,
+                     QUARANTINED, STOPPED)
+from .scheduler import TERMINAL_OK
+
+#: the brownout ladder, mildest first; the level INDEXES this tuple
+DEGRADE_LEVELS = ("normal", "cap_max_new", "shed_no_deadline",
+                  "essential_only")
+
+#: controllers alive in this process, for the /slo debug endpoint
+_LIVE = weakref.WeakSet()
+
+
+class SLO:
+    """A declared serving objective the controller steers toward.
+
+    ``deadline_miss_target`` is the tolerated fraction of finished
+    requests retiring with ``finish_reason="deadline"`` (EWMA-smoothed).
+    ``ttft_p99_s`` / ``tpot_p99_s`` bound the worst replica's latency
+    EWMAs (None disables the bound).  ``max_shed_fraction`` caps the
+    VOLUNTARY shed rate: once the controller is shedding more than this
+    fraction of offered work it stops escalating the degrade ladder —
+    shedding harder cannot be the fix for an SLO that counts shed work
+    against attainment."""
+
+    def __init__(self, deadline_miss_target=0.05, ttft_p99_s=None,
+                 tpot_p99_s=None, max_shed_fraction=0.25):
+        if not 0.0 <= deadline_miss_target <= 1.0:
+            raise ValueError(
+                f"deadline_miss_target must be in [0, 1], got "
+                f"{deadline_miss_target}")
+        if not 0.0 <= max_shed_fraction <= 1.0:
+            raise ValueError(
+                f"max_shed_fraction must be in [0, 1], got "
+                f"{max_shed_fraction}")
+        for label, v in (("ttft_p99_s", ttft_p99_s),
+                         ("tpot_p99_s", tpot_p99_s)):
+            if v is not None and v <= 0:
+                raise ValueError(f"{label} must be > 0, got {v}")
+        self.deadline_miss_target = float(deadline_miss_target)
+        self.ttft_p99_s = None if ttft_p99_s is None else float(ttft_p99_s)
+        self.tpot_p99_s = None if tpot_p99_s is None else float(tpot_p99_s)
+        self.max_shed_fraction = float(max_shed_fraction)
+
+    def as_dict(self):
+        return {"deadline_miss_target": self.deadline_miss_target,
+                "ttft_p99_s": self.ttft_p99_s,
+                "tpot_p99_s": self.tpot_p99_s,
+                "max_shed_fraction": self.max_shed_fraction}
+
+    def __repr__(self):
+        return f"SLO({self.as_dict()!r})"
+
+
+class SLOReject(RuntimeError):
+    """A submit refused by the controller BEFORE taking a slot.
+
+    ``reason`` is one of ``"infeasible_deadline"`` (the predictive
+    estimate proves the deadline cannot be met at current load),
+    ``"no_deadline_brownout"`` (deadline-less traffic shed at degrade
+    level >= 2), or ``"essential_only"`` (level 3 rejects all external
+    work).  ``estimate`` carries the admission cost breakdown (seconds:
+    ``wait_s``/``prefill_s``/``decode_s``/``total_s``/``slack_s``) when
+    the rejection was estimate-driven, else None.  ``degrade_level``
+    is the ladder level at rejection time."""
+
+    def __init__(self, reason, estimate=None, degrade_level=0):
+        self.reason = str(reason)
+        self.estimate = estimate
+        self.degrade_level = int(degrade_level)
+        detail = ""
+        if estimate is not None:
+            detail = (f" (need {estimate['total_s']:.3f}s, have "
+                      f"{estimate['slack_s']:.3f}s)")
+        super().__init__(
+            f"shed by SLO controller: {self.reason}"
+            f"[level={DEGRADE_LEVELS[self.degrade_level]}]{detail}")
+
+
+class CostModel:
+    """Measured request-cost estimator for predictive admission.
+
+    ``decode_s`` is an EWMA of seconds per generated token, fed from the
+    fleet's per-replica TPOT EWMAs every tick (the best replica's —
+    admission must only shed work that cannot meet its deadline even on
+    the FASTEST path).  Prefill cost is bucketed by power-of-two prompt
+    length (measured ``ttft - queue_wait`` per finished request, the
+    PR 10 signal shape); an unseen bucket borrows the nearest measured
+    one, and with no prefill evidence at all one decode step stands in.
+    :meth:`prime` seeds ``decode_s`` from a
+    :class:`~..telemetry.profiling.ProgramProfiler` observed profile so
+    a controller can start warm from a prior ``--profile`` round.
+
+    The governing principle: estimates only ever REJECT work when built
+    on measurement — ``estimate()`` returns ``total_s=None`` (admit)
+    until a decode cost exists."""
+
+    def __init__(self, alpha=0.3):
+        self.alpha = float(alpha)
+        self.decode_s = None      # EWMA seconds / generated token
+        self.prefill_s = {}       # pow2 bucket -> EWMA seconds
+
+    @staticmethod
+    def bucket(prompt_len):
+        return max(1, int(prompt_len)).bit_length()
+
+    def _fold(self, old, sample):
+        s = float(sample)
+        return s if old is None else \
+            (1.0 - self.alpha) * old + self.alpha * s
+
+    def observe_decode(self, seconds):
+        if seconds is not None and seconds > 0:
+            self.decode_s = self._fold(self.decode_s, seconds)
+
+    def observe_prefill(self, prompt_len, seconds):
+        if seconds is None or seconds < 0:
+            return
+        b = self.bucket(prompt_len)
+        self.prefill_s[b] = self._fold(self.prefill_s.get(b), seconds)
+
+    def prefill_estimate(self, prompt_len):
+        """Measured bucket, else the nearest measured bucket (larger
+        preferred — conservative), else None."""
+        if not self.prefill_s:
+            return None
+        b = self.bucket(prompt_len)
+        if b in self.prefill_s:
+            return self.prefill_s[b]
+        near = min(self.prefill_s,
+                   key=lambda k: (abs(k - b), -k))
+        return self.prefill_s[near]
+
+    def prime(self, profiler, decode="serve_decode"):
+        """Seed ``decode_s`` from an OBSERVED program profile (one with
+        measured ``steps_per_sec`` in its derived block)."""
+        prof = profiler.profile(decode)
+        derived = (prof or {}).get("derived") or {}
+        sps = derived.get("steps_per_sec")
+        if sps:
+            self.observe_decode(1.0 / float(sps))
+        return self.decode_s
+
+    def as_dict(self):
+        return {"decode_s": self.decode_s,
+                "prefill_s": {f"2^{k}": v
+                              for k, v in sorted(self.prefill_s.items())},
+                "alpha": self.alpha}
+
+
+class FleetController:
+    """Feedback controller steering one EngineFleet toward its SLO.
+
+    Route external traffic through :meth:`submit` (predictive admission
+    + the degrade ladder) and call :meth:`tick` once per pump/interval
+    (sense → learn costs → scale → degrade).  ``min_engines`` /
+    ``max_engines`` bound autoscaling; ``scale_up_queue`` /
+    ``scale_down_queue`` are per-replica queue-depth thresholds with
+    hysteresis (down << up); ``cooldown_s`` spaces scale actions so a
+    breaker flap (quarantine → restart) cannot thrash scale;
+    ``degrade_enter_ticks`` / ``degrade_exit_ticks`` are the sustained
+    violation/recovery runs required to move the ladder.  All tunables
+    are documented in docs/SLO.md."""
+
+    def __init__(self, fleet, slo=None, *, clock=None, cost_model=None,
+                 min_engines=1, max_engines=4,
+                 scale_up_queue=4.0, scale_down_queue=0.5,
+                 cooldown_s=2.0, ewma_alpha=0.3,
+                 degrade_enter_ticks=10, degrade_exit_ticks=20,
+                 brownout_max_new=16, admission_margin=1.0):
+        if min_engines < 1:
+            raise ValueError(
+                f"min_engines must be >= 1, got {min_engines}")
+        if max_engines < min_engines:
+            raise ValueError(
+                f"max_engines={max_engines} < min_engines={min_engines}")
+        self.fleet = fleet
+        self.slo = slo if slo is not None else SLO()
+        self.name = fleet.name
+        self._clock = clock if clock is not None else fleet._clock
+        self.cost = cost_model if cost_model is not None else CostModel(
+            alpha=ewma_alpha)
+        self.min_engines = int(min_engines)
+        self.max_engines = int(max_engines)
+        self.scale_up_queue = float(scale_up_queue)
+        self.scale_down_queue = float(scale_down_queue)
+        self.cooldown_s = float(cooldown_s)
+        self.ewma_alpha = float(ewma_alpha)
+        self.degrade_enter_ticks = int(degrade_enter_ticks)
+        self.degrade_exit_ticks = int(degrade_exit_ticks)
+        self.brownout_max_new = int(brownout_max_new)
+        self.admission_margin = float(admission_margin)
+        # controller state
+        self.level = 0
+        self.queue_ewma = None
+        self.miss_ewma = None
+        self.ticks = 0
+        self.accepted = 0
+        self.shed = 0
+        self.capped = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.degrade_entries = 0
+        self.degrade_exits = 0
+        self.max_level_seen = 0
+        self._draining = set()
+        self._last_scale = None
+        self._last_fin = 0
+        self._last_miss = 0
+        self._viol_ticks = 0
+        self._ok_ticks = 0
+        self._viol_now = ()
+        self._depth = 0
+        self._rec_seen = {}       # (replica, incarnation) -> records idx
+        self._thread = None
+        self._running = False
+        reg = _telemetry.get_registry()
+
+        def _g(name, help):
+            return reg.gauge(name, help,
+                             labels=("controller",)).labels(
+                                 controller=self.name)
+
+        self._m_level = _g(
+            "hetu_slo_degrade_level",
+            "Brownout ladder level (0 normal, 1 cap_max_new, "
+            "2 shed_no_deadline, 3 essential_only)")
+        self._m_engines = _g(
+            "hetu_slo_engines",
+            "Live (non-draining) replicas under the controller")
+        self._m_miss = _g(
+            "hetu_slo_deadline_miss_ewma",
+            "EWMA fraction of finished requests that missed their "
+            "deadline")
+        self._m_queue = _g(
+            "hetu_slo_queue_depth_ewma",
+            "EWMA of fleet-wide queued + running requests")
+        self._m_shed_frac = _g(
+            "hetu_slo_shed_fraction",
+            "Fraction of offered requests shed by predictive admission "
+            "or brownout")
+        self._m_attain = _g(
+            "hetu_slo_attainment",
+            "Fraction of offered work (finished + shed) that completed "
+            "healthily (eos/max_new)")
+        self._m_scale = reg.counter(
+            "hetu_slo_scale_events_total",
+            "Autoscale actions taken by the controller",
+            labels=("controller", "direction"))
+        self._m_degrade = reg.counter(
+            "hetu_slo_degrade_transitions_total",
+            "Degrade-ladder transitions, by destination level",
+            labels=("controller", "to"))
+        self._m_rejects = reg.counter(
+            "hetu_slo_admission_rejects_total",
+            "Submits shed with SLOReject before taking a slot",
+            labels=("controller", "reason"))
+        self._fl = _telemetry.get_flight()
+        self._m_level.set(0)
+        self._m_engines.set(len(fleet._replicas))
+        _LIVE.add(self)
+
+    # -- admission ---------------------------------------------------------
+    def _reject(self, reason, estimate=None):
+        self.shed += 1
+        self._m_rejects.labels(controller=self.name, reason=reason).inc()
+        self._m_shed_frac.set(self.shed_fraction())
+        raise SLOReject(reason, estimate=estimate,
+                        degrade_level=self.level)
+
+    def estimate(self, prompt_len, max_new, now=None):
+        """Admission-time cost estimate (seconds): best-replica queue
+        wait + bucketed prefill + ``max_new`` decode steps.  Returns
+        ``total_s=None`` when there is no measured decode cost yet —
+        no evidence, no rejection."""
+        now = self._clock() if now is None else now
+        decode_s = self.cost.decode_s
+        if decode_s is None:
+            return {"wait_s": None, "prefill_s": None, "decode_s": None,
+                    "total_s": None}
+        wait = self._wait_estimate(decode_s)
+        prefill = self.cost.prefill_estimate(prompt_len)
+        if prefill is None:
+            prefill = decode_s      # one step stands in
+        total = wait + prefill + float(max_new) * decode_s
+        return {"wait_s": wait, "prefill_s": prefill,
+                "decode_s": decode_s, "total_s": total}
+
+    def _wait_estimate(self, decode_s):
+        """Expected queue wait on the BEST dispatchable replica: its
+        outstanding token debt spread over its slots, at its observed
+        decode rate."""
+        best = None
+        for rep in list(self.fleet._replicas):
+            if not rep.health.dispatchable or rep.engine is None:
+                continue
+            b = rep.engine.scheduler.backlog()
+            tpot = rep.tpot_ewma or decode_s
+            slots = rep.engine.cache.n_slots
+            debt = b["queued_tokens"] + b["running_tokens"]
+            w = (debt / max(1, slots)) * tpot
+            best = w if best is None else min(best, w)
+        return 0.0 if best is None else best
+
+    def submit(self, prompt, max_new, stream=None, eos_id=None,
+               ttl=None, deadline=None, hedge=False):
+        """Admit one external request through the degrade ladder and
+        predictive admission, then route it via ``fleet.submit``.
+        Raises :class:`SLOReject` (shed, no slot taken), or whatever
+        ``fleet.submit`` raises once admitted."""
+        now = self._clock()
+        if ttl is not None:
+            if deadline is not None:
+                raise ValueError("pass ttl= or deadline=, not both")
+            if ttl <= 0:
+                raise ValueError(f"ttl must be > 0, got {ttl}")
+            deadline = now + float(ttl)
+        level = self.level
+        if level >= 3:
+            self._reject("essential_only")
+        if level >= 2 and deadline is None:
+            self._reject("no_deadline_brownout")
+        eff_max_new = int(max_new)
+        if level >= 1 and eff_max_new > self.brownout_max_new:
+            eff_max_new = self.brownout_max_new
+            self.capped += 1
+        if deadline is not None:
+            est = self.estimate(_prompt_len(prompt), eff_max_new,
+                                now=now)
+            if est["total_s"] is not None:
+                slack = deadline - now
+                est["slack_s"] = slack
+                if est["total_s"] * self.admission_margin > slack:
+                    self._reject("infeasible_deadline", estimate=est)
+        freq = self.fleet.submit(prompt, eff_max_new, stream=stream,
+                                 eos_id=eos_id, deadline=deadline,
+                                 hedge=hedge)
+        self.accepted += 1
+        self._m_shed_frac.set(self.shed_fraction())
+        return freq
+
+    # -- sensing helpers ---------------------------------------------------
+    def shed_fraction(self):
+        offered = self.accepted + self.shed
+        return self.shed / offered if offered else 0.0
+
+    def _live_replicas(self):
+        return [r for r in list(self.fleet._replicas)
+                if r.health.state not in (DRAINING, STOPPED)]
+
+    def _learn_costs(self):
+        """Fold the fleet's measured signals into the cost model: the
+        best replica TPOT becomes the decode cost, and every newly
+        finished request's ``ttft - queue_wait`` becomes a prefill
+        sample for its prompt-length bucket."""
+        best = None
+        for rep in list(self.fleet._replicas):
+            if rep.tpot_ewma:
+                best = rep.tpot_ewma if best is None \
+                    else min(best, rep.tpot_ewma)
+            eng = rep.engine
+            if eng is None:
+                continue
+            key = (rep.name, rep.incarnation)
+            seen = self._rec_seen.get(key, 0)
+            recs = eng.records
+            for rec in recs[seen:]:
+                ttft = rec.get("ttft")
+                qw = rec.get("queue_wait")
+                pl = rec.get("prompt_len")
+                if ttft is not None and qw is not None and pl:
+                    self.cost.observe_prefill(
+                        pl, max(0.0, ttft - qw))
+            self._rec_seen[key] = len(recs)
+        if best is not None:
+            self.cost.observe_decode(best)
+
+    def _violations(self):
+        out = []
+        if (self.miss_ewma or 0.0) > self.slo.deadline_miss_target:
+            out.append("deadline_miss")
+        live = self._live_replicas()
+        if self._depth == 0:
+            # the replica TTFT/TPOT EWMAs are finish-time signals: with
+            # nothing in flight they go stale, and holding a brownout on
+            # a stale reading would wedge the ladder open forever — an
+            # idle fleet meets its latency bounds by definition
+            return tuple(out)
+        if self.slo.ttft_p99_s is not None:
+            worst = max((r.ttft_ewma for r in live if r.ttft_ewma),
+                        default=None)
+            if worst is not None and worst > self.slo.ttft_p99_s:
+                out.append("ttft")
+        if self.slo.tpot_p99_s is not None:
+            worst = max((r.tpot_ewma for r in live if r.tpot_ewma),
+                        default=None)
+            if worst is not None and worst > self.slo.tpot_p99_s:
+                out.append("tpot")
+        return tuple(out)
+
+    # -- the control loop --------------------------------------------------
+    def tick(self):
+        """One sense → learn → actuate pass.  Call after each
+        ``fleet.pump()`` in manual mode; the :meth:`start` thread calls
+        it on an interval for threaded fleets."""
+        now = self._clock()
+        self.ticks += 1
+        self._learn_costs()
+        live = self._live_replicas()
+        depth = 0
+        for rep in live:
+            if rep.engine is not None:
+                sch = rep.engine.scheduler
+                depth += len(sch.queue) + len(sch.running)
+        a = self.ewma_alpha
+        self.queue_ewma = float(depth) if self.queue_ewma is None else \
+            (1.0 - a) * self.queue_ewma + a * depth
+        # deadline-miss rate from the fleet's O(1) finish counters; a
+        # tick with no finishes carries no signal UNLESS the fleet is
+        # idle (an idle fleet meets its SLO by definition — this is the
+        # recovery path out of a brownout once traffic stops)
+        fin = sum(self.fleet.finish_counts.values())
+        miss = self.fleet.finish_counts.get("deadline", 0)
+        dfin, dmiss = fin - self._last_fin, miss - self._last_miss
+        self._last_fin, self._last_miss = fin, miss
+        sample = None
+        if dfin > 0:
+            sample = dmiss / dfin
+        elif depth == 0:
+            sample = 0.0
+        if sample is not None:
+            self.miss_ewma = sample if self.miss_ewma is None else \
+                (1.0 - a) * self.miss_ewma + a * sample
+        self._depth = depth
+        self._reap_draining()
+        viol = self._violations()
+        self._viol_now = viol
+        self._autoscale(now, viol)
+        self._degrade(now, viol)
+        # refresh the live gauges
+        self._m_engines.set(len(self._live_replicas()))
+        self._m_miss.set(self.miss_ewma or 0.0)
+        self._m_queue.set(self.queue_ewma or 0.0)
+        self._m_shed_frac.set(self.shed_fraction())
+        self._m_attain.set(self.attainment())
+        return self
+
+    def _cool(self, now):
+        return (self._last_scale is not None
+                and now - self._last_scale < self.cooldown_s)
+
+    def _autoscale(self, now, viol):
+        live = self._live_replicas()
+        n = len(live)
+        pressure = (bool(viol)
+                    or (self.queue_ewma or 0.0)
+                    > self.scale_up_queue * max(1, n))
+        if pressure and n < self.max_engines and not self._cool(now):
+            name = self.fleet.add_replica()
+            self._last_scale = now
+            self.scale_ups += 1
+            self._scale_event("up", name, now, viol)
+            return
+        calm = (not viol and self.level == 0
+                and (self.queue_ewma or 0.0)
+                < self.scale_down_queue * max(1, n)
+                and (self.miss_ewma or 0.0)
+                <= self.slo.deadline_miss_target / 2.0)
+        if calm and n > self.min_engines and not self._cool(now):
+            victim = self._scale_down_victim(live)
+            if victim is None:
+                return
+            self.fleet.drain(victim.name, wait=False)
+            self._draining.add(victim.name)
+            self._last_scale = now
+            self.scale_downs += 1
+            self._scale_event("down", victim.name, now, viol)
+
+    def _scale_down_victim(self, live):
+        cands = [r for r in live
+                 if r.health.state in DISPATCHABLE
+                 and r.name not in self._draining]
+        if len(cands) <= self.min_engines:
+            return None
+        return min(cands, key=lambda r: (len(r.inflight),
+                                         -r.index))
+
+    def _scale_event(self, direction, engine, now, viol):
+        self._m_scale.labels(controller=self.name,
+                             direction=direction).inc()
+        self._fl.incident(
+            "slo_scale", health=self.fleet.health(),
+            extra={"controller": self.name, "direction": direction,
+                   "engine": engine,
+                   "n_engines": len(self._live_replicas()),
+                   "queue_ewma": round(self.queue_ewma or 0.0, 4),
+                   "miss_ewma": round(self.miss_ewma or 0.0, 4),
+                   "violations": list(viol)})
+
+    def _reap_draining(self):
+        """Finish two-phase scale-downs: remove replicas whose drain
+        completed; re-drain any that a breaker restart revived."""
+        for name in sorted(self._draining):
+            rep = self.fleet._by_name(name)
+            if rep is None:
+                self._draining.discard(name)
+                continue
+            st = rep.health.state
+            if st in (STOPPED, QUARANTINED):
+                if self.fleet.remove_replica(name, wait=False):
+                    self._draining.discard(name)
+            elif st in (HEALTHY, DEGRADED):
+                # auto_restart revived it mid-drain: drain again
+                self.fleet.drain(name, wait=False)
+
+    def _degrade(self, now, viol):
+        at_max = len(self._live_replicas()) >= self.max_engines
+        if viol and at_max:
+            self._viol_ticks += 1
+            self._ok_ticks = 0
+        elif not viol:
+            self._ok_ticks += 1
+            self._viol_ticks = 0
+        else:
+            # violating but scale-up is still available: let
+            # autoscaling fix it before shedding anything
+            self._viol_ticks = 0
+        if (self._viol_ticks >= self.degrade_enter_ticks
+                and self.level < len(DEGRADE_LEVELS) - 1
+                and self.shed_fraction() <= self.slo.max_shed_fraction):
+            self._set_level(self.level + 1, ",".join(viol))
+            self._viol_ticks = 0
+        elif self._ok_ticks >= self.degrade_exit_ticks and self.level > 0:
+            self._set_level(self.level - 1, "recovered")
+            self._ok_ticks = 0
+
+    def _set_level(self, level, reason):
+        old, self.level = self.level, int(level)
+        if self.level > old:
+            self.degrade_entries += 1
+        else:
+            self.degrade_exits += 1
+        self.max_level_seen = max(self.max_level_seen, self.level)
+        self._m_level.set(self.level)
+        self._m_degrade.labels(controller=self.name,
+                               to=DEGRADE_LEVELS[self.level]).inc()
+        self._fl.incident(
+            "slo_degrade", health=self.fleet.health(),
+            extra={"controller": self.name,
+                   "from": DEGRADE_LEVELS[old],
+                   "to": DEGRADE_LEVELS[self.level],
+                   "reason": reason,
+                   "queue_ewma": round(self.queue_ewma or 0.0, 4),
+                   "miss_ewma": round(self.miss_ewma or 0.0, 4),
+                   "n_engines": len(self._live_replicas())})
+        warnings.warn(
+            f"slo controller {self.name}: degrade "
+            f"{DEGRADE_LEVELS[old]} -> {DEGRADE_LEVELS[self.level]} "
+            f"({reason})")
+
+    # -- introspection -----------------------------------------------------
+    def attainment(self):
+        """Fraction of OFFERED work (finished + shed) that completed
+        healthily (eos/max_new).  Shed and missed work both count
+        against it — degrading is a controlled loss, not a free pass."""
+        fc = self.fleet.finish_counts
+        ok = sum(fc.get(r, 0) for r in TERMINAL_OK)
+        offered = sum(fc.values()) + self.shed
+        return ok / offered if offered else 1.0
+
+    def report(self):
+        """The /slo debug block: SLO, ladder position, EWMAs, cost
+        model, and action counters."""
+        return {
+            "controller": self.name,
+            "slo": self.slo.as_dict(),
+            "level": self.level,
+            "level_name": DEGRADE_LEVELS[self.level],
+            "violations": list(self._viol_now),
+            "n_engines": len(self._live_replicas()),
+            "draining": sorted(self._draining),
+            "ewma": {"queue_depth": self.queue_ewma,
+                     "deadline_miss": self.miss_ewma},
+            "cost_model": self.cost.as_dict(),
+            "shed_fraction": round(self.shed_fraction(), 4),
+            "attainment": round(self.attainment(), 4),
+            "counters": {"ticks": self.ticks,
+                         "accepted": self.accepted,
+                         "shed": self.shed,
+                         "capped": self.capped,
+                         "scale_ups": self.scale_ups,
+                         "scale_downs": self.scale_downs,
+                         "degrade_entries": self.degrade_entries,
+                         "degrade_exits": self.degrade_exits,
+                         "max_level_seen": self.max_level_seen},
+        }
+
+    # -- threaded drive ----------------------------------------------------
+    def start(self, interval=0.05):
+        """Run :meth:`tick` on a daemon supervisor thread (threaded
+        fleets).  No-op when already running."""
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, args=(float(interval),), daemon=True,
+            name=f"slo-{self.name}")
+        self._thread.start()
+        return self
+
+    def _loop(self, interval):
+        while self._running:
+            try:
+                self.tick()
+            except Exception as e:    # the controller must never die
+                warnings.warn(
+                    f"slo controller {self.name}: tick error "
+                    f"{type(e).__name__}: {e}")
+            time.sleep(interval)
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _prompt_len(prompt):
+    try:
+        return int(getattr(prompt, "size", None) or len(prompt))
+    except TypeError:
+        return 1
+
+
+def slo_report():
+    """{controller: report} for every live FleetController — the
+    ``/slo`` debug endpoint payload."""
+    return {c.name: c.report() for c in list(_LIVE)}

@@ -324,7 +324,11 @@ class InferenceEngine:
         but lowering re-traces the shared python callables, so the
         retrace witnesses (``hetu_serving_retraces_total``,
         ``trace_counts``) each advance by one: capture profiles outside
-        any compile-once assertion window."""
+        any compile-once assertion window — or through
+        :meth:`capture_cost_profiles`, which keys the profiler's
+        capture cache on :meth:`cost_signature` so only the FIRST
+        capture per signature pays the re-lower (continuous profiling
+        under the SLO controller stays retrace-flat)."""
         def ab(x):
             return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
 
@@ -340,6 +344,39 @@ class InferenceEngine:
                     params, k, v, prompt, scalar, scalar, key).compile(),
                 "decode": self._step_fn.lower(
                     params, k, v, lane, lane, active, key).compile()}
+
+    def cost_signature(self):
+        """Stable identity of the compiled (prefill, decode) pair at
+        this engine's serving shapes — the profiler's capture-cache
+        key.  Same adapter/config/sampling/backend (the shared program
+        key) plus the same slot geometry means the same executables,
+        so a cached cost/memory capture is exact, not approximate."""
+        return repr((self._program_key(), self.cache.n_slots,
+                     self.max_len, self.max_prompt_len))
+
+    def capture_cost_profiles(self, profiler, kind="serve", prefix=None):
+        """Capture cost/memory for both serving programs through
+        ``profiler``'s signature cache (profile names
+        ``{prefix}_prefill`` / ``{prefix}_decode``; the prefix defaults
+        to the adapter name, matching ``bench.py --profile``).  Only a
+        cache MISS builds the AOT programs — :meth:`cost_programs` runs
+        at most once per call and not at all when both signatures hit,
+        so calling this every controller tick never re-traces."""
+        prefix = self.adapter.name if prefix is None else str(prefix)
+        sig = self.cost_signature()
+        progs = {}
+
+        def deferred(which):
+            def build():
+                if not progs:
+                    progs.update(self.cost_programs())
+                return progs[which]
+            return build
+
+        return {which: profiler.capture(
+                    f"{prefix}_{which}", deferred(which), kind=kind,
+                    signature=f"{sig}:{which}")
+                for which in ("prefill", "decode")}
 
     def close(self):
         """Release engine-owned HBM-ledger accounting (the KV slot

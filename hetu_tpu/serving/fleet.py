@@ -185,8 +185,8 @@ class EngineFleet:
     ``latency_buckets``, and per-replica ``device`` pinning when the
     backend has multiple devices.  ``threaded=False`` disables the
     driver/supervisor threads: drive the fleet deterministically with
-    :meth:`pump` (wedge detection needs real threads and is off in this
-    mode).
+    :meth:`pump` (each tick is wedge-bounded: a stalled step is
+    reported and quarantined when the pump regains control).
 
     ``engine_factory=`` swaps the replica type for any engine speaking
     the same surface (``submit``/``step``/``cancel``/``harvest``/
@@ -200,9 +200,11 @@ class EngineFleet:
                  *, threaded=True, clock=None, name="fleet",
                  degraded_after=1, quarantine_after=3, recover_after=8,
                  breaker_base=0.25, breaker_cap=30.0, max_failovers=3,
-                 wedge_timeout=5.0, supervise_interval=0.02,
+                 wedge_timeout=None, wedge_floor=5.0, wedge_safety=50.0,
+                 supervise_interval=0.02,
                  idle_sleep=0.001, auto_restart=True, ewma_alpha=0.3,
-                 latency_buckets=None, engine_factory=None):
+                 latency_buckets=None, engine_factory=None,
+                 replica_prefix="e"):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
         self._executor = executor
@@ -220,7 +222,13 @@ class EngineFleet:
                         recover_after=recover_after)
         self._bp = dict(base=breaker_base, cap=breaker_cap)
         self.max_failovers = int(max_failovers)
-        self.wedge_timeout = float(wedge_timeout)
+        # wedge_timeout=None derives the bound from the replica's
+        # observed TPOT (effective_wedge_timeout); an explicit value is
+        # an absolute override, as before
+        self.wedge_timeout = (None if wedge_timeout is None
+                              else float(wedge_timeout))
+        self.wedge_floor = float(wedge_floor)
+        self.wedge_safety = float(wedge_safety)
         self.supervise_interval = float(supervise_interval)
         self.idle_sleep = float(idle_sleep)
         self.auto_restart = bool(auto_restart)
@@ -242,6 +250,9 @@ class EngineFleet:
         self.failovers_done = 0
         self.hedged = 0
         self.hedges_skipped = 0
+        self.replica_prefix = str(replica_prefix)
+        self._next_index = int(n_engines)   # add_replica allocation
+        self.finish_counts = {}   # reason -> count (O(1) controller read)
         reg = _telemetry.get_registry()
         self._m_health = reg.gauge(
             "hetu_fleet_engine_health_state",
@@ -282,7 +293,7 @@ class EngineFleet:
 
     # -- construction ------------------------------------------------------
     def _instance_name(self, index, incarnation):
-        base = f"e{index}"
+        base = f"{self.replica_prefix}{index}"
         return base if incarnation == 0 else f"{base}.{incarnation}"
 
     def _build_engine(self, index, incarnation):
@@ -295,13 +306,55 @@ class EngineFleet:
             **self._ekw)
 
     def _make_replica(self, index):
-        name = f"e{index}"
+        name = f"{self.replica_prefix}{index}"
         rep = _Replica(
             index, name, self._build_engine(index, 0),
             ReplicaHealth(name, clock=self._clock, **self._hp),
             CircuitBreaker(clock=self._clock, **self._bp))
         self._m_health.labels(engine=name).set(HEALTH_STATE_CODES[HEALTHY])
         return rep
+
+    # -- elastic scale (the controller's actuators) ------------------------
+    def add_replica(self):
+        """Scale up: build one fresh replica at the next free index
+        (indices are never reused, so rids stay unique across the
+        fleet's whole life) and start its driver when threaded.
+        Returns the new replica's name."""
+        index = self._next_index
+        self._next_index += 1
+        rep = self._make_replica(index)
+        # atomic list swap: readers iterate a snapshot, never a
+        # half-mutated list
+        self._replicas = self._replicas + [rep]
+        if self.threaded and self._running:
+            self._start_driver(rep)
+        return rep.name
+
+    def remove_replica(self, name, wait=True, timeout=60.0):
+        """Scale down with zero accepted-rid loss: drain the replica
+        (siblings keep serving), then drop it from the fleet.  With
+        ``wait=False`` the replica is left DRAINING and the call
+        returns ``False``; call again once a later pump/supervise pass
+        has drained it (the controller's two-phase scale-down).  The
+        last replica cannot be removed."""
+        rep = self._by_name(name, required=True)
+        if len(self._replicas) <= 1:
+            raise ValueError("cannot remove the last replica")
+        if rep.health.state not in (QUARANTINED, STOPPED):
+            self.drain(name, wait=wait, timeout=timeout)
+        if rep.health.state not in (QUARANTINED, STOPPED):
+            return False            # still draining (wait=False path)
+        # QUARANTINED work was already harvested into the failover
+        # queue; STOPPED means drained-to-idle — either way nothing of
+        # ours runs there any more
+        rep.generation += 1         # fence any driver thread
+        if rep.health.state != STOPPED:
+            rep.health.to(STOPPED, "removed")
+        self._set_health(rep)
+        if rep.engine is not None:
+            rep.engine.close()
+        self._replicas = [r for r in self._replicas if r is not rep]
+        return True
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -694,6 +747,7 @@ class EngineFleet:
         freq._finish_reason = reason
         freq.t_done = self._clock()
         self.completed += 1
+        self.finish_counts[reason] = self.finish_counts.get(reason, 0) + 1
         if freq.rid is not None:
             # cluster-level terminal (idempotent over the engine-level
             # finish for healthy completions; the ONLY terminal for
@@ -778,18 +832,35 @@ class EngineFleet:
                     f"{type(e).__name__}: {e}")
             time.sleep(self.supervise_interval)
 
+    def effective_wedge_timeout(self, rep=None):
+        """The heartbeat-staleness bound that counts as a wedge.  An
+        explicit ``wedge_timeout=`` kwarg is absolute; by default the
+        bound is derived from the replica's OBSERVED decode rate —
+        ``max(wedge_floor, wedge_safety × TPOT_EWMA)`` — so detection
+        survives real TPU step times instead of assuming 5 s ≫ one
+        step.  A replica with no TPOT yet borrows the slowest sibling's
+        (conservative: slow siblings imply slow steps here too) and
+        falls back to the floor before any EWMA exists."""
+        if self.wedge_timeout is not None:
+            return self.wedge_timeout
+        tpot = rep.tpot_ewma if rep is not None else None
+        if not tpot:
+            known = [r.tpot_ewma for r in self._replicas if r.tpot_ewma]
+            tpot = max(known) if known else 0.0
+        return max(self.wedge_floor, self.wedge_safety * tpot)
+
     def _supervise_once(self):
         """One supervision pass: wedge detection (threaded only),
         breaker-gated restarts, failover dispatch, deferred cancels."""
         now = self._clock()
-        for rep in self._replicas:
+        for rep in list(self._replicas):
             if (self.threaded and rep.thread is not None
                     and rep.thread.is_alive()
                     and rep.health.state in (HEALTHY, DEGRADED)
                     and rep.engine is not None
                     and not rep.engine.scheduler.idle
                     and rep.health.heartbeat_age(now)
-                    > self.wedge_timeout):
+                    > self.effective_wedge_timeout(rep)):
                 self._on_wedge(rep, rep.health.heartbeat_age(now))
             if (rep.health.state == QUARANTINED and self.auto_restart
                     and rep.breaker.allow(now)):
@@ -892,16 +963,52 @@ class EngineFleet:
     # -- pumping / waiting -------------------------------------------------
     def pump(self, iterations=1):
         """Deterministic manual drive (``threaded=False`` fleets): one
-        tick per replica per iteration, then one supervision pass."""
+        tick per replica per iteration, then one supervision pass.
+
+        Each tick is bounded by the same wedge check the threaded
+        supervisor runs: a step that stalls past
+        :meth:`effective_wedge_timeout` has, by the time the pump loop
+        regains control, already blocked the caller — it cannot be
+        pre-empted from inside one thread, but it IS reported (wedge
+        metric + incident) and the replica is quarantined + failed
+        over instead of silently degrading every later iteration."""
         if self.threaded:
             raise RuntimeError(
                 "pump() drives threaded=False fleets; this one runs "
                 "driver threads")
         for _ in range(int(iterations)):
-            for rep in self._replicas:
+            for rep in list(self._replicas):
+                busy = (rep.health.state in (HEALTHY, DEGRADED)
+                        and rep.engine is not None
+                        and not rep.engine.scheduler.idle)
+                t0 = self._clock()
                 self._tick(rep)
+                dur = self._clock() - t0
+                if busy and dur > self.effective_wedge_timeout(rep) \
+                        and rep.health.state in (HEALTHY, DEGRADED) \
+                        and rep.engine is not None:
+                    self._on_pump_stall(rep, dur)
             self._supervise_once()
         return self
+
+    def _on_pump_stall(self, rep, dur):
+        """A manual-mode tick stalled past the wedge bound.  Unlike a
+        threaded wedge the step RETURNED (nobody holds the engine), so
+        the replica is quarantined through the clean harvest path and
+        its work failed over; auto_restart revives it through the
+        breaker like any other quarantine."""
+        self._m_wedges.labels(engine=rep.name).inc()
+        self._fl.incident(
+            "engine_wedge", health=self.health(),
+            extra={"engine": rep.name, "stalled_step_s": round(dur, 4),
+                   "mode": "pump"})
+        warnings.warn(
+            f"fleet {self.name}: engine {rep.name} pump tick stalled "
+            f"{dur:.2f}s — wedged; quarantining and failing over")
+        with rep.lock:
+            actions = self._quarantine_locked(
+                rep, f"pump tick stalled {dur:.2f}s")
+        self._queue_failovers(actions)
 
     def _reap_all(self):
         """Manual-mode bookkeeping sweep without stepping engines."""

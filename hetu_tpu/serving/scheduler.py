@@ -328,6 +328,17 @@ class Scheduler:
     def idle(self):
         return not self.queue and not self.running
 
+    def backlog(self):
+        """Outstanding token debt, for predictive admission: the
+        generated-token budget still owed to queued requests (their
+        whole ``max_new``) and running ones (what's left of it)."""
+        queued = sum(r.max_new for r in self.queue)
+        running = sum(max(0, r.max_new - len(r.tokens))
+                      for r in self.running.values())
+        return {"depth": len(self.queue) + len(self.running),
+                "queued_tokens": int(queued),
+                "running_tokens": int(running)}
+
     def admit(self):
         """Move queued requests into free slots; returns the admitted
         [(request, slot)] for the engine to prefill, FIFO order."""

@@ -95,6 +95,14 @@ def enabled():
     return _registry.enabled
 
 
+def _slo_block():
+    """The /slo debug payload: every live FleetController's report.
+    Lazy import — serving imports telemetry, never the reverse at
+    module load."""
+    from ..serving import control
+    return control.slo_report()
+
+
 def enable(http_port=None, host="127.0.0.1", incident_dir=None):
     """Turn instruments live; optionally start the HTTP exporter
     (``http_port=0`` binds an ephemeral port) and point the flight
@@ -114,6 +122,7 @@ def enable(http_port=None, host="127.0.0.1", incident_dir=None):
                 "/requests": _request_trace.inflight,
                 "/incidents": _flight.incidents,
                 "/profile": _profiler.report_block,
+                "/slo": _slo_block,
             })
     return _server
 

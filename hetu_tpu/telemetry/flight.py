@@ -39,7 +39,7 @@ __all__ = ["FlightRecorder", "INCIDENT_KINDS"]
 #: every trip kind a dump can carry (documented in docs/INCIDENTS.md)
 INCIDENT_KINDS = ("guard_trip", "watchdog", "engine_crash",
                   "engine_wedge", "breaker_open", "fleet_unavailable",
-                  "ps_unavailable")
+                  "ps_unavailable", "slo_scale", "slo_degrade")
 
 
 class FlightRecorder:

@@ -287,6 +287,7 @@ class ProgramProfiler:
         self._workspace = {}            # name -> ledger handle
         self._peaks = None
         self._m = None
+        self.cache_hits = 0             # signature-cache short-circuits
 
     def _metrics(self):
         """The hetu_profile_* instrument set (lazy; None w/o registry).
@@ -335,7 +336,8 @@ class ProgramProfiler:
 
     # -- capture -----------------------------------------------------------
     def capture(self, name, compiled=None, *, kind="program", cost=None,
-                memory=None, eval_nodes=None, feed_shapes=None):
+                memory=None, eval_nodes=None, feed_shapes=None,
+                signature=None):
         """Profile one compiled program.
 
         ``compiled`` is an XLA compiled object (``jitted.lower(...)
@@ -344,9 +346,27 @@ class ProgramProfiler:
         ``cost``/``memory`` dicts may be passed instead (tests, remote
         rounds).  ``eval_nodes`` (+ optional ``feed_shapes``) adds the
         per-layer attribution table.  Re-capturing a name replaces its
-        profile (and its workspace ledger entry)."""
+        profile (and its workspace ledger entry).
+
+        ``signature=`` keys a capture CACHE: when the stored profile
+        for ``name`` carries the same signature the stored profile is
+        returned as-is (``cache_hits`` counts them) and ``compiled`` is
+        never analyzed — pass a zero-arg factory as ``compiled`` to
+        defer even BUILDING the program (an engine's AOT re-lower) to
+        the cache-miss path.  That is what keeps continuous profiling
+        under the SLO controller retrace-flat.  A changed or absent
+        signature replaces the profile as before."""
         from ..platform import (compiled_cost_analysis,
                                 compiled_memory_analysis)
+        if signature is not None:
+            with self._lock:
+                prev = self._profiles.get(str(name))
+            if prev is not None and prev.get("signature") == signature:
+                self.cache_hits += 1
+                return prev
+        if compiled is not None and callable(compiled) \
+                and not hasattr(compiled, "cost_analysis"):
+            compiled = compiled()   # deferred build: cache missed
         if compiled is not None:
             cost = compiled_cost_analysis(compiled) if cost is None \
                 else cost
@@ -362,6 +382,7 @@ class ProgramProfiler:
                             if k in cost},
                    "memory": memory,
                    "layers": layers,
+                   "signature": signature,
                    "derived": perf_model.derive(cost, peaks=self.peaks())}
         with self._lock:
             self._profiles[str(name)] = profile

@@ -579,6 +579,93 @@ def test_perf_diff_static_growth_trips_and_no_baseline_passes(tmp_path):
     assert json.loads(proc.stdout)["status"] == "no_baseline"
 
 
+def test_slo_stage_emits_full_compact_and_history(tmp_path):
+    """`--slo --quick` must end in a compact parseable line carrying
+    the controller-vs-static verdict (wins, miss rates, attainment,
+    shed, scale and degrade tallies), with the full headline on the
+    line above AND mirrored to SLO_FULL.json, plus one flat-signals
+    entry appended to the perf-diff history feed."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_SLO_JSON"] = str(tmp_path / "slo.json")
+    env["HETU_PERF_HISTORY"] = str(tmp_path / "history.jsonl")
+    proc = subprocess.run([sys.executable, BENCH, "--slo", "--quick"],
+                          capture_output=True, text=True, timeout=300,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    compact = json.loads(lines[-1])
+    assert len(lines[-1].encode()) <= 1500, \
+        "compact slo line must fit the driver's stdout tail"
+    assert compact["metric"] == "slo_attainment"
+    assert 0.0 < compact["value"] <= 1.0
+    # the acceptance gates, re-checked from the emitted evidence
+    assert compact["wins"] is True
+    assert compact["miss"]["ctl"] < compact["miss"]["static"]
+    assert compact["attain"]["ctl"] > compact["attain"]["static"]
+    assert compact["shed"]["n"] > 0 and compact["shed"]["doomed"] > 0
+    assert compact["scale"]["up"] >= 1
+    assert compact["degrade"]["in"] >= 1
+    assert compact["degrade"]["in"] == compact["degrade"]["out"]
+    full = json.loads(lines[-2])
+    with open(tmp_path / "slo.json") as f:
+        assert json.load(f) == full
+    assert set(full["stages"]) == {"controller", "static"}
+    assert full["controller_wins"] is True
+    for s in full["stages"].values():
+        assert s["all_accepted_terminal"] is True
+    # every ladder/scale transition produced a flight-recorder incident
+    tr = full["transitions"]
+    assert tr["scale_incidents"] == tr["scale"]
+    assert tr["degrade_incidents"] == tr["degrade"]
+    # one history entry: the flat higher-is-better attainment signals
+    with open(tmp_path / "history.jsonl") as f:
+        entries = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(entries) == 1
+    sig = entries[0]["signals"]
+    assert sig == full["signals"]
+    assert {"slo_attainment", "shed_fraction",
+            "slo_static_attainment"} == set(sig)
+
+
+def test_perf_diff_attainment_one_sided_and_shed_informational(tmp_path):
+    """Unit-level perf_diff checks for the --slo signals: an attainment
+    drop beyond 5 points trips rc 1 (one-sided, absolute); a 4-point
+    drop passes; shed_fraction is informational and never gates."""
+    diff = os.path.join(os.path.dirname(BENCH), "tools", "perf_diff.py")
+    base_doc = {"signals": {"slo_attainment": 0.90,
+                            "slo_static_attainment": 0.60,
+                            "shed_fraction": 0.05}}
+    cur_doc = {"signals": {"slo_attainment": 0.84,
+                           "slo_static_attainment": 0.70,
+                           "shed_fraction": 0.50}}
+    (tmp_path / "base.json").write_text(json.dumps(base_doc))
+    (tmp_path / "cur.json").write_text(json.dumps(cur_doc))
+    argv = [sys.executable, diff,
+            "--current", str(tmp_path / "cur.json"),
+            "--baseline", str(tmp_path / "base.json"), "--json"]
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 1
+    verdict = json.loads(proc.stdout)
+    bad = [r for r in verdict["table"] if r["regressed"]]
+    assert [r["signal"] for r in bad] == ["slo_attainment"]
+    assert bad[0]["kind"] == "attainment"
+    by_sig = {r["signal"]: r for r in verdict["table"]}
+    # a 10x shed_fraction change is context, not a failure
+    assert by_sig["shed_fraction"]["kind"] == "info"
+    assert by_sig["shed_fraction"]["regressed"] is False
+    # gains never fail either (static attainment went UP)
+    assert by_sig["slo_static_attainment"]["regressed"] is False
+    # inside the 5-point tolerance: clean
+    cur_doc["signals"]["slo_attainment"] = 0.86
+    (tmp_path / "cur.json").write_text(json.dumps(cur_doc))
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["status"] == "ok"
+
+
 @pytest.mark.slow
 def test_one_stage_budget_preserves_finished_stage(tmp_path):
     """A budget that admits roughly one stage: the tail must carry that

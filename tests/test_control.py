@@ -1,0 +1,542 @@
+"""SLO control-plane contracts (hetu_tpu/serving/control.py + the
+fleet's elastic-scale and wedge-bound plumbing it actuates).
+
+Pinned here:
+* :class:`SLO` validation and the typed :class:`SLOReject` (reason +
+  admission estimate + ladder level, raised BEFORE a slot is taken);
+* :class:`CostModel` — decode EWMA, pow2 prefill buckets with
+  nearest-larger fallback, evidence gating (no measurement, no
+  rejection), and priming from an observed ProgramProfiler profile;
+* predictive admission: provably-infeasible deadlines shed with the
+  estimate attached while feasible work rides through untouched;
+* the brownout ladder: sustained violation walks
+  normal → cap_max_new → shed_no_deadline → essential_only and
+  sustained recovery walks it back down, one level per dwell;
+* autoscaling: queue pressure spawns replicas (bounded by
+  ``max_engines`` + cooldown), calm drains them two-phase with zero
+  accepted-rid loss, never below ``min_engines``;
+* fleet elastic scale: ``add_replica`` / ``remove_replica`` contracts
+  (fresh never-reused indices; the last replica is irremovable);
+* the wedge bound derived from observed TPOT
+  (``max(floor, safety × EWMA)``) with the explicit kwarg as absolute
+  override, and the manual-``pump()`` stall check that quarantines +
+  fails over a wedged replica instead of silently degrading;
+* deadline races under ``drop_expired_first`` + predictive admission:
+  every accepted rid finalizes exactly once (records unique, finish
+  audit balanced);
+* the ProgramProfiler signature cache: re-capturing an unchanged
+  program is a cache hit that never re-lowers (retrace counters flat).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import telemetry
+from hetu_tpu.models import LlamaConfig, LlamaForCausalLM
+from hetu_tpu.resilience import faults
+from hetu_tpu.serving import (CostModel, DEGRADE_LEVELS, EngineFleet,
+                              FleetController, InferenceEngine, SLO,
+                              SLOReject, TERMINAL_OK)
+from hetu_tpu.serving.control import slo_report
+from hetu_tpu.serving.health import (DRAINING, HEALTHY, QUARANTINED,
+                                     STOPPED)
+from hetu_tpu.telemetry.profiling import ProgramProfiler
+
+V = 64
+EKW = dict(n_slots=2, max_len=32, max_prompt_len=8, name="slo")
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+@pytest.fixture(scope="module")
+def served():
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=16)
+    model = LlamaForCausalLM(c, name="slo")
+    ids = ht.placeholder_op("slo_ids", (1, 4), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    return ex, model
+
+
+def _fleet(served, n=1, **kw):
+    ex, model = served
+    kw.setdefault("engine_kwargs", EKW)
+    kw.setdefault("threaded", False)
+    return EngineFleet(ex, model, n_engines=n, **kw)
+
+
+def _prompt():
+    return np.array([1, 2, 3], np.int32)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _quiet():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        yield
+
+
+# -- SLO + SLOReject units ---------------------------------------------------
+
+def test_slo_validation_and_dict():
+    s = SLO(deadline_miss_target=0.1, ttft_p99_s=2.0,
+            max_shed_fraction=0.5)
+    assert s.as_dict() == {"deadline_miss_target": 0.1,
+                           "ttft_p99_s": 2.0, "tpot_p99_s": None,
+                           "max_shed_fraction": 0.5}
+    with pytest.raises(ValueError, match="deadline_miss_target"):
+        SLO(deadline_miss_target=1.5)
+    with pytest.raises(ValueError, match="max_shed_fraction"):
+        SLO(max_shed_fraction=-0.1)
+    with pytest.raises(ValueError, match="ttft_p99_s"):
+        SLO(ttft_p99_s=0.0)
+    with pytest.raises(ValueError, match="tpot_p99_s"):
+        SLO(tpot_p99_s=-1.0)
+
+
+def test_slo_reject_carries_reason_estimate_and_level():
+    est = {"wait_s": 1.0, "prefill_s": 0.5, "decode_s": 0.1,
+           "total_s": 2.3, "slack_s": 0.4}
+    e = SLOReject("infeasible_deadline", estimate=est, degrade_level=1)
+    assert e.reason == "infeasible_deadline"
+    assert e.estimate["total_s"] == 2.3
+    assert e.degrade_level == 1
+    assert "need 2.300s" in str(e) and "have 0.400s" in str(e)
+    assert DEGRADE_LEVELS[1] in str(e)
+    assert isinstance(e, RuntimeError)
+
+
+# -- cost model --------------------------------------------------------------
+
+def test_cost_model_ewma_and_buckets():
+    cm = CostModel(alpha=0.5)
+    assert cm.decode_s is None
+    cm.observe_decode(0.1)
+    assert cm.decode_s == pytest.approx(0.1)
+    cm.observe_decode(0.2)
+    assert cm.decode_s == pytest.approx(0.15)
+    cm.observe_decode(0.0)          # non-positive samples ignored
+    assert cm.decode_s == pytest.approx(0.15)
+    assert CostModel.bucket(1) == 1
+    assert CostModel.bucket(7) == 3
+    assert CostModel.bucket(8) == 4
+    cm.observe_prefill(7, 0.3)
+    assert cm.prefill_estimate(5) == pytest.approx(0.3)   # same bucket
+    assert cm.prefill_estimate(100) == pytest.approx(0.3)  # nearest
+    d = cm.as_dict()
+    assert d["prefill_s"] == {"2^3": pytest.approx(0.3)}
+
+
+def test_cost_model_nearest_bucket_prefers_larger():
+    cm = CostModel()
+    assert cm.prefill_estimate(4) is None       # no evidence at all
+    cm.observe_prefill(3, 0.1)      # bucket 2
+    cm.observe_prefill(15, 0.4)     # bucket 4
+    # bucket 3 is equidistant: the larger (conservative) one wins
+    assert cm.prefill_estimate(7) == pytest.approx(0.4)
+
+
+def test_cost_model_primes_from_observed_profile():
+    prof = ProgramProfiler()
+    prof.capture("slo_decode", cost={"flops": 100.0})
+    cm = CostModel()
+    # static-only profile: no measured rate, nothing to prime from
+    assert cm.prime(prof, decode="slo_decode") is None
+    prof.observe("slo_decode", steps=20, elapsed_s=1.0)
+    assert cm.prime(prof, decode="slo_decode") == pytest.approx(0.05)
+
+
+# -- predictive admission ----------------------------------------------------
+
+def test_predictive_admission_sheds_with_estimate_before_slot(served):
+    clk = ManualClock()
+    fleet = _fleet(served, n=1, clock=clk)
+    cm = CostModel()
+    cm.observe_decode(1.0)          # measured: 1 s per token
+    ctl = FleetController(fleet, SLO(), cost_model=cm, max_engines=1)
+    with pytest.raises(SLOReject) as ei:
+        ctl.submit(_prompt(), 8, ttl=2.0)   # needs >= 9 s, has 2
+    e = ei.value
+    assert e.reason == "infeasible_deadline"
+    assert e.estimate["total_s"] >= 8.0
+    assert e.estimate["slack_s"] == pytest.approx(2.0)
+    # shed BEFORE taking a slot: the fleet never saw the request
+    assert fleet.submitted == 0
+    assert fleet._replicas[0].engine.scheduler.idle
+    assert ctl.shed == 1 and ctl.accepted == 0
+    assert ctl.shed_fraction() == 1.0
+    # feasible work rides through untouched
+    with _quiet():
+        r = ctl.submit(_prompt(), 4, ttl=100.0)
+        fleet.wait([r])
+    assert r.finish_reason in TERMINAL_OK
+    assert ctl.accepted == 1
+    fleet.stop()
+
+
+def test_admission_without_evidence_always_admits(served):
+    clk = ManualClock()
+    fleet = _fleet(served, n=1, clock=clk)
+    ctl = FleetController(fleet, SLO(), max_engines=1)
+    assert ctl.estimate(3, 8)["total_s"] is None
+    with _quiet():
+        # an impossible deadline, but no measured decode cost yet: the
+        # estimator must not reject on a guess
+        r = ctl.submit(_prompt(), 8, ttl=1e-9)
+        clk.advance(1.0)
+        fleet.wait([r])
+    assert r.finish_reason == "deadline"
+    assert ctl.accepted == 1 and ctl.shed == 0
+    fleet.stop()
+
+
+def test_submit_ttl_deadline_validation(served):
+    fleet = _fleet(served, n=1, clock=ManualClock())
+    ctl = FleetController(fleet, SLO(), max_engines=1)
+    with pytest.raises(ValueError, match="not both"):
+        ctl.submit(_prompt(), 4, ttl=1.0, deadline=5.0)
+    with pytest.raises(ValueError, match="ttl"):
+        ctl.submit(_prompt(), 4, ttl=0.0)
+    fleet.stop()
+
+
+# -- brownout ladder ---------------------------------------------------------
+
+def test_brownout_ladder_escalates_and_recovers(served):
+    clk = ManualClock()
+    fleet = _fleet(served, n=1, clock=clk, auto_restart=False)
+    ctl = FleetController(
+        fleet, SLO(deadline_miss_target=0.05, max_shed_fraction=1.0),
+        max_engines=1, ewma_alpha=1.0, degrade_enter_ticks=2,
+        degrade_exit_ticks=2, brownout_max_new=2)
+
+    def miss_tick():
+        # one request expires in queue -> a deadline-miss sample
+        with _quiet():
+            ctl.submit(_prompt(), 4, ttl=0.5)
+            clk.advance(1.0)
+            fleet.pump()
+            ctl.tick()
+
+    miss_tick()
+    assert ctl.level == 0 and ctl.miss_ewma == 1.0
+    miss_tick()
+    assert ctl.level == 1                     # cap_max_new
+    with _quiet():
+        ctl.submit(_prompt(), 4, ttl=0.5)     # 4 > brownout_max_new=2
+    assert ctl.capped == 1
+    miss_tick(), miss_tick()
+    assert ctl.level == 2                     # shed_no_deadline
+    with pytest.raises(SLOReject) as ei:
+        ctl.submit(_prompt(), 4)              # no deadline at level 2
+    assert ei.value.reason == "no_deadline_brownout"
+    miss_tick(), miss_tick()
+    assert ctl.level == 3                     # essential_only
+    with pytest.raises(SLOReject) as ei:
+        ctl.submit(_prompt(), 4, ttl=100.0)
+    assert ei.value.reason == "essential_only"
+    assert ei.value.degrade_level == 3
+    # traffic stops; the idle fleet meets its SLO -> one level per dwell
+    with _quiet():
+        for _ in range(6):
+            fleet.pump()
+            ctl.tick()
+    assert ctl.level == 0
+    assert ctl.degrade_entries == 3 and ctl.degrade_exits == 3
+    assert ctl.max_level_seen == 3
+    fleet.stop()
+
+
+def test_shed_fraction_cap_blocks_escalation(served):
+    clk = ManualClock()
+    fleet = _fleet(served, n=1, clock=clk, auto_restart=False)
+    ctl = FleetController(
+        fleet, SLO(deadline_miss_target=0.05, max_shed_fraction=0.0),
+        max_engines=1, ewma_alpha=1.0, degrade_enter_ticks=1)
+    cm = ctl.cost
+    cm.observe_decode(1.0)
+    with pytest.raises(SLOReject):
+        ctl.submit(_prompt(), 8, ttl=0.5)     # shed_fraction -> 1.0
+    with _quiet():
+        ctl.submit(_prompt(), 2, ttl=5.0)     # feasible: est 3.0 < 5.0
+        clk.advance(6.0)                      # ...but expires queued
+        fleet.pump()
+        ctl.tick()                            # miss violation this tick
+    # shedding harder cannot fix an SLO that counts shed work against
+    # attainment: above the cap the ladder must NOT escalate
+    assert ctl.miss_ewma == 1.0 and ctl.level == 0
+    fleet.stop()
+
+
+# -- autoscaling -------------------------------------------------------------
+
+def test_autoscale_up_cooldown_and_two_phase_down(served):
+    clk = ManualClock()
+    fleet = _fleet(served, n=1, clock=clk)
+    ctl = FleetController(
+        fleet, SLO(), min_engines=1, max_engines=3,
+        scale_up_queue=1.0, scale_down_queue=2.0, cooldown_s=5.0,
+        ewma_alpha=1.0, degrade_enter_ticks=10_000)
+    with _quiet():
+        reqs = [ctl.submit(_prompt(), 6) for _ in range(8)]
+        ctl.tick()                          # depth 8 > 1x1: scale up
+        assert len(fleet._replicas) == 2 and ctl.scale_ups == 1
+        ctl.tick()                          # cooldown holds
+        assert ctl.scale_ups == 1
+        clk.advance(5.0)
+        ctl.tick()
+        assert len(fleet._replicas) == 3 and ctl.scale_ups == 2
+        clk.advance(5.0)
+        ctl.tick()                          # at max_engines: no more
+        assert len(fleet._replicas) == 3
+        # indices are never reused: fresh names past the seed replica
+        assert [r.name for r in fleet._replicas] == ["e0", "e1", "e2"]
+        fleet.wait(reqs)
+    assert all(r.finish_reason in TERMINAL_OK for r in reqs)
+    # calm: two-phase scale-down (drain first, remove once drained)
+    with _quiet():
+        clk.advance(5.0)
+        ctl.tick()
+        assert ctl.scale_downs == 1
+        draining = [r for r in fleet._replicas
+                    if r.health.state == DRAINING]
+        assert len(draining) == 1
+        fleet.pump()                        # idle DRAINING -> STOPPED
+        ctl.tick()                          # reap: replica removed
+        assert len(fleet._replicas) == 2
+        clk.advance(5.0)
+        ctl.tick()
+        fleet.pump()
+        ctl.tick()
+        assert len(fleet._replicas) == 1 and ctl.scale_downs == 2
+        clk.advance(5.0)
+        ctl.tick()                          # never below min_engines
+        assert len(fleet._replicas) == 1
+    rep = ctl.report()
+    assert rep["counters"]["scale_ups"] == 2
+    assert rep["counters"]["scale_downs"] == 2
+    fleet.stop()
+
+
+def test_fleet_add_remove_replica_contracts(served):
+    fleet = _fleet(served, n=2, clock=ManualClock())
+    assert fleet.add_replica() == "e2"
+    assert [r.name for r in fleet._replicas] == ["e0", "e1", "e2"]
+    assert fleet.remove_replica("e2") is True
+    assert [r.name for r in fleet._replicas] == ["e0", "e1"]
+    # the freed index is NOT reused: rids stay unique for the fleet's
+    # whole life
+    assert fleet.add_replica() == "e3"
+    assert fleet.remove_replica("e3") is True
+    assert fleet.remove_replica("e1") is True
+    with pytest.raises(ValueError, match="last replica"):
+        fleet.remove_replica("e0")
+    with pytest.raises(KeyError):
+        fleet.remove_replica("nope")
+    fleet.stop()
+
+
+# -- wedge bound (satellites 2 + 3) ------------------------------------------
+
+def test_effective_wedge_timeout_derived_from_tpot(served):
+    fleet = _fleet(served, n=2, clock=ManualClock(), wedge_floor=2.0,
+                   wedge_safety=10.0)
+    r0, r1 = fleet._replicas
+    # no TPOT evidence anywhere: the floor
+    assert fleet.effective_wedge_timeout(r0) == 2.0
+    assert fleet.effective_wedge_timeout() == 2.0
+    r0.tpot_ewma = 0.5
+    assert fleet.effective_wedge_timeout(r0) == pytest.approx(5.0)
+    # a replica with no EWMA borrows the slowest sibling's
+    assert fleet.effective_wedge_timeout(r1) == pytest.approx(5.0)
+    # derived bound never drops below the floor
+    r0.tpot_ewma = 0.01
+    r1.tpot_ewma = 0.01
+    assert fleet.effective_wedge_timeout(r0) == 2.0
+    fleet.stop()
+    # an explicit kwarg is an absolute override
+    fleet = _fleet(served, n=1, clock=ManualClock(), wedge_timeout=1.25)
+    rep = fleet._replicas[0]
+    rep.tpot_ewma = 9.0
+    assert fleet.effective_wedge_timeout(rep) == 1.25
+    fleet.stop()
+
+
+@pytest.mark.timeout(120)
+def test_pump_stall_quarantined_and_failed_over(served):
+    """Manual-mode fleets used to be blind to wedges (the heartbeat
+    check lived only in the threaded supervisor): a stalled step now
+    trips the same bound from inside pump(), quarantines the replica
+    through the clean-harvest path, and fails its work over."""
+    fl = telemetry.get_flight()
+    was = fl.enabled
+    fl.enabled = True
+    try:
+        with _quiet():
+            fleet = _fleet(served, n=2, wedge_timeout=0.3,
+                           breaker_base=0.01)
+            reqs = [fleet.submit(_prompt(), 6) for _ in range(2)]
+            fleet.pump()                      # both replicas working
+            victim = max(fleet._replicas, key=lambda r: len(r.inflight))
+            n0 = fl.incident_count("engine_wedge")
+            faults.wedge_engine(victim.engine, 0.8)
+            fleet.pump()                      # the stalled tick
+            assert fl.incident_count("engine_wedge") == n0 + 1
+            fleet.wait(reqs)
+    finally:
+        fl.enabled = was
+    assert all(r.finish_reason in TERMINAL_OK for r in reqs)
+    assert fleet.stats()["failovers"] >= 1
+    fleet.stop()
+
+
+# -- deadline races / no double finalize (satellite 4) -----------------------
+
+def test_deadline_races_no_double_finalize(served):
+    """drop_expired_first + predictive admission + exact-deadline races
+    on a hand clock: every accepted rid finalizes exactly once and the
+    finish audit balances (accepted == finish_counts total)."""
+    clk = ManualClock()
+    ekw = dict(EKW, max_queue=3, shed_policy="drop_expired_first")
+    fleet = _fleet(served, n=1, clock=clk, engine_kwargs=ekw)
+    cm = CostModel()
+    cm.observe_decode(0.05)
+    ctl = FleetController(fleet, SLO(), cost_model=cm, max_engines=1)
+    with _quiet():
+        # fill the bounded queue with soon-to-expire work
+        for _ in range(3):
+            ctl.submit(_prompt(), 4, ttl=1.0)
+        # provably infeasible: shed typed, no rid assigned
+        with pytest.raises(SLOReject):
+            ctl.submit(_prompt(), 8, ttl=0.2)
+        assert fleet.submitted == 3
+        # everything queued expires; the next feasible submit must ride
+        # in over the dead seats (drop_expired_first), not be refused
+        clk.advance(2.0)
+        r5 = ctl.submit(_prompt(), 4, ttl=10.0)
+        assert fleet.submitted == 4
+        fleet.wait([r5])
+        # the race: deadline lands mid-decode, later pumps must not
+        # re-finalize the already-retired rid
+        r6 = ctl.submit(_prompt(), 6, ttl=1.0)
+        fleet.pump(2)
+        clk.advance(1.0)                     # now == deadline exactly
+        fleet.pump(4)
+    assert r6.finished and r6.finish_reason == "deadline"
+    assert r5.finish_reason in TERMINAL_OK
+    assert ctl.accepted == 5 and ctl.shed == 1
+    # finish audit balanced: every accepted rid retired exactly once
+    assert sum(fleet.finish_counts.values()) == 5
+    recs = fleet._replicas[0].engine.records
+    rids = [rec["id"] for rec in recs]
+    assert len(rids) == len(set(rids)) == 5
+    by_reason = {}
+    for rec in recs:
+        by_reason[rec["finish_reason"]] = \
+            by_reason.get(rec["finish_reason"], 0) + 1
+    assert by_reason == fleet.finish_counts
+    fleet.stop()
+
+
+# -- profiler signature cache (satellite 1) ----------------------------------
+
+def test_profiler_signature_cache_short_circuits():
+    prof = ProgramProfiler()
+    calls = []
+
+    def factory():
+        calls.append(1)
+        return None
+
+    p1 = prof.capture("sig_prog", factory, cost={"flops": 5.0},
+                      signature="s1")
+    assert calls == [1] and prof.cache_hits == 0
+    p2 = prof.capture("sig_prog", factory, cost={"flops": 5.0},
+                      signature="s1")
+    assert p2 is p1                      # stored profile, untouched
+    assert calls == [1] and prof.cache_hits == 1
+    # a CHANGED signature re-analyzes and replaces
+    p3 = prof.capture("sig_prog", factory, cost={"flops": 7.0},
+                      signature="s2")
+    assert calls == [1, 1] and p3["cost"]["flops"] == 7.0
+    assert prof.profile("sig_prog")["signature"] == "s2"
+    # no signature: the old replace-always behavior
+    prof.capture("sig_prog", factory, cost={"flops": 9.0})
+    assert calls == [1, 1, 1]
+    assert prof.cache_hits == 1
+
+
+def test_engine_capture_cost_profiles_retrace_flat(served):
+    """Continuous profiling under the controller must not re-lower per
+    tick: the second capture of an unchanged engine is a pure cache hit
+    (trace counters advance exactly once, for the first capture)."""
+    ex, model = served
+    eng = InferenceEngine(ex, model, **EKW)
+    prof = ProgramProfiler()
+    t0 = dict(eng.trace_counts)
+    p1 = eng.capture_cost_profiles(prof)
+    t1 = dict(eng.trace_counts)
+    # the first capture pays the AOT re-lower: prefill always re-traces
+    # (its lowering shape differs from the serving call); step may hit
+    # the jit trace cache when shapes coincide — bounded either way
+    assert t1["prefill"] == t0["prefill"] + 1
+    assert t0["step"] <= t1["step"] <= t0["step"] + 1
+    assert set(p1) == {"prefill", "decode"}
+    assert p1["decode"]["name"] == "slo_decode"
+    assert p1["decode"]["signature"].endswith(":decode")
+    p2 = eng.capture_cost_profiles(prof)
+    assert dict(eng.trace_counts) == t1             # flat: cache hit
+    assert prof.cache_hits == 2
+    assert p2["prefill"] is p1["prefill"]
+    assert p2["decode"] is p1["decode"]
+    # a DIFFERENT slot geometry is a different signature: re-captures
+    eng2 = InferenceEngine(ex, model, **dict(EKW, n_slots=3))
+    assert eng2.cost_signature() != eng.cost_signature()
+    eng2.close()
+    eng.close()
+
+
+# -- introspection -----------------------------------------------------------
+
+def test_slo_report_endpoint_lists_live_controllers(served):
+    fleet = _fleet(served, n=1, clock=ManualClock(), name="slorep")
+    ctl = FleetController(fleet, SLO(), max_engines=1)
+    ctl.tick()
+    block = telemetry._slo_block()
+    assert "slorep" in block
+    rep = block["slorep"]
+    assert rep["level_name"] == "normal"
+    assert rep["counters"]["ticks"] == 1
+    assert rep["n_engines"] == 1
+    assert slo_report()["slorep"]["controller"] == "slorep"
+    fleet.stop()
+
+
+def test_controller_start_stop_thread(served):
+    """The threaded drive: a daemon tick loop that survives tick errors
+    and joins cleanly on stop (the leaked-thread gate covers the rest).
+    """
+    fleet = _fleet(served, n=1)
+    ctl = FleetController(fleet, SLO(), max_engines=1)
+    with ctl:
+        ctl.start(interval=0.001)
+        assert ctl.start() is ctl            # idempotent
+        fleet._wait_for(lambda: ctl.ticks >= 3, 30, "controller ticks")
+    assert ctl._thread is None and not ctl._running
+    fleet.stop()

@@ -15,6 +15,13 @@ direction-aware per-signal tolerances:
   version + shape set, so the default tolerance is tight (1%) — a
   compiled program quietly growing flops/bytes or a pool growing live
   HBM is exactly what this gate exists to catch.
+* attainment signals (``*attainment*``, from ``bench.py --slo``):
+  higher is better and ONE-SIDED in absolute points on a [0, 1] scale —
+  a regression is current < baseline - tol_attainment (default 0.05 =
+  5 points); gains never fail.
+* informational signals (``*shed_fraction*``): reported, never gating —
+  how much the SLO controller shed is context for the attainment
+  number, not independently good or bad.
 
 Signals present on only one side are reported as notes, never failures
 (new programs appear, old ones retire).  Exit status: 0 when every
@@ -39,10 +46,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: signal-name fragments that mark a higher-is-better (throughput) signal
 THROUGHPUT_MARKERS = (".mfu", "_per_sec")
+#: higher-is-better one-sided signals compared in absolute points
+ATTAINMENT_MARKERS = ("attainment",)
+#: context-only signals that never gate
+INFO_MARKERS = ("shed_fraction",)
 
 
 def classify(name):
-    """'throughput' (higher is better) or 'static' (lower is better)."""
+    """'attainment' (higher is better, absolute one-sided), 'info'
+    (never gates), 'throughput' (higher is better, ratio), or 'static'
+    (lower is better, ratio)."""
+    if any(m in name for m in ATTAINMENT_MARKERS):
+        return "attainment"
+    if any(m in name for m in INFO_MARKERS):
+        return "info"
     return ("throughput"
             if any(m in name for m in THROUGHPUT_MARKERS) else "static")
 
@@ -78,7 +95,8 @@ def load_history_entry(path, index):
         return None
 
 
-def diff_signals(current, baseline, tol_throughput, tol_static):
+def diff_signals(current, baseline, tol_throughput, tol_static,
+                 tol_attainment=0.05):
     """Per-signal verdicts: [{signal, kind, current, baseline, ratio,
     regressed}] for shared signals, plus the one-sided names."""
     rows, only_current, only_baseline = [], [], []
@@ -91,7 +109,16 @@ def diff_signals(current, baseline, tol_throughput, tol_static):
             continue
         cur, base = float(current[name]), float(baseline[name])
         kind = classify(name)
-        if base == 0:
+        if kind == "attainment":
+            # absolute points, one-sided: only a DROP beyond the
+            # tolerance fails (a ratio misreads a 0.02 -> 0.01 noise
+            # wiggle as a 50% collapse)
+            ratio = None if base == 0 else cur / base
+            regressed = (base - cur) > tol_attainment
+        elif kind == "info":
+            ratio = None if base == 0 else cur / base
+            regressed = False
+        elif base == 0:
             # a zero baseline can't scale a tolerance; only flag a
             # static signal that became nonzero (new cost from nothing)
             regressed = kind == "static" and cur > 0
@@ -137,6 +164,10 @@ def main(argv=None):
     ap.add_argument("--tol-static", type=float, default=0.01,
                     help="allowed fractional GROWTH of a static "
                          "cost/memory signal (default 0.01)")
+    ap.add_argument("--tol-attainment", type=float, default=0.05,
+                    help="allowed absolute DROP of an attainment "
+                         "signal, in fractions of 1 (default 0.05 = "
+                         "5 points)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full verdict table as JSON")
     args = ap.parse_args(argv)
@@ -174,14 +205,16 @@ def main(argv=None):
         return 0
 
     rows, only_cur, only_base = diff_signals(
-        current, baseline, args.tol_throughput, args.tol_static)
+        current, baseline, args.tol_throughput, args.tol_static,
+        args.tol_attainment)
     regressions = [r for r in rows if r["regressed"]]
     summary = {"status": "regressed" if regressions else "ok",
                "baseline": baseline_src,
                "compared": len(rows),
                "regressions": len(regressions),
                "tolerances": {"throughput": args.tol_throughput,
-                              "static": args.tol_static},
+                              "static": args.tol_static,
+                              "attainment": args.tol_attainment},
                "new_signals": only_cur,
                "missing_signals": only_base}
     if args.json:
