@@ -742,7 +742,7 @@ CHAOS_DETAIL_PATH = os.environ.get(
                  "CHAOS_FULL.json"))
 
 
-def _chaos_build(tag, guard=None, B=32, rows=2000):
+def _chaos_build(tag, guard=None, B=32, rows=2000, numerics=None):
     """Small W&D train step (the chaos workload: cheap, NaN-prone float
     path through labels/dense) + a deterministic per-step batch maker."""
     import hetu_tpu as ht
@@ -756,6 +756,8 @@ def _chaos_build(tag, guard=None, B=32, rows=2000):
         model = WDL(rows, embedding_dim=8)
         loss = model.loss(dense, sparse, labels)
     kw = {"step_guard": guard} if guard is not None else {}
+    if numerics is not None:
+        kw["numerics"] = numerics
     ex = ht.Executor(
         {"train": [loss, ht.AdamOptimizer(0.01).minimize(loss)]}, **kw)
 
@@ -773,20 +775,45 @@ def _chaos_build(tag, guard=None, B=32, rows=2000):
 
 def _chaos_nan_skip(steps, injector):
     """NaN batches absorbed by the skip policy: the fused select keeps
-    params clean and the run finishes finite."""
+    params clean and the run finishes finite.  A NumericsMonitor rides
+    along so every trip carries culprit layer attribution — with the
+    flight recorder on, the guard_trip incident dump must NAME the
+    culprit layer (the ISSUE 12 acceptance gate)."""
+    from hetu_tpu import telemetry
     from hetu_tpu.resilience import StepGuard
+    from hetu_tpu.telemetry import NumericsMonitor
     guard = StepGuard(policy="skip")
-    ex, batch = _chaos_build("skip", guard)
+    mon = NumericsMonitor(name="chaos_nan", check_interval=1)
+    ex, batch = _chaos_build("skip", guard, numerics=mon)
     fault_at = set(injector.pick_steps(steps, n_faults=2))
     for i in range(steps):
         ex.run("train", feed_dict=batch(i, bad=i in fault_at))
     guard.flush()
+    mon.flush()
     final = ex.run("train", feed_dict=batch(steps),
                    convert_to_numpy_ret_vals=True)
-    return {"faults_injected": len(fault_at),
-            "faults_recovered": int(guard.stats["skipped"]),
-            "steps": steps,
-            "final_loss_finite": bool(np.isfinite(final[0]))}
+    culprit = mon.culprit()
+    layers = set(mon.layers or ())
+    out = {"faults_injected": len(fault_at),
+           "faults_recovered": int(guard.stats["skipped"]),
+           "steps": steps,
+           "final_loss_finite": bool(np.isfinite(final[0])),
+           "culprit_layer": culprit.get("first_nonfinite"),
+           "nonfinite_layers": culprit.get("nonfinite_layers")}
+    assert out["culprit_layer"] in layers, \
+        f"numerics culprit {out['culprit_layer']!r} is not a model layer"
+    fl = telemetry.get_flight()
+    if fl.enabled and fl.incident_dir:
+        trips = [e for e in fl.incidents() if e["kind"] == "guard_trip"]
+        assert trips, "no guard_trip incident despite injected NaNs"
+        dump = fl.load_dump(trips[-1]["path"])
+        named = ((dump.get("extra") or {}).get("culprit")
+                 or {}).get("first_nonfinite")
+        assert named in layers, \
+            f"guard_trip incident dump culprit {named!r} not a layer"
+        out["culprit_in_incident"] = named
+    mon.close()
+    return out
 
 
 def _chaos_nan_rollback(steps, injector, tmpdir):
@@ -939,6 +966,50 @@ def _chaos_overhead(steps, check_interval=4):
     return out
 
 
+def _chaos_numerics_overhead(steps, check_interval=4, sample_every=256):
+    """Steady-state numerics-plane cost: monitored vs plain steps/sec
+    on the same workload, interleaved groups + median of ratios (the
+    chaos-overhead protocol).  Target <= 1% at the production config —
+    off-cadence steps run a program with NO stats in it at all (the
+    executor switches to the stats-bearing twin host-side every
+    ``sample_every``-th step), and host reads are deferred by
+    ``check_interval`` so the step path stays sync-free.  Each timing
+    group spans exactly ``sample_every`` steps, so every group pays
+    exactly one sampled step wherever the cadence phase lands.
+    (``sample_every=1`` forensics mode pays ~3 extra memory passes per
+    step: near-free on TPU where the reduces fuse into the update
+    fusion, visible on CPU.)"""
+    import jax.numpy as jnp
+    from hetu_tpu.telemetry import NumericsMonitor
+    mon = NumericsMonitor(name="ovh_num", check_interval=check_interval,
+                          sample_every=sample_every)
+    exn, batchn = _chaos_build("ovh_n", numerics=mon)
+    exp, batchp = _chaos_build("ovh_p")
+    fn = {k: jnp.asarray(v) for k, v in batchn(0).items()}
+    fp = {k: jnp.asarray(v) for k, v in batchp(0).items()}
+    run_n = lambda: exn.run("train", feed_dict=fn)    # noqa: E731
+    run_p = lambda: exp.run("train", feed_dict=fp)    # noqa: E731
+    for _ in range(2):                # compile both variants + warm
+        run_n(), run_p()
+    group = sample_every
+    ratios, n_best, p_best = [], 0.0, 0.0
+    for r in range(8):
+        first, second = (run_n, run_p) if r % 2 else (run_p, run_n)
+        a = 1.0 / _time_group(first, group)
+        b = 1.0 / _time_group(second, group)
+        n, p = (a, b) if r % 2 else (b, a)
+        ratios.append(n / p)
+        n_best, p_best = max(n_best, n), max(p_best, p)
+    mon.flush()
+    mon.close()
+    ratio = sorted(ratios)[len(ratios) // 2]
+    return {"numerics_on_steps_per_sec": round(n_best, 2),
+            "numerics_off_steps_per_sec": round(p_best, 2),
+            "numerics_overhead_frac": round(max(0.0, 1.0 - ratio), 4),
+            "check_interval": check_interval,
+            "sample_every": sample_every}
+
+
 def _telemetry_on():
     """Enable the unified runtime telemetry for this process (bench
     --telemetry): registry + tracer + request trace + flight recorder
@@ -1055,6 +1126,7 @@ def run_chaos(quick=False, seed=0):
     with tempfile.TemporaryDirectory() as d:
         stages["preempt"] = _staged(_chaos_preempt, injector, d)
     overhead = _chaos_overhead(steps)
+    numerics_overhead = _chaos_numerics_overhead(steps)
     out = {"metric": "chaos_resilience",
            "value": sum(s["faults_recovered"] for s in stages.values()),
            "unit": "faults_recovered",
@@ -1062,6 +1134,7 @@ def run_chaos(quick=False, seed=0):
            "platform": jax.default_backend(),
            "stages": stages}
     out.update(overhead)
+    out["numerics"] = numerics_overhead
     out["all_stages_recovered"] = all(
         s["faults_recovered"] >= 1 for s in stages.values())
     return out
@@ -1091,6 +1164,11 @@ def _emit_chaos(out, detail_path=None):
     if "telemetry_overhead" in out:
         compact["telemetry_overhead_frac"] = \
             out["telemetry_overhead"]["overhead_frac"]
+    if "numerics" in out:
+        compact["numerics_overhead_frac"] = \
+            out["numerics"]["numerics_overhead_frac"]
+        compact["culprit_layer"] = \
+            out["stages"].get("nan_skip", {}).get("culprit_layer")
     _print_compact(compact, drop_order=("host_gap",))
 
 

@@ -20,6 +20,7 @@ materialized by hand in its graph-rewrite pass (context.py:1469).
 
 from __future__ import annotations
 
+import functools
 import time
 import zlib
 
@@ -78,6 +79,9 @@ class SubExecutor:
         self._ps_pending = []
         self._jitted = None
         self._multi_jitted = None   # lazily-built run_steps program
+        self._numerics_layers = None  # set by _build when a monitor rides
+        self._numerics_sample = 1     # in-graph stats sampling cadence
+        self._jitted_stats = None     # stats-bearing twin (sampled mode)
         # fast-path cache for steady-state training loops: the first
         # slow-path run() caches the feed pytree STRUCTURE — key set,
         # canonical names, declared dtypes, which placeholders are
@@ -191,6 +195,36 @@ class SubExecutor:
         guard_losses = ([op.loss for op in self.opt_ops
                          if getattr(op, "loss", None) is not None]
                         if guard is not None else [])
+        # telemetry.NumericsMonitor: like the guard sentinel, the
+        # per-layer stats vector is traced INTO the step when a monitor
+        # is attached, so each L2 reduce fuses with the grad/update
+        # computation that produced the tensor.  The layer spec is
+        # static (optimizer var lists, keyed by profiling.layer_of), so
+        # the row order is fixed before any trace runs.
+        numerics_groups = None
+        numerics_sample = 1
+        self._numerics_layers = None
+        self._numerics_sample = 1
+        if (self.executor.config.get("numerics") is not None
+                and self.training and self.opt_ops):
+            numerics_sample = max(1, int(getattr(
+                self.executor.config["numerics"], "sample_every", 1)))
+            self._numerics_sample = numerics_sample
+            from ..telemetry.profiling import layer_of
+            groups = {}
+            for op in self.opt_ops:
+                if not hasattr(op, "var_list"):
+                    continue
+                for var, gnode in zip(op.var_list,
+                                      op.inputs[:len(op.var_list)]):
+                    groups.setdefault(layer_of(var.name), []).append(
+                        (var, gnode, None))
+                for var, sites in getattr(op, "sparse", None) or []:
+                    groups.setdefault(layer_of(var.name), []).append(
+                        (var, None, sites))
+            if groups:
+                numerics_groups = list(groups.items())
+                self._numerics_layers = tuple(groups)
 
         def cast(x):
             if compute_dtype is not None and jnp.issubdtype(
@@ -204,9 +238,16 @@ class SubExecutor:
         # ResNet eval, ...)
         needs_rng = any(getattr(n, "needs_rng", False) for n in topo)
 
-        def step_fn(params, opt_state, feeds, base_key, step):
+        def step_fn(params, opt_state, feeds, base_key, step,
+                    _stats="cond"):
             # host-side retrace witness: runs at TRACE time only, so the
-            # counter ticks once per compiled program variant
+            # counter ticks once per compiled program variant.
+            # ``_stats`` is a python-level mode bound per program
+            # variant (functools.partial below, never traced): None
+            # emits no stats outputs (byte-identical to an unmonitored
+            # step), "full" emits the row unconditionally, "cond"
+            # emits it under the in-graph sample_every lax.cond
+            # (run_steps' amortized path).
             self._m_retrace.inc()
             # the per-step key derives INSIDE the program from a
             # device-resident step counter — an eager fold_in per run()
@@ -234,6 +275,60 @@ class SubExecutor:
                 new_params[var.name] = val.astype(params[var.name].dtype)
             new_opt_state = dict(opt_state)
             new_opt_state.update(ctx.new_opt_state)
+            nstats = None
+            if numerics_groups is not None and _stats is not None:
+                # fused per-layer stats: sums of squares of the grad,
+                # the ATTEMPTED update delta (pre skip-select, so a
+                # poisoned step shows its non-finite norms even when
+                # the guard discards it), and the current params — one
+                # [n_layers, 3] f32 row block per step.  Sqrt happens
+                # host-side; NaN/inf propagate through the sums, so a
+                # non-finite row IS the per-layer finite flag.
+                def _sumsq(x):
+                    x = x.astype(jnp.float32)
+                    return jnp.sum(x * x)
+
+                def _nstats():
+                    rows = []
+                    for _layer, entries in numerics_groups:
+                        gsq = jnp.float32(0)
+                        usq = jnp.float32(0)
+                        psq = jnp.float32(0)
+                        for var, gnode, sites in entries:
+                            old = params[var.name]
+                            psq = psq + _sumsq(old)
+                            new = ctx.updates.get(var)
+                            if new is not None:
+                                usq = usq + _sumsq(
+                                    new.astype(jnp.float32)
+                                    - old.astype(jnp.float32))
+                            if gnode is not None and gnode in env:
+                                gsq = gsq + _sumsq(env[gnode])
+                            for rnode, _ids in (sites or ()):
+                                if rnode in env:
+                                    # sparse tables: L2 over the batch's
+                                    # touched row grads (dense rows are
+                                    # 0)
+                                    gsq = gsq + _sumsq(env[rnode])
+                        rows.append(jnp.stack([gsq, usq, psq]))
+                    return jnp.stack(rows)
+
+                if _stats == "cond" and numerics_sample > 1:
+                    # sampled cadence inside run_steps' fori_loop: the
+                    # reductions run only on every sample_every-th
+                    # inner step (real control flow, not a select);
+                    # the loop carry keeps the latest SAMPLED row, so
+                    # the zeros filler is never surfaced.  The single-
+                    # step run() path never pays even the cond — it
+                    # switches between the plain and "full" compiled
+                    # programs host-side on the same cadence.
+                    nstats = jax.lax.cond(
+                        (step % jnp.uint32(numerics_sample)) == 0,
+                        _nstats,
+                        lambda: jnp.zeros((len(numerics_groups), 3),
+                                          jnp.float32))
+                else:
+                    nstats = _nstats()
             if guard is not None:
                 # fused guard sentinel: one scalar conjunction over loss
                 # finiteness and every parameter update written this step
@@ -271,13 +366,33 @@ class SubExecutor:
                         new_opt_state[k] = jax.tree_util.tree_map(
                             lambda nv, ov: jnp.where(gfin, nv, ov),
                             new_opt_state[k], opt_state[k])
+            # hidden trailing outputs, strip order (last-first in
+            # _dispatch): [.., nstats][gfin, gloss]
+            if nstats is not None:
+                vals = list(vals) + [nstats]
+            if guard is not None:
                 vals = list(vals) + [gfin, gloss]
             return vals, new_params, new_opt_state, step + 1
 
         self._step_fn = step_fn   # run_steps builds its scan over this
         donate = ((0, 1, 4) if self.training and self._should_donate()
                   else (4,))
+        # single-step program variants: on a sampled cadence the
+        # steady-state program carries NO stats (the stats reductions
+        # would otherwise pin the pre-update params live across the
+        # update — a cond can't help, its operand liveness is static —
+        # costing a params copy per step); the "full" twin runs only
+        # on every sample_every-th dispatch.
+        single = step_fn
+        stats_fn = None
+        if numerics_groups is not None:
+            if numerics_sample == 1:
+                single = functools.partial(step_fn, _stats="full")
+            else:
+                single = functools.partial(step_fn, _stats=None)
+                stats_fn = functools.partial(step_fn, _stats="full")
         in_shardings = self.executor._input_shardings(self)
+        self._jitted_stats = None
         if in_shardings is not None:
             # pin updated params/opt-state to their INPUT shardings: with
             # interior reshard constraints in the program, GSPMD may
@@ -289,11 +404,19 @@ class SubExecutor:
             rep = replicated(self.executor.mesh)
             param_sh, opt_sh, _, _, _ = in_shardings
             out_shardings = (rep, param_sh, opt_sh, rep)
-            self._jitted = jax.jit(step_fn, donate_argnums=donate,
+            self._jitted = jax.jit(single, donate_argnums=donate,
                                    in_shardings=in_shardings,
                                    out_shardings=out_shardings)
+            if stats_fn is not None:
+                self._jitted_stats = jax.jit(
+                    stats_fn, donate_argnums=donate,
+                    in_shardings=in_shardings,
+                    out_shardings=out_shardings)
         else:
-            self._jitted = jax.jit(step_fn, donate_argnums=donate)
+            self._jitted = jax.jit(single, donate_argnums=donate)
+            if stats_fn is not None:
+                self._jitted_stats = jax.jit(stats_fn,
+                                             donate_argnums=donate)
 
     def _fast_resolve(self, feed_dict):
         """Steady-state dispatch: swap leaf buffers into the cached feed
@@ -458,12 +581,22 @@ class SubExecutor:
     def _dispatch(self, ex, feeds, ps_ids, convert_to_numpy_ret_vals):
         if ex._step_arr is None:
             ex._step_arr = jnp.uint32(ex._global_step)
+        # numerics cadence for the step about to run (counter value
+        # ex._global_step): off-cadence steps run the plain program —
+        # zero stats cost, not even a cond — the sampled ones run the
+        # stats-bearing twin
+        has_stats = self._numerics_layers is not None and (
+            self._numerics_sample == 1
+            or ex._global_step % self._numerics_sample == 0)
+        fn = (self._jitted_stats
+              if has_stats and self._jitted_stats is not None
+              else self._jitted)
         ex._global_step += 1
         # "dispatch" phase: the jitted call itself — asynchronous on
         # accelerators, so time spent HERE past the enqueue cost is
         # runtime back-pressure (in-flight queue full ≈ device-bound)
         with self._tr.span("dispatch"):
-            vals, new_params, new_opt_state, ex._step_arr = self._jitted(
+            vals, new_params, new_opt_state, ex._step_arr = fn(
                 ex.params, ex.opt_state, feeds, ex._base_key,
                 ex._step_arr)
         ex.params = new_params
@@ -473,6 +606,12 @@ class SubExecutor:
         guard_out = None
         if guard is not None:
             guard_out, vals = vals[-2:], vals[:-2]
+        # the per-layer numerics stats block rides just before them
+        # (only on the stats-bearing program — off-cadence dispatches
+        # emit no row at all)
+        nstats_out = None
+        if has_stats:
+            nstats_out, vals = vals[-1], vals[:-1]
         # poll monitor counters after this SUBGRAPH's first step and
         # every interval of ITS runs (a global-step schedule can
         # permanently miss a subgraph under alternating train/validate);
@@ -510,6 +649,13 @@ class SubExecutor:
                 f.result()
                 self._ps_pending.remove(f)
             vals = vals[:n_user]
+        if nstats_out is not None:
+            # BEFORE the guard check, so a trip this step can attribute
+            # its culprit layer from the freshly queued stats row
+            with self._tr.span("numerics"):
+                ex.config["numerics"].on_step(
+                    ex, self._numerics_layers, ex._global_step,
+                    nstats_out)
         if guard_out is not None:
             # after PS pushes so a rollback can't orphan in-flight grads;
             # may restore executor state or raise GuardTripped (abort)
@@ -582,6 +728,10 @@ class SubExecutor:
             # guard state at build time matches _build's: attach/detach
             # invalidate both compiled programs together
             guarded = ex.config.get("step_guard") is not None
+            nlayers = len(self._numerics_layers or ())
+            nsample = self._numerics_sample if nlayers else 1
+            # the stats block rides before the two guard scalars
+            stats_idx = -3 if guarded else -1
 
             def multi_fn(params, opt_state, feeds, base_key, step,
                          n_steps):
@@ -589,26 +739,48 @@ class SubExecutor:
                 # every inner step accumulates into a carried counter,
                 # so trips are EXACT across the fori_loop instead of
                 # detected only at the call boundary (ROADMAP item).
-                # vals[-2] is the step's fused gfin sentinel.
-                def body(_, carry):
-                    params, opt_state, step, trips = carry
+                # vals[-2] is the step's fused gfin sentinel.  The
+                # numerics carry does the same per LAYER: an int32
+                # [n_layers] count of inner steps whose stats row went
+                # non-finite.  On the sampled cadence the latest
+                # SAMPLED row is carried too, so the window's newest
+                # real stats come back whichever inner step they
+                # belong to (zeros filler rows are never surfaced).
+                def nf_of(vals, nf):
+                    row_ok = jnp.isfinite(
+                        jnp.sum(vals[stats_idx], axis=1))
+                    return nf + jnp.where(row_ok, 0, 1).astype(jnp.int32)
+
+                def advance(carry):
+                    params, opt_state, step, trips, nf, nrow = carry
+                    prev = step
                     vals, params, opt_state, step = step_fn(
                         params, opt_state, feeds, base_key, step)
                     if guarded:
                         trips = trips + jnp.where(vals[-2], 0, 1).astype(
                             jnp.int32)
-                    return (params, opt_state, step, trips)
+                    if nlayers:
+                        nf = nf_of(vals, nf)
+                        if nsample > 1:
+                            nrow = jnp.where(
+                                (prev % jnp.uint32(nsample)) == 0,
+                                vals[stats_idx], nrow)
+                    return vals, (params, opt_state, step, trips, nf,
+                                  nrow)
 
-                params, opt_state, step, trips = jax.lax.fori_loop(
-                    0, n_steps - 1, body,
-                    (params, opt_state, step, jnp.int32(0)))
+                carry = (params, opt_state, step, jnp.int32(0),
+                         jnp.zeros((nlayers,), jnp.int32),
+                         jnp.zeros((nlayers, 3), jnp.float32))
+                carry = jax.lax.fori_loop(
+                    0, n_steps - 1,
+                    lambda _, c: advance(c)[1], carry)
                 # last step outside the loop so its values are returned
-                vals, params, opt_state, step = step_fn(
-                    params, opt_state, feeds, base_key, step)
-                if guarded:
-                    trips = trips + jnp.where(vals[-2], 0, 1).astype(
-                        jnp.int32)
-                return vals, params, opt_state, step, trips
+                vals, carry = advance(carry)
+                params, opt_state, step, trips, nf, nrow = carry
+                if nlayers and nsample > 1:
+                    vals = list(vals)
+                    vals[stats_idx] = nrow
+                return vals, params, opt_state, step, trips, nf
 
             self._multi_jitted = jax.jit(multi_fn, donate_argnums=donate)
         if ex._step_arr is None:
@@ -616,18 +788,35 @@ class SubExecutor:
         ex._global_step += n
         with self._tr.span("dispatch"):
             (vals, ex.params, ex.opt_state, ex._step_arr,
-             trips_arr) = self._multi_jitted(
+             trips_arr, nf_arr) = self._multi_jitted(
                 ex.params, ex.opt_state, feeds, ex._base_key,
                 ex._step_arr, jnp.int32(n))
         self._m_steps.inc(n)
         self._m_multi.inc()
         guard = ex.config.get("step_guard")
+        guard_out = None
         if guard is not None:
+            guard_out, vals = vals[-2:], vals[:-2]
+        if self._numerics_layers is not None:
+            # the returned stats cover the FINAL inner step (latest
+            # SAMPLED inner step on the sampled cadence); the carried
+            # [n_layers] counter attributes every inner step's
+            # non-finite rows exactly (mirroring inner_trips).  A
+            # window too short to contain a sampled step delivers
+            # nothing — the filler row carries no information.
+            nstats_out, vals = vals[-1], vals[:-1]
+            ns = self._numerics_sample
+            s0 = ex._global_step - n
+            if ns == 1 or ((s0 + n - 1) // ns) * ns >= s0:
+                with self._tr.span("numerics"):
+                    ex.config["numerics"].on_step(
+                        ex, self._numerics_layers, ex._global_step,
+                        nstats_out, n=n, inner_nf=nf_arr)
+        if guard_out is not None:
             # the returned sentinel covers the FINAL inner step; the
             # carried counter reports every inner step's trip exactly
             # (the 'skip' policy's in-graph select still protects every
             # inner step; rollback/abort act at the call boundary)
-            guard_out, vals = vals[-2:], vals[:-2]
             with self._tr.span("guard_check"):
                 guard.on_step(ex, guard_out[0], guard_out[1], n=n,
                               inner_trips=trips_arr)
@@ -846,6 +1035,10 @@ class Executor:
         # bind it so policy actions (rollback/abort) can reach this state
         if self.config.get("step_guard") is not None:
             self.config["step_guard"]._bind(self)
+        # telemetry.NumericsMonitor passed as Executor(..., numerics=mon):
+        # bind so escalation can find the guard through this executor
+        if self.config.get("numerics") is not None:
+            self.config["numerics"]._executor = self
 
     # -- sharding hooks (filled in by parallel layer) ----------------------
     def _place(self, var, value):

@@ -49,16 +49,27 @@ from .. import telemetry as _telemetry
 
 
 class GuardTripped(RuntimeError):
-    """The step guard detected a fault it was told not to absorb."""
+    """The step guard detected a fault it was told not to absorb.
 
-    def __init__(self, reason, step, loss=None):
+    ``culprit`` carries the NumericsMonitor's layer attribution when
+    one is attached to the same executor: a dict with
+    ``first_nonfinite`` (first layer whose stats row went non-finite)
+    and ``largest_z`` (layer with the largest grad-norm z-score)."""
+
+    def __init__(self, reason, step, loss=None, culprit=None):
         msg = f"step guard tripped at step {step}: {reason}"
         if loss is not None:
             msg += f" (loss={loss!r})"
+        if culprit is not None:
+            layer = culprit.get("first_nonfinite") or culprit.get(
+                "largest_z")
+            if layer:
+                msg += f" [culprit layer: {layer}]"
         super().__init__(msg)
         self.reason = reason
         self.step = step
         self.loss = loss
+        self.culprit = culprit
 
 
 class StepGuard:
@@ -194,17 +205,32 @@ class StepGuard:
                          else self.ema_decay * ema
                          + (1.0 - self.ema_decay) * loss)
 
+    def _culprit(self, step):
+        """Layer attribution from the NumericsMonitor sharing this
+        executor, if one rides: who went non-finite first, who has the
+        largest grad-norm z-score.  None when no monitor is attached
+        (attribution must never turn a trip into a second failure)."""
+        ex = self._executor
+        mon = ex.config.get("numerics") if ex is not None else None
+        if mon is None:
+            return None
+        try:
+            return mon.culprit(step)
+        except Exception:
+            return None
+
     def _trip(self, reason, step, loss):
         self.stats["trip_steps"].append(int(step))
         self._m_trips.inc()
+        culprit = self._culprit(step)
         _telemetry.get_flight().incident(
             "guard_trip",
             extra={"reason": reason, "step": int(step),
                    "loss": (float(loss) if loss is not None
                             and np.isfinite(loss) else None),
-                   "policy": self.policy})
+                   "policy": self.policy, "culprit": culprit})
         if self.policy == "abort":
-            raise GuardTripped(reason, step, loss)
+            raise GuardTripped(reason, step, loss, culprit=culprit)
         if self.policy == "skip":
             self.stats["skipped"] += 1
             if "spike" in reason:
@@ -220,7 +246,7 @@ class StepGuard:
             raise GuardTripped(
                 f"{reason} — exceeded max_rollbacks={self.max_rollbacks} "
                 "(the fault is recurring; aborting instead of looping)",
-                step, loss)
+                step, loss, culprit=culprit)
         # sentinels still queued describe the now-discarded timeline
         self._pending.clear()
         self._ema = None

@@ -216,7 +216,9 @@ class FleetController:
                  scale_up_queue=4.0, scale_down_queue=0.5,
                  cooldown_s=2.0, ewma_alpha=0.3,
                  degrade_enter_ticks=10, degrade_exit_ticks=20,
-                 brownout_max_new=16, admission_margin=1.0):
+                 brownout_max_new=16, admission_margin=1.0,
+                 hbm_limit_bytes=None, hbm_safety=0.9,
+                 mfu_scale_threshold=None):
         if min_engines < 1:
             raise ValueError(
                 f"min_engines must be >= 1, got {min_engines}")
@@ -239,6 +241,18 @@ class FleetController:
         self.degrade_exit_ticks = int(degrade_exit_ticks)
         self.brownout_max_new = int(brownout_max_new)
         self.admission_margin = float(admission_margin)
+        # direction-5 memory/compute inputs: the HbmLedger's tracked
+        # bytes vs device capacity gate scale-up (a replica whose KV
+        # pool won't fit must not be added just to crash), and measured
+        # MFU (ProgramProfiler.observe) reads as compute saturation
+        self.hbm_limit_bytes = (None if hbm_limit_bytes is None
+                                else int(hbm_limit_bytes))
+        self.hbm_safety = float(hbm_safety)
+        self.mfu_scale_threshold = (None if mfu_scale_threshold is None
+                                    else float(mfu_scale_threshold))
+        self.hbm_headroom = None
+        self.mfu = None
+        self.hbm_blocked = 0
         # controller state
         self.level = 0
         self.queue_ewma = None
@@ -292,6 +306,11 @@ class FleetController:
             "hetu_slo_attainment",
             "Fraction of offered work (finished + shed) that completed "
             "healthily (eos/max_new)")
+        self._m_headroom = _g(
+            "hetu_slo_hbm_headroom",
+            "Usable device HBM headroom in bytes (safety-scaled device "
+            "capacity minus HbmLedger live bytes) seen by the "
+            "controller's scale gate")
         self._m_scale = reg.counter(
             "hetu_slo_scale_events_total",
             "Autoscale actions taken by the controller",
@@ -424,6 +443,54 @@ class FleetController:
         if best is not None:
             self.cost.observe_decode(best)
 
+    def _device_hbm_limit(self):
+        if self.hbm_limit_bytes is not None:
+            return self.hbm_limit_bytes
+        limit = 16 * 1024 ** 3   # v5e/v5p-class HBM default
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats()
+        except Exception:   # backend without memory_stats (CPU) — the
+            stats = None    # nominal default above stands
+        if stats and stats.get("bytes_limit"):
+            limit = stats["bytes_limit"]
+        return int(limit)
+
+    def _sense_capacity(self):
+        """Fold the telemetry plane's memory/compute evidence into the
+        controller: HBM headroom (safety-scaled device capacity minus
+        the ledger's live bytes) and the best measured MFU across
+        captured program profiles (only ``observe``-d profiles carry
+        one)."""
+        led = _telemetry.get_hbm_ledger()
+        headroom = (self.hbm_safety * self._device_hbm_limit()
+                    - led.live_bytes())
+        self.hbm_headroom = float(headroom)
+        self._m_headroom.set(self.hbm_headroom)
+        best = None
+        for prof in _telemetry.get_profiler().profiles().values():
+            mfu = (prof.get("derived") or {}).get("mfu")
+            if mfu is not None:
+                best = mfu if best is None else max(best, mfu)
+        self.mfu = best
+
+    def _kv_projection(self):
+        """Projected kv_cache bytes ONE more replica would pin: the
+        per-replica mean of the pool's live bytes (every replica of one
+        fleet builds the same slot geometry)."""
+        led = _telemetry.get_hbm_ledger()
+        kv = led.live_bytes("kv_cache")
+        n = sum(1 for r in self._live_replicas() if r.engine is not None)
+        return kv / n if n else 0.0
+
+    def _hbm_would_block(self):
+        """True when one more replica's projected kv_cache pool exceeds
+        the current headroom — scale-up is unavailable regardless of
+        max_engines, and the degrade ladder must carry the pressure."""
+        projected = self._kv_projection()
+        return (self.hbm_headroom is not None and projected > 0
+                and projected > self.hbm_headroom)
+
     def _violations(self):
         out = []
         if (self.miss_ewma or 0.0) > self.slo.deadline_miss_target:
@@ -481,6 +548,7 @@ class FleetController:
             self.miss_ewma = sample if self.miss_ewma is None else \
                 (1.0 - a) * self.miss_ewma + a * sample
         self._depth = depth
+        self._sense_capacity()
         self._reap_draining()
         viol = self._violations()
         self._viol_now = viol
@@ -503,8 +571,33 @@ class FleetController:
         n = len(live)
         pressure = (bool(viol)
                     or (self.queue_ewma or 0.0)
-                    > self.scale_up_queue * max(1, n))
+                    > self.scale_up_queue * max(1, n)
+                    # compute-saturated: measured MFU above the
+                    # threshold means the device, not the queue, is the
+                    # bottleneck — more replicas is the only lever
+                    or (self.mfu_scale_threshold is not None
+                        and (self.mfu or 0.0) > self.mfu_scale_threshold))
         if pressure and n < self.max_engines and not self._cool(now):
+            if self._hbm_would_block():
+                # headroom-blocked: one more replica's kv_cache pool
+                # would not fit the device — scaling up would trade an
+                # SLO violation for an OOM.  Degrade handles pressure.
+                self.hbm_blocked += 1
+                # cooldown applies to the BLOCK too: sustained pressure
+                # must not emit an incident per tick
+                self._last_scale = now
+                self._m_scale.labels(controller=self.name,
+                                     direction="up_blocked_hbm").inc()
+                self._fl.incident(
+                    "slo_scale", health=self.fleet.health(),
+                    extra={"controller": self.name,
+                           "direction": "up_blocked_hbm",
+                           "n_engines": n,
+                           "projected_kv_bytes": int(
+                               self._kv_projection()),
+                           "hbm_headroom": int(self.hbm_headroom),
+                           "violations": list(viol)})
+                return
             name = self.fleet.add_replica()
             self._last_scale = now
             self.scale_ups += 1
@@ -563,7 +656,10 @@ class FleetController:
                 self.fleet.drain(name, wait=False)
 
     def _degrade(self, now, viol):
-        at_max = len(self._live_replicas()) >= self.max_engines
+        # "can't scale" includes HBM-blocked below max_engines: the
+        # ladder must carry the pressure when adding a replica would OOM
+        at_max = (len(self._live_replicas()) >= self.max_engines
+                  or self._hbm_would_block())
         if viol and at_max:
             self._viol_ticks += 1
             self._ok_ticks = 0
@@ -631,6 +727,12 @@ class FleetController:
             "ewma": {"queue_depth": self.queue_ewma,
                      "deadline_miss": self.miss_ewma},
             "cost_model": self.cost.as_dict(),
+            "capacity": {
+                "hbm_headroom": (None if self.hbm_headroom is None
+                                 else int(self.hbm_headroom)),
+                "projected_kv_bytes": int(self._kv_projection()),
+                "mfu": self.mfu,
+                "hbm_blocked": self.hbm_blocked},
             "shed_fraction": round(self.shed_fraction(), 4),
             "attainment": round(self.attainment(), 4),
             "counters": {"ticks": self.ticks,

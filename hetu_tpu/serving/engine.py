@@ -314,21 +314,31 @@ class InferenceEngine:
         runs the same executable at the same shapes."""
         return dict(self._traces)
 
-    def cost_programs(self):
+    # AOT (prefill, decode) executables keyed by cost_signature():
+    # engines sharing a signature share exact shapes, so the compiled
+    # analysis pair is identical and a raw cost_programs() call is
+    # retrace-free after the first per signature
+    _COST_PROGRAMS = {}
+
+    def cost_programs(self, force=False):
         """AOT-lower + compile the (prefill, decode) pair at this
         engine's exact serving shapes and return ``{"prefill":
         compiled, "decode": compiled}`` for the profiling layer
         (``telemetry.profiling.ProgramProfiler.capture``).
 
-        Pure analysis — nothing executes and no engine state changes —
-        but lowering re-traces the shared python callables, so the
-        retrace witnesses (``hetu_serving_retraces_total``,
-        ``trace_counts``) each advance by one: capture profiles outside
-        any compile-once assertion window — or through
-        :meth:`capture_cost_profiles`, which keys the profiler's
-        capture cache on :meth:`cost_signature` so only the FIRST
-        capture per signature pays the re-lower (continuous profiling
-        under the SLO controller stays retrace-flat)."""
+        Pure analysis — nothing executes and no engine state changes.
+        Results are cached per :meth:`cost_signature` (like the shared
+        serving programs), so only the FIRST call per signature pays
+        the re-lower/re-trace; repeat calls — and
+        :meth:`capture_cost_profiles` misses — stay retrace-flat even
+        inside a compile-once assertion window.  ``force=True``
+        rebuilds (and refreshes the cache) unconditionally."""
+        sig = self.cost_signature()
+        if not force:
+            cached = self._COST_PROGRAMS.get(sig)
+            if cached is not None:
+                return dict(cached)
+
         def ab(x):
             return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
 
@@ -340,10 +350,12 @@ class InferenceEngine:
         scalar = jax.ShapeDtypeStruct((), jnp.int32)
         lane = jax.ShapeDtypeStruct((n,), jnp.int32)
         active = jax.ShapeDtypeStruct((n,), jnp.bool_)
-        return {"prefill": self._prefill_fn.lower(
-                    params, k, v, prompt, scalar, scalar, key).compile(),
-                "decode": self._step_fn.lower(
-                    params, k, v, lane, lane, active, key).compile()}
+        progs = {"prefill": self._prefill_fn.lower(
+                     params, k, v, prompt, scalar, scalar, key).compile(),
+                 "decode": self._step_fn.lower(
+                     params, k, v, lane, lane, active, key).compile()}
+        self._COST_PROGRAMS[sig] = dict(progs)
+        return progs
 
     def cost_signature(self):
         """Stable identity of the compiled (prefill, decode) pair at

@@ -29,6 +29,7 @@ appends :func:`report` to the stage's detail JSON.
 from __future__ import annotations
 
 from .flight import FlightRecorder, INCIDENT_KINDS
+from .numerics import ANOMALY_KINDS, NumericsMonitor, numerics_report
 from .profiling import HBM_POOLS, HbmLedger, ProgramProfiler
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        JsonlWriter, MetricsRegistry, MetricsServer,
@@ -41,6 +42,7 @@ __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "RequestTrace", "FlightRecorder", "EVENT_TYPES",
            "INCIDENT_KINDS", "DEFAULT_BUCKETS", "start_http_server",
            "HbmLedger", "ProgramProfiler", "HBM_POOLS",
+           "NumericsMonitor", "numerics_report", "ANOMALY_KINDS",
            "get_registry", "get_tracer", "get_request_trace",
            "get_flight", "get_hbm_ledger", "get_profiler",
            "enabled", "enable", "disable", "shutdown",
@@ -123,6 +125,7 @@ def enable(http_port=None, host="127.0.0.1", incident_dir=None):
                 "/incidents": _flight.incidents,
                 "/profile": _profiler.report_block,
                 "/slo": _slo_block,
+                "/numerics": numerics_report,
             })
     return _server
 
@@ -176,7 +179,7 @@ def _sync_loss_gauges(reg=None, tr=None, rt=None, fl=None):
 
 # span names recorded INSIDE SubExecutor.run()'s wall time; everything
 # else host-side (data_wait, prefetch_h2d) happens between run() calls
-_RUN_PHASES = ("h2d", "dispatch", "guard_check")
+_RUN_PHASES = ("h2d", "dispatch", "numerics", "guard_check")
 _LOOP_PHASES = ("data_wait", "prefetch_h2d")
 
 
@@ -249,7 +252,8 @@ def report(registry=None, tracer=None):
                               k: _flight.incident_count(k)
                               for k in INCIDENT_KINDS
                               if _flight.incident_count(k)}},
-            "profile": _profiler.report_block()}
+            "profile": _profiler.report_block(),
+            "numerics": numerics_report()}
 
 
 def chrome_trace(jax_trace_dir=None, **kw):

@@ -1,0 +1,412 @@
+"""Training numerics plane: per-layer gradient/update statistics.
+
+The StepGuard reduces a whole step to one fused ok-scalar — a trip says
+"something went non-finite" with no idea *which layer*, and the slow
+failure modes that precede NaNs (exploding grad norms, vanishing
+update-to-weight ratios, parameter drift) produce no signal at all.
+This module is the host side of the per-layer numerics capture:
+
+* **In-graph stats** — when a :class:`NumericsMonitor` is attached
+  (``Executor(..., numerics=mon)`` or ``mon.attach(executor)``), the
+  executor's jitted step emits ONE fused ``[n_layers, 3]`` float32
+  array of per-layer sums of squares — gradient, update delta
+  (attempted, pre-skip-select), and parameter — riding as a hidden
+  trailing output exactly like the guard sentinel.  Each reduce fuses
+  with the update computation that produced the tensor; layers are
+  keyed by the same canonical name scopes
+  :func:`~hetu_tpu.telemetry.profiling.layer_of` uses, so numerics
+  rows line up with the PR 10 cost/memory attribution.
+* **Deferred host reads** — like the guard, the monitor holds the
+  device array and materializes on the ``check_interval`` cadence
+  (one step late under ``defer``), so the step path stays sync-free.
+  ``run_steps`` carries an exact per-inner-step non-finite count per
+  layer through its fori_loop, mirroring ``inner_trips``.
+* **Anomaly detection** — per-layer EWMAs with z-scores:
+  ``spike`` (grad-norm z above ``z_threshold``), ``vanish`` (grad norm
+  collapsed below ``vanish_factor x`` its EWMA), ``drift`` (param norm
+  wandered more than ``drift_tolerance`` relative to its EWMA), and
+  ``nonfinite`` (the layer's stats row is not finite).  Derived
+  update-to-weight ratios ride along (the classic LR-sanity signal).
+* **Culprit attribution** — :meth:`culprit` names the first-non-finite
+  and largest-z layers; ``StepGuard._trip`` calls it so every
+  ``guard_trip`` incident dump and :class:`GuardTripped` carries the
+  layer that actually went bad.
+* **Escalation** — with ``escalate_after=k``, a layer anomalous for
+  ``k`` consecutive processed steps escalates into the guard's
+  skip/rollback/abort policy *before* the NaN ever lands.
+
+Everything is disabled-by-default: an executor without a monitor
+traces zero extra ops, and the monitor's instruments are the usual
+~100 ns no-ops until :func:`hetu_tpu.telemetry.enable`.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from .registry import JsonlWriter
+
+ANOMALY_KINDS = ("spike", "vanish", "drift", "nonfinite")
+
+# live monitors, for telemetry.report()["numerics"] and /numerics
+_LIVE = weakref.WeakSet()
+
+
+def numerics_report():
+    """Every live monitor's report block, keyed by monitor name (the
+    ``/numerics`` debug payload and ``telemetry.report()["numerics"]``)."""
+    return {m.name: m.report() for m in list(_LIVE)}
+
+
+class NumericsMonitor:
+    """Host-side consumer of the fused per-layer stats vector.
+
+    Attach with ``Executor(..., numerics=mon)`` or ``mon.attach(ex)``
+    (the latter invalidates compiled step programs so the stats get
+    traced in).  The executor calls :meth:`on_step` with the DEVICE
+    array; materialization is deferred per ``defer``/``check_interval``.
+    """
+
+    def __init__(self, name="train", check_interval=1, defer=True,
+                 sample_every=1, ema_decay=0.9, z_threshold=6.0,
+                 vanish_factor=1e-3, drift_tolerance=0.25, warmup=5,
+                 history_path=None, history_cap=256, guard=None,
+                 escalate_after=None, registry=None):
+        self.name = str(name)
+        self.check_interval = max(1, int(check_interval))
+        self.defer = bool(defer)
+        # in-graph sampling cadence: the stats row is COMPUTED only on
+        # steps where global_step % sample_every == 0 (a lax.cond skips
+        # the reductions entirely on the other steps).  1 = every step:
+        # exact per-step non-finite attribution, at ~3 extra memory
+        # passes over params/grads per step — cheap on TPU where the
+        # reduces fuse into the update fusion, material on CPU.
+        # Production loops that want trend monitoring at ~zero cost
+        # sample (e.g. 256, the bench twin's pinned config); forensics
+        # and the exactness tests use 1.
+        # Changing it after attach requires re-attach (the compiled
+        # step bakes the cadence in).
+        self.sample_every = max(1, int(sample_every))
+        self.ema_decay = float(ema_decay)
+        self.z_threshold = float(z_threshold)
+        self.vanish_factor = float(vanish_factor)
+        self.drift_tolerance = float(drift_tolerance)
+        self.warmup = int(warmup)
+        self.history_path = history_path
+        self.history_cap = int(history_cap)
+        self.guard = guard
+        self.escalate_after = (None if escalate_after is None
+                               else max(1, int(escalate_after)))
+        # (layers, step, stats_arr, n, inner_nf_arr_or_None)
+        self._pending = collections.deque()
+        self._epoch = time.perf_counter()
+        self._lock = threading.Lock()
+        self._executor = None
+        self._writer = None
+        self._in_culprit = False
+        self._last_nonfinite = None   # (step, [layers in row order])
+        self._last_step = None
+        self.layers = {}              # layer -> per-layer state dict
+        self.history = collections.deque(maxlen=self.history_cap)
+        self.stats = {"steps": 0, "processed": 0, "anomalies": 0,
+                      "nonfinite_rows": 0, "escalations": 0}
+        if registry is None:
+            from . import get_registry
+            registry = get_registry()
+        reg = registry
+
+        def _m(kind, name, help, labels):
+            return getattr(reg, kind)(name, help, labels=labels)
+
+        self._g_grad = _m(
+            "gauge", "hetu_numerics_grad_norm",
+            "Latest per-layer gradient L2 norm", ("monitor", "layer"))
+        self._g_update = _m(
+            "gauge", "hetu_numerics_update_norm",
+            "Latest per-layer parameter-update L2 norm (attempted "
+            "update, pre-skip-select)", ("monitor", "layer"))
+        self._g_param = _m(
+            "gauge", "hetu_numerics_param_norm",
+            "Latest per-layer parameter L2 norm", ("monitor", "layer"))
+        self._g_ratio = _m(
+            "gauge", "hetu_numerics_update_ratio",
+            "Latest per-layer update-to-weight L2 ratio",
+            ("monitor", "layer"))
+        self._m_steps = _m(
+            "counter", "hetu_numerics_steps_total",
+            "Training steps whose per-layer numerics were processed "
+            "(run_steps inner steps included)", ("monitor",)
+        ).labels(monitor=self.name)
+        self._m_anom = _m(
+            "counter", "hetu_numerics_anomalies_total",
+            "Per-layer numerics anomalies by kind "
+            "(spike/vanish/drift/nonfinite)", ("monitor", "layer", "kind"))
+        self._m_nonfinite = _m(
+            "counter", "hetu_numerics_nonfinite_total",
+            "Steps on which a layer's stats row was non-finite (exact "
+            "across run_steps inner steps)", ("monitor", "layer"))
+        self._m_escalations = _m(
+            "counter", "hetu_numerics_escalations_total",
+            "Sustained anomalies escalated into the StepGuard policy",
+            ("monitor",)).labels(monitor=self.name)
+        _LIVE.add(self)
+
+    # -- wiring ------------------------------------------------------------
+    def attach(self, executor):
+        """Install on an already-built executor: compiled step programs
+        are invalidated so the next run traces the stats vector in."""
+        executor.config["numerics"] = self
+        self._executor = executor
+        for sub in executor.subexecutor.values():
+            if hasattr(sub, "_jitted"):
+                sub._jitted = None
+            if hasattr(sub, "_multi_jitted"):
+                sub._multi_jitted = None
+        return self
+
+    def detach(self, executor):
+        """Remove the monitor (and the stats vector from the step)."""
+        self.flush()
+        executor.config.pop("numerics", None)
+        for sub in executor.subexecutor.values():
+            if hasattr(sub, "_jitted"):
+                sub._jitted = None
+            if hasattr(sub, "_multi_jitted"):
+                sub._multi_jitted = None
+        return self
+
+    # -- per-step hook (called by SubExecutor) -----------------------------
+    def on_step(self, executor, layers, step, stats_arr, n=1,
+                inner_nf=None):
+        """Receive one step's DEVICE stats array (``[n_layers, 3]``
+        sums of squares: grad, update, param).  No host sync happens
+        here — the array is queued and materialized on the
+        ``check_interval`` cadence (one step late under ``defer``, by
+        which time the buffer is ready and the read is a fetch, not a
+        sync).  ``inner_nf``: run_steps' carried per-layer non-finite
+        step count (device ``[n_layers]`` int32)."""
+        self._executor = executor
+        self._pending.append((tuple(layers), step, stats_arr, n,
+                              inner_nf))
+        keep = 1 if self.defer else 0
+        if len(self._pending) >= self.check_interval + keep:
+            while len(self._pending) > keep:
+                self._process(*self._pending.popleft())
+
+    def flush(self):
+        """Materialize and process every pending stats row (call after
+        the training loop).  Returns the stats dict."""
+        while self._pending:
+            self._process(*self._pending.popleft())
+        return self.stats
+
+    @property
+    def pending_count(self):
+        return len(self._pending)
+
+    # -- internals ---------------------------------------------------------
+    def _layer_state(self, layer):
+        st = self.layers.get(layer)
+        if st is None:
+            st = {"grad": None, "update": None, "param": None,
+                  "ratio": None, "z": None, "steps": 0,
+                  "ema_grad": None, "var_grad": None, "ema_param": None,
+                  "nonfinite_steps": 0, "anomaly_streak": 0,
+                  "anomalies": {k: 0 for k in ANOMALY_KINDS},
+                  # cached label children: .labels() re-resolution per
+                  # step is the hot path's dominant host cost
+                  "_h": (self._g_grad.labels(monitor=self.name,
+                                             layer=layer),
+                         self._g_update.labels(monitor=self.name,
+                                               layer=layer),
+                         self._g_param.labels(monitor=self.name,
+                                              layer=layer),
+                         self._g_ratio.labels(monitor=self.name,
+                                              layer=layer),
+                         self._m_nonfinite.labels(monitor=self.name,
+                                                  layer=layer))}
+            self.layers[layer] = st
+        return st
+
+    def _process(self, layers, step, stats_arr, n, inner_nf):
+        rows = np.asarray(stats_arr, dtype=np.float64).tolist()
+        nf = (None if inner_nf is None
+              else np.asarray(inner_nf, dtype=np.int64).tolist())
+        self.stats["steps"] += int(n)
+        self.stats["processed"] += 1
+        self._m_steps.inc(int(n))
+        self._last_step = int(step)
+        row_nonfinite = []
+        hist_row = {}
+        eps = 1e-12
+        isfinite, sqrt = math.isfinite, math.sqrt
+        for i, layer in enumerate(layers):
+            st = self._layer_state(layer)
+            st["steps"] += int(n)
+            gsq, usq, psq = rows[i]
+            gf, uf, pf = isfinite(gsq), isfinite(usq), isfinite(psq)
+            finite = gf and uf and pf
+            # norms from the fused sums of squares; NaN propagates so a
+            # poisoned layer shows non-finite norms, not garbage
+            g = sqrt(gsq) if gf and gsq > 0.0 else (
+                0.0 if gf else float("nan"))
+            u = sqrt(usq) if uf and usq > 0.0 else (
+                0.0 if uf else float("nan"))
+            p = sqrt(psq) if pf and psq > 0.0 else (
+                0.0 if pf else float("nan"))
+            ratio = (u / (p + eps)) if finite else float("nan")
+            st["grad"], st["update"] = g, u
+            st["param"], st["ratio"] = p, ratio
+            nf_steps = (int(nf[i]) if nf is not None
+                        else (0 if finite else 1))
+            kinds = ()
+            if nf_steps or not finite:
+                st["nonfinite_steps"] += max(nf_steps, 1)
+                st["_h"][4].inc(max(nf_steps, 1))
+                row_nonfinite.append(layer)
+                kinds = ("nonfinite",)
+                st["z"] = None
+            else:
+                warm = st["steps"] > self.warmup
+                ema, var = st["ema_grad"], st["var_grad"]
+                if ema is None:
+                    st["ema_grad"], st["var_grad"] = g, 0.0
+                    st["z"] = 0.0
+                else:
+                    z = (g - ema) / (sqrt(max(var, 0.0)) + eps)
+                    st["z"] = z
+                    if warm and abs(z) > self.z_threshold and g > ema:
+                        kinds += ("spike",)
+                    if warm and g < self.vanish_factor * ema:
+                        kinds += ("vanish",)
+                    d = self.ema_decay
+                    st["ema_grad"] = d * ema + (1.0 - d) * g
+                    st["var_grad"] = (d * var
+                                      + (1.0 - d) * (g - ema) ** 2)
+                pema = st["ema_param"]
+                if pema is None:
+                    st["ema_param"] = p
+                else:
+                    if (warm and abs(p - pema)
+                            > self.drift_tolerance * (abs(pema) + eps)):
+                        kinds += ("drift",)
+                    d = self.ema_decay
+                    st["ema_param"] = d * pema + (1.0 - d) * p
+                h = st["_h"]
+                h[0].set(g), h[1].set(u), h[2].set(p), h[3].set(ratio)
+            for k in kinds:
+                st["anomalies"][k] += 1
+                self.stats["anomalies"] += 1
+                self._m_anom.labels(monitor=self.name, layer=layer,
+                                    kind=k).inc()
+            st["anomaly_streak"] = (st["anomaly_streak"] + 1 if kinds
+                                    else 0)
+            hist_row[layer] = {"grad": g, "update": u, "param": p,
+                               "ratio": ratio, "z": st["z"],
+                               "finite": finite,
+                               "anomalies": list(kinds)}
+        if row_nonfinite:
+            self.stats["nonfinite_rows"] += 1
+            self._last_nonfinite = (int(step), row_nonfinite)
+        entry = {"step": int(step), "n": int(n), "layers": hist_row}
+        self.history.append(entry)
+        self._write_history(entry)
+        self._maybe_escalate(step)
+
+    def _write_history(self, entry):
+        if self.history_path is None:
+            return
+        with self._lock:
+            if self._writer is None:
+                self._writer = JsonlWriter(self.history_path)
+        # monotonic seconds since monitor creation (the flight
+        # recorder's idiom) — wall-clock time.time() is gated out
+        self._writer.write(dict(
+            entry, t=round(time.perf_counter() - self._epoch, 6),
+            monitor=self.name))
+
+    def close(self):
+        """Flush pending rows and close the JSONL history file."""
+        self.flush()
+        with self._lock:
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+    def _maybe_escalate(self, step):
+        if self.escalate_after is None or self._in_culprit:
+            return
+        guard = self.guard
+        if guard is None and self._executor is not None:
+            guard = self._executor.config.get("step_guard")
+        if guard is None:
+            return
+        for layer, st in self.layers.items():
+            if st["anomaly_streak"] >= self.escalate_after:
+                kinds = [k for k, c in st["anomalies"].items() if c]
+                st["anomaly_streak"] = 0   # one escalation per streak
+                self.stats["escalations"] += 1
+                self._m_escalations.inc()
+                guard._trip(
+                    f"numerics escalation: layer '{layer}' anomalous "
+                    f"({'/'.join(kinds)}) for {self.escalate_after} "
+                    "consecutive checks", step, None)
+                return   # a trip may have restored state; re-evaluate
+
+    # -- attribution / reporting ------------------------------------------
+    def culprit(self, step=None):
+        """Layer attribution for a trip at ``step`` (or now): drains
+        pending stats (the trip path is already synchronous), then
+        names the first-non-finite layer of the most recent poisoned
+        row and the largest-|z| layer overall.  Reentrancy-safe against
+        the guard calling back in during an escalation trip."""
+        self._in_culprit = True
+        try:
+            self.flush()
+        finally:
+            self._in_culprit = False
+        first_nf, nf_layers = None, []
+        if self._last_nonfinite is not None:
+            nf_step, nf_layers = self._last_nonfinite
+            first_nf = nf_layers[0]
+        best, best_z = None, 0.0
+        for layer, st in self.layers.items():
+            z = st.get("z")
+            if z is not None and np.isfinite(z) and abs(z) > abs(best_z):
+                best, best_z = layer, float(z)
+        return {"step": (int(step) if step is not None
+                         else self._last_step),
+                "first_nonfinite": first_nf,
+                "nonfinite_layers": list(nf_layers),
+                "largest_z": best,
+                "z": (best_z if best is not None else None)}
+
+    def report(self):
+        """The ``/numerics`` block for this monitor: per-layer latest
+        norms/ratios/z + anomaly counts, and the monitor totals."""
+        return {
+            "layers": {
+                layer: {"grad_norm": st["grad"],
+                        "update_norm": st["update"],
+                        "param_norm": st["param"],
+                        "update_ratio": st["ratio"],
+                        "z": st["z"], "steps": st["steps"],
+                        "nonfinite_steps": st["nonfinite_steps"],
+                        "anomalies": dict(st["anomalies"])}
+                for layer, st in self.layers.items()},
+            "steps": self.stats["steps"],
+            "processed": self.stats["processed"],
+            "pending": len(self._pending),
+            "anomalies": self.stats["anomalies"],
+            "nonfinite_rows": self.stats["nonfinite_rows"],
+            "escalations": self.stats["escalations"],
+            "check_interval": self.check_interval,
+            "sample_every": self.sample_every,
+            "history_path": (str(self.history_path)
+                             if self.history_path else None)}

@@ -48,8 +48,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 THROUGHPUT_MARKERS = (".mfu", "_per_sec")
 #: higher-is-better one-sided signals compared in absolute points
 ATTAINMENT_MARKERS = ("attainment",)
-#: context-only signals that never gate
-INFO_MARKERS = ("shed_fraction",)
+#: context-only signals that never gate.  Numerics signals (per-layer
+#: grad/update-norm drift, anomaly counts from the NumericsMonitor) are
+#: model-health evidence, not performance — history rounds carry them
+#: for trend reading without ever destabilizing the gate.
+INFO_MARKERS = ("shed_fraction", "numerics", "grad_norm", "update_norm",
+                "update_ratio", "anomal")
 
 
 def classify(name):
