@@ -1228,7 +1228,12 @@ def _serve_trace(seed, n_requests, vocab, p_lo, p_hi, new_lo, new_hi,
 
 def _serve_replay(engine, trace):
     """Drive one engine through the trace (arrival clock = iteration
-    index) and summarize throughput + latency percentiles."""
+    index) and summarize throughput + latency percentiles.
+    ``stream_sha`` hashes every request's token stream in trace order —
+    two engines replaying the same trace produced bitwise-identical
+    streams iff the hashes match (the paged-vs-slot parity witness)."""
+    import hashlib
+
     from hetu_tpu.metrics import request_latency_summary
 
     engine.reset_stats()
@@ -1244,6 +1249,9 @@ def _serve_replay(engine, trace):
     wall = time.perf_counter() - t0
     toks = sum(len(r.tokens) for r in reqs)
     assert all(r.finished for r in reqs), "replay left unfinished requests"
+    sha = hashlib.sha256()
+    for r in reqs:
+        sha.update(np.asarray(r.tokens, np.int32).tobytes())
     lat = request_latency_summary(engine.records)
     stats = engine.stats()
     return {"tokens_per_sec": round(toks / wall, 2),
@@ -1252,6 +1260,10 @@ def _serve_replay(engine, trace):
             "iterations": it,
             "decode_steps": stats["decode_steps"],
             "mean_occupancy": stats["mean_occupancy"],
+            "peak_active": stats["peak_active"],
+            "peak_live_tokens": stats["peak_live_tokens"],
+            "prefill_chunks": stats["prefill_chunks"],
+            "stream_sha": sha.hexdigest()[:16],
             "trace_counts": stats["trace_counts"],
             "latency_s": {k: {q: (round(x, 6)
                                   if isinstance(x, float) else x)
@@ -1272,16 +1284,102 @@ def run_serve(quick=False, seed=0):
         trace = _serve_trace(seed, 80, c.vocab_size, 8, 48, 8, 64)
     kw = dict(n_slots=n_slots, max_len=max_len, max_prompt_len=max_prompt,
               prefill_budget=2, name="serve", seed=seed)
+
+    def best_of(engine, tr, n=2):
+        # replay variance on shared CPUs swings +-10%; keep the best of
+        # n measured replays (every replay still asserts correctness)
+        best = None
+        for _ in range(n):
+            r = _serve_replay(engine, tr)
+            if best is None or r["tokens_per_sec"] > best["tokens_per_sec"]:
+                best = r
+        return best
+
     results = {}
+    engines = {}
     for mode, gang in (("continuous", False), ("static_batch", True)):
         eng = InferenceEngine(ex, model, gang=gang, instance=mode, **kw)
-        # warm the two jitted programs outside the timed replay; the
-        # trace counters keep counting, so a retrace DURING the replay
-        # still shows up as trace_counts > 1
+        # warm the jitted programs with one untimed replay; the trace
+        # counters keep counting, so a retrace DURING the measured
+        # replay still shows up as trace_counts > 1
         eng.generate_many([trace[0][1]], 2)
-        results[mode] = _serve_replay(eng, trace)
+        _serve_replay(eng, trace)
+        results[mode] = best_of(eng, trace)
+        engines[mode] = eng
+
+    # paged twin (ISSUE 13): the same model + trace through a paged-KV
+    # engine whose pool holds the SAME usable KV HBM as the slot twin's
+    # dense pool — n_pages * page_len == n_slots * max_len tokens (+ the
+    # never-allocated sentinel page) — but spread over pages, so
+    # worst-case reservation per request (< max_len for real mixes)
+    # admits MORE concurrent requests at equal bytes.  Chunked prefill
+    # (prefill_token_budget) keeps decode interleaving under long
+    # prompts.
+    if quick:
+        paged_slots, page_len, prefill_budget, mix_budget = 8, 8, 24, 6
+    else:
+        paged_slots, page_len, prefill_budget, mix_budget = 16, 16, 96, 24
+    n_pages = (n_slots * max_len) // page_len + 1   # + sentinel
+    pkw = dict(kw, n_slots=paged_slots, paged=True, page_len=page_len,
+               n_pages=n_pages, prefill_token_budget=prefill_budget)
+    peng = InferenceEngine(ex, model, instance="paged", **pkw)
+    # warm EVERY pow2 prefill bucket the trace can hit by replaying it
+    # once untimed, then pin the retrace counters: a flat counter dict
+    # across the measured replays is the compile-once witness
+    _serve_replay(peng, trace)
+    warm_traces = dict(peng.trace_counts)
+    # fair A/B: measure the slot and paged twins ADJACENTLY with
+    # alternating replays and keep each engine's best.  In-process
+    # warm-state drift between stages (allocator / code-cache state left
+    # behind by whichever engine ran last) biases a later stage by
+    # 10-25% on shared CPUs, so a sequential slot-then-static-then-paged
+    # sweep systematically under-reads the paged twin; interleaving
+    # exposes both engines to the same instantaneous machine state.
+    best_slot = best_paged = None
+    for _ in range(3):
+        rs = _serve_replay(engines["continuous"], trace)
+        rp = _serve_replay(peng, trace)
+        if best_slot is None or (rs["tokens_per_sec"]
+                                 > best_slot["tokens_per_sec"]):
+            best_slot = rs
+        if best_paged is None or (rp["tokens_per_sec"]
+                                  > best_paged["tokens_per_sec"]):
+            best_paged = rp
+    results["paged"] = best_paged
+    results["slot_adjacent"] = best_slot
+    paged_flat = peng.trace_counts == warm_traces
+    # TPOT under a long-prompt + short-decode mix, with the prefill
+    # budget dropped BELOW the prompt lengths so every long prompt
+    # chunks and decode interleaves between its chunks — the
+    # head-of-line latency claim (the budget is a host-side scheduling
+    # knob, not program geometry: same executables at the same shapes).
+    # Smaller chunks CAN hit new pow2 prefill buckets, so this workload
+    # gets its own untimed warm replay before the measured one.
+    peng.prefill_token_budget = mix_budget
+    mix = _serve_trace(seed + 1, 12 if quick else 40, c.vocab_size,
+                       max(3, max_prompt - 2), max_prompt, 2, 6,
+                       mean_gap=0.3)
+    _serve_replay(peng, mix)
+    results["paged_longmix"] = best_of(peng, mix)
+
     cont, stat = results["continuous"], results["static_batch"]
+    paged, slot = results["paged"], results["slot_adjacent"]
+    scache = engines["continuous"].cache
+    sb = int(scache.k.nbytes) + int(scache.v.nbytes)
+    pb = int(peng.cache.k.nbytes) + int(peng.cache.v.nbytes)
+    usable_pb = pb * (n_pages - 1) // n_pages
     vs = round(cont["tokens_per_sec"] / stat["tokens_per_sec"], 3)
+    pvs = round(paged["tokens_per_sec"] / slot["tokens_per_sec"], 3)
+    signals = {
+        "serve_tokens_per_s": paged["tokens_per_sec"],
+        "serve_slot_tokens_per_s": slot["tokens_per_sec"],
+        "serve_paged_peak_concurrency": paged["peak_active"],
+        "serve_slot_peak_concurrency": slot["peak_active"],
+        "kv_hbm_bytes_per_token": round(
+            pb / max(1, paged["peak_live_tokens"]), 1),
+        "serve_chunked_tpot_p99_s":
+            results["paged_longmix"]["latency_s"]["tpot"]["p99"],
+    }
     return {"metric": "serve_continuous_tokens_per_sec",
             "value": cont["tokens_per_sec"], "unit": "tokens/sec",
             "vs_baseline": vs,       # > 1 iff continuous beats static
@@ -1292,6 +1390,22 @@ def run_serve(quick=False, seed=0):
             "seed": seed, "quick": bool(quick),
             "n_requests": len(trace), "n_slots": n_slots,
             "max_len": max_len, "max_prompt_len": max_prompt,
+            "paged": {"n_slots": paged_slots, "page_len": page_len,
+                      "n_pages": n_pages,
+                      "prefill_token_budget": prefill_budget,
+                      "longmix_token_budget": mix_budget,
+                      "pool_bytes": pb, "slot_pool_bytes": sb,
+                      "usable_pool_bytes": usable_pb,
+                      "equal_hbm": bool(usable_pb == sb),
+                      "vs_slot": pvs,
+                      "wins_throughput": bool(pvs >= 1.0),
+                      "wins_concurrency": bool(
+                          paged["peak_active"] > slot["peak_active"]),
+                      "bitwise_match": bool(
+                          paged["stream_sha"] == slot["stream_sha"]),
+                      "compile_flat": bool(paged_flat),
+                      "pages": peng.stats()["pages"]},
+            "signals": signals,
             "stages": results}
 
 
@@ -1301,15 +1415,31 @@ def _emit_serve(out):
     fits the driver's stdout window.  The detail file is written only
     now — after the run has real results — so an aborted run never
     clobbers the previous round's committed evidence with a placeholder
-    (the BENCH_FULL.json contract, REVIEW r6)."""
+    (the BENCH_FULL.json contract, REVIEW r6).  The flat ``signals``
+    block also appends to benchmarks/history.jsonl so
+    ``tools/perf_diff.py --current SERVE_FULL.json`` can gate the
+    paged-vs-slot serving numbers like any --profile round."""
+    from hetu_tpu.telemetry import JsonlWriter
     full = json.dumps(out)
     try:
         with open(SERVE_DETAIL_PATH, "w") as f:
             f.write(full + "\n")
     except OSError:
         pass
+    if out.get("signals"):
+        entry = {"t": round(time.time(), 3), "platform": out["platform"],
+                 "quick": out["quick"], "seed": out["seed"],
+                 "signals": out["signals"]}
+        try:
+            os.makedirs(os.path.dirname(HISTORY_PATH) or ".",
+                        exist_ok=True)
+            with JsonlWriter(HISTORY_PATH) as w:  # append, never truncate
+                w.write(entry)
+        except OSError:
+            pass
     print(full, flush=True)
     lat_c = out["stages"]["continuous"]["latency_s"]
+    pg = out["paged"]
     compact = {"metric": out["metric"], "value": out["value"],
                "unit": out["unit"], "vs_baseline": out["vs_baseline"],
                "continuous_wins": out["continuous_wins"],
@@ -1323,11 +1453,23 @@ def _emit_serve(out):
                           "p99": lat_c["ttft"]["p99"]},
                "tpot_s": {"p50": lat_c["tpot"]["p50"],
                           "p99": lat_c["tpot"]["p99"]},
+               "paged": {
+                   "tok_s": out["signals"]["serve_tokens_per_s"],
+                   "vs_slot": pg["vs_slot"],
+                   "peak": [out["signals"]["serve_paged_peak_concurrency"],
+                            out["signals"]["serve_slot_peak_concurrency"]],
+                   "kv_B_per_tok":
+                       out["signals"]["kv_hbm_bytes_per_token"],
+                   "tpot_p99_s":
+                       out["signals"]["serve_chunked_tpot_p99_s"],
+                   "bitwise": pg["bitwise_match"],
+                   "equal_hbm": pg["equal_hbm"],
+                   "compile_flat": pg["compile_flat"]},
                "detail": os.path.basename(SERVE_DETAIL_PATH)}
     if "telemetry_overhead" in out:
         compact["telemetry_overhead_frac"] = \
             out["telemetry_overhead"]["overhead_frac"]
-    _print_compact(compact)
+    _print_compact(compact, drop_order=("occupancy",))
 
 
 # -- embedding-serve mode (bench.py --serve-embed) -------------------------

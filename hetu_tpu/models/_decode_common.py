@@ -74,6 +74,60 @@ def make_picker(temperature, top_k):
     return pick
 
 
+def make_slot_picker():
+    """Per-lane token selection from OPERANDS instead of closure
+    constants: ``pick(logits [S, V], temps [S], top_ks [S], seeds [S],
+    consumed [S])`` samples each lane under its own temperature / top_k
+    / seed without recompiling per sampling signature (the paged
+    engine's per-request sampling).
+
+    Determinism contract: lane keys derive from ``fold_in(fold_in(
+    key(0), seed), consumed)`` where ``consumed`` counts the tokens the
+    request has produced so far (prompt length at prefill, position + 1
+    at decode) — a function of the REQUEST's seed and progress only,
+    never of the slot index, co-tenants, or engine instance.  A sampled
+    stream is therefore reproducible at a fixed seed and continues
+    bit-exactly after a failover replay onto another replica.
+
+    Greedy lanes (temperature <= 0) use the identical ``jnp.argmax`` the
+    closure picker uses, preserving bitwise parity with the slot twin.
+    An all-greedy batch — the common serving case — skips the whole
+    sort/sample branch at RUNTIME via ``lax.cond`` (both branches are
+    traced once; only the taken one executes), so per-request sampling
+    support costs greedy-only workloads nothing per step.
+    """
+
+    def pick(logits, temps, top_ks, seeds, consumed):
+        greedy = jnp.argmax(logits, axis=-1)
+
+        def sample(_):
+            lg = logits.astype(jnp.float32) / jnp.maximum(
+                temps, 1e-6)[:, None]
+            v = lg.shape[-1]
+            # per-lane top-k via a full descending sort: lane i keeps
+            # logits >= the top_ks[i]-th largest (top_ks == 0 keeps
+            # everything)
+            srt = jnp.sort(lg, axis=-1)[:, ::-1]
+            kth_idx = jnp.clip(top_ks - 1, 0, v - 1)
+            kth = jnp.take_along_axis(srt, kth_idx[:, None], axis=-1)
+            lg = jnp.where((top_ks[:, None] > 0) & (lg < kth),
+                           -jnp.inf, lg)
+            base = jax.random.key(0)
+
+            def lane(row, seed, step):
+                k = jax.random.fold_in(jax.random.fold_in(base, seed),
+                                       step)
+                return jax.random.categorical(k, row, axis=-1)
+
+            sampled = jax.vmap(lane)(lg, seeds, consumed)
+            return jnp.where(temps <= 0.0, greedy, sampled)
+
+        return jax.lax.cond(jnp.any(temps > 0.0), sample,
+                            lambda _: greedy, None)
+
+    return pick
+
+
 def make_attend(head_dim, n_rep=1):
     """Masked cache attention: q [B, H, Sq, D] against cached keys/vals
     [B, KV, T, D] (kv heads broadcast n_rep-fold for GQA), with an

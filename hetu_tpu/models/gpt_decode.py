@@ -69,6 +69,28 @@ def make_block(config):
     return block
 
 
+def make_chunk_embed(config, name):
+    """Embedding + learned-position rows + mask for one prefill CHUNK
+    per lane (llama_decode.make_chunk_embed's GPT sibling, minus
+    rotary).  Returns ``chunk_inputs(params, tokens [B, C], starts [B],
+    t) -> (x [B, C, H], mask [B, C, t])``.  Position rows are gathered
+    with clipping against the wpe table (pad-tail rows past seq_len
+    must not fault); the mask derives from the unclipped rows so those
+    lanes stay exact where it matters — they are never emitted."""
+    del config
+
+    def chunk_inputs(params, tokens, starts, t):
+        emb = params[f"{name}_wte_table"]
+        wpe = params[f"{name}_wpe"]
+        cl = tokens.shape[1]
+        rows = starts[:, None] + jnp.arange(cl)[None, :]     # [B, C]
+        rc = jnp.clip(rows, 0, wpe.shape[0] - 1)
+        mask = jnp.arange(t)[None, None, :] <= rows[:, :, None]
+        return emb[tokens] + wpe[rc], mask
+
+    return chunk_inputs
+
+
 def make_logits(config, name):
     del config
 

@@ -126,6 +126,31 @@ def make_block(config):
     return block
 
 
+def make_chunk_embed(config, name):
+    """Embedding + rotary rows + mask for one prefill CHUNK per lane.
+
+    Returns ``chunk_inputs(params, tokens [B, C], starts [B], t) ->
+    (x [B, C, H], cos [B, C, hd], sin [B, C, hd], mask [B, C, t])``
+    where lane ``i``'s chunk occupies global rows ``[starts[i],
+    starts[i] + C)`` of a ``t``-row cache.  Rows are gathered (never
+    ``dynamic_slice``d — an out-of-range start would silently CLAMP
+    and shift valid rows) and clipped for the pad tail; the mask stays
+    exact because it derives from the unclipped rows."""
+    c = config
+    hd = c.hidden_size // c.num_heads
+
+    def chunk_inputs(params, tokens, starts, t):
+        emb = params[f"{name}_embed_table"]
+        cos_t, sin_t = _rope_tables(t, hd, c.rope_theta)
+        cl = tokens.shape[1]
+        rows = starts[:, None] + jnp.arange(cl)[None, :]     # [B, C]
+        rc = jnp.clip(rows, 0, t - 1)
+        mask = jnp.arange(t)[None, None, :] <= rows[:, :, None]
+        return emb[tokens], cos_t[rc], sin_t[rc], mask
+
+    return chunk_inputs
+
+
 def make_logits(config, name):
     """Final-norm + LM-head projection shared by decode paths."""
     c = config

@@ -1,6 +1,10 @@
 """Continuous-batching inference serving over the KV-cache decoders.
 
-Slot-pooled K/V cache (kv_cache.py) + iteration-level FIFO scheduler
+Slot-pooled K/V cache — dense per-slot spans (``SlotKVCache``) or a
+paged pool with per-slot block tables, batched + chunked prefill, and
+per-request sampling operands (``PagedKVCache``, ``paged=True`` on the
+engine; docs/SERVING.md walks the page math) — plus an
+iteration-level FIFO scheduler
 with bounded-queue admission control (scheduler.py) + slot-batched
 model adapters (adapters.py) + the engine tying them together with
 per-request deadlines, cancellation, and a decode watchdog (engine.py).
@@ -31,7 +35,7 @@ under sustained violation.  ``bench.py --slo`` replays a bursty diurnal
 trace through a controlled fleet vs its static twin.
 """
 
-from .kv_cache import SlotKVCache
+from .kv_cache import PagedKVCache, SlotKVCache
 from .scheduler import (EngineOverloaded, Request, Scheduler,
                         FINISH_REASONS, SHED_POLICIES, TERMINAL_OK)
 from .adapters import (LlamaSlotAdapter, GPTSlotAdapter, adapter_for)
@@ -44,7 +48,8 @@ from .control import (CostModel, DEGRADE_LEVELS, FleetController, SLO,
 from .embedding import (BatchSlotPool, DeviceHotRowCache, EmbedRequest,
                         EmbeddingServer, EMBED_BUCKETS)
 
-__all__ = ["SlotKVCache", "Request", "Scheduler", "EngineOverloaded",
+__all__ = ["PagedKVCache", "SlotKVCache", "Request", "Scheduler",
+           "EngineOverloaded",
            "FINISH_REASONS", "SHED_POLICIES", "TERMINAL_OK",
            "LlamaSlotAdapter", "GPTSlotAdapter", "adapter_for",
            "InferenceEngine", "CircuitBreaker", "ReplicaHealth",

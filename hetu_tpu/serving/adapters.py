@@ -15,8 +15,16 @@ copied) in slot-batched form:
 * ``prefill(params, prompt [1, P])`` — a whole prompt through all
   layers at once, returning the per-layer K/V to deposit into one slot
   plus the logits row that seeds the first generated token.
+* ``prefill_chunk(params, tokens [B, C], starts [B], k, v)`` — the
+  paged engine's batched AND chunked prefill: lane ``i`` pushes chunk
+  rows ``[starts[i], starts[i] + C)`` of its prompt through all layers
+  against its own gathered cache ``[B, L, KV, T', D]``.  The engine
+  pads the gathered time axis by C before calling (so the in-block
+  ``dynamic_update_slice`` at ``start`` can never clamp) and routes
+  the pad rows' page-pool write-back to the sentinel page.
 
-Both are pure functions of static shapes: the engine jits them once.
+All are pure functions of static shapes: the engine jits them once
+(per ``[B, C]`` bucket for the chunk path).
 
 Pad-safety: prefill pads prompts to the engine's fixed bucket P and
 also returns K/V for the pad tail.  That tail is harmless — decode
@@ -54,6 +62,7 @@ class LlamaSlotAdapter:
         self._layer_params = _ld.make_layer_params(c, name, moe_names)
         self._block = _ld.make_block(c)
         self._logits = _ld.make_logits(c, name)
+        self._chunk_inputs = _ld.make_chunk_embed(c, name)
 
     @classmethod
     def for_model(cls, model, name):
@@ -101,6 +110,27 @@ class LlamaSlotAdapter:
         logits = self._logits(params, x[0])              # [P, V]
         return logits, jnp.stack(ks), jnp.stack(vs)
 
+    def prefill_chunk(self, params, tokens, starts, k, v):
+        """Batched chunked prefill (see module doc): ``tokens [B, C]``
+        against per-lane caches ``k, v [B, L, KV, T', D]`` with lane
+        write offsets ``starts [B]``.  Returns ``(logits [B, C, V],
+        k', v')`` with the chunk's K/V written at rows
+        ``[start, start + C)``."""
+        lps = [self._layer_params(params, i) for i in range(self.layers)]
+        t = k.shape[3]
+        x, cos, sin, mask = self._chunk_inputs(params, tokens, starts, t)
+        x = x[:, None]                                   # [B, 1, C, H]
+        vblock = jax.vmap(self._block,
+                          in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
+        ks, vs = [], []
+        for i, lp in enumerate(lps):
+            ck, cv = k[:, i][:, None], v[:, i][:, None]  # [B, 1, KV, T', D]
+            x, ck, cv = vblock(lp, x, ck, cv, cos, sin, mask, starts)
+            ks.append(ck[:, 0])
+            vs.append(cv[:, 0])
+        logits = self._logits(params, x[:, 0])           # [B, C, V]
+        return logits, jnp.stack(ks, 1), jnp.stack(vs, 1)
+
 
 class GPTSlotAdapter:
     """Learned-positions GPT slot-batched decode.  The position table
@@ -119,6 +149,7 @@ class GPTSlotAdapter:
         self._layer_params = _gd.make_layer_params(c, name)
         self._block = _gd.make_block(c)
         self._logits = _gd.make_logits(c, name)
+        self._chunk_inputs = _gd.make_chunk_embed(c, name)
 
     @classmethod
     def for_model(cls, model, name):
@@ -159,6 +190,23 @@ class GPTSlotAdapter:
             vs.append(cv[0])
         logits = self._logits(params, x[0])
         return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def prefill_chunk(self, params, tokens, starts, k, v):
+        """Batched chunked prefill, GPT flavor (learned positions are
+        added at embedding time by the chunk-input helper)."""
+        lps = [self._layer_params(params, i) for i in range(self.layers)]
+        t = k.shape[3]
+        x, mask = self._chunk_inputs(params, tokens, starts, t)
+        x = x[:, None]                                   # [B, 1, C, H]
+        vblock = jax.vmap(self._block, in_axes=(None, 0, 0, 0, 0, 0))
+        ks, vs = [], []
+        for i, lp in enumerate(lps):
+            ck, cv = k[:, i][:, None], v[:, i][:, None]
+            x, ck, cv = vblock(lp, x, ck, cv, mask, starts)
+            ks.append(ck[:, 0])
+            vs.append(cv[:, 0])
+        logits = self._logits(params, x[:, 0])           # [B, C, V]
+        return logits, jnp.stack(ks, 1), jnp.stack(vs, 1)
 
 
 def adapter_for(model, name):

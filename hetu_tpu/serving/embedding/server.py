@@ -68,7 +68,10 @@ class BatchSlotPool:
     def n_active(self):
         return self.n_slots - len(self._free)
 
-    def alloc(self, owner=None):
+    def alloc(self, owner=None, n_tokens=None):
+        # ``n_tokens`` (worst-case token span) is a KV-pool concern the
+        # scheduler passes uniformly; batch seats have no token axis
+        del n_tokens
         if not self._free:
             return None
         slot = self._free.pop()

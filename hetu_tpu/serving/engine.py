@@ -13,6 +13,27 @@ model into exactly TWO jitted programs whose shapes never change:
   slots compute masked garbage — the price of a static shape — and
   their outputs are discarded host-side.
 
+``paged=True`` swaps the dense ``SlotKVCache`` for a
+:class:`~.kv_cache.PagedKVCache` (fixed page pool + per-slot block
+tables) and rebuilds both programs around an in-graph page gather:
+
+* decode gains block-table + per-slot sampling operands
+  (``tables [S, max_pages]``, ``temps/top_ks/seeds [S]``) — slot
+  capacity and sampling become data, not compile-time constants, so
+  the compile-once contract is untouched by request mix;
+* prefill becomes BATCHED and CHUNKED: every admitted prompt chunk in
+  one padded ``[B, C]`` call (both axes pow2-bucketed to bound compile
+  variants), long prompts split across iterations under
+  ``prefill_token_budget`` so decode interleaves between chunks
+  instead of stalling behind one long prompt.
+
+Sampling keys derive in-graph from ``fold_in(fold_in(key(0), seed),
+consumed)`` — per request, not per engine — so a sampled stream at a
+fixed seed is deterministic and continues bit-exactly through fleet
+failover replay.  Greedy lanes run the identical argmax as the slot
+engine: the paged twin's greedy streams are bitwise equal to the
+dense twin's (the serve bench asserts it).
+
 Both programs also return a FINITENESS SENTINEL computed in-graph (the
 StepGuard idea from the training path, re-hosted per slot): ``prefill``
 returns one ok scalar for its logits row, ``step`` returns a per-slot
@@ -88,10 +109,17 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry as _telemetry
-from ..models._decode_common import make_picker, param_prefix, pad_prompts
+from ..models._decode_common import (make_picker, make_slot_picker,
+                                     param_prefix, pad_prompts)
 from .adapters import adapter_for
-from .kv_cache import SlotKVCache
+from .kv_cache import (PagedKVCache, SlotKVCache, ceil_div, gather_pages,
+                       scatter_rows)
 from .scheduler import Request, Scheduler
+
+
+def _p2(n):
+    """Next power of two >= n (the prefill bucket rounding)."""
+    return 1 << max(0, (int(n) - 1).bit_length())
 
 
 class InferenceEngine:
@@ -111,7 +139,8 @@ class InferenceEngine:
                  gang=False, max_queue=None, low_watermark=None,
                  shed_policy="reject_newest", watchdog=True,
                  stream_stall_timeout=None, clock=None, instance=None,
-                 latency_buckets=None, device=None):
+                 latency_buckets=None, device=None, paged=False,
+                 page_len=16, n_pages=None, prefill_token_budget=None):
         self.params = executor.params
         self.instance = None if instance is None else str(instance)
         self.device = device
@@ -136,12 +165,37 @@ class InferenceEngine:
                 f"max_prompt_len={self.max_prompt_len} > max_len="
                 f"{self.max_len}")
         emb = self.params[self.adapter.embed_param]
-        self.cache = SlotKVCache(
-            n_slots, self.adapter.layers, self.adapter.kv_heads,
-            self.max_len, self.adapter.head_dim, dtype=emb.dtype)
+        self._paged = bool(paged)
+        if self._paged:
+            self.cache = PagedKVCache(
+                n_slots, self.adapter.layers, self.adapter.kv_heads,
+                page_len, self.adapter.head_dim, max_len=self.max_len,
+                n_pages=n_pages, dtype=emb.dtype,
+                label=self.instance or f"{name}:{id(self):x}")
+        else:
+            self.cache = SlotKVCache(
+                n_slots, self.adapter.layers, self.adapter.kv_heads,
+                self.max_len, self.adapter.head_dim, dtype=emb.dtype)
         if device is not None:
             self.cache.k = jax.device_put(self.cache.k, device)
             self.cache.v = jax.device_put(self.cache.v, device)
+        if prefill_token_budget is not None:
+            prefill_token_budget = int(prefill_token_budget)
+            if prefill_token_budget < 1:
+                raise ValueError(
+                    f"prefill_token_budget must be >= 1, got "
+                    f"{prefill_token_budget}")
+            if not self._paged:
+                raise ValueError(
+                    "prefill_token_budget requires paged=True (the slot "
+                    "engine prefills whole prompts)")
+        self.prefill_token_budget = prefill_token_budget
+        # paged prefill batching: lanes per call (B bucket cap) and the
+        # chunk-length cap (C bucket cap = the prompt bucket)
+        self._lane_cap = min(8, _p2(n_slots))
+        self._chunk_cap = _p2(self.max_prompt_len)
+        self._prefilling = {}      # slot -> {"req", "start"} mid-chunk
+        self._prefill_order = []   # admission order of those slots
         self.scheduler = Scheduler(self.cache,
                                    prefill_budget=prefill_budget,
                                    gang=gang, max_queue=max_queue,
@@ -157,7 +211,20 @@ class InferenceEngine:
         self._sampling = (float(temperature), int(top_k))
         self._pick = make_picker(temperature, top_k)
         self._key = jax.random.key(seed)
+        self._default_seed = int(seed)
         self._last_tokens = np.zeros(n_slots, np.int32)
+        # per-slot sampling operands (paged engines thread these through
+        # the programs; engine defaults unless submit() overrides)
+        self._temps = np.full(n_slots, self._sampling[0], np.float32)
+        self._topks = np.full(n_slots, self._sampling[1], np.int32)
+        self._seeds = np.full(n_slots, self._default_seed, np.int32)
+        # cached device copies of the sampling operands (dropped on
+        # admission, the only writer) and of the last active-lane mask:
+        # both change at request boundaries but are decode operands
+        # EVERY step, and per-step upload dispatch dwarfs the compiled
+        # step itself at serving batch sizes
+        self._dev_sampling = None
+        self._dev_active = (None, None)
         # per-request latency records + per-iteration occupancy log
         # (the per-request API; the registry mirrors below are the LIVE
         # surface — same numbers, scrapeable mid-run via /metrics)
@@ -165,6 +232,9 @@ class InferenceEngine:
         self.occupancy = []
         self.decode_steps = 0
         self.prefills = 0
+        self.prefill_chunks = 0
+        self.peak_active = 0
+        self.peak_live_tokens = 0
         self.cancellations = 0
         self.expirations = 0
         self.watchdog_trips = 0
@@ -252,10 +322,26 @@ class InferenceEngine:
     def _program_key(self):
         cfg = tuple(sorted((k, repr(v)) for k, v in
                            vars(self.adapter.config).items()))
+        # paged and slot programs must NEVER collide in _PROGRAMS (or in
+        # the profiler caches keyed off cost_signature): the paged pair
+        # has different operand signatures (block tables + sampling
+        # vectors) and different cache geometry.  Paged sampling is an
+        # OPERAND, so the closure constants drop out of its key; the
+        # page geometry takes their place.
+        if self._paged:
+            sampling = ("operands",)
+            geometry = ("paged", self.cache.page_len, self.cache.n_pages,
+                        self.cache.max_pages)
+        else:
+            sampling = self._sampling
+            geometry = ("slot",)
         return (type(self.adapter).__name__, self.adapter.name, cfg,
-                self._sampling, jax.default_backend())
+                sampling, geometry, jax.default_backend())
 
     def _build(self):
+        if self._paged:
+            self._build_paged()
+            return
         entry = self._PROGRAMS.get(self._program_key())
         if entry is None:
             adapter, pick = self.adapter, self._pick
@@ -307,6 +393,109 @@ class InferenceEngine:
         self._step_fn = entry["step"]
         self._traces = entry["traces"]
 
+    def _build_paged(self):
+        """The paged program pair: same math as the slot pair, but both
+        programs gather per-slot caches from the page pool through the
+        block-table operand, write the new rows back with a scatter
+        (inactive/pad lanes routed to sentinel page 0), and sample from
+        per-slot operand vectors.  Prefill is batched ``[B, C]`` — one
+        jitted callable retracing once per pow2 (B, C) bucket, each
+        bucket its own entry in the retrace witness."""
+        entry = self._PROGRAMS.get(self._program_key())
+        if entry is None:
+            adapter = self.adapter
+            pick = make_slot_picker()
+            from .. import telemetry as _tel
+            retrace = _tel.get_registry().counter(
+                "hetu_serving_retraces_total",
+                "Times each jitted serving program was traced — >1 "
+                "after warmup breaks the compile-once contract",
+                labels=("program",))
+            traces = {"step": 0}
+
+            def prefill(params, k, v, prompts, p_lens, starts,
+                        chunk_lens, tables, temps, top_ks, seeds):
+                bb, cb = prompts.shape
+                tag = f"prefill[{bb}x{cb}]"   # retrace witness per bucket
+                traces[tag] = traces.get(tag, 0) + 1
+                retrace.labels(program=tag).inc()
+                nl, nkv, nd = k.shape[1], k.shape[2], k.shape[4]
+                page_len, mp = k.shape[3], tables.shape[1]
+                kc = gather_pages(k, tables)
+                vc = gather_pages(v, tables)
+                # pad the gathered time axis by C so the in-block write
+                # at ``start`` never clamps (dynamic_update_slice CLAMPS
+                # an out-of-range start, which would silently shift a
+                # pad lane's garbage onto valid rows)
+                pad = ((0, 0), (0, 0), (0, 0), (0, cb), (0, 0))
+                kc, vc = jnp.pad(kc, pad), jnp.pad(vc, pad)
+                logits, kc, vc = adapter.prefill_chunk(
+                    params, prompts, starts, kc, vc)
+                # write-back: chunk rows -> (page, offset); rows past the
+                # lane's true chunk length go to sentinel page 0
+                rows = starts[:, None] + jnp.arange(cb)[None, :]
+                valid = jnp.arange(cb)[None, :] < chunk_lens[:, None]
+                pidx = jnp.clip(rows // page_len, 0, mp - 1)
+                pages = jnp.where(
+                    valid, jnp.take_along_axis(tables, pidx, axis=1), 0)
+                offs = rows % page_len
+                rix = jnp.clip(rows, 0,
+                               kc.shape[3] - 1)[:, None, None, :, None]
+                krows = jnp.take_along_axis(kc, rix, axis=3)
+                vrows = jnp.take_along_axis(vc, rix, axis=3)
+                n = bb * cb
+                k = scatter_rows(
+                    k, pages.reshape(n), offs.reshape(n),
+                    krows.transpose(0, 3, 1, 2, 4).reshape(n, nl, nkv, nd))
+                v = scatter_rows(
+                    v, pages.reshape(n), offs.reshape(n),
+                    vrows.transpose(0, 3, 1, 2, 4).reshape(n, nl, nkv, nd))
+                last = jnp.clip(chunk_lens - 1, 0, cb - 1)
+                lrow = jnp.take_along_axis(
+                    logits, last[:, None, None], axis=1)[:, 0]   # [B, V]
+                ok = jnp.all(jnp.isfinite(lrow), axis=-1)
+                # sampling key folds the request's consumed count: the
+                # first generated token is token p_len of the stream
+                tok = pick(lrow, temps, top_ks, seeds,
+                           p_lens).astype(jnp.int32)
+                return k, v, tok, ok
+
+            def step(params, k, v, tokens, positions, tables, active,
+                     temps, top_ks, seeds):
+                traces["step"] += 1        # host-side retrace witness
+                retrace.labels(program="step").inc()
+                page_len, mp = k.shape[3], tables.shape[1]
+                kc = gather_pages(k, tables)
+                vc = gather_pages(v, tables)
+                logits, kc, vc = adapter.decode(params, tokens,
+                                                positions, kc, vc)
+                slot_ok = jnp.all(jnp.isfinite(logits), axis=-1)
+                nxt = pick(logits, temps, top_ks, seeds,
+                           positions + 1).astype(jnp.int32)
+                pidx = jnp.clip(positions // page_len, 0, mp - 1)
+                pages = jnp.where(
+                    active,
+                    jnp.take_along_axis(tables, pidx[:, None],
+                                        axis=1)[:, 0],
+                    0)
+                offs = positions % page_len
+                rix = jnp.clip(positions, 0,
+                               kc.shape[3] - 1)[:, None, None, None, None]
+                krow = jnp.take_along_axis(kc, rix, axis=3)[:, :, :, 0]
+                vrow = jnp.take_along_axis(vc, rix, axis=3)[:, :, :, 0]
+                k = scatter_rows(k, pages, offs, krow)
+                v = scatter_rows(v, pages, offs, vrow)
+                return k, v, jnp.where(active, nxt, 0), slot_ok
+
+            donate = () if jax.default_backend() == "cpu" else (1, 2)
+            entry = {"prefill": jax.jit(prefill, donate_argnums=donate),
+                     "step": jax.jit(step, donate_argnums=donate),
+                     "traces": traces}
+            self._PROGRAMS[self._program_key()] = entry
+        self._prefill_fn = entry["prefill"]
+        self._step_fn = entry["step"]
+        self._traces = entry["traces"]
+
     @property
     def trace_counts(self):
         """{'prefill': n, 'step': n} — times the (shared) program was
@@ -346,14 +535,35 @@ class InferenceEngine:
         k, v = ab(self.cache.k), ab(self.cache.v)
         key = ab(self._key)
         n = self.cache.n_slots
-        prompt = jax.ShapeDtypeStruct((1, self.max_prompt_len), jnp.int32)
-        scalar = jax.ShapeDtypeStruct((), jnp.int32)
         lane = jax.ShapeDtypeStruct((n,), jnp.int32)
         active = jax.ShapeDtypeStruct((n,), jnp.bool_)
-        progs = {"prefill": self._prefill_fn.lower(
-                     params, k, v, prompt, scalar, scalar, key).compile(),
-                 "decode": self._step_fn.lower(
-                     params, k, v, lane, lane, active, key).compile()}
+        if self._paged:
+            # analysis shapes: a full-lane [B=lane_cap, C=chunk_cap]
+            # prefill bucket and the (only) decode signature
+            b = self._lane_cap
+            mp = self.cache.max_pages
+            prompts = jax.ShapeDtypeStruct((b, self._chunk_cap),
+                                           jnp.int32)
+            blane = jax.ShapeDtypeStruct((b,), jnp.int32)
+            bf32 = jax.ShapeDtypeStruct((b,), jnp.float32)
+            btab = jax.ShapeDtypeStruct((b, mp), jnp.int32)
+            tab = jax.ShapeDtypeStruct((n, mp), jnp.int32)
+            f32 = jax.ShapeDtypeStruct((n,), jnp.float32)
+            progs = {"prefill": self._prefill_fn.lower(
+                         params, k, v, prompts, blane, blane, blane,
+                         btab, bf32, blane, blane).compile(),
+                     "decode": self._step_fn.lower(
+                         params, k, v, lane, lane, tab, active, f32,
+                         lane, lane).compile()}
+        else:
+            prompt = jax.ShapeDtypeStruct((1, self.max_prompt_len),
+                                          jnp.int32)
+            scalar = jax.ShapeDtypeStruct((), jnp.int32)
+            progs = {"prefill": self._prefill_fn.lower(
+                         params, k, v, prompt, scalar, scalar,
+                         key).compile(),
+                     "decode": self._step_fn.lower(
+                         params, k, v, lane, lane, active, key).compile()}
         self._COST_PROGRAMS[sig] = dict(progs)
         return progs
 
@@ -409,7 +619,7 @@ class InferenceEngine:
     # -- request API -------------------------------------------------------
     def submit(self, prompt, max_new, stream=None, eos_id=None,
                arrival=None, deadline=None, ttl=None, replay=None,
-               rid=None):
+               rid=None, temperature=None, top_k=None, seed=None):
         """Queue one generation request; returns its Request handle.
         ``stream(token, request)`` is called per generated token.
         ``ttl`` (seconds from now) or ``deadline`` (absolute, on the
@@ -418,9 +628,23 @@ class InferenceEngine:
         whatever tokens it produced.  ``replay=`` (fleet failover)
         teacher-forces a previous attempt's tokens to rebuild the KV
         state without re-emitting them, and ``rid=`` keeps the failed
-        attempt's cluster-level id.  Raises
+        attempt's cluster-level id.  ``temperature=`` / ``top_k=`` /
+        ``seed=`` override the engine defaults for THIS request (paged
+        engines only — per-slot sampling is a decode operand there, a
+        compile-time constant on the slot engine).  Raises
         :class:`~.scheduler.EngineOverloaded` when the bounded queue
         refuses admission."""
+        if not self._paged and (temperature is not None
+                                or top_k is not None or seed is not None):
+            raise ValueError(
+                "per-request sampling (temperature/top_k/seed) requires "
+                "a paged engine (paged=True); the slot engine bakes "
+                "sampling into the compiled program")
+        if temperature is not None and float(temperature) < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        if top_k is not None and int(top_k) < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size > self.max_prompt_len:
             raise ValueError(
@@ -442,7 +666,8 @@ class InferenceEngine:
                       arrival=now if arrival is None else arrival,
                       stream=stream,
                       eos_id=self.eos_id if eos_id is None else eos_id,
-                      deadline=deadline, replay=replay, rid=rid)
+                      deadline=deadline, replay=replay, rid=rid,
+                      temperature=temperature, top_k=top_k, seed=seed)
         try:
             self.scheduler.submit(req, now=now)
         finally:
@@ -550,7 +775,13 @@ class InferenceEngine:
                 m.observe(v)
 
     def _finalize_active(self, req, reason, now):
-        """Retire a RUNNING request (slot freed immediately)."""
+        """Retire a RUNNING request (slot freed immediately).  A
+        request retired mid-chunked-prefill (cancel/expire/harvest)
+        also leaves the in-progress prefill registry."""
+        if req.slot is not None and req.slot in self._prefilling:
+            self._prefilling.pop(req.slot, None)
+            if req.slot in self._prefill_order:
+                self._prefill_order.remove(req.slot)
         req.t_done = now
         self.scheduler.retire(req, reason)
         self._record(req)
@@ -630,12 +861,163 @@ class InferenceEngine:
             "retired with finish_reason='error'; engine continues")
 
     # -- the iteration -----------------------------------------------------
+    def _prefill_paged(self):
+        """Paged admission/prefill: continue in-flight chunked prefills
+        (admission order), admit new requests up to the scheduler's
+        count budget AND the per-iteration ``prefill_token_budget``,
+        then run ALL lanes as ONE batched ``[B, C]`` prefill call (both
+        axes pow2-bucketed).  Lanes whose final chunk lands emit their
+        first token; the rest park in ``_prefilling`` and decode
+        proceeds around them.  Returns tokens produced."""
+        produced = 0
+        budget = self.prefill_token_budget
+        used = 0
+        work = []   # [req, slot, start, chunk_len]
+        for slot in list(self._prefill_order):
+            if (len(work) >= self._lane_cap
+                    or (budget is not None and used >= budget)):
+                break
+            st = self._prefilling[slot]
+            req = st["req"]
+            clen = min(int(req.prompt.size) - st["start"],
+                       self._chunk_cap)
+            if budget is not None:
+                clen = min(clen, budget - used)
+            if clen <= 0:
+                break
+            work.append((req, slot, st["start"], clen))
+            used += clen
+        if (len(work) < self._lane_cap
+                and (budget is None or used < budget)):
+            tb = None if budget is None else budget - used
+            for req, slot in self.scheduler.admit(token_budget=tb):
+                req.t_admit = self._now()
+                self._rt.event(req.rid, "admitted",
+                               engine=self.instance, slot=slot)
+                self._rt.event(req.rid, "prefill_start",
+                               engine=self.instance, slot=slot,
+                               prompt_len=int(req.prompt.size))
+                self._temps[slot] = (self._sampling[0]
+                                     if req.temperature is None
+                                     else req.temperature)
+                self._topks[slot] = (self._sampling[1]
+                                     if req.top_k is None else req.top_k)
+                self._seeds[slot] = (self._default_seed
+                                     if req.seed is None else req.seed)
+                self._dev_sampling = None
+                self._prefilling[slot] = {"req": req, "start": 0}
+                self._prefill_order.append(slot)
+                clen = min(int(req.prompt.size), self._chunk_cap)
+                if budget is not None:
+                    clen = min(clen, budget - used)
+                if clen > 0 and len(work) < self._lane_cap:
+                    work.append((req, slot, 0, clen))
+                    used += clen
+        if not work:
+            return 0
+        bb = min(_p2(len(work)), self._lane_cap)
+        cb = _p2(max(w[3] for w in work))
+        mp = self.cache.max_pages
+        prompts = np.zeros((bb, cb), np.int32)
+        p_lens = np.ones(bb, np.int32)
+        starts = np.zeros(bb, np.int32)
+        chunk_lens = np.zeros(bb, np.int32)   # pad lanes: 0 valid rows
+        tables = np.zeros((bb, mp), np.int32)
+        temps = np.zeros(bb, np.float32)
+        topks = np.zeros(bb, np.int32)
+        seeds = np.zeros(bb, np.int32)
+        for i, (req, slot, start, clen) in enumerate(work):
+            prompts[i, :clen] = req.prompt[start:start + clen]
+            p_lens[i] = req.prompt.size
+            starts[i] = start
+            chunk_lens[i] = clen
+            tables[i] = self.cache.block_tables[slot]
+            temps[i] = self._temps[slot]
+            topks[i] = self._topks[slot]
+            seeds[i] = self._seeds[slot]
+        try:
+            with self._tr.span("serve_prefill"):
+                k, v, toks, oks = self._prefill_fn(
+                    self.params, self.cache.k, self.cache.v,
+                    jnp.asarray(prompts), jnp.asarray(p_lens),
+                    jnp.asarray(starts), jnp.asarray(chunk_lens),
+                    jnp.asarray(tables), jnp.asarray(temps),
+                    jnp.asarray(topks), jnp.asarray(seeds))
+                self.cache.update(k, v)
+                toks = np.asarray(toks)
+                oks = np.asarray(oks)
+        except Exception as e:
+            if not self.watchdog:
+                raise
+            now = self._now()
+            self.watchdog_trips += 1
+            self._m_watchdog.inc()
+            why = (f"batched prefill raised {type(e).__name__}: {e}")
+            warnings.warn(f"decode watchdog: {why} — quarantined")
+            for req, slot, start, clen in work:
+                self._rt.event(req.rid, "watchdog_trip",
+                               engine=self.instance,
+                               why="prefill_raise")
+                self._fl.incident("watchdog", rid=req.rid,
+                                  extra={"engine": self.instance,
+                                         "why": why})
+                self._finalize_active(req, "error", now)
+            return 0
+        now = self._now()
+        for i, (req, slot, start, clen) in enumerate(work):
+            self.prefill_chunks += 1
+            if self.watchdog and not bool(oks[i]):
+                self.watchdog_trips += 1
+                self._m_watchdog.inc()
+                warnings.warn(
+                    f"decode watchdog: non-finite prefill logits for "
+                    f"request {req.rid} — quarantined")
+                self._rt.event(req.rid, "watchdog_trip",
+                               engine=self.instance,
+                               why="nonfinite_prefill")
+                self._fl.incident(
+                    "watchdog", rid=req.rid,
+                    extra={"engine": self.instance,
+                           "why": "non-finite prefill logits"})
+                self._finalize_active(req, "error", now)
+                continue
+            if start + clen < int(req.prompt.size):
+                # mid-prompt: park until the next iteration's chunk —
+                # decode interleaves in the meantime
+                self._prefilling[slot]["start"] = start + clen
+                self._rt.event(req.rid, "prefill_chunk",
+                               engine=self.instance, slot=slot,
+                               start=start, tokens=clen)
+                continue
+            self._prefilling.pop(slot, None)
+            self._prefill_order.remove(slot)
+            self.cache.positions[slot] = int(req.prompt.size)
+            self.prefills += 1
+            self._m_prefill_iters.inc()
+            self._rt.event(req.rid, "prefill_end", engine=self.instance,
+                           slot=slot, ok=True)
+            tok = int(toks[i])
+            forced = req.next_replay()
+            if forced is not None:
+                tok = forced
+                self._last_tokens[slot] = tok
+                self._absorb_replay(req, tok)
+            else:
+                self._last_tokens[slot] = tok
+                self._emit(req, tok, now)
+                produced += 1
+            self._maybe_retire(req, tok, now)
+        return produced
+
     def step(self):
         """One scheduler iteration: expire/admit/prefill, then one fused
         decode step for everything in flight.  Returns the number of
         tokens produced."""
         produced = 0
         self._expire(self._now())
+        if self._paged:
+            produced += self._prefill_paged()
+            return produced + self._step_decode()
         # 1) admission: prefill up to the budget into free slots
         for req, slot in self.scheduler.admit():
             req.t_admit = self._now()
@@ -708,11 +1090,34 @@ class InferenceEngine:
                 self._emit(req, tok, now)
                 produced += 1
             self._maybe_retire(req, tok, now)
-        # 2) one decode iteration over every active slot
+        return produced + self._step_decode()
+
+    def _step_decode(self):
+        """One fused decode iteration over every active slot (shared by
+        the slot and paged paths; the paged call swaps the PRNG key for
+        block-table + per-slot sampling operands and skips slots whose
+        prompt is still mid-chunked-prefill)."""
+        produced = 0
+        live = len(self.scheduler.running)
+        if live:
+            self.peak_active = max(self.peak_active, live)
+            self.peak_live_tokens = max(self.peak_live_tokens,
+                                        int(self.cache.positions.sum()))
         slots = self.scheduler.active_slots()
+        if self._paged:
+            # mid-prefill slots hold pages but have no decodable token
+            # yet — decode proceeds AROUND them (that's the chunked
+            # interleaving), their lanes masked to the sentinel page
+            slots = [s for s in slots if s not in self._prefilling]
         if slots:
             active = np.zeros(self.cache.n_slots, bool)
             active[slots] = True
+            # the active mask only changes at request boundaries; reuse
+            # the device copy across the (long) decode runs in between
+            akey = active.tobytes()
+            if self._dev_active[0] != akey:
+                self._dev_active = (akey, jnp.asarray(active))
+            dev_active = self._dev_active[1]
             occ = len(slots) / self.cache.n_slots
             self.occupancy.append(occ)
             self._m_occ.set(occ)
@@ -724,11 +1129,25 @@ class InferenceEngine:
                     # copy, and the post-dispatch mutation raced the
                     # pending read (nondeterministic streams — the
                     # tier-1 serving flake)
-                    k, v, nxt, slot_ok = self._step_fn(
-                        self.params, self.cache.k, self.cache.v,
-                        jnp.asarray(self._last_tokens.copy()),
-                        self.cache.device_positions(),
-                        jnp.asarray(active), self._next_key())
+                    if self._paged:
+                        if self._dev_sampling is None:
+                            self._dev_sampling = (
+                                jnp.asarray(self._temps.copy()),
+                                jnp.asarray(self._topks.copy()),
+                                jnp.asarray(self._seeds.copy()))
+                        temps, topks, seeds = self._dev_sampling
+                        k, v, nxt, slot_ok = self._step_fn(
+                            self.params, self.cache.k, self.cache.v,
+                            jnp.asarray(self._last_tokens.copy()),
+                            self.cache.device_positions(),
+                            self.cache.device_block_tables(),
+                            dev_active, temps, topks, seeds)
+                    else:
+                        k, v, nxt, slot_ok = self._step_fn(
+                            self.params, self.cache.k, self.cache.v,
+                            jnp.asarray(self._last_tokens.copy()),
+                            self.cache.device_positions(),
+                            dev_active, self._next_key())
                     self.cache.update(k, v)
                     self.cache.advance(slots)
                     # materialize INSIDE the span: this is where the
@@ -850,6 +1269,9 @@ class InferenceEngine:
         self.occupancy = []
         self.decode_steps = 0
         self.prefills = 0
+        self.prefill_chunks = 0
+        self.peak_active = 0
+        self.peak_live_tokens = 0
         self.cancellations = 0
         self.expirations = 0
         self.watchdog_trips = 0
@@ -860,10 +1282,13 @@ class InferenceEngine:
     # -- reporting ---------------------------------------------------------
     def stats(self):
         occ = float(np.mean(self.occupancy)) if self.occupancy else 0.0
-        return {"n_slots": self.cache.n_slots,
+        out = {"n_slots": self.cache.n_slots,
                 "mean_occupancy": round(occ, 4),
                 "decode_steps": self.decode_steps,
                 "prefills": self.prefills,
+                "prefill_chunks": self.prefill_chunks,
+                "peak_active": self.peak_active,
+                "peak_live_tokens": self.peak_live_tokens,
                 "requests_finished": len(self.records),
                 "slot_allocs": self.cache.alloc_count,
                 "slot_frees": self.cache.free_count,
@@ -876,3 +1301,6 @@ class InferenceEngine:
                 "streams_detached": self.streams_detached,
                 "replayed_tokens": self.replayed_tokens,
                 "trace_counts": self.trace_counts}
+        if self._paged:
+            out["pages"] = self.cache.occupancy()
+        return out

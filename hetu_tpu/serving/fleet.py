@@ -109,13 +109,20 @@ class FleetRequest:
     (wedged) attempt are fenced off."""
 
     def __init__(self, prompt, max_new, stream=None, eos_id=None,
-                 deadline=None, arrival=None, hedge=False):
+                 deadline=None, arrival=None, hedge=False,
+                 temperature=None, top_k=None, seed=None):
         self.rid = None             # set at first dispatch
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.stream_cb = stream
         self.eos_id = eos_id
         self.deadline = None if deadline is None else float(deadline)
+        # per-request sampling (paged replicas): request-scoped, so a
+        # failover re-dispatch samples under the SAME seed and the
+        # continued stream stays bit-exact
+        self.temperature = temperature
+        self.top_k = top_k
+        self.seed = seed
         self.hedge = bool(hedge)
         self.attempt = None         # current engine-level Request
         self.engine = None          # replica name serving the attempt
@@ -461,11 +468,18 @@ class EngineFleet:
     def _submit_on(self, rep, freq, replay=None, secondary=False):
         """Dispatch (or re-dispatch) one fleet request onto a replica.
         Caller picked ``rep``; raises EngineOverloaded through."""
+        # sampling kwargs ride along only when set: LLM engines accept
+        # them (paged ones honor them), EmbeddingServer fleets never
+        # see unexpected keywords
+        kw = {k: getattr(freq, k) for k in ("temperature", "top_k",
+                                            "seed")
+              if getattr(freq, k, None) is not None}
         with rep.lock:
             attempt = rep.engine.submit(
                 freq.prompt, freq.max_new,
                 stream=self._wrap_stream(freq), eos_id=freq.eos_id,
-                deadline=freq.deadline, replay=replay, rid=freq.rid)
+                deadline=freq.deadline, replay=replay, rid=freq.rid,
+                **kw)
             rep.inflight[attempt.rid] = (freq, attempt)
             rep.dispatches += 1
         if secondary:
@@ -480,7 +494,8 @@ class EngineFleet:
         return attempt
 
     def submit(self, prompt, max_new, stream=None, eos_id=None,
-               ttl=None, deadline=None, hedge=False):
+               ttl=None, deadline=None, hedge=False, temperature=None,
+               top_k=None, seed=None):
         """Route one request to the best replica; returns its
         :class:`FleetRequest`.  Raises :class:`FleetUnavailable` when no
         replica is dispatchable, or the last replica's
@@ -497,7 +512,9 @@ class EngineFleet:
             deadline = now + float(ttl)
         freq = FleetRequest(prompt, max_new, stream=stream,
                             eos_id=eos_id, deadline=deadline,
-                            arrival=now, hedge=hedge)
+                            arrival=now, hedge=hedge,
+                            temperature=temperature, top_k=top_k,
+                            seed=seed)
         rep = self._place(freq, now=now)
         self._requests[freq.rid] = freq
         self.submitted += 1

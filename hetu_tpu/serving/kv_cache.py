@@ -1,6 +1,7 @@
-"""Preallocated slot pool for serving K/V caches.
+"""Preallocated pools for serving K/V caches: dense slots and pages.
 
-The whole cache is ONE pair of static-shaped device arrays,
+:class:`SlotKVCache` is the original dense pool — ONE pair of
+static-shaped device arrays,
 
     k, v : [n_slots, layers, kv_heads, max_len, head_dim]
 
@@ -17,13 +18,59 @@ host-side in numpy and shipped into the step as a [n_slots] int32
 operand; stale rows beyond a slot's position are never attended (the
 step's mask is ``col <= position``) and are overwritten in order by
 subsequent decode writes, so freeing/reusing a slot needs no cache
-zeroing."""
+zeroing.
+
+:class:`PagedKVCache` keeps every one of those contracts but breaks the
+``max_len``-per-slot HBM proportionality: the pool is
+
+    k, v : [n_pages, layers, kv_heads, page_len, head_dim]
+
+and a slot owns only the pages its reserved token span needs
+(``ceil((prompt + max_new) / page_len)``, reserved in full at
+admission so a request can never run out of pages mid-flight).  A
+host-side ``[n_slots, max_pages]`` int32 block table maps a slot's
+logical rows to pages; the jitted programs receive it as an operand and
+gather ``pool[table]`` in-graph, so the executable — and therefore the
+compile-once guarantee — is untouched by which pages a request happens
+to hold.  Page 0 is a reserved sentinel: it is never allocated, every
+unused block-table entry points at it, and the engine routes the
+scatter-writes of inactive/padding lanes into it, so garbage rows land
+in a page nothing ever reads unmasked.  Gathers of page 0 are harmless
+for the same reason stale slot rows were: the attention mask is still
+``col <= position``."""
 
 from __future__ import annotations
 
 import numpy as np
 
 import jax.numpy as jnp
+
+
+def ceil_div(a, b):
+    return -(-int(a) // int(b))
+
+
+def gather_pages(pool, block_tables):
+    """Materialize per-slot contiguous caches from the page pool.
+
+    ``pool [n_pages, L, KV, page_len, D]`` gathered by ``block_tables
+    [S, max_pages]`` -> ``[S, L, KV, max_pages * page_len, D]`` with a
+    slot's pages concatenated in logical order along the time axis —
+    the exact layout the dense decode/prefill math already expects, so
+    the model code is shared verbatim between the slot and paged paths.
+    """
+    g = pool[block_tables]                      # [S, MP, L, KV, PL, D]
+    s, mp, l, kv, pl, d = g.shape
+    return jnp.transpose(g, (0, 2, 3, 1, 4, 5)).reshape(s, l, kv, mp * pl, d)
+
+
+def scatter_rows(pool, pages, offsets, rows):
+    """Write ``rows [N, L, KV, D]`` into ``pool`` at ``(pages[i],
+    offsets[i])``.  Duplicate (page, offset) pairs only ever occur on
+    the sentinel page 0 (inactive/padding lanes), where write order is
+    irrelevant; live (page, offset) pairs are distinct by construction
+    of the allocator."""
+    return pool.at[pages, :, :, offsets, :].set(rows)
 
 
 class SlotKVCache:
@@ -64,9 +111,12 @@ class SlotKVCache:
     def n_active(self):
         return self.n_slots - len(self._free)
 
-    def alloc(self, owner=None):
+    def alloc(self, owner=None, n_tokens=None):
         """Claim a free slot (lowest id first); None when the pool is
-        exhausted — admission control, not an error."""
+        exhausted — admission control, not an error.  ``n_tokens`` (the
+        paged pool's worst-case reservation) is accepted and ignored:
+        every dense slot already holds a full ``max_len`` span."""
+        del n_tokens
         if not self._free:
             return None
         slot = self._free.pop()
@@ -132,3 +182,280 @@ class SlotKVCache:
         """End the HBM-ledger accounting for this pool (idempotent).
         The arrays themselves are reclaimed by ordinary GC."""
         self._hbm_handle.free()
+
+
+class PagedKVCache:
+    """Fixed page pool + per-slot block tables (see module doc).
+
+    ``n_slots`` bounds concurrent requests (block-table operand rows),
+    ``max_len`` bounds one request's total span (prompt + generated),
+    ``page_len`` is the allocation granule, and ``n_pages`` sizes the
+    pool — the HBM budget — independently of ``n_slots * max_len``;
+    that decoupling is the whole point.  Default ``n_pages`` matches
+    the dense pool's worst case (every slot at full ``max_len``) plus
+    the sentinel, i.e. strictly safe; servers size it down to their
+    real mix.  ``label`` names this pool in metrics and in flight-
+    recorder incident dumps."""
+
+    def __init__(self, n_slots, layers, kv_heads, page_len, head_dim,
+                 max_len=128, n_pages=None, dtype=jnp.float32,
+                 label=None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if page_len < 1:
+            raise ValueError(f"page_len must be >= 1, got {page_len}")
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        self.n_slots = int(n_slots)
+        self.layers = int(layers)
+        self.kv_heads = int(kv_heads)
+        self.page_len = int(page_len)
+        self.head_dim = int(head_dim)
+        self.max_len = int(max_len)
+        self.max_pages = ceil_div(self.max_len, self.page_len)
+        if n_pages is None:
+            n_pages = self.n_slots * self.max_pages + 1  # + sentinel
+        self.n_pages = int(n_pages)
+        if self.n_pages < 2:
+            raise ValueError(
+                f"n_pages must be >= 2 (sentinel + one usable page), "
+                f"got {self.n_pages}")
+        self.label = str(label) if label is not None else f"kv:{id(self):x}"
+        shape = (self.n_pages, self.layers, self.kv_heads, self.page_len,
+                 self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # host mirrors: write position + reserved token capacity per
+        # slot, and the block tables the jitted programs consume.
+        # Unused table entries stay 0 = the sentinel page.
+        self.positions = np.zeros(self.n_slots, np.int32)
+        self.capacity = np.zeros(self.n_slots, np.int32)
+        self.block_tables = np.zeros((self.n_slots, self.max_pages),
+                                     np.int32)
+        self._free_slots = list(range(self.n_slots - 1, -1, -1))
+        self._owner = [None] * self.n_slots
+        self._slot_pages = [[] for _ in range(self.n_slots)]
+        # page 0 is the sentinel: never on the free list.  pop() hands
+        # out page 1 first.
+        self._free_pages = list(range(self.n_pages - 1, 0, -1))
+        # per-page refcounts: 1 for a privately-held page; >1 once a
+        # prefix-cache shares it (copy-on-write groundwork — freeing a
+        # slot only releases pages whose count hits 0)
+        self._ref = np.zeros(self.n_pages, np.int32)
+        # cached device copy of block_tables, dropped by every table
+        # mutation (_take_page/free/share_pages): tables change only at
+        # page-allocation events, but decode consumes them EVERY step —
+        # re-uploading an unchanged [n_slots, max_pages] array per step
+        # costs more host->device dispatch than the whole compiled step
+        self._dev_tables = None
+        self.alloc_count = 0
+        self.free_count = 0
+        self.page_alloc_count = 0
+        self.page_free_count = 0
+        from .. import telemetry
+        self._hbm_handle = telemetry.get_hbm_ledger().alloc(
+            "kv_cache", int(self.k.nbytes) + int(self.v.nbytes),
+            owner=f"kv_cache:{self.label}")
+        reg = telemetry.get_registry()
+        self._g_active = reg.gauge(
+            "hetu_serving_pages_active",
+            "KV pages currently allocated to slots, by pool",
+            labels=("pool",))
+        self._g_free = reg.gauge(
+            "hetu_serving_pages_free",
+            "KV pages on the free list (sentinel excluded), by pool",
+            labels=("pool",))
+        self._c_churn = reg.counter(
+            "hetu_serving_page_churn_total",
+            "KV page allocations + releases, by pool — allocation "
+            "traffic, the page-level analogue of slot alloc/free",
+            labels=("pool",))
+        self._flight = telemetry.get_flight()
+        self._flight.register_pages(self.label, self.occupancy)
+        self._sync_gauges()
+
+    # -- allocation --------------------------------------------------------
+    @property
+    def n_free(self):
+        return len(self._free_slots)
+
+    @property
+    def n_active(self):
+        return self.n_slots - len(self._free_slots)
+
+    @property
+    def pages_active(self):
+        return (self.n_pages - 1) - len(self._free_pages)
+
+    @property
+    def pages_free(self):
+        return len(self._free_pages)
+
+    def _sync_gauges(self):
+        self._g_active.labels(pool=self.label).set(self.pages_active)
+        self._g_free.labels(pool=self.label).set(self.pages_free)
+
+    def _take_page(self, slot):
+        page = self._free_pages.pop()
+        self._ref[page] = 1
+        self._slot_pages[slot].append(page)
+        self.block_tables[slot, len(self._slot_pages[slot]) - 1] = page
+        self._dev_tables = None
+        self.page_alloc_count += 1
+        self._c_churn.labels(pool=self.label).inc()
+        return page
+
+    def alloc(self, owner=None, n_tokens=None):
+        """Claim a free slot AND reserve every page its span needs.
+
+        ``n_tokens`` is the request's worst-case token span
+        (prompt + max_new); reserving ``ceil(n_tokens / page_len)``
+        pages up front means admission is the only place a request can
+        be refused — no mid-flight page exhaustion, no preemption.
+        Returns None (admission control, not an error) when either
+        slots or pages are short."""
+        n_tokens = self.max_len if n_tokens is None else int(n_tokens)
+        if n_tokens < 1 or n_tokens > self.max_len:
+            raise ValueError(
+                f"n_tokens must be in [1, max_len={self.max_len}], "
+                f"got {n_tokens}")
+        need = ceil_div(n_tokens, self.page_len)
+        if not self._free_slots or need > len(self._free_pages):
+            return None
+        slot = self._free_slots.pop()
+        self._owner[slot] = owner
+        self.positions[slot] = 0
+        self.capacity[slot] = need * self.page_len
+        for _ in range(need):
+            self._take_page(slot)
+        self.alloc_count += 1
+        self._sync_gauges()
+        return slot
+
+    def free(self, slot):
+        """Return ``slot`` and its pages to the pool.  Double-free is a
+        bug in the scheduler and raises; a shared page (refcount > 1)
+        survives until its last holder releases it."""
+        slot = int(slot)
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range")
+        if slot in self._free_slots:
+            raise RuntimeError(f"double free of slot {slot}")
+        for page in self._slot_pages[slot]:
+            if self._ref[page] < 1:
+                raise RuntimeError(
+                    f"page {page} refcount underflow (double release)")
+            self._ref[page] -= 1
+            if self._ref[page] == 0:
+                self._free_pages.append(page)
+                self.page_free_count += 1
+                self._c_churn.labels(pool=self.label).inc()
+        self._slot_pages[slot] = []
+        self.block_tables[slot, :] = 0
+        self._dev_tables = None
+        self._owner[slot] = None
+        self.positions[slot] = 0
+        self.capacity[slot] = 0
+        self._free_slots.append(slot)
+        self.free_count += 1
+        self._sync_gauges()
+        return None
+
+    def share_pages(self, src, dst, n_pages):
+        """Map ``src``'s first ``n_pages`` pages into ``dst``'s table
+        (refcounted, read-only by convention) — the copy-on-write hook
+        a prefix cache builds on.  ``dst`` must hold no pages yet."""
+        src, dst, n_pages = int(src), int(dst), int(n_pages)
+        if self._slot_pages[dst]:
+            raise RuntimeError(
+                f"slot {dst} already holds pages; share before append")
+        if n_pages > len(self._slot_pages[src]):
+            raise ValueError(
+                f"slot {src} holds {len(self._slot_pages[src])} pages, "
+                f"cannot share {n_pages}")
+        for i in range(n_pages):
+            page = self._slot_pages[src][i]
+            self._ref[page] += 1
+            self._slot_pages[dst].append(page)
+            self.block_tables[dst, i] = page
+        self._dev_tables = None
+        self.capacity[dst] = n_pages * self.page_len
+        self._sync_gauges()
+
+    def owner(self, slot):
+        return self._owner[slot]
+
+    def allocated_slots(self):
+        """Slots currently claimed (not on the free list), sorted."""
+        free = set(self._free_slots)
+        return [s for s in range(self.n_slots) if s not in free]
+
+    def audit(self):
+        """Lifetime accounting for the no-leak invariants: after a
+        drain ``allocs == frees``, ``in_use == 0``, AND ``page_allocs
+        == page_frees`` with ``pages_in_use == 0`` — a leaked page
+        starves admission just as surely as a leaked slot."""
+        return {"allocs": self.alloc_count,
+                "frees": self.free_count,
+                "in_use": self.n_active,
+                "page_allocs": self.page_alloc_count,
+                "page_frees": self.page_free_count,
+                "pages_in_use": self.pages_active}
+
+    def occupancy(self):
+        """Live page-pool occupancy/fragmentation — the block every
+        flight-recorder incident dump carries (registered at
+        construction) and the bench reports.  ``internal_fragmentation``
+        is the fraction of reserved token capacity not yet written:
+        worst-case reservation trades exactly this much slack for the
+        no-preemption guarantee."""
+        used = int(self.positions.sum())
+        reserved = int(self.capacity.sum())
+        usable = self.n_pages - 1
+        return {"n_pages": self.n_pages,
+                "page_len": self.page_len,
+                "pages_active": self.pages_active,
+                "pages_free": self.pages_free,
+                "utilization": (round(self.pages_active / usable, 4)
+                                if usable else 0.0),
+                "internal_fragmentation": (round(1.0 - used / reserved, 4)
+                                           if reserved else 0.0),
+                "page_churn": self.page_alloc_count + self.page_free_count}
+
+    # -- step plumbing -----------------------------------------------------
+    def device_positions(self):
+        # SNAPSHOT, not view — same aliasing hazard as SlotKVCache
+        return jnp.asarray(self.positions.copy())
+
+    def device_block_tables(self):
+        # SNAPSHOT, not view — ``free``/``alloc``/``share_pages``
+        # rewrite table rows in place between decode dispatches.  The
+        # snapshot is CACHED between mutations (every writer drops
+        # ``_dev_tables``): block tables change only at page-allocation
+        # events, so steady-state decode reuses one device buffer
+        # instead of paying an upload dispatch per step.
+        if self._dev_tables is None:
+            self._dev_tables = jnp.asarray(self.block_tables.copy())
+        return self._dev_tables
+
+    def advance(self, slots):
+        """Bump the write position of ``slots`` after a decode step
+        wrote one token each.  The guard is per-slot reserved capacity,
+        not the global ``max_len`` — overrunning a reservation would
+        scatter into another request's page."""
+        for s in slots:
+            if self.positions[s] >= self.capacity[s]:
+                raise RuntimeError(
+                    f"slot {s} overran its reserved capacity="
+                    f"{int(self.capacity[s])} (page_len={self.page_len})")
+            self.positions[s] += 1
+
+    def update(self, k, v):
+        """Adopt the cache arrays a jitted step returned."""
+        self.k, self.v = k, v
+
+    def close(self):
+        """End HBM-ledger accounting and unhook the flight-recorder
+        occupancy provider (idempotent)."""
+        self._hbm_handle.free()
+        self._flight.unregister_pages(self.label)

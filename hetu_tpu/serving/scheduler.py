@@ -102,7 +102,8 @@ class Request:
     """
 
     def __init__(self, prompt, max_new, arrival=None, stream=None,
-                 eos_id=None, deadline=None, replay=None, rid=None):
+                 eos_id=None, deadline=None, replay=None, rid=None,
+                 temperature=None, top_k=None, seed=None):
         self.rid = rid            # scheduler-scoped, set on submit
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -112,6 +113,12 @@ class Request:
         self.max_new = int(max_new)
         self.stream = stream
         self.eos_id = eos_id
+        # per-request sampling overrides (paged engines thread these as
+        # decode operands; None = use the engine's defaults)
+        self.temperature = (None if temperature is None
+                            else float(temperature))
+        self.top_k = None if top_k is None else int(top_k)
+        self.seed = None if seed is None else int(seed)
         # absolute deadline on the engine's monotonic clock; None = no TTL
         self.deadline = None if deadline is None else float(deadline)
         if replay is None:
@@ -339,18 +346,33 @@ class Scheduler:
                 "queued_tokens": int(queued),
                 "running_tokens": int(running)}
 
-    def admit(self):
+    def admit(self, token_budget=None):
         """Move queued requests into free slots; returns the admitted
-        [(request, slot)] for the engine to prefill, FIFO order."""
+        [(request, slot)] for the engine to prefill, FIFO order.
+
+        ``token_budget`` (paged engines) additionally caps the PROMPT
+        tokens admitted this iteration — the chunked-prefill knob that
+        keeps one long prompt from stalling in-flight decode.  Slot
+        allocation passes each request's worst-case token need
+        (prompt + max_new) so a paged pool reserves pages up front and
+        can never run out mid-flight."""
         out = []
         if self.gang and self.cache.n_active > 0:
             return out   # static batching: wait for the batch to drain
         budget = self.cache.n_slots if self.gang else self.prefill_budget
+        used_tokens = 0
         while self.queue and len(out) < budget:
             req = self.queue[0]
-            slot = self.cache.alloc(owner=req.rid)
+            if (token_budget is not None
+                    and used_tokens + int(req.prompt.size) > token_budget
+                    and out):
+                break   # FIFO: don't skip ahead past a too-long prompt
+            slot = self.cache.alloc(owner=req.rid,
+                                    n_tokens=(int(req.prompt.size)
+                                              + req.max_new))
             if slot is None:
                 break
+            used_tokens += int(req.prompt.size)
             self.queue.popleft()
             req.slot = slot
             self.running[slot] = req

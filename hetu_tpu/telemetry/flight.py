@@ -64,6 +64,7 @@ class FlightRecorder:
         self._incidents = []        # index entries, oldest first
         self._request_trace = None  # wired by telemetry.enable()
         self._hbm = None            # callable -> HBM ledger snapshot
+        self._pages = {}            # label -> callable -> page-pool occupancy
         self._m_incidents = None
 
     # -- configuration -----------------------------------------------------
@@ -80,6 +81,22 @@ class FlightRecorder:
         if hbm is not None:
             self._hbm = hbm
         return self
+
+    def register_pages(self, label, fn):
+        """Register a page-pool occupancy callable (``kv_cache.
+        PagedKVCache`` wires itself here at construction); every
+        incident dump then carries its live occupancy/fragmentation
+        under ``pages[label]`` — page-starved admission stalls and
+        fragmentation pathologies must be visible in the post-mortem,
+        not reconstructed from metrics after the fact."""
+        with self._lock:
+            self._pages[str(label)] = fn
+
+    def unregister_pages(self, label):
+        """Drop a page-pool provider (idempotent; pools unregister on
+        close so dumps never call into a torn-down cache)."""
+        with self._lock:
+            self._pages.pop(str(label), None)
 
     @property
     def dropped(self):
@@ -131,6 +148,8 @@ class FlightRecorder:
             self._m_incidents.labels(kind=str(kind)).inc()
         now = time.perf_counter()
         rt = self._request_trace
+        with self._lock:
+            pages_fns = dict(self._pages)
         dump = {"kind": str(kind),
                 "t": round(now - self._epoch, 9),
                 "rid": rid,
@@ -141,6 +160,8 @@ class FlightRecorder:
                              else None),
                 "registry": reg.snapshot() if reg is not None else None,
                 "hbm": self._hbm() if self._hbm is not None else None,
+                "pages": ({lbl: fn() for lbl, fn in pages_fns.items()}
+                          if pages_fns else None),
                 "extra": extra}
         with self._lock:
             self._seq += 1

@@ -5,9 +5,9 @@ the question the chaos/fleet benches kept re-deriving from scattered
 records — "what happened to request e0-17, and why was it slow?".
 Every rid accumulates a typed, monotonic-clock event timeline:
 
-    queued, admitted, prefill_start, prefill_end, decode_iter,
-    hot_hit, host_pull, watchdog_trip, harvested, failover_replay,
-    expired, cancelled, finish
+    queued, admitted, prefill_start, prefill_chunk, prefill_end,
+    decode_iter, hot_hit, host_pull, watchdog_trip, harvested,
+    failover_replay, expired, cancelled, finish
 
 ``decode_iter`` is ONE event per engine iteration per request (slot +
 token count), not one per token emission call, so a 64-token request
@@ -42,10 +42,10 @@ from collections import OrderedDict
 __all__ = ["RequestTrace", "EVENT_TYPES"]
 
 #: the full event vocabulary (tests pin additions to the doc)
-EVENT_TYPES = ("queued", "admitted", "prefill_start", "prefill_end",
-               "decode_iter", "hot_hit", "host_pull", "watchdog_trip",
-               "harvested", "failover_replay", "expired", "cancelled",
-               "finish")
+EVENT_TYPES = ("queued", "admitted", "prefill_start", "prefill_chunk",
+               "prefill_end", "decode_iter", "hot_hit", "host_pull",
+               "watchdog_trip", "harvested", "failover_replay",
+               "expired", "cancelled", "finish")
 
 #: attempt-level finish reasons that do NOT end the cluster timeline
 #: (the fleet re-homes the rid; more events follow)

@@ -151,7 +151,8 @@ def test_serve_stage_emits_full_and_compact(tmp_path):
     assert compact["compile_once"] is True
     full = json.loads(lines[-2])
     stages = full["stages"]
-    assert set(stages) == {"continuous", "static_batch"}
+    assert set(stages) == {"continuous", "static_batch", "paged",
+                           "slot_adjacent", "paged_longmix"}
     for s in stages.values():
         assert {"tokens_per_sec", "mean_occupancy", "decode_steps",
                 "latency_s", "trace_counts"} <= set(s)
@@ -162,6 +163,27 @@ def test_serve_stage_emits_full_and_compact(tmp_path):
             < stages["static_batch"]["decode_steps"])
     assert (stages["continuous"]["mean_occupancy"]
             > stages["static_batch"]["mean_occupancy"])
+    # paged twin (ISSUE 13): deterministic acceptance bits — byte-equal
+    # pools, bitwise greedy streams, strictly more admitted concurrency,
+    # retrace-flat measured replays.  Wall-clock vs_slot is asserted by
+    # the driver run, not here (shared-CPU noise).
+    pg = full["paged"]
+    assert pg["equal_hbm"] is True
+    assert pg["bitwise_match"] is True
+    assert pg["wins_concurrency"] is True
+    assert pg["compile_flat"] is True
+    assert (stages["paged"]["stream_sha"]
+            == stages["slot_adjacent"]["stream_sha"])
+    assert stages["paged"]["decode_steps"] \
+        < stages["slot_adjacent"]["decode_steps"]
+    assert stages["paged_longmix"]["prefill_chunks"] \
+        > stages["paged"]["prefill_chunks"]
+    assert {"serve_tokens_per_s", "serve_slot_tokens_per_s",
+            "serve_paged_peak_concurrency", "serve_slot_peak_concurrency",
+            "kv_hbm_bytes_per_token", "serve_chunked_tpot_p99_s"} \
+        <= set(full["signals"])
+    assert {"tok_s", "vs_slot", "peak", "kv_B_per_tok", "bitwise",
+            "equal_hbm", "compile_flat"} <= set(compact["paged"])
     with open(tmp_path / "serve.json") as f:
         assert json.load(f) == full
 

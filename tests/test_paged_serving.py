@@ -1,0 +1,469 @@
+"""Paged KV-cache serving invariants (hetu_tpu/serving/kv_cache.py
+PagedKVCache + the engine's paged=True path).
+
+The contracts pinned here:
+* page allocator: worst-case reservation at admission, double-free /
+  refcount-underflow / capacity-overrun guards, allocs==frees AND
+  page_allocs==page_frees after mixed churn, share_pages refcounts
+  (the copy-on-write groundwork);
+* PAGING NEVER CHANGES WHAT IS GENERATED — the paged engine's greedy
+  streams are BITWISE identical to the slot engine's and to the
+  one-shot ``greedy_generate`` oracle, for both the Llama and GPT
+  tiers, even though prefill is batched + chunked and decode gathers
+  through block tables;
+* chunked prefill interleaves: with a small ``prefill_token_budget`` a
+  long prompt prefills across several iterations while OTHER requests
+  decode in between (the head-of-line-blocking fix);
+* per-request sampling operands: a sampled stream at a fixed seed is
+  reproducible, independent of co-tenants, and never perturbs a greedy
+  neighbour; the slot engine refuses the overrides (compile-time
+  constants there);
+* compile-once holds: decode traces once, prefill once per pow2
+  [B, C] bucket, and re-running the workload retraces nothing; the
+  paged and slot program caches never collide;
+* fleet failover replays into a PAGED sibling bitwise;
+* the page pool is HBM-ledger-accounted and its occupancy rides every
+  flight-recorder incident dump.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import telemetry
+from hetu_tpu.models import (GPTConfig, GPTModel, LlamaConfig,
+                             LlamaForCausalLM)
+from hetu_tpu.models.gpt_decode import greedy_generate as gpt_generate
+from hetu_tpu.models.llama_decode import greedy_generate
+from hetu_tpu.resilience import faults
+from hetu_tpu.serving import (EngineFleet, InferenceEngine, PagedKVCache)
+
+V = 64
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _llama(name, seq_len=16):
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=seq_len)
+    model = LlamaForCausalLM(c, name=name)
+    ids = ht.placeholder_op(f"{name}_ids", (1, 4), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    return ex, model
+
+
+def _gpt(name):
+    c = GPTConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                  num_heads=4, seq_len=32, dropout_prob=0.0)
+    model = GPTModel(c, name=name)
+    ids = ht.placeholder_op(f"{name}_ids", (1, 4), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    return ex, model
+
+
+def _prompts(rng, n, lo=3, hi=9):
+    return [rng.integers(1, V, (int(L),))
+            for L in rng.integers(lo, hi, n)]
+
+
+def _pool(n_slots=2, page_len=4, max_len=16, **kw):
+    return PagedKVCache(n_slots, layers=2, kv_heads=2,
+                        page_len=page_len, head_dim=4, max_len=max_len,
+                        **kw)
+
+
+# -- page allocator ----------------------------------------------------------
+
+def test_page_alloc_reserves_worst_case_span():
+    pool = _pool(n_slots=3, page_len=4, max_len=16, n_pages=7)
+    # 6 usable pages (page 0 is the sentinel, never handed out)
+    assert pool.pages_free == 6
+    a = pool.alloc(owner="a", n_tokens=9)      # ceil(9/4) = 3 pages
+    assert a is not None
+    assert pool.pages_active == 3
+    assert int(pool.capacity[a]) == 12
+    assert 0 not in pool._slot_pages[a]
+    # table rows beyond the reservation stay on the sentinel
+    assert list(pool.block_tables[a, 3:]) == [0]
+    b = pool.alloc(owner="b", n_tokens=12)     # 3 more pages: exhausted
+    assert b is not None and pool.pages_free == 0
+    # slots remain, pages don't: admission refused, not an error
+    assert pool.alloc(owner="c", n_tokens=1) is None
+    pool.free(a)
+    assert pool.pages_free == 3
+    assert pool.alloc(owner="c", n_tokens=1) is not None
+    with pytest.raises(ValueError, match="n_tokens"):
+        pool.alloc(n_tokens=17)                # > max_len
+
+
+def test_page_pool_double_free_and_overrun_raise():
+    pool = _pool(n_slots=1, page_len=4, max_len=8)
+    s = pool.alloc(n_tokens=4)                 # one page: capacity 4
+    for _ in range(4):
+        pool.advance([s])
+    with pytest.raises(RuntimeError, match="reserved capacity"):
+        pool.advance([s])                      # would cross into a
+    pool.free(s)                               # page it doesn't own
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.free(s)
+
+
+def test_page_pool_churn_soak_audit_balances(rng):
+    pool = _pool(n_slots=4, page_len=4, max_len=16)
+    live = []
+    for _ in range(200):
+        if live and rng.random() < 0.45:
+            pool.free(live.pop(rng.integers(len(live))))
+        else:
+            s = pool.alloc(n_tokens=int(rng.integers(1, 17)))
+            if s is not None:
+                live.append(s)
+    for s in live:
+        pool.free(s)
+    a = pool.audit()
+    assert a["allocs"] == a["frees"] and a["in_use"] == 0
+    assert a["page_allocs"] == a["page_frees"]
+    assert a["pages_in_use"] == 0
+    assert pool.pages_free == pool.n_pages - 1
+    # every table row is back on the sentinel
+    assert int(pool.block_tables.sum()) == 0
+
+
+def test_share_pages_refcounts_survive_first_free():
+    pool = _pool(n_slots=2, page_len=4, max_len=16)
+    src = pool.alloc(owner="src", n_tokens=8)  # 2 pages
+    dst = 1 - src
+    # claim the sibling slot bare — the prefix-cache path shares into
+    # a slot that holds no pages of its own yet
+    pool._free_slots.remove(dst)
+    pool.share_pages(src, dst, 2)
+    shared = list(pool._slot_pages[src])
+    assert list(pool._slot_pages[dst]) == shared
+    assert all(pool._ref[p] == 2 for p in shared)
+    assert int(pool.capacity[dst]) == 8
+    pool.free(src)                             # shared pages survive
+    assert all(pool._ref[p] == 1 for p in shared)
+    assert pool.pages_active == 2
+    pool.free(dst)                             # last holder releases
+    assert pool.pages_active == 0
+    a = pool.audit()
+    assert a["page_allocs"] == a["page_frees"]
+    # sharing into an occupied table is refused
+    s2 = pool.alloc(n_tokens=4)
+    with pytest.raises(RuntimeError, match="already holds"):
+        pool.share_pages(s2, s2, 1)
+
+
+def test_occupancy_reports_fragmentation():
+    pool = _pool(n_slots=2, page_len=4, max_len=16, n_pages=9)
+    s = pool.alloc(n_tokens=6)                 # reserves 8, uses 0
+    occ = pool.occupancy()
+    assert occ["pages_active"] == 2 and occ["pages_free"] == 6
+    assert occ["utilization"] == pytest.approx(2 / 8)
+    assert occ["internal_fragmentation"] == 1.0
+    for _ in range(6):
+        pool.advance([s])
+    assert pool.occupancy()["internal_fragmentation"] == \
+        pytest.approx(1 - 6 / 8)
+
+
+# -- bitwise parity against the slot engine and the oracle -------------------
+
+def test_paged_engine_bitwise_matches_slot_and_oracle_llama(rng):
+    ex, model = _llama("pgl")
+    prompts = _prompts(rng, 6)
+    slot = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                           max_prompt_len=16, name="pgl")
+    paged = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                            max_prompt_len=16, name="pgl", paged=True,
+                            page_len=4)
+    outs_s = slot.generate_many(prompts, 10)
+    outs_p = paged.generate_many(prompts, 10)
+    for p, s, g in zip(prompts, outs_s, outs_p):
+        oracle = greedy_generate(ex, model, p[None], 10,
+                                 name="pgl")[0, len(p):]
+        np.testing.assert_array_equal(s, oracle)
+        np.testing.assert_array_equal(g, oracle)
+    a = paged.cache.audit()
+    assert a["page_allocs"] == a["page_frees"] and a["pages_in_use"] == 0
+
+
+def test_paged_engine_bitwise_matches_oracle_gpt(rng):
+    ex, model = _gpt("pgg")
+    prompts = _prompts(rng, 5)
+    paged = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                            max_prompt_len=16, name="pgg", paged=True,
+                            page_len=8)
+    outs = paged.generate_many(prompts, 10)
+    for p, g in zip(prompts, outs):
+        oracle = gpt_generate(ex, model, p[None], 10,
+                              name="pgg")[0, len(p):]
+        np.testing.assert_array_equal(g, oracle)
+
+
+def test_paged_twin_packs_more_slots_into_the_same_pool(rng):
+    """The perf claim in allocator form: at the DENSE pool's byte
+    budget (n_slots * max_pages usable pages), a paged engine admits
+    more concurrent requests than the slot twin has slots, because
+    real requests reserve less than max_len."""
+    ex, model = _llama("pgc")
+    # slot twin: 2 slots * 32 tokens.  Same usable pages: 8 * page 8.
+    paged = InferenceEngine(ex, model, n_slots=6, max_len=32,
+                            max_prompt_len=16, name="pgc", paged=True,
+                            page_len=8, n_pages=9)
+    prompts = _prompts(rng, 6, lo=3, hi=8)
+    # short requests: prompt + 4 new <= 12 tokens -> ceil(12/8)=2 pages
+    outs = paged.generate_many(prompts, 4)
+    for p, g in zip(prompts, outs):
+        oracle = greedy_generate(ex, model, p[None], 4,
+                                 name="pgc")[0, len(p):]
+        np.testing.assert_array_equal(g, oracle)
+    assert paged.peak_active > 2     # beats the dense twin's n_slots
+    a = paged.cache.audit()
+    assert a["in_use"] == 0 and a["pages_in_use"] == 0
+
+
+# -- chunked prefill ---------------------------------------------------------
+
+def test_chunked_prefill_interleaves_decode(rng):
+    """A long prompt under a small token budget prefills across
+    several iterations, and a short co-tenant DECODES between those
+    chunks — the head-of-line fix the budget exists for.  Outputs stay
+    bitwise-oracle regardless."""
+    ex, model = _llama("pgi")
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=16, name="pgi", paged=True,
+                          page_len=4, prefill_token_budget=4)
+    long_p = rng.integers(1, V, (13,))         # 4 chunks at budget 4
+    short_p = rng.integers(1, V, (3,))
+    short = eng.submit(short_p, 8)
+    eng.step()                                 # short admits+prefills
+    long = eng.submit(long_p, 8)
+    interleaved = 0
+    for _ in range(6):
+        before = len(short.tokens)
+        eng.step()
+        if (long.slot is not None and long.slot in eng._prefilling
+                and len(short.tokens) > before):
+            interleaved += 1                   # decode ran mid-prefill
+    assert interleaved >= 2
+    eng.run()
+    assert eng.prefill_chunks >= 4
+    np.testing.assert_array_equal(
+        short.result(),
+        greedy_generate(ex, model, short_p[None], 8,
+                        name="pgi")[0, len(short_p):])
+    np.testing.assert_array_equal(
+        long.result(),
+        greedy_generate(ex, model, long_p[None], 8,
+                        name="pgi")[0, len(long_p):])
+
+
+def test_prefill_token_budget_requires_paged(rng):
+    ex, model = _llama("pgb")
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(ex, model, n_slots=2, max_len=32, name="pgb",
+                        prefill_token_budget=8)
+
+
+# -- per-request sampling ----------------------------------------------------
+
+def test_per_request_sampling_deterministic_and_isolated(rng):
+    ex, model = _llama("pgs")
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=16, name="pgs", paged=True,
+                          page_len=4)
+    p = rng.integers(1, V, (5,))
+    g = rng.integers(1, V, (4,))
+
+    # greedy-alone reference stream
+    greedy_alone = eng.generate_many([g], 8)[0]
+
+    def sampled_run(seed):
+        r_s = eng.submit(p, 8, temperature=0.9, top_k=8, seed=seed)
+        r_g = eng.submit(g, 8)       # greedy neighbour, same batch
+        eng.run()
+        return r_s.result(), r_g.result()
+
+    s1, g1 = sampled_run(123)
+    s2, g2 = sampled_run(123)
+    s3, _ = sampled_run(321)
+    np.testing.assert_array_equal(s1, s2)      # fixed seed reproduces
+    assert not np.array_equal(s1, s3)          # seed actually matters
+    # a sampled co-tenant never perturbs the greedy neighbour
+    np.testing.assert_array_equal(g1, greedy_alone)
+    np.testing.assert_array_equal(g2, greedy_alone)
+    # temperature 0 through the operand path == the greedy argmax
+    r0 = eng.submit(g, 8, temperature=0.0, seed=77)
+    eng.run()
+    np.testing.assert_array_equal(r0.result(), greedy_alone)
+
+
+def test_slot_engine_refuses_sampling_overrides(rng):
+    ex, model = _llama("pgr")
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32, name="pgr")
+    with pytest.raises(ValueError, match="paged"):
+        eng.submit(rng.integers(1, V, (4,)), 8, temperature=0.7)
+    with pytest.raises(ValueError, match="paged"):
+        eng.submit(rng.integers(1, V, (4,)), 8, seed=3)
+
+
+# -- compile-once + program-cache coexistence --------------------------------
+
+def test_paged_compile_once_after_warmup(rng):
+    ex, model = _llama("pgo")
+    eng = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                          max_prompt_len=16, name="pgo", paged=True,
+                          page_len=4)
+    prompts = _prompts(rng, 4)
+    eng.generate_many(prompts, 8)              # warmup
+    warm = dict(eng.trace_counts)
+    assert warm["step"] == 1                   # decode: ONE signature
+    assert all(n == 1 for n in warm.values())  # each bucket once
+    eng.reset_stats()
+    eng.generate_many(prompts, 8)              # identical workload
+    assert eng.trace_counts == warm            # zero retraces
+    # a twin engine with the same geometry shares the executables
+    twin = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                           max_prompt_len=16, name="pgo", paged=True,
+                           page_len=4)
+    twin.generate_many(prompts, 8)
+    assert twin.trace_counts == warm
+
+
+def test_slot_and_paged_program_caches_never_collide(rng):
+    ex, model = _llama("pgx")
+    slot = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                           max_prompt_len=16, name="pgx")
+    paged = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                            max_prompt_len=16, name="pgx", paged=True,
+                            page_len=4)
+    assert slot._program_key() != paged._program_key()
+    assert slot.cost_signature() != paged.cost_signature()
+    assert slot._prefill_fn is not paged._prefill_fn
+    # geometry is part of the key: a different page_len is a
+    # different executable, never a silent cache hit
+    paged8 = InferenceEngine(ex, model, n_slots=2, max_len=32,
+                             max_prompt_len=16, name="pgx", paged=True,
+                             page_len=8)
+    assert paged8._program_key() != paged._program_key()
+    # all three work side by side
+    p = rng.integers(1, V, (5,))
+    oracle = greedy_generate(ex, model, p[None], 6, name="pgx")[0, 5:]
+    for eng in (slot, paged, paged8):
+        np.testing.assert_array_equal(eng.generate_many([p], 6)[0],
+                                      oracle)
+
+
+# -- fleet failover into a paged sibling -------------------------------------
+
+def test_crash_failover_into_paged_sibling_bitwise(rng):
+    """Kill a PAGED replica mid-decode: in-flight greedy streams
+    continue on paged siblings bitwise identical to an uninterrupted
+    run (replay is teacher-forced through the same paged
+    executables)."""
+    ex, model = _llama("pgf")
+    ekw = dict(n_slots=2, max_len=32, max_prompt_len=8, name="pgf",
+               paged=True, page_len=4)
+    prompts = _prompts(rng, 6)
+    base = InferenceEngine(ex, model, **ekw).generate_many(prompts, 10)
+    fleet = EngineFleet(ex, model, n_engines=3, threaded=False,
+                        engine_kwargs=ekw, breaker_base=1e-4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reqs = [fleet.submit(p, 10) for p in prompts]
+        fleet.pump(3)
+        victim = max(fleet._replicas, key=lambda r: len(r.inflight))
+        assert victim.inflight
+        faults.crash_engine(victim.engine)
+        fleet.wait(reqs)
+    assert fleet.stats()["failovers"] >= 1
+    assert all(r.finish_reason in ("eos", "max_new") for r in reqs)
+    for r, b in zip(reqs, base):
+        np.testing.assert_array_equal(r.result(), b)
+    for a in fleet.audit().values():
+        assert a["allocs"] == a["frees"] and a["in_use"] == 0
+        if "page_allocs" in a:
+            assert a["page_allocs"] == a["page_frees"]
+    fleet.stop()
+
+
+def test_fleet_failover_preserves_sampled_stream_at_fixed_seed(rng):
+    """Sampling keys derive from (request seed, consumed count) — not
+    the engine — so even a SAMPLED stream continues bit-exactly through
+    failover onto a paged sibling."""
+    ex, model = _llama("pgz")
+    ekw = dict(n_slots=2, max_len=32, max_prompt_len=8, name="pgz",
+               paged=True, page_len=4)
+    p = rng.integers(1, V, (5,))
+    solo = InferenceEngine(ex, model, **ekw)
+    r = solo.submit(p, 10, temperature=0.9, top_k=8, seed=99)
+    solo.run()
+    base = r.result()
+    fleet = EngineFleet(ex, model, n_engines=2, threaded=False,
+                        engine_kwargs=ekw, breaker_base=1e-4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        freq = fleet.submit(p, 10, temperature=0.9, top_k=8, seed=99)
+        fleet.pump(3)
+        victim = fleet._by_name(freq.engine)
+        faults.crash_engine(victim.engine)
+        fleet.wait([freq])
+    assert freq.failovers >= 1
+    np.testing.assert_array_equal(freq.result(), base)
+    fleet.stop()
+
+
+# -- telemetry surfaces ------------------------------------------------------
+
+def test_page_pool_is_hbm_ledger_accounted():
+    led = telemetry.get_hbm_ledger()
+    before = led.live_bytes("kv_cache")
+    pool = _pool(n_slots=2, page_len=4, max_len=16, label="ledger-t")
+    expected = int(pool.k.nbytes) + int(pool.v.nbytes)
+    assert led.live_bytes("kv_cache") == before + expected
+    owners = [b["owner"] for b in led.live_buffers("kv_cache")]
+    assert "kv_cache:ledger-t" in owners
+    pool.close()
+    assert led.live_bytes("kv_cache") == before
+    pool.close()                               # idempotent
+
+
+def test_incident_dumps_carry_page_occupancy(tmp_path):
+    telemetry.enable(incident_dir=str(tmp_path / "inc"))
+    try:
+        pool = _pool(n_slots=2, page_len=4, max_len=16,
+                     label="inc-pool")
+        s = pool.alloc(n_tokens=9)
+        pool.advance([s]); pool.advance([s])
+        fl = telemetry.get_flight()
+        entry = fl.incident("watchdog", extra={"why": "test"})
+        dump = fl.load_dump(entry["path"])
+        pages = dump["pages"]["inc-pool"]
+        assert pages["pages_active"] == 3
+        assert pages["internal_fragmentation"] == \
+            pytest.approx(1 - 2 / 12, abs=1e-3)
+        # metrics mirrors are live too
+        sam = telemetry.get_registry().snapshot()
+        active = sam["hetu_serving_pages_active"]["samples"]
+        assert any(s["labels"].get("pool") == "inc-pool"
+                   and s["value"] == 3 for s in active)
+        pool.close()
+        # a closed pool leaves incident dumps: no dangling provider
+        entry2 = fl.incident("watchdog")
+        dump2 = fl.load_dump(entry2["path"])
+        assert (dump2["pages"] is None
+                or "inc-pool" not in dump2["pages"])
+    finally:
+        telemetry.disable()
+        telemetry.get_flight().clear()
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

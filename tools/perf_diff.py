@@ -6,15 +6,19 @@ or any JSON carrying a ``signals`` key) against a BASELINE — an explicit
 ``--baseline`` file, or an entry of ``benchmarks/history.jsonl`` — with
 direction-aware per-signal tolerances:
 
-* throughput signals (``*.mfu``, ``*_per_sec*``): higher is better;
-  a regression is current < baseline * (1 - tol_throughput).  Wall-time
-  signals are noisy (CPU-quick rounds especially), so the default
-  tolerance is loose (25%).
+* throughput signals (``*.mfu``, ``*_per_sec*``, ``*_per_s``, and the
+  serving ``*concurrency`` peaks from ``bench.py --serve``): higher is
+  better; a regression is current < baseline * (1 - tol_throughput).
+  Wall-time signals are noisy (CPU-quick rounds especially), so the
+  default tolerance is loose (25%).  Concurrency is integral and
+  one-sided the same way — a paged engine admitting fewer concurrent
+  requests at the same HBM budget is a capacity regression.
 * static signals (``*.flops_per_step``, ``*.bytes_per_step``,
-  ``hbm.*_bytes``): lower is better and deterministic for one code
-  version + shape set, so the default tolerance is tight (1%) — a
-  compiled program quietly growing flops/bytes or a pool growing live
-  HBM is exactly what this gate exists to catch.
+  ``hbm.*_bytes``, ``kv_hbm_bytes_per_token``): lower is better and
+  deterministic for one code version + shape set, so the default
+  tolerance is tight (1%) — a compiled program quietly growing
+  flops/bytes, a pool growing live HBM, or the paged KV cache spending
+  more bytes per live token is exactly what this gate exists to catch.
 * attainment signals (``*attainment*``, from ``bench.py --slo``):
   higher is better and ONE-SIDED in absolute points on a [0, 1] scale —
   a regression is current < baseline - tol_attainment (default 0.05 =
@@ -33,6 +37,8 @@ Typical use::
     python bench.py --profile --quick
     python tools/perf_diff.py                       # vs BASELINE.json
     python tools/perf_diff.py --history-index -2    # vs previous round
+    python bench.py --serve --quick                 # paged-vs-slot twin
+    python tools/perf_diff.py --current SERVE_FULL.json
 """
 
 from __future__ import annotations
@@ -44,16 +50,21 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: signal-name fragments that mark a higher-is-better (throughput) signal
-THROUGHPUT_MARKERS = (".mfu", "_per_sec")
+#: signal-name fragments that mark a higher-is-better (throughput)
+#: signal; ``_per_s`` is matched as a SUFFIX only (it is a substring of
+#: the static ``*_per_step`` cost signals)
+THROUGHPUT_MARKERS = (".mfu", "_per_sec", "concurrency")
+THROUGHPUT_SUFFIXES = ("_per_s",)
 #: higher-is-better one-sided signals compared in absolute points
 ATTAINMENT_MARKERS = ("attainment",)
 #: context-only signals that never gate.  Numerics signals (per-layer
 #: grad/update-norm drift, anomaly counts from the NumericsMonitor) are
 #: model-health evidence, not performance — history rounds carry them
-#: for trend reading without ever destabilizing the gate.
+#: for trend reading without ever destabilizing the gate.  TPOT
+#: percentiles are wall-clock latency on shared CPUs — trend context
+#: for the chunked-prefill claim, too noisy to gate.
 INFO_MARKERS = ("shed_fraction", "numerics", "grad_norm", "update_norm",
-                "update_ratio", "anomal")
+                "update_ratio", "anomal", "tpot")
 
 
 def classify(name):
@@ -64,8 +75,10 @@ def classify(name):
         return "attainment"
     if any(m in name for m in INFO_MARKERS):
         return "info"
-    return ("throughput"
-            if any(m in name for m in THROUGHPUT_MARKERS) else "static")
+    if (any(m in name for m in THROUGHPUT_MARKERS)
+            or name.endswith(THROUGHPUT_SUFFIXES)):
+        return "throughput"
+    return "static"
 
 
 def extract_signals(doc):
