@@ -676,8 +676,11 @@ class SubExecutor:
         device-resident step counter keeps per-step RNG identical to n
         ``run()`` calls; checkpoint state advances the same way.
         Requires pure device-side feeds (no PS embeddings / dataloader
-        placeholders — those interact with the host every step) and an
-        unsharded executor."""
+        placeholders — those interact with the host every step).
+        Sharded executors work: the fori_loop program carries the same
+        param/opt-state/feed shardings as the single-step program, so
+        GSPMD re-inserts the identical collectives inside the loop
+        body."""
         if n < 1:
             raise ValueError(f"run_steps needs n >= 1, got {n}")
         if self._jitted is None:
@@ -688,9 +691,6 @@ class SubExecutor:
         if any(hasattr(p, "auto_feed") for p in self.placeholders):
             raise ValueError("run_steps: dataloader placeholders pull a "
                              "new batch per step; use run()")
-        if self.executor._input_shardings(self) is not None:
-            raise ValueError("run_steps is not supported on sharded "
-                             "executors yet; use run()")
         ex = self.executor
         feeds = None
         if self._fast_feed is not None and not self._fast_feed[1]:
@@ -782,7 +782,22 @@ class SubExecutor:
                     vals[stats_idx] = nrow
                 return vals, params, opt_state, step, trips, nf
 
-            self._multi_jitted = jax.jit(multi_fn, donate_argnums=donate)
+            in_sh = ex._input_shardings(self)
+            if in_sh is not None:
+                # mirror _build: pin the carried params/opt-state to
+                # their INPUT shardings so iteration i+1 of the loop —
+                # and the next run_steps call — sees the layout its
+                # executable expects; n_steps rides replicated
+                from ..parallel.mesh import replicated
+                rep = replicated(ex.mesh)
+                param_sh, opt_sh = in_sh[0], in_sh[1]
+                self._multi_jitted = jax.jit(
+                    multi_fn, donate_argnums=donate,
+                    in_shardings=in_sh + (rep,),
+                    out_shardings=(rep, param_sh, opt_sh, rep, rep, rep))
+            else:
+                self._multi_jitted = jax.jit(multi_fn,
+                                             donate_argnums=donate)
         if ex._step_arr is None:
             ex._step_arr = jnp.uint32(ex._global_step)
         ex._global_step += n

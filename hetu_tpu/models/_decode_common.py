@@ -128,6 +128,22 @@ def make_slot_picker():
     return pick
 
 
+def make_gather(mesh):
+    """The tensor-parallel replicate-back hook for ``make_block``'s
+    ``gather=``: constrain an activation to fully-replicated on
+    ``mesh`` so GSPMD inserts an all-gather (byte movement — exact)
+    instead of a psum of partial dot products (reduction reordering —
+    would break the sharded engine's bitwise-parity oracle).  Works
+    under ``jax.vmap``: the batched dim joins the spec as replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def gather(x):
+        return jax.lax.with_sharding_constraint(x, rep)
+
+    return gather
+
+
 def make_attend(head_dim, n_rep=1):
     """Masked cache attention: q [B, H, Sq, D] against cached keys/vals
     [B, KV, T, D] (kv heads broadcast n_rep-fold for GQA), with an

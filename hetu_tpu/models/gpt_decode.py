@@ -42,13 +42,16 @@ def make_layer_params(config, name):
     return layer_params
 
 
-def make_block(config):
+def make_block(config, gather=None):
     """One GPT decoder layer over an explicit K/V cache; same signature
     family as llama_decode.make_block minus rotary (GPT positions are a
-    learned table added at embedding time)."""
+    learned table added at embedding time).  ``gather`` is the
+    tensor-parallel replicate-back hook (see llama_decode.make_block);
+    identity when not sharded."""
     c = config
     hd = c.hidden_size // c.num_heads
     attend = make_attend(hd)
+    g = gather if gather is not None else (lambda x: x)
 
     def block(lp, x, ck, cv, pos_mask, write_at):
         b, sq, _ = x.shape
@@ -60,11 +63,11 @@ def make_block(config):
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k, write_at, axis=2)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v, write_at, axis=2)
         o = attend(q, ck, cv, pos_mask)
-        o = o.transpose(0, 2, 1, 3).reshape(b, sq, c.hidden_size)
-        x = x + o @ lp["wo"] + lp["bo"]
+        o = g(o.transpose(0, 2, 1, 3).reshape(b, sq, c.hidden_size))
+        x = g(x + o @ lp["wo"] + lp["bo"])
         f = _ln(x, lp["ln2_g"], lp["ln2_b"])
         f = jax.nn.gelu(f @ lp["w1"] + lp["b1"])   # approximate, as gelu_op
-        return x + f @ lp["w2"] + lp["b2"], ck, cv
+        return g(x + g(f) @ lp["w2"] + lp["b2"]), ck, cv
 
     return block
 

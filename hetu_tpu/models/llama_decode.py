@@ -75,14 +75,23 @@ def make_layer_params(config, name, moe_names=None):
     return layer_params
 
 
-def make_block(config):
+def make_block(config, gather=None):
     """One Llama decoder layer over an explicit K/V cache; returns
     ``block(lp, x [B, Sq, H], cache_k, cache_v [B, KV, T, D], cos, sin,
     pos_mask, write_at) -> (x', cache_k', cache_v')``.  Used by both the
-    one-shot greedy decoder and the slot-batched serving engine."""
+    one-shot greedy decoder and the slot-batched serving engine.
+
+    ``gather`` (tensor-parallel serving, serving/sharding.py): a hook
+    constraining an activation back to replicated, applied before each
+    op that reduces over a sharded axis — the attention output before
+    ``wo``, the MLP activation before ``down``, and both residual sums
+    (the next norm reduces over hidden).  All-gathers move bytes
+    exactly, so the sharded block stays a bitwise twin of the
+    unsharded one.  Identity (free) when not tensor-parallel."""
     c = config
     hd = c.hidden_size // c.num_heads
     attend = make_attend(hd, c.num_heads // c.num_kv_heads)
+    g = gather if gather is not None else (lambda x: x)
 
     def moe_ffn(lp, f):
         """Dense-combine top-k MoE for decode: every expert computes, the
@@ -115,13 +124,13 @@ def make_block(config):
         cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, write_at,
                                                       axis=2)
         o = attend(q, cache_k, cache_v, pos_mask)
-        o = o.transpose(0, 2, 1, 3).reshape(b, sq, c.hidden_size)
-        x = x + o @ lp["wo"]
+        o = g(o.transpose(0, 2, 1, 3).reshape(b, sq, c.hidden_size))
+        x = g(x + o @ lp["wo"])
         f = _rms(x, lp["post_norm"], c.rms_eps)
         if c.num_experts:
-            return x + moe_ffn(lp, f), cache_k, cache_v
-        return (x + (jax.nn.silu(f @ lp["gate"]) * (f @ lp["up"]))
-                @ lp["down"], cache_k, cache_v)
+            return g(x + moe_ffn(lp, f)), cache_k, cache_v
+        return (g(x + g(jax.nn.silu(f @ lp["gate"]) * (f @ lp["up"]))
+                @ lp["down"]), cache_k, cache_v)
 
     return block
 

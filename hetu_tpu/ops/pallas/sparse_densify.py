@@ -33,9 +33,13 @@ Unique pack ids make the write-only kernel race-free (no two in-flight
 DMAs share a target line); invalid lanes (padding / merged duplicates)
 are skipped under ``pl.when``.
 
-Callers inside GSPMD-sharded programs must pass ``use_pallas=False`` —
-pallas_call does not partition; the jnp fallback is numerically
-identical.
+pallas_call does not partition under GSPMD, so callers inside a
+sharded program have two options: pass ``use_pallas=False`` (the jnp
+fallback is numerically identical), or call
+:func:`sharded_packed_lookup`, which wraps the lookup in the
+``platform.shard_map`` shim — the id batch splits over a mesh axis,
+the packed table rides replicated into every shard, and each device
+runs the SAME kernel on its local slice.
 """
 
 from __future__ import annotations
@@ -211,6 +215,39 @@ def _packed_lookup_bwd(dim, use_pallas, res, g):
 
 
 packed_lookup.defvjp(_packed_lookup_fwd, _packed_lookup_bwd)
+
+
+def sharded_packed_lookup(mesh, table, ids, dim, axis="model",
+                          use_pallas=True):
+    """:func:`packed_lookup` inside a GSPMD mesh program.
+
+    ``pallas_call`` does not partition, so the lookup runs under the
+    platform ``shard_map`` shim: the packed ``[p_rows, 128]`` table is
+    replicated into every shard, the id batch's LEADING dim splits over
+    mesh axis ``axis`` (it must divide the axis size), and each device
+    runs the identical kernel — or the bitwise-equal jnp fallback off
+    TPU — on its local slice.  Returns ``[..., dim]`` rows sharded the
+    same way as ``ids``.  This is the inference/scoring path (the
+    embedding server's lookups); training gradients keep flowing
+    through the unsharded ``packed_lookup`` vjp."""
+    from jax.sharding import PartitionSpec as P
+    from ...platform import shard_map
+
+    n_shards = int(mesh.shape[axis])
+    if ids.shape[0] % n_shards:
+        raise ValueError(
+            f"ids leading dim {ids.shape[0]} must divide mesh axis "
+            f"{axis!r} (size {n_shards})")
+
+    def local(tbl, local_ids):
+        return packed_lookup(tbl, local_ids, dim, use_pallas)
+
+    spec = P(axis) if ids.ndim == 1 else P(*((axis,) + (None,) *
+                                             (ids.ndim - 1)))
+    out_spec = P(*(tuple(spec) + (None,)))
+    f = shard_map(local, mesh=mesh, in_specs=(P(), spec),
+                  out_specs=out_spec)
+    return f(table, ids)
 
 
 def pack_table(table, dim=None):

@@ -11,6 +11,13 @@ only as silent divergence.  These utilities make that failure loud:
   * `equal_across(x, axis)` — in-program (shard_map): max deviation of x
     from the mesh-axis mean; jit-friendly, psum-based, usable as an
     assertion signal every N steps.
+  * `sharding_spec(arr)` / `placement_summary(arr)` /
+    `visualize_sharding(arr)` — placement introspection the sharded-
+    serving tests assert against.  The pinned jax (0.4.37) only renders
+    `jax.debug.visualize_array_sharding` when the optional `rich`
+    dependency is installed, so `visualize_sharding` falls back to a
+    plain-text rendering built from ``addressable_shards`` — same
+    information, no new dependency.
 """
 
 from __future__ import annotations
@@ -67,6 +74,57 @@ def equal_across(x, axis_name):
     n = lax.psum(jnp.ones((), jnp.float32), axis_name)
     mean = lax.psum(xf, axis_name) / n
     return lax.pmax(jnp.max(jnp.abs(xf - mean)), axis_name)
+
+
+def sharding_spec(arr):
+    """The PartitionSpec of a jax.Array as a plain tuple (None entries
+    = replicated dims), or None when the array carries no named
+    sharding — a stable assertion surface across jax versions (the
+    Sharding object reprs drift; the spec tuple does not)."""
+    spec = getattr(getattr(arr, "sharding", None), "spec", None)
+    return None if spec is None else tuple(spec)
+
+
+def placement_summary(arr):
+    """``{device_id: shard_shape}`` for a jax.Array — what actually
+    lives where.  A replicated array maps every device to the full
+    shape; a dim-d sharded array shows shape[d] / axis_size per
+    device.  This is the machine-checkable sibling of
+    :func:`visualize_sharding` (which is for humans)."""
+    arr = jax.device_put(arr) if not hasattr(arr, "addressable_shards") \
+        else arr
+    return {int(s.device.id): tuple(s.data.shape)
+            for s in arr.addressable_shards}
+
+
+def _fmt_slice(sl, dim):
+    start = 0 if sl.start is None else int(sl.start)
+    stop = dim if sl.stop is None else int(sl.stop)
+    return ":" if (start, stop) == (0, dim) else f"{start}:{stop}"
+
+
+def visualize_sharding(arr, prefer_rich=True):
+    """Render an array's device placement as text.
+
+    Uses ``jax.debug.visualize_array_sharding`` when it can actually
+    run (it imports ``rich`` lazily on the pinned jax and raises
+    without it, and it only handles rank <= 2); every other case falls
+    back to one ``devN: [slices]`` line per shard built from
+    ``addressable_shards``.  Always RETURNS the fallback text so tests
+    and logs can assert on it regardless of which path printed."""
+    arr = jax.device_put(arr) if not hasattr(arr, "addressable_shards") \
+        else arr
+    if prefer_rich and arr.ndim in (1, 2):
+        try:
+            jax.debug.visualize_array_sharding(arr)
+        except Exception:
+            prefer_rich = False   # no rich / unsupported layout: text only
+    lines = []
+    for s in sorted(arr.addressable_shards, key=lambda s: s.device.id):
+        idx = ", ".join(_fmt_slice(sl, dim)
+                        for sl, dim in zip(s.index, arr.shape))
+        lines.append(f"dev{int(s.device.id)}: [{idx}]")
+    return "\n".join(lines)
 
 
 def fingerprint(tree):

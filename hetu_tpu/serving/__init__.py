@@ -19,6 +19,15 @@ faults and proves one engine survives them; ``bench.py --chaos --serve
 --fleet`` kills, wedges, and rolls whole replicas and proves the fleet
 loses nothing.
 
+Engines scale past one chip with TENSOR-PARALLEL serving (sharding.py,
+docs/SHARDING.md): ``InferenceEngine(..., paged=True, mesh=
+serving_mesh(tp))`` shards block weights on their output dims and the
+KV page pool over kv_heads, with activations gathered back to
+replicated before every cross-shard reduction — so the sharded engine
+is a token-stream-bitwise twin of the single-chip one, and
+``EngineFleet(tp_size=N)`` pins one replica per contiguous N-device
+sub-mesh with failover replay landing bit-exactly on a sharded sibling.
+
 A second production workload rides the same lifecycle: the embedding
 subpackage (embedding/) serves batched sparse-feature lookups + CTR
 scoring through the identical Scheduler — a HET-style device hot-row
@@ -40,6 +49,9 @@ from .scheduler import (EngineOverloaded, Request, Scheduler,
                         FINISH_REASONS, SHED_POLICIES, TERMINAL_OK)
 from .adapters import (LlamaSlotAdapter, GPTSlotAdapter, adapter_for)
 from .engine import InferenceEngine
+from .sharding import (KV_POOL_SPEC, kv_sharding, param_pspecs,
+                       param_shardings, per_chip_bytes, serving_mesh,
+                       shard_params, validate_tp)
 from .health import (CircuitBreaker, ReplicaHealth, HEALTH_STATES,
                      HEALTH_STATE_CODES)
 from .fleet import EngineFleet, FleetRequest, FleetUnavailable
@@ -57,4 +69,7 @@ __all__ = ["PagedKVCache", "SlotKVCache", "Request", "Scheduler",
            "FleetRequest", "FleetUnavailable", "CostModel",
            "DEGRADE_LEVELS", "FleetController", "SLO", "SLOReject",
            "BatchSlotPool", "DeviceHotRowCache", "EmbedRequest",
-           "EmbeddingServer", "EMBED_BUCKETS"]
+           "EmbeddingServer", "EMBED_BUCKETS", "KV_POOL_SPEC",
+           "kv_sharding", "param_pspecs", "param_shardings",
+           "per_chip_bytes", "serving_mesh", "shard_params",
+           "validate_tp"]

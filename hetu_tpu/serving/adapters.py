@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from ..ops.rotary import _rope_tables
 from ..models import llama_decode as _ld
 from ..models import gpt_decode as _gd
+from ..models._decode_common import make_gather
 
 
 def _causal(p_len):
@@ -50,24 +51,26 @@ def _causal(p_len):
 class LlamaSlotAdapter:
     """Rotary/GQA (Llama-family, incl. sparse-MoE) slot-batched decode."""
 
-    def __init__(self, config, name, moe_names=None):
+    def __init__(self, config, name, moe_names=None, mesh=None):
         c = config
         self.config = c
         self.name = name
+        self.mesh = mesh
         self.layers = c.num_layers
         self.kv_heads = c.num_kv_heads
         self.head_dim = c.hidden_size // c.num_heads
         self.position_cap = None          # rotary: no learned-table limit
         self.embed_param = f"{name}_embed_table"
+        gather = make_gather(mesh) if mesh is not None else None
         self._layer_params = _ld.make_layer_params(c, name, moe_names)
-        self._block = _ld.make_block(c)
+        self._block = _ld.make_block(c, gather=gather)
         self._logits = _ld.make_logits(c, name)
         self._chunk_inputs = _ld.make_chunk_embed(c, name)
 
     @classmethod
-    def for_model(cls, model, name):
+    def for_model(cls, model, name, mesh=None):
         return cls(model.config, name,
-                   moe_names=_ld.moe_param_names(model))
+                   moe_names=_ld.moe_param_names(model), mesh=mesh)
 
     def decode(self, params, tokens, positions, k, v):
         c, hd = self.config, self.head_dim
@@ -137,23 +140,25 @@ class GPTSlotAdapter:
     caps total sequence length at ``config.seq_len`` — the engine
     enforces ``max_len <= seq_len`` via ``position_cap``."""
 
-    def __init__(self, config, name):
+    def __init__(self, config, name, mesh=None):
         c = config
         self.config = c
         self.name = name
+        self.mesh = mesh
         self.layers = c.num_layers
         self.kv_heads = c.num_heads       # no GQA in the GPT tier
         self.head_dim = c.hidden_size // c.num_heads
         self.position_cap = c.seq_len
         self.embed_param = f"{name}_wte_table"
+        gather = make_gather(mesh) if mesh is not None else None
         self._layer_params = _gd.make_layer_params(c, name)
-        self._block = _gd.make_block(c)
+        self._block = _gd.make_block(c, gather=gather)
         self._logits = _gd.make_logits(c, name)
         self._chunk_inputs = _gd.make_chunk_embed(c, name)
 
     @classmethod
-    def for_model(cls, model, name):
-        return cls(model.config, name)
+    def for_model(cls, model, name, mesh=None):
+        return cls(model.config, name, mesh=mesh)
 
     def decode(self, params, tokens, positions, k, v):
         emb = params[self.embed_param]
@@ -209,14 +214,16 @@ class GPTSlotAdapter:
         return logits, jnp.stack(ks, 1), jnp.stack(vs, 1)
 
 
-def adapter_for(model, name):
+def adapter_for(model, name, mesh=None):
     """Pick the slot adapter matching a model instance by its config
-    family (rotary Llama-likes vs learned-position GPTs)."""
+    family (rotary Llama-likes vs learned-position GPTs).  ``mesh``
+    (tensor-parallel serving) threads the replicate-back hook into the
+    block math — see serving/sharding.py."""
     c = model.config
     if hasattr(c, "rope_theta"):
-        return LlamaSlotAdapter.for_model(model, name)
+        return LlamaSlotAdapter.for_model(model, name, mesh=mesh)
     if hasattr(c, "seq_len") and hasattr(c, "num_layers"):
-        return GPTSlotAdapter.for_model(model, name)
+        return GPTSlotAdapter.for_model(model, name, mesh=mesh)
     raise TypeError(
         f"no slot adapter for {type(model).__name__} "
         f"(config {type(c).__name__}) — serving supports the Llama and "

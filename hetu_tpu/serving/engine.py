@@ -111,6 +111,7 @@ import jax.numpy as jnp
 from .. import telemetry as _telemetry
 from ..models._decode_common import (make_picker, make_slot_picker,
                                      param_prefix, pad_prompts)
+from . import sharding as _shd
 from .adapters import adapter_for
 from .kv_cache import (PagedKVCache, SlotKVCache, ceil_div, gather_pages,
                        scatter_rows)
@@ -140,10 +141,28 @@ class InferenceEngine:
                  shed_policy="reject_newest", watchdog=True,
                  stream_stall_timeout=None, clock=None, instance=None,
                  latency_buckets=None, device=None, paged=False,
-                 page_len=16, n_pages=None, prefill_token_budget=None):
+                 page_len=16, n_pages=None, prefill_token_budget=None,
+                 mesh=None):
         self.params = executor.params
         self.instance = None if instance is None else str(instance)
         self.device = device
+        self.mesh = mesh
+        self._tp = 1
+        if mesh is not None:
+            # tensor-parallel serving (serving/sharding.py): this engine
+            # spans every device of a (replica=1, model=tp) mesh; GSPMD
+            # inserts the collectives from the shardings threaded through
+            # the paged program pair below
+            if not paged:
+                raise ValueError(
+                    "mesh= (tensor-parallel serving) requires paged=True "
+                    "— the sharded executables are the paged pair")
+            if device is not None:
+                raise ValueError(
+                    "pass device= (single-chip pinning) or mesh= "
+                    "(tensor-parallel), not both")
+            self._tp = _shd.mesh_axis_size(mesh)
+        self._rep = None if mesh is None else _shd.replicated(mesh)
         if device is not None:
             # fleet replica pinning: park THIS engine's params + cache on
             # one device so N replicas split the chips instead of
@@ -152,7 +171,13 @@ class InferenceEngine:
         name = name or param_prefix(
             executor, "_embed_table"
             if hasattr(model.config, "rope_theta") else "_wte_table")
-        self.adapter = adapter_for(model, name)
+        self.adapter = adapter_for(model, name, mesh=mesh)
+        if mesh is not None:
+            _shd.validate_tp(self.adapter, self._tp)
+            # every mesh engine owns a mesh-placed copy of the params —
+            # fleet replicas on disjoint sub-meshes must not share one
+            self.params = _shd.shard_params(mesh, self.adapter,
+                                            self.params)
         cap = self.adapter.position_cap
         if cap is not None and max_len > cap:
             raise ValueError(
@@ -167,11 +192,14 @@ class InferenceEngine:
         emb = self.params[self.adapter.embed_param]
         self._paged = bool(paged)
         if self._paged:
+            meshkw = ({} if mesh is None else
+                      dict(shards=self._tp,
+                           put_sharding=_shd.replicated(mesh)))
             self.cache = PagedKVCache(
                 n_slots, self.adapter.layers, self.adapter.kv_heads,
                 page_len, self.adapter.head_dim, max_len=self.max_len,
                 n_pages=n_pages, dtype=emb.dtype,
-                label=self.instance or f"{name}:{id(self):x}")
+                label=self.instance or f"{name}:{id(self):x}", **meshkw)
         else:
             self.cache = SlotKVCache(
                 n_slots, self.adapter.layers, self.adapter.kv_heads,
@@ -179,6 +207,13 @@ class InferenceEngine:
         if device is not None:
             self.cache.k = jax.device_put(self.cache.k, device)
             self.cache.v = jax.device_put(self.cache.v, device)
+        elif mesh is not None:
+            # the page pool splits kv_heads / tp per chip — the dominant
+            # serving HBM saving the mesh buys (pages/slots replicated
+            # host-side, so the allocator and block tables are untouched)
+            kvsh = _shd.kv_sharding(mesh)
+            self.cache.k = jax.device_put(self.cache.k, kvsh)
+            self.cache.v = jax.device_put(self.cache.v, kvsh)
         if prefill_token_budget is not None:
             prefill_token_budget = int(prefill_token_budget)
             if prefill_token_budget < 1:
@@ -298,6 +333,24 @@ class InferenceEngine:
                           **hkw)
         self._m_qwait = _m("histogram", "hetu_serving_queue_wait_seconds",
                            "Arrival -> slot admission wait", **hkw)
+        if mesh is not None:
+            minst = self.instance or name
+            reg.gauge(
+                "hetu_mesh_tp_size",
+                "Model-axis (tensor-parallel) degree of the engine's "
+                "serving mesh",
+                labels=("engine",)).labels(engine=minst).set(self._tp)
+            reg.gauge(
+                "hetu_mesh_kv_per_chip_bytes",
+                "Bytes of the sharded KV page pool resident per chip",
+                labels=("engine",)).labels(engine=minst).set(
+                _shd.per_chip_bytes((self.cache.k, self.cache.v)))
+            reg.gauge(
+                "hetu_mesh_param_per_chip_bytes",
+                "Bytes of the engine's (partially sharded) params "
+                "resident per chip",
+                labels=("engine",)).labels(engine=minst).set(
+                _shd.per_chip_bytes(self.params))
         self._tr = _telemetry.get_tracer()
         self._rt = _telemetry.get_request_trace()
         self._fl = _telemetry.get_flight()
@@ -332,6 +385,12 @@ class InferenceEngine:
             sampling = ("operands",)
             geometry = ("paged", self.cache.page_len, self.cache.n_pages,
                         self.cache.max_pages)
+            if self.mesh is not None:
+                # a mesh engine's executables bake device assignments in
+                # via in_shardings; fleet sub-meshes on different device
+                # groups (and the single-device twin) must not collide
+                geometry = geometry + (
+                    ("tp", self._tp) + _shd.device_ids(self.mesh),)
         else:
             sampling = self._sampling
             geometry = ("slot",)
@@ -488,8 +547,25 @@ class InferenceEngine:
                 return k, v, jnp.where(active, nxt, 0), slot_ok
 
             donate = () if jax.default_backend() == "cpu" else (1, 2)
-            entry = {"prefill": jax.jit(prefill, donate_argnums=donate),
-                     "step": jax.jit(step, donate_argnums=donate),
+            pjkw, sjkw = {}, {}
+            if self.mesh is not None:
+                # thread NamedShardings through both programs: params by
+                # their layout map, the page pool on kv_heads, every
+                # host-built operand (and every token/sentinel output)
+                # replicated — XLA inserts the all-gathers at the
+                # gather= hook points in the block math
+                psh = _shd.param_shardings(self.mesh, adapter,
+                                           self.params)
+                kvsh = _shd.kv_sharding(self.mesh)
+                rep = _shd.replicated(self.mesh)
+                pjkw = dict(in_shardings=(psh, kvsh, kvsh) + (rep,) * 8,
+                            out_shardings=(kvsh, kvsh, rep, rep))
+                sjkw = dict(in_shardings=(psh, kvsh, kvsh) + (rep,) * 7,
+                            out_shardings=(kvsh, kvsh, rep, rep))
+            entry = {"prefill": jax.jit(prefill, donate_argnums=donate,
+                                        **pjkw),
+                     "step": jax.jit(step, donate_argnums=donate,
+                                     **sjkw),
                      "traces": traces}
             self._PROGRAMS[self._program_key()] = entry
         self._prefill_fn = entry["prefill"]
@@ -502,6 +578,14 @@ class InferenceEngine:
         traced; 1 after warmup means every engine with this signature
         runs the same executable at the same shapes."""
         return dict(self._traces)
+
+    def _dev_put(self, host_array):
+        """Upload a host-built operand.  Mesh engines place it
+        replicated over their devices ONCE, so the cached copies below
+        aren't resharded by every jit dispatch."""
+        if self.mesh is not None:
+            return jax.device_put(host_array, self._rep)
+        return jnp.asarray(host_array)
 
     # AOT (prefill, decode) executables keyed by cost_signature():
     # engines sharing a signature share exact shapes, so the compiled
@@ -939,10 +1023,10 @@ class InferenceEngine:
             with self._tr.span("serve_prefill"):
                 k, v, toks, oks = self._prefill_fn(
                     self.params, self.cache.k, self.cache.v,
-                    jnp.asarray(prompts), jnp.asarray(p_lens),
-                    jnp.asarray(starts), jnp.asarray(chunk_lens),
-                    jnp.asarray(tables), jnp.asarray(temps),
-                    jnp.asarray(topks), jnp.asarray(seeds))
+                    self._dev_put(prompts), self._dev_put(p_lens),
+                    self._dev_put(starts), self._dev_put(chunk_lens),
+                    self._dev_put(tables), self._dev_put(temps),
+                    self._dev_put(topks), self._dev_put(seeds))
                 self.cache.update(k, v)
                 toks = np.asarray(toks)
                 oks = np.asarray(oks)
@@ -1116,7 +1200,7 @@ class InferenceEngine:
             # the device copy across the (long) decode runs in between
             akey = active.tobytes()
             if self._dev_active[0] != akey:
-                self._dev_active = (akey, jnp.asarray(active))
+                self._dev_active = (akey, self._dev_put(active))
             dev_active = self._dev_active[1]
             occ = len(slots) / self.cache.n_slots
             self.occupancy.append(occ)
@@ -1132,13 +1216,13 @@ class InferenceEngine:
                     if self._paged:
                         if self._dev_sampling is None:
                             self._dev_sampling = (
-                                jnp.asarray(self._temps.copy()),
-                                jnp.asarray(self._topks.copy()),
-                                jnp.asarray(self._seeds.copy()))
+                                self._dev_put(self._temps.copy()),
+                                self._dev_put(self._topks.copy()),
+                                self._dev_put(self._seeds.copy()))
                         temps, topks, seeds = self._dev_sampling
                         k, v, nxt, slot_ok = self._step_fn(
                             self.params, self.cache.k, self.cache.v,
-                            jnp.asarray(self._last_tokens.copy()),
+                            self._dev_put(self._last_tokens.copy()),
                             self.cache.device_positions(),
                             self.cache.device_block_tables(),
                             dev_active, temps, topks, seeds)
@@ -1303,4 +1387,11 @@ class InferenceEngine:
                 "trace_counts": self.trace_counts}
         if self._paged:
             out["pages"] = self.cache.occupancy()
+        if self.mesh is not None:
+            out["mesh"] = {
+                "tp": self._tp,
+                "devices": list(_shd.device_ids(self.mesh)),
+                "kv_per_chip_bytes": _shd.per_chip_bytes(
+                    (self.cache.k, self.cache.v)),
+                "param_per_chip_bytes": _shd.per_chip_bytes(self.params)}
         return out

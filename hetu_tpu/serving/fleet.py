@@ -211,7 +211,7 @@ class EngineFleet:
                  supervise_interval=0.02,
                  idle_sleep=0.001, auto_restart=True, ewma_alpha=0.3,
                  latency_buckets=None, engine_factory=None,
-                 replica_prefix="e"):
+                 replica_prefix="e", tp_size=1):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
         self._executor = executor
@@ -242,10 +242,36 @@ class EngineFleet:
         self.ewma_alpha = float(ewma_alpha)
         self._latency_buckets = latency_buckets
         # one replica per device when the mesh offers several (ROADMAP
-        # direction 1's scale-out shape); on one device they time-share
+        # direction 1's scale-out shape); on one device they time-share.
+        # tp_size > 1 upgrades the unit of pinning from one device to a
+        # contiguous group of tp_size devices: each replica becomes a
+        # tensor-parallel engine on its own (replica=1, model=tp_size)
+        # sub-mesh, and failover re-homes onto a sharded sibling
         import jax
         devs = jax.devices()
-        self._devices = devs if len(devs) > 1 else [None] * n_engines
+        self.tp_size = int(tp_size)
+        if self.tp_size < 1:
+            raise ValueError(f"tp_size must be >= 1, got {tp_size}")
+        if self.tp_size > 1:
+            if not self._ekw.get("paged"):
+                raise ValueError(
+                    "tp_size > 1 requires paged=True engine_kwargs — "
+                    "the sharded executables are the paged pair")
+            if len(devs) < self.tp_size:
+                raise ValueError(
+                    f"tp_size={self.tp_size} needs that many devices, "
+                    f"have {len(devs)}")
+            from . import sharding as _shd
+            n_groups = len(devs) // self.tp_size
+            self._meshes = [
+                _shd.serving_mesh(
+                    self.tp_size,
+                    devices=devs[g * self.tp_size:(g + 1) * self.tp_size])
+                for g in range(n_groups)]
+            self._devices = [None]
+        else:
+            self._meshes = None
+            self._devices = devs if len(devs) > 1 else [None] * n_engines
         self._requests = {}        # rid -> FleetRequest (accepted ever)
         self._flock = threading.Lock()
         self._failover = deque()   # (FleetRequest, tokens) to re-home
@@ -304,13 +330,20 @@ class EngineFleet:
         return base if incarnation == 0 else f"{base}.{incarnation}"
 
     def _build_engine(self, index, incarnation):
+        if self._meshes is not None:
+            # sub-mesh pinning: replicas round-robin the contiguous
+            # device groups (same group across restarts — the rebuilt
+            # engine reuses the incarnation-independent index, so the
+            # compile-once cache keyed on device ids still hits)
+            pin = dict(mesh=self._meshes[index % len(self._meshes)])
+        else:
+            pin = dict(device=self._devices[index % len(self._devices)])
         return self._engine_factory(
             self._executor, self._model,
             instance=self._instance_name(index, incarnation),
             clock=self._clock,
             latency_buckets=self._latency_buckets,
-            device=self._devices[index % len(self._devices)],
-            **self._ekw)
+            **pin, **self._ekw)
 
     def _make_replica(self, index):
         name = f"{self.replica_prefix}{index}"
@@ -1120,6 +1153,7 @@ class EngineFleet:
                     reasons.get(freq.finish_reason, 0) + 1
         return {
             "n_engines": len(self._replicas),
+            "tp_size": self.tp_size,
             "submitted": self.submitted,
             "completed": self.completed,
             "failovers": self.failovers_done,

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 
@@ -199,7 +200,7 @@ class PagedKVCache:
 
     def __init__(self, n_slots, layers, kv_heads, page_len, head_dim,
                  max_len=128, n_pages=None, dtype=jnp.float32,
-                 label=None):
+                 label=None, shards=1, put_sharding=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if page_len < 1:
@@ -221,6 +222,15 @@ class PagedKVCache:
                 f"n_pages must be >= 2 (sentinel + one usable page), "
                 f"got {self.n_pages}")
         self.label = str(label) if label is not None else f"kv:{id(self):x}"
+        # tensor-parallel serving: ``shards`` is the model-axis degree
+        # the pool is split over (kv_heads / shards live per chip), so
+        # the HBM ledger records PER-CHIP bytes — the number headroom
+        # gating compares against one device's capacity.
+        # ``put_sharding`` places host-side step operands (positions,
+        # block tables) once, replicated over the mesh, instead of
+        # letting every jit dispatch reshard a single-device upload.
+        self.shards = max(1, int(shards))
+        self.put_sharding = put_sharding
         shape = (self.n_pages, self.layers, self.kv_heads, self.page_len,
                  self.head_dim)
         self.k = jnp.zeros(shape, dtype)
@@ -254,7 +264,8 @@ class PagedKVCache:
         self.page_free_count = 0
         from .. import telemetry
         self._hbm_handle = telemetry.get_hbm_ledger().alloc(
-            "kv_cache", int(self.k.nbytes) + int(self.v.nbytes),
+            "kv_cache",
+            (int(self.k.nbytes) + int(self.v.nbytes)) // self.shards,
             owner=f"kv_cache:{self.label}")
         reg = telemetry.get_registry()
         self._g_active = reg.gauge(
@@ -423,9 +434,14 @@ class PagedKVCache:
                 "page_churn": self.page_alloc_count + self.page_free_count}
 
     # -- step plumbing -----------------------------------------------------
+    def _put(self, host_array):
+        if self.put_sharding is not None:
+            return jax.device_put(host_array, self.put_sharding)
+        return jnp.asarray(host_array)
+
     def device_positions(self):
         # SNAPSHOT, not view — same aliasing hazard as SlotKVCache
-        return jnp.asarray(self.positions.copy())
+        return self._put(self.positions.copy())
 
     def device_block_tables(self):
         # SNAPSHOT, not view — ``free``/``alloc``/``share_pages``
@@ -435,7 +451,7 @@ class PagedKVCache:
         # events, so steady-state decode reuses one device buffer
         # instead of paying an upload dispatch per step.
         if self._dev_tables is None:
-            self._dev_tables = jnp.asarray(self.block_tables.copy())
+            self._dev_tables = self._put(self.block_tables.copy())
         return self._dev_tables
 
     def advance(self, slots):

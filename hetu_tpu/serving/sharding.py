@@ -1,0 +1,164 @@
+"""Tensor-parallel layouts for the serving engine (ISSUE 14).
+
+One `(replica, model)` logical mesh per engine (``serving_mesh``), with
+every block weight sharded on the **model** axis and the paged KV pool
+sharded over its kv_heads axis.  The layouts are chosen so that the
+sharded engine is a *bitwise* twin of the single-device engine — the
+acceptance oracle for this layer:
+
+* every weight matrix is sharded on an OUTPUT (non-contracting)
+  dimension, so each device computes full-precision dot products over
+  the complete contraction axis — no partial sums, no psum reordering;
+* activations are gathered back to replicated (an all-gather moves
+  bytes, exactly) before any op that reduces over a sharded axis
+  (norms, the second projection of attention/MLP, sampling over
+  logits).  The gather points live in ``models/*_decode.make_block``
+  behind the ``gather=`` hook built by :func:`make_gather`.
+
+This differs deliberately from the classic Megatron row-parallel
+layout in ``parallel/tensor_parallel.py``: row-parallel's
+psum-of-partials changes float reduction order and would break the
+bitwise parity contract, so the second matmul of each pair shards its
+output dim instead and the input is all-gathered.  Attention stays
+genuinely head-parallel (q/k/v projections, rotary, softmax and the
+weighted sum are all per-head local), and the KV page pool — the
+dominant serving HBM consumer — is split ``kv_heads / tp`` per chip.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import make_mesh
+
+# the KV page pool is [n_pages, layers, kv_heads, page_len, head_dim];
+# kv_heads (axis 2) is the model-parallel axis — pages/slots stay
+# replicated host-side so block tables and the allocator never change
+KV_POOL_SPEC = P(None, None, "model", None, None)
+
+
+def serving_mesh(tp, devices=None):
+    """A ``(replica, model)`` mesh over ``tp`` devices.
+
+    ``devices`` selects an explicit sub-mesh (the fleet pins one
+    replica per contiguous device group); default is the first ``tp``
+    of ``jax.devices()``.  The replica axis is always 1 here — fleet
+    replication happens at the EngineFleet layer, not inside one
+    engine's programs."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    devices = list(devices) if devices is not None else jax.devices()[:tp]
+    if len(devices) < tp:
+        raise ValueError(
+            f"tensor-parallel degree {tp} needs {tp} devices, have "
+            f"{len(devices)} (on CPU set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8)")
+    return make_mesh({"replica": 1, "model": tp}, devices=devices[:tp])
+
+
+def mesh_axis_size(mesh, axis="model"):
+    return int(mesh.shape[axis])
+
+
+def validate_tp(adapter, tp):
+    """The head/ffn axes must divide evenly — a ragged shard would need
+    padding inside the executables and break the parity oracle."""
+    c = adapter.config
+    bad = []
+    if c.num_heads % tp:
+        bad.append(f"num_heads={c.num_heads}")
+    if adapter.kv_heads % tp:
+        bad.append(f"kv_heads={adapter.kv_heads}")
+    inter = getattr(c, "intermediate_size", None)
+    if inter and inter % tp:
+        bad.append(f"intermediate_size={inter}")
+    if bad:
+        raise ValueError(
+            f"model axes not divisible by tp={tp}: {', '.join(bad)}")
+
+
+def param_pspecs(adapter, params):
+    """``{param_name: PartitionSpec}`` for every executor param the
+    adapter consumes.  Unknown params (anything outside the decode
+    naming contract, e.g. MoE routers) stay replicated — correctness
+    first, sharding where the layout is pinned."""
+    name = adapter.name
+    layers = adapter.layers
+    col = P(None, "model")          # shard the output dim
+    specs = {k: P() for k in params}
+    # class name check avoids an import cycle with adapters.py
+    kind = type(adapter).__name__
+    for i in range(layers):
+        if kind == "LlamaSlotAdapter":
+            our = f"{name}_layer{i}"
+            for suffix in ("attn_q_weight", "attn_k_weight",
+                           "attn_v_weight", "attn_out_weight",
+                           "mlp_gate_weight", "mlp_up_weight",
+                           "mlp_out_weight"):
+                key = f"{our}_{suffix}"
+                if key in specs:
+                    specs[key] = col
+        else:                       # GPT tier
+            our = f"{name}_h{i}"
+            for suffix in ("attn_q_weight", "attn_k_weight",
+                           "attn_v_weight", "attn_out_weight",
+                           "ffn_in_weight", "ffn_out_weight"):
+                key = f"{our}_{suffix}"
+                if key in specs:
+                    specs[key] = col
+            # a bias rides its matmul's sharded output dim
+            for suffix in ("attn_q_bias", "attn_k_bias", "attn_v_bias",
+                           "attn_out_bias", "ffn_in_bias",
+                           "ffn_out_bias"):
+                key = f"{our}_{suffix}"
+                if key in specs:
+                    specs[key] = P("model")
+    # embeddings / norms / untied lm_head stay replicated: the head
+    # matmul is a tiny fraction of decode FLOPs at serving vocab sizes
+    # and a replicated head keeps sampling local and exact
+    return specs
+
+
+def param_shardings(mesh, adapter, params):
+    """``{param_name: NamedSharding}`` for jit in_shardings."""
+    return {k: NamedSharding(mesh, s)
+            for k, s in param_pspecs(adapter, params).items()}
+
+
+def kv_sharding(mesh):
+    return NamedSharding(mesh, KV_POOL_SPEC)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_params(mesh, adapter, params):
+    """Place a param dict on the mesh per :func:`param_pspecs`."""
+    sh = param_shardings(mesh, adapter, params)
+    return {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+
+
+def per_chip_bytes(tree):
+    """Bytes resident per device for a (possibly sharded) array tree —
+    the number the fleet's HBM headroom gating needs."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            per_dev = {}
+            for s in shards:
+                did = s.device.id
+                per_dev[did] = per_dev.get(did, 0) + int(s.data.nbytes)
+            total += max(per_dev.values())
+        else:
+            total += int(np.asarray(leaf).nbytes)
+    return total
+
+
+def device_ids(mesh):
+    return tuple(int(d.id) for d in mesh.devices.flat)

@@ -1,0 +1,324 @@
+"""Tensor-parallel serving invariants (hetu_tpu/serving/sharding.py +
+the engine's ``mesh=`` path), on the conftest-forced 8-device CPU.
+
+The contracts pinned here:
+* mesh construction: ``serving_mesh(tp)`` is a (replica=1, model=tp)
+  mesh over the first tp devices; ``validate_tp`` rejects head/width
+  geometries the mesh does not divide;
+* SHARDING NEVER CHANGES WHAT IS GENERATED — the mesh engine's token
+  streams are BITWISE identical to the single-device paged twin's, for
+  greedy AND fixed-seed sampled decoding, at TP=2 and TP=4, for both
+  the Llama and GPT tiers.  (Weights shard on output dims and
+  activations gather to replicated before every cross-shard reduction,
+  so no psum ever reorders a float accumulation);
+* placement is what sharding.py promises: block weights carry
+  ``P(None, 'model')``, the KV page pool shards its kv_heads dim, and
+  everything else is replicated — asserted through the
+  ``parallel.debug`` introspection helpers, not Sharding reprs;
+* compile-once holds per mesh: the program key carries the mesh
+  geometry, so a mesh engine and its single-device twin never collide
+  in the shared cache, and replaying a workload retraces nothing;
+* the HBM ledger charges the sharded pool PER CHIP (total // tp) and
+  the engine's mesh gauges agree;
+* ``EngineFleet(tp_size=N)`` pins one replica per contiguous N-device
+  sub-mesh and crash failover replays in-flight streams bit-exactly
+  into a SHARDED sibling;
+* the satellite surfaces ride along: ``run_steps`` under sharded
+  (DP/FSDP) training executors matches single-step loss exactly and
+  preserves param shardings; ``sharded_packed_lookup`` matches the
+  unsharded packed lookup bitwise under the shard_map shim.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+from hetu_tpu import telemetry
+from hetu_tpu.models import (GPTConfig, GPTModel, LlamaConfig,
+                             LlamaForCausalLM, MLP)
+from hetu_tpu.parallel import DataParallel, FSDP
+from hetu_tpu.parallel.debug import (placement_summary, sharding_spec,
+                                     visualize_sharding)
+from hetu_tpu.resilience import faults
+from hetu_tpu.serving import (EngineFleet, InferenceEngine, KV_POOL_SPEC,
+                              serving_mesh, validate_tp)
+from hetu_tpu.serving.sharding import mesh_axis_size, per_chip_bytes
+
+V = 64
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _llama(name, kv_heads=2):
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=kv_heads,
+                    intermediate_size=56, seq_len=16)
+    model = LlamaForCausalLM(c, name=name)
+    ids = ht.placeholder_op(f"{name}_ids", (1, 4), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    return ex, model
+
+
+def _gpt(name):
+    c = GPTConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                  num_heads=4, seq_len=48, dropout_prob=0.0)
+    model = GPTModel(c, name=name)
+    ids = ht.placeholder_op(f"{name}_ids", (1, 4), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    return ex, model
+
+
+def _prompts(rng, n, lo=3, hi=9):
+    return [rng.integers(1, V, (int(L),))
+            for L in rng.integers(lo, hi, n)]
+
+
+_EKW = dict(n_slots=4, max_len=32, max_prompt_len=8, paged=True,
+            page_len=8)
+
+
+# -- mesh construction -------------------------------------------------------
+
+def test_serving_mesh_shape_and_axis():
+    mesh = serving_mesh(2)
+    assert dict(mesh.shape) == {"replica": 1, "model": 2}
+    assert mesh_axis_size(mesh) == 2
+    assert len(mesh.devices.ravel()) == 2
+
+
+def test_validate_tp_rejects_undividable_geometry():
+    ex, model = _llama("shv")    # 4 heads, 2 kv heads, intermediate 56
+    eng = InferenceEngine(ex, model, name="shv", **_EKW)
+    validate_tp(eng.adapter, 2)                     # divides everything
+    with pytest.raises(ValueError, match="kv_heads"):
+        validate_tp(eng.adapter, 4)                 # 2 kv heads % 4 != 0
+
+
+def test_mesh_requires_paged():
+    ex, model = _llama("shp")
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(ex, model, name="shp", n_slots=2, max_len=16,
+                        max_prompt_len=8, mesh=serving_mesh(2))
+
+
+# -- placement ---------------------------------------------------------------
+
+def test_param_and_kv_placement(rng):
+    ex, model = _llama("shl")
+    eng = InferenceEngine(ex, model, name="shl", mesh=serving_mesh(2),
+                          **_EKW)
+    # a block weight shards its output dim over the model axis...
+    w = eng.params["shl_layer0_attn_q_weight"]
+    assert sharding_spec(w) == (None, "model")
+    shapes = placement_summary(w)
+    assert shapes[0] == shapes[1] == (w.shape[0], w.shape[1] // 2)
+    # ...embeddings / norms stay replicated (an empty spec = P())...
+    emb = eng.params["shl_embed_table"]
+    assert sharding_spec(emb) == ()
+    assert placement_summary(emb)[0] == emb.shape
+    # ...and the KV page pool splits its kv_heads dim (dim 2)
+    assert sharding_spec(eng.cache.k) == tuple(KV_POOL_SPEC)
+    kshapes = placement_summary(eng.cache.k)
+    assert kshapes[0][2] == eng.cache.k.shape[2] // 2
+    assert kshapes[0][:2] == eng.cache.k.shape[:2]
+    text = visualize_sharding(w, prefer_rich=False)
+    assert "dev0" in text and "dev1" in text
+
+
+# -- bitwise parity ----------------------------------------------------------
+
+def test_llama_tp2_streams_bitwise_greedy_and_sampled(rng):
+    ex, model = _llama("sh2")
+    prompts = _prompts(rng, 6)
+    base = InferenceEngine(ex, model, name="sh2", **_EKW)
+    tp = InferenceEngine(ex, model, name="sh2", mesh=serving_mesh(2),
+                         **_EKW)
+    for a, b in zip(base.generate_many(prompts, 8),
+                    tp.generate_many(prompts, 8)):
+        np.testing.assert_array_equal(a, b)
+    # sampled at a fixed seed: per-request keys derive from (seed,
+    # consumed count), and sampling runs on the gathered (replicated)
+    # logits — the stream survives sharding bit-exactly too
+    skw = dict(_EKW, temperature=0.9, top_k=8, seed=7)
+    sb = InferenceEngine(ex, model, name="sh2", **skw)
+    st = InferenceEngine(ex, model, name="sh2", mesh=serving_mesh(2),
+                         **skw)
+    for a, b in zip(sb.generate_many(prompts, 8),
+                    st.generate_many(prompts, 8)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_llama_tp4_streams_bitwise(rng):
+    # TP=4 needs 4 KV heads (the pool shards over kv_heads); both twins
+    # share the widened config so the parity stays apples-to-apples
+    ex, model = _llama("sh4", kv_heads=4)
+    prompts = _prompts(rng, 5)
+    base = InferenceEngine(ex, model, name="sh4", **_EKW)
+    tp = InferenceEngine(ex, model, name="sh4", mesh=serving_mesh(4),
+                         **_EKW)
+    for a, b in zip(base.generate_many(prompts, 8),
+                    tp.generate_many(prompts, 8)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_gpt_tp_streams_bitwise(rng):
+    ex, model = _gpt("shg")
+    prompts = _prompts(rng, 5)
+    base = InferenceEngine(ex, model, name="shg", **_EKW)
+    outs = [InferenceEngine(ex, model, name="shg", mesh=serving_mesh(t),
+                            **_EKW).generate_many(prompts, 8)
+            for t in (2, 4)]
+    ref = base.generate_many(prompts, 8)
+    for out in outs:
+        for a, b in zip(ref, out):
+            np.testing.assert_array_equal(a, b)
+
+
+# -- compile-once across the shared program cache ----------------------------
+
+def test_mesh_program_key_distinct_and_compile_once(rng):
+    ex, model = _llama("shk")
+    prompts = _prompts(rng, 4)
+    base = InferenceEngine(ex, model, name="shk", **_EKW)
+    tp = InferenceEngine(ex, model, name="shk", mesh=serving_mesh(2),
+                         **_EKW)
+    # the mesh geometry rides the program key AND the cost signature —
+    # the twins can never hand each other a stale executable
+    assert base._program_key() != tp._program_key()
+    assert base.cost_signature() != tp.cost_signature()
+    base.generate_many(prompts, 6)
+    tp.generate_many(prompts, 6)
+    warm = dict(tp.trace_counts)
+    assert all(v == 1 for v in warm.values())
+    tp.generate_many(prompts, 6)          # same shapes: zero retraces
+    assert tp.trace_counts == warm
+
+
+# -- HBM accounting ----------------------------------------------------------
+
+def test_sharded_pool_ledger_charges_per_chip():
+    led = telemetry.get_hbm_ledger()
+    before = led.live_bytes("kv_cache")
+    ex, model = _llama("shb")
+    eng = InferenceEngine(ex, model, name="shb", mesh=serving_mesh(2),
+                          **_EKW)
+    total = int(eng.cache.k.nbytes) + int(eng.cache.v.nbytes)
+    assert led.live_bytes("kv_cache") == before + total // 2
+    st = eng.stats()["mesh"]
+    assert st["tp"] == 2 and st["devices"] == [0, 1]
+    assert st["kv_per_chip_bytes"] == total // 2
+    assert st["kv_per_chip_bytes"] == per_chip_bytes(
+        {"k": eng.cache.k, "v": eng.cache.v})
+    # params are only PARTIALLY sharded (embeddings/norms replicate),
+    # so per-chip sits strictly between total/tp and total
+    ptotal = sum(int(v.nbytes) for v in eng.params.values())
+    assert ptotal // 2 < st["param_per_chip_bytes"] < ptotal
+    eng.cache.close()
+    assert led.live_bytes("kv_cache") == before
+
+
+# -- fleet: sub-mesh pinning + failover --------------------------------------
+
+def test_fleet_pins_disjoint_submeshes():
+    ex, model = _llama("shf")
+    fleet = EngineFleet(ex, model, n_engines=3, threaded=False,
+                        tp_size=2,
+                        engine_kwargs=dict(_EKW, name="shf"))
+    assert fleet.stats()["tp_size"] == 2
+    groups = [tuple(r.engine.stats()["mesh"]["devices"])
+              for r in fleet._replicas]
+    assert groups == [(0, 1), (2, 3), (4, 5)]
+    fleet.stop()
+
+
+@pytest.mark.slow
+def test_crash_failover_into_sharded_sibling_bitwise(rng):
+    """Kill a TP=2 replica mid-decode: in-flight greedy streams finish
+    on a SHARDED sibling bitwise identical to an uninterrupted
+    single-device run (teacher-forced replay through the sibling's own
+    sharded executables)."""
+    ex, model = _llama("shx")
+    ekw = dict(_EKW, name="shx")
+    prompts = _prompts(rng, 6)
+    base = InferenceEngine(ex, model, **ekw).generate_many(prompts, 10)
+    fleet = EngineFleet(ex, model, n_engines=3, threaded=False,
+                        tp_size=2, engine_kwargs=ekw, breaker_base=1e-4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reqs = [fleet.submit(p, 10) for p in prompts]
+        fleet.pump(3)
+        victim = max(fleet._replicas, key=lambda r: len(r.inflight))
+        assert victim.inflight
+        faults.crash_engine(victim.engine)
+        fleet.wait(reqs)
+    assert fleet.stats()["failovers"] >= 1
+    assert all(r.finish_reason in ("eos", "max_new") for r in reqs)
+    for r, b in zip(reqs, base):
+        np.testing.assert_array_equal(r.result(), b)
+    fleet.stop()
+
+
+# -- satellite surfaces ------------------------------------------------------
+
+def _mlp_graph(batch=64):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((batch, 32)).astype(np.float32)
+    labels = (X[:, 0] > 0).astype(np.int64)
+    x = ht.placeholder_op("x", X.shape)
+    y = ht.placeholder_op("y", labels.shape, dtype=np.int32)
+    model = MLP(dims=(32, 64, 2))
+    logits = model(x)
+    loss = ht.reduce_mean_op(
+        ht.softmax_cross_entropy_sparse_op(logits, y))
+    opt = ht.SGDOptimizer(learning_rate=0.5)
+    return [loss, opt.minimize(loss)], {x: X, y: labels}
+
+
+@pytest.mark.parametrize("strat", [DataParallel(ndev=8), FSDP(ndev=8)],
+                         ids=["dp", "fsdp"])
+def test_run_steps_on_sharded_executor_matches_stepwise(strat):
+    nodes, feed = _mlp_graph()
+    ex1 = ht.Executor(nodes, dist_strategy=strat)
+    for _ in range(6):
+        l_run = ex1.run(feed_dict=feed,
+                        convert_to_numpy_ret_vals=True)[0]
+    nodes2, feed2 = _mlp_graph()
+    ex2 = ht.Executor(nodes2, dist_strategy=strat)
+    name = next(iter(ex2.subexecutor))
+    l_multi = ex2.run_steps(name, feed2, 6,
+                            convert_to_numpy_ret_vals=True)[0]
+    np.testing.assert_allclose(float(l_run), float(l_multi),
+                               rtol=1e-6, atol=1e-7)
+    # the fori_loop program must hand params back in their declared
+    # shardings, not gathered replicas
+    for v in ex2.variables:
+        if v.dist_state is not None:
+            assert ex2.params[v.name].sharding.spec == \
+                ex1.params[v.name].sharding.spec
+
+
+def test_sharded_packed_lookup_bitwise(rng):
+    from hetu_tpu.ops.pallas.sparse_densify import (pack_table,
+                                                    packed_lookup,
+                                                    sharded_packed_lookup)
+    tbl = rng.normal(0, 1, (100, 16)).astype(np.float32)
+    packed = pack_table(tbl)
+    mesh = serving_mesh(4)
+    ids = rng.integers(0, 100, size=(32,)).astype(np.int32)
+    for shaped in (ids, ids.reshape(8, 4)):
+        ref = packed_lookup(packed, jnp.asarray(shaped), 16)
+        out = sharded_packed_lookup(mesh, packed, jnp.asarray(shaped),
+                                    16)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+    with pytest.raises(ValueError, match="divide"):
+        sharded_packed_lookup(mesh, packed,
+                              jnp.asarray(ids[:30]), 16)

@@ -26,6 +26,10 @@ direction-aware per-signal tolerances:
 * informational signals (``*shed_fraction*``): reported, never gating —
   how much the SLO controller shed is context for the attainment
   number, not independently good or bad.
+* speedup signals (``*speedup*``, from ``bench.py --serve --tp N``):
+  platform-conditional — gated one-sided like throughput when the
+  current round ran on a real TPU mesh, informational on CPU where the
+  forced host "devices" time-share the same cores.
 
 Signals present on only one side are reported as notes, never failures
 (new programs appear, old ones retire).  Exit status: 0 when every
@@ -65,12 +69,21 @@ ATTAINMENT_MARKERS = ("attainment",)
 #: for the chunked-prefill claim, too noisy to gate.
 INFO_MARKERS = ("shed_fraction", "numerics", "grad_norm", "update_norm",
                 "update_ratio", "anomal", "tpot")
+#: platform-conditional signals (``serve_tp_speedup`` from ``bench.py
+#: --serve --tp N``): a real speedup only exists on a real multi-chip
+#: mesh — on CPU the forced host "devices" share the same cores, so the
+#: ratio is machine-load noise and must not gate
+SPEEDUP_MARKERS = ("speedup",)
 
 
-def classify(name):
+def classify(name, platform=None):
     """'attainment' (higher is better, absolute one-sided), 'info'
     (never gates), 'throughput' (higher is better, ratio), or 'static'
-    (lower is better, ratio)."""
+    (lower is better, ratio).  Speedup signals are throughput on a real
+    TPU mesh and informational anywhere else (forced-host CPU devices
+    time-share the same cores)."""
+    if any(m in name for m in SPEEDUP_MARKERS):
+        return "throughput" if platform == "tpu" else "info"
     if any(m in name for m in ATTAINMENT_MARKERS):
         return "attainment"
     if any(m in name for m in INFO_MARKERS):
@@ -113,9 +126,11 @@ def load_history_entry(path, index):
 
 
 def diff_signals(current, baseline, tol_throughput, tol_static,
-                 tol_attainment=0.05):
+                 tol_attainment=0.05, platform=None):
     """Per-signal verdicts: [{signal, kind, current, baseline, ratio,
-    regressed}] for shared signals, plus the one-sided names."""
+    regressed}] for shared signals, plus the one-sided names.
+    ``platform`` is the CURRENT round's backend — it decides whether
+    speedup signals gate (tpu) or inform (everything else)."""
     rows, only_current, only_baseline = [], [], []
     for name in sorted(set(current) | set(baseline)):
         if name not in baseline:
@@ -125,7 +140,7 @@ def diff_signals(current, baseline, tol_throughput, tol_static,
             only_baseline.append(name)
             continue
         cur, base = float(current[name]), float(baseline[name])
-        kind = classify(name)
+        kind = classify(name, platform)
         if kind == "attainment":
             # absolute points, one-sided: only a DROP beyond the
             # tolerance fails (a ratio misreads a 0.02 -> 0.01 noise
@@ -189,7 +204,10 @@ def main(argv=None):
                     help="emit the full verdict table as JSON")
     args = ap.parse_args(argv)
 
-    current = extract_signals(load_json(args.current))
+    current_doc = load_json(args.current)
+    current = extract_signals(current_doc)
+    platform = (current_doc.get("platform")
+                if isinstance(current_doc, dict) else None)
     baseline_src = None
     baseline = None
     default_baseline = os.path.join(REPO, "benchmarks", "BASELINE.json")
@@ -223,7 +241,7 @@ def main(argv=None):
 
     rows, only_cur, only_base = diff_signals(
         current, baseline, args.tol_throughput, args.tol_static,
-        args.tol_attainment)
+        args.tol_attainment, platform=platform)
     regressions = [r for r in rows if r["regressed"]]
     summary = {"status": "regressed" if regressions else "ok",
                "baseline": baseline_src,
