@@ -1473,6 +1473,274 @@ def _emit_serve(out):
     _print_compact(compact, drop_order=("occupancy",))
 
 
+# -- speculative serve mode (bench.py --serve --spec) -----------------------
+# Speculative-decoding + prefix-caching evidence (ISSUE 15): the SAME
+# paged engine + arrival trace, once plain and once with spec_k draft
+# lookahead, at byte-identical page-pool geometry (self-draft reuses
+# the target's own weights and KV pages — zero extra HBM).  The sha256
+# stream witness must match bitwise: acceptance is prefix-match against
+# the teacher-forced verify step, so speculation is a latency
+# optimization, never a sampler.  The trace is LOW-CONCURRENCY
+# (n_slots=2, queued arrivals): speculative decoding pays off exactly
+# when the batch is too small to amortize per-step dispatch — at high
+# occupancy the plain engine already amortizes each step over every
+# active slot and speculation's extra draft FLOPs only lose.  Three
+# sub-stages:
+#   * acceptance-friendly: a truncated-layer self-draft made a FAITHFUL
+#     predictor by zeroing the residual-branch output projections of
+#     the layers above the draft depth — the random-init stand-in for
+#     a trained draft/target pair that agrees (draft cost ~1/num_layers
+#     of the target per proposed token, acceptance near 1);
+#   * adversarial: an injectable 1-layer random-weight ModelDraft that
+#     agrees with nothing — the spec_min_accept gate must notice and
+#     fall back to plain decode (bounded downside);
+#   * prefix-heavy: requests sharing a system-prompt prefix through a
+#     PrefixCache twin — warm prompts skip prefill chunks, so TTFT
+#     drops at zero contamination (stream sha vs the uncached twin).
+
+SERVE_SPEC_DETAIL_PATH = os.environ.get(
+    "HETU_SERVE_SPEC_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "SERVE_SPEC_FULL.json"))
+
+
+def run_serve_spec(quick=False, seed=0):
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu.models import LlamaConfig, LlamaForCausalLM
+    from hetu_tpu.serving import InferenceEngine, ModelDraft
+
+    ex, model, c = _serve_build(quick)
+    # acceptance-friendly target: zero the residual-branch output
+    # projections of every layer ABOVE the draft depth, so the
+    # truncated-layer self-draft computes the target function exactly
+    # (layers >= 1 become the identity on the residual stream).  At
+    # random init a truncated draft agrees with nothing; a trained
+    # draft/target pair agrees most of the time — this constructs the
+    # agreeing regime deterministically while the plain twin pays the
+    # full per-step op count (zeroed weights are not faster on any
+    # backend), so the A/B stays fair.
+    draft_layers = 1
+    for k in list(ex.params):
+        for ly in range(draft_layers, c.num_layers):
+            if (f"layer{ly}_attn_out" in k) or (f"layer{ly}_mlp_out" in k):
+                ex.params[k] = ex.params[k] * 0.0
+    # decode-heavy queued trace: long outputs, near-simultaneous
+    # arrivals, TWO slots — the latency-bound regime where the plain
+    # engine commits ~2 tokens per dispatch; headroom bound is
+    # prompt + max_new <= max_len - spec_k
+    spec_k = 5
+    if quick:
+        n_slots, max_len, max_prompt = 2, 128, 12
+        page_len, prefill_budget = 8, 24
+        trace = _serve_trace(seed, 8, c.vocab_size, 3, 10, 72, 80,
+                             mean_gap=0.5)
+    else:
+        n_slots, max_len, max_prompt = 2, 224, 48
+        page_len, prefill_budget = 16, 96
+        trace = _serve_trace(seed, 24, c.vocab_size, 8, 32, 96, 128,
+                             mean_gap=0.5)
+    # pool sized for the prefix sub-stage's higher slot count below
+    n_pages = (8 * max_len) // page_len + 1   # + sentinel
+    pkw = dict(n_slots=n_slots, max_len=max_len,
+               max_prompt_len=max_prompt, prefill_budget=2, paged=True,
+               page_len=page_len, n_pages=n_pages,
+               prefill_token_budget=prefill_budget, name="serve",
+               seed=seed)
+
+    plain = InferenceEngine(ex, model, instance="plain", **pkw)
+    # truncated self-draft: same weights, same KV pages, zero extra
+    # HBM; with the aligned target above it proposes what verify will
+    # emit, so each verify dispatch commits ~k+1 tokens
+    spec = InferenceEngine(ex, model, instance="spec", spec_k=spec_k,
+                           draft_layers=draft_layers, **pkw)
+    # adversarial: an injectable 1-layer ModelDraft with its OWN random
+    # weights proposes noise against the same target; the
+    # acceptance-EWMA gate must close and fall back to plain decode,
+    # probing occasionally for workload shift (sparse probes: each one
+    # costs a junk draft+verify round trip)
+    jc = LlamaConfig(vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+                     num_layers=1, num_heads=c.num_heads,
+                     num_kv_heads=c.num_kv_heads,
+                     intermediate_size=c.intermediate_size,
+                     seq_len=c.seq_len)
+    jmodel = LlamaForCausalLM(jc, name="servejunk")
+    jids = ht.placeholder_op("servejunk_ids", (1, 4), dtype=np.int32)
+    jex = ht.Executor([jmodel(jids)])
+    adv = InferenceEngine(ex, model, instance="spec_adv", spec_k=spec_k,
+                          draft=ModelDraft(jex, jmodel, name="servejunk"),
+                          spec_min_accept=2.0, spec_probe_every=256,
+                          **pkw)
+    engines = {"plain": plain, "spec": spec, "adversarial": adv}
+    for eng in engines.values():
+        _serve_replay(eng, trace)       # untimed warm replay
+    warm_spec = dict(spec.trace_counts)
+    # fair A/B: alternate replays so all three engines see the same
+    # instantaneous machine state (same rationale as the paged-vs-slot
+    # interleaving in run_serve), keep each engine's best
+    results = {}
+    for _ in range(3):
+        for mode, eng in engines.items():
+            r = _serve_replay(eng, trace)
+            if (mode not in results or r["tokens_per_sec"]
+                    > results[mode]["tokens_per_sec"]):
+                results[mode] = r
+    spec_flat = spec.trace_counts == warm_spec
+    sspec, sadv = spec.stats()["spec"], adv.stats()["spec"]
+    pool_b = {m: int(e.cache.k.nbytes) + int(e.cache.v.nbytes)
+              for m, e in engines.items()}
+
+    # prefix-heavy sub-stage: every prompt = one shared system prefix
+    # (whole pages) + a short unique tail.  Cold prefill needs several
+    # chunks at the dropped token budget; a prefix hit skips the shared
+    # pages, so warm TTFT is chunks fewer.  Arrivals spread out so the
+    # first request's pages are interned before followers arrive.
+    if quick:
+        pfx_len, n_pfx, tail_lo, tail_hi, pfx_budget = page_len, 12, 2, 4, 4
+    else:
+        pfx_len, n_pfx = 2 * page_len, 32
+        tail_lo, tail_hi, pfx_budget = 2, max_prompt - 2 * page_len, 16
+    rng = np.random.default_rng(seed + 2)
+    sys_prompt = rng.integers(1, c.vocab_size, (pfx_len,)).astype(np.int32)
+    gaps = rng.exponential(3.0, n_pfx)
+    arrivals = np.floor(np.cumsum(gaps)).astype(np.int64)
+    ptrace = []
+    for i in range(n_pfx):
+        tail = rng.integers(1, c.vocab_size,
+                            (int(rng.integers(tail_lo, tail_hi + 1)),))
+        ptrace.append((int(arrivals[i]),
+                       np.concatenate([sys_prompt,
+                                       tail.astype(np.int32)]),
+                       int(rng.integers(4, 9))))
+    pfx_kw = dict(pkw, n_slots=8, prefill_token_budget=pfx_budget)
+    cold = InferenceEngine(ex, model, instance="noprefix", **pfx_kw)
+    warm = InferenceEngine(ex, model, instance="prefix",
+                           prefix_cache=True, **pfx_kw)
+    for eng in (cold, warm):
+        _serve_replay(eng, ptrace)      # untimed warm replay; also
+    results["noprefix"] = None          # interns the shared prefix
+    results["prefix"] = None
+    for _ in range(2):
+        for mode, eng in (("noprefix", cold), ("prefix", warm)):
+            r = _serve_replay(eng, ptrace)
+            if (results[mode] is None or r["latency_s"]["ttft"]["p50"]
+                    < results[mode]["latency_s"]["ttft"]["p50"]):
+                results[mode] = r
+    pstats = warm.prefix_cache.stats()
+    warm.prefix_cache.close()
+
+    vs = round(results["spec"]["tokens_per_sec"]
+               / results["plain"]["tokens_per_sec"], 3)
+    adv_vs = round(results["adversarial"]["tokens_per_sec"]
+                   / results["plain"]["tokens_per_sec"], 3)
+    ttft_c = results["noprefix"]["latency_s"]["ttft"]["p50"]
+    ttft_w = results["prefix"]["latency_s"]["ttft"]["p50"]
+    signals = {
+        "serve_spec_tokens_per_s": results["spec"]["tokens_per_sec"],
+        "serve_spec_plain_tokens_per_s":
+            results["plain"]["tokens_per_sec"],
+        "spec_acceptance_rate": sspec["acceptance_rate"],
+        "prefix_hit_rate": pstats["hit_rate"],
+        "serve_prefix_ttft_p50_s": ttft_w,
+        "serve_noprefix_ttft_p50_s": ttft_c,
+    }
+    return {"metric": "serve_spec_tokens_per_s",
+            "value": results["spec"]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_plain": vs,             # > 1 iff speculation pays
+            "spec_wins": bool(vs >= 1.2),
+            "spec_k": spec_k,
+            "draft_layers": draft_layers,
+            "aligned_target": True,     # layers above draft depth zeroed
+            "latency_bound_slots": n_slots,
+            "acceptance_rate": sspec["acceptance_rate"],
+            "accepted_per_step_ewma": sspec["accepted_per_step_ewma"],
+            "bitwise_match": bool(
+                results["spec"]["stream_sha"]
+                == results["plain"]["stream_sha"]),
+            "equal_hbm": bool(len(set(pool_b.values())) == 1),
+            "pool_bytes": pool_b["plain"],
+            "compile_flat": bool(spec_flat),
+            "adversarial": {"vs_plain": adv_vs,
+                            "bounded": bool(adv_vs >= 1 / 1.05),
+                            "gate_closed": bool(
+                                sadv["steps"]
+                                < results["adversarial"]["decode_steps"]),
+                            "acceptance_rate": sadv["acceptance_rate"],
+                            "bitwise_match": bool(
+                                results["adversarial"]["stream_sha"]
+                                == results["plain"]["stream_sha"])},
+            "prefix": {"ttft_p50_s": ttft_w,
+                       "noprefix_ttft_p50_s": ttft_c,
+                       "ttft_reduced": bool(ttft_w < ttft_c),
+                       "hits": pstats["hits"],
+                       "hit_rate": pstats["hit_rate"],
+                       "cow_forks": pstats["cow_forks"],
+                       "prefix_len": int(pfx_len),
+                       "prefill_token_budget": pfx_budget,
+                       "no_contamination": bool(
+                           results["prefix"]["stream_sha"]
+                           == results["noprefix"]["stream_sha"])},
+            "platform": jax.default_backend(),
+            "seed": seed, "quick": bool(quick),
+            "n_requests": len(trace), "n_prefix_requests": n_pfx,
+            "paged": {"n_slots": pkw["n_slots"], "page_len": page_len,
+                      "n_pages": n_pages,
+                      "prefill_token_budget": prefill_budget},
+            "signals": signals,
+            "stages": results}
+
+
+def _emit_serve_spec(out):
+    """Same layered emission contract as _emit_serve: full headline +
+    SERVE_SPEC_FULL.json (written only after the run has real results),
+    flat signals appended to benchmarks/history.jsonl for
+    tools/perf_diff.py, compact tail line inside the driver window."""
+    from hetu_tpu.telemetry import JsonlWriter
+    full = json.dumps(out)
+    try:
+        with open(SERVE_SPEC_DETAIL_PATH, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass
+    if out.get("signals"):
+        entry = {"t": round(time.time(), 3), "platform": out["platform"],
+                 "quick": out["quick"], "seed": out["seed"],
+                 "signals": out["signals"]}
+        try:
+            os.makedirs(os.path.dirname(HISTORY_PATH) or ".",
+                        exist_ok=True)
+            with JsonlWriter(HISTORY_PATH) as w:  # append, never truncate
+                w.write(entry)
+        except OSError:
+            pass
+    print(full, flush=True)
+    compact = {"metric": out["metric"], "value": out["value"],
+               "unit": out["unit"], "vs_plain": out["vs_plain"],
+               "spec_wins": out["spec_wins"],
+               "acceptance_rate": out["acceptance_rate"],
+               "bitwise": out["bitwise_match"],
+               "equal_hbm": out["equal_hbm"],
+               "compile_flat": out["compile_flat"],
+               "adversarial": {
+                   "vs_plain": out["adversarial"]["vs_plain"],
+                   "bounded": out["adversarial"]["bounded"],
+                   "gate_closed": out["adversarial"]["gate_closed"]},
+               "prefix": {
+                   "ttft_p50_s": out["prefix"]["ttft_p50_s"],
+                   "noprefix_ttft_p50_s":
+                       out["prefix"]["noprefix_ttft_p50_s"],
+                   "ttft_reduced": out["prefix"]["ttft_reduced"],
+                   "hits": out["prefix"]["hits"],
+                   "no_contamination":
+                       out["prefix"]["no_contamination"]},
+               "detail": os.path.basename(SERVE_SPEC_DETAIL_PATH)}
+    if "telemetry_overhead" in out:
+        compact["telemetry_overhead_frac"] = \
+            out["telemetry_overhead"]["overhead_frac"]
+    _print_compact(compact, drop_order=("adversarial",))
+
+
 # -- sharded serve mode (bench.py --serve --tp N) ---------------------------
 # Tensor-parallel serving evidence: the SAME paged engine + arrival
 # trace, once over a (replica=1, model=N) mesh and once on a single
@@ -3315,6 +3583,13 @@ def main():
         quick = quick or jax.default_backend() == "cpu"
         if telemetry_on:
             _telemetry_on()
+        if "--spec" in sys.argv:
+            out = run_serve_spec(quick)
+            if telemetry_on:
+                out["telemetry"] = _telemetry_report()
+                _assert_rid_audit(out["telemetry"])
+            _emit_serve_spec(out)
+            return
         if tp > 1:
             out = run_serve_tp(quick, tp)
             if telemetry_on:

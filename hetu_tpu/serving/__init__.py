@@ -28,6 +28,17 @@ is a token-stream-bitwise twin of the single-chip one, and
 ``EngineFleet(tp_size=N)`` pins one replica per contiguous N-device
 sub-mesh with failover replay landing bit-exactly on a sharded sibling.
 
+The paged engine's raw-speed multiplier is SPECULATIVE DECODING
+(speculative.py): a cheap draft — truncated-layer self-draft or an
+injectable small model — proposes ``spec_k`` tokens per iteration and
+ONE fused verify step teacher-forces the whole window, committing the
+accepted prefix bitwise-identically to the non-speculative twin (the
+replay path widened to ``[S, k+1]``; rejected rows roll back by
+host-side position bookkeeping alone).  On the same page refcounts,
+PREFIX CACHING (prefix_cache.py) interns finished prompts' page-aligned
+prefixes and shares them into later admissions — shared system prompts
+skip prefill, guarded read-only with copy-on-write forking.
+
 A second production workload rides the same lifecycle: the embedding
 subpackage (embedding/) serves batched sparse-feature lookups + CTR
 scoring through the identical Scheduler — a HET-style device hot-row
@@ -49,6 +60,8 @@ from .scheduler import (EngineOverloaded, Request, Scheduler,
                         FINISH_REASONS, SHED_POLICIES, TERMINAL_OK)
 from .adapters import (LlamaSlotAdapter, GPTSlotAdapter, adapter_for)
 from .engine import InferenceEngine
+from .speculative import ModelDraft, SelfDraft
+from .prefix_cache import PrefixCache
 from .sharding import (KV_POOL_SPEC, kv_sharding, param_pspecs,
                        param_shardings, per_chip_bytes, serving_mesh,
                        shard_params, validate_tp)
@@ -64,7 +77,8 @@ __all__ = ["PagedKVCache", "SlotKVCache", "Request", "Scheduler",
            "EngineOverloaded",
            "FINISH_REASONS", "SHED_POLICIES", "TERMINAL_OK",
            "LlamaSlotAdapter", "GPTSlotAdapter", "adapter_for",
-           "InferenceEngine", "CircuitBreaker", "ReplicaHealth",
+           "InferenceEngine", "ModelDraft", "SelfDraft", "PrefixCache",
+           "CircuitBreaker", "ReplicaHealth",
            "HEALTH_STATES", "HEALTH_STATE_CODES", "EngineFleet",
            "FleetRequest", "FleetUnavailable", "CostModel",
            "DEGRADE_LEVELS", "FleetController", "SLO", "SLOReject",

@@ -72,10 +72,16 @@ class LlamaSlotAdapter:
         return cls(model.config, name,
                    moe_names=_ld.moe_param_names(model), mesh=mesh)
 
-    def decode(self, params, tokens, positions, k, v):
+    def decode(self, params, tokens, positions, k, v, n_layers=None):
+        """Slot-batched decode (see module doc).  ``n_layers`` truncates
+        the stack to its first N blocks — the speculative self-draft
+        path: the caller passes caches sliced to ``[:, :N]`` and the
+        truncated trunk feeds the full final-norm/LM head, so draft
+        logits cost N/L of a target step with zero extra parameters."""
         c, hd = self.config, self.head_dim
+        nl = self.layers if n_layers is None else int(n_layers)
         emb = params[self.embed_param]
-        lps = [self._layer_params(params, i) for i in range(self.layers)]
+        lps = [self._layer_params(params, i) for i in range(nl)]
         max_len = k.shape[3]
         cos_t, sin_t = _rope_tables(max_len, hd, c.rope_theta)
         x = emb[tokens][:, None, None, :]            # [S, 1, 1, H]
@@ -160,10 +166,11 @@ class GPTSlotAdapter:
     def for_model(cls, model, name, mesh=None):
         return cls(model.config, name, mesh=mesh)
 
-    def decode(self, params, tokens, positions, k, v):
+    def decode(self, params, tokens, positions, k, v, n_layers=None):
+        nl = self.layers if n_layers is None else int(n_layers)
         emb = params[self.embed_param]
         wpe = params[f"{self.name}_wpe"]
-        lps = [self._layer_params(params, i) for i in range(self.layers)]
+        lps = [self._layer_params(params, i) for i in range(nl)]
         max_len = k.shape[3]
         x = (emb[tokens] + wpe[positions])[:, None, None, :]  # [S, 1, 1, H]
         mask = (jnp.arange(max_len)[None, :]

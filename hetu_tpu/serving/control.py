@@ -148,6 +148,11 @@ class CostModel:
         self.alpha = float(alpha)
         self.decode_s = None      # EWMA seconds / generated token
         self.prefill_s = {}       # pow2 bucket -> EWMA seconds
+        # speculative decoding divisor: measured accepted tokens per
+        # verify step (None until a speculating engine reports) — one
+        # decode DISPATCH commits this many tokens, so per-token cost
+        # derived from per-step timings must divide by it
+        self.accepted_per_step = None
 
     @staticmethod
     def bucket(prompt_len):
@@ -161,6 +166,14 @@ class CostModel:
     def observe_decode(self, seconds):
         if seconds is not None and seconds > 0:
             self.decode_s = self._fold(self.decode_s, seconds)
+
+    def observe_speculation(self, accepted_per_step):
+        """Fold a speculating engine's measured accepted-tokens-per-
+        verify-step (the engine's own acceptance EWMA).  Clamped to
+        >= 1: even a fully-rejecting window commits one token."""
+        if accepted_per_step is not None and accepted_per_step > 0:
+            self.accepted_per_step = self._fold(
+                self.accepted_per_step, max(1.0, accepted_per_step))
 
     def observe_prefill(self, prompt_len, seconds):
         if seconds is None or seconds < 0:
@@ -182,18 +195,25 @@ class CostModel:
 
     def prime(self, profiler, decode="serve_decode"):
         """Seed ``decode_s`` from an OBSERVED program profile (one with
-        measured ``steps_per_sec`` in its derived block)."""
+        measured ``steps_per_sec`` in its derived block).  Profiled
+        steps are verify DISPATCHES: under speculative decoding each
+        commits ``accepted_per_step`` tokens, so the per-token seed
+        divides by the measured acceptance when one is known."""
         prof = profiler.profile(decode)
         derived = (prof or {}).get("derived") or {}
         sps = derived.get("steps_per_sec")
         if sps:
-            self.observe_decode(1.0 / float(sps))
+            per_step = 1.0 / float(sps)
+            if self.accepted_per_step:
+                per_step /= self.accepted_per_step
+            self.observe_decode(per_step)
         return self.decode_s
 
     def as_dict(self):
         return {"decode_s": self.decode_s,
                 "prefill_s": {f"2^{k}": v
                               for k, v in sorted(self.prefill_s.items())},
+                "accepted_per_step": self.accepted_per_step,
                 "alpha": self.alpha}
 
 
@@ -393,7 +413,16 @@ class FleetController:
             eff_max_new = self.brownout_max_new
             self.capped += 1
         if deadline is not None:
-            est = self.estimate(_prompt_len(prompt), eff_max_new,
+            # prefix-cache-aware prefill: pages already interned on some
+            # live replica are mapped at admission, not recomputed, so
+            # the deadline estimate buckets only the uncached tail
+            plen = _prompt_len(prompt)
+            cached = 0
+            for rep in self._live_replicas():
+                pc = getattr(rep.engine, "prefix_cache", None)
+                if pc is not None:
+                    cached = max(cached, pc.hit_tokens(prompt))
+            est = self.estimate(max(plen - cached, 1), eff_max_new,
                                 now=now)
             if est["total_s"] is not None:
                 slack = deadline - now
@@ -429,6 +458,12 @@ class FleetController:
             eng = rep.engine
             if eng is None:
                 continue
+            # speculation-aware decode costs: TPOT EWMAs above already
+            # reflect multi-token verify steps, but profiler-primed
+            # per-step seeds need the measured divisor too
+            aps = getattr(eng, "spec_accepted_per_step", None)
+            if aps is not None:
+                self.cost.observe_speculation(aps)
             key = (rep.name, rep.incarnation)
             seen = self._rec_seen.get(key, 0)
             recs = eng.records

@@ -142,8 +142,15 @@ class InferenceEngine:
                  stream_stall_timeout=None, clock=None, instance=None,
                  latency_buckets=None, device=None, paged=False,
                  page_len=16, n_pages=None, prefill_token_budget=None,
-                 mesh=None):
-        self.params = executor.params
+                 mesh=None, spec_k=0, draft=None, draft_layers=None,
+                 spec_min_accept=None, spec_probe_every=32,
+                 shared_params=None, prefix_cache=None):
+        # shared_params (fleet multi-replica-per-chip): a param pytree
+        # ALREADY placed on this engine's device — replicas pinned to
+        # the same chip pass one placed copy instead of re-uploading
+        # per engine (the HBM ledger's pool=params books it once)
+        self.params = (executor.params if shared_params is None
+                       else shared_params)
         self.instance = None if instance is None else str(instance)
         self.device = device
         self.mesh = mesh
@@ -163,7 +170,11 @@ class InferenceEngine:
                     "(tensor-parallel), not both")
             self._tp = _shd.mesh_axis_size(mesh)
         self._rep = None if mesh is None else _shd.replicated(mesh)
-        if device is not None:
+        if shared_params is not None and mesh is not None:
+            raise ValueError(
+                "shared_params is the single-chip replica-sharing path; "
+                "mesh engines own mesh-placed params (see _shd.shard_params)")
+        if device is not None and shared_params is None:
             # fleet replica pinning: park THIS engine's params + cache on
             # one device so N replicas split the chips instead of
             # contending for device 0 (jit follows the operands' device)
@@ -225,6 +236,62 @@ class InferenceEngine:
                     "prefill_token_budget requires paged=True (the slot "
                     "engine prefills whole prompts)")
         self.prefill_token_budget = prefill_token_budget
+        # -- speculative decoding (serving/speculative.py) ----------------
+        spec_k = int(spec_k)
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_k and not self._paged:
+            raise ValueError(
+                "spec_k (speculative decoding) requires paged=True — the "
+                "verify program is the paged step widened to a window")
+        self._spec_k = spec_k
+        self._draft = None           # ModelDraft instance (or None)
+        self._draft_layers = 0       # SelfDraft depth (0 = model draft)
+        if spec_k:
+            from . import speculative as _spec
+            if draft is None:
+                draft = _spec.SelfDraft(draft_layers)
+            elif callable(draft) and not hasattr(draft, "kind"):
+                draft = draft()      # factory: each replica gets its own
+            if draft.kind == "self":
+                dl = draft.layers
+                if dl is None:
+                    dl = (int(draft_layers) if draft_layers is not None
+                          else max(1, self.adapter.layers // 2))
+                if not 1 <= dl <= self.adapter.layers:
+                    raise ValueError(
+                        f"draft_layers={dl} outside [1, "
+                        f"{self.adapter.layers}]")
+                self._draft_layers = int(dl)
+            else:
+                if mesh is not None:
+                    raise ValueError(
+                        "ModelDraft is single-chip only; mesh engines "
+                        "use the truncated-layer SelfDraft")
+                self._draft = draft
+        # adaptive gate: fall back to plain decode when the accepted-
+        # tokens-per-iteration EWMA sags below spec_min_accept (None =
+        # always speculate), re-probing every spec_probe_every plain
+        # iterations so recovered acceptance re-enables speculation
+        self._spec_min_accept = (None if spec_min_accept is None
+                                 else float(spec_min_accept))
+        self._spec_probe_every = max(1, int(spec_probe_every))
+        self._spec_accept_ewma = float(spec_k + 1)
+        self._spec_since_probe = 0
+        # -- prefix caching (serving/prefix_cache.py) ---------------------
+        self.prefix_cache = None
+        if prefix_cache:
+            if not self._paged:
+                raise ValueError("prefix_cache requires paged=True — a "
+                                 "shared prefix is shared PAGES")
+            if prefix_cache is True:
+                from .prefix_cache import PrefixCache
+                self.prefix_cache = PrefixCache(self.cache)
+            else:
+                if prefix_cache.pool is not self.cache:
+                    raise ValueError(
+                        "prefix_cache is bound to a different page pool")
+                self.prefix_cache = prefix_cache
         # paged prefill batching: lanes per call (B bucket cap) and the
         # chunk-length cap (C bucket cap = the prompt bucket)
         self._lane_cap = min(8, _p2(n_slots))
@@ -236,7 +303,10 @@ class InferenceEngine:
                                    gang=gang, max_queue=max_queue,
                                    low_watermark=low_watermark,
                                    shed_policy=shed_policy,
-                                   rid_prefix=self.instance)
+                                   rid_prefix=self.instance,
+                                   lookahead=spec_k)
+        if self.prefix_cache is not None:
+            self.scheduler.prefix_lookup = self.prefix_cache.lookup
         self.eos_id = eos_id
         self.watchdog = bool(watchdog)
         self.stream_stall_timeout = (
@@ -276,6 +346,9 @@ class InferenceEngine:
         self.slot_leaks_reclaimed = 0
         self.streams_detached = 0
         self.replayed_tokens = 0
+        self.spec_steps = 0        # speculative iterations dispatched
+        self.spec_proposed = 0     # draft-origin window candidates
+        self.spec_accepted = 0     # of those, accepted by verify
         mode = "gang" if gang else "continuous"
         reg = _telemetry.get_registry()
         # per-deployment histogram bucket overrides: real TPU TTFT/TPOT
@@ -325,6 +398,12 @@ class InferenceEngine:
             "counter", "hetu_serving_replayed_tokens_total",
             "Tokens teacher-forced during failover replay (rebuilt, "
             "never re-emitted)")
+        self._m_spec_proposed = _m(
+            "counter", "hetu_serving_spec_proposed_total",
+            "Draft tokens proposed into speculative verify windows")
+        self._m_spec_accepted = _m(
+            "counter", "hetu_serving_spec_accepted_total",
+            "Draft-proposed tokens the verify step accepted")
         self._m_ttft = _m("histogram", "hetu_serving_ttft_seconds",
                           "Time to first token (arrival -> first emit)",
                           **hkw)
@@ -354,7 +433,14 @@ class InferenceEngine:
         self._tr = _telemetry.get_tracer()
         self._rt = _telemetry.get_request_trace()
         self._fl = _telemetry.get_flight()
+        self._verify_fn = None
+        self._draft_fn = None
+        self._spec_traces = {}
         self._build()
+        if self._spec_k:
+            self._build_spec()
+            if self._draft is not None:
+                self._draft.attach(self)
 
     # -- jitted programs ---------------------------------------------------
     # ONE compiled (prefill, step) pair per (adapter signature, sampling)
@@ -572,12 +658,81 @@ class InferenceEngine:
         self._step_fn = entry["step"]
         self._traces = entry["traces"]
 
+    def _build_spec(self):
+        """The speculative program pair, cached under the paged program
+        key EXTENDED with the window geometry.  Extending (never
+        changing) the key keeps this engine's prefill and one-token
+        step as the SAME executables its non-speculative twin runs —
+        the bitwise-parity and equal-footing contracts — while verify/
+        draft are shared across engines with the same signature."""
+        from . import speculative as _spec
+        key = self._program_key() + (
+            ("spec", self._spec_k, self._draft_layers),)
+        entry = self._PROGRAMS.get(key)
+        if entry is None:
+            adapter = self.adapter
+            pick = make_slot_picker()
+            from .. import telemetry as _tel
+            retrace = _tel.get_registry().counter(
+                "hetu_serving_retraces_total",
+                "Times each jitted serving program was traced — >1 "
+                "after warmup breaks the compile-once contract",
+                labels=("program",))
+            traces = {"verify": 0}
+            verify_core = _spec.make_verify_fn(adapter, pick,
+                                               self._spec_k + 1)
+
+            def verify(*a):
+                traces["verify"] += 1      # host-side retrace witness
+                retrace.labels(program="verify").inc()
+                return verify_core(*a)
+
+            draft_jit = None
+            if self._draft_layers:
+                traces["draft"] = 0
+                draft_core = _spec.make_self_draft_fn(
+                    adapter, pick, self._spec_k, self._draft_layers)
+
+                def draft(*a):
+                    traces["draft"] += 1   # host-side retrace witness
+                    retrace.labels(program="draft").inc()
+                    return draft_core(*a)
+
+            donate = () if jax.default_backend() == "cpu" else (1, 2)
+            vjkw, djkw = {}, {}
+            if self.mesh is not None:
+                psh = _shd.param_shardings(self.mesh, adapter,
+                                           self.params)
+                kvsh = _shd.kv_sharding(self.mesh)
+                rep = _shd.replicated(self.mesh)
+                vjkw = dict(in_shardings=(psh, kvsh, kvsh) + (rep,) * 7,
+                            out_shardings=(kvsh, kvsh, rep, rep))
+                djkw = dict(in_shardings=(psh, kvsh, kvsh) + (rep,) * 6,
+                            out_shardings=rep)
+            if self._draft_layers:
+                # NO donation: the draft is carry-only over the pool
+                draft_jit = jax.jit(draft, **djkw)
+            entry = {"verify": jax.jit(verify, donate_argnums=donate,
+                                       **vjkw),
+                     "draft": draft_jit,
+                     "traces": traces}
+            self._PROGRAMS[key] = entry
+        self._verify_fn = entry["verify"]
+        self._draft_fn = entry["draft"]
+        self._spec_traces = entry["traces"]
+
     @property
     def trace_counts(self):
-        """{'prefill': n, 'step': n} — times the (shared) program was
-        traced; 1 after warmup means every engine with this signature
-        runs the same executable at the same shapes."""
-        return dict(self._traces)
+        """{'prefill': n, 'step': n, ...} — times each (shared) program
+        was traced; 1 after warmup means every engine with this
+        signature runs the same executable at the same shapes.
+        Speculative engines add their verify/draft witnesses (and a
+        ModelDraft its prefill/step pair) to the same dict."""
+        out = dict(self._traces)
+        out.update(self._spec_traces)
+        if self._draft is not None:
+            out.update(self._draft.trace_counts)
+        return out
 
     def _dev_put(self, host_array):
         """Upload a host-built operand.  Mesh engines place it
@@ -686,7 +841,12 @@ class InferenceEngine:
 
     def close(self):
         """Release engine-owned HBM-ledger accounting (the KV slot
-        pool).  Idempotent; scheduler/stats state stays readable."""
+        pool, a ModelDraft's cache, the prefix cache's retained pages).
+        Idempotent; scheduler/stats state stays readable."""
+        if self._draft is not None:
+            self._draft.close()
+        if self.prefix_cache is not None:
+            self.prefix_cache.close()
         self.cache.close()
 
     def __enter__(self):
@@ -735,10 +895,15 @@ class InferenceEngine:
                 f"prompt length {prompt.size} exceeds max_prompt_len="
                 f"{self.max_prompt_len}")
         max_new = int(max_new)
-        if prompt.size + max_new > self.max_len:
+        if prompt.size + max_new > self.max_len - self._spec_k:
+            # the spec_k headroom is the verify window's worst-case
+            # overhang: admission reserves it so the window can never
+            # scatter past a slot's pages mid-flight (admission stays
+            # the only refusal point)
+            spec = (f" - spec_k={self._spec_k}" if self._spec_k else "")
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new ({max_new}) exceeds "
-                f"max_len={self.max_len}")
+                f"max_len={self.max_len}{spec}")
         now = self._now()
         if ttl is not None:
             if deadline is not None:
@@ -783,6 +948,21 @@ class InferenceEngine:
         self.cancellations += 1
         self._m_cancelled.inc()
         return True
+
+    def prefix_hit_tokens(self, prompt):
+        """Tokens of ``prompt`` an interned prefix would cover at
+        admission (0 without a prefix cache) — the fleet's routing
+        tie-break toward the replica holding the warmest prefix."""
+        if self.prefix_cache is None:
+            return 0
+        return self.prefix_cache.hit_tokens(
+            np.asarray(prompt, np.int32).reshape(-1))
+
+    @property
+    def spec_accepted_per_step(self):
+        """Measured accepted-tokens-per-verify-step EWMA (None when not
+        speculating) — the SLO cost model's per-token decode divisor."""
+        return self._spec_accept_ewma if self._spec_k else None
 
     def _now(self):
         return self._clock()
@@ -866,6 +1046,8 @@ class InferenceEngine:
             self._prefilling.pop(req.slot, None)
             if req.slot in self._prefill_order:
                 self._prefill_order.remove(req.slot)
+        if self._draft is not None and req.slot is not None:
+            self._draft.release(req.slot)
         req.t_done = now
         self.scheduler.retire(req, reason)
         self._record(req)
@@ -989,13 +1171,23 @@ class InferenceEngine:
                 self._seeds[slot] = (self._default_seed
                                      if req.seed is None else req.seed)
                 self._dev_sampling = None
-                self._prefilling[slot] = {"req": req, "start": 0}
+                # prefix-cache hit: the scheduler shared the interned
+                # pages into this slot at alloc — prefill starts AFTER
+                # them (rows < start read the shared pages via the
+                # gathered block table; nothing is recomputed)
+                start0 = int(getattr(req, "prefix_tokens", 0))
+                if start0:
+                    self._rt.event(req.rid, "prefix_hit",
+                                   engine=self.instance, slot=slot,
+                                   tokens=start0)
+                self._prefilling[slot] = {"req": req, "start": start0}
                 self._prefill_order.append(slot)
-                clen = min(int(req.prompt.size), self._chunk_cap)
+                clen = min(int(req.prompt.size) - start0,
+                           self._chunk_cap)
                 if budget is not None:
                     clen = min(clen, budget - used)
                 if clen > 0 and len(work) < self._lane_cap:
-                    work.append((req, slot, 0, clen))
+                    work.append((req, slot, start0, clen))
                     used += clen
         if not work:
             return 0
@@ -1019,6 +1211,15 @@ class InferenceEngine:
             temps[i] = self._temps[slot]
             topks[i] = self._topks[slot]
             seeds[i] = self._seeds[slot]
+        for req, slot, start, clen in work:
+            # CoW discipline: chunk writes start AFTER any shared
+            # prefix, so they can only hit privately-held pages.  The
+            # guard (on in tests) turns a violation into a loud raise
+            # instead of silent cross-request contamination.
+            if self.cache.pages_shared:
+                self.cache.ensure_writable(slot, start, clen)
+            if self.cache.cow_guard:
+                self.cache.assert_writable(slot, start, clen)
         try:
             with self._tr.span("serve_prefill"):
                 k, v, toks, oks = self._prefill_fn(
@@ -1076,6 +1277,18 @@ class InferenceEngine:
             self._prefilling.pop(slot, None)
             self._prefill_order.remove(slot)
             self.cache.positions[slot] = int(req.prompt.size)
+            if self._draft is not None and (
+                    self._spec_min_accept is None
+                    or self._spec_accept_ewma >= self._spec_min_accept):
+                # gate closed -> skip the draft-side prefill dispatch:
+                # the lane stays at pos 0 and the catchup arithmetic in
+                # _step_speculative feeds prompt + stream through the
+                # draft's bulk-catchup program if a probe ever reopens
+                # speculation, so a junk draft costs nothing per
+                # admission while gated off
+                self._draft.admit(slot, req.prompt)
+            if self.prefix_cache is not None:
+                self.prefix_cache.intern(req.prompt, slot)
             self.prefills += 1
             self._m_prefill_iters.inc()
             self._rt.event(req.rid, "prefill_end", engine=self.instance,
@@ -1101,6 +1314,8 @@ class InferenceEngine:
         self._expire(self._now())
         if self._paged:
             produced += self._prefill_paged()
+            if self._spec_k and self._spec_gate():
+                return produced + self._step_speculative()
             return produced + self._step_decode()
         # 1) admission: prefill up to the budget into free slots
         for req, slot in self.scheduler.admit():
@@ -1205,6 +1420,14 @@ class InferenceEngine:
             occ = len(slots) / self.cache.n_slots
             self.occupancy.append(occ)
             self._m_occ.set(occ)
+            if self._paged and (self.cache.pages_shared
+                                or self.cache.cow_guard):
+                for s in slots:
+                    pos = int(self.cache.positions[s])
+                    if self.cache.pages_shared:
+                        self.cache.ensure_writable(s, pos, 1)
+                    if self.cache.cow_guard:
+                        self.cache.assert_writable(s, pos, 1)
             try:
                 with self._tr.span("serve_decode"):
                     # _last_tokens is mutated in place per emitted token,
@@ -1293,9 +1516,13 @@ class InferenceEngine:
                                engine=self.instance, slot=slot,
                                tokens=len(req.tokens))
                 self._maybe_retire(req, tok, now)
-        # 3) leak sweep: a slot owned by nobody can never be retired
-        # through the request path — reclaim it so the pool cannot
-        # starve (cheap: one int comparison in the healthy case)
+        return self._leak_sweep(produced)
+
+    def _leak_sweep(self, produced):
+        """Leak sweep (end of every decode iteration): a slot owned by
+        nobody can never be retired through the request path — reclaim
+        it so the pool cannot starve (cheap: one int comparison in the
+        healthy case)."""
         if (self.watchdog
                 and self.cache.n_active != len(self.scheduler.running)):
             reclaimed = self.scheduler.reconcile()
@@ -1306,6 +1533,209 @@ class InferenceEngine:
                     f"slot reconcile: reclaimed {reclaimed} leaked KV "
                     "slot(s)")
         return produced
+
+    def _spec_gate(self):
+        """Adaptive speculation gate: True -> run the verify window
+        this iteration.  With no threshold configured speculation is
+        unconditional; otherwise fall back to plain decode while the
+        accepted-tokens-per-iteration EWMA sags below it, re-probing
+        every ``spec_probe_every`` iterations so recovered acceptance
+        re-enables speculation.  The fallback runs the SAME shared
+        step executable as the non-speculative twin, so the floor is
+        plain-decode throughput minus probe overhead — a slope, never
+        a cliff."""
+        if self._spec_min_accept is None:
+            return True
+        if self._spec_accept_ewma >= self._spec_min_accept:
+            self._spec_since_probe = 0
+            return True
+        self._spec_since_probe += 1
+        if self._spec_since_probe >= self._spec_probe_every:
+            self._spec_since_probe = 0
+            return True
+        return False
+
+    def _step_speculative(self):
+        """One speculative iteration: the draft proposes ``spec_k``
+        candidates per slot, ONE fused verify step teacher-forces the
+        whole ``[S, W]`` window (W = spec_k + 1, the PR 6 replay path
+        widened), and the host commits the accepted prefix — bitwise
+        the tokens the plain decode loop would have emitted, in fewer
+        dispatches.  Rejected rows need no device rollback: they sit
+        beyond the committed position, exactly the stale rows the
+        ``col <= position`` mask never attends, and the next write at
+        those positions overwrites them (``kv_cache.advance_by``).
+        Failover replay slots spend their known continuation as window
+        candidates first, so replay accepts at full width and stays
+        bit-exact mid-speculation."""
+        produced = 0
+        live = len(self.scheduler.running)
+        if live:
+            self.peak_active = max(self.peak_active, live)
+            self.peak_live_tokens = max(self.peak_live_tokens,
+                                        int(self.cache.positions.sum()))
+        slots = [s for s in self.scheduler.active_slots()
+                 if s not in self._prefilling]
+        if not slots:
+            return self._leak_sweep(produced)
+        kk = self._spec_k
+        window = kk + 1
+        n = self.cache.n_slots
+        active = np.zeros(n, bool)
+        active[slots] = True
+        akey = active.tobytes()
+        if self._dev_active[0] != akey:
+            self._dev_active = (akey, self._dev_put(active))
+        dev_active = self._dev_active[1]
+        occ = len(slots) / n
+        self.occupancy.append(occ)
+        self._m_occ.set(occ)
+        if self._dev_sampling is None:
+            self._dev_sampling = (self._dev_put(self._temps.copy()),
+                                  self._dev_put(self._topks.copy()),
+                                  self._dev_put(self._seeds.copy()))
+        temps, topks, seeds = self._dev_sampling
+        # window candidates: replay remainder first (failover — the
+        # stream continuation is KNOWN and accepts by construction),
+        # then draft proposals
+        rems = {}
+        need_draft = False
+        for s in slots:
+            req = self.scheduler.running[s]
+            rem = ([] if req.replay is None else
+                   [int(t) for t in req.replay[
+                       req._replay_pos:req._replay_pos + kk]])
+            rems[s] = rem
+            if len(rem) < kk:
+                need_draft = True
+        props = None
+        try:
+            if self._draft is not None:
+                work = []
+                for s in slots:
+                    req = self.scheduler.running[s]
+                    dp = int(self._draft.pos[s])
+                    p = int(req.prompt.size)
+                    if dp < p:
+                        cat = ([int(t) for t in req.prompt[dp:]]
+                               + list(req.tokens))
+                    else:
+                        cat = list(req.tokens[dp - p:])
+                    work.append((s, cat))
+                props = self._draft.propose(work, temps, topks, seeds)
+            elif need_draft:
+                props = np.asarray(self._draft_fn(
+                    self.params, self.cache.k, self.cache.v,
+                    self._dev_put(self._last_tokens.copy()),
+                    self.cache.device_positions(),
+                    self.cache.device_block_tables(),
+                    temps, topks, seeds))
+        except Exception as e:
+            if not self.watchdog:
+                raise
+            self._quarantine_all(
+                f"speculative draft raised {type(e).__name__}: {e}",
+                self._now())
+            return produced
+        toks = np.zeros((n, window), np.int32)
+        toks[:, 0] = self._last_tokens
+        for s in slots:
+            cand = list(rems[s])
+            if props is not None:
+                cand += [int(props[s, i]) for i in range(len(cand), kk)]
+                d = kk - len(rems[s])
+                if d > 0:
+                    self.spec_proposed += d
+                    self._m_spec_proposed.inc(d)
+            else:
+                cand += [0] * (kk - len(cand))
+            toks[s, 1:] = cand
+            pos = int(self.cache.positions[s])
+            if self.cache.pages_shared:
+                self.cache.ensure_writable(s, pos, window)
+            if self.cache.cow_guard:
+                self.cache.assert_writable(s, pos, window)
+        try:
+            with self._tr.span("serve_decode"):
+                k, v, picks, oks = self._verify_fn(
+                    self.params, self.cache.k, self.cache.v,
+                    self._dev_put(toks), self.cache.device_positions(),
+                    self.cache.device_block_tables(), dev_active,
+                    temps, topks, seeds)
+                self.cache.update(k, v)
+                picks = np.asarray(picks)
+                oks = np.asarray(oks)
+        except Exception as e:
+            if not self.watchdog:
+                raise
+            self._quarantine_all(
+                f"speculative verify raised {type(e).__name__}: {e}",
+                self._now())
+            return produced
+        self.decode_steps += 1
+        self.spec_steps += 1
+        self._m_decode_iters.inc()
+        now = self._now()
+        total_m = 0
+        for s in slots:
+            req = self.scheduler.running[s]
+            r = len(rems[s])
+            m = 0
+            finished = False
+            for j in range(window):
+                if self.watchdog and not oks[s, j]:
+                    self.watchdog_trips += 1
+                    self._m_watchdog.inc()
+                    warnings.warn(
+                        f"decode watchdog: non-finite logits in slot "
+                        f"{s} (request {req.rid}) — quarantined")
+                    self._rt.event(req.rid, "watchdog_trip",
+                                   engine=self.instance, slot=s,
+                                   why="nonfinite_decode")
+                    self._fl.incident(
+                        "watchdog", rid=req.rid,
+                        extra={"engine": self.instance, "slot": s,
+                               "why": "non-finite decode logits"})
+                    self._finalize_active(req, "error", now)
+                    finished = True
+                    break
+                forced = req.next_replay()
+                if forced is not None:
+                    tok = int(forced)
+                    self._last_tokens[s] = tok
+                    self._absorb_replay(req, tok)
+                else:
+                    tok = int(picks[s, j])
+                    self._last_tokens[s] = tok
+                    self._emit(req, tok, now)
+                    produced += 1
+                m += 1
+                done_eos = (req.eos_id is not None
+                            and tok == req.eos_id)
+                if done_eos or len(req.tokens) >= req.max_new:
+                    self._finalize_active(
+                        req, "eos" if done_eos else "max_new", now)
+                    finished = True
+                    break
+                # the chain rule: window step j+1 fed candidate
+                # toks[s, j+1]; its pick is the stream continuation iff
+                # that candidate IS the token just committed
+                if j + 1 < window and int(toks[s, j + 1]) == tok:
+                    if j >= r:      # a draft-origin candidate survived
+                        self.spec_accepted += 1
+                        self._m_spec_accepted.inc()
+                    continue
+                break
+            total_m += m
+            if not finished:
+                self.cache.advance_by(s, m)
+                self._rt.event(req.rid, "decode_iter",
+                               engine=self.instance, slot=s,
+                               tokens=len(req.tokens), spec=m)
+        mean_m = total_m / len(slots)
+        self._spec_accept_ewma += 0.25 * (mean_m
+                                          - self._spec_accept_ewma)
+        return self._leak_sweep(produced)
 
     def run(self, max_iterations=None):
         """Step until queue and slots drain; returns iterations used."""
@@ -1362,6 +1792,9 @@ class InferenceEngine:
         self.slot_leaks_reclaimed = 0
         self.streams_detached = 0
         self.replayed_tokens = 0
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     # -- reporting ---------------------------------------------------------
     def stats(self):
@@ -1387,6 +1820,21 @@ class InferenceEngine:
                 "trace_counts": self.trace_counts}
         if self._paged:
             out["pages"] = self.cache.occupancy()
+        if self._spec_k:
+            prop = self.spec_proposed
+            out["spec"] = {
+                "k": self._spec_k,
+                "draft": ("model" if self._draft is not None
+                          else f"self[{self._draft_layers}]"),
+                "steps": self.spec_steps,
+                "proposed": prop,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": (round(self.spec_accepted / prop, 4)
+                                    if prop else 0.0),
+                "accepted_per_step_ewma": round(
+                    self._spec_accept_ewma, 4)}
+        if self.prefix_cache is not None:
+            out["prefix"] = self.prefix_cache.stats()
         if self.mesh is not None:
             out["mesh"] = {
                 "tp": self._tp,

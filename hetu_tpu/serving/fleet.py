@@ -321,6 +321,10 @@ class EngineFleet:
             "Submits refused with FleetUnavailable")
         self._rt = _telemetry.get_request_trace()
         self._fl = _telemetry.get_flight()
+        # multi-replica-per-chip param sharing: one placed copy of the
+        # weights per device, every co-resident replica reads it —
+        # device -> (placed pytree, HBM ledger handle, pool="params")
+        self._param_store = {}
         self._replicas = [self._make_replica(i) for i in range(n_engines)]
         self.start()
 
@@ -328,6 +332,28 @@ class EngineFleet:
     def _instance_name(self, index, incarnation):
         base = f"{self.replica_prefix}{index}"
         return base if incarnation == 0 else f"{base}.{incarnation}"
+
+    def _shared_params(self, dev):
+        """One placed copy of the weights per device, shared by every
+        replica pinned there (and by every incarnation across
+        restarts): N co-resident replicas cost 1x params HBM, not Nx.
+        The copy is ledger-accounted once under ``pool="params"`` — the
+        kv pools stay per-replica, so the incident-dump HBM view shows
+        exactly what is deduplicated and what is not."""
+        ent = self._param_store.get(dev)
+        if ent is None:
+            if dev is None:
+                placed = self._executor.params
+            else:
+                import jax
+                placed = {k: jax.device_put(v, dev)
+                          for k, v in self._executor.params.items()}
+            nbytes = sum(int(v.nbytes) for v in placed.values())
+            handle = _telemetry.get_hbm_ledger().alloc(
+                "params", nbytes,
+                owner=f"fleet:{self.name}:params:{dev or 'host'}")
+            ent = self._param_store[dev] = (placed, handle)
+        return ent[0]
 
     def _build_engine(self, index, incarnation):
         if self._meshes is not None:
@@ -337,7 +363,14 @@ class EngineFleet:
             # compile-once cache keyed on device ids still hits)
             pin = dict(mesh=self._meshes[index % len(self._meshes)])
         else:
-            pin = dict(device=self._devices[index % len(self._devices)])
+            # single-device pinning: the replica reads the fleet's
+            # per-device shared copy of the params instead of placing
+            # its own (engine_factory overrides — embedding fleets —
+            # keep their own placement path)
+            dev = self._devices[index % len(self._devices)]
+            pin = dict(device=dev)
+            if self._engine_factory is InferenceEngine:
+                pin["shared_params"] = self._shared_params(dev)
         return self._engine_factory(
             self._executor, self._model,
             instance=self._instance_name(index, incarnation),
@@ -462,13 +495,30 @@ class EngineFleet:
         return [r for r in self._replicas
                 if r.health.dispatchable and r.engine is not None]
 
-    def _choose(self, prefer_not=None, exclude=()):
+    def _choose(self, prefer_not=None, exclude=(), prompt=None):
         cands = [r for r in self._candidates() if r.name not in exclude]
         if not cands:
             return None
         if prefer_not is not None and len(cands) > 1:
             others = [r for r in cands if r.name != prefer_not]
             cands = others or cands
+        if prompt is not None and len(cands) > 1:
+            # prefix-affinity tie-break: prefix caches are per-replica
+            # (page ids are pool-local), so a prompt whose prefix some
+            # replica already holds prefills fastest THERE — route to
+            # the longest hit unless that replica is meaningfully more
+            # loaded (>2x the best latency score; load still wins)
+            hits, floor = {}, None
+            for r in cands:
+                fn = getattr(r.engine, "prefix_hit_tokens", None)
+                hits[r.name] = int(fn(prompt)) if fn is not None else 0
+            if any(hits.values()):
+                floor = 2.0 * min(self._score(r) for r in cands)
+                best = max(hits.values())
+                warm = [r for r in cands
+                        if hits[r.name] == best
+                        and self._score(r) <= floor]
+                cands = warm or cands
         return min(cands,
                    key=lambda r: (self._score(r), r.dispatches, r.name))
 
@@ -576,7 +626,8 @@ class EngineFleet:
         parking) out of the client-facing refusal counter."""
         tried, last_overload = set(), None
         for _ in range(len(self._replicas)):
-            rep = self._choose(prefer_not=prefer_not, exclude=tried)
+            rep = self._choose(prefer_not=prefer_not, exclude=tried,
+                               prompt=freq.prompt)
             if rep is None:
                 break
             try:

@@ -41,6 +41,8 @@ for the same reason stale slot rows were: the attention mask is still
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -49,6 +51,13 @@ import jax.numpy as jnp
 
 def ceil_div(a, b):
     return -(-int(a) // int(b))
+
+
+@jax.jit
+def _copy_page(pool, src, dst):
+    """Device-side page copy for copy-on-write forks: duplicate page
+    ``src`` into page ``dst`` without a host round-trip."""
+    return pool.at[dst].set(pool[src])
 
 
 def gather_pages(pool, block_tables):
@@ -70,7 +79,16 @@ def scatter_rows(pool, pages, offsets, rows):
     offsets[i])``.  Duplicate (page, offset) pairs only ever occur on
     the sentinel page 0 (inactive/padding lanes), where write order is
     irrelevant; live (page, offset) pairs are distinct by construction
-    of the allocator."""
+    of the allocator.
+
+    Shared pages (refcount > 1) are read-only: a scatter into one would
+    leak state between every request holding it.  The page indices here
+    are tracers, so the invariant is enforced host-side — the engine
+    computes the exact (slot, row-range) write set of every dispatch and
+    runs it through :meth:`PagedKVCache.assert_writable` when the CoW
+    write-guard is armed (``HETU_COW_GUARD=1``, on in the test suite),
+    after :meth:`PagedKVCache.ensure_writable` has had its chance to
+    fork divergent writers off shared pages."""
     return pool.at[pages, :, :, offsets, :].set(rows)
 
 
@@ -112,12 +130,18 @@ class SlotKVCache:
     def n_active(self):
         return self.n_slots - len(self._free)
 
-    def alloc(self, owner=None, n_tokens=None):
+    def alloc(self, owner=None, n_tokens=None, shared=None):
         """Claim a free slot (lowest id first); None when the pool is
         exhausted — admission control, not an error.  ``n_tokens`` (the
         paged pool's worst-case reservation) is accepted and ignored:
-        every dense slot already holds a full ``max_len`` span."""
+        every dense slot already holds a full ``max_len`` span.
+        ``shared`` (page-granular prefix sharing) is a paged-pool
+        concept and must stay empty here."""
         del n_tokens
+        if shared:
+            raise ValueError(
+                "SlotKVCache has no pages to share; prefix caching "
+                "requires the paged pool")
         if not self._free:
             return None
         slot = self._free.pop()
@@ -200,7 +224,8 @@ class PagedKVCache:
 
     def __init__(self, n_slots, layers, kv_heads, page_len, head_dim,
                  max_len=128, n_pages=None, dtype=jnp.float32,
-                 label=None, shards=1, put_sharding=None):
+                 label=None, shards=1, put_sharding=None,
+                 cow_guard=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if page_len < 1:
@@ -262,6 +287,20 @@ class PagedKVCache:
         self.free_count = 0
         self.page_alloc_count = 0
         self.page_free_count = 0
+        self.cow_fork_count = 0
+        # CoW write-guard: when armed, the engine routes every
+        # dispatch's write set through assert_writable so a scatter
+        # aimed at a shared page fails loudly instead of silently
+        # corrupting every request holding it.  Debug mode — on in the
+        # test suite (HETU_COW_GUARD=1), off by default in production.
+        if cow_guard is None:
+            cow_guard = os.environ.get("HETU_COW_GUARD", "") not in ("", "0")
+        self.cow_guard = bool(cow_guard)
+        # optional page reclaimer (a PrefixCache eviction hook): called
+        # by alloc() when pages run short with the shortfall, returns
+        # the number of pages it released — interned-but-idle prefixes
+        # yield to live admissions before admission refuses.
+        self.reclaim = None
         from .. import telemetry
         self._hbm_handle = telemetry.get_hbm_ledger().alloc(
             "kv_cache",
@@ -280,6 +319,11 @@ class PagedKVCache:
             "hetu_serving_page_churn_total",
             "KV page allocations + releases, by pool — allocation "
             "traffic, the page-level analogue of slot alloc/free",
+            labels=("pool",))
+        self._c_cow = reg.counter(
+            "hetu_serving_prefix_cow_forks_total",
+            "Copy-on-write page forks, by pool: a slot diverged inside "
+            "a shared prefix page and was given a private copy",
             labels=("pool",))
         self._flight = telemetry.get_flight()
         self._flight.register_pages(self.label, self.occupancy)
@@ -302,6 +346,13 @@ class PagedKVCache:
     def pages_free(self):
         return len(self._free_pages)
 
+    @property
+    def pages_shared(self):
+        """Pages currently held by more than one owner (refcount > 1) —
+        prefix-cache sharing in flight.  Zero means every live page is
+        private and the CoW machinery is fully idle."""
+        return int((self._ref > 1).sum())
+
     def _sync_gauges(self):
         self._g_active.labels(pool=self.label).set(self.pages_active)
         self._g_free.labels(pool=self.label).set(self.pages_free)
@@ -316,28 +367,60 @@ class PagedKVCache:
         self._c_churn.labels(pool=self.label).inc()
         return page
 
-    def alloc(self, owner=None, n_tokens=None):
+    def alloc(self, owner=None, n_tokens=None, shared=None):
         """Claim a free slot AND reserve every page its span needs.
 
         ``n_tokens`` is the request's worst-case token span
-        (prompt + max_new); reserving ``ceil(n_tokens / page_len)``
-        pages up front means admission is the only place a request can
-        be refused — no mid-flight page exhaustion, no preemption.
-        Returns None (admission control, not an error) when either
-        slots or pages are short."""
+        (prompt + max_new, plus any speculative lookahead); reserving
+        ``ceil(n_tokens / page_len)`` pages up front means admission is
+        the only place a request can be refused — no mid-flight page
+        exhaustion, no preemption.  Returns None (admission control,
+        not an error) when either slots or pages are short.
+
+        ``shared`` is an optional sequence of already-filled page ids
+        (a prefix-cache hit): they are mapped into the front of the
+        slot's table with their refcount bumped — read-only until a
+        copy-on-write fork — and count toward the reservation, so a
+        prefix hit makes admission CHEAPER, never changes its shape."""
         n_tokens = self.max_len if n_tokens is None else int(n_tokens)
         if n_tokens < 1 or n_tokens > self.max_len:
             raise ValueError(
                 f"n_tokens must be in [1, max_len={self.max_len}], "
                 f"got {n_tokens}")
         need = ceil_div(n_tokens, self.page_len)
-        if not self._free_slots or need > len(self._free_pages):
+        shared = list(shared) if shared else []
+        if len(shared) > need:
+            raise ValueError(
+                f"{len(shared)} shared pages exceed the {need}-page "
+                f"reservation for n_tokens={n_tokens}")
+        need_private = need - len(shared)
+        if not self._free_slots:
             return None
+        # pin the shared pages FIRST: the reclaim hook below may evict
+        # the very prefix-cache entry whose pages this hit is about to
+        # map, and the extra reference keeps them alive through it
+        shared = [int(p) for p in shared]
+        for page in shared:
+            if self._ref[page] < 1:
+                raise RuntimeError(
+                    f"shared page {page} has refcount 0 (evicted "
+                    f"between lookup and alloc?)")
+            self._ref[page] += 1
+        while need_private > len(self._free_pages):
+            short = need_private - len(self._free_pages)
+            if self.reclaim is None or not self.reclaim(short):
+                self.release_pages(shared)   # unpin the refused hit
+                return None
         slot = self._free_slots.pop()
         self._owner[slot] = owner
         self.positions[slot] = 0
         self.capacity[slot] = need * self.page_len
-        for _ in range(need):
+        for i, page in enumerate(shared):
+            self._slot_pages[slot].append(page)
+            self.block_tables[slot, i] = page
+        if shared:
+            self._dev_tables = None
+        for _ in range(need_private):
             self._take_page(slot)
         self.alloc_count += 1
         self._sync_gauges()
@@ -393,6 +476,114 @@ class PagedKVCache:
         self.capacity[dst] = n_pages * self.page_len
         self._sync_gauges()
 
+    def slot_pages(self, slot):
+        """The pages ``slot`` currently maps, in logical order."""
+        return tuple(self._slot_pages[int(slot)])
+
+    def retain_pages(self, pages):
+        """Bump the refcount of ``pages`` on behalf of a slot-less
+        owner (the prefix cache interning a finished prompt's prefix):
+        the pages survive the writing slot's retirement and stay mapped
+        until :meth:`release_pages`."""
+        for page in pages:
+            page = int(page)
+            if self._ref[page] < 1:
+                raise RuntimeError(
+                    f"cannot retain page {page}: refcount is 0")
+            self._ref[page] += 1
+
+    def release_pages(self, pages):
+        """Drop one reference from each of ``pages`` (prefix-cache
+        eviction); pages whose count hits 0 return to the free list."""
+        freed = 0
+        for page in pages:
+            page = int(page)
+            if self._ref[page] < 1:
+                raise RuntimeError(
+                    f"page {page} refcount underflow (double release)")
+            self._ref[page] -= 1
+            if self._ref[page] == 0:
+                self._free_pages.append(page)
+                self.page_free_count += 1
+                self._c_churn.labels(pool=self.label).inc()
+                freed += 1
+        self._sync_gauges()
+        return freed
+
+    def fork_page(self, slot, page_index):
+        """Copy-on-write: give ``slot`` a private copy of the shared
+        page at logical index ``page_index`` (device-side page copy +
+        block-table rewrite).  No-op when the page is already private.
+        Raises when the free list is empty — the engine's admission
+        reservation makes that unreachable for the page-aligned prefix
+        flow (writes start past the shared span); direct partial-page
+        ``share_pages`` users own the headroom."""
+        slot, page_index = int(slot), int(page_index)
+        page = self._slot_pages[slot][page_index]
+        if self._ref[page] <= 1:
+            return page
+        if not self._free_pages:
+            raise RuntimeError(
+                f"no free page for copy-on-write fork of page {page} "
+                f"(slot {slot}); reserve fork headroom when sharing "
+                f"partial pages")
+        new = self._free_pages.pop()
+        self._ref[new] = 1
+        self.page_alloc_count += 1
+        self._c_churn.labels(pool=self.label).inc()
+        self.k = _copy_page(self.k, page, new)
+        self.v = _copy_page(self.v, page, new)
+        self._ref[page] -= 1          # was > 1, never hits 0 here
+        self._slot_pages[slot][page_index] = new
+        self.block_tables[slot, page_index] = new
+        self._dev_tables = None
+        self.cow_fork_count += 1
+        self._c_cow.labels(pool=self.label).inc()
+        self._sync_gauges()
+        return new
+
+    def _write_page_indices(self, slot, row0, n_rows):
+        slot, row0, n_rows = int(slot), int(row0), int(n_rows)
+        if n_rows < 1:
+            return slot, range(0)
+        held = len(self._slot_pages[slot])
+        p0 = max(0, row0 // self.page_len)
+        p1 = min(held - 1, (row0 + n_rows - 1) // self.page_len)
+        return slot, range(p0, p1 + 1)
+
+    def ensure_writable(self, slot, row0, n_rows=1):
+        """Fork every shared page that rows ``[row0, row0 + n_rows)``
+        of ``slot`` would touch — the first divergent write after a
+        prefix share lands in a private copy.  Returns the number of
+        forks performed (0 on the fast path: nothing shared)."""
+        if self.pages_shared == 0:
+            return 0
+        forks = 0
+        slot, prange = self._write_page_indices(slot, row0, n_rows)
+        for pi in prange:
+            if self._ref[self._slot_pages[slot][pi]] > 1:
+                self.fork_page(slot, pi)
+                forks += 1
+        return forks
+
+    def assert_writable(self, slot, row0, n_rows=1):
+        """CoW write-guard (armed via ``HETU_COW_GUARD=1``, on in the
+        test suite): raise if rows ``[row0, row0 + n_rows)`` of
+        ``slot`` map any page with refcount > 1.  The jitted programs'
+        page indices are tracers, so the read-only contract on shared
+        pages is enforced here, at the host-side dispatch boundary,
+        with the exact write set each dispatch is about to scatter."""
+        slot, prange = self._write_page_indices(slot, row0, n_rows)
+        for pi in prange:
+            page = self._slot_pages[slot][pi]
+            if self._ref[page] > 1:
+                raise AssertionError(
+                    f"write to SHARED page {page} (refcount="
+                    f"{int(self._ref[page])}) by slot {slot}: rows "
+                    f"[{int(row0)}, {int(row0) + int(n_rows)}) overlap "
+                    f"logical page {pi}; fork before writing "
+                    f"(ensure_writable) or share fewer pages")
+
     def owner(self, slot):
         return self._owner[slot]
 
@@ -431,6 +622,8 @@ class PagedKVCache:
                                 if usable else 0.0),
                 "internal_fragmentation": (round(1.0 - used / reserved, 4)
                                            if reserved else 0.0),
+                "pages_shared": self.pages_shared,
+                "cow_forks": self.cow_fork_count,
                 "page_churn": self.page_alloc_count + self.page_free_count}
 
     # -- step plumbing -----------------------------------------------------
@@ -465,6 +658,24 @@ class PagedKVCache:
                     f"slot {s} overran its reserved capacity="
                     f"{int(self.capacity[s])} (page_len={self.page_len})")
             self.positions[s] += 1
+
+    def advance_by(self, slot, n):
+        """Bump ``slot``'s write position by ``n`` rows at once — the
+        speculative verify step commits 1..k+1 accepted tokens per
+        iteration.  Rows written beyond the committed span (rejected
+        speculative tokens) are simply never advanced over: the
+        ``col <= position`` mask keeps them unattendable and the next
+        write at those positions overwrites them — host-side block-table
+        state IS the rollback, no device work needed."""
+        slot, n = int(slot), int(n)
+        if n < 0:
+            raise ValueError(f"advance_by needs n >= 0, got {n}")
+        if self.positions[slot] + n > self.capacity[slot]:
+            raise RuntimeError(
+                f"slot {slot} would overrun its reserved capacity="
+                f"{int(self.capacity[slot])} (position="
+                f"{int(self.positions[slot])}, advance {n})")
+        self.positions[slot] += n
 
     def update(self, k, v):
         """Adopt the cache arrays a jitted step returned."""

@@ -1,0 +1,163 @@
+"""Fleet-wide prefix caching over shared KV pages.
+
+A shared prompt prefix is just shared PAGES: when a prompt finishes
+prefill, its page-aligned prefixes are interned — the cache takes a
+refcount on the slot's leading pages (``PagedKVCache.retain_pages``),
+so they survive the writing slot's retirement.  A later prompt that
+starts with an interned prefix has those pages mapped straight into
+its new slot at admission (``alloc(shared=...)``): prefill starts
+AFTER the shared span, the skipped rows are read through the gathered
+block table, and TTFT drops by the skipped chunks.
+
+Safety comes from three mechanisms layered on the refcounts:
+
+* read-only by refcount — a page with refcount > 1 is never written;
+  engine write-sets start past the shared span by construction, the
+  host-side CoW guard (``HETU_COW_GUARD=1``, on in tests) asserts it
+  at every dispatch, and ``ensure_writable`` forks a private copy
+  (copy-on-write) if a divergent write ever does overlap.
+* interning caps at ``prompt_len - 1`` tokens, so the final prompt row
+  — the one whose logits seed the first generated token — is always
+  computed by the admitted request itself, with its own sampling
+  lanes.  Zero cross-request contamination: shared pages are a pure
+  read-side dedup of identical (token, position) KV rows.
+* eviction is LRU and *cooperative*: the pool's ``reclaim`` hook asks
+  the cache to release entries only when an allocation is short of
+  pages, so idle retained pages never refuse admission.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+
+
+class PrefixCache:
+    """Page-granular prompt-prefix interning over one ``PagedKVCache``.
+
+    One instance serves one pool (page ids are pool-local); a fleet
+    enables one per replica and routes prefix-heavy requests to the
+    replica reporting the longest hit (``EngineFleet`` tie-break).
+    """
+
+    def __init__(self, pool, max_entries=64):
+        if not hasattr(pool, "retain_pages"):
+            raise TypeError(
+                "PrefixCache requires a PagedKVCache (shared prefixes "
+                "are shared pages)")
+        self.pool = pool
+        self.max_entries = int(max_entries)
+        if self.max_entries < 1:
+            raise ValueError(
+                f"max_entries must be >= 1, got {self.max_entries}")
+        # token-bytes of the prefix -> (pages tuple, n_tokens); insert
+        # order is the LRU order (move_to_end on every hit)
+        self._entries = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.interned = 0
+        self.evicted = 0
+        self._c_hits = _telemetry.get_registry().counter(
+            "hetu_serving_prefix_hits_total",
+            "Prefix-cache hits at admission (prompts whose leading "
+            "pages were shared instead of re-prefilled)",
+            labels=("pool",)).labels(pool=self.pool.label)
+        # cooperative eviction: the pool calls back with its page
+        # shortfall when an allocation comes up short
+        pool.reclaim = self._reclaim
+
+    # -- internals ---------------------------------------------------------
+    def _max_pages(self, prompt):
+        """Shareable page count: whole pages only, capped one token
+        short of the prompt so the final row (the one that seeds the
+        first generated token) is always computed by the request."""
+        return (int(prompt.size) - 1) // self.pool.page_len
+
+    def _evict_lru(self):
+        key, (pages, _) = self._entries.popitem(last=False)
+        self.evicted += 1
+        return self.pool.release_pages(pages)
+
+    def _reclaim(self, short):
+        """Pool shortfall hook: evict LRU entries until ``short`` pages
+        actually returned to the free list (an entry whose pages are
+        still mapped by running slots frees nothing yet — its refcounts
+        just drop to the holders').  Returns the pages freed; 0 tells
+        the allocator to give up and refuse admission."""
+        freed = 0
+        while freed < int(short) and self._entries:
+            freed += self._evict_lru()
+        return freed
+
+    # -- admission-side API ------------------------------------------------
+    def lookup(self, prompt):
+        """Longest interned page-prefix of ``prompt``: returns
+        ``(pages, n_tokens)`` to map into the admitted slot, or None.
+        The scheduler calls this at admission (``prefix_lookup``)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pl = self.pool.page_len
+        for p in range(self._max_pages(prompt), 0, -1):
+            ent = self._entries.get(prompt[:p * pl].tobytes())
+            if ent is not None:
+                self._entries.move_to_end(prompt[:p * pl].tobytes())
+                self.hits += 1
+                self._c_hits.inc()
+                return list(ent[0]), int(ent[1])
+        self.misses += 1
+        return None
+
+    def hit_tokens(self, prompt):
+        """Length (tokens) of the longest interned prefix — the fleet's
+        routing tie-break.  Pure peek: no counters, no LRU bump."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pl = self.pool.page_len
+        for p in range(self._max_pages(prompt), 0, -1):
+            if prompt[:p * pl].tobytes() in self._entries:
+                return p * pl
+        return 0
+
+    def intern(self, prompt, slot):
+        """Intern every page-aligned prefix of ``prompt`` from the
+        pages ``slot`` holds after its prefill finished.  Idempotent
+        per prefix (an already-interned one is just LRU-bumped); each
+        new entry retains its pages so they outlive the slot."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        held = self.pool.slot_pages(slot)
+        pl = self.pool.page_len
+        n = min(len(held), self._max_pages(prompt))
+        for p in range(1, n + 1):
+            key = prompt[:p * pl].tobytes()
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            pages = tuple(int(x) for x in held[:p])
+            self.pool.retain_pages(pages)
+            self._entries[key] = (pages, p * pl)
+            self.interned += 1
+            while len(self._entries) > self.max_entries:
+                self._evict_lru()
+
+    # -- reporting / lifecycle ---------------------------------------------
+    def stats(self):
+        total = self.hits + self.misses
+        return {"entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (round(self.hits / total, 4) if total
+                             else 0.0),
+                "interned": self.interned,
+                "evicted": self.evicted,
+                "pages_retained": sum(len(pages) for pages, _
+                                      in self._entries.values()),
+                "cow_forks": self.pool.cow_fork_count}
+
+    def close(self):
+        """Release every retained page (so the pool's page audit
+        balances after a drain) and unhook from the pool.  Idempotent."""
+        while self._entries:
+            self._evict_lru()
+        if self.pool.reclaim is self._reclaim:
+            self.pool.reclaim = None

@@ -199,7 +199,8 @@ class Scheduler:
 
     def __init__(self, cache, prefill_budget=2, gang=False,
                  max_queue=None, low_watermark=None,
-                 shed_policy="reject_newest", rid_prefix=None):
+                 shed_policy="reject_newest", rid_prefix=None,
+                 lookahead=0):
         if prefill_budget < 1:
             raise ValueError(
                 f"prefill_budget must be >= 1, got {prefill_budget}")
@@ -227,6 +228,18 @@ class Scheduler:
                     f"low_watermark={self.low_watermark} must be in "
                     f"[0, max_queue={self.max_queue})")
         self.shed_policy = shed_policy
+        # speculative lookahead: extra per-request token reservation so
+        # a verify window (k candidates past the newest position) can
+        # never scatter outside the slot's pages — admission stays the
+        # only refusal point (engine passes spec_k)
+        self.lookahead = int(lookahead)
+        if self.lookahead < 0:
+            raise ValueError(
+                f"lookahead must be >= 0, got {self.lookahead}")
+        # prefix-cache hook (engine-installed): prompt -> (pages,
+        # n_tokens) of an interned prefix to share into the new slot,
+        # or None on a miss
+        self.prefix_lookup = None
         self.queue = deque()
         self.running = {}           # slot -> Request
         self.admitted_order = []    # rids in prefill order (FIFO witness)
@@ -367,12 +380,21 @@ class Scheduler:
                     and used_tokens + int(req.prompt.size) > token_budget
                     and out):
                 break   # FIFO: don't skip ahead past a too-long prompt
+            shared, shared_tokens = None, 0
+            if self.prefix_lookup is not None:
+                hit = self.prefix_lookup(req.prompt)
+                if hit is not None:
+                    shared, shared_tokens = hit
+            alloc_kw = {"shared": shared} if shared is not None else {}
             slot = self.cache.alloc(owner=req.rid,
                                     n_tokens=(int(req.prompt.size)
-                                              + req.max_new))
+                                              + req.max_new
+                                              + self.lookahead),
+                                    **alloc_kw)
             if slot is None:
                 break
-            used_tokens += int(req.prompt.size)
+            req.prefix_tokens = shared_tokens
+            used_tokens += int(req.prompt.size) - shared_tokens
             self.queue.popleft()
             req.slot = slot
             self.running[slot] = req

@@ -1,0 +1,387 @@
+"""Speculative decoding over the paged serving engine.
+
+A cheap DRAFT proposes ``k`` tokens per iteration; the target engine
+verifies all of them in ONE fused teacher-forced step — the PR 6 replay
+path widened to a ``[S, k+1]`` window — and commits the longest prefix
+the target itself would have emitted:
+
+* :func:`make_verify_fn` builds the fused verification program.  It
+  feeds the window ``toks[:, j]`` at ``positions + j`` through the SAME
+  slot-batched ``adapter.decode`` math as the one-token step program
+  (gather pages once, carry the contiguous caches across the unrolled
+  window, batch-scatter every written row back), and runs the SAME
+  ``make_slot_picker`` lanes at consumed-count ``positions + j + 1`` —
+  so ``picks[:, j]`` is bitwise the token the non-speculative twin
+  would have emitted after consuming the first ``j + 1`` window tokens.
+  Greedy acceptance is therefore bitwise prefix-match, and fixed-seed
+  sampled acceptance is the same exact-match test (the picker's
+  ``fold_in(fold_in(key, seed), consumed)`` lanes make the "leftover"
+  sample after a rejection the target's own deterministic draw), which
+  keeps replay-failover bit-exact mid-speculation.
+
+* Rejected tokens need no device rollback.  The verify step writes all
+  ``k + 1`` rows, but the engine only advances a slot's position over
+  the accepted prefix: rows beyond it are exactly the stale rows the
+  ``col <= position`` mask already never attends, and the next write at
+  those positions overwrites them.  Admission reserves the ``k``-token
+  lookahead worst-case (scheduler ``lookahead``), so the window can
+  never scatter past a slot's reservation and admission stays the only
+  refusal point.
+
+Two draft flavors share the proposer surface:
+
+* :class:`SelfDraft` — truncated-layer self-draft: the first
+  ``draft_layers`` blocks of the TARGET model (same params, same page
+  pool, layer-sliced gather) feed the full LM head.  Zero extra
+  parameters, zero extra KV: the draft pass is carry-only and the
+  verify step rewrites every row it touched.
+* :class:`ModelDraft` — an injectable small model through the same
+  adapter surface (``adapter_for``), with its own dense per-slot cache
+  and a fused catchup + propose program: between verify iterations the
+  draft teacher-forces the tokens the target committed, then rolls
+  ``k`` proposals forward — one dispatch per engine iteration.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models._decode_common import make_slot_picker
+from .kv_cache import gather_pages, scatter_rows
+
+
+def _p2(n):
+    n = max(1, int(n))
+    return 1 << (n - 1).bit_length()
+
+
+def make_verify_fn(adapter, pick, window):
+    """The fused verify program: feed ``window`` candidate tokens per
+    slot through the paged decode math in one dispatch.
+
+    Signature (all static shapes)::
+
+        (params, k, v, toks [S, W], positions [S], tables [S, MP],
+         active [S], temps, top_ks, seeds)
+        -> (k, v, picks [S, W], ok [S, W])
+
+    ``picks[:, j]`` is the token the target emits after consuming
+    ``toks[:, :j+1]`` — computed with the identical per-step ops and
+    sampling lanes as the one-token step program, at consumed count
+    ``positions + j + 1``.  All ``W`` written rows land in the slot's
+    reserved pages (inactive lanes scatter into the sentinel page 0);
+    committing or discarding them is purely host-side position
+    bookkeeping."""
+
+    def verify(params, k, v, toks, positions, tables, active,
+               temps, top_ks, seeds):
+        page_len, mp = k.shape[3], tables.shape[1]
+        kc = gather_pages(k, tables)
+        vc = gather_pages(v, tables)
+        picks, oks = [], []
+        for j in range(window):
+            pos_j = positions + j
+            logits, kc, vc = adapter.decode(params, toks[:, j], pos_j,
+                                            kc, vc)
+            oks.append(jnp.all(jnp.isfinite(logits), axis=-1))
+            picks.append(pick(logits, temps, top_ks, seeds,
+                              pos_j + 1).astype(jnp.int32))
+        rows = positions[:, None] + jnp.arange(window)[None, :]  # [S, W]
+        pidx = jnp.clip(rows // page_len, 0, mp - 1)
+        pages = jnp.where(active[:, None],
+                          jnp.take_along_axis(tables, pidx, axis=1), 0)
+        offs = rows % page_len
+        rix = jnp.clip(rows, 0, kc.shape[3] - 1)[:, None, None, :, None]
+        krows = jnp.take_along_axis(kc, rix, axis=3)  # [S, L, KV, W, D]
+        vrows = jnp.take_along_axis(vc, rix, axis=3)
+        s, l, kv, w, d = krows.shape
+        krows = jnp.transpose(krows, (0, 3, 1, 2, 4)).reshape(
+            s * w, l, kv, d)
+        vrows = jnp.transpose(vrows, (0, 3, 1, 2, 4)).reshape(
+            s * w, l, kv, d)
+        k = scatter_rows(k, pages.reshape(-1), offs.reshape(-1), krows)
+        v = scatter_rows(v, pages.reshape(-1), offs.reshape(-1), vrows)
+        picks = jnp.where(active[:, None], jnp.stack(picks, 1), 0)
+        return k, v, picks, jnp.stack(oks, 1)
+
+    return verify
+
+
+def make_self_draft_fn(adapter, pick, k_draft, n_layers):
+    """The truncated-layer self-draft program: roll ``k_draft``
+    proposals forward through the first ``n_layers`` blocks of the
+    target (layer-sliced page gather, carry-only — no pool writes; the
+    verify step rewrites every row for all layers).  The picker runs
+    the same ``(seed, consumed)`` lanes as the target, so a draft deep
+    enough to agree with the target proposes exactly what verify will
+    pick — acceptance degrades gracefully with depth, never
+    correctness."""
+
+    def draft(params, k, v, toks0, positions, tables,
+              temps, top_ks, seeds):
+        kc = gather_pages(k[:, :n_layers], tables)
+        vc = gather_pages(v[:, :n_layers], tables)
+        t = toks0
+        props = []
+        for j in range(k_draft):
+            logits, kc, vc = adapter.decode(params, t, positions + j,
+                                            kc, vc, n_layers=n_layers)
+            t = pick(logits, temps, top_ks, seeds,
+                     positions + j + 1).astype(jnp.int32)
+            props.append(t)
+        return jnp.stack(props, 1)
+
+    return draft
+
+
+class SelfDraft:
+    """Truncated-layer self-draft config: propose with the target's
+    first ``layers`` blocks (default ``max(1, L // 2)``, resolved by
+    the engine).  ``layers == L`` is the degenerate full-depth draft —
+    proposals match the target's picks and acceptance is ~total, which
+    is what the acceptance-friendly bench trace uses to isolate the
+    dispatch-amortization win at zero extra HBM."""
+
+    kind = "self"
+
+    def __init__(self, layers=None):
+        self.layers = None if layers is None else int(layers)
+
+
+class ModelDraft:
+    """Injectable small-model draft over the same adapter surface.
+
+    Owns a dense per-slot cache ``[S, L_d, KV_d, max_len, D_d]`` for
+    the draft model and three host-visible phases, all driven by the
+    engine:
+
+    * :meth:`admit` — deposit the prompt's draft KV (one padded-bucket
+      prefill per admission, traced once).
+    * :meth:`propose` — ONE fused catchup + propose dispatch per engine
+      iteration: each lane teacher-forces the ``cnt`` stream tokens the
+      target committed since last sync (per-lane phase arithmetic with
+      idempotent idle re-feeds keeps the shapes static), then rolls
+      ``k`` proposals forward with the shared sampling lanes.
+    * :meth:`release` — forget a retired slot (its rows go stale, the
+      next admission's prefill overwrites them).
+
+    The draft's speculative rows are overwritten by the next catchup at
+    the same positions before they can ever be attended — the same
+    stale-row invariant the target pool relies on."""
+
+    kind = "model"
+
+    #: shared compiled programs: (adapter type, name, geometry) ->
+    #: {"prefill": fn, "step": fn, "traces": {...}} — ModelDraft
+    #: instances over the same draft model reuse one executable set
+    #: (fleet replicas each attach their own instance).
+    _PROGRAMS = {}
+
+    def __init__(self, executor, model, name="draft"):
+        self.executor = executor
+        self.model = model
+        self.name = str(name)
+        self._attached = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def attach(self, engine):
+        """Size caches + build programs from the target engine's
+        geometry.  One ModelDraft serves one engine (per-slot state);
+        pass a factory (zero-arg callable) as the engine's ``draft=``
+        when replicas each need their own."""
+        if self._attached:
+            raise RuntimeError(
+                "ModelDraft already attached to an engine; use a "
+                "factory (draft=lambda: ModelDraft(...)) for fleets")
+        from .adapters import adapter_for
+        self._attached = True
+        self.adapter = adapter_for(self.model, self.name)
+        self.spec_k = int(engine._spec_k)
+        self.n_slots = int(engine.cache.n_slots)
+        self.max_len = int(engine.max_len)
+        self.p_bucket = _p2(engine.max_prompt_len)
+        cap = self.adapter.position_cap
+        if cap is not None and self.max_len > cap:
+            raise ValueError(
+                f"draft model position cap {cap} < engine "
+                f"max_len={self.max_len}")
+        self.params = self.executor.params
+        if engine.device is not None:
+            self.params = jax.device_put(self.params, engine.device)
+        a = self.adapter
+        shape = (self.n_slots, a.layers, a.kv_heads, self.max_len,
+                 a.head_dim)
+        dtype = self.params[a.embed_param].dtype
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.pos = np.zeros(self.n_slots, np.int32)
+        self._last = np.zeros(self.n_slots, np.int32)
+        from .. import telemetry
+        self._hbm = telemetry.get_hbm_ledger().alloc(
+            "kv_cache", int(self.k.nbytes) + int(self.v.nbytes),
+            owner=f"draft:{self.name}:{id(self):x}")
+        key = (type(a).__name__, a.name, a.layers, a.kv_heads,
+               a.head_dim, self.n_slots, self.max_len, self.p_bucket,
+               self.spec_k, jax.default_backend())
+        progs = ModelDraft._PROGRAMS.get(key)
+        if progs is None:
+            progs = self._build_programs()
+            ModelDraft._PROGRAMS[key] = progs
+        self._prefill = progs["prefill"]
+        self._dstep = progs["step"]
+        self._dcatch = progs["catch"]
+        self._catch_w = progs["catch_w"]
+        self.trace_counts = progs["traces"]
+
+    def _build_programs(self):
+        adapter, kk = self.adapter, self.spec_k
+        window = kk + 1                       # max catchup per sync
+        total = (window - 1) + kk
+        catch_w = 4 * window                  # bulk-catchup bucket
+        pick = make_slot_picker()
+        traces = {"draft_prefill": 0, "draft_step": 0, "draft_catch": 0}
+
+        def dprefill(params, k, v, prompt, slot):
+            traces["draft_prefill"] += 1
+            _, ks, vs = adapter.prefill(params, prompt)
+            k = jax.lax.dynamic_update_slice(k, ks[None],
+                                             (slot, 0, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(v, vs[None],
+                                             (slot, 0, 0, 0, 0))
+            return k, v
+
+        def dstep(params, k, v, cat, cnt, base, temps, top_ks, seeds):
+            # cat [S, W]: the cnt stream tokens committed since last
+            # sync (cat[:, cnt-1] is the newest).  Lane phase at global
+            # step j: i = j - (cnt - 1); i < 0 -> catchup feed
+            # cat[:, j]; i == 0 -> feed the newest stream token;
+            # i >= 1 -> feed the lane's own previous pick (proposal
+            # i-1).  Filler steps past a lane's kk-th proposal
+            # (i >= kk) pin at ONE PAST the last proposal row — always
+            # a speculative row the next catchup overwrites before it
+            # is ever attendable, never the newest real row (clamping
+            # at kk-1 would re-feed a WRONG token onto the last
+            # proposal row, and for kk == 1 onto the newest catchup
+            # row itself).
+            traces["draft_step"] += 1
+            prev = cat[:, 0]
+            picks = []
+            for j in range(total):
+                i = j - (cnt - 1)                             # [S]
+                cat_tok = jnp.take_along_axis(
+                    cat, jnp.minimum(j, cnt - 1)[:, None], axis=1)[:, 0]
+                fed = jnp.where(i <= 0, cat_tok, prev)
+                pos = (base + jnp.minimum(j, cnt - 1)
+                       + jnp.clip(i, 0, kk))
+                logits, k, v = adapter.decode(params, fed, pos, k, v)
+                prev = pick(logits, temps, top_ks, seeds,
+                            pos + 1).astype(jnp.int32)
+                picks.append(prev)
+            stacked = jnp.stack(picks, 1)                     # [S, total]
+            idx = (cnt - 1)[:, None] + jnp.arange(kk)[None, :]
+            props = jnp.take_along_axis(stacked, idx, axis=1)
+            return k, v, props
+
+        def dcatch(params, k, v, cat, cnt, base):
+            # pure teacher-forced KV replay of up to catch_w committed
+            # tokens per lane — the bulk half of a long catchup (the
+            # engine ran gate-closed plain iterations and the backlog
+            # outgrew one window).  Same phase arithmetic as dstep's
+            # catchup prefix but no sampling lanes: catchup picks are
+            # never consumed, so a 4x-wider no-pick program drains a
+            # backlog in a fraction of the dispatches AND the
+            # per-position op count.  Lanes with cnt < catch_w re-feed
+            # their newest row idempotently.
+            traces["draft_catch"] += 1
+            for j in range(catch_w):
+                jj = jnp.minimum(j, cnt - 1)                  # [S]
+                tok = jnp.take_along_axis(cat, jj[:, None],
+                                          axis=1)[:, 0]
+                _, k, v = adapter.decode(params, tok, base + jj, k, v)
+            return k, v
+
+        donate = () if jax.default_backend() == "cpu" else (1, 2)
+        return {"prefill": jax.jit(dprefill, donate_argnums=donate),
+                "step": jax.jit(dstep, donate_argnums=donate),
+                "catch": jax.jit(dcatch, donate_argnums=donate),
+                "catch_w": catch_w,
+                "traces": traces}
+
+    # -- engine-driven phases ---------------------------------------------
+    def admit(self, slot, prompt):
+        """Deposit ``prompt``'s draft KV into ``slot`` (padded to the
+        engine's prompt bucket; pad rows are overwritten by the first
+        catchup before they become attendable)."""
+        prompt = np.asarray(prompt, np.int32)
+        buf = np.zeros((1, self.p_bucket), np.int32)
+        buf[0, :prompt.size] = prompt
+        self.k, self.v = self._prefill(self.params, self.k, self.v,
+                                       jnp.asarray(buf), int(slot))
+        self.pos[slot] = prompt.size
+        self._last[slot] = prompt[-1]
+
+    def release(self, slot):
+        self.pos[slot] = 0
+        self._last[slot] = 0
+
+    def propose(self, work, temps, top_ks, seeds):
+        """One fused catchup + propose dispatch.  ``work`` is
+        ``[(slot, catchup_tokens), ...]`` where ``catchup_tokens`` are
+        the stream tokens committed since the last sync, newest last
+        (at least the newest token on a normal iteration).  Lags longer
+        than one window (the engine ran plain-decode fallback
+        iterations) are drained with extra idempotent rounds.  Returns
+        proposals ``[n_slots, k]`` (rows of idle slots are garbage)."""
+        W = self.spec_k + 1
+        remaining = {int(s): list(map(int, t)) for s, t in work}
+        # bulk-drain long backlogs (gate-closed fallback stretches)
+        # through the wide no-pick catchup program first; the fused
+        # round below then starts at most one window behind
+        C = self._catch_w
+        while max((len(t) for t in remaining.values()), default=0) > W:
+            cat = np.zeros((self.n_slots, C), np.int32)
+            cnt = np.ones(self.n_slots, np.int32)
+            base = np.maximum(self.pos - 1, 0).astype(np.int32)
+            cat[:, 0] = self._last
+            for slot, toks in remaining.items():
+                take = toks[:C]
+                if not take:            # drained: idle re-feed
+                    continue
+                cat[slot, :len(take)] = take
+                cnt[slot] = len(take)
+                base[slot] = self.pos[slot]
+                remaining[slot] = toks[C:]
+                self.pos[slot] += len(take)
+                self._last[slot] = take[-1]
+            self.k, self.v = self._dcatch(self.params, self.k, self.v,
+                                          jnp.asarray(cat),
+                                          jnp.asarray(cnt),
+                                          jnp.asarray(base))
+        while True:
+            cat = np.zeros((self.n_slots, W), np.int32)
+            cnt = np.ones(self.n_slots, np.int32)
+            base = np.maximum(self.pos - 1, 0).astype(np.int32)
+            cat[:, 0] = self._last
+            for slot, toks in remaining.items():
+                take = toks[:W]
+                if not take:            # drained: idle re-feed
+                    continue
+                cat[slot, :len(take)] = take
+                cnt[slot] = len(take)
+                base[slot] = self.pos[slot]
+                remaining[slot] = toks[W:]
+                self.pos[slot] += len(take)
+                self._last[slot] = take[-1]
+            self.k, self.v, props = self._dstep(
+                self.params, self.k, self.v, jnp.asarray(cat),
+                jnp.asarray(cnt), jnp.asarray(base),
+                temps, top_ks, seeds)
+            if not any(remaining.values()):
+                return np.asarray(props)
+
+    def close(self):
+        if self._attached:
+            self._hbm.free()

@@ -11,6 +11,10 @@ import os
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
+# copy-on-write write-guard: every page write is asserted against the
+# refcount table (kv_cache.assert_writable) — debug mode, always on
+# under the test suite
+os.environ.setdefault("HETU_COW_GUARD", "1")
 
 import jax  # noqa: E402
 

@@ -67,8 +67,13 @@ ATTAINMENT_MARKERS = ("attainment",)
 #: for trend reading without ever destabilizing the gate.  TPOT
 #: percentiles are wall-clock latency on shared CPUs — trend context
 #: for the chunked-prefill claim, too noisy to gate.
+#: ``acceptance_rate`` / ``hit_rate`` are workload properties of the
+#: speculative/prefix bench traces (how often the draft agrees, how
+#: often prompts share prefixes), and ``ttft`` percentiles are
+#: wall-clock — all trend context, none a performance gate
 INFO_MARKERS = ("shed_fraction", "numerics", "grad_norm", "update_norm",
-                "update_ratio", "anomal", "tpot")
+                "update_ratio", "anomal", "tpot", "acceptance_rate",
+                "hit_rate", "ttft")
 #: platform-conditional signals (``serve_tp_speedup`` from ``bench.py
 #: --serve --tp N``): a real speedup only exists on a real multi-chip
 #: mesh — on CPU the forced host "devices" share the same cores, so the
