@@ -1881,6 +1881,365 @@ def _emit_serve_tp(out):
     _print_compact(compact, drop_order=("kv_per_chip_B",))
 
 
+# -- quantized serve mode (bench.py --serve --kv-dtype DT) ------------------
+# Quantized serving-plane evidence (ISSUE 16): three sub-stages, one per
+# transport leg of the shared block codec (hetu_tpu/ops/quant.py).
+#   * KV twin: the SAME paged engine + arrival trace, once f32 and once
+#     with kv_dtype=DT, at byte-equal page-pool HBM — quantized pages
+#     are ~3-5x smaller, so the same byte budget holds MORE pages and
+#     reservation-based admission admits more concurrent requests.
+#     Streams are no longer bitwise, so the witness is an
+#     ERROR-BOUNDED TWIN: a teacher-forced dual-cache probe replays the
+#     f32 twin's greedy streams through BOTH pools step by step and
+#     reports the per-token max logit divergence (the engine's real
+#     compounding path — each quantized step attends to a history that
+#     itself went through the codec), plus a task-level equal-quality
+#     A/B (fraction of requests whose full greedy stream matches f32).
+#   * wire: an in-process PSServer lookup round, raw-f32 vs 'q8' reply
+#     codec — measured payload bytes per pull + round-trip error bound.
+#   * TP gathers: a tp=2 mesh engine with gather_dtype=DT vs an
+#     unsharded f32 reference — greedy stream agreement + analytic
+#     all-gather bytes per decode step (3 hidden-width + 1
+#     intermediate-width gather per layer, see llama_decode.make_block).
+
+SERVE_QUANT_DETAIL_PATH = os.environ.get(
+    "HETU_SERVE_QUANT_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "SERVE_QUANT_FULL.json"))
+
+
+def _replay_tokens(engine, trace):
+    """Replay a trace and return each request's full token stream (in
+    trace order) — the per-request agreement witness the aggregate
+    stream sha of _serve_replay can't provide."""
+    submitted, it, reqs = 0, 0, []
+    while submitted < len(trace) or not engine.scheduler.idle:
+        while submitted < len(trace) and trace[submitted][0] <= it:
+            _, prompt, max_new = trace[submitted]
+            reqs.append(engine.submit(prompt, max_new))
+            submitted += 1
+        engine.step()
+        it += 1
+    return [list(r.tokens) for r in reqs]
+
+
+def _kv_quant_probe(adapter, params, seqs, prompt_lens, page_len,
+                    kv_dtype):
+    """Teacher-forced dual-cache divergence probe: drive each f32
+    greedy stream through a plain f32 page pool AND a quantized one,
+    step by step, and compare the decode logits.  Each branch scatters
+    its OWN new K/V rows, so the quantized branch compounds codec error
+    through positions exactly like the serving engine does.  Returns
+    (max_logit_div, relative_div, per_step_greedy_agreement)."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.serving.kv_cache import (QuantizedKVPool, gather_pages,
+                                           scatter_rows)
+
+    L, KV, D = adapter.layers, adapter.kv_heads, adapter.head_dim
+    n_pages = max(-(-len(s) // page_len) for s in seqs)
+    shape = (n_pages, L, KV, page_len, D)
+    table = jnp.arange(n_pages)[None]
+
+    @jax.jit
+    def dual_step(params, tok, pos, fk, fv, qk, qv):
+        lf, nfk, nfv = adapter.decode(
+            params, tok[None], pos[None],
+            gather_pages(fk, table), gather_pages(fv, table))
+        lq, nqk, nqv = adapter.decode(
+            params, tok[None], pos[None],
+            gather_pages(qk, table), gather_pages(qv, table))
+        pages, offs = (pos // page_len)[None], (pos % page_len)[None]
+
+        def row(nc):        # [1, L, KV, T, D] -> the new row [1, L, KV, D]
+            return jax.lax.dynamic_slice_in_dim(
+                nc, pos, 1, axis=3)[:, :, :, 0]
+
+        fk = scatter_rows(fk, pages, offs, row(nfk))
+        fv = scatter_rows(fv, pages, offs, row(nfv))
+        qk = scatter_rows(qk, pages, offs, row(nqk))
+        qv = scatter_rows(qv, pages, offs, row(nqv))
+        div = jnp.max(jnp.abs(lf - lq))
+        return (fk, fv, qk, qv, div, jnp.max(jnp.abs(lf)),
+                jnp.argmax(lf[0]) == jnp.argmax(lq[0]))
+
+    max_div, max_ref, agree, steps = 0.0, 1e-9, 0, 0
+    for seq, p_len in zip(seqs, prompt_lens):
+        fk = jnp.zeros(shape, jnp.float32)
+        fv = jnp.zeros(shape, jnp.float32)
+        qk = QuantizedKVPool.zeros(shape, kv_dtype)
+        qv = QuantizedKVPool.zeros(shape, kv_dtype)
+        _, pk, pv = adapter.prefill(
+            params, jnp.asarray(seq[:p_len], jnp.int32)[None])
+        rows_k = jnp.transpose(pk, (2, 0, 1, 3))     # [P, L, KV, D]
+        rows_v = jnp.transpose(pv, (2, 0, 1, 3))
+        pos = np.arange(p_len)
+        pages, offs = pos // page_len, pos % page_len
+        fk = scatter_rows(fk, pages, offs, rows_k)
+        fv = scatter_rows(fv, pages, offs, rows_v)
+        qk = scatter_rows(qk, pages, offs, rows_k)
+        qv = scatter_rows(qv, pages, offs, rows_v)
+        for i in range(p_len, len(seq)):
+            tok = jnp.asarray(seq[i], jnp.int32)
+            fk, fv, qk, qv, div, ref, ok = dual_step(
+                params, tok, jnp.asarray(i, jnp.int32), fk, fv, qk, qv)
+            max_div = max(max_div, float(div))
+            max_ref = max(max_ref, float(ref))
+            agree += int(ok)
+            steps += 1
+    return max_div, max_div / max_ref, (agree / steps if steps else 1.0)
+
+
+def run_serve_quant(quick=False, kv_dtype="int8", seed=0):
+    import jax
+    from hetu_tpu.ops import quant as _quant
+    from hetu_tpu.serving import InferenceEngine
+
+    ex, model, c = _serve_build(quick)
+    if quick:
+        max_len, max_prompt = 48, 12
+        trace = _serve_trace(seed, 24, c.vocab_size, 3, 12, 4, 16)
+        page_len, prefill_budget, f32_pages = 8, 24, 13
+    else:
+        max_len, max_prompt = 160, 48
+        trace = _serve_trace(seed, 80, c.vocab_size, 8, 48, 8, 64)
+        page_len, prefill_budget, f32_pages = 16, 96, 26
+    # f32_pages is deliberately TIGHT (pages, not slots, bind): both
+    # twins get one slot per trace request, so admitted concurrency is
+    # purely a function of how many pages the byte budget holds
+    kw = dict(n_slots=len(trace), max_len=max_len,
+              max_prompt_len=max_prompt, prefill_budget=2, name="serve",
+              seed=seed, paged=True, page_len=page_len,
+              prefill_token_budget=prefill_budget)
+    feng = InferenceEngine(ex, model, instance="quant_f32",
+                           n_pages=f32_pages, **kw)
+    fb = int(feng.cache.k.nbytes) + int(feng.cache.v.nbytes)
+    # byte-equal pool HBM: the quantized twin gets as many pages as the
+    # f32 twin's byte budget can hold at the quantized per-page cost
+    # (codes + the per-row f32 scale overhead both counted)
+    D = c.hidden_size // c.num_heads
+    cb = _quant.code_bytes_per_element(kv_dtype)
+    qpage_bytes = 2 * c.num_layers * c.num_kv_heads * page_len * (
+        D * cb + 4)
+    q_pages = max(f32_pages, fb // qpage_bytes)
+    qeng = InferenceEngine(ex, model, instance=f"quant_{kv_dtype}",
+                           n_pages=int(q_pages), kv_dtype=kv_dtype, **kw)
+    qb = int(qeng.cache.k.nbytes) + int(qeng.cache.v.nbytes)
+    assert qb <= fb, "quantized pool exceeded the byte-equal budget"
+
+    # untimed warm replay per engine, then pin the retrace counters
+    _serve_replay(feng, trace)
+    _serve_replay(qeng, trace)
+    warm_f, warm_q = dict(feng.trace_counts), dict(qeng.trace_counts)
+    # task-level equal-quality A/B: per-request greedy stream agreement
+    toks_f = _replay_tokens(feng, trace)
+    toks_q = _replay_tokens(qeng, trace)
+    stream_agree = (sum(a == b for a, b in zip(toks_f, toks_q))
+                    / max(1, len(toks_f)))
+    # fair A/B: interleave the twins' measured replays, keep each best
+    best_f = best_q = None
+    for _ in range(3):
+        rf = _serve_replay(feng, trace)
+        rq = _serve_replay(qeng, trace)
+        if best_f is None or (rf["tokens_per_sec"]
+                              > best_f["tokens_per_sec"]):
+            best_f = rf
+        if best_q is None or (rq["tokens_per_sec"]
+                              > best_q["tokens_per_sec"]):
+            best_q = rq
+
+    # error-bounded-twin probe over the f32 twin's first streams
+    n_probe = 3 if quick else 4
+    seqs = [list(np.asarray(trace[i][1])) + toks_f[i]
+            for i in range(n_probe)]
+    p_lens = [len(trace[i][1]) for i in range(n_probe)]
+    max_div, rel_div, step_agree = _kv_quant_probe(
+        qeng.adapter, qeng.params, seqs, p_lens, page_len, kv_dtype)
+
+    # -- wire leg: measured lookup-reply bytes, f4 vs q8 codec ----------
+    wire = _wire_quant_stage(quick, seed)
+
+    # -- TP-gather leg: quantized all-gathers vs unsharded reference ----
+    tp_out = _tp_quant_stage(ex, model, c, kw, kv_dtype, quick, seed)
+
+    conc_x = round(best_q["peak_active"] / max(1, best_f["peak_active"]),
+                   3)
+    signals = {
+        "serve_quant_tokens_per_s": best_q["tokens_per_sec"],
+        "serve_quant_f32_tokens_per_s": best_f["tokens_per_sec"],
+        "serve_quant_peak_concurrency": best_q["peak_active"],
+        "serve_quant_f32_peak_concurrency": best_f["peak_active"],
+        "kv_quant_concurrency_x": conc_x,
+        "kv_quant_hbm_bytes_per_token": round(
+            qb / max(1, best_q["peak_live_tokens"]), 1),
+        "kv_quant_max_logit_div": round(max_div, 6),
+        "kv_quant_greedy_attainment": round(stream_agree, 4),
+        "wire_bytes_per_pull": wire["q8_bytes_per_pull"],
+        "tp_gather_bytes_per_step":
+            tp_out["quant_gather_bytes_per_step"],
+    }
+    return {"metric": "serve_quant_peak_concurrency",
+            "value": best_q["peak_active"], "unit": "requests",
+            "vs_baseline": conc_x,   # > 1 iff quantization buys capacity
+            "kv_dtype": kv_dtype,
+            "platform": jax.default_backend(),
+            "seed": seed, "quick": bool(quick),
+            "n_requests": len(trace),
+            "paged": {"page_len": page_len, "f32_pages": f32_pages,
+                      "quant_pages": int(q_pages),
+                      "prefill_token_budget": prefill_budget},
+            "hbm": {"f32_pool_bytes": fb, "quant_pool_bytes": qb,
+                    "equal_hbm_budget": bool(qb <= fb),
+                    "pool_bytes_ratio": round(qb / fb, 4)},
+            "divergence": {"max_logit_div": round(max_div, 6),
+                           "relative_div": round(rel_div, 6),
+                           "probe_step_agreement": round(step_agree, 4),
+                           "stream_agreement": round(stream_agree, 4),
+                           "probe_sequences": n_probe},
+            "compile_flat": bool(feng.trace_counts == warm_f
+                                 and qeng.trace_counts == warm_q),
+            "wire": wire, "tp": tp_out,
+            "signals": signals,
+            "stages": {"quant": best_q, "f32": best_f}}
+
+
+def _wire_quant_stage(quick, seed):
+    """In-process PSServer lookup round: measured reply payload bytes
+    for the raw-f32 wire vs the negotiated q8 codec, plus the
+    round-trip error bound check (half an int8 step per row absmax)."""
+    import socket as _socket
+    import threading
+    from hetu_tpu.ps.rpc import (PSServer, RemoteTable, recv_msg,
+                                 send_msg)
+    from hetu_tpu.ps.store import EmbeddingTable
+
+    rows, dim, n_keys = (4096, 16, 256) if quick else (65536, 64, 1024)
+    table = EmbeddingTable(rows, dim, seed=seed)
+    server = PSServer(table, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    keys = np.arange(n_keys, dtype="<i8")
+
+    def pull(codec):
+        s = _socket.create_connection((server.host, server.port),
+                                      timeout=30)
+        try:
+            hdr = {"verb": "lookup"}
+            if codec:
+                hdr["codec"] = codec
+            send_msg(s, hdr, keys)
+            reply, payloads = recv_msg(s)
+            assert reply.get("verb") == "ok", reply
+            return sum(len(p) for p in payloads)
+        finally:
+            s.close()
+
+    f4_bytes, q8_bytes = pull(None), pull("q8")
+    # parity through the real client path
+    rt_f = RemoteTable(server.host, server.port)
+    rt_q = RemoteTable(server.host, server.port, codec="q8")
+    rows_f, rows_q = rt_f.lookup(keys), rt_q.lookup(keys)
+    bound = np.abs(rows_f).max(axis=1, keepdims=True) / 127 * 0.5 + 1e-7
+    err = float(np.abs(rows_q - rows_f).max())
+    within = bool((np.abs(rows_q - rows_f) <= bound).all())
+    rt_f.close()
+    rt_q.close()
+    server.stop()
+    return {"n_keys": n_keys, "dim": dim,
+            "f4_bytes_per_pull": f4_bytes,
+            "q8_bytes_per_pull": q8_bytes,
+            "bytes_ratio": round(q8_bytes / f4_bytes, 4),
+            "max_roundtrip_err": round(err, 6),
+            "within_bound": within}
+
+
+def _tp_quant_stage(ex, model, c, kw, kv_dtype, quick, seed):
+    """tp=2 mesh engine with quantized gathers vs an unsharded f32
+    reference on a short trace: greedy stream agreement + analytic
+    gather bytes per decode step per slot (3 hidden-width + 1
+    intermediate-width gather per layer)."""
+    import jax
+    from hetu_tpu.ops import quant as _quant
+    from hetu_tpu.serving import InferenceEngine, serving_mesh
+
+    tp = 2
+    if len(jax.devices()) < tp:
+        return {"skipped": f"needs {tp} devices",
+                "quant_gather_bytes_per_step": 0,
+                "f32_gather_bytes_per_step": 0}
+    ttrace = _serve_trace(seed + 2, 8 if quick else 16, c.vocab_size,
+                          3, 10, 4, 8)
+    tkw = dict(kw, n_slots=4,
+               n_pages=(4 * kw["max_len"]) // kw["page_len"] + 1)
+    teng = InferenceEngine(ex, model, instance=f"tp{tp}_g{kv_dtype}",
+                           mesh=serving_mesh(tp), gather_dtype=kv_dtype,
+                           **tkw)
+    seng = InferenceEngine(ex, model, instance="tp_quant_ref", **tkw)
+    toks_t = _replay_tokens(teng, ttrace)
+    toks_s = _replay_tokens(seng, ttrace)
+    agree = (sum(a == b for a, b in zip(toks_t, toks_s))
+             / max(1, len(toks_t)))
+    cb = _quant.code_bytes_per_element(kv_dtype)
+    H, I, L = c.hidden_size, c.intermediate_size, c.num_layers
+
+    def blocks(d):      # scales per gathered activation (make_gather)
+        return tp if d % tp == 0 else 1
+
+    f32_b = L * (3 * H + I) * 4
+    q_b = L * (3 * (H * cb + blocks(H) * 4) + (I * cb + blocks(I) * 4))
+    return {"tp": tp, "n_requests": len(ttrace),
+            "stream_agreement": round(agree, 4),
+            "f32_gather_bytes_per_step": f32_b,
+            "quant_gather_bytes_per_step": q_b,
+            "gather_bytes_ratio": round(q_b / f32_b, 4)}
+
+
+def _emit_serve_quant(out):
+    """Same layered emission contract as _emit_serve_tp: full headline
+    + SERVE_QUANT_FULL.json written only after the run has real results
+    (the no-clobber rule), signals appended to benchmarks/history.jsonl
+    for ``tools/perf_diff.py --current SERVE_QUANT_FULL.json``, compact
+    tail line inside the driver's stdout window."""
+    from hetu_tpu.telemetry import JsonlWriter
+    full = json.dumps(out)
+    try:
+        with open(SERVE_QUANT_DETAIL_PATH, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass
+    if out.get("signals"):
+        entry = {"t": round(time.time(), 3), "platform": out["platform"],
+                 "quick": out["quick"], "seed": out["seed"],
+                 "signals": out["signals"]}
+        try:
+            os.makedirs(os.path.dirname(HISTORY_PATH) or ".",
+                        exist_ok=True)
+            with JsonlWriter(HISTORY_PATH) as w:  # append, never truncate
+                w.write(entry)
+        except OSError:
+            pass
+    print(full, flush=True)
+    sg = out["signals"]
+    compact = {"metric": out["metric"], "value": out["value"],
+               "unit": out["unit"], "kv_dtype": out["kv_dtype"],
+               "conc": [sg["serve_quant_peak_concurrency"],
+                        sg["serve_quant_f32_peak_concurrency"]],
+               "conc_x": sg["kv_quant_concurrency_x"],
+               "kv_B_per_tok": sg["kv_quant_hbm_bytes_per_token"],
+               "logit_div": sg["kv_quant_max_logit_div"],
+               "greedy_attain": sg["kv_quant_greedy_attainment"],
+               "wire_B_per_pull": [sg["wire_bytes_per_pull"],
+                                   out["wire"]["f4_bytes_per_pull"]],
+               "tp_gather_B": [sg["tp_gather_bytes_per_step"],
+                               out["tp"].get(
+                                   "f32_gather_bytes_per_step", 0)],
+               "pool_ratio": out["hbm"]["pool_bytes_ratio"],
+               "compile_flat": out["compile_flat"],
+               "platform": out["platform"],
+               "detail": os.path.basename(SERVE_QUANT_DETAIL_PATH)}
+    _print_compact(compact, drop_order=("tp_gather_B", "pool_ratio"))
+
+
 # -- embedding-serve mode (bench.py --serve-embed) -------------------------
 # Tiered-embedding serving evidence (ROADMAP direction 5): replay one
 # seeded Zipfian key trace (Criteo-shaped skew) through the
@@ -3568,10 +3927,12 @@ def main():
         # --serve --tp N runs the tensor-parallel twin stage instead.
         tp = (int(sys.argv[sys.argv.index("--tp") + 1])
               if "--tp" in sys.argv else 1)
-        if tp > 1:
+        if tp > 1 or "--kv-dtype" in sys.argv:
             # the forced host-device flag must be in the env BEFORE jax
             # initializes its backends; it only multiplies the CPU
             # platform's device count, so it is a no-op on a real TPU
+            # (--kv-dtype needs it too: its TP-gather sub-stage builds a
+            # tp=2 mesh)
             flag = "--xla_force_host_platform_device_count=8"
             if flag not in os.environ.get("XLA_FLAGS", ""):
                 os.environ["XLA_FLAGS"] = (
@@ -3589,6 +3950,14 @@ def main():
                 out["telemetry"] = _telemetry_report()
                 _assert_rid_audit(out["telemetry"])
             _emit_serve_spec(out)
+            return
+        if "--kv-dtype" in sys.argv:
+            kvd = sys.argv[sys.argv.index("--kv-dtype") + 1]
+            out = run_serve_quant(quick, kv_dtype=kvd)
+            if telemetry_on:
+                out["telemetry"] = _telemetry_report()
+                _assert_rid_audit(out["telemetry"])
+            _emit_serve_quant(out)
             return
         if tp > 1:
             out = run_serve_tp(quick, tp)

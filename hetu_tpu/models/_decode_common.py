@@ -128,18 +128,48 @@ def make_slot_picker():
     return pick
 
 
-def make_gather(mesh):
+def make_gather(mesh, quant_dtype=None):
     """The tensor-parallel replicate-back hook for ``make_block``'s
     ``gather=``: constrain an activation to fully-replicated on
     ``mesh`` so GSPMD inserts an all-gather (byte movement — exact)
     instead of a psum of partial dot products (reduction reordering —
     would break the sharded engine's bitwise-parity oracle).  Works
-    under ``jax.vmap``: the batched dim joins the spec as replicated."""
+    under ``jax.vmap``: the batched dim joins the spec as replicated.
+
+    ``quant_dtype`` ('int8' | 'fp8', EQuARX-style) moves the gather's
+    bytes through the shared block codec instead: the activation is
+    quantized per shard-width block BEFORE the replication constraint
+    — so each chip's local block gets its own absmax scale and the
+    all-gather transports 1-byte codes plus a small f32 scale vector —
+    and dequantized right after.  This trades the bitwise-parity oracle
+    for a bounded divergence (tests/test_sharded_serving.py carries the
+    relaxed twin), which is why it defaults OFF: the unquantized path
+    is byte-identical to what this function always built."""
     from jax.sharding import NamedSharding, PartitionSpec
     rep = NamedSharding(mesh, PartitionSpec())
+    if quant_dtype is None:
+
+        def gather(x):
+            return jax.lax.with_sharding_constraint(x, rep)
+
+        return gather
+
+    from ..ops import quant as _quant
+    _quant.code_dtype(quant_dtype)        # fail fast on a bad codec
+    tp = 1
+    for size in mesh.shape.values():
+        tp *= int(size)
 
     def gather(x):
-        return jax.lax.with_sharding_constraint(x, rep)
+        d = x.shape[-1]
+        # one block per shard when the width divides; otherwise a
+        # whole-axis block (still quantized transport, coarser scale)
+        block = d // tp if tp > 1 and d % tp == 0 else None
+        codes, scales = _quant.quantize_blocks(x, block=block,
+                                               dtype=quant_dtype)
+        codes = jax.lax.with_sharding_constraint(codes, rep)
+        scales = jax.lax.with_sharding_constraint(scales, rep)
+        return _quant.dequantize_blocks(codes, scales)
 
     return gather
 

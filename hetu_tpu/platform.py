@@ -72,6 +72,18 @@ def compiled_memory_analysis(compiled):
     return out
 
 
+def fp8_dtype():
+    """The fp8 e4m3 dtype this jax build ships, or None.
+
+    jax >= 0.4.9 re-exports ml_dtypes' ``float8_e4m3fn`` as
+    ``jnp.float8_e4m3fn``; older builds don't define it.  The quantized
+    serving plane (``ops/quant.py``) gates its fp8 codec on this —
+    callers fall back to int8 (or skip, in tests) when it returns
+    None rather than growing their own version probes."""
+    import jax.numpy as jnp
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
 def force_platform_from_env():
     """Honor JAX_PLATFORMS through jax.config BEFORE any device use.
 

@@ -36,8 +36,13 @@ van.cc:105 heartbeats):
     locked socket.
 
 Wire format (trusted-cluster, no pickle): one u32 little-endian JSON
-header length, the JSON header ({"verb", "seq", "cid", "sizes", ...}),
-then the raw little-endian array payloads back to back.
+header length, the JSON header ({"verb", "seq", "cid", "sizes",
+"dtypes", ...}), then the raw array payloads back to back.  "dtypes"
+carries each payload's SOURCE dtype so int64 keys, int32 counters, and
+bf16 grads round-trip unchanged; peers without the list fall back to the
+pre-typed-wire float32/int64 hard-codes.  Lookups may negotiate the
+block-quantized reply codec ({"codec": "q8"} → int8 codes + f32 row
+scales through ``ops/quant.py``) for ~4x fewer bytes per pull.
 """
 
 from __future__ import annotations
@@ -65,12 +70,39 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
+def wire_dtype(arr):
+    """JSON-safe wire name for an array's dtype.
+
+    numpy's byte-order-explicit ``.str`` where it is faithful; the dtype
+    ``.name`` for extension dtypes (bfloat16, float8_e4m3fn) whose
+    ``.str`` is an anonymous void code (``'<V2'``) that ``np.dtype``
+    cannot decode back."""
+    dt = np.asarray(arr).dtype
+    return dt.name if dt.str.lstrip("<>|=").startswith("V") else dt.str
+
+
+def wire_np_dtype(name):
+    """Decode a :func:`wire_dtype` name back to a numpy dtype, falling
+    back to ml_dtypes for extension names core numpy doesn't know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def send_msg(sock, header, *arrays):
-    payloads = [np.ascontiguousarray(a).tobytes() for a in arrays]
+    arrays = [np.ascontiguousarray(a) for a in arrays]
     header = dict(header)
-    header["sizes"] = [len(p) for p in payloads]
+    header["sizes"] = [a.nbytes for a in arrays]
+    # every payload's dtype rides the header, so peers round-trip the
+    # SOURCE dtype (int64 keys, int32 counters, bf16 grads) instead of
+    # assuming the pre-typed-wire float32; receivers without the list
+    # (or replies from old servers) fall back to the legacy hard-codes
+    header["dtypes"] = [wire_dtype(a) for a in arrays]
     hb = json.dumps(header).encode()
-    sock.sendall(struct.pack("<I", len(hb)) + hb + b"".join(payloads))
+    sock.sendall(struct.pack("<I", len(hb)) + hb
+                 + b"".join(a.tobytes() for a in arrays))
 
 
 def recv_msg(sock):
@@ -78,6 +110,14 @@ def recv_msg(sock):
     header = json.loads(_recv_exact(sock, hlen))
     payloads = [_recv_exact(sock, n) for n in header.get("sizes", ())]
     return header, payloads
+
+
+def _payload(header, payloads, i, legacy):
+    """Decode payload ``i`` by the header's dtype list, defaulting to
+    the ``legacy`` hard-coded dtype for pre-typed-wire peers."""
+    dts = header.get("dtypes") or ()
+    dt = wire_np_dtype(dts[i]) if i < len(dts) else np.dtype(legacy)
+    return np.frombuffer(payloads[i], dt)
 
 
 class PSUnavailable(ConnectionError):
@@ -156,22 +196,33 @@ class _Handler(socketserver.BaseRequestHandler):
                 f"no table {header.get('table', '')!r} on this server "
                 f"(tables: {sorted(self.server.tables)})")
         if verb == "lookup":
-            keys = np.frombuffer(payloads[0], "<i8")
-            return ok, [table.lookup(keys).astype("<f4")]
+            keys = _payload(header, payloads, 0, "<i8")
+            rows = table.lookup(keys).astype("<f4")
+            if header.get("codec") == "q8":
+                # block-quantized reply (ISSUE 16 leg b): one int8 code
+                # per element + one f32 scale per row through the shared
+                # codec — ~4x fewer reply bytes for the cold embedding
+                # tier.  Negotiated per request: the reply header's
+                # codec tag is what the client dequantizes by.
+                from ..ops import quant as _quant
+                codes, scales = _quant.quantize_blocks(rows, dtype="int8")
+                return dict(ok, codec="q8"), [codes,
+                                              scales.astype("<f4")]
+            return ok, [rows]
         elif verb == "push":
-            keys = np.frombuffer(payloads[0], "<i8")
-            grads = np.frombuffer(payloads[1], "<f4").reshape(
+            keys = _payload(header, payloads, 0, "<i8")
+            grads = _payload(header, payloads, 1, "<f4").reshape(
                 keys.size, table.dim)
             table.push(keys, grads)
             return ok, []
         elif verb == "set_rows":
-            keys = np.frombuffer(payloads[0], "<i8")
-            vals = np.frombuffer(payloads[1], "<f4").reshape(
+            keys = _payload(header, payloads, 0, "<i8")
+            vals = _payload(header, payloads, 1, "<f4").reshape(
                 keys.size, table.dim)
             table.set_rows(keys, vals)
             return ok, []
         elif verb == "versions":
-            keys = np.frombuffer(payloads[0], "<i8")
+            keys = _payload(header, payloads, 0, "<i8")
             return ok, [table.versions(keys).astype("<u8")]
         elif verb == "meta":
             return dict(ok, rows=table.rows, dim=table.dim), []
@@ -201,13 +252,29 @@ class _Handler(socketserver.BaseRequestHandler):
                 float(header.get("wait_ms", 100.0)))
             return dict(ok, partner=list(partner)), []
         elif verb == "reduce":
-            arrays = [np.frombuffer(p, "<f4").reshape(s)
-                      for p, s in zip(payloads, header["shapes"])]
+            dts = header.get("dtypes")
+            dts = ([wire_np_dtype(d) for d in dts] if dts
+                   else [np.dtype("<f4")] * len(payloads))
+            arrays = []
+            for p, dt, s in zip(payloads, dts, header["shapes"]):
+                a = np.frombuffer(p, dt).reshape(s)
+                if a.dtype.kind == "f" and a.dtype.itemsize < 4:
+                    # bf16/fp8 leaves: average in f32 (sub-word float
+                    # accumulation would throw away the mean's mantissa)
+                    a = a.astype(np.float32)
+                arrays.append(a)
             mean = self.server.reducer.reduce(
                 int(header["round"]), int(header["rank"]),
                 tuple(header["group"]), arrays)
-            return (dict(ok, shapes=header["shapes"]),
-                    [m.astype("<f4") for m in mean])
+            # each mean goes back in its leaf's SOURCE dtype (integer
+            # leaves round to nearest — np.mean made them float64)
+            out = []
+            for m, dt in zip(mean, dts):
+                m = np.asarray(m)
+                if dt.kind in "iu" and m.dtype.kind == "f":
+                    m = np.rint(m)
+                out.append(np.ascontiguousarray(m.astype(dt)))
+            return dict(ok, shapes=header["shapes"]), out
         else:
             return {"verb": "error", "seq": header.get("seq"),
                     "message": f"bad verb {verb}"}, []
@@ -365,7 +432,7 @@ class RemoteTable:
     def __init__(self, host, port, timeout=30.0, pool_size=3,
                  retry_deadline=60.0, heartbeat_interval=None, table="",
                  fetch_meta=True, priority_channels=True,
-                 bulk_chunk_rows=65536):
+                 bulk_chunk_rows=65536, codec=None):
         # pool_size default is 3 so the reserved priority lane leaves
         # TWO bulk connections — the same bulk concurrency the pre-lane
         # pool_size=2 default offered
@@ -373,6 +440,16 @@ class RemoteTable:
         self._timeout = timeout
         self._deadline = retry_deadline
         self._table = table
+        # lookup-reply wire codec (ISSUE 16 leg b): None asks for raw
+        # f32 rows; 'q8' asks the server for block-quantized int8 codes
+        # + per-row f32 scales via the shared ops/quant codec (~4x fewer
+        # bytes per pull for the cold embedding tier, bounded round-trip
+        # error).  Negotiated per request — a server predating the codec
+        # simply replies untagged f32 and the client takes the raw path.
+        if codec not in (None, "q8"):
+            raise ValueError(f"unknown wire codec {codec!r} "
+                             "(expected None or 'q8')")
+        self.codec = codec
         # unique across processes AND instances (resender keys on sender)
         self._cid = f"{os.getpid()}.{next(self._cid_counter)}"
         self._seq = itertools.count()
@@ -416,6 +493,11 @@ class RemoteTable:
             "RPCs whose whole retry deadline elapsed without a reply "
             "(raised as PSUnavailable)",
             labels=("verb",))
+        self._m_pull_bytes = reg.counter(
+            "hetu_quant_wire_pull_bytes_total",
+            "Lookup-reply payload bytes received, by wire codec ('f4' "
+            "raw float32 rows, 'q8' block-quantized codes + scales)",
+            labels=("codec",))
         if fetch_meta:
             meta = self._call({"verb": "meta"})[0]
             self.rows, self.dim = meta["rows"], meta["dim"]
@@ -562,7 +644,19 @@ class RemoteTable:
     # -- table interface ---------------------------------------------------
     def lookup(self, keys):
         keys = np.asarray(keys).reshape(-1).astype("<i8")
-        _, payloads = self._call({"verb": "lookup"}, keys)
+        header = {"verb": "lookup"}
+        if self.codec:
+            header["codec"] = self.codec
+        reply, payloads = self._call(header, keys)
+        self._m_pull_bytes.labels(codec=reply.get("codec", "f4")).inc(
+            sum(len(p) for p in payloads))
+        if reply.get("codec") == "q8":
+            from ..ops import quant as _quant
+            codes = np.frombuffer(payloads[0], np.int8).reshape(
+                keys.size, self.dim)
+            scales = np.frombuffer(payloads[1], "<f4").reshape(
+                keys.size, 1)
+            return _quant.dequantize_blocks(codes, scales)
         return np.frombuffer(payloads[0], "<f4").reshape(
             keys.size, self.dim).copy()
 
@@ -660,7 +754,12 @@ class RemoteCoordinator(RemoteTable):
     def reduce(self, round_id, rank, group, grads):
         import jax
         import jax.numpy as jnp
-        leaves = [np.asarray(l, np.float32)
+        # each leaf keeps its SOURCE dtype on the wire (send_msg records
+        # the per-payload dtype list): int32 counters no longer pay a
+        # 4-byte float encode plus a lossy cast on the way back, and
+        # bf16 grads move at 2 bytes/element.  The reply's own dtype
+        # list drives decoding, so a legacy f32-only server still works.
+        leaves = [np.ascontiguousarray(l)
                   for l in jax.tree_util.tree_leaves(grads)]
         tree = jax.tree_util.tree_structure(grads)
         reply, payloads = self._call(
@@ -668,8 +767,9 @@ class RemoteCoordinator(RemoteTable):
              "group": [int(g) for g in group],
              "shapes": [list(l.shape) for l in leaves]},
             *leaves)
-        out = [jnp.asarray(np.frombuffer(p, "<f4").reshape(s))
-               for p, s in zip(payloads, reply["shapes"])]
+        out = [jnp.asarray(_payload(reply, payloads, i, "<f4")
+                           .reshape(s))
+               for i, s in enumerate(reply["shapes"])]
         return jax.tree_util.tree_unflatten(tree, out)
 
 

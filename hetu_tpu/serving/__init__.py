@@ -55,7 +55,7 @@ under sustained violation.  ``bench.py --slo`` replays a bursty diurnal
 trace through a controlled fleet vs its static twin.
 """
 
-from .kv_cache import PagedKVCache, SlotKVCache
+from .kv_cache import PagedKVCache, QuantizedKVPool, SlotKVCache
 from .scheduler import (EngineOverloaded, Request, Scheduler,
                         FINISH_REASONS, SHED_POLICIES, TERMINAL_OK)
 from .adapters import (LlamaSlotAdapter, GPTSlotAdapter, adapter_for)
@@ -73,7 +73,8 @@ from .control import (CostModel, DEGRADE_LEVELS, FleetController, SLO,
 from .embedding import (BatchSlotPool, DeviceHotRowCache, EmbedRequest,
                         EmbeddingServer, EMBED_BUCKETS)
 
-__all__ = ["PagedKVCache", "SlotKVCache", "Request", "Scheduler",
+__all__ = ["PagedKVCache", "QuantizedKVPool", "SlotKVCache",
+           "Request", "Scheduler",
            "EngineOverloaded",
            "FINISH_REASONS", "SHED_POLICIES", "TERMINAL_OK",
            "LlamaSlotAdapter", "GPTSlotAdapter", "adapter_for",

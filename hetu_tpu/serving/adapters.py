@@ -51,7 +51,8 @@ def _causal(p_len):
 class LlamaSlotAdapter:
     """Rotary/GQA (Llama-family, incl. sparse-MoE) slot-batched decode."""
 
-    def __init__(self, config, name, moe_names=None, mesh=None):
+    def __init__(self, config, name, moe_names=None, mesh=None,
+                 gather_dtype=None):
         c = config
         self.config = c
         self.name = name
@@ -61,16 +62,18 @@ class LlamaSlotAdapter:
         self.head_dim = c.hidden_size // c.num_heads
         self.position_cap = None          # rotary: no learned-table limit
         self.embed_param = f"{name}_embed_table"
-        gather = make_gather(mesh) if mesh is not None else None
+        gather = (make_gather(mesh, quant_dtype=gather_dtype)
+                  if mesh is not None else None)
         self._layer_params = _ld.make_layer_params(c, name, moe_names)
         self._block = _ld.make_block(c, gather=gather)
         self._logits = _ld.make_logits(c, name)
         self._chunk_inputs = _ld.make_chunk_embed(c, name)
 
     @classmethod
-    def for_model(cls, model, name, mesh=None):
+    def for_model(cls, model, name, mesh=None, gather_dtype=None):
         return cls(model.config, name,
-                   moe_names=_ld.moe_param_names(model), mesh=mesh)
+                   moe_names=_ld.moe_param_names(model), mesh=mesh,
+                   gather_dtype=gather_dtype)
 
     def decode(self, params, tokens, positions, k, v, n_layers=None):
         """Slot-batched decode (see module doc).  ``n_layers`` truncates
@@ -146,7 +149,7 @@ class GPTSlotAdapter:
     caps total sequence length at ``config.seq_len`` — the engine
     enforces ``max_len <= seq_len`` via ``position_cap``."""
 
-    def __init__(self, config, name, mesh=None):
+    def __init__(self, config, name, mesh=None, gather_dtype=None):
         c = config
         self.config = c
         self.name = name
@@ -156,15 +159,17 @@ class GPTSlotAdapter:
         self.head_dim = c.hidden_size // c.num_heads
         self.position_cap = c.seq_len
         self.embed_param = f"{name}_wte_table"
-        gather = make_gather(mesh) if mesh is not None else None
+        gather = (make_gather(mesh, quant_dtype=gather_dtype)
+                  if mesh is not None else None)
         self._layer_params = _gd.make_layer_params(c, name)
         self._block = _gd.make_block(c, gather=gather)
         self._logits = _gd.make_logits(c, name)
         self._chunk_inputs = _gd.make_chunk_embed(c, name)
 
     @classmethod
-    def for_model(cls, model, name, mesh=None):
-        return cls(model.config, name, mesh=mesh)
+    def for_model(cls, model, name, mesh=None, gather_dtype=None):
+        return cls(model.config, name, mesh=mesh,
+                   gather_dtype=gather_dtype)
 
     def decode(self, params, tokens, positions, k, v, n_layers=None):
         nl = self.layers if n_layers is None else int(n_layers)
@@ -221,16 +226,20 @@ class GPTSlotAdapter:
         return logits, jnp.stack(ks, 1), jnp.stack(vs, 1)
 
 
-def adapter_for(model, name, mesh=None):
+def adapter_for(model, name, mesh=None, gather_dtype=None):
     """Pick the slot adapter matching a model instance by its config
     family (rotary Llama-likes vs learned-position GPTs).  ``mesh``
     (tensor-parallel serving) threads the replicate-back hook into the
-    block math — see serving/sharding.py."""
+    block math — see serving/sharding.py.  ``gather_dtype`` quantizes
+    those gathers through the shared codec (ops/quant.py); None keeps
+    the bitwise replicate-back."""
     c = model.config
     if hasattr(c, "rope_theta"):
-        return LlamaSlotAdapter.for_model(model, name, mesh=mesh)
+        return LlamaSlotAdapter.for_model(model, name, mesh=mesh,
+                                          gather_dtype=gather_dtype)
     if hasattr(c, "seq_len") and hasattr(c, "num_layers"):
-        return GPTSlotAdapter.for_model(model, name, mesh=mesh)
+        return GPTSlotAdapter.for_model(model, name, mesh=mesh,
+                                        gather_dtype=gather_dtype)
     raise TypeError(
         f"no slot adapter for {type(model).__name__} "
         f"(config {type(c).__name__}) — serving supports the Llama and "

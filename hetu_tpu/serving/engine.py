@@ -144,7 +144,8 @@ class InferenceEngine:
                  page_len=16, n_pages=None, prefill_token_budget=None,
                  mesh=None, spec_k=0, draft=None, draft_layers=None,
                  spec_min_accept=None, spec_probe_every=32,
-                 shared_params=None, prefix_cache=None):
+                 shared_params=None, prefix_cache=None, kv_dtype=None,
+                 gather_dtype=None):
         # shared_params (fleet multi-replica-per-chip): a param pytree
         # ALREADY placed on this engine's device — replicas pinned to
         # the same chip pass one placed copy instead of re-uploading
@@ -179,10 +180,28 @@ class InferenceEngine:
             # one device so N replicas split the chips instead of
             # contending for device 0 (jit follows the operands' device)
             self.params = jax.device_put(self.params, device)
+        # -- quantized serving plane (ops/quant.py) -----------------------
+        # kv_dtype quantizes the paged pool at rest; gather_dtype
+        # quantizes the TP all-gathers.  Both default off, and OFF means
+        # bitwise-identical programs to an engine built before these
+        # knobs existed (the program key only grows a component when one
+        # is set, so default engines keep sharing the same executables).
+        self._kv_dtype = None if kv_dtype is None else str(kv_dtype)
+        if self._kv_dtype is not None and not paged:
+            raise ValueError(
+                "kv_dtype (quantized KV pages) requires paged=True — "
+                "the dense slot pool has no per-page scale layout")
+        self._gather_dtype = (None if gather_dtype is None
+                              else str(gather_dtype))
+        if self._gather_dtype is not None and mesh is None:
+            raise ValueError(
+                "gather_dtype (quantized TP gathers) requires mesh= — "
+                "a single-chip engine has no cross-shard gathers")
         name = name or param_prefix(
             executor, "_embed_table"
             if hasattr(model.config, "rope_theta") else "_wte_table")
-        self.adapter = adapter_for(model, name, mesh=mesh)
+        self.adapter = adapter_for(model, name, mesh=mesh,
+                                   gather_dtype=self._gather_dtype)
         if mesh is not None:
             _shd.validate_tp(self.adapter, self._tp)
             # every mesh engine owns a mesh-placed copy of the params —
@@ -210,6 +229,7 @@ class InferenceEngine:
                 n_slots, self.adapter.layers, self.adapter.kv_heads,
                 page_len, self.adapter.head_dim, max_len=self.max_len,
                 n_pages=n_pages, dtype=emb.dtype,
+                kv_dtype=self._kv_dtype,
                 label=self.instance or f"{name}:{id(self):x}", **meshkw)
         else:
             self.cache = SlotKVCache(
@@ -480,6 +500,14 @@ class InferenceEngine:
         else:
             sampling = self._sampling
             geometry = ("slot",)
+        # quantization components are appended ONLY when the knobs are
+        # set: a default f32 engine's key — and therefore its cached
+        # executables — is byte-identical to one built before the
+        # quantized plane existed (the strictly-opt-in guarantee)
+        if self._kv_dtype is not None:
+            geometry = geometry + (("kv_dtype", self._kv_dtype),)
+        if self._gather_dtype is not None:
+            geometry = geometry + (("gather_dtype", self._gather_dtype),)
         return (type(self.adapter).__name__, self.adapter.name, cfg,
                 sampling, geometry, jax.default_backend())
 
@@ -771,7 +799,9 @@ class InferenceEngine:
             return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
 
         params = jax.tree_util.tree_map(ab, self.params)
-        k, v = ab(self.cache.k), ab(self.cache.v)
+        # quantized pools are pytrees (codes + scales): abstract per leaf
+        k = jax.tree_util.tree_map(ab, self.cache.k)
+        v = jax.tree_util.tree_map(ab, self.cache.v)
         key = ab(self._key)
         n = self.cache.n_slots
         lane = jax.ShapeDtypeStruct((n,), jnp.int32)

@@ -48,15 +48,89 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..ops import quant as _quant
+
 
 def ceil_div(a, b):
     return -(-int(a) // int(b))
 
 
+@jax.tree_util.register_pytree_node_class
+class QuantizedKVPool:
+    """A page pool stored as quantized codes plus per-row scales.
+
+    ``codes [n_pages, L, KV, page_len, D]`` in the codec storage dtype
+    (int8 / fp8 e4m3) and ``scales [n_pages, L, KV, page_len, 1]``
+    float32 — one symmetric absmax scale per cached row's head_dim
+    vector, the finest granularity :func:`scatter_rows` can maintain
+    without cross-row reductions.  The class is a registered pytree so
+    everything that moves pools (``jax.device_put``, mesh
+    ``in_shardings``, donation) keeps working: the 5-D
+    ``sharding.KV_POOL_SPEC`` applies to BOTH leaves unchanged because
+    ``scales`` keeps the same leading four axes and only collapses the
+    last one to a broadcast 1.
+
+    The pool deliberately mimics the raw-array surface the engine and
+    bench already consume — ``.shape`` (of the codes), ``.nbytes``
+    (codes + scales: the scale overhead is real HBM and must be billed),
+    and layer-range slicing (``pool[:, :n_layers]`` for the truncated
+    self-draft) — so quantization stays a pool-construction decision,
+    not an engine rewrite."""
+
+    def __init__(self, codes, scales, qdtype):
+        self.codes = codes
+        self.scales = scales
+        self.qdtype = str(qdtype)
+
+    @classmethod
+    def zeros(cls, shape, qdtype):
+        codes = jnp.zeros(shape, _quant.code_dtype(qdtype))
+        scales = jnp.zeros(tuple(shape[:-1]) + (1,), jnp.float32)
+        return cls(codes, scales, qdtype)
+
+    def tree_flatten(self):
+        return (self.codes, self.scales), self.qdtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def shape(self):
+        return self.codes.shape
+
+    @property
+    def dtype(self):
+        """The LOGICAL element dtype (what dequantization yields) —
+        shape/dtype introspection sites expect the math dtype, not the
+        storage dtype."""
+        return jnp.float32
+
+    @property
+    def nbytes(self):
+        return int(self.codes.nbytes) + int(self.scales.nbytes)
+
+    def __getitem__(self, idx):
+        # layer-range slicing for the self-draft's truncated gather:
+        # both leaves carry layers on axis 1, so one index applies to
+        # each (anything fancier than basic slicing should go through
+        # gather_pages, which dequantizes)
+        return QuantizedKVPool(self.codes[idx], self.scales[idx],
+                               self.qdtype)
+
+
 @jax.jit
 def _copy_page(pool, src, dst):
     """Device-side page copy for copy-on-write forks: duplicate page
-    ``src`` into page ``dst`` without a host round-trip."""
+    ``src`` into page ``dst`` without a host round-trip.  Quantized
+    pools copy codes AND scales, so the fork starts bit-identical and
+    every later write updates only its own scale rows — forked pages
+    keep independent scales."""
+    if isinstance(pool, QuantizedKVPool):
+        return QuantizedKVPool(
+            pool.codes.at[dst].set(pool.codes[src]),
+            pool.scales.at[dst].set(pool.scales[src]),
+            pool.qdtype)
     return pool.at[dst].set(pool[src])
 
 
@@ -68,8 +142,15 @@ def gather_pages(pool, block_tables):
     slot's pages concatenated in logical order along the time axis —
     the exact layout the dense decode/prefill math already expects, so
     the model code is shared verbatim between the slot and paged paths.
+    Quantized pools dequantize in-graph here (shared codec), so every
+    consumer — decode, prefill, speculative verify — always attends
+    float32 rows; the narrow dtype exists only at rest in the pool.
     """
-    g = pool[block_tables]                      # [S, MP, L, KV, PL, D]
+    if isinstance(pool, QuantizedKVPool):
+        g = _quant.dequantize_blocks(pool.codes[block_tables],
+                                     pool.scales[block_tables])
+    else:
+        g = pool[block_tables]                  # [S, MP, L, KV, PL, D]
     s, mp, l, kv, pl, d = g.shape
     return jnp.transpose(g, (0, 2, 3, 1, 4, 5)).reshape(s, l, kv, mp * pl, d)
 
@@ -79,7 +160,9 @@ def scatter_rows(pool, pages, offsets, rows):
     offsets[i])``.  Duplicate (page, offset) pairs only ever occur on
     the sentinel page 0 (inactive/padding lanes), where write order is
     irrelevant; live (page, offset) pairs are distinct by construction
-    of the allocator.
+    of the allocator.  Quantized pools quantize on write (shared
+    codec): each row's head_dim vector gets its own absmax scale, and
+    the codes/scales leaves are scattered with the same index pattern.
 
     Shared pages (refcount > 1) are read-only: a scatter into one would
     leak state between every request holding it.  The page indices here
@@ -89,6 +172,12 @@ def scatter_rows(pool, pages, offsets, rows):
     write-guard is armed (``HETU_COW_GUARD=1``, on in the test suite),
     after :meth:`PagedKVCache.ensure_writable` has had its chance to
     fork divergent writers off shared pages."""
+    if isinstance(pool, QuantizedKVPool):
+        codes, scales = _quant.quantize_blocks(rows, dtype=pool.qdtype)
+        return QuantizedKVPool(
+            pool.codes.at[pages, :, :, offsets, :].set(codes),
+            pool.scales.at[pages, :, :, offsets, :].set(scales),
+            pool.qdtype)
     return pool.at[pages, :, :, offsets, :].set(rows)
 
 
@@ -220,12 +309,20 @@ class PagedKVCache:
     the dense pool's worst case (every slot at full ``max_len``) plus
     the sentinel, i.e. strictly safe; servers size it down to their
     real mix.  ``label`` names this pool in metrics and in flight-
-    recorder incident dumps."""
+    recorder incident dumps.
+
+    ``kv_dtype`` (None | 'int8' | 'fp8') selects quantized page
+    storage: the pools become :class:`QuantizedKVPool` pairs (codes +
+    per-row scales), ``gather_pages`` dequantizes in-graph and
+    ``scatter_rows`` quantizes on write, and every byte figure this
+    class reports (HBM ledger, ``nbytes``) already includes the scale
+    overhead.  ``None`` (default) is the existing float32 path,
+    bitwise-untouched — quantization is strictly opt-in."""
 
     def __init__(self, n_slots, layers, kv_heads, page_len, head_dim,
                  max_len=128, n_pages=None, dtype=jnp.float32,
                  label=None, shards=1, put_sharding=None,
-                 cow_guard=None):
+                 cow_guard=None, kv_dtype=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if page_len < 1:
@@ -258,8 +355,15 @@ class PagedKVCache:
         self.put_sharding = put_sharding
         shape = (self.n_pages, self.layers, self.kv_heads, self.page_len,
                  self.head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        self.kv_dtype = None if kv_dtype is None else str(kv_dtype)
+        if self.kv_dtype is None:
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
+        else:
+            # raises up front on an unknown/unsupported codec (fp8 on a
+            # jax build without float8_e4m3fn) instead of at first write
+            self.k = QuantizedKVPool.zeros(shape, self.kv_dtype)
+            self.v = QuantizedKVPool.zeros(shape, self.kv_dtype)
         # host mirrors: write position + reserved token capacity per
         # slot, and the block tables the jitted programs consume.
         # Unused table entries stay 0 = the sentinel page.
@@ -325,6 +429,24 @@ class PagedKVCache:
             "Copy-on-write page forks, by pool: a slot diverged inside "
             "a shared prefix page and was given a private copy",
             labels=("pool",))
+        if self.kv_dtype is not None:
+            # the scale arrays are the price of quantized pages: report
+            # both sides so kv_hbm_bytes_per_token can be decomposed
+            # (codes shrink 4x, scales add head_dim-fraction overhead)
+            codes_b = int(self.k.codes.nbytes) + int(self.v.codes.nbytes)
+            scales_b = (int(self.k.scales.nbytes)
+                        + int(self.v.scales.nbytes))
+            reg.gauge(
+                "hetu_quant_kv_codes_bytes",
+                "Quantized KV page-pool code bytes (both pools), by "
+                "pool label", labels=("pool",)).labels(
+                pool=self.label).set(codes_b // self.shards)
+            reg.gauge(
+                "hetu_quant_kv_scales_bytes",
+                "Quantized KV page-pool scale bytes (the per-row "
+                "float32 absmax scales — quantization's HBM overhead), "
+                "by pool label", labels=("pool",)).labels(
+                pool=self.label).set(scales_b // self.shards)
         self._flight = telemetry.get_flight()
         self._flight.register_pages(self.label, self.occupancy)
         self._sync_gauges()

@@ -705,3 +705,112 @@ def test_one_stage_budget_preserves_finished_stage(tmp_path):
     # at least the headline stage (bert, first in run order) completed
     # or explicitly failed — it may not be PENDING in the final line
     assert compact["unit"] != "PENDING"
+
+
+def test_serve_quant_stage_emits_full_and_compact(tmp_path):
+    """`--serve --kv-dtype int8 --quick` must end in a compact
+    parseable line carrying the concurrency verdict, the divergence
+    gate, and both wire legs, with the full headline on the line above
+    AND mirrored to SERVE_QUANT_FULL.json."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_SERVE_QUANT_JSON"] = str(tmp_path / "quant.json")
+    env["HETU_PERF_HISTORY"] = str(tmp_path / "history.jsonl")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--serve", "--kv-dtype", "int8",
+         "--quick"],
+        capture_output=True, text=True, timeout=580, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    compact = json.loads(lines[-1])
+    assert len(lines[-1].encode()) <= 1500, \
+        "compact serve-quant line must fit the driver's stdout tail"
+    assert compact["metric"] == "serve_quant_peak_concurrency"
+    assert compact["kv_dtype"] == "int8"
+    assert {"conc", "conc_x", "kv_B_per_tok", "logit_div",
+            "greedy_attain", "wire_B_per_pull",
+            "compile_flat"} <= set(compact)
+    assert compact["compile_flat"] is True
+    full = json.loads(lines[-2])
+    with open(tmp_path / "quant.json") as f:
+        assert json.load(f) == full
+    # acceptance gates, re-checked from the emitted evidence
+    assert full["hbm"]["equal_hbm_budget"] is True
+    assert full["hbm"]["quant_pool_bytes"] <= full["hbm"]["f32_pool_bytes"]
+    assert full["vs_baseline"] >= 1.7 or \
+        full["signals"]["kv_quant_hbm_bytes_per_token"] <= 238.6
+    assert 0 < full["divergence"]["max_logit_div"] < 0.5
+    assert full["divergence"]["stream_agreement"] > 0.5
+    assert full["wire"]["within_bound"] is True
+    assert full["wire"]["q8_bytes_per_pull"] \
+        < full["wire"]["f4_bytes_per_pull"] // 2
+    assert {"serve_quant_tokens_per_s", "serve_quant_peak_concurrency",
+            "kv_quant_concurrency_x", "kv_quant_hbm_bytes_per_token",
+            "kv_quant_max_logit_div", "kv_quant_greedy_attainment",
+            "wire_bytes_per_pull", "tp_gather_bytes_per_step"} \
+        <= set(full["signals"])
+    # one flat-signals entry appended to the perf-diff history feed
+    with open(tmp_path / "history.jsonl") as f:
+        entries = [json.loads(ln) for ln in f if ln.strip()]
+    assert entries and set(entries[-1]["signals"]) == set(full["signals"])
+
+
+def test_serve_quant_aborted_run_preserves_prior_detail_file(tmp_path):
+    """SERVE_QUANT_FULL.json follows the no-clobber contract: a run
+    killed before reporting leaves the prior round's evidence intact."""
+    detail = tmp_path / "quant.json"
+    sentinel = {"metric": "serve_quant_peak_concurrency", "value": 12}
+    detail.write_text(json.dumps(sentinel))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_SERVE_QUANT_JSON"] = str(detail)
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--serve", "--kv-dtype", "int8",
+         "--quick"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        start_new_session=True)
+    try:
+        import time
+        time.sleep(1.0)        # inside jax import / engine build
+        os.killpg(os.getpgid(proc.pid), 9)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert json.loads(detail.read_text()) == sentinel
+
+
+def test_perf_diff_error_bound_signals_one_sided(tmp_path):
+    """error_bound signals (``*logit_div*``) gate one-sided: growth
+    past --tol-error-bound trips rc 1, shrink or equality passes, and
+    the tolerance flag widens the gate."""
+    diff = os.path.join(os.path.dirname(BENCH), "tools", "perf_diff.py")
+    base_doc = {"signals": {"serve.kv_quant_max_logit_div": 0.2,
+                            "serve.tokens_per_s": 100.0}}
+    (tmp_path / "base.json").write_text(json.dumps(base_doc))
+
+    def run(cur_div, *extra):
+        cur = {"signals": {"serve.kv_quant_max_logit_div": cur_div,
+                           "serve.tokens_per_s": 100.0}}
+        (tmp_path / "cur.json").write_text(json.dumps(cur))
+        return subprocess.run(
+            [sys.executable, diff,
+             "--current", str(tmp_path / "cur.json"),
+             "--baseline", str(tmp_path / "base.json"), "--json",
+             *extra],
+            capture_output=True, text=True, timeout=60)
+
+    # divergence grew 2x (>> default 25% tolerance): regression
+    proc = run(0.4)
+    assert proc.returncode == 1, proc.stdout[-2000:]
+    verdict = json.loads(proc.stdout)
+    bad = [r for r in verdict["table"] if r["regressed"]]
+    assert [r["signal"] for r in bad] \
+        == ["serve.kv_quant_max_logit_div"]
+    assert bad[0]["kind"] == "error_bound"
+    assert verdict["tolerances"]["error_bound"] == 0.25
+    # one-sided: a TIGHTER bound is an improvement, never a regression
+    assert run(0.05).returncode == 0
+    assert run(0.2).returncode == 0
+    # within the widened gate
+    assert run(0.4, "--tol-error-bound", "1.5").returncode == 0

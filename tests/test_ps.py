@@ -415,3 +415,111 @@ def test_partial_bulk_error_reports_applied_rows(monkeypatch):
     # resume contract: set_rows(keys[applied_rows:]) re-covers the
     # uncertain chunk and the unsent tail exactly
     assert calls == [10, 10, 10]
+
+
+# -- typed wire + quantized pull codec (ISSUE 16 leg b) ----------------------
+
+def _live_server(rows=64, dim=16, nworkers=None):
+    from hetu_tpu.ps.rpc import PSServer
+    return PSServer(EmbeddingTable(rows, dim, optimizer="sgd", lr=1.0,
+                                   init_scale=0),
+                    nworkers=nworkers).start()
+
+
+def test_reduce_roundtrips_mixed_dtype_pytree(rng):
+    """The coordinator's reduce keeps every leaf's SOURCE dtype on the
+    wire and in the reply: f32 stays f32, int32 counters come back
+    int32 with exact integral means (no lossy float encode), bf16
+    grads move at 2 bytes/element and average in f32."""
+    import threading
+
+    import jax.numpy as jnp
+    from hetu_tpu.ps.rpc import RemoteCoordinator
+
+    srv = _live_server(nworkers=2)
+    try:
+        def tree(w, ids, h):
+            return {"w": jnp.asarray(w, jnp.float32),
+                    "ids": jnp.asarray(ids, jnp.int32),
+                    "h": jnp.asarray(h, jnp.bfloat16)}
+
+        g0 = tree([[1.0, 2.0]], [2, 4, 6], [1.0, -2.0])
+        g1 = tree([[3.0, 6.0]], [4, 6, 8], [3.0, 0.0])
+        peer_out = {}
+
+        def peer():
+            c = RemoteCoordinator(srv.host, srv.port)
+            peer_out["v"] = c.reduce(7, 1, [0, 1], g1)
+            c.close()
+
+        th = threading.Thread(target=peer)
+        th.start()
+        coord = RemoteCoordinator(srv.host, srv.port)
+        out = coord.reduce(7, 0, [0, 1], g0)
+        th.join(timeout=30)
+        assert not th.is_alive()
+        for got in (out, peer_out["v"]):
+            assert got["w"].dtype == jnp.float32
+            assert got["ids"].dtype == jnp.int32
+            assert got["h"].dtype == jnp.bfloat16
+            np.testing.assert_allclose(np.asarray(got["w"]),
+                                       [[2.0, 4.0]], rtol=1e-6)
+            np.testing.assert_array_equal(np.asarray(got["ids"]),
+                                          [3, 5, 7])
+            np.testing.assert_array_equal(
+                np.asarray(got["h"], np.float32), [2.0, -1.0])
+        coord.close()
+    finally:
+        srv.stop()
+
+
+def test_q8_lookup_codec_parity_and_bytes(rng):
+    """The q8 pull codec round-trips within the shared codec's bound
+    and moves ~4x fewer payload bytes than raw f32 rows; the default
+    (codec=None) path stays bitwise."""
+    from hetu_tpu import telemetry
+    from hetu_tpu.ps.rpc import RemoteTable
+
+    srv = _live_server(rows=64, dim=16)
+    telemetry.get_registry().reset()
+    telemetry.enable()
+    try:
+        tf = RemoteTable(srv.host, srv.port)
+        tq = RemoteTable(srv.host, srv.port, codec="q8")
+        vals = rng.standard_normal((64, 16)).astype(np.float32)
+        tf.set_rows(np.arange(64), vals)
+
+        keys = rng.integers(0, 64, (32,))
+        rows_f = tf.lookup(keys)
+        np.testing.assert_array_equal(rows_f, vals[keys])
+        rows_q = tq.lookup(keys)
+        bound = np.abs(rows_f).max(axis=1, keepdims=True) / 127.0 * 0.5
+        assert (np.abs(rows_q - rows_f) <= bound + 1e-7).all()
+
+        # payload bytes: f32 rows vs int8 codes + one f32 scale per row
+        wire = keys.reshape(-1).astype("<i8")
+        f_bytes = sum(len(p) for p in
+                      tf._call({"verb": "lookup"}, wire)[1])
+        q_bytes = sum(len(p) for p in
+                      tq._call({"verb": "lookup", "codec": "q8"},
+                               wire)[1])
+        assert f_bytes == keys.size * 16 * 4
+        assert q_bytes == keys.size * 16 + keys.size * 4
+        assert q_bytes * 3 < f_bytes
+
+        # both pulls billed to the per-codec wire counter
+        snap = telemetry.get_registry().snapshot()
+        samples = {s["labels"]["codec"]: s["value"] for s in
+                   snap["hetu_quant_wire_pull_bytes_total"]["samples"]}
+        assert samples["f4"] > 0 and samples["q8"] > 0
+
+        # empty pulls keep the codec's shape contract
+        assert tq.lookup(np.array([], np.int64)).shape == (0, 16)
+
+        with pytest.raises(ValueError, match="codec"):
+            RemoteTable(srv.host, srv.port, codec="zstd")
+        tf.close()
+        tq.close()
+    finally:
+        telemetry.disable()
+        srv.stop()

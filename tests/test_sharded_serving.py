@@ -322,3 +322,64 @@ def test_sharded_packed_lookup_bitwise(rng):
     with pytest.raises(ValueError, match="divide"):
         sharded_packed_lookup(mesh, packed,
                               jnp.asarray(ids[:30]), 16)
+
+
+# -- quantized TP gathers (ISSUE 16 leg c) -----------------------------------
+
+def test_quant_gather_tp2_streams_within_divergence_gate(rng):
+    """gather_dtype='int8' moves the replicate-back all-gathers as
+    block-quantized codes + per-shard scales.  That trades the bitwise
+    oracle for a BOUNDED divergence: streams must all complete, most
+    must still match the unquantized TP twin on this tiny model, and
+    the audit must balance.  The f32 mesh path itself stays bitwise
+    (the test above), so the relaxation is strictly opt-in."""
+    ex, model = _llama("shq")
+    prompts = _prompts(rng, 6)
+    tp = InferenceEngine(ex, model, name="shq", mesh=serving_mesh(2),
+                         instance="f32", **_EKW)
+    qt = InferenceEngine(ex, model, name="shq", mesh=serving_mesh(2),
+                         instance="q8", gather_dtype="int8", **_EKW)
+    outs_f = tp.generate_many(prompts, 8)
+    outs_q = qt.generate_many(prompts, 8)
+    assert all(len(o) == 8 for o in outs_q)
+    agree = sum(list(a) == list(b) for a, b in zip(outs_f, outs_q))
+    assert agree >= len(prompts) // 2
+    a = qt.cache.audit()
+    assert a["page_allocs"] == a["page_frees"] and a["in_use"] == 0
+
+
+def test_quant_gather_program_key_distinct_from_f32_mesh(rng):
+    """A quantized-gather engine must not reuse the f32 mesh twin's
+    executables (different math), and the f32 twin's key must carry no
+    quantization marker (compile sharing with pre-quant builds)."""
+    ex, model = _llama("shqk")
+    tp = InferenceEngine(ex, model, name="shqk", mesh=serving_mesh(2),
+                         instance="f32", **_EKW)
+    qt = InferenceEngine(ex, model, name="shqk", mesh=serving_mesh(2),
+                         instance="q8", gather_dtype="int8", **_EKW)
+    assert tp._program_key() != qt._program_key()
+    assert "gather_dtype" not in str(tp._program_key())
+
+
+def test_make_gather_quant_bounded_per_shard_block(rng):
+    """The gather hook itself: quantizing a [.., d] activation with one
+    block per shard keeps the round-trip within the codec bound per
+    block, and an un-divisible width falls back to a whole-axis block
+    instead of failing."""
+    import jax.numpy as jnp
+    from hetu_tpu.models._decode_common import make_gather
+    from hetu_tpu.serving import serving_mesh as _sm
+
+    mesh = _sm(2)
+    g = make_gather(mesh, quant_dtype="int8")
+    x = rng.normal(scale=2.0, size=(3, 16)).astype(np.float32)
+    y = np.asarray(g(jnp.asarray(x)))
+    blocked = x.reshape(3, 2, 8)
+    bound = np.abs(blocked).max(-1, keepdims=True) / 127.0 * 0.5
+    assert (np.abs(y.reshape(3, 2, 8) - blocked) <= bound + 1e-7).all()
+    odd = rng.normal(size=(2, 7)).astype(np.float32)
+    yo = np.asarray(g(jnp.asarray(odd)))
+    bo = np.abs(odd).max(-1, keepdims=True) / 127.0 * 0.5
+    assert (np.abs(yo - odd) <= bo + 1e-7).all()
+    with pytest.raises(ValueError):
+        make_gather(mesh, quant_dtype="int4")

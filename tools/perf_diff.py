@@ -23,6 +23,12 @@ direction-aware per-signal tolerances:
   higher is better and ONE-SIDED in absolute points on a [0, 1] scale —
   a regression is current < baseline - tol_attainment (default 0.05 =
   5 points); gains never fail.
+* error-bound signals (``*logit_div*``, from ``bench.py --serve
+  --kv-dtype``): a committed numerical-divergence budget, lower is
+  better and ONE-SIDED — a regression is current > baseline *
+  (1 + tol_error_bound); the quantized twin drifting further from its
+  f32 reference than the committed bound is a quality regression, while
+  shrinking divergence never fails.
 * informational signals (``*shed_fraction*``): reported, never gating —
   how much the SLO controller shed is context for the attainment
   number, not independently good or bad.
@@ -61,6 +67,13 @@ THROUGHPUT_MARKERS = (".mfu", "_per_sec", "concurrency")
 THROUGHPUT_SUFFIXES = ("_per_s",)
 #: higher-is-better one-sided signals compared in absolute points
 ATTAINMENT_MARKERS = ("attainment",)
+#: lower-is-better one-sided DIVERGENCE signals (quantized-twin
+#: max-logit divergence from ``--serve --kv-dtype``): only GROWTH past
+#: the committed bound fails — a quantization codec drifting is a
+#: quality bug, a tighter round never is.  Checked before the generic
+#: static class so the loose error tolerance (quantization error is
+#: noisy across traces) doesn't inherit static's 1%.
+ERROR_BOUND_MARKERS = ("logit_div",)
 #: context-only signals that never gate.  Numerics signals (per-layer
 #: grad/update-norm drift, anomaly counts from the NumericsMonitor) are
 #: model-health evidence, not performance — history rounds carry them
@@ -82,15 +95,18 @@ SPEEDUP_MARKERS = ("speedup",)
 
 
 def classify(name, platform=None):
-    """'attainment' (higher is better, absolute one-sided), 'info'
-    (never gates), 'throughput' (higher is better, ratio), or 'static'
-    (lower is better, ratio).  Speedup signals are throughput on a real
-    TPU mesh and informational anywhere else (forced-host CPU devices
+    """'attainment' (higher is better, absolute one-sided),
+    'error_bound' (lower is better, one-sided growth), 'info' (never
+    gates), 'throughput' (higher is better, ratio), or 'static' (lower
+    is better, ratio).  Speedup signals are throughput on a real TPU
+    mesh and informational anywhere else (forced-host CPU devices
     time-share the same cores)."""
     if any(m in name for m in SPEEDUP_MARKERS):
         return "throughput" if platform == "tpu" else "info"
     if any(m in name for m in ATTAINMENT_MARKERS):
         return "attainment"
+    if any(m in name for m in ERROR_BOUND_MARKERS):
+        return "error_bound"
     if any(m in name for m in INFO_MARKERS):
         return "info"
     if (any(m in name for m in THROUGHPUT_MARKERS)
@@ -131,7 +147,8 @@ def load_history_entry(path, index):
 
 
 def diff_signals(current, baseline, tol_throughput, tol_static,
-                 tol_attainment=0.05, platform=None):
+                 tol_attainment=0.05, platform=None,
+                 tol_error_bound=0.25):
     """Per-signal verdicts: [{signal, kind, current, baseline, ratio,
     regressed}] for shared signals, plus the one-sided names.
     ``platform`` is the CURRENT round's backend — it decides whether
@@ -152,6 +169,14 @@ def diff_signals(current, baseline, tol_throughput, tol_static,
             # wiggle as a 50% collapse)
             ratio = None if base == 0 else cur / base
             regressed = (base - cur) > tol_attainment
+        elif kind == "error_bound":
+            # one-sided GROWTH check: divergence swelling past the
+            # committed bound fails; a baseline of 0 (exact twin) can't
+            # scale a tolerance, and punishing any nonzero drift against
+            # it would make the gate un-meetable — first nonzero rounds
+            # re-commit the bound instead
+            ratio = None if base == 0 else cur / base
+            regressed = base > 0 and cur > base * (1.0 + tol_error_bound)
         elif kind == "info":
             ratio = None if base == 0 else cur / base
             regressed = False
@@ -205,6 +230,9 @@ def main(argv=None):
                     help="allowed absolute DROP of an attainment "
                          "signal, in fractions of 1 (default 0.05 = "
                          "5 points)")
+    ap.add_argument("--tol-error-bound", type=float, default=0.25,
+                    help="allowed fractional GROWTH of an error-bound "
+                         "divergence signal (default 0.25)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full verdict table as JSON")
     args = ap.parse_args(argv)
@@ -246,7 +274,8 @@ def main(argv=None):
 
     rows, only_cur, only_base = diff_signals(
         current, baseline, args.tol_throughput, args.tol_static,
-        args.tol_attainment, platform=platform)
+        args.tol_attainment, platform=platform,
+        tol_error_bound=args.tol_error_bound)
     regressions = [r for r in rows if r["regressed"]]
     summary = {"status": "regressed" if regressions else "ok",
                "baseline": baseline_src,
@@ -254,7 +283,8 @@ def main(argv=None):
                "regressions": len(regressions),
                "tolerances": {"throughput": args.tol_throughput,
                               "static": args.tol_static,
-                              "attainment": args.tol_attainment},
+                              "attainment": args.tol_attainment,
+                              "error_bound": args.tol_error_bound},
                "new_signals": only_cur,
                "missing_signals": only_base}
     if args.json:
