@@ -2240,6 +2240,285 @@ def _emit_serve_quant(out):
     _print_compact(compact, drop_order=("tp_gather_B", "pool_ratio"))
 
 
+# -- serve-migrate mode (bench.py --serve --fleet --migrate) ---------------
+# Live KV page migration evidence (ROADMAP direction 2, the
+# disaggregation half): a mid-decode request's refcounted pages move to
+# a sibling replica as a CRC32-framed blob (serving/kv_transfer.py) and
+# the stream continues BITWISE where it left off.  Three stages:
+#
+# * ab          — the handoff A/B: snapshot -> splice -> ack on a live
+#                 request at T generated tokens, timed against the
+#                 teacher-forced replay rebuild of the same stream on an
+#                 identical sibling.  migrate_vs_replay_speedup is the
+#                 headline (perf_diff gates it one-sided at 1.0: live
+#                 migration must never be slower than the PR 12 replay
+#                 oracle it falls back to), migrate_bytes_per_token the
+#                 static wire-cost signal.
+# * drain       — scale-down A/B on a manual fleet: drain(migrate=True)
+#                 moves the decode tail NOW vs drain(migrate=False)
+#                 waiting it out; both parity-checked against an
+#                 uninterrupted oracle.
+# * failover    — crash the warm replica of a prefix-cached pair: live
+#                 streams re-home by PAGE MIGRATION (not replay), the
+#                 quarantined replica's interned prefixes re-install on
+#                 the survivor, and the warm prompt still hits.
+#
+# Detail -> MIGRATE_FULL.json under the BENCH_FULL no-clobber contract;
+# signals append to benchmarks/history.jsonl for tools/perf_diff.py.
+
+SERVE_MIGRATE_DETAIL_PATH = os.environ.get(
+    "HETU_MIGRATE_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "MIGRATE_FULL.json"))
+
+#: paged engines only — page migration is a block-table splice
+_MIG_EKW = dict(n_slots=4, max_len=32, max_prompt_len=8, name="serve",
+                paged=True, page_len=4)
+
+
+def _migrate_prompts(rng, n, vocab, lo=3, hi=8):
+    return [rng.integers(1, vocab, (int(L),)).astype(np.int32)
+            for L in rng.integers(lo, hi, n)]
+
+
+def _migrate_ab_stage(ex, model, c, quick, seed):
+    """Handoff A/B (see section comment): median over n_probe live
+    requests, each decoded to T tokens on a donor, then (a) page-
+    migrated and (b) replay-rebuilt onto identical siblings; both
+    continuations must finish bitwise equal to the uninterrupted
+    oracle."""
+    from hetu_tpu.serving import InferenceEngine
+    from hetu_tpu.serving import kv_transfer as kvt
+
+    rng = np.random.default_rng(seed)
+    n_probe = 4 if quick else 8
+    T = 6 if quick else 12
+    max_new = T + 6
+    prompts = _migrate_prompts(rng, n_probe, c.vocab_size)
+    oracle_eng = InferenceEngine(ex, model, instance="mig.oracle",
+                                 **_MIG_EKW)
+    oracle = oracle_eng.generate_many(prompts, max_new)
+    oracle_eng.close()
+
+    donor = InferenceEngine(ex, model, instance="mig.donor", **_MIG_EKW)
+    recv_m = InferenceEngine(ex, model, instance="mig.recv", **_MIG_EKW)
+    recv_r = InferenceEngine(ex, model, instance="mig.replay",
+                             **_MIG_EKW)
+    mig_t, rep_t, blob_b, tok_cov = [], [], [], []
+    parity = True
+    try:
+        for i, p in enumerate(prompts):
+            req = donor.submit(p, max_new)
+            while len(req.tokens) < T:
+                donor.step()
+            # live path: serialize -> CRC frame -> splice -> ack
+            t0 = time.perf_counter()
+            blob = kvt.snapshot_request(donor, req)
+            adopted = kvt.resume_request(recv_m, blob)
+            mig_t.append(time.perf_counter() - t0)
+            donor.release_migrated(req.rid)
+            blob_b.append(len(blob))
+            tok_cov.append(len(p) + len(req.tokens))
+            # replay path: re-prefill + teacher-force the same stream
+            replay = np.asarray(req.tokens, np.int32)
+            t0 = time.perf_counter()
+            rr = recv_r.submit(p, max_new, replay=replay)
+            while len(rr.tokens) < len(replay):
+                recv_r.step()
+            rep_t.append(time.perf_counter() - t0)
+            recv_m.run(max_iterations=300)
+            recv_r.run(max_iterations=300)
+            parity = (parity
+                      and np.array_equal(adopted.result(), oracle[i])
+                      and np.array_equal(rr.result(), oracle[i]))
+    finally:
+        for e in (donor, recv_m, recv_r):
+            e.close()
+    med_m, med_r = float(np.median(mig_t)), float(np.median(rep_t))
+    return {"n_probe": n_probe, "tokens_at_handoff": T,
+            "migrate_ms_median": round(med_m * 1e3, 3),
+            "replay_ms_median": round(med_r * 1e3, 3),
+            "speedup": round(med_r / max(med_m, 1e-9), 3),
+            "blob_bytes_mean": int(np.mean(blob_b)),
+            "bytes_per_token": round(
+                float(np.sum(blob_b)) / max(1, sum(tok_cov)), 1),
+            "bitwise_parity": bool(parity)}
+
+
+def _migrate_drain_stage(ex, model, c, quick, seed):
+    """Scale-down A/B: two identical manual fleets mid-decode; one
+    drains its busiest replica with migrate=True (tail moves NOW), the
+    twin waits the tail out.  Both runs' streams must match the
+    uninterrupted oracle."""
+    import warnings
+    from hetu_tpu.serving import EngineFleet, InferenceEngine
+
+    rng = np.random.default_rng(seed + 7)
+    # fewer requests than one replica's slots: the survivor must have
+    # FREE slots to adopt into (adoption cannot queue the way replay
+    # can), so a full fleet would silently fall back to waiting
+    n_req = 3 if quick else 4
+    max_new = 24    # a long decode tail: what migrate-then-drain skips
+    prompts = _migrate_prompts(rng, n_req, c.vocab_size)
+    oracle_eng = InferenceEngine(ex, model, instance="mig.drain.oracle",
+                                 **_MIG_EKW)
+    oracle = oracle_eng.generate_many(prompts, max_new)
+    oracle_eng.close()
+
+    def episode(migrate):
+        fleet = EngineFleet(ex, model, n_engines=2,
+                            engine_kwargs=_MIG_EKW, threaded=False,
+                            name=f"migdrain{int(migrate)}")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            reqs = [fleet.submit(p, max_new) for p in prompts]
+            fleet.pump(3)
+            busy = max(fleet._replicas, key=lambda r: len(r.inflight))
+            held = len(busy.inflight)
+            t0 = time.perf_counter()
+            fleet.drain(busy.name, wait=True, migrate=migrate)
+            dt = time.perf_counter() - t0
+            fleet.wait(reqs, timeout=120)
+        s = fleet.stats()
+        par = all(np.array_equal(r.result(), o)
+                  for r, o in zip(reqs, oracle))
+        audits = fleet.audit()
+        balanced = all(a["allocs"] == a["frees"] and a["in_use"] == 0
+                       for a in audits.values())
+        fleet.stop()
+        return {"drain_s": round(dt, 4), "held_at_drain": held,
+                "migrations": s["migrations"],
+                "bitwise_parity": bool(par),
+                "slot_audit_balanced": bool(balanced)}
+
+    mig, wait = episode(True), episode(False)
+    # a time RATIO, not a gated speedup: on the quick CPU shapes the
+    # waited-out tail is single-digit milliseconds, too close to the
+    # handoff cost to gate — trend context (perf_diff 'info')
+    return {"migrate": mig, "wait": wait,
+            "drain_time_ratio": round(
+                wait["drain_s"] / max(mig["drain_s"], 1e-9), 3)}
+
+
+def _migrate_failover_stage(ex, model, c, quick, seed):
+    """Crash the warm replica of a prefix-cached pair mid-decode: live
+    requests re-home by page migration (stats show migrations, not just
+    replays), the victim's interned prefixes re-install on the
+    survivor, and the shared warm prompt still prefix-hits there."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import EngineFleet, InferenceEngine
+
+    rng = np.random.default_rng(seed + 13)
+    max_new = 10
+    warm = np.arange(1, 9, dtype=np.int32)      # two full pages
+    prompts = _migrate_prompts(rng, 3 if quick else 5, c.vocab_size)
+    ekw = dict(_MIG_EKW, prefix_cache=True)
+    oracle_eng = InferenceEngine(ex, model,
+                                 instance="mig.fo.oracle", **ekw)
+    oracle = oracle_eng.generate_many([warm] + prompts, max_new)
+    oracle_eng.close()
+
+    fleet = EngineFleet(ex, model, n_engines=2, engine_kwargs=ekw,
+                        threaded=False, breaker_base=1e-4,
+                        name="migfo")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        # warm the prefix cache on whichever replica takes the warm rid
+        wreq = fleet.submit(warm, max_new)
+        fleet.wait([wreq], timeout=60)
+        victim = fleet._by_name(wreq.engine)
+        reqs = [fleet.submit(p, max_new) for p in prompts]
+        fleet.pump(3)
+        faults.crash_engine(victim.engine)
+        fleet.wait(reqs, timeout=120)
+    s = fleet.stats()
+    survivor = next(r for r in fleet._replicas if r is not victim)
+    hit = 0
+    if survivor.engine is not None \
+            and survivor.engine.prefix_cache is not None:
+        hit = int(survivor.engine.prefix_cache.hit_tokens(warm))
+    par = all(np.array_equal(r.result(), o)
+              for r, o in zip([wreq] + reqs, oracle))
+    fleet.stop()
+    return {"migrations": s["migrations"],
+            "migration_failures": s["migration_failures"],
+            "prefix_handoffs": s["prefix_handoffs"],
+            "failovers": s["failovers"],
+            "warm_prefix_hit_tokens": hit,
+            "warm_prefix_len": int(warm.size),
+            "prefix_hit_rate_after_crash": round(
+                hit / float(warm.size), 4),
+            "bitwise_parity": bool(par)}
+
+
+def run_serve_migrate(quick=False, seed=0):
+    import jax
+
+    ex, model, c = _serve_build(quick)
+    ab = _migrate_ab_stage(ex, model, c, quick, seed)
+    drain = _migrate_drain_stage(ex, model, c, quick, seed)
+    failover = _migrate_failover_stage(ex, model, c, quick, seed)
+    signals = {
+        "migrate_vs_replay_speedup": ab["speedup"],
+        "migrate_bytes_per_token": ab["bytes_per_token"],
+        "migrate_drain_time_ratio": drain["drain_time_ratio"],
+        "migrate_prefix_hit_rate": failover[
+            "prefix_hit_rate_after_crash"],
+    }
+    parity = bool(ab["bitwise_parity"]
+                  and drain["migrate"]["bitwise_parity"]
+                  and drain["wait"]["bitwise_parity"]
+                  and failover["bitwise_parity"])
+    return {"metric": "migrate_vs_replay_speedup",
+            "value": ab["speedup"], "unit": "x",
+            "vs_baseline": ab["speedup"],  # replay IS the baseline
+            "platform": jax.default_backend(),
+            "seed": seed, "quick": bool(quick),
+            "bitwise_parity": parity,
+            "stages": {"ab": ab, "drain": drain,
+                       "failover": failover},
+            "signals": signals}
+
+
+def _emit_serve_migrate(out):
+    """Layered emission (same contract as _emit_serve_quant): full
+    headline + MIGRATE_FULL.json after real results, signals appended
+    to benchmarks/history.jsonl, compact tail line."""
+    from hetu_tpu.telemetry import JsonlWriter
+    full = json.dumps(out)
+    try:
+        with open(SERVE_MIGRATE_DETAIL_PATH, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass
+    if out.get("signals"):
+        entry = {"t": round(time.time(), 3), "platform": out["platform"],
+                 "quick": out["quick"], "seed": out["seed"],
+                 "signals": out["signals"]}
+        try:
+            os.makedirs(os.path.dirname(HISTORY_PATH) or ".",
+                        exist_ok=True)
+            with JsonlWriter(HISTORY_PATH) as w:  # append, never truncate
+                w.write(entry)
+        except OSError:
+            pass
+    print(full, flush=True)
+    sg = out["signals"]
+    ab = out["stages"]["ab"]
+    compact = {"metric": out["metric"], "value": out["value"],
+               "unit": out["unit"],
+               "migrate_ms": ab["migrate_ms_median"],
+               "replay_ms": ab["replay_ms_median"],
+               "B_per_tok": sg["migrate_bytes_per_token"],
+               "drain_x": sg["migrate_drain_time_ratio"],
+               "prefix_hit": sg["migrate_prefix_hit_rate"],
+               "bitwise": out["bitwise_parity"],
+               "platform": out["platform"],
+               "detail": os.path.basename(SERVE_MIGRATE_DETAIL_PATH)}
+    _print_compact(compact, drop_order=("prefix_hit", "drain_x"))
+
+
 # -- embedding-serve mode (bench.py --serve-embed) -------------------------
 # Tiered-embedding serving evidence (ROADMAP direction 5): replay one
 # seeded Zipfian key trace (Criteo-shaped skew) through the
@@ -3306,14 +3585,16 @@ FLEET_DETAIL_PATH = os.environ.get(
 _FLEET_EKW = dict(n_slots=2, max_len=32, max_prompt_len=8, name="serve")
 
 
-def _fleet_baseline(ex, model, prompts, max_new, seed, instance="base"):
+def _fleet_baseline(ex, model, prompts, max_new, seed, instance="base",
+                    ekw=None):
     """Uninterrupted single-engine greedy streams — the parity oracle
     every failover stage compares against (shared compile-once programs
-    make the comparison bitwise)."""
+    make the comparison bitwise).  ``ekw`` overrides the engine kwargs
+    (the migration stages need a PAGED twin)."""
     from hetu_tpu.serving import InferenceEngine
 
     eng = InferenceEngine(ex, model, seed=seed, instance=instance,
-                          **_FLEET_EKW)
+                          **(_FLEET_EKW if ekw is None else ekw))
     return eng.generate_many(prompts, max_new)
 
 
@@ -3627,6 +3908,145 @@ def _chaos_fleet_slo_controller(ex, model, c, seed):
             "accepted": len(reqs) + len(doomed), **detail}
 
 
+#: paged replicas for the KV-migration chaos stages — page migration is
+#: a block-table splice, so the dense-slot _FLEET_EKW can't carry it;
+#: n_slots=4 leaves receivers FREE slots to adopt into
+_MIG_FLEET_EKW = dict(_FLEET_EKW, n_slots=4, paged=True, page_len=4)
+
+
+def _chaos_fleet_transfer_drop(ex, model, c, seed):
+    """Every migration blob vanishes in flight (dropped frames): page
+    migration fails LOUDLY — TransferError, migrate_failed incident,
+    counted failure — and teacher-forced replay takes over with zero
+    accepted-rid loss and the same bitwise streams."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import EngineFleet
+
+    rng = np.random.default_rng(seed + 55)
+    prompts = _chaos_serve_prompts(rng, 4, c.vocab_size)
+    baseline = _fleet_baseline(ex, model, prompts, 10, seed,
+                               instance="base.tdrop",
+                               ekw=_MIG_FLEET_EKW)
+    fleet = EngineFleet(ex, model, n_engines=3,
+                        engine_kwargs=_MIG_FLEET_EKW, threaded=False,
+                        breaker_base=1e-4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reqs = [fleet.submit(p, 10) for p in prompts]
+        fleet.pump(3)
+        # each injector drops the FIRST transfer it sees, so a stack of
+        # them swallows every blob this stage can produce
+        for _ in range(8):
+            faults.drop_transfer(fleet, at=0)
+        victim = max(fleet._replicas, key=lambda r: len(r.inflight))
+        in_flight = len(victim.inflight)
+        faults.crash_engine(victim.engine)
+        fleet.wait(reqs, timeout=240)
+    s = fleet.stats()
+    ok, detail = _fleet_checks(fleet, reqs, baseline)
+    recovered = (ok and s["migrations"] == 0
+                 and s["migration_failures"] >= 1
+                 and s["failovers"] >= in_flight)
+    fleet.stop()
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "in_flight_at_crash": in_flight,
+            "migrations": s["migrations"],
+            "migration_failures": s["migration_failures"],
+            "failovers": s["failovers"], **detail}
+
+
+def _chaos_fleet_transfer_corrupt(ex, model, c, seed):
+    """Every migration blob takes a flipped byte mid-wire: the CRC32
+    frame rejects it (no silently-adopted garbage pages) and replay
+    restores the streams bitwise."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import EngineFleet
+
+    rng = np.random.default_rng(seed + 66)
+    prompts = _chaos_serve_prompts(rng, 4, c.vocab_size)
+    baseline = _fleet_baseline(ex, model, prompts, 10, seed,
+                               instance="base.tcorrupt",
+                               ekw=_MIG_FLEET_EKW)
+    fleet = EngineFleet(ex, model, n_engines=3,
+                        engine_kwargs=_MIG_FLEET_EKW, threaded=False,
+                        breaker_base=1e-4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reqs = [fleet.submit(p, 10) for p in prompts]
+        fleet.pump(3)
+        # corrupted bytes flow through the whole filter chain, so each
+        # injector must target a DISTINCT transfer index — and an even
+        # stack of same-byte XOR flips on one blob would cancel out
+        for i in range(8):
+            faults.corrupt_transfer(fleet, at=i)
+        victim = max(fleet._replicas, key=lambda r: len(r.inflight))
+        in_flight = len(victim.inflight)
+        faults.crash_engine(victim.engine)
+        fleet.wait(reqs, timeout=240)
+    s = fleet.stats()
+    ok, detail = _fleet_checks(fleet, reqs, baseline)
+    recovered = (ok and s["migrations"] == 0
+                 and s["migration_failures"] >= 1
+                 and s["failovers"] >= in_flight)
+    fleet.stop()
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "in_flight_at_crash": in_flight,
+            "migrations": s["migrations"],
+            "migration_failures": s["migration_failures"],
+            "failovers": s["failovers"], **detail}
+
+
+def _chaos_fleet_donor_crash(ex, model, c, seed):
+    """The donor dies MID-MIGRATION (scale-down drain): the first blob
+    never lands (the wire died with the donor) and the stream it
+    carried re-homes by replay off the corpse's quarantine; later
+    streams still escape by page migration — the donor's host-side
+    state outlives its wedged device step."""
+    import warnings
+    from hetu_tpu.resilience import faults
+    from hetu_tpu.serving import EngineFleet
+
+    rng = np.random.default_rng(seed + 77)
+    prompts = _chaos_serve_prompts(rng, 4, c.vocab_size)
+    baseline = _fleet_baseline(ex, model, prompts, 10, seed,
+                               instance="base.tdonor",
+                               ekw=_MIG_FLEET_EKW)
+    fleet = EngineFleet(ex, model, n_engines=3,
+                        engine_kwargs=_MIG_FLEET_EKW, threaded=False,
+                        breaker_base=1e-4)
+    state = {"fired": False}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        reqs = [fleet.submit(p, 10) for p in prompts]
+        fleet.pump(3)
+        victim = max(fleet._replicas, key=lambda r: len(r.inflight))
+        in_flight = len(victim.inflight)
+
+        def die_mid_transfer(blob):
+            if not state["fired"]:
+                state["fired"] = True
+                faults.crash_engine(victim.engine)
+                return None     # the wire died with the donor
+            return blob
+
+        fleet.transfer_filter = die_mid_transfer
+        fleet.drain(victim.name, wait=False, migrate=True)
+        fleet.wait(reqs, timeout=240)
+    s = fleet.stats()
+    ok, detail = _fleet_checks(fleet, reqs, baseline)
+    recovered = (ok and state["fired"]
+                 and s["migration_failures"] >= 1)
+    fleet.stop()
+    return {"faults_injected": 1, "faults_recovered": int(recovered),
+            "in_flight_at_drain": in_flight,
+            "donor_crashed_mid_transfer": bool(state["fired"]),
+            "migrations": s["migrations"],
+            "migration_failures": s["migration_failures"],
+            "failovers": s["failovers"], **detail}
+
+
 def run_chaos_fleet(quick=False, seed=0):
     import jax
 
@@ -3645,6 +4065,12 @@ def run_chaos_fleet(quick=False, seed=0):
                                        model, c, seed, quick)
     stages["slo_controller"] = _staged(_chaos_fleet_slo_controller, ex,
                                        model, c, seed)
+    stages["transfer_drop"] = _staged(_chaos_fleet_transfer_drop, ex,
+                                      model, c, seed)
+    stages["transfer_corrupt"] = _staged(_chaos_fleet_transfer_corrupt,
+                                         ex, model, c, seed)
+    stages["donor_crash_mid_migration"] = _staged(
+        _chaos_fleet_donor_crash, ex, model, c, seed)
     out = {"metric": "chaos_fleet_resilience",
            "value": sum(s["faults_recovered"] for s in stages.values()),
            "unit": "faults_recovered",
@@ -3944,6 +4370,15 @@ def main():
         quick = quick or jax.default_backend() == "cpu"
         if telemetry_on:
             _telemetry_on()
+        if "--migrate" in sys.argv:
+            # --serve --fleet --migrate: live KV page migration A/B vs
+            # the teacher-forced replay oracle (MIGRATE_FULL.json)
+            out = run_serve_migrate(quick)
+            if telemetry_on:
+                out["telemetry"] = _telemetry_report()
+                _assert_rid_audit(out["telemetry"])
+            _emit_serve_migrate(out)
+            return
         if "--spec" in sys.argv:
             out = run_serve_spec(quick)
             if telemetry_on:

@@ -321,6 +321,80 @@ def slow_engine(engine, seconds):
     return lambda: setattr(engine, "_step_fn", orig)
 
 
+# -- KV transfer faults (serving/kv_transfer.py wire) -----------------------
+# The fleet routes every migration blob through ``fleet.transfer_filter``
+# when one is set; these injectors compose with whatever filter was
+# already installed and return an undo callable like everything above.
+
+def _wrap_transfer(fleet, fn):
+    prev = fleet.transfer_filter
+
+    def filt(blob):
+        if prev is not None:
+            blob = prev(blob)
+            if blob is None:
+                return None
+        return fn(blob)
+
+    fleet.transfer_filter = filt
+    return lambda: setattr(fleet, "transfer_filter", prev)
+
+
+def drop_transfer(fleet, at=0):
+    """Make the fleet's ``at``-th KV migration transfer (0-based,
+    counted from now) vanish in flight — the network ate it.  The
+    receiver never sees bytes; the fleet must fall back to
+    teacher-forced replay with zero stream divergence."""
+    state = {"n": 0}
+
+    def fn(blob):
+        n = state["n"]
+        state["n"] += 1
+        return None if n == int(at) else blob
+
+    return _wrap_transfer(fleet, fn)
+
+
+def corrupt_transfer(fleet, at=0):
+    """Flip one byte in the middle of the ``at``-th migration blob —
+    bit rot in transit.  The CRC32 frame walk on the receiver must
+    reject it loudly (TransferError), leaving both pools untouched."""
+    state = {"n": 0}
+
+    def fn(blob):
+        n = state["n"]
+        state["n"] += 1
+        if n != int(at):
+            return blob
+        b = bytearray(blob)
+        b[len(b) // 2] ^= 0xFF
+        return bytes(b)
+
+    return _wrap_transfer(fleet, fn)
+
+
+#: keep enough bytes that the magic survives — the failure under test
+#: is a TORN FRAME, not a non-blob
+_TRANSFER_MAGIC = b"HTKV1"
+
+
+def tear_transfer(fleet, at=0, frac=0.5):
+    """Truncate the ``at``-th migration blob to ``frac`` of its bytes —
+    the sender died mid-write.  The receiver's frame walk must reject
+    the torn frame, never a partial splice."""
+    state = {"n": 0}
+
+    def fn(blob):
+        n = state["n"]
+        state["n"] += 1
+        if n != int(at):
+            return blob
+        return blob[:max(len(_TRANSFER_MAGIC),
+                         int(len(blob) * float(frac)))]
+
+    return _wrap_transfer(fleet, fn)
+
+
 # -- files & process -------------------------------------------------------
 
 def tear_file(path, frac=0.5, keep_bytes=None):

@@ -47,6 +47,22 @@ cache over the PS table tier, packed-lookup scoring, and
 ``bench.py --serve-embed`` replays a seeded Zipfian key trace against
 an uncached host-tier twin.
 
+The fleet also moves LIVE state between replicas (kv_transfer.py): a
+mid-decode request's refcounted KV pages — raw float32 rows or the
+quantized pool's codes + scales — serialize into a CRC32-framed blob
+that splices into a sibling's pool and continues the stream BITWISE
+where it left off (paged sampling keys fold only the per-request seed
+and consumed count).  Four robustness paths ride the wire:
+prefill→decode handoff in role-split fleets (``EngineFleet(roles=)``),
+page-level failover after a crash, SLO-driven decode rebalancing
+(``fleet.rebalance``), and migrate-then-drain scale-down
+(``drain(migrate=True)``); the quarantined replica's prefix cache is
+re-interned on a sibling the same way.  Any transfer failure — torn or
+corrupt frame, geometry drift, a full receiver — raises
+:class:`~.kv_transfer.TransferError` and the fleet falls back to
+teacher-forced replay, so migration is strictly no worse than the
+PR 12 failover oracle.
+
 Above the fleet sits the SLO control plane (control.py): a declared
 :class:`~.control.SLO` plus a :class:`~.control.FleetController` that
 autoscales replicas, sheds provably-infeasible work at admission with a
@@ -68,6 +84,9 @@ from .sharding import (KV_POOL_SPEC, kv_sharding, param_pspecs,
 from .health import (CircuitBreaker, ReplicaHealth, HEALTH_STATES,
                      HEALTH_STATE_CODES)
 from .fleet import EngineFleet, FleetRequest, FleetUnavailable
+from .kv_transfer import (TransferError, blob_info, can_migrate,
+                          install_prefix_cache, resume_request,
+                          snapshot_prefix_cache, snapshot_request)
 from .control import (CostModel, DEGRADE_LEVELS, FleetController, SLO,
                       SLOReject)
 from .embedding import (BatchSlotPool, DeviceHotRowCache, EmbedRequest,
@@ -81,7 +100,10 @@ __all__ = ["PagedKVCache", "QuantizedKVPool", "SlotKVCache",
            "InferenceEngine", "ModelDraft", "SelfDraft", "PrefixCache",
            "CircuitBreaker", "ReplicaHealth",
            "HEALTH_STATES", "HEALTH_STATE_CODES", "EngineFleet",
-           "FleetRequest", "FleetUnavailable", "CostModel",
+           "FleetRequest", "FleetUnavailable", "TransferError",
+           "blob_info", "can_migrate", "install_prefix_cache",
+           "resume_request", "snapshot_prefix_cache",
+           "snapshot_request", "CostModel",
            "DEGRADE_LEVELS", "FleetController", "SLO", "SLOReject",
            "BatchSlotPool", "DeviceHotRowCache", "EmbedRequest",
            "EmbeddingServer", "EMBED_BUCKETS", "KV_POOL_SPEC",

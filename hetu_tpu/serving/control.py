@@ -238,7 +238,8 @@ class FleetController:
                  degrade_enter_ticks=10, degrade_exit_ticks=20,
                  brownout_max_new=16, admission_margin=1.0,
                  hbm_limit_bytes=None, hbm_safety=0.9,
-                 mfu_scale_threshold=None):
+                 mfu_scale_threshold=None, rebalance_ratio=None,
+                 rebalance_cooldown_s=None):
         if min_engines < 1:
             raise ValueError(
                 f"min_engines must be >= 1, got {min_engines}")
@@ -270,6 +271,19 @@ class FleetController:
         self.hbm_safety = float(hbm_safety)
         self.mfu_scale_threshold = (None if mfu_scale_threshold is None
                                     else float(mfu_scale_threshold))
+        # opt-in decode-slot rebalancing: when one replica's observed
+        # TPOT runs ratio× the fastest sibling's, live-migrate a stream
+        # off it (None disables; the default controller never perturbs
+        # placement behind the operator's back)
+        if rebalance_ratio is not None and float(rebalance_ratio) <= 1.0:
+            raise ValueError(
+                f"rebalance_ratio must be > 1.0 (a hot/cold TPOT "
+                f"ratio), got {rebalance_ratio}")
+        self.rebalance_ratio = (None if rebalance_ratio is None
+                                else float(rebalance_ratio))
+        self.rebalance_cooldown_s = (
+            float(cooldown_s) if rebalance_cooldown_s is None
+            else float(rebalance_cooldown_s))
         self.hbm_headroom = None
         self.mfu = None
         self.hbm_blocked = 0
@@ -283,6 +297,8 @@ class FleetController:
         self.capped = 0
         self.scale_ups = 0
         self.scale_downs = 0
+        self.rebalances = 0
+        self._last_rebalance = None
         self.degrade_entries = 0
         self.degrade_exits = 0
         self.max_level_seen = 0
@@ -589,6 +605,7 @@ class FleetController:
         self._viol_now = viol
         self._autoscale(now, viol)
         self._degrade(now, viol)
+        self._rebalance(now)
         # refresh the live gauges
         self._m_engines.set(len(self._live_replicas()))
         self._m_miss.set(self.miss_ewma or 0.0)
@@ -647,11 +664,45 @@ class FleetController:
             victim = self._scale_down_victim(live)
             if victim is None:
                 return
-            self.fleet.drain(victim.name, wait=False)
+            # migrate-then-drain: the victim's long decode tail moves
+            # to surviving siblings NOW (live KV page migration), so
+            # the two-phase removal isn't gated on its slowest stream;
+            # anything non-migratable just drains out as before
+            self.fleet.drain(victim.name, wait=False, migrate=True)
             self._draining.add(victim.name)
             self._last_scale = now
             self.scale_downs += 1
             self._scale_event("down", victim.name, now, viol)
+
+    def _rebalance(self, now):
+        """Decode-slot rebalancing (opt-in via ``rebalance_ratio=``):
+        when the hottest replica's observed TPOT runs ``ratio``× the
+        fastest sibling's — thermal throttle, noisy neighbor — one
+        running stream is live-migrated off it per pass (bounded,
+        cooldown-spaced) instead of waiting for the health machine to
+        call the replica sick.  Queue pressure counts too: a replica
+        that is both slow and loaded sheds first."""
+        if self.rebalance_ratio is None:
+            return
+        if (self._last_rebalance is not None
+                and now - self._last_rebalance
+                < self.rebalance_cooldown_s):
+            return
+        cands = [r for r in self._live_replicas()
+                 if r.health.state in DISPATCHABLE and r.tpot_ewma
+                 and r.name not in self._draining]
+        if len(cands) < 2:
+            return
+        # hottest by observed decode latency, load as the tie-break
+        hot = max(cands, key=lambda r: (r.tpot_ewma, len(r.inflight)))
+        cool = min(r.tpot_ewma for r in cands if r is not hot)
+        if hot.tpot_ewma < self.rebalance_ratio * cool \
+                or not hot.inflight:
+            return
+        moved = self.fleet.rebalance(hot.name, max_requests=1)
+        if moved:
+            self.rebalances += moved
+            self._last_rebalance = now
 
     def _scale_down_victim(self, live):
         cands = [r for r in live
@@ -776,6 +827,7 @@ class FleetController:
                          "capped": self.capped,
                          "scale_ups": self.scale_ups,
                          "scale_downs": self.scale_downs,
+                         "rebalances": self.rebalances,
                          "degrade_entries": self.degrade_entries,
                          "degrade_exits": self.degrade_exits,
                          "max_level_seen": self.max_level_seen},

@@ -366,6 +366,8 @@ class InferenceEngine:
         self.slot_leaks_reclaimed = 0
         self.streams_detached = 0
         self.replayed_tokens = 0
+        self.migrated_in = 0       # streams adopted from a sibling's pages
+        self.migrated_out = 0      # streams released to a sibling post-ack
         self.spec_steps = 0        # speculative iterations dispatched
         self.spec_proposed = 0     # draft-origin window candidates
         self.spec_accepted = 0     # of those, accepted by verify
@@ -418,6 +420,14 @@ class InferenceEngine:
             "counter", "hetu_serving_replayed_tokens_total",
             "Tokens teacher-forced during failover replay (rebuilt, "
             "never re-emitted)")
+        self._m_migrated_in = _m(
+            "counter", "hetu_serving_migrated_in_total",
+            "Decode streams adopted mid-flight from a sibling's "
+            "exported KV pages")
+        self._m_migrated_out = _m(
+            "counter", "hetu_serving_migrated_out_total",
+            "Decode streams released after a sibling acked adoption "
+            "of their KV pages")
         self._m_spec_proposed = _m(
             "counter", "hetu_serving_spec_proposed_total",
             "Draft tokens proposed into speculative verify windows")
@@ -1138,6 +1148,120 @@ class InferenceEngine:
             self._finalize_unadmitted(req, "failover", now)
             out.append(req)
         return out
+
+    # -- live KV migration (serving/kv_transfer.py rides these) ------------
+    def adopt_request(self, prompt, tokens, pages, position, max_new, *,
+                      rid=None, stream=None, eos_id=None, deadline=None,
+                      temperature=None, top_k=None, seed=None,
+                      arrival=None):
+        """Resume a sibling's mid-decode stream from spliced pages.
+
+        ``pages`` are ids from THIS pool's :meth:`~.kv_cache.PagedKVCache.
+        import_pages` (one caller-owned reference each); ``tokens`` are
+        the stream's already-delivered generated ids (never re-emitted);
+        ``position`` is the donor's cached-row count, which for a stream
+        with T >= 1 generated tokens is exactly ``prompt + T - 1`` — the
+        newest token is a decode operand, not a cache row.  Paged
+        sampling keys fold only the per-request seed and the consumed
+        count, so the continued stream is BITWISE the uninterrupted one.
+
+        On success the request owns the pages (the caller's reference is
+        released here) and decodes on the next iteration.  Returns None
+        when admission is refused (no slot/pages — caller keeps its page
+        reference and falls back to replay)."""
+        if not self._paged:
+            raise ValueError("adopt_request requires a paged engine — "
+                             "migration moves pages, not slots")
+        if self._draft is not None:
+            raise ValueError(
+                "adopt_request cannot target a ModelDraft engine: the "
+                "draft's per-slot state is not part of the wire format "
+                "(use replay, or the truncated-layer SelfDraft)")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        tokens = [int(t) for t in tokens]
+        if len(tokens) < 1:
+            raise ValueError(
+                "adopt_request needs >= 1 generated token (a mid-prefill "
+                "stream has no decode state to move — replay it)")
+        max_new = int(max_new)
+        if len(tokens) >= max_new:
+            raise ValueError(
+                f"stream already holds {len(tokens)} >= max_new="
+                f"{max_new} tokens — nothing left to decode")
+        if int(position) != prompt.size + len(tokens) - 1:
+            raise ValueError(
+                f"position {int(position)} != prompt ({prompt.size}) + "
+                f"tokens ({len(tokens)}) - 1 — donor state torn")
+        if prompt.size > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds max_prompt_len="
+                f"{self.max_prompt_len}")
+        if prompt.size + max_new > self.max_len - self._spec_k:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new ({max_new}) exceeds "
+                f"max_len={self.max_len}")
+        now = self._now()
+        slot = self.cache.alloc(
+            owner=rid,
+            n_tokens=prompt.size + max_new + self.scheduler.lookahead,
+            shared=pages)
+        if slot is None:
+            return None
+        # the slot now holds its own reference on every page; dropping
+        # the caller's makes them private again (refcount 1) so the
+        # next decode write into the partially-filled last page is an
+        # in-place write, not a copy-on-write fork
+        self.cache.release_pages(pages)
+        req = Request(prompt, max_new,
+                      arrival=now if arrival is None else arrival,
+                      stream=stream,
+                      eos_id=self.eos_id if eos_id is None else eos_id,
+                      deadline=deadline, rid=rid,
+                      temperature=temperature, top_k=top_k, seed=seed)
+        if req.rid is None:
+            n = next(self.scheduler._ids)
+            req.rid = (n if self.scheduler.rid_prefix is None
+                       else f"{self.scheduler.rid_prefix}-{n}")
+        req.tokens = tokens
+        req.prefix_tokens = 0
+        req.slot = slot
+        req.t_admit = now
+        req.t_first = now
+        self.cache.positions[slot] = int(position)
+        self._last_tokens[slot] = tokens[-1]
+        self._temps[slot] = (self._sampling[0] if temperature is None
+                             else float(temperature))
+        self._topks[slot] = (self._sampling[1] if top_k is None
+                             else int(top_k))
+        self._seeds[slot] = (self._default_seed if seed is None
+                             else int(seed))
+        self._dev_sampling = None
+        self.scheduler.running[slot] = req
+        self.scheduler.admitted_order.append(req.rid)
+        self.migrated_in += 1
+        self._m_migrated_in.inc()
+        self._rt.event(req.rid, "migrated", engine=self.instance,
+                       tokens=len(tokens), pages=len(pages))
+        return req
+
+    def release_migrated(self, rid):
+        """Donor-side ack: a sibling adopted this stream, so retire the
+        local attempt with the attempt-level ``finish_reason="failover"``
+        (the cluster-level request lives on over there) and free its
+        slot and pages NOW — never before the receiver holds its own
+        copy.  Returns True if a live request was released."""
+        req = self.scheduler.find(rid)
+        if req is None:
+            return False
+        now = self._now()
+        if req.slot is not None:
+            self._finalize_active(req, "failover", now)
+        else:
+            self.scheduler.remove_queued(req)
+            self._finalize_unadmitted(req, "failover", now)
+        self.migrated_out += 1
+        self._m_migrated_out.inc()
+        return True
 
     def _quarantine_all(self, reason, now):
         """A fault that cannot be attributed to one slot (the jitted

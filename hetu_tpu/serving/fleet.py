@@ -76,6 +76,11 @@ from .scheduler import EngineOverloaded, TERMINAL_OK
 
 __all__ = ["EngineFleet", "FleetRequest", "FleetUnavailable"]
 
+#: replica roles for disaggregated serving (EngineFleet(roles=...)):
+#: "prefill" replicas admit + prefill and hand streams off, "decode"
+#: replicas receive migrated streams, "mixed" (the default) does both.
+_ROLES = ("prefill", "decode", "mixed")
+
 
 class FleetUnavailable(RuntimeError):
     """No replica can take the request: every engine is circuit-broken,
@@ -164,12 +169,14 @@ class _Replica:
     """One supervised engine slot: the engine, its driver thread, its
     health + breaker, and the fleet requests in flight on it."""
 
-    def __init__(self, index, name, engine, health, breaker):
+    def __init__(self, index, name, engine, health, breaker,
+                 role="mixed"):
         self.index = index
         self.name = name
         self.engine = engine
         self.health = health
         self.breaker = breaker
+        self.role = role           # "prefill" | "decode" | "mixed"
         self.lock = threading.RLock()
         self.thread = None
         self.generation = 0        # bumped to fence a zombie driver
@@ -211,9 +218,27 @@ class EngineFleet:
                  supervise_interval=0.02,
                  idle_sleep=0.001, auto_restart=True, ewma_alpha=0.3,
                  latency_buckets=None, engine_factory=None,
-                 replica_prefix="e", tp_size=1):
+                 replica_prefix="e", tp_size=1, roles=None):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
+        # disaggregated prefill/decode: roles=("prefill", "decode", ...)
+        # names one role per initial replica.  "prefill" replicas take
+        # new submissions; once a stream has >= 1 generated token the
+        # supervision pass migrates its pages to a "decode"/"mixed"
+        # sibling (kv_transfer), so prefill-heavy replicas never spend
+        # iterations decoding.  None (default) = every replica "mixed",
+        # behavior unchanged.
+        if roles is not None:
+            roles = [str(r) for r in roles]
+            if len(roles) != int(n_engines):
+                raise ValueError(
+                    f"roles has {len(roles)} entries for "
+                    f"n_engines={n_engines}")
+            bad = [r for r in roles if r not in _ROLES]
+            if bad:
+                raise ValueError(
+                    f"unknown roles {bad}; expected one of {_ROLES}")
+        self._roles = roles
         self._executor = executor
         self._model = model
         self._engine_factory = (InferenceEngine if engine_factory is None
@@ -274,13 +299,29 @@ class EngineFleet:
             self._devices = devs if len(devs) > 1 else [None] * n_engines
         self._requests = {}        # rid -> FleetRequest (accepted ever)
         self._flock = threading.Lock()
-        self._failover = deque()   # (FleetRequest, tokens) to re-home
+        # (FleetRequest, tokens, blob) to re-home: blob is the donor's
+        # kv_transfer snapshot when one could be taken (page migration
+        # first), None otherwise (teacher-forced replay only)
+        self._failover = deque()
         self._cancels = deque()    # (replica_name, rid) deferred cancels
+        self._prefix_handoffs = deque()   # (donor_name, prefix blob)
+        # test/fault hook (resilience/faults.py): every migration blob
+        # passes through this callable on its way to the receiver; None
+        # return = dropped in flight, mutated bytes = corruption — the
+        # CRC framing catches it and replay takes over
+        self.transfer_filter = None
+        self._migrate_lock = threading.Lock()   # one migration at a time
+        # manual-mode dispatch-wedge watcher (armed around pump ticks)
+        self._watch_armed = None
+        self._watch_thread = None
         self._running = False
         self._sup_thread = None
         self.submitted = 0
         self.completed = 0
         self.failovers_done = 0
+        self.migrations_done = 0
+        self.migration_failures = 0
+        self.prefix_handoffs_done = 0
         self.hedged = 0
         self.hedges_skipped = 0
         self.replica_prefix = str(replica_prefix)
@@ -319,6 +360,29 @@ class EngineFleet:
         self._m_unavail = reg.counter(
             "hetu_fleet_unavailable_total",
             "Submits refused with FleetUnavailable")
+        self._m_migrations = reg.counter(
+            "hetu_migrate_attempts_total",
+            "Live KV page migrations attempted, by path (failover, "
+            "rebalance, drain, handoff)", labels=("path",))
+        self._m_migrate_fail = reg.counter(
+            "hetu_migrate_failures_total",
+            "Migrations that fell back to teacher-forced replay "
+            "(torn/corrupt transfer, geometry drift, receiver refusal)",
+            labels=("path",))
+        self._m_migrate_bytes = reg.counter(
+            "hetu_migrate_bytes_total",
+            "Wire bytes of successfully spliced KV transfer blobs")
+        self._m_migrate_prefix = reg.counter(
+            "hetu_migrate_prefix_entries_total",
+            "Prefix-cache entries re-interned on a sibling after their "
+            "replica was quarantined")
+        self._m_handoffs = reg.counter(
+            "hetu_serving_role_handoffs_total",
+            "Prefill->decode stream handoffs between role groups")
+        self._g_role = reg.gauge(
+            "hetu_serving_role_replicas",
+            "Replicas per disaggregation role",
+            labels=("fleet", "role"))
         self._rt = _telemetry.get_request_trace()
         self._fl = _telemetry.get_flight()
         # multi-replica-per-chip param sharing: one placed copy of the
@@ -326,6 +390,7 @@ class EngineFleet:
         # device -> (placed pytree, HBM ledger handle, pool="params")
         self._param_store = {}
         self._replicas = [self._make_replica(i) for i in range(n_engines)]
+        self._sync_role_gauge()
         self.start()
 
     # -- construction ------------------------------------------------------
@@ -378,12 +443,32 @@ class EngineFleet:
             latency_buckets=self._latency_buckets,
             **pin, **self._ekw)
 
+    def _role_for(self, index):
+        """Initial replicas get their configured role; replicas added
+        later (controller scale-up) join as "mixed" — they can absorb
+        whatever the fleet is short of."""
+        if self._roles is not None and index < len(self._roles):
+            return self._roles[index]
+        return "mixed"
+
+    @property
+    def _has_roles(self):
+        return self._roles is not None
+
+    def _sync_role_gauge(self):
+        counts = {r: 0 for r in _ROLES}
+        for rep in self._replicas:
+            counts[rep.role] = counts.get(rep.role, 0) + 1
+        for role, n in counts.items():
+            self._g_role.labels(fleet=self.name, role=role).set(n)
+
     def _make_replica(self, index):
         name = f"{self.replica_prefix}{index}"
         rep = _Replica(
             index, name, self._build_engine(index, 0),
             ReplicaHealth(name, clock=self._clock, **self._hp),
-            CircuitBreaker(clock=self._clock, **self._bp))
+            CircuitBreaker(clock=self._clock, **self._bp),
+            role=self._role_for(index))
         self._m_health.labels(engine=name).set(HEALTH_STATE_CODES[HEALTHY])
         return rep
 
@@ -399,6 +484,7 @@ class EngineFleet:
         # atomic list swap: readers iterate a snapshot, never a
         # half-mutated list
         self._replicas = self._replicas + [rep]
+        self._sync_role_gauge()
         if self.threaded and self._running:
             self._start_driver(rep)
         return rep.name
@@ -427,6 +513,7 @@ class EngineFleet:
         if rep.engine is not None:
             rep.engine.close()
         self._replicas = [r for r in self._replicas if r is not rep]
+        self._sync_role_gauge()
         return True
 
     # -- lifecycle ---------------------------------------------------------
@@ -457,17 +544,19 @@ class EngineFleet:
         home finalize with ``finish_reason="error"`` unless told not
         to."""
         self._running = False
-        threads = [self._sup_thread] + [r.thread for r in self._replicas]
+        threads = [self._sup_thread, self._watch_thread] \
+            + [r.thread for r in self._replicas]
         for rep in self._replicas:
             rep.generation += 1       # fence every driver
         for t in threads:
             if t is not None:
                 t.join(timeout=2.0)
         self._sup_thread = None
+        self._watch_thread = None
         if finalize_pending:
             with self._flock:
                 pending, self._failover = list(self._failover), deque()
-            for freq, _ in pending:
+            for freq, *_ in pending:
                 self._finalize(freq, "error")
         return self
 
@@ -495,8 +584,16 @@ class EngineFleet:
         return [r for r in self._replicas
                 if r.health.dispatchable and r.engine is not None]
 
-    def _choose(self, prefer_not=None, exclude=(), prompt=None):
+    def _choose(self, prefer_not=None, exclude=(), prompt=None,
+                roles=None, strict_roles=False):
         cands = [r for r in self._candidates() if r.name not in exclude]
+        if roles is not None and cands:
+            # role preference: fall back to ANY dispatchable replica
+            # unless strict (a role-pure handoff that has no valid
+            # target should just not happen, not bounce) — no request
+            # is ever refused because the "right" role is down
+            wanted = [r for r in cands if r.role in roles]
+            cands = wanted if (wanted or strict_roles) else cands
         if not cands:
             return None
         if prefer_not is not None and len(cands) > 1:
@@ -598,7 +695,12 @@ class EngineFleet:
                             arrival=now, hedge=hedge,
                             temperature=temperature, top_k=top_k,
                             seed=seed)
-        rep = self._place(freq, now=now)
+        # role routing: new work lands on prefill/mixed replicas;
+        # decode-role replicas receive migrated streams (with graceful
+        # fallback inside _choose when no prefill replica is up)
+        rep = self._place(freq, now=now,
+                          roles=(("prefill", "mixed") if self._has_roles
+                                 else None))
         self._requests[freq.rid] = freq
         self.submitted += 1
         if hedge:
@@ -616,7 +718,7 @@ class EngineFleet:
         return freq
 
     def _place(self, freq, now=None, prefer_not=None, replay=None,
-               count_unavailable=True):
+               count_unavailable=True, roles=None):
         """Dispatch onto the best replica, falling through overloaded
         ones (each replica is tried at most once — the loop is bounded
         by the fleet size).  Raises the last EngineOverloaded when every
@@ -627,7 +729,7 @@ class EngineFleet:
         tried, last_overload = set(), None
         for _ in range(len(self._replicas)):
             rep = self._choose(prefer_not=prefer_not, exclude=tried,
-                               prompt=freq.prompt)
+                               prompt=freq.prompt, roles=roles)
             if rep is None:
                 break
             try:
@@ -654,7 +756,7 @@ class EngineFleet:
                 with rep.lock:
                     hit = rep.engine.cancel(rid) or hit
         with self._flock:
-            for i, (f, _) in enumerate(self._failover):
+            for i, (f, *_) in enumerate(self._failover):
                 if f is freq:
                     del self._failover[i]
                     self._finalize(freq, "cancelled")
@@ -779,8 +881,11 @@ class EngineFleet:
                 and not freq.attempt.finished
         return False
 
-    def _failover_or_fail(self, freq, attempt):
-        """The attempt died: queue a re-home, or give up past the cap."""
+    def _failover_or_fail(self, freq, attempt, blob=None):
+        """The attempt died: queue a re-home, or give up past the cap.
+        ``blob`` is the donor's page snapshot when one was taken before
+        harvest — the dispatcher tries to splice it into a sibling
+        before falling back to teacher-forced replay."""
         freq.failovers += 1
         tokens = list(attempt.tokens)
         freq._tokens_snapshot = tokens
@@ -788,11 +893,16 @@ class EngineFleet:
         if freq.failovers > self.max_failovers:
             self._finalize(freq, "error")
             return []
-        return [(freq, tokens)]
+        return [(freq, tokens, blob)]
 
     def _quarantine_locked(self, rep, reason, harvest=True):
         """Open the breaker and (when the engine is still callable)
-        harvest every live request for failover."""
+        harvest every live request for failover.  Before the harvest
+        frees anything, the replica's migratable decode state is
+        snapshotted: page blobs ride the failover queue so streams
+        splice onto a sibling instead of replaying, and the prefix
+        cache is exported for re-interning elsewhere (the interned
+        pages would otherwise die with this replica)."""
         rep.health.to(QUARANTINED, reason)
         self._set_health(rep)
         rep.breaker.open_()
@@ -801,6 +911,8 @@ class EngineFleet:
                           extra={"engine": rep.name, "why": reason})
         out = []
         if harvest and rep.engine is not None:
+            blobs = self._snapshot_for_failover(rep)
+            self._stash_prefix_handoff(rep)
             harvested = rep.engine.harvest()
             for req in harvested:
                 entry = rep.inflight.pop(req.rid, None)
@@ -811,10 +923,85 @@ class EngineFleet:
                     continue
                 if self._promote_survivor(freq, attempt):
                     continue    # hedged twin still live elsewhere
-                out.extend(self._failover_or_fail(freq, attempt))
+                out.extend(self._failover_or_fail(
+                    freq, attempt, blobs.get(req.rid)))
             # anything else finished in the same iteration
             out.extend(self._reap_locked(rep))
         return out
+
+    def _snapshot_for_failover(self, rep):
+        """Page blobs for every migratable in-flight stream (rid ->
+        blob), taken BEFORE harvest frees the pages.  Best-effort:
+        anything that cannot snapshot just rides replay."""
+        from . import kv_transfer as kvt
+        blobs = {}
+        eng = rep.engine
+        sch = getattr(eng, "scheduler", None)
+        if sch is None:
+            return blobs
+        for req in list(sch.running.values()):
+            if not kvt.can_migrate(eng, req):
+                continue
+            try:
+                blobs[req.rid] = kvt.snapshot_request(eng, req)
+            except Exception as e:
+                self._note_migrate_failure(
+                    "failover", req.rid, rep.name, None, e)
+        return blobs
+
+    def _stash_prefix_handoff(self, rep):
+        """Export the quarantined replica's interned prefix pages; a
+        later supervision pass re-interns them on the healthiest
+        sibling (outside any replica lock)."""
+        from . import kv_transfer as kvt
+        try:
+            blob = kvt.snapshot_prefix_cache(rep.engine)
+        except Exception as e:
+            self._note_migrate_failure("prefix", None, rep.name, None, e)
+            return
+        if blob is not None:
+            with self._flock:
+                self._prefix_handoffs.append((rep.name, blob))
+
+    def _install_prefix_handoffs(self):
+        """Drain stashed prefix-cache blobs into the best live sibling
+        that runs a prefix cache (re-parked when none is up yet).  One
+        bounded pass: each stashed blob is tried once; blobs stashed
+        mid-pass wait for the next supervision tick."""
+        from . import kv_transfer as kvt
+        with self._flock:
+            pending = list(self._prefix_handoffs)
+            self._prefix_handoffs.clear()
+        for i, (src_name, blob) in enumerate(pending):
+            cands = [r for r in self._candidates()
+                     if getattr(r.engine, "prefix_cache", None)
+                     is not None and r.name != src_name]
+            if not cands:
+                with self._flock:
+                    # re-park this and everything after it, in order,
+                    # ahead of anything stashed while we worked
+                    self._prefix_handoffs.extendleft(
+                        reversed(pending[i:]))
+                return
+            dst = min(cands, key=lambda r: (self._score(r), r.name))
+            try:
+                with dst.lock:
+                    n = kvt.install_prefix_cache(dst.engine, blob)
+            except kvt.TransferError as e:
+                self._note_migrate_failure(
+                    "prefix", None, src_name, dst.name, e)
+                continue
+            self.prefix_handoffs_done += n
+            if n:
+                self._m_migrate_prefix.inc(n)
+
+    def _note_migrate_failure(self, path, rid, src, dst, err):
+        self.migration_failures += 1
+        self._m_migrate_fail.labels(path=path).inc()
+        self._fl.incident(
+            "migrate_failed", health=self.health(),
+            extra={"path": path, "rid": rid, "from": src, "to": dst,
+                   "error": f"{type(err).__name__}: {err}"})
 
     def _on_crash_locked(self, rep, exc):
         rep.last_error = exc
@@ -893,12 +1080,18 @@ class EngineFleet:
         bounded pass over the queue snapshot per call."""
         with self._flock:
             pending, self._failover = list(self._failover), deque()
-        for i, (freq, tokens) in enumerate(pending):
+        for i, (freq, tokens, blob) in enumerate(pending):
             if freq.finished:
                 continue
             now = self._clock()
             if freq.deadline is not None and now >= freq.deadline:
                 self._finalize(freq, "deadline")
+                continue
+            # page migration first: splice the donor's snapshot into a
+            # sibling's pool and the stream continues without replaying
+            # a single token.  ANY transfer failure falls through to
+            # replay — migration can only ever improve on it.
+            if blob is not None and self._resume_from_blob(freq, blob):
                 continue
             try:
                 self._place(freq, now=now,
@@ -922,6 +1115,197 @@ class EngineFleet:
                 replayed=len(tokens),
                 from_engine=(freq.engines[-2]
                              if len(freq.engines) > 1 else None))
+
+    def _can_adopt(self, rep):
+        """A migration target needs a FREE slot right now (adoption
+        cannot queue the way replay-submit can) on a paged engine
+        without a ModelDraft."""
+        eng = rep.engine
+        return (eng is not None and getattr(eng, "_paged", False)
+                and eng._draft is None
+                and len(eng.scheduler.running) < eng.cache.n_slots)
+
+    def _resume_from_blob(self, freq, blob):
+        """Try to re-home a harvested stream by splicing its page blob
+        into the best sibling.  True on success; False (after counting
+        the failure) sends the caller down the replay path."""
+        from . import kv_transfer as kvt
+        last = freq.engines[-1] if freq.engines else None
+        full = {r.name for r in self._replicas
+                if not self._can_adopt(r)}
+        rep = self._choose(prefer_not=last, exclude=full,
+                           roles=(("decode", "mixed") if self._has_roles
+                                  else None))
+        if rep is None:
+            return False    # nobody can adopt NOW: replay can queue
+        self._m_migrations.labels(path="failover").inc()
+        try:
+            filt = self.transfer_filter
+            wired = blob if filt is None else filt(blob)
+            if wired is None:
+                raise kvt.TransferError("transfer dropped in flight")
+            with rep.lock:
+                att = kvt.resume_request(rep.engine, wired,
+                                         stream=self._wrap_stream(freq))
+                rep.inflight[att.rid] = (freq, att)
+                rep.dispatches += 1
+                freq.attempt = att
+                freq.engine = rep.name
+        except kvt.TransferError as e:
+            self._note_migrate_failure(
+                "failover", freq.rid, last, rep.name, e)
+            return False
+        freq.engines.append(rep.name)
+        self._m_dispatch.labels(engine=rep.name).inc()
+        self.migrations_done += 1
+        self._m_migrate_bytes.inc(len(blob))
+        self.failovers_done += 1
+        self._m_failovers.inc()
+        self._rt.event(freq.rid, "migrated", engine=rep.name,
+                       path="failover", bytes=len(blob),
+                       from_engine=last)
+        return True
+
+    # -- live migration (both replicas up) ----------------------------------
+    def _migrate_attempt(self, src, freq, att, dst, path):
+        """Live-migrate one running stream from ``src`` to ``dst``:
+        snapshot under the donor lock (the donor cannot step past the
+        snapshot), splice into the receiver, rebind the stream fence,
+        then ack the donor (which frees its pages).  Serialized
+        fleet-wide by ``_migrate_lock`` so two replicas never migrate
+        toward each other with crossed locks.  Returns True on success;
+        on ANY transfer failure the stream stays on the donor untouched
+        — migrating is strictly no worse than not migrating."""
+        from . import kv_transfer as kvt
+        if dst is None or dst is src:
+            return False
+        with self._migrate_lock:
+            with src.lock:
+                if src.engine is None or dst.engine is None:
+                    return False
+                if (freq.finished or freq.attempt is not att
+                        or att.finished
+                        or freq.hedge_attempt is not None
+                        or not kvt.can_migrate(src.engine, att)):
+                    return False
+                self._m_migrations.labels(path=path).inc()
+                try:
+                    blob = kvt.snapshot_request(src.engine, att)
+                    filt = self.transfer_filter
+                    wired = blob if filt is None else filt(blob)
+                    if wired is None:
+                        raise kvt.TransferError(
+                            "transfer dropped in flight")
+                    with dst.lock:
+                        new = kvt.resume_request(
+                            dst.engine, wired,
+                            stream=self._wrap_stream(freq))
+                        dst.inflight[new.rid] = (freq, new)
+                        dst.dispatches += 1
+                        # rebind INSIDE the receiver lock: the stream
+                        # fence flips to the new attempt before the
+                        # receiver can deliver a single token
+                        freq.attempt = new
+                        freq.engine = dst.name
+                except kvt.TransferError as e:
+                    self._note_migrate_failure(
+                        path, freq.rid, src.name, dst.name, e)
+                    return False
+                # donor ack: only now does the donor free its side —
+                # the receiver already owns the adopted stream
+                src.inflight.pop(freq.rid, None)
+                src.engine.release_migrated(freq.rid)
+        freq.engines.append(dst.name)
+        self.migrations_done += 1
+        self._m_migrate_bytes.inc(len(blob))
+        self._m_dispatch.labels(engine=dst.name).inc()
+        self._rt.event(freq.rid, "migrated", engine=dst.name,
+                       path=path, bytes=len(blob),
+                       from_engine=src.name)
+        return True
+
+    def migrate_out(self, name, path="drain", roles=None):
+        """Preemptively move every migratable stream off ``name`` onto
+        siblings (scale-down, maintenance: migrate-then-drain).
+        Returns the number moved; whatever cannot move simply stays and
+        drains normally — no stream is ever worse off for the try."""
+        rep = self._by_name(name, required=True)
+        if rep.engine is None:
+            return 0
+        if roles is None and self._has_roles:
+            roles = ("decode", "mixed")
+        moved = 0
+        for rid, (freq, att) in list(rep.inflight.items()):
+            if freq.finished or att.finished \
+                    or freq.attempt is not att:
+                continue
+            full = {r.name for r in self._replicas
+                    if not self._can_adopt(r)}
+            dst = self._choose(exclude={rep.name} | full, roles=roles)
+            if dst is None:
+                break
+            if self._migrate_attempt(rep, freq, att, dst, path):
+                moved += 1
+        return moved
+
+    def rebalance(self, src, dst=None, max_requests=1,
+                  path="rebalance"):
+        """Move up to ``max_requests`` running decode streams off the
+        ``src`` replica onto ``dst`` (or the best-scored sibling) — the
+        SLO controller calls this to shed load from a hot replica
+        without restarting anything.  Returns the number moved."""
+        s = self._by_name(src, required=True)
+        if s.engine is None:
+            return 0
+        moved = 0
+        for rid, (freq, att) in list(s.inflight.items()):
+            if moved >= int(max_requests):
+                break
+            if freq.finished or att.finished \
+                    or freq.attempt is not att:
+                continue
+            full = {r.name for r in self._replicas
+                    if not self._can_adopt(r)}
+            d = (self._by_name(dst, required=True) if dst is not None
+                 else self._choose(exclude={s.name} | full))
+            if d is None or not self._can_adopt(d) \
+                    or not d.health.dispatchable:
+                break
+            if self._migrate_attempt(s, freq, att, d, path):
+                moved += 1
+        return moved
+
+    def _migration_pass(self):
+        """Disaggregation pass (role fleets only): any decode stream
+        still running on a prefill-role replica is handed off to a
+        decode/mixed sibling as soon as one can take it — prefill
+        replicas stay free to absorb new prompts, decode replicas own
+        the long tail.  Runs every supervision pass / pump."""
+        if not self._has_roles:
+            return
+        for rep in list(self._replicas):
+            if rep.role != "prefill" or rep.engine is None \
+                    or rep.health.state not in (HEALTHY, DEGRADED):
+                continue
+            self._handoff_from(rep)
+
+    def _handoff_from(self, rep):
+        for rid, (freq, att) in list(rep.inflight.items()):
+            if freq.finished or att.finished \
+                    or freq.attempt is not att:
+                continue
+            # strict: a role-pure handoff with no decode sibling up
+            # should just not happen (keep decoding here), not bounce
+            # to another prefill replica
+            full = {r.name for r in self._replicas
+                    if not self._can_adopt(r)}
+            dst = self._choose(roles=("decode", "mixed"),
+                               exclude={rep.name} | full,
+                               strict_roles=True)
+            if dst is None:
+                return
+            if self._migrate_attempt(rep, freq, att, dst, "handoff"):
+                self._m_handoffs.inc()
 
     def _supervise_loop(self):
         while self._running:
@@ -966,6 +1350,8 @@ class EngineFleet:
             if (rep.health.state == QUARANTINED and self.auto_restart
                     and rep.breaker.allow(now)):
                 self.restart(rep.name)
+        self._migration_pass()
+        self._install_prefix_handoffs()
         self._dispatch_failovers()
         self._run_cancels()
 
@@ -1035,10 +1421,13 @@ class EngineFleet:
             self._start_driver(rep)
         return rep.name
 
-    def drain(self, name=None, wait=True, timeout=60.0):
+    def drain(self, name=None, wait=True, timeout=60.0, migrate=False):
         """Stop dispatching to the replica(s) but finish what they hold;
         DRAINING flips to STOPPED at idle.  ``wait=True`` blocks (or
-        pumps, when ``threaded=False``) until drained."""
+        pumps, when ``threaded=False``) until drained.
+        ``migrate=True`` first live-migrates every migratable decode
+        stream to a sibling (scale-down: the long decode tail moves NOW
+        instead of being waited out), then drains whatever remains."""
         reps = ([self._by_name(name, required=True)] if name is not None
                 else list(self._replicas))
         for rep in reps:
@@ -1047,6 +1436,10 @@ class EngineFleet:
             rep.health.to(DRAINING, "drain requested")
             self._set_health(rep)
             self._m_drains.labels(engine=rep.name).inc()
+            if migrate:
+                # flip DRAINING first (no new work lands mid-migration),
+                # then move the tail; non-migratable streams just drain
+                self.migrate_out(rep.name, path="drain")
         if wait:
             self._wait_for(
                 lambda: all(r.health.state != DRAINING for r in reps),
@@ -1083,7 +1476,20 @@ class EngineFleet:
                         and rep.engine is not None
                         and not rep.engine.scheduler.idle)
                 t0 = self._clock()
-                self._tick(rep)
+                if busy:
+                    # arm the dispatch watcher BEFORE the tick: if this
+                    # step wedges inside the device call, the caller is
+                    # stuck and cannot report it — the watcher thread
+                    # quarantines + fails over from the side instead
+                    bound = self.effective_wedge_timeout(rep)
+                    self._ensure_watcher()
+                    self._watch_armed = (rep, rep.generation,
+                                         time.perf_counter() + bound,
+                                         bound)
+                try:
+                    self._tick(rep)
+                finally:
+                    self._watch_armed = None
                 dur = self._clock() - t0
                 if busy and dur > self.effective_wedge_timeout(rep) \
                         and rep.health.state in (HEALTHY, DEGRADED) \
@@ -1091,6 +1497,78 @@ class EngineFleet:
                     self._on_pump_stall(rep, dur)
             self._supervise_once()
         return self
+
+    def _ensure_watcher(self):
+        """Lazy dispatch watcher for manual (``threaded=False``)
+        fleets: the pump loop arms a deadline before every busy tick,
+        so a step that wedges INSIDE the dispatch is detected while the
+        pumping caller is still stuck — the manual-mode mirror of the
+        threaded supervisor's heartbeat check.  One daemon thread per
+        fleet, started on first use, joined at stop()."""
+        t = self._watch_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(target=self._watch_loop,
+                             name=f"{self.name}-dispatch-watch",
+                             daemon=True)
+        self._watch_thread = t
+        t.start()
+
+    def _watch_loop(self):
+        # wall-clock on purpose: a ManualClock fleet still wedges in
+        # real time, and the stuck caller cannot advance any clock
+        while self._running:
+            armed = self._watch_armed
+            if armed is not None:
+                rep, gen, deadline, bound = armed
+                if (time.perf_counter() >= deadline
+                        and rep.generation == gen
+                        and self._watch_armed is armed):
+                    self._watch_armed = None
+                    try:
+                        self._on_dispatch_wedge(rep, gen, bound)
+                    except Exception as e:   # watcher must never die
+                        warnings.warn(
+                            f"fleet {self.name}: dispatch watcher "
+                            f"error {type(e).__name__}: {e}")
+            time.sleep(min(self.supervise_interval, 0.005))
+
+    def _on_dispatch_wedge(self, rep, gen, bound):
+        """An armed pump tick blew past its wedge bound with the caller
+        still stuck inside the dispatch: same fencing as a threaded
+        wedge (:meth:`_on_wedge`), run from the watcher thread, tagged
+        ``mode="dispatch"`` so operators can tell the two apart."""
+        if rep.generation != gen \
+                or rep.health.state not in (HEALTHY, DEGRADED):
+            return
+        rep.generation += 1     # fence: the stuck tick discards itself
+        self._m_wedges.labels(engine=rep.name).inc()
+        self._fl.incident(
+            "engine_wedge", health=self.health(),
+            extra={"engine": rep.name, "mode": "dispatch",
+                   "wedge_timeout_s": round(bound, 4)})
+        warnings.warn(
+            f"fleet {self.name}: engine {rep.name} dispatch stuck past "
+            f"{bound:.2f}s — wedged; quarantining and failing over")
+        inflight, rep.inflight = rep.inflight, {}
+        out = []
+        for rid, (freq, attempt) in inflight.items():
+            if freq.finished:
+                continue
+            if self._promote_survivor(freq, attempt):
+                continue
+            # the zombie dispatch owns the engine (and its pool): no
+            # clean harvest, no page snapshot — replay is the seam
+            self._rt.event(rid, "harvested", engine=rep.name,
+                           why="wedge")
+            out.extend(self._failover_or_fail(freq, attempt))
+        rep.health.to(QUARANTINED,
+                      f"dispatch stuck past {bound:.2f}s")
+        self._set_health(rep)
+        rep.breaker.open_()
+        self._m_breaker.labels(engine=rep.name).inc()
+        rep.engine = None           # abandoned with the stuck call
+        self._queue_failovers(out)
 
     def _on_pump_stall(self, rep, dur):
         """A manual-mode tick stalled past the wedge bound.  Unlike a
@@ -1208,6 +1686,9 @@ class EngineFleet:
             "submitted": self.submitted,
             "completed": self.completed,
             "failovers": self.failovers_done,
+            "migrations": self.migrations_done,
+            "migration_failures": self.migration_failures,
+            "prefix_handoffs": self.prefix_handoffs_done,
             "hedged": self.hedged,
             "hedges_skipped": self.hedges_skipped,
             "pending_failovers": pending,
@@ -1216,6 +1697,7 @@ class EngineFleet:
             "engines": {
                 r.name: {
                     "state": r.health.state,
+                    "role": r.role,
                     "incarnation": r.incarnation,
                     "dispatches": r.dispatches,
                     "ttft_ewma": r.ttft_ewma,

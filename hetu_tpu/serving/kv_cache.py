@@ -714,6 +714,105 @@ class PagedKVCache:
         free = set(self._free_slots)
         return [s for s in range(self.n_slots) if s not in free]
 
+    # -- page migration (serving/kv_transfer.py rides these) ---------------
+    def page_geometry(self):
+        """The shape contract two pools must share for a page to move
+        between them bit-identically: raw rows only splice into a pool
+        with the same per-page layout AND the same at-rest encoding."""
+        return {"layers": self.layers, "kv_heads": self.kv_heads,
+                "page_len": self.page_len, "head_dim": self.head_dim,
+                "kv_dtype": self.kv_dtype}
+
+    def export_pages(self, pages):
+        """Host-side snapshot of ``pages`` as RAW pool rows — float32
+        arrays for a dense pool, codes + scales (never dequantized,
+        never re-cast) for a quantized one — so an import on a
+        matching pool reproduces the rows BITWISE.  The pages' live
+        state (refcounts, tables) is untouched: export is a pure read,
+        the donor keeps serving until the receiver acks."""
+        pages = [int(p) for p in pages]
+        if not pages:
+            raise ValueError("export_pages needs at least one page")
+        for p in pages:
+            if not 1 <= p < self.n_pages:
+                raise ValueError(
+                    f"page {p} out of range (sentinel 0 excluded)")
+            if self._ref[p] < 1:
+                raise RuntimeError(
+                    f"cannot export page {p}: refcount is 0 (freed)")
+        idx = np.asarray(pages, np.int32)
+        if self.kv_dtype is None:
+            return {"kv_dtype": None,
+                    "k": np.asarray(self.k[idx]),
+                    "v": np.asarray(self.v[idx])}
+        return {"kv_dtype": self.kv_dtype,
+                "k_codes": np.asarray(self.k.codes[idx]),
+                "k_scales": np.asarray(self.k.scales[idx]),
+                "v_codes": np.asarray(self.v.codes[idx]),
+                "v_scales": np.asarray(self.v.scales[idx])}
+
+    def import_pages(self, payload):
+        """Splice an :meth:`export_pages` payload into THIS pool:
+        allocate fresh pages (through the same free-list accounting as
+        ``alloc``, so ``audit`` stays balanced) and write the raw rows
+        device-side.  Returns the new page ids — each with refcount 1
+        OWNED BY THE CALLER, exactly like prefix-cache retained pages:
+        map them into a slot via ``alloc(shared=...)`` and then
+        ``release_pages`` the caller's reference, or ``release_pages``
+        outright to abort.  Returns None when the pool is short of
+        pages even after the reclaim hook (admission control, not an
+        error); raises on a geometry/encoding mismatch — a payload
+        from an incompatible pool can never splice bit-identically."""
+        if payload.get("kv_dtype") != self.kv_dtype:
+            raise ValueError(
+                f"pool kv_dtype mismatch: payload "
+                f"{payload.get('kv_dtype')!r} vs pool {self.kv_dtype!r}")
+        lead = payload["k" if self.kv_dtype is None else "k_codes"]
+        row_shape = (self.layers, self.kv_heads, self.page_len,
+                     self.head_dim)
+        for name, arr in payload.items():
+            if name == "kv_dtype":
+                continue
+            want = (row_shape if not name.endswith("scales")
+                    else row_shape[:-1] + (1,))
+            if tuple(arr.shape[1:]) != want or arr.shape[0] != lead.shape[0]:
+                raise ValueError(
+                    f"payload array {name!r} shape {tuple(arr.shape)} "
+                    f"does not match pool geometry {want}")
+        n = int(lead.shape[0])
+        if n < 1:
+            raise ValueError("import_pages needs at least one page")
+        while n > len(self._free_pages):
+            short = n - len(self._free_pages)
+            if self.reclaim is None or not self.reclaim(short):
+                return None
+        new = [self._free_pages.pop() for _ in range(n)]
+        for p in new:
+            self._ref[p] = 1
+        self.page_alloc_count += n
+        self._c_churn.labels(pool=self.label).inc(n)
+        idx = jnp.asarray(np.asarray(new, np.int32))
+        if self.kv_dtype is None:
+            self.k = self.k.at[idx].set(
+                jnp.asarray(payload["k"], self.k.dtype))
+            self.v = self.v.at[idx].set(
+                jnp.asarray(payload["v"], self.v.dtype))
+        else:
+            # raw codes + scales move as-is: requantizing would round
+            # twice and break the bitwise-continuation contract
+            self.k = QuantizedKVPool(
+                self.k.codes.at[idx].set(jnp.asarray(payload["k_codes"])),
+                self.k.scales.at[idx].set(
+                    jnp.asarray(payload["k_scales"])),
+                self.kv_dtype)
+            self.v = QuantizedKVPool(
+                self.v.codes.at[idx].set(jnp.asarray(payload["v_codes"])),
+                self.v.scales.at[idx].set(
+                    jnp.asarray(payload["v_scales"])),
+                self.kv_dtype)
+        self._sync_gauges()
+        return new
+
     def audit(self):
         """Lifetime accounting for the no-leak invariants: after a
         drain ``allocs == frees``, ``in_use == 0``, AND ``page_allocs

@@ -1,0 +1,321 @@
+"""Live KV page migration: the serving plane's page transfer wire.
+
+Hetu's stance is that distribution is data flow, not plumbing — and a
+decode stream's KV state is data like any other.  This module gives the
+fleet a wire format for moving that state between sibling engines:
+
+* ``snapshot_request`` serializes a mid-decode request — its refcounted
+  pages as RAW pool rows (float32, or the quantized pool's codes +
+  scales, never requantized) plus the host-side stream state (prompt,
+  delivered tokens, position, effective sampling operands) — into a
+  CRC32-framed blob.
+* ``resume_request`` splices the blob into a sibling's pool
+  (``PagedKVCache.import_pages`` + ``InferenceEngine.adopt_request``)
+  and the stream continues BITWISE where it left off: paged sampling
+  keys fold only the per-request seed and the consumed count, so the
+  continuation is indistinguishable from an uninterrupted run.
+* ``snapshot_prefix_cache`` / ``install_prefix_cache`` do the same for
+  a replica's interned prefix pages, so the fleet-wide prefix cache
+  survives the replica that built it (failover handoff).
+
+Every parse error — torn frame, CRC mismatch, geometry drift, a
+receiver pool out of pages — raises :class:`TransferError` and leaves
+BOTH pools untouched (imported pages are rolled back before the raise).
+The fleet catches it and falls back to teacher-forced replay, the
+PR 12 bitwise oracle, so migration can only ever improve on replay:
+same stream either way, fewer recomputed tokens when the wire works.
+
+Framing: ``MAGIC`` then frames of ``[u32 length][payload][u32 crc32]``
+(big-endian).  Frame 0 is a JSON header carrying stream state, pool
+geometry, and array descriptors; subsequent frames are the raw array
+bytes in header order.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+import numpy as np
+
+MAGIC = b"HTKV1"
+#: bump on any framing/header change; receivers refuse other versions
+WIRE_VERSION = 1
+
+
+class TransferError(RuntimeError):
+    """A KV transfer failed (torn/corrupt frame, geometry mismatch,
+    receiver refusal).  Both pools are left untouched; the caller falls
+    back to teacher-forced replay."""
+
+
+# -- framing ----------------------------------------------------------------
+def _frame(payload):
+    return (struct.pack(">I", len(payload)) + payload
+            + struct.pack(">I", zlib.crc32(payload) & 0xFFFFFFFF))
+
+
+def _read_frame(blob, off):
+    if off + 4 > len(blob):
+        raise TransferError(
+            f"torn frame at offset {off}: length header truncated")
+    (n,) = struct.unpack_from(">I", blob, off)
+    off += 4
+    if off + n + 4 > len(blob):
+        raise TransferError(
+            f"torn frame at offset {off}: {n} payload bytes promised, "
+            f"{len(blob) - off} remain")
+    payload = blob[off:off + n]
+    off += n
+    (crc,) = struct.unpack_from(">I", blob, off)
+    off += 4
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise TransferError(
+            f"CRC32 mismatch in frame ending at offset {off} — "
+            "transfer corrupt, falling back to replay")
+    return payload, off
+
+
+def _pack(header, arrays):
+    parts = [MAGIC, _frame(json.dumps(
+        header, separators=(",", ":")).encode())]
+    for arr in arrays:
+        parts.append(_frame(np.ascontiguousarray(arr).tobytes()))
+    return b"".join(parts)
+
+
+def _unpack(blob):
+    if not isinstance(blob, (bytes, bytearray, memoryview)):
+        raise TransferError(
+            f"transfer blob must be bytes, got {type(blob).__name__}")
+    blob = bytes(blob)
+    if not blob.startswith(MAGIC):
+        raise TransferError("bad magic: not a KV transfer blob")
+    hb, off = _read_frame(blob, len(MAGIC))
+    try:
+        header = json.loads(hb.decode())
+    except Exception as e:
+        raise TransferError(f"header frame is not JSON: {e}") from e
+    if header.get("version") != WIRE_VERSION:
+        raise TransferError(
+            f"wire version {header.get('version')} != {WIRE_VERSION}")
+    raws = []
+    while off < len(blob):
+        raw, off = _read_frame(blob, off)
+        raws.append(raw)
+    return header, raws
+
+
+def _np_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import jax.numpy as jnp   # ml_dtypes names (fp8, bf16)
+        return np.dtype(getattr(jnp, name))
+
+
+def _describe(payload):
+    """Deterministic (name, array) order for a pool payload."""
+    names = (("k", "v") if payload["kv_dtype"] is None
+             else ("k_codes", "k_scales", "v_codes", "v_scales"))
+    return [(n, payload[n]) for n in names]
+
+
+def _rebuild(descs, raws):
+    if len(descs) != len(raws):
+        raise TransferError(
+            f"{len(descs)} arrays promised, {len(raws)} frames present")
+    out = {}
+    for d, raw in zip(descs, raws):
+        dt = _np_dtype(d["dtype"])
+        shape = tuple(int(x) for x in d["shape"])
+        want = int(np.prod(shape)) * dt.itemsize
+        if len(raw) != want:
+            raise TransferError(
+                f"array {d['name']!r}: {len(raw)} bytes for shape "
+                f"{shape} dtype {d['dtype']} (want {want})")
+        out[d["name"]] = np.frombuffer(raw, dt).reshape(shape)
+    return out
+
+
+def _check_geometry(header, cache):
+    want = cache.page_geometry()
+    got = header.get("geometry")
+    if got != want:
+        raise TransferError(
+            f"pool geometry mismatch: donor {got} vs receiver {want} — "
+            "pages cannot splice bit-identically, use replay")
+
+
+# -- request transfer -------------------------------------------------------
+def can_migrate(engine, req):
+    """True when ``req``'s decode state can move off ``engine`` whole:
+    paged engine without a ModelDraft, request running (not queued, not
+    mid-chunked-prefill, not replaying a previous attempt — the replay
+    remainder was already delivered and must not be re-emitted), with
+    at least one generated token and decoding still to do."""
+    return (getattr(engine, "_paged", False)
+            and engine._draft is None
+            and req.slot is not None
+            and not req.finished
+            and req.slot not in engine._prefilling
+            and not req.replaying
+            and 1 <= len(req.tokens) < req.max_new)
+
+
+def snapshot_request(engine, req):
+    """Serialize ``req``'s live decode state on ``engine`` into a
+    transfer blob.  Pure read: the donor keeps decoding this request
+    until the receiver acks (``engine.release_migrated``) — the caller
+    must hold the replica lock across snapshot → resume → ack so the
+    donor cannot advance past the snapshot in between."""
+    if not can_migrate(engine, req):
+        raise TransferError(
+            f"request {req.rid} is not migratable on {engine.instance} "
+            "(queued/prefilling/replaying/finished) — use replay")
+    cache = engine.cache
+    slot = req.slot
+    position = int(cache.positions[slot])
+    if position != int(req.prompt.size) + len(req.tokens) - 1:
+        raise TransferError(
+            f"request {req.rid}: position {position} torn vs prompt "
+            f"{int(req.prompt.size)} + {len(req.tokens)} tokens")
+    used = -(-position // cache.page_len)
+    pages = cache.slot_pages(slot)[:used]
+    payload = cache.export_pages(pages)
+    arrays = _describe(payload)
+    header = {
+        "version": WIRE_VERSION, "kind": "request",
+        "rid": req.rid,
+        "prompt": [int(t) for t in req.prompt],
+        "tokens": [int(t) for t in req.tokens],
+        "max_new": int(req.max_new),
+        "eos_id": None if req.eos_id is None else int(req.eos_id),
+        "deadline": req.deadline,
+        "position": position,
+        "pages": int(used),
+        # EFFECTIVE sampling operands (not the overrides): the receiver
+        # replays them verbatim, so its engine defaults never leak into
+        # a migrated stream's sampling key
+        "temperature": float(engine._temps[slot]),
+        "top_k": int(engine._topks[slot]),
+        "seed": int(engine._seeds[slot]),
+        "geometry": cache.page_geometry(),
+        "arrays": [{"name": n, "shape": list(a.shape),
+                    "dtype": a.dtype.name} for n, a in arrays],
+    }
+    return _pack(header, [a for _, a in arrays])
+
+
+def blob_info(blob):
+    """The parsed header of a transfer blob (full CRC walk — cheap at
+    page-pool sizes).  For metrics/inspection; raises TransferError on
+    a torn blob like any consumer would."""
+    header, _ = _unpack(blob)
+    return header
+
+
+def resume_request(engine, blob, stream=None, verify=None):
+    """Splice a :func:`snapshot_request` blob into ``engine`` and adopt
+    the stream.  ``verify(header, arrays)`` is the receiver-side hook:
+    called after CRC + geometry checks with the parsed header and the
+    name->array dict; raising, or returning False, refuses the
+    transfer.  Returns the adopted Request; raises
+    :class:`TransferError` on ANY failure with the receiver pool rolled
+    back (imported pages released) so replay can take over."""
+    header, raws = _unpack(blob)
+    if header.get("kind") != "request":
+        raise TransferError(
+            f"expected a request blob, got kind={header.get('kind')!r}")
+    _check_geometry(header, engine.cache)
+    arrays = _rebuild(header["arrays"], raws)
+    if verify is not None:
+        try:
+            ok = verify(header, arrays)
+        except Exception as e:
+            raise TransferError(
+                f"receiver verify hook rejected transfer: {e}") from e
+        if ok is False:
+            raise TransferError("receiver verify hook returned False")
+    payload = dict(arrays)
+    payload["kv_dtype"] = header["geometry"]["kv_dtype"]
+    pages = engine.cache.import_pages(payload)
+    if pages is None:
+        raise TransferError(
+            f"receiver {engine.instance} pool refused "
+            f"{header['pages']} pages (out of free pages)")
+    try:
+        req = engine.adopt_request(
+            np.asarray(header["prompt"], np.int32),
+            header["tokens"], pages, header["position"],
+            header["max_new"], rid=header.get("rid"), stream=stream,
+            eos_id=header.get("eos_id"), deadline=header.get("deadline"),
+            temperature=header["temperature"], top_k=header["top_k"],
+            seed=header["seed"])
+    except Exception as e:
+        engine.cache.release_pages(pages)
+        raise TransferError(
+            f"receiver {engine.instance} failed to adopt "
+            f"{header.get('rid')}: {e}") from e
+    if req is None:
+        engine.cache.release_pages(pages)
+        raise TransferError(
+            f"receiver {engine.instance} refused admission "
+            "(no free slot)")
+    return req
+
+
+# -- prefix-cache transfer --------------------------------------------------
+def snapshot_prefix_cache(engine, max_entries=None):
+    """Serialize ``engine``'s interned prefix entries (hottest last)
+    into a transfer blob, or None when there is nothing to hand off."""
+    pc = getattr(engine, "prefix_cache", None)
+    if pc is None:
+        return None
+    entries = pc.export_entries(max_entries=max_entries)
+    if not entries:
+        return None
+    header_entries = []
+    arrays = []
+    for ent in entries:
+        named = _describe(ent["payload"])
+        header_entries.append({
+            "tokens": [int(t) for t in ent["tokens"]],
+            "n_tokens": int(ent["n_tokens"]),
+            "arrays": [{"name": n, "shape": list(a.shape),
+                        "dtype": a.dtype.name} for n, a in named]})
+        arrays.extend(a for _, a in named)
+    header = {"version": WIRE_VERSION, "kind": "prefix",
+              "geometry": engine.cache.page_geometry(),
+              "entries": header_entries}
+    return _pack(header, arrays)
+
+
+def install_prefix_cache(engine, blob):
+    """Adopt a :func:`snapshot_prefix_cache` blob into ``engine``'s own
+    prefix cache (dedup-aware; pool-full entries are skipped, not
+    errors).  Returns the number of entries newly interned."""
+    pc = getattr(engine, "prefix_cache", None)
+    if pc is None:
+        return 0
+    header, raws = _unpack(blob)
+    if header.get("kind") != "prefix":
+        raise TransferError(
+            f"expected a prefix blob, got kind={header.get('kind')!r}")
+    _check_geometry(header, engine.cache)
+    adopted = 0
+    off = 0
+    for ent in header["entries"]:
+        n = len(ent["arrays"])
+        arrays = _rebuild(ent["arrays"], raws[off:off + n])
+        off += n
+        payload = dict(arrays)
+        payload["kv_dtype"] = header["geometry"]["kv_dtype"]
+        pages = engine.cache.import_pages(payload)
+        if pages is None:
+            continue   # receiver pool full: a cache is best-effort
+        if pc.adopt(np.asarray(ent["tokens"], np.int32),
+                    ent["n_tokens"], pages):
+            adopted += 1
+    return adopted

@@ -140,6 +140,49 @@ class PrefixCache:
             while len(self._entries) > self.max_entries:
                 self._evict_lru()
 
+    # -- cross-replica handoff (serving/kv_transfer.py) ---------------------
+    def export_entries(self, max_entries=None):
+        """Host-side snapshot of interned entries (coldest first,
+        hottest last — LRU order) for cross-replica handoff on
+        failover: each item carries the prefix tokens, its token count,
+        and the raw page payload from ``pool.export_pages``.  Pure
+        read; the donor entries stay live."""
+        items = list(self._entries.items())
+        if max_entries is not None:
+            items = items[-int(max_entries):]
+        out = []
+        for key, (pages, n_tokens) in items:
+            out.append({"tokens": np.frombuffer(key, np.int32),
+                        "n_tokens": int(n_tokens),
+                        "payload": self.pool.export_pages(pages)})
+        return out
+
+    def adopt(self, tokens, n_tokens, pages):
+        """Intern an entry around ALREADY-IMPORTED pages of this cache's
+        own pool: the entry takes over the caller's one reference per
+        page (mirroring what ``retain_pages`` would have granted).  On a
+        dedup hit the existing entry wins and the caller's pages are
+        released.  Returns True if a new entry landed."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n_tokens = int(n_tokens)
+        pages = tuple(int(p) for p in pages)
+        if (tokens.size != n_tokens
+                or n_tokens != len(pages) * self.pool.page_len):
+            raise ValueError(
+                f"prefix entry shape torn: {tokens.size} tokens, "
+                f"n_tokens={n_tokens}, {len(pages)} pages of "
+                f"page_len={self.pool.page_len}")
+        key = tokens.tobytes()
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            self.pool.release_pages(pages)
+            return False
+        self._entries[key] = (pages, n_tokens)
+        self.interned += 1
+        while len(self._entries) > self.max_entries:
+            self._evict_lru()
+        return True
+
     # -- reporting / lifecycle ---------------------------------------------
     def stats(self):
         total = self.hits + self.misses

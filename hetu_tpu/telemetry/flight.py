@@ -39,7 +39,8 @@ __all__ = ["FlightRecorder", "INCIDENT_KINDS"]
 #: every trip kind a dump can carry (documented in docs/INCIDENTS.md)
 INCIDENT_KINDS = ("guard_trip", "watchdog", "engine_crash",
                   "engine_wedge", "breaker_open", "fleet_unavailable",
-                  "ps_unavailable", "slo_scale", "slo_degrade")
+                  "ps_unavailable", "slo_scale", "slo_degrade",
+                  "migrate_failed")
 
 
 class FlightRecorder:

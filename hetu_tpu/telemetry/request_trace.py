@@ -45,7 +45,7 @@ __all__ = ["RequestTrace", "EVENT_TYPES"]
 EVENT_TYPES = ("queued", "admitted", "prefill_start", "prefill_chunk",
                "prefill_end", "decode_iter", "hot_hit", "host_pull",
                "watchdog_trip", "harvested", "failover_replay",
-               "expired", "cancelled", "finish")
+               "migrated", "expired", "cancelled", "finish")
 
 #: attempt-level finish reasons that do NOT end the cluster timeline
 #: (the fleet re-homes the rid; more events follow)

@@ -36,6 +36,16 @@ direction-aware per-signal tolerances:
   platform-conditional — gated one-sided like throughput when the
   current round ran on a real TPU mesh, informational on CPU where the
   forced host "devices" time-share the same cores.
+* migration signals (``migrate_*``, from ``bench.py --serve --fleet
+  --migrate``) — checked BEFORE the generic speedup class: the
+  ``migrate_*_speedup`` ratios gate against an ABSOLUTE floor of 1.0
+  rather than the baseline (the contract is "live page migration is
+  never slower than the teacher-forced replay it falls back to", and
+  that holds on any platform — both sides of each A/B share the same
+  machine); ``migrate_bytes_per_token`` is a static wire-cost signal
+  (tight tolerance — the blob quietly growing per token is a framing
+  regression); the rest (drain-time ratio, prefix hit rate after a
+  crash) are trend context.
 
 Signals present on only one side are reported as notes, never failures
 (new programs appear, old ones retire).  Exit status: 0 when every
@@ -92,15 +102,28 @@ INFO_MARKERS = ("shed_fraction", "numerics", "grad_norm", "update_norm",
 #: mesh — on CPU the forced host "devices" share the same cores, so the
 #: ratio is machine-load noise and must not gate
 SPEEDUP_MARKERS = ("speedup",)
+#: live-KV-migration signals (``bench.py --serve --fleet --migrate``).
+#: Checked before SPEEDUP_MARKERS: ``migrate_vs_replay_speedup``
+#: contains "speedup" but gates against an ABSOLUTE 1.0 floor on every
+#: platform — each A/B ran migration and replay on the same machine, so
+#: the ratio is platform-independent in a way the TP speedup is not.
+MIGRATION_PREFIX = "migrate_"
 
 
 def classify(name, platform=None):
     """'attainment' (higher is better, absolute one-sided),
     'error_bound' (lower is better, one-sided growth), 'info' (never
-    gates), 'throughput' (higher is better, ratio), or 'static' (lower
-    is better, ratio).  Speedup signals are throughput on a real TPU
-    mesh and informational anywhere else (forced-host CPU devices
-    time-share the same cores)."""
+    gates), 'throughput' (higher is better, ratio), 'static' (lower
+    is better, ratio), or 'migration_floor' (absolute one-sided floor
+    at 1.0).  Speedup signals are throughput on a real TPU mesh and
+    informational anywhere else (forced-host CPU devices time-share the
+    same cores)."""
+    if name.startswith(MIGRATION_PREFIX):
+        if "speedup" in name:
+            return "migration_floor"
+        if "bytes_per_token" in name:
+            return "static"
+        return "info"
     if any(m in name for m in SPEEDUP_MARKERS):
         return "throughput" if platform == "tpu" else "info"
     if any(m in name for m in ATTAINMENT_MARKERS):
@@ -177,6 +200,13 @@ def diff_signals(current, baseline, tol_throughput, tol_static,
             # re-commit the bound instead
             ratio = None if base == 0 else cur / base
             regressed = base > 0 and cur > base * (1.0 + tol_error_bound)
+        elif kind == "migration_floor":
+            # absolute one-sided floor: the migrate/replay A/B shares a
+            # machine, so < 1.0 means live migration lost to the replay
+            # oracle outright — a contract break, not noise.  The
+            # baseline only supplies trend context.
+            ratio = None if base == 0 else cur / base
+            regressed = cur < 1.0
         elif kind == "info":
             ratio = None if base == 0 else cur / base
             regressed = False
