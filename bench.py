@@ -2987,6 +2987,196 @@ def _emit_profile(out, history_path=None):
     _print_compact(compact, drop_order=("history",))
 
 
+# -- plan mode (bench.py --plan) -------------------------------------------
+# Auto-parallel planner evidence (ISSUE 18): calibrate per-layer
+# LayerProfiles on the live backend (compiled fwd+bwd timing + XLA
+# temp-bytes slope + measured ICI), run the Galvatron search, persist
+# the winning plan as a versioned artifact, then EXECUTE the emitted
+# plan through HybridParallelModel and gate the predicted-vs-measured
+# iteration-time error (plan_pred_err) plus a hand-picked pure-DP
+# baseline A/B.  A pre-existing HETU_PLAN_PROFILE artifact is reused
+# instead of recalibrated — same profile in, byte-identical plan out.
+
+PLAN_DETAIL_PATH = os.environ.get(
+    "HETU_PLAN_JSON",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "PLAN_FULL.json"))
+
+PLAN_PROFILE_PATH = os.environ.get(
+    "HETU_PLAN_PROFILE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "benchmarks", "plan_profile.json"))
+
+PLAN_ARTIFACT_PATH = os.environ.get(
+    "HETU_PLAN_ARTIFACT",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "benchmarks", "plan_train.json"))
+
+
+def _plan_specs(quick):
+    from hetu_tpu.galvatron.runtime import TransformerHPLayer
+    n = 4 if quick else 8
+    hidden = 64 if quick else 128
+    return [TransformerHPLayer(hidden, 4, ffn=2 * hidden)
+            for _ in range(n)]
+
+
+def _plan_budget():
+    """Per-device search memory budget: the backend's reported HBM
+    limit when it has one, a 4 GiB nominal otherwise (CPU)."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(0.9 * stats["bytes_limit"])
+    except Exception:
+        pass
+    return 4 << 30
+
+
+def _plan_execute(cfg, specs, global_bsz, seq, reps):
+    """Run the config through HybridParallelModel's real train step and
+    return the measured per-iteration milliseconds (median of ``reps``
+    fully-synced iterations — the same per-iteration quantity the cost
+    model predicts)."""
+    import statistics
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.galvatron.runtime import HybridParallelModel
+    model = HybridParallelModel(specs, cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    step, opt_init = model.make_train_step()
+    opt_state = opt_init(params)
+    hidden = specs[0].hidden
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (global_bsz, seq, hidden), jnp.float32)
+    tgt = jax.random.normal(jax.random.PRNGKey(2),
+                            (global_bsz, seq, hidden), jnp.float32)
+    params, opt_state, loss = step(params, opt_state, x, tgt)
+    jax.block_until_ready(loss)                 # compile outside
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, x, tgt)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) * 1e3
+
+
+def run_plan(quick=False, seed=0):
+    import jax
+    from hetu_tpu.galvatron.config import HybridParallelConfig
+    from hetu_tpu.galvatron.search import LayerProfile, load_profile_doc
+    from hetu_tpu.planner import (calibrate_and_save,
+                                  emit_plan_from_profile, predict,
+                                  save_plan, serving_tp)
+    specs = _plan_specs(quick)
+    n = len(specs)
+    seq = 32 if quick else 64
+    global_bsz = 8
+    t0 = time.perf_counter()
+    reused = os.path.exists(PLAN_PROFILE_PATH)
+    if not reused:
+        # calibrate at the SAME batch the plan will execute, so the
+        # per-sample compute_ms and the measured step share fixed costs
+        calibrate_and_save(PLAN_PROFILE_PATH, specs, batch=global_bsz,
+                           seq=seq, reps=5 if quick else 20)
+    calibrate_s = time.perf_counter() - t0
+    doc = load_profile_doc(PLAN_PROFILE_PATH)
+    layers = [LayerProfile.from_json(l) for l in doc["layers"]]
+    world = jax.device_count()
+    t0 = time.perf_counter()
+    plan = emit_plan_from_profile(
+        PLAN_PROFILE_PATH, world, _plan_budget(),
+        global_bsz=global_bsz, chunks_candidates=(1, 2, 4))
+    search_ms = (time.perf_counter() - t0) * 1e3
+    save_plan(PLAN_ARTIFACT_PATH, plan)
+    cfg = HybridParallelConfig.from_json(plan["config"])
+    reps = 10 if quick else 30
+    meas_ms = _plan_execute(cfg, specs, global_bsz, seq, reps)
+    pred_ms = plan["predicted"]["iter_ms"]
+    err = abs(pred_ms - meas_ms) / meas_ms
+    # hand-picked baseline: the config a person writes without a
+    # search — uniform pure data parallelism, no pipeline, no ckpt
+    hand_cfg = HybridParallelConfig(
+        pp_deg=1, tp_sizes=[1] * n, dp_types=[0] * n, world=world,
+        global_bsz=global_bsz, chunks=1)
+    hand_pred = predict(hand_cfg, layers,
+                        ici_gbps=doc.get("ici_gbps", 100.0))
+    hand_ms = _plan_execute(hand_cfg, specs, global_bsz, seq, reps)
+    signals = {
+        "plan_pred_err": round(err, 6),
+        "plan_iter_ms": round(meas_ms, 4),
+        "plan_pred_iter_ms": round(pred_ms, 4),
+        "plan_hand_iter_ms": round(hand_ms, 4),
+        "plan_vs_hand_ratio": round(meas_ms / hand_ms, 4)
+        if hand_ms > 0 else None,
+        "plan_search_ms": round(search_ms, 3),
+    }
+    signals = {k: v for k, v in signals.items() if v is not None}
+    return {"metric": "plan_pred_err", "value": round(err, 6),
+            "unit": "frac", "vs_baseline": None,
+            "platform": jax.default_backend(),
+            "seed": seed, "quick": bool(quick),
+            "world": world, "n_layers": n,
+            "profile": {"path": os.path.basename(PLAN_PROFILE_PATH),
+                        "reused": bool(reused),
+                        "calibrate_s": round(calibrate_s, 3),
+                        "ici_gbps": doc.get("ici_gbps"),
+                        "meta": doc.get("meta")},
+            "plan": plan,
+            "plan_artifact": os.path.basename(PLAN_ARTIFACT_PATH),
+            "serving_tp": serving_tp(plan),
+            "measured": {"iter_ms": round(meas_ms, 4), "reps": reps,
+                         "global_bsz": global_bsz, "seq": seq},
+            "hand_baseline": {"iter_ms": round(hand_ms, 4),
+                              "predicted": hand_pred,
+                              "config": hand_cfg.to_json()},
+            "signals": signals}
+
+
+def _emit_plan(out, history_path=None):
+    """Plan evidence in the bench layered shape: full headline to an
+    early line + PLAN_FULL.json (written only after the run has real
+    results — the no-clobber contract), one signals entry appended to
+    benchmarks/history.jsonl, compact tail line with the ``pl``
+    block."""
+    from hetu_tpu.telemetry import JsonlWriter
+    history_path = HISTORY_PATH if history_path is None else history_path
+    full = json.dumps(out)
+    try:
+        with open(PLAN_DETAIL_PATH, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass
+    entry = {"t": round(time.time(), 3), "platform": out["platform"],
+             "quick": out["quick"], "seed": out["seed"],
+             "signals": out["signals"]}
+    try:
+        os.makedirs(os.path.dirname(history_path) or ".", exist_ok=True)
+        with JsonlWriter(history_path) as w:     # append, never truncate
+            w.write(entry)
+    except OSError:
+        pass
+    print(full, flush=True)
+    plan = out["plan"]
+    cfgj = plan["config"]
+    pl = {"iter_ms": out["measured"]["iter_ms"],
+          "pred_ms": plan["predicted"]["iter_ms"],
+          "hand_ms": out["hand_baseline"]["iter_ms"],
+          "core": plan["core"],
+          "pp": cfgj.get("pp_deg"),
+          "tp_max": out["serving_tp"],
+          "chunks": cfgj.get("chunks"),
+          "world": out["world"]}
+    compact = {"metric": out["metric"], "value": out["value"],
+               "unit": out["unit"], "platform": out["platform"],
+               "pl": pl,
+               "history": os.path.basename(history_path),
+               "detail": os.path.basename(PLAN_DETAIL_PATH)}
+    _print_compact(compact, drop_order=("history",))
+
+
 # -- SLO control-plane mode (bench.py --slo) -------------------------------
 # The ISSUE 11 evidence: a seeded bursty "diurnal" arrival trace driven
 # through a FleetController-supervised fleet and through its static
@@ -4311,6 +4501,19 @@ def main():
         out = run_profile(quick)
         out["telemetry"] = _telemetry_report()
         _emit_profile(out)
+        return
+    if "--plan" in sys.argv:
+        # plan mode runs in-process: calibrate measured LayerProfiles,
+        # run the Galvatron search, persist the profile + plan
+        # artifacts, execute the emitted plan end-to-end and gate the
+        # predicted-vs-measured iteration-time error (plan_pred_err).
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        quick = quick or jax.default_backend() == "cpu"
+        out = run_plan(quick)
+        _emit_plan(out)
         return
     if "--slo" in sys.argv:
         # SLO control-plane mode runs in-process: the seeded bursty

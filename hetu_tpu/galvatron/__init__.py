@@ -4,10 +4,11 @@ in galvatron/core/{parallel,pipeline,comm_groups}.py), re-designed for TPU
 meshes: per-layer (tp, DDP|FSDP, checkpoint) strategies expressed as
 PartitionSpecs on a binary-factorized mesh inside one SPMD program."""
 
-from .build import dp_core, dp_core_numpy
+from .build import dp_core, dp_core_auto, dp_core_numpy
 from .config import HybridParallelConfig, layer_mesh_axes, tp_dp_axes
-from .search import (CostModel, GalvatronSearch, LayerProfile, Strategy,
-                     load_profile, measure_ici_gbps,
+from .search import (CostModel, GalvatronSearch, LayerProfile,
+                     ProfileError, Strategy,
+                     load_profile, load_profile_doc, measure_ici_gbps,
                      profile_layers_analytic, profile_hp_layers,
                      save_profile,
                      strategy_space)
@@ -17,9 +18,10 @@ from .runtime import (HybridParallelModel, LayerShardings,
                       make_lm_hybrid_model, build_mesh)
 
 __all__ = [
-    "dp_core", "dp_core_numpy", "HybridParallelConfig", "layer_mesh_axes",
+    "dp_core", "dp_core_auto", "dp_core_numpy", "HybridParallelConfig", "layer_mesh_axes",
     "tp_dp_axes", "CostModel", "GalvatronSearch", "LayerProfile", "Strategy",
-    "load_profile", "measure_ici_gbps",
+    "load_profile", "load_profile_doc", "measure_ici_gbps",
+    "ProfileError",
     "profile_layers_analytic", "profile_hp_layers",
     "save_profile",
     "strategy_space", "HybridParallelModel", "LayerShardings",

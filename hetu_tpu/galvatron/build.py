@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import warnings
 
 import numpy as np
 
@@ -67,6 +68,33 @@ def dp_core(mem_cost, intra_cost, inter_cost, max_mem):
     if rc != 0:
         return float("inf"), None, -1
     return float(cost.value), res.tolist(), int(left.value)
+
+
+_fallback_warned = False
+
+
+def dp_core_auto(mem_cost, intra_cost, inter_cost, max_mem,
+                 use_native=True):
+    """Run the DP on the native csrc core when it builds, the numpy
+    oracle otherwise — and say WHICH ran: returns ``(result, core)``
+    with ``core in ("native", "numpy")``.  A toolchain-less host must
+    not silently search on a different code path than the one the
+    committed plans were produced by, so the first native→numpy
+    fallback warns with the build error."""
+    global _fallback_warned
+    if use_native:
+        try:
+            return dp_core(mem_cost, intra_cost, inter_cost,
+                           max_mem), "native"
+        except (RuntimeError, OSError) as e:
+            if not _fallback_warned:
+                _fallback_warned = True
+                warnings.warn(
+                    f"galvatron native dp_core unavailable "
+                    f"({type(e).__name__}: {e}); searches run on the "
+                    f"numpy oracle instead")
+    return dp_core_numpy(mem_cost, intra_cost, inter_cost,
+                         max_mem), "numpy"
 
 
 def dp_core_numpy(mem_cost, intra_cost, inter_cost, max_mem):

@@ -20,10 +20,11 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 
 import numpy as np
 
-from .build import dp_core, dp_core_numpy
+from .build import dp_core_auto
 from .config import HybridParallelConfig
 
 
@@ -60,16 +61,68 @@ class LayerProfile:
                    d.get("act_mem_bytes"))
 
 
-def save_profile(path, layers, ici_gbps=100.0, dcn_gbps=10.0):
-    """computation_profiling_*.json equivalent."""
-    with open(path, "w") as fh:
-        json.dump({"layers": [l.to_json() for l in layers],
-                   "ici_gbps": ici_gbps, "dcn_gbps": dcn_gbps}, fh, indent=2)
+#: the profile artifact is a versioned contract: the planner
+#: (hetu_tpu/planner) loads it across sessions, so a torn or
+#: foreign-schema file must fail loudly, not search on garbage
+PROFILE_SCHEMA = "galvatron_profile"
+PROFILE_VERSION = 1
+
+
+class ProfileError(ValueError):
+    """A profile artifact failed schema/version validation on load."""
+
+
+def save_profile(path, layers, ici_gbps=100.0, dcn_gbps=10.0, meta=None):
+    """computation_profiling_*.json equivalent.  Atomic (tmp +
+    ``os.replace``, the checkpoint-writer convention): a crash mid-write
+    leaves the previous artifact intact instead of a torn JSON that a
+    later search would load as garbage.  ``meta`` carries calibration
+    provenance (platform, shapes, window) verbatim."""
+    doc = {"schema": PROFILE_SCHEMA, "version": PROFILE_VERSION,
+           "layers": [l.to_json() for l in layers],
+           "ici_gbps": ici_gbps, "dcn_gbps": dcn_gbps}
+    if meta:
+        doc["meta"] = dict(meta)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_profile_doc(path):
+    """The validated raw artifact dict, or :class:`ProfileError`."""
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise ProfileError(f"unreadable profile artifact {path}: {e}")
+    if not isinstance(d, dict):
+        raise ProfileError(f"profile artifact {path} is not an object")
+    if d.get("schema") != PROFILE_SCHEMA:
+        raise ProfileError(
+            f"profile artifact {path}: schema "
+            f"{d.get('schema')!r} != {PROFILE_SCHEMA!r}")
+    if d.get("version") != PROFILE_VERSION:
+        raise ProfileError(
+            f"profile artifact {path}: version "
+            f"{d.get('version')!r} != {PROFILE_VERSION}")
+    layers = d.get("layers")
+    if not isinstance(layers, list) or not layers:
+        raise ProfileError(f"profile artifact {path}: empty layers")
+    for i, l in enumerate(layers):
+        missing = {"compute_ms", "param_bytes", "act_bytes"} - set(
+            l if isinstance(l, dict) else ())
+        if missing:
+            raise ProfileError(
+                f"profile artifact {path}: layer {i} missing "
+                f"{sorted(missing)}")
+    return d
 
 
 def load_profile(path):
-    with open(path) as fh:
-        d = json.load(fh)
+    d = load_profile_doc(path)
     return ([LayerProfile.from_json(l) for l in d["layers"]],
             d.get("ici_gbps", 100.0), d.get("dcn_gbps", 10.0))
 
@@ -229,6 +282,10 @@ class GalvatronSearch:
         self.use_native = use_native
         self.pp_candidates = pp_candidates
         self.chunks_candidates = chunks_candidates
+        #: which DP core actually ran the last search ("native" csrc or
+        #: the "numpy" oracle) — plan artifacts record it as provenance
+        self.core_used = None
+        self.best_cost_ms = None
 
     def _pp_list(self, n_layers):
         if self.pp_candidates is not None:
@@ -257,6 +314,10 @@ class GalvatronSearch:
                                                chunks, global_bsz)
                 if cost < best[0]:
                     best = (cost, cfg)
+        # the winning estimate is the planner's predicted iteration time
+        # (ms per step at global_bsz) — plan artifacts gate it against
+        # the measured run
+        self.best_cost_ms = best[0] if best[1] is not None else None
         return best[1]
 
     def _search_inner(self, layers, pp, per_stage, space, chunks, global_bsz):
@@ -342,15 +403,15 @@ class GalvatronSearch:
 
     def _eval_division(self, division, pp, space, chunks, global_bsz,
                        mem, intra, inter):
-        run = dp_core if self.use_native else dp_core_numpy
         assignment, stage_times = [], []
         lo = 0
         for stage_len in division:
             hi = lo + stage_len
-            cost, stage_assign, _ = run(
+            (cost, stage_assign, _), self.core_used = dp_core_auto(
                 np.ascontiguousarray(mem[lo:hi]),
                 np.ascontiguousarray(intra[lo:hi]),
-                np.ascontiguousarray(inter[lo:hi]), self.mem_units)
+                np.ascontiguousarray(inter[lo:hi]), self.mem_units,
+                use_native=self.use_native)
             if stage_assign is None:
                 return float("inf"), None
             assignment += stage_assign

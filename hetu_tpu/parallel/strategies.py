@@ -217,6 +217,54 @@ class ModelParallel4CNN(Strategy):
         return self.mesh
 
 
+class PlannedParallel(Strategy):
+    """A planner-emitted plan artifact as a graph annotation.
+
+    The auto-parallel planner (``hetu_tpu/planner``) emits a searched
+    ``hetu_train_plan`` dict; this strategy lowers it onto a flat node
+    graph by delegating to the simple strategy the plan's per-layer
+    assignment implies: searched tp > 1 -> :class:`MegatronLM` on a
+    dp×tp mesh, fsdp-majority dp_types -> :class:`FSDP`, else
+    :class:`DataParallel`.  (Pipeline stages are a runtime-level
+    concept — ``galvatron/runtime.HybridParallelModel`` executes them —
+    so a node-graph annotation uses the plan's intra-stage layout.)
+
+    ``config()``/``save_json`` persist the full plan dict, so a saved
+    strategy round-trips through :meth:`Strategy.load_json`."""
+
+    def __init__(self, plan, mesh_shape=None):
+        cfg = plan["config"] if "config" in plan else plan
+        from ..galvatron.config import HybridParallelConfig
+        hp = (HybridParallelConfig.from_json(cfg)
+              if isinstance(cfg, dict) else cfg)
+        self.plan = dict(plan)
+        self.mesh_shape = dict(mesh_shape) if mesh_shape else None
+        tp = max(int(t) for t in hp.tp_sizes)
+        world = int(hp.world or hp.pp_deg * tp)
+        dp = max(1, world // (int(hp.pp_deg) * tp))
+        fsdp = sum(int(t) for t in hp.dp_types) * 2 > len(hp.dp_types)
+        self.tp, self.dp = tp, dp
+        mesh = make_mesh(self.mesh_shape) if self.mesh_shape else None
+        if tp > 1:
+            self._inner = MegatronLM(
+                mesh=mesh if mesh is not None
+                else make_mesh({"dp": dp, "tp": tp}))
+        elif fsdp and dp > 1:
+            self._inner = FSDP(mesh=mesh, ndev=dp)
+        else:
+            self._inner = DataParallel(mesh=mesh, ndev=dp)
+        self.lowered = type(self._inner).__name__
+
+    def annotate(self, eval_nodes):
+        self.mesh = self._inner.annotate(eval_nodes)
+        return self.mesh
+
+    def config(self):
+        return {"strategy": type(self).__name__,
+                "plan": self.plan,
+                "mesh_shape": self.mesh_shape}
+
+
 def _ndev():
     import jax
     return len(jax.devices())

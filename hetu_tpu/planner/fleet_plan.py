@@ -1,0 +1,244 @@
+"""Serving-mesh planner: measured costs -> fleet shape under HBM + SLO.
+
+The training side searches layer assignments; the serving side's search
+space is the FLEET SHAPE: tensor-parallel degree × replica count ×
+KV page-pool geometry.  :func:`plan_fleet` enumerates that space under
+two hard constraints — the fleet's total HBM footprint must fit the
+declared budget, and the projected per-token / first-token latencies
+must meet the declared :class:`~hetu_tpu.serving.control.SLO` — and
+picks the cheapest feasible shape (fewest chips, then least HBM, then
+most capacity).
+
+Evidence in, never hand numbers: ``decode_s`` / ``prefill_s`` come from
+the controller's measured :class:`~hetu_tpu.serving.control.CostModel`
+(:func:`fleet_plan_from_controller` refuses to plan without measured
+decode evidence — an unmeasured plan is a guess wearing a schema).
+KV page-pool bytes follow ``serving/kv_cache.py``'s exact geometry
+(``n_pages = n_slots × ceil(max_len / page_len) + 1`` with the sentinel
+page), so the planner's HBM arithmetic is the ledger's arithmetic.
+
+``FleetController.replan()`` (serving/control.py) adopts an emitted
+fleet plan live via the PR 17 migrate-then-drain machinery.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+FLEET_PLAN_SCHEMA = "hetu_fleet_plan"
+FLEET_PLAN_VERSION = 1
+
+
+class FleetPlanError(ValueError):
+    """No feasible fleet shape, missing measured evidence, or a fleet
+    plan artifact failed validation."""
+
+
+def _candidate(tp, replicas, page_len, *, decode_s, prefill_s,
+               bytes_per_token, params_bytes_per_replica, n_slots,
+               max_len, avg_decode_tokens, tp_efficiency):
+    """One enumerated shape, fully costed.  Returns the candidate dict
+    (feasibility against budget/SLO is the caller's cut)."""
+    max_pages = math.ceil(max_len / page_len)
+    n_pages = n_slots * max_pages + 1          # kv_cache sentinel page 0
+    kv_pool = n_pages * page_len * bytes_per_token
+    # tp shards both weights and the KV pool across the replica's chips,
+    # so per-replica HBM is invariant in tp — what tp buys is latency
+    replica_hbm = params_bytes_per_replica + kv_pool
+    fleet_hbm = replicas * replica_hbm
+    speed = 1.0 if tp == 1 else tp * tp_efficiency
+    tpot = decode_s / speed
+    prefill = (prefill_s / speed) if prefill_s is not None else tpot
+    per_req = avg_decode_tokens * tpot + prefill
+    capacity_rps = (replicas * n_slots / per_req) if per_req > 0 else 0.0
+    return {"tp_size": int(tp), "replicas": int(replicas),
+            "page_len": int(page_len), "n_pages": int(n_pages),
+            "n_slots": int(n_slots), "max_len": int(max_len),
+            "chips": int(tp * replicas),
+            "kv_pool_bytes": int(round(kv_pool)),
+            "replica_hbm_bytes": int(round(replica_hbm)),
+            "fleet_hbm_bytes": int(round(fleet_hbm)),
+            "tpot_s": round(tpot, 9),
+            "prefill_s": round(prefill, 9),
+            "capacity_rps": round(capacity_rps, 6)}
+
+
+def plan_fleet(decode_s, bytes_per_token, hbm_budget_bytes, slo=None,
+               prefill_s=None, offered_rps=None, avg_decode_tokens=16,
+               params_bytes_per_replica=0, n_slots=4, max_len=64,
+               page_len_candidates=(8, 16, 32), tp_candidates=(1,),
+               min_replicas=1, max_replicas=8, tp_efficiency=0.7,
+               meta=None):
+    """Search fleet shapes and emit the fleet plan artifact dict.
+
+    ``decode_s`` / ``prefill_s`` are MEASURED single-chip seconds (the
+    CostModel's EWMAs); tp divides them by ``tp × tp_efficiency``
+    (sub-linear collective overhead).  A shape is feasible when its
+    total HBM fits ``hbm_budget_bytes``, it meets ``slo``'s tpot/ttft
+    bounds, and (when ``offered_rps`` is given) its admission capacity
+    covers the offered load.  Objective among feasible shapes:
+    fewest chips, then least fleet HBM, then most capacity — a
+    deterministic total order, so the same evidence always emits the
+    same plan.  Raises :class:`FleetPlanError` when nothing fits."""
+    if decode_s is None or decode_s <= 0:
+        raise FleetPlanError(
+            "plan_fleet needs a measured decode_s > 0 — no evidence, "
+            "no plan")
+    if bytes_per_token <= 0:
+        raise FleetPlanError(f"bytes_per_token={bytes_per_token} must "
+                             f"be > 0")
+    cands, rejected = [], {"hbm": 0, "slo": 0, "load": 0}
+    for tp in sorted(set(int(t) for t in tp_candidates)):
+        for replicas in range(int(min_replicas), int(max_replicas) + 1):
+            for page_len in sorted(set(int(p)
+                                       for p in page_len_candidates)):
+                if page_len < 1 or page_len > max_len:
+                    continue
+                c = _candidate(
+                    tp, replicas, page_len, decode_s=float(decode_s),
+                    prefill_s=(None if prefill_s is None
+                               else float(prefill_s)),
+                    bytes_per_token=float(bytes_per_token),
+                    params_bytes_per_replica=float(
+                        params_bytes_per_replica),
+                    n_slots=int(n_slots), max_len=int(max_len),
+                    avg_decode_tokens=float(avg_decode_tokens),
+                    tp_efficiency=float(tp_efficiency))
+                if c["fleet_hbm_bytes"] > hbm_budget_bytes:
+                    rejected["hbm"] += 1
+                    continue
+                if slo is not None:
+                    tpot_lim = getattr(slo, "tpot_p99_s", None)
+                    ttft_lim = getattr(slo, "ttft_p99_s", None)
+                    if ((tpot_lim is not None
+                         and c["tpot_s"] > tpot_lim)
+                            or (ttft_lim is not None
+                                and c["prefill_s"] > ttft_lim)):
+                        rejected["slo"] += 1
+                        continue
+                if (offered_rps is not None
+                        and c["capacity_rps"] < float(offered_rps)):
+                    rejected["load"] += 1
+                    continue
+                cands.append(c)
+    if not cands:
+        raise FleetPlanError(
+            f"no feasible fleet shape: budget={hbm_budget_bytes} bytes, "
+            f"decode_s={decode_s}, rejections={rejected}")
+    best = min(cands, key=lambda c: (c["chips"], c["fleet_hbm_bytes"],
+                                     -c["capacity_rps"], c["tp_size"],
+                                     c["replicas"], c["page_len"]))
+    plan = {"schema": FLEET_PLAN_SCHEMA, "version": FLEET_PLAN_VERSION,
+            "hbm_budget_bytes": int(hbm_budget_bytes),
+            "evidence": {
+                "decode_s": round(float(decode_s), 9),
+                "prefill_s": (None if prefill_s is None
+                              else round(float(prefill_s), 9)),
+                "bytes_per_token": round(float(bytes_per_token), 6),
+                "params_bytes_per_replica": int(
+                    round(params_bytes_per_replica)),
+                "avg_decode_tokens": float(avg_decode_tokens),
+                "tp_efficiency": float(tp_efficiency),
+                "offered_rps": (None if offered_rps is None
+                                else float(offered_rps)),
+                "slo": slo.as_dict() if slo is not None else None},
+            "searched": len(cands) + sum(rejected.values()),
+            "feasible": len(cands),
+            "rejected": rejected,
+            "shape": best}
+    if meta:
+        plan["meta"] = dict(meta)
+    return plan
+
+
+def fleet_plan_from_controller(ctl, hbm_budget_bytes=None,
+                               bytes_per_token=None, **kw):
+    """Emit a fleet plan from a live controller's MEASURED state.
+
+    Evidence: ``ctl.cost.decode_s`` (refuse when None — the cost model
+    has observed nothing), the largest measured prefill bucket, the
+    ledger's per-replica KV projection for byte geometry, and the
+    fleet's own slot/page configuration.  Budget defaults to the
+    safety-scaled device HBM limit across the fleet's current chips."""
+    decode_s = ctl.cost.decode_s
+    if decode_s is None:
+        raise FleetPlanError(
+            "controller's CostModel has no measured decode_s — run "
+            "traffic (or CostModel.prime) before planning")
+    prefill_s = None
+    if ctl.cost.prefill_s:
+        prefill_s = ctl.cost.prefill_s[max(ctl.cost.prefill_s)]
+    fleet = ctl.fleet
+    ekw = dict(getattr(fleet, "_ekw", {}) or {})
+    n_slots = int(ekw.get("n_slots", 4))
+    max_len = int(ekw.get("max_len", 64))
+    page_len = int(ekw.get("page_len", 16) or 16)
+    if bytes_per_token is None:
+        # per-token bytes from the live pool: projected per-replica KV
+        # bytes over the pool's token capacity (pages x page_len)
+        kv = ctl._kv_projection()
+        max_pages = math.ceil(max_len / page_len)
+        n_pages = n_slots * max_pages + 1
+        if kv > 0:
+            bytes_per_token = kv / (n_pages * page_len)
+        else:
+            raise FleetPlanError(
+                "no live kv_cache ledger evidence and no "
+                "bytes_per_token override — nothing to size pages from")
+    live = len(ctl._live_replicas())
+    if hbm_budget_bytes is None:
+        chips = max(1, int(getattr(fleet, "tp_size", 1)) * max(1, live))
+        hbm_budget_bytes = int(ctl.hbm_safety * ctl._device_hbm_limit()
+                               * chips)
+    kw.setdefault("slo", ctl.slo)
+    kw.setdefault("n_slots", n_slots)
+    kw.setdefault("max_len", max_len)
+    kw.setdefault("min_replicas", ctl.min_engines)
+    kw.setdefault("max_replicas", ctl.max_engines)
+    kw.setdefault("meta", {"source": "controller",
+                           "fleet": getattr(fleet, "name", "fleet"),
+                           "live_replicas": live})
+    return plan_fleet(decode_s, bytes_per_token, hbm_budget_bytes,
+                      prefill_s=prefill_s, **kw)
+
+
+def fleet_plan_dumps(plan):
+    """Canonical fleet-plan bytes (sorted keys, trailing newline)."""
+    return json.dumps(plan, indent=2, sort_keys=True) + "\n"
+
+
+def save_fleet_plan(path, plan):
+    """Atomic fleet-plan write (tmp + ``os.replace``)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(fleet_plan_dumps(plan))
+    os.replace(tmp, path)
+    return path
+
+
+def load_fleet_plan(path):
+    """Validated fleet plan dict, or :class:`FleetPlanError`."""
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise FleetPlanError(f"unreadable fleet plan {path}: {e}")
+    if not isinstance(d, dict) or d.get("schema") != FLEET_PLAN_SCHEMA:
+        raise FleetPlanError(
+            f"fleet plan {path}: schema "
+            f"{d.get('schema') if isinstance(d, dict) else type(d)!r} "
+            f"!= {FLEET_PLAN_SCHEMA!r}")
+    if d.get("version") != FLEET_PLAN_VERSION:
+        raise FleetPlanError(f"fleet plan {path}: version "
+                             f"{d.get('version')!r} != "
+                             f"{FLEET_PLAN_VERSION}")
+    shape = d.get("shape")
+    if not isinstance(shape, dict):
+        raise FleetPlanError(f"fleet plan {path}: missing shape")
+    for key in ("tp_size", "replicas", "page_len"):
+        if key not in shape:
+            raise FleetPlanError(
+                f"fleet plan {path}: shape missing {key!r}")
+    return d

@@ -239,7 +239,7 @@ class FleetController:
                  brownout_max_new=16, admission_margin=1.0,
                  hbm_limit_bytes=None, hbm_safety=0.9,
                  mfu_scale_threshold=None, rebalance_ratio=None,
-                 rebalance_cooldown_s=None):
+                 rebalance_cooldown_s=None, planner=None):
         if min_engines < 1:
             raise ValueError(
                 f"min_engines must be >= 1, got {min_engines}")
@@ -287,6 +287,14 @@ class FleetController:
         self.hbm_headroom = None
         self.mfu = None
         self.hbm_blocked = 0
+        # opt-in fleet replanning: a callable ``planner(ctl) -> fleet
+        # plan dict | None`` invoked on HBM-blocked or SLO-violating
+        # ticks (cooldown-spaced); whatever it returns is adopted via
+        # :meth:`replan`.  ``hetu_tpu.planner.fleet_plan_from_controller``
+        # is the intended implementation
+        self._planner = planner
+        self.replans = 0
+        self._last_replan = None
         # controller state
         self.level = 0
         self.queue_ewma = None
@@ -359,6 +367,10 @@ class FleetController:
             "hetu_slo_admission_rejects_total",
             "Submits shed with SLOReject before taking a slot",
             labels=("controller", "reason"))
+        self._m_replans = reg.counter(
+            "hetu_plan_fleet_replans_total",
+            "Planner-emitted fleet shapes adopted live via replan()",
+            labels=("controller",))
         self._fl = _telemetry.get_flight()
         self._m_level.set(0)
         self._m_engines.set(len(fleet._replicas))
@@ -603,6 +615,7 @@ class FleetController:
         self._reap_draining()
         viol = self._violations()
         self._viol_now = viol
+        self._maybe_replan(now, viol)
         self._autoscale(now, viol)
         self._degrade(now, viol)
         self._rebalance(now)
@@ -725,6 +738,114 @@ class FleetController:
                    "miss_ewma": round(self.miss_ewma or 0.0, 4),
                    "violations": list(viol)})
 
+    # -- fleet replanning --------------------------------------------------
+    def _maybe_replan(self, now, viol):
+        """Invoke the configured ``planner=`` callable on HBM-blocked
+        or SLO-violating ticks (cooldown-spaced — the spacing applies
+        to the ATTEMPT, so a planner with no feasible answer is not
+        hammered every tick); any plan it returns is adopted through
+        :meth:`replan`."""
+        if self._planner is None:
+            return
+        if not (viol or self._hbm_would_block()):
+            return
+        if (self._last_replan is not None
+                and now - self._last_replan < self.cooldown_s):
+            return
+        self._last_replan = now
+        try:
+            plan = self._planner(self)
+        except Exception as e:   # planner failure must not kill tick
+            warnings.warn(
+                f"slo controller {self.name}: planner failed "
+                f"{type(e).__name__}: {e}")
+            return
+        if plan:
+            self.replan(plan)
+
+    def replan(self, plan):
+        """Adopt a planner-emitted fleet plan live — the actuator for
+        ``hetu_tpu.planner.plan_fleet`` output (a ``hetu_fleet_plan``
+        dict or just its ``shape`` block).
+
+        Page-geometry changes update the fleet's shared engine kwargs
+        and ROLLING-REPLACE every live replica: the freshly-geometried
+        replicas are added FIRST, then the stale ones drain out with
+        live KV page migration (the PR 17 machinery), so no accepted
+        request is lost.  Pure count changes add replicas or drain the
+        autoscaler's victims.  ``tp_size`` cannot change on a live
+        fleet (tp sub-meshes are built at construction) — a mismatch is
+        recorded in the report's notes, never silently applied.  The
+        target replica count is clamped to ``[min_engines,
+        max_engines]``.  Returns the adoption report."""
+        shape = plan.get("shape", plan)
+        live = [r for r in self._live_replicas()
+                if r.name not in self._draining]
+        target = int(shape.get("replicas", len(live)))
+        clamped = max(self.min_engines, min(self.max_engines, target))
+        notes = []
+        if clamped != target:
+            notes.append(f"replicas {target} clamped to {clamped} "
+                         f"(min={self.min_engines}, "
+                         f"max={self.max_engines})")
+        fleet = self.fleet
+        tp_now = int(getattr(fleet, "tp_size", 1))
+        tp_want = int(shape.get("tp_size", tp_now))
+        if tp_want != tp_now:
+            notes.append(f"tp_size {tp_now} -> {tp_want} requires a "
+                         f"fleet rebuild; keeping tp={tp_now}")
+        geom = {}
+        for key in ("page_len", "n_pages", "n_slots", "max_len"):
+            want = shape.get(key)
+            if want is None:
+                continue
+            cur = fleet._ekw.get(key)
+            if cur is not None and int(cur) != int(want):
+                geom[key] = int(want)
+        added, removed = [], []
+        if geom and not fleet._ekw.get("paged"):
+            notes.append(f"geometry change {geom} ignored: engines are "
+                         f"not paged")
+            geom = {}
+        if geom:
+            fleet._ekw.update(geom)
+            for _ in range(clamped):
+                added.append(fleet.add_replica())
+            for rep in live:
+                fleet.drain(rep.name, wait=False, migrate=True)
+                self._draining.add(rep.name)
+                removed.append(rep.name)
+        else:
+            n = len(live)
+            while n < clamped:
+                added.append(fleet.add_replica())
+                n += 1
+            while n > clamped:
+                victim = self._scale_down_victim(
+                    [r for r in live if r.name not in removed])
+                if victim is None:
+                    notes.append(f"stopped at {n} replicas: no "
+                                 f"drainable victim")
+                    break
+                fleet.drain(victim.name, wait=False, migrate=True)
+                self._draining.add(victim.name)
+                removed.append(victim.name)
+                n -= 1
+        # adopting a shape IS a scale action: cooldown keeps the
+        # autoscaler from fighting the plan on the very next tick
+        self._last_scale = self._clock()
+        self.replans += 1
+        self._m_replans.labels(controller=self.name).inc()
+        report = {"adopted": True, "target_replicas": clamped,
+                  "tp_size": tp_now, "added": added,
+                  "draining": removed, "geometry": geom,
+                  "notes": notes}
+        self._fl.incident(
+            "slo_replan", health=fleet.health(),
+            extra={"controller": self.name, **report,
+                   "n_engines": len(self._live_replicas())})
+        return report
+
     def _reap_draining(self):
         """Finish two-phase scale-downs: remove replicas whose drain
         completed; re-drain any that a breaker restart revived."""
@@ -828,6 +949,7 @@ class FleetController:
                          "scale_ups": self.scale_ups,
                          "scale_downs": self.scale_downs,
                          "rebalances": self.rebalances,
+                         "replans": self.replans,
                          "degrade_entries": self.degrade_entries,
                          "degrade_exits": self.degrade_exits,
                          "max_level_seen": self.max_level_seen},

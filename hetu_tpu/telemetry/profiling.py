@@ -467,6 +467,33 @@ class ProgramProfiler:
         rows.sort(key=lambda r: -r["flops"])
         return rows
 
+    def calibration(self, name):
+        """Measured per-layer cost evidence for the auto-parallel
+        planner (``hetu_tpu/planner/calibrate.py``): the
+        :meth:`observe`-d window's measured step time attributed over
+        the program's layers by XLA flops fraction.  Requires a capture
+        with ``eval_nodes=`` (the attribution table) and a measured
+        window (``steps_per_sec``); rows are ``{"layer", "ms", "flops",
+        "bytes", "flops_frac"}``, heaviest first."""
+        p = self.profile(name)
+        if p is None:
+            raise KeyError(f"no captured profile named {name!r}")
+        layers = p.get("layers")
+        if not layers:
+            raise ValueError(
+                f"profile {name!r} has no layer attribution — capture "
+                f"with eval_nodes=")
+        sps = (p.get("derived") or {}).get("steps_per_sec")
+        if not sps:
+            raise ValueError(
+                f"profile {name!r} has no measured window — observe() "
+                f"it first")
+        step_ms = 1e3 / float(sps)
+        return [{"layer": r["layer"],
+                 "ms": round(step_ms * r["flops_frac"], 6),
+                 "flops": r["flops"], "bytes": r["bytes"],
+                 "flops_frac": r["flops_frac"]} for r in layers]
+
     def report_block(self):
         """The ``profile`` block of ``telemetry.report()`` (also the
         ``/profile`` debug endpoint): every program's cost/memory/

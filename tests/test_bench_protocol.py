@@ -814,3 +814,139 @@ def test_perf_diff_error_bound_signals_one_sided(tmp_path):
     assert run(0.2).returncode == 0
     # within the widened gate
     assert run(0.4, "--tol-error-bound", "1.5").returncode == 0
+
+
+def _plan_env(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_PLAN_JSON"] = str(tmp_path / "plan_full.json")
+    env["HETU_PLAN_PROFILE"] = str(tmp_path / "plan_profile.json")
+    env["HETU_PLAN_ARTIFACT"] = str(tmp_path / "plan_train.json")
+    env["HETU_PERF_HISTORY"] = str(tmp_path / "history.jsonl")
+    return env
+
+
+def _run_plan_round(tmp_path):
+    proc = subprocess.run([sys.executable, BENCH, "--plan", "--quick"],
+                          capture_output=True, text=True, timeout=600,
+                          env=_plan_env(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
+@pytest.mark.slow
+def test_plan_emits_executes_and_is_deterministic(tmp_path):
+    """`--plan --quick` is the planner loop end to end: calibrate a
+    measured profile artifact, search it, save the plan artifact,
+    EXECUTE the planned config, and emit the layered evidence (full
+    early line + PLAN_FULL.json + history entry + compact `pl` tail).
+    A second round reusing the committed profile must emit a
+    byte-identical plan artifact — the search is deterministic."""
+    proc = _run_plan_round(tmp_path)
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    compact = json.loads(lines[-1])
+    assert len(lines[-1].encode()) <= 1500
+    assert compact["metric"] == "plan_pred_err"
+    assert compact["pl"]["iter_ms"] > 0 and compact["pl"]["pred_ms"] > 0
+    assert compact["pl"]["core"] in ("native", "numpy")
+    assert compact["pl"]["world"] >= 1
+    with open(tmp_path / "plan_full.json") as f:
+        full = json.load(f)
+    assert json.loads(lines[-2]) == full
+    # the headline number is the executed-vs-predicted error and it is
+    # computed from the committed artifact's own prediction
+    meas = full["measured"]["iter_ms"]
+    pred = full["plan"]["predicted"]["iter_ms"]
+    assert full["value"] == pytest.approx(abs(pred - meas) / meas,
+                                          abs=1e-4)
+    sig = full["signals"]
+    for name in ("plan_pred_err", "plan_iter_ms", "plan_pred_iter_ms",
+                 "plan_hand_iter_ms", "plan_search_ms"):
+        assert name in sig, name
+    # profile + plan artifacts are committed, versioned, loadable
+    from hetu_tpu.galvatron import load_profile
+    from hetu_tpu.planner import load_plan, plan_config
+    layers, ici, _ = load_profile(str(tmp_path / "plan_profile.json"))
+    assert len(layers) == full["n_layers"]
+    assert all(l.compute_ms > 0 for l in layers)
+    plan = load_plan(str(tmp_path / "plan_train.json"))
+    assert plan_config(plan).world == full["world"]
+    assert not full["profile"]["reused"]
+    with open(tmp_path / "history.jsonl") as f:
+        entries = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(entries) == 1 and entries[0]["signals"] == sig
+    # round 2: same profile in, byte-identical plan artifact out
+    plan_bytes = (tmp_path / "plan_train.json").read_bytes()
+    proc2 = _run_plan_round(tmp_path)
+    full2 = json.loads(
+        [ln for ln in proc2.stdout.strip().splitlines()
+         if ln.strip()][-2])
+    assert full2["profile"]["reused"]
+    assert (tmp_path / "plan_train.json").read_bytes() == plan_bytes
+    with open(tmp_path / "history.jsonl") as f:
+        assert len([ln for ln in f if ln.strip()]) == 2
+
+
+def test_plan_aborted_run_preserves_prior_detail_file(tmp_path):
+    """PLAN_FULL.json follows the no-clobber contract: a run killed
+    during calibration leaves the committed evidence intact and
+    appends nothing to history."""
+    detail = tmp_path / "plan_full.json"
+    sentinel = {"metric": "plan_pred_err", "value": 0.01}
+    detail.write_text(json.dumps(sentinel))
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--plan", "--quick"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=_plan_env(tmp_path), start_new_session=True)
+    try:
+        import time
+        time.sleep(1.0)          # inside jax import / calibration
+        os.killpg(os.getpgid(proc.pid), 9)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert json.loads(detail.read_text()) == sentinel
+    assert not (tmp_path / "history.jsonl").exists()
+
+
+def test_perf_diff_plan_budget_and_latency_signals(tmp_path):
+    """Planner signal classes in the regression gate: plan_pred_err
+    carries an ABSOLUTE 0.35 budget (a noisy-but-under-budget baseline
+    cannot ratchet the gate shut), plan *_iter_ms are lower-better
+    latencies, plan_search_ms is informational."""
+    diff = os.path.join(os.path.dirname(BENCH), "tools", "perf_diff.py")
+    base_doc = {"signals": {"plan_pred_err": 0.10,
+                            "plan_iter_ms": 100.0,
+                            "plan_search_ms": 1.0}}
+    (tmp_path / "base.json").write_text(json.dumps(base_doc))
+
+    def run(**cur_sig):
+        sig = dict(base_doc["signals"])
+        sig.update(cur_sig)
+        (tmp_path / "cur.json").write_text(
+            json.dumps({"signals": sig}))
+        return subprocess.run(
+            [sys.executable, diff,
+             "--current", str(tmp_path / "cur.json"),
+             "--baseline", str(tmp_path / "base.json"), "--json"],
+            capture_output=True, text=True, timeout=60)
+
+    # within the absolute budget: err tripled vs baseline but <= 0.35
+    assert run(plan_pred_err=0.30).returncode == 0
+    # over budget: rc 1, kind plan_err_budget
+    proc = run(plan_pred_err=0.40)
+    assert proc.returncode == 1, proc.stdout[-2000:]
+    bad = [r for r in json.loads(proc.stdout)["table"]
+           if r["regressed"]]
+    assert [r["signal"] for r in bad] == ["plan_pred_err"]
+    assert bad[0]["kind"] == "plan_err_budget"
+    # executed iteration time regressing 50% trips the latency class
+    proc = run(plan_iter_ms=150.0)
+    assert proc.returncode == 1
+    bad = [r for r in json.loads(proc.stdout)["table"]
+           if r["regressed"]]
+    assert [r["signal"] for r in bad] == ["plan_iter_ms"]
+    assert bad[0]["kind"] == "latency"
+    # search getting slower is information, not a gate
+    assert run(plan_search_ms=50.0).returncode == 0

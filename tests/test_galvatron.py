@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from hetu_tpu.galvatron import (GalvatronSearch, HybridParallelConfig,
                                 HybridParallelModel, LayerProfile,
-                                TransformerHPLayer, dp_core, dp_core_numpy,
+                                TransformerHPLayer, dp_core, dp_core_auto,
+                                dp_core_numpy,
                                 profile_layers_analytic, strategy_space,
                                 tp_dp_axes, layer_mesh_axes)
 
@@ -67,6 +68,31 @@ class TestDPCore:
         mem = np.full((2, 2), 50, dtype=np.int32)
         cost, res, left = dp_core(mem, np.ones((2, 2)), np.zeros((2, 2, 2)), 10)
         assert cost == float("inf") and res is None
+
+    def test_auto_core_parity_sweep(self, rng):
+        """dp_core_auto is a drop-in front for whichever core solved:
+        over a randomized sweep spanning loose and binding budgets,
+        auto/native/numpy agree on cost AND feasibility, and the
+        assignment auto returns prices out to its own optimal cost."""
+        for trial in range(12):
+            L = int(rng.integers(2, 9))
+            S = int(rng.integers(2, 6))
+            mem, intra, inter, _ = self._rand_problem(rng, L=L, S=S)
+            V = int(rng.integers(L, 4 * L))   # some trials infeasible
+            (ca, ra, _), core = dp_core_auto(mem, intra, inter, V)
+            assert core in ("native", "numpy")
+            cn, rn, _ = dp_core_numpy(mem, intra, inter, V)
+            assert ca == pytest.approx(cn)
+            assert (ra is None) == (rn is None)
+            if ra is None:
+                assert ca == float("inf")
+                continue
+            # re-price auto's assignment: intra + transition chain
+            priced = sum(intra[i, s] for i, s in enumerate(ra)) + \
+                sum(inter[i, ra[i - 1], ra[i]] for i in range(1, L))
+            assert priced == pytest.approx(ca)
+            # and it fits the budget (effective capacity V - 1)
+            assert sum(mem[i, s] for i, s in enumerate(ra)) <= V - 1
 
     def test_transition_cost_prefers_uniform(self):
         # alternating cheap strategies but huge transition cost => uniform

@@ -1,0 +1,541 @@
+"""Auto-parallel planner (hetu_tpu/planner) + its galvatron artifacts.
+
+The contracts pinned here:
+
+* PROFILE ARTIFACT — ``save_profile`` writes atomically (tmp +
+  ``os.replace``, no tmp droppings) with schema + version stamps;
+  ``load_profile`` round-trips every LayerProfile field and raises a
+  typed :class:`ProfileError` on anything malformed — missing file,
+  wrong schema, wrong version, empty or incomplete layer rows.
+* DP CORE PROVENANCE — ``dp_core_auto`` reports WHICH core solved the
+  assignment ("native"/"numpy"), warns loudly exactly once when the
+  native build is unavailable, and both cores agree on randomized
+  instances; the search records provenance on itself and in plans.
+* CALIBRATION — measured LayerProfiles from live evidence: the HP-layer
+  path times compiled fwd+bwd (compute_ms = measured/3/batch, the cost
+  model's bwd = 2x fwd convention), same-typed layers share one timing;
+  the profiler path attributes an observed window by flops fraction and
+  refuses unknown layers.
+* PLAN EMISSION — ``predict()`` recomputes EXACTLY the cost the
+  search's DP minimized (plan artifacts carry the number the bench
+  gates against); same profile in, byte-identical plan JSON out;
+  infeasible search is a typed PlanError, not a half-written artifact;
+  ``load_plan`` validates schema/version/keys.
+* LOWERING — one plan feeds every consumer: HybridParallelConfig,
+  mesh + per-layer shardings, the serving tp degree, and a
+  ``PlannedParallel`` strategy that delegates to MegatronLM/FSDP/
+  DataParallel and round-trips through Strategy.save_json/load_json.
+* FLEET PLAN — tp x replicas x page-geometry search under a fleet HBM
+  budget + SLO from measured costs; kv page arithmetic matches
+  ``PagedKVCache``'s exact ``n_slots * ceil(max_len/page_len) + 1``
+  sentinel convention; no measured decode evidence -> typed refusal.
+* REPLAN — ``FleetController.replan()`` adopts a planner shape live:
+  page-geometry changes rolling-replace replicas via migrate-then-drain
+  with ZERO accepted-request loss; tp changes are recorded, never
+  silently applied; the ``planner=`` hook fires on violating ticks,
+  cooldown-spaced, and a crashing planner never kills the tick.
+"""
+
+import json
+import math
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+from hetu_tpu.galvatron import (GalvatronSearch, HybridParallelConfig,
+                                LayerProfile, ProfileError, dp_core_auto,
+                                dp_core_numpy, load_profile,
+                                load_profile_doc, save_profile)
+from hetu_tpu.galvatron.runtime import TransformerHPLayer
+from hetu_tpu.planner import (FleetPlanError, PlanError, emit_plan,
+                              emit_plan_from_profile, fleet_plan_dumps,
+                              fleet_plan_from_controller,
+                              calibrate_from_profiler,
+                              calibrate_hp_layers, load_fleet_plan,
+                              load_plan, plan_config, plan_dumps,
+                              plan_fleet, plan_shardings, plan_strategy,
+                              predict, save_fleet_plan, save_plan,
+                              serving_tp)
+
+
+def _layers(n=4, ms=2.0, pb=1 << 20, ab=1 << 16):
+    return [LayerProfile(ms, pb, ab) for _ in range(n)]
+
+
+# -- profile artifact (atomic, versioned, typed errors) ---------------------
+
+class TestProfileArtifact:
+    def test_roundtrip_and_atomicity(self, tmp_path):
+        layers = [LayerProfile(1.5, 2048, 512, act_mem_bytes=4096.0),
+                  LayerProfile(0.5, 1024, 256)]
+        path = str(tmp_path / "prof.json")
+        save_profile(path, layers, ici_gbps=42.0,
+                     meta={"source": "test"})
+        assert os.listdir(tmp_path) == ["prof.json"]   # no tmp droppings
+        out, ici, dcn = load_profile(path)
+        assert ici == 42.0
+        assert [l.to_json() for l in out] == [l.to_json() for l in layers]
+        doc = load_profile_doc(path)
+        assert doc["schema"] == "galvatron_profile"
+        assert doc["version"] == 1
+        assert doc["meta"] == {"source": "test"}
+        # overwrite is atomic too: old artifact replaced, still valid
+        save_profile(path, layers[:1], ici_gbps=7.0)
+        out2, ici2, _ = load_profile(path)
+        assert len(out2) == 1 and ici2 == 7.0
+
+    @pytest.mark.parametrize("doc", [
+        "not json{{{",
+        json.dumps([1, 2, 3]),
+        json.dumps({"schema": "other", "version": 1, "layers": []}),
+        json.dumps({"schema": "galvatron_profile", "version": 99,
+                    "layers": [{"compute_ms": 1, "param_bytes": 1,
+                                "act_bytes": 1}]}),
+        json.dumps({"schema": "galvatron_profile", "version": 1,
+                    "layers": []}),
+        json.dumps({"schema": "galvatron_profile", "version": 1,
+                    "layers": [{"compute_ms": 1}]}),
+    ])
+    def test_malformed_artifacts_raise_typed(self, tmp_path, doc):
+        p = tmp_path / "bad.json"
+        p.write_text(doc)
+        with pytest.raises(ProfileError):
+            load_profile(str(p))
+
+    def test_missing_file_raises_typed(self, tmp_path):
+        with pytest.raises(ProfileError):
+            load_profile(str(tmp_path / "absent.json"))
+
+
+# -- dp core provenance + parity --------------------------------------------
+
+class TestDPCoreAuto:
+    def _problem(self, rng, L=5, S=3):
+        return (rng.integers(1, 6, size=(L, S)).astype(np.int32),
+                rng.uniform(1.0, 8.0, size=(L, S)),
+                rng.uniform(0.0, 1.5, size=(L, S, S)))
+
+    def test_reports_core_and_matches_numpy(self):
+        rng = np.random.default_rng(11)
+        for _ in range(8):
+            mem, intra, inter = self._problem(rng)
+            (c_auto, r_auto, _), core = dp_core_auto(mem, intra, inter,
+                                                     30)
+            assert core in ("native", "numpy")
+            c_np, r_np, _ = dp_core_numpy(mem, intra, inter, 30)
+            assert c_auto == pytest.approx(c_np)
+            assert (r_auto is None) == (r_np is None)
+
+    def test_use_native_false_runs_numpy(self):
+        rng = np.random.default_rng(5)
+        mem, intra, inter = self._problem(rng)
+        _, core = dp_core_auto(mem, intra, inter, 30, use_native=False)
+        assert core == "numpy"
+
+    def test_native_failure_warns_once_and_falls_back(self, monkeypatch):
+        from hetu_tpu.galvatron import build as B
+        monkeypatch.setattr(B, "dp_core", lambda *a, **k: (_ for _ in
+                            ()).throw(RuntimeError("no toolchain")))
+        monkeypatch.setattr(B, "_fallback_warned", False)
+        rng = np.random.default_rng(6)
+        mem, intra, inter = self._problem(rng)
+        with pytest.warns(UserWarning, match="numpy oracle"):
+            (_, res, _), core = B.dp_core_auto(mem, intra, inter, 30)
+        assert core == "numpy" and res is not None
+        with warnings.catch_warnings():        # once, not per search
+            warnings.simplefilter("error")
+            _, core = B.dp_core_auto(mem, intra, inter, 30)
+        assert core == "numpy"
+
+    def test_search_records_provenance(self):
+        s = GalvatronSearch(2, 8 << 30, use_native=False)
+        cfg = s.search(_layers(), global_bsz=8)
+        assert cfg is not None
+        assert s.core_used == "numpy"
+        assert s.best_cost_ms is not None and s.best_cost_ms > 0
+
+
+# -- calibration ------------------------------------------------------------
+
+class TestCalibration:
+    def test_hp_layers_measured_and_shared_by_type(self):
+        specs = [TransformerHPLayer(32, 4, ffn=64),
+                 TransformerHPLayer(32, 4, ffn=64),
+                 TransformerHPLayer(48, 4, ffn=96)]
+        layers, meta = calibrate_hp_layers(specs, batch=2, seq=8, reps=2)
+        assert len(layers) == 3
+        assert layers[0] is layers[1]          # same type: one timing
+        assert layers[0] is not layers[2]
+        for l in layers:
+            assert l.compute_ms > 0
+        p = specs[0].init(jax.random.PRNGKey(0))
+        want = sum(v.size * v.dtype.itemsize
+                   for v in jax.tree_util.tree_leaves(p))
+        assert layers[0].param_bytes == want
+        assert layers[0].act_bytes == 8 * 32 * 4
+        assert meta["source"] == "hp_layers"
+        assert meta["timing"] == "fwd_bwd/3"
+        assert meta["n_layers"] == 3
+
+    def test_profiler_path_attribution_and_refusal(self):
+        class FakeProf:
+            def calibration(self, name):
+                return [
+                    {"layer": "blk0", "ms": 3.0, "flops": 300,
+                     "bytes": 4096, "flops_frac": 0.75},
+                    {"layer": "blk1", "ms": 1.0, "flops": 100,
+                     "bytes": 1024, "flops_frac": 0.25},
+                ]
+        params = {"blk0_weight": np.zeros((4, 4), np.float32),
+                  "blk0_bias": np.zeros((4,), np.float32),
+                  "blk1_weight": np.zeros((2, 2), np.float32)}
+        layers, meta = calibrate_from_profiler(
+            FakeProf(), "train", batch_size=2, params=params)
+        assert len(layers) == 2
+        # compute_ms = attributed ms / fwd_bwd_factor / batch
+        assert layers[0].compute_ms == pytest.approx(3.0 / 3.0 / 2)
+        assert layers[0].param_bytes == 64 + 16
+        assert layers[1].param_bytes == 16
+        assert layers[0].act_bytes == pytest.approx(4096 / 2)
+        assert meta["source"] == "profiler"
+        with pytest.raises(KeyError, match="not in"):
+            calibrate_from_profiler(FakeProf(), "train", 2,
+                                    layer_order=["blk0", "nope"])
+
+
+# -- plan emission ----------------------------------------------------------
+
+class TestPlanEmission:
+    def test_predict_matches_search_cost(self):
+        rng = np.random.default_rng(7)
+        for _ in range(5):
+            n = int(rng.choice([3, 4, 6]))
+            layers = [LayerProfile(float(rng.uniform(0.5, 4.0)),
+                                   int(rng.choice([1 << 18, 1 << 20])),
+                                   1 << 14) for _ in range(n)]
+            world = int(rng.choice([2, 4, 8]))
+            s = GalvatronSearch(world, 8 << 30, use_native=False)
+            cfg = s.search(layers, global_bsz=8)
+            assert cfg is not None
+            pred = predict(cfg, layers, ici_gbps=s.ici_gbps)
+            assert pred["iter_ms"] == pytest.approx(s.best_cost_ms,
+                                                    rel=1e-6)
+            assert len(pred["stage_ms"]) == cfg.pp_deg
+            assert pred["max_stage_mem_bytes"] == max(
+                pred["stage_mem_bytes"])
+
+    def test_emit_is_deterministic_and_validated(self, tmp_path):
+        layers = _layers()
+        p1 = emit_plan(layers, 4, 8 << 30, global_bsz=8,
+                       use_native=False)
+        p2 = emit_plan(layers, 4, 8 << 30, global_bsz=8,
+                       use_native=False)
+        assert plan_dumps(p1) == plan_dumps(p2)
+        assert p1["schema"] == "hetu_train_plan" and p1["version"] == 1
+        assert p1["core"] == "numpy"
+        path = str(tmp_path / "plan.json")
+        save_plan(path, p1)
+        assert plan_dumps(load_plan(path)) == plan_dumps(p1)
+        # validation is typed
+        (tmp_path / "bad1.json").write_text("{]")
+        (tmp_path / "bad2.json").write_text(json.dumps(
+            {"schema": "hetu_train_plan", "version": 99,
+             "config": {}, "predicted": {}, "world": 1}))
+        (tmp_path / "bad3.json").write_text(json.dumps(
+            {"schema": "hetu_train_plan", "version": 1, "world": 1}))
+        for bad in ("bad1.json", "bad2.json", "bad3.json"):
+            with pytest.raises(PlanError):
+                load_plan(str(tmp_path / bad))
+
+    def test_infeasible_is_typed(self):
+        with pytest.raises(PlanError, match="no feasible"):
+            emit_plan(_layers(pb=1 << 34, ab=1 << 30), 2, 1 << 20,
+                      global_bsz=8, use_native=False)
+
+    def test_emit_from_profile_carries_provenance(self, tmp_path):
+        path = str(tmp_path / "prof.json")
+        save_profile(path, _layers(), ici_gbps=55.0,
+                     meta={"source": "test", "platform": "cpu"})
+        plan = emit_plan_from_profile(path, 4, 8 << 30, global_bsz=8,
+                                      use_native=False)
+        assert plan["ici_gbps"] == 55.0
+        assert plan["profile_meta"]["source"] == "test"
+
+
+# -- lowering ---------------------------------------------------------------
+
+class TestLowering:
+    def _graph(self, tag):
+        x = ht.placeholder_op(f"pl_x_{tag}", (4, 8))
+        w = ht.Variable(f"pl_{tag}_q_weight",
+                        value=np.zeros((8, 8), np.float32))
+        return [ht.matmul_op(x, w)]
+
+    def test_plan_strategy_dataparallel_world1(self):
+        plan = emit_plan(_layers(), 1, 8 << 30, global_bsz=8,
+                         use_native=False)
+        st = plan_strategy(plan)
+        assert st.lowered == "DataParallel" and st.tp == 1
+        mesh = st.annotate(self._graph("dp1"))
+        assert dict(mesh.shape) == {"dp": 1}
+
+    def test_plan_strategy_megatron_and_json_roundtrip(self, tmp_path):
+        cfg = HybridParallelConfig(pp_deg=1, tp_sizes=[2, 2],
+                                   dp_types=[0, 0], world=4)
+        plan = {"schema": "hetu_train_plan", "version": 1, "world": 4,
+                "config": cfg.to_json(),
+                "predicted": {"iter_ms": 1.0}}
+        st = plan_strategy(plan)
+        assert st.lowered == "MegatronLM"
+        assert (st.tp, st.dp) == (2, 2)
+        mesh = st.annotate(self._graph("mt"))
+        assert dict(mesh.shape) == {"dp": 2, "tp": 2}
+        path = str(tmp_path / "strategy.json")
+        st.save_json(path)
+        from hetu_tpu.parallel.strategies import Strategy
+        st2 = Strategy.load_json(path)
+        assert type(st2).__name__ == "PlannedParallel"
+        assert st2.lowered == "MegatronLM" and st2.plan == st.plan
+
+    def test_plan_strategy_fsdp_majority(self):
+        cfg = HybridParallelConfig(pp_deg=1, tp_sizes=[1, 1],
+                                   dp_types=[1, 1], world=4)
+        st = plan_strategy({"config": cfg.to_json()})
+        assert st.lowered == "FSDP" and st.dp == 4
+
+    def test_plan_shardings_and_serving_tp(self):
+        plan = emit_plan(_layers(), 4, 8 << 30, global_bsz=8,
+                         use_native=False)
+        mesh, shards = plan_shardings(plan)
+        cfg = plan_config(plan)
+        assert len(shards) == len(cfg.tp_sizes) == 4
+        assert serving_tp(plan) == max(cfg.tp_sizes)
+        assert mesh.shape["pp"] == cfg.pp_deg
+
+
+# -- fleet plan -------------------------------------------------------------
+
+class TestFleetPlan:
+    def test_geometry_matches_paged_kv_convention(self):
+        fp = plan_fleet(decode_s=0.01, bytes_per_token=4096.0,
+                        hbm_budget_bytes=8 << 30, n_slots=4, max_len=64,
+                        page_len_candidates=(16,))
+        sh = fp["shape"]
+        assert sh["n_pages"] == 4 * math.ceil(64 / 16) + 1
+        assert sh["kv_pool_bytes"] == sh["n_pages"] * 16 * 4096
+        assert sh["fleet_hbm_bytes"] == (sh["replicas"]
+                                         * sh["replica_hbm_bytes"])
+
+    def test_deterministic_and_minimal_chips(self):
+        kw = dict(decode_s=0.01, bytes_per_token=2048.0,
+                  hbm_budget_bytes=4 << 30, tp_candidates=(1, 2, 4),
+                  max_replicas=6)
+        a, b = plan_fleet(**kw), plan_fleet(**kw)
+        assert fleet_plan_dumps(a) == fleet_plan_dumps(b)
+        # nothing constrains latency or load: 1 chip wins
+        assert a["shape"]["chips"] == 1
+
+    def test_slo_tpot_forces_tensor_parallel(self):
+        from hetu_tpu.serving.control import SLO
+        fp = plan_fleet(decode_s=0.01, bytes_per_token=2048.0,
+                        hbm_budget_bytes=8 << 30,
+                        slo=SLO(tpot_p99_s=0.004),
+                        tp_candidates=(1, 2, 4), tp_efficiency=0.7)
+        assert fp["shape"]["tp_size"] == 4       # 0.01/(4*.7) <= 0.004
+        assert fp["shape"]["tpot_s"] <= 0.004
+        assert fp["rejected"]["slo"] > 0
+
+    def test_hbm_budget_cuts_and_infeasible_is_typed(self):
+        one = 17 * 8 * 2048.0                    # one replica's kv pool
+        fp = plan_fleet(decode_s=0.01, bytes_per_token=2048.0,
+                        hbm_budget_bytes=int(2.5 * one), n_slots=4,
+                        max_len=32, page_len_candidates=(8,),
+                        offered_rps=None, max_replicas=8)
+        assert fp["shape"]["replicas"] <= 2
+        assert fp["rejected"]["hbm"] > 0
+        with pytest.raises(FleetPlanError, match="no feasible"):
+            plan_fleet(decode_s=0.01, bytes_per_token=2048.0,
+                      hbm_budget_bytes=100, max_len=32,
+                      page_len_candidates=(8,))
+
+    def test_refuses_without_evidence(self):
+        with pytest.raises(FleetPlanError, match="no evidence"):
+            plan_fleet(decode_s=None, bytes_per_token=1.0,
+                       hbm_budget_bytes=1 << 30)
+        with pytest.raises(FleetPlanError):
+            plan_fleet(decode_s=0.01, bytes_per_token=0,
+                       hbm_budget_bytes=1 << 30)
+
+    def test_artifact_roundtrip_and_validation(self, tmp_path):
+        fp = plan_fleet(decode_s=0.01, bytes_per_token=2048.0,
+                        hbm_budget_bytes=4 << 30)
+        path = str(tmp_path / "fleet.json")
+        save_fleet_plan(path, fp)
+        assert os.listdir(tmp_path) == ["fleet.json"]
+        assert fleet_plan_dumps(load_fleet_plan(path)) == \
+            fleet_plan_dumps(fp)
+        (tmp_path / "bad.json").write_text(json.dumps(
+            {"schema": "hetu_fleet_plan", "version": 1,
+             "shape": {"tp_size": 1}}))
+        with pytest.raises(FleetPlanError, match="missing"):
+            load_fleet_plan(str(tmp_path / "bad.json"))
+        with pytest.raises(FleetPlanError):
+            load_fleet_plan(str(tmp_path / "absent.json"))
+
+
+# -- live replan (FleetController.replan + the planner= hook) ---------------
+
+from hetu_tpu.models import LlamaConfig, LlamaForCausalLM          # noqa: E402
+from hetu_tpu.serving import (EngineFleet, FleetController, SLO,   # noqa: E402
+                              TERMINAL_OK)
+
+V = 64
+PAGED_EKW = dict(n_slots=4, max_len=32, max_prompt_len=8, name="rpl",
+                 paged=True, page_len=4)
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+@pytest.fixture(scope="module")
+def served():
+    c = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=56,
+                    seq_len=16)
+    model = LlamaForCausalLM(c, name="rpl")
+    ids = ht.placeholder_op("rpl_ids", (1, 4), dtype=np.int32)
+    ex = ht.Executor([model(ids)])
+    return ex, model
+
+
+def _settle(fleet, ctl, clk, reqs, limit=400):
+    for _ in range(limit):
+        fleet.pump()
+        ctl.tick()
+        clk.advance(0.05)
+        if all(r.finished for r in reqs) and not ctl._draining:
+            return
+    raise AssertionError("fleet did not settle")
+
+
+@pytest.mark.timeout(180)
+def test_replan_rolling_replace_zero_loss(served):
+    """Adopting a planner shape with new page geometry rolling-replaces
+    every replica (fresh geometry added FIRST, stale drained with live
+    KV migration) while in-flight work finishes — zero accepted-rid
+    loss.  A tp_size mismatch is recorded in the notes, never applied;
+    the target count clamps to [min_engines, max_engines]."""
+    ex, model = served
+    clk = ManualClock()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fleet = EngineFleet(ex, model, n_engines=2, threaded=False,
+                            clock=clk, engine_kwargs=dict(PAGED_EKW))
+        ctl = FleetController(fleet, SLO(), min_engines=1,
+                              max_engines=4, cooldown_s=1000.0,
+                              degrade_enter_ticks=10_000)
+        rng = np.random.default_rng(3)
+        reqs = [ctl.submit(rng.integers(1, V, (4,)), 6)
+                for _ in range(6)]
+        fleet.pump(2)                     # work genuinely in flight
+        report = ctl.replan({"shape": {"replicas": 9, "tp_size": 2,
+                                       "page_len": 8}})
+        assert report["adopted"]
+        assert report["geometry"] == {"page_len": 8}
+        assert report["target_replicas"] == 4          # clamped
+        assert any("clamped" in n for n in report["notes"])
+        assert any("tp_size 1 -> 2" in n and "keeping tp=1" in n
+                   for n in report["notes"])
+        assert report["draining"] == ["e0", "e1"]
+        assert len(report["added"]) == 4
+        _settle(fleet, ctl, clk, reqs)
+    # zero loss: every accepted request finished OK with real tokens
+    assert all(r.finish_reason in TERMINAL_OK for r in reqs)
+    assert all(len(r.result()) > 0 for r in reqs)
+    # the old replicas are gone; every survivor runs the NEW geometry
+    live = [r.name for r in ctl._live_replicas()]
+    assert set(live) == set(report["added"])
+    assert fleet._ekw["page_len"] == 8
+    for rep in ctl._live_replicas():
+        assert rep.engine.cache.page_len == 8
+    assert ctl.replans == 1
+    assert ctl.report()["counters"]["replans"] == 1
+    fleet.stop()
+
+
+def test_replan_count_only_and_planner_tick_hook(served):
+    """Count-only shapes scale without touching geometry.  The
+    ``planner=`` hook fires on violating ticks only, is cooldown
+    spaced, and a crashing planner warns instead of killing tick()."""
+    ex, model = served
+    clk = ManualClock()
+    calls = []
+
+    def planner(c):
+        calls.append(c.ticks)
+        if len(calls) >= 2:
+            raise RuntimeError("search blew up")
+        return {"shape": {"replicas": 2}}
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fleet = EngineFleet(ex, model, n_engines=1, threaded=False,
+                            clock=clk, engine_kwargs=dict(PAGED_EKW))
+        ctl = FleetController(fleet, SLO(deadline_miss_target=0.05),
+                              min_engines=1, max_engines=3,
+                              cooldown_s=5.0, planner=planner,
+                              degrade_enter_ticks=10_000)
+        ctl.tick()                       # healthy: planner not consulted
+        assert calls == []
+        ctl.miss_ewma = 1.0              # violating tick: planner fires
+        ctl.tick()
+        assert len(calls) == 1 and ctl.replans == 1
+        assert len(fleet._replicas) == 2
+        ctl.miss_ewma = 1.0              # cooldown: attempt suppressed
+        ctl.tick()
+        assert len(calls) == 1
+        clk.advance(5.0)
+        ctl.miss_ewma = 1.0
+    with pytest.warns(UserWarning, match="planner failed"):
+        ctl.tick()                       # planner crash -> warn, survive
+    assert len(calls) == 2 and ctl.replans == 1
+    fleet.stop()
+
+
+def test_fleet_plan_from_controller_measured_evidence(served):
+    """The live bridge refuses to plan without measured decode
+    evidence; with it, the emitted plan carries the controller's own
+    SLO/limits and the fleet's slot geometry."""
+    ex, model = served
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fleet = EngineFleet(ex, model, n_engines=1, threaded=False,
+                            clock=ManualClock(),
+                            engine_kwargs=dict(PAGED_EKW))
+        ctl = FleetController(fleet, SLO(), min_engines=1,
+                              max_engines=3)
+        with pytest.raises(FleetPlanError, match="no measured"):
+            fleet_plan_from_controller(ctl)
+        ctl.cost.observe_decode(0.01)
+        fp = fleet_plan_from_controller(
+            ctl, bytes_per_token=2048.0, hbm_budget_bytes=4 << 30)
+        assert fp["evidence"]["decode_s"] == pytest.approx(0.01)
+        assert fp["shape"]["n_slots"] == PAGED_EKW["n_slots"]
+        assert fp["shape"]["max_len"] == PAGED_EKW["max_len"]
+        assert fp["shape"]["replicas"] <= 3
+        assert fp["meta"]["source"] == "controller"
+        fleet.stop()

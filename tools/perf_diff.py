@@ -36,6 +36,12 @@ direction-aware per-signal tolerances:
   platform-conditional — gated one-sided like throughput when the
   current round ran on a real TPU mesh, informational on CPU where the
   forced host "devices" time-share the same cores.
+* planner signals (``plan_*``, from ``bench.py --plan``):
+  ``plan_pred_err`` gates one-sided against the larger of the committed
+  baseline grown by ``--tol-error-bound`` and the absolute 0.35
+  accuracy budget; ``plan_*_iter_ms`` are lower-is-better wall-clock
+  latency under the loose throughput tolerance; search runtime and the
+  plan-vs-hand ratio are trend context.
 * migration signals (``migrate_*``, from ``bench.py --serve --fleet
   --migrate``) — checked BEFORE the generic speedup class: the
   ``migrate_*_speedup`` ratios gate against an ABSOLUTE floor of 1.0
@@ -108,6 +114,19 @@ SPEEDUP_MARKERS = ("speedup",)
 #: platform — each A/B ran migration and replay on the same machine, so
 #: the ratio is platform-independent in a way the TP speedup is not.
 MIGRATION_PREFIX = "migrate_"
+#: auto-parallel planner signals (``bench.py --plan``) — checked before
+#: every generic class: ``plan_pred_err`` is the planner's committed
+#: predicted-vs-measured iteration-time error, gated one-sided against
+#: the LARGER of the committed baseline grown by tol_error_bound and an
+#: absolute accuracy budget (a cost model that can no longer predict
+#: what it schedules is a planner regression; shrinking error never
+#: fails, and baseline noise below the budget can't trip the gate);
+#: ``plan_*_iter_ms`` are wall-clock latency (lower is better, gated
+#: with the loose throughput tolerance); the rest (search runtime, the
+#: plan-vs-hand ratio) are trend context.
+PLAN_PREFIX = "plan_"
+#: absolute plan_pred_err ceiling: the ISSUE 18 acceptance budget
+PLAN_PRED_ERR_BUDGET = 0.35
 
 
 def classify(name, platform=None):
@@ -123,6 +142,12 @@ def classify(name, platform=None):
             return "migration_floor"
         if "bytes_per_token" in name:
             return "static"
+        return "info"
+    if name.startswith(PLAN_PREFIX):
+        if "pred_err" in name:
+            return "plan_err_budget"
+        if name.endswith("_iter_ms"):
+            return "latency"
         return "info"
     if any(m in name for m in SPEEDUP_MARKERS):
         return "throughput" if platform == "tpu" else "info"
@@ -207,6 +232,20 @@ def diff_signals(current, baseline, tol_throughput, tol_static,
             # baseline only supplies trend context.
             ratio = None if base == 0 else cur / base
             regressed = cur < 1.0
+        elif kind == "plan_err_budget":
+            # one-sided GROWTH past the larger of the committed error
+            # grown by the error tolerance and the absolute accuracy
+            # budget: a tiny committed baseline must not turn timing
+            # noise into a failure, and a large one must not launder a
+            # cost model drifting past the budget
+            ratio = None if base == 0 else cur / base
+            regressed = cur > max(base * (1.0 + tol_error_bound),
+                                  PLAN_PRED_ERR_BUDGET)
+        elif kind == "latency":
+            # lower-is-better wall-clock, loose tolerance (same noise
+            # class as throughput, opposite direction)
+            ratio = None if base == 0 else cur / base
+            regressed = base > 0 and cur > base * (1.0 + tol_throughput)
         elif kind == "info":
             ratio = None if base == 0 else cur / base
             regressed = False
