@@ -1075,35 +1075,194 @@ def _staged(stage_fn, *args):
     return out
 
 
+class _PlaneProbe:
+    """ISSUE 19 chaos acceptance: a dedicated time-series plane (own
+    ring + the standard slo_rules AlertManager + a scoped GoodputLedger,
+    all on one manual clock) wrapped around the canonical fault stages.
+    Each probed stage must (a) fire EXACTLY its named alert rule — one
+    pending->firing transition, resolving once the movement ages out of
+    the window, no flapping — with truly-unrelated fault rules quiet,
+    and (b) attribute lost capacity to the MATCHING goodput cause with
+    the bucket fractions summing to 1.  Inactive (one flag check per
+    wrapped stage) unless --telemetry enabled the instruments the plane
+    reads."""
+
+    #: the fault-class -> rule -> cause contract probed by the chaos
+    #: modes (nan step, engine crash, transfer fault, overload burst)
+    FAULT_RULES = ("guard_trips", "engine_crashes",
+                   "migration_failures", "overload_shed")
+
+    def __init__(self, tag):
+        from hetu_tpu import telemetry
+        from hetu_tpu.telemetry import GoodputLedger
+
+        self.active = telemetry.enabled()
+        if not self.active:
+            return
+        self.t = 0.0                # manual clock: 1.0 per poll
+        clock = lambda: self.t      # noqa: E731
+        self._clock = clock
+        self.ledger = GoodputLedger(
+            registry=telemetry.get_registry(),
+            tracer=telemetry.get_tracer(), name=str(tag),
+            clock=clock, enabled=True)
+        self._fresh_plane()
+
+    def _fresh_plane(self):
+        """A NEW ring + AlertManager for each probed stage: the first
+        frames baseline the registry as it stands NOW, so counter
+        movement from unprobed stages run between probes (while the
+        manual clock is frozen) cannot masquerade as a fresh burst
+        inside this stage's window — and the transition history is
+        per-stage by construction."""
+        from hetu_tpu import telemetry
+        from hetu_tpu.telemetry import (AlertManager, TimeSeriesStore,
+                                        slo_rules)
+        reg = telemetry.get_registry()
+        self.store = TimeSeriesStore(registry=reg, capacity=256,
+                                     clock=self._clock, enabled=True)
+        # window=8 ticks, for_ticks=2: a fault fires on the second
+        # post-fault poll and ages out after eight — short enough that
+        # one probe sequence walks the whole state machine
+        self.alerts = AlertManager(
+            self.store, slo_rules(window=8.0, for_ticks=2),
+            registry=reg, flight=telemetry.get_flight(),
+            clock=self._clock, enabled=True)
+
+    def _poll(self, n):
+        fired = set()
+        for _ in range(n):
+            self.t += 1.0
+            fired.update(self.alerts.poll(self.t))
+        return fired
+
+    def stage(self, rule, cause, quiet, stage_fn, *args):
+        """Run one fault stage under the probe.  ``rule``: the alert
+        that MUST fire; ``cause``: the goodput bucket the lost capacity
+        MUST land in; ``quiet``: fault rules that must NOT fire (the
+        FAULT_RULES minus legitimate co-trips — e.g. a transfer fault
+        stage crashes an engine on purpose, so engine_crashes is not in
+        its quiet set)."""
+        if not self.active:
+            return _staged(stage_fn, *args)
+        self._fresh_plane()
+        self._poll(3)                       # pre-fault baseline
+        self.ledger.begin(now=self.t)
+        w0 = time.perf_counter()
+        out = _staged(stage_fn, *args)
+        wall = time.perf_counter() - w0
+        fired = self._poll(4)               # detection window
+        acct = self.ledger.account(wall_s=wall, now=self.t)
+        self._poll(12)                      # fault ages out: resolve
+        assert rule in fired, \
+            f"injected fault did not fire alert rule {rule!r} " \
+            f"(fired: {sorted(fired)})"
+        firings = [t for s, t in self.alerts.transitions(rule)
+                   if s == "firing"]
+        assert len(firings) == 1, \
+            f"alert rule {rule!r} flapped: firing at {firings}"
+        end_state = self.alerts.state(rule)
+        assert end_state in ("resolved", "inactive"), \
+            f"alert rule {rule!r} never resolved (state {end_state!r})"
+        for q in quiet:
+            q_fired = [t for s, t in self.alerts.transitions(q)
+                       if s == "firing"]
+            assert not q_fired, \
+                f"unrelated fault rule {q!r} fired at {q_fired} " \
+                f"during the {rule!r} stage"
+        fr = acct["fractions"]
+        total = sum(fr.values())
+        assert abs(total - 1.0) <= 1e-6, \
+            f"goodput fractions sum to {total!r}, not 1"
+        assert fr[cause] > 0.0, \
+            f"no lost capacity attributed to {cause!r} " \
+            f"(lost: {acct['lost']})"
+        out["alert"] = {"rule": rule, "fired": sorted(fired),
+                        "transitions": self.alerts.transitions(rule),
+                        "state": end_state,
+                        "quiet_checked": sorted(quiet)}
+        out["goodput"] = {"cause": cause,
+                          "cause_fraction": fr[cause],
+                          "goodput_fraction": acct["goodput_fraction"],
+                          "fractions_sum": round(total, 9),
+                          "window_s": acct["window_s"],
+                          "scaled_to_wall": acct["scaled_to_wall"],
+                          "lost": acct["lost"]}
+        return out
+
+
 def run_telemetry_overhead(quick=False, rounds=6):
     """Measured cost of telemetry-on vs -off on the SAME warmed step
     (interleaved groups, median of ratios — the chaos-overhead
     protocol): the proof that the disabled fast path is free and the
-    enabled path is cheap."""
+    enabled path is cheap.  The ISSUE 19 plane rides the same twin at
+    its production cadence: both sides run a store-tick + full
+    alert-rule evaluation at most every ``poll_interval_s`` of wall
+    time (an operator plane polls on a wall-clock period, not per
+    step) — enabled on the ON side, the one-flag-check disabled path
+    on the OFF side — so ``overhead_frac`` covers metric history and
+    alerting, not just the registry/tracer.  The goodput ledger is a
+    report-time instrument (one account per window, never per step),
+    so its cost is measured once and reported separately."""
     import jax
     from hetu_tpu import telemetry
+    from hetu_tpu.telemetry import (AlertManager, GoodputLedger,
+                                    TimeSeriesStore, slo_rules)
 
     steps = 15 if quick else 40
+    poll_interval_s = 0.1
     ex, batch = _chaos_build("tel")
     import jax.numpy as jnp
     feed = {k: jnp.asarray(v) for k, v in batch(0).items()}
-    run = lambda: ex.run("train", feed_dict=feed)     # noqa: E731
+    reg = telemetry.get_registry()
+    plane = {"t": 0.0, "last": 0.0}
+    clock = lambda: plane["t"]                        # noqa: E731
+    store = TimeSeriesStore(registry=reg, capacity=256, clock=clock)
+    alerts = AlertManager(store, slo_rules(), registry=reg, clock=clock)
+    ledger = GoodputLedger(registry=reg, tracer=telemetry.get_tracer(),
+                           name="overhead", clock=clock)
+
+    def run():
+        out = ex.run("train", feed_dict=feed)
+        now = time.perf_counter()
+        if now - plane["last"] >= poll_interval_s:
+            plane["last"] = now
+            plane["t"] += 1.0
+            alerts.poll(plane["t"])
+        return out
+
+    def set_on(on):
+        telemetry.enable() if on else telemetry.disable()
+        store.enabled = alerts.enabled = ledger.enabled = bool(on)
+
+    set_on(False)
     run()                                             # compile + warm
     ratios, on_best, off_best = [], 0.0, 0.0
     for r in range(rounds):
-        telemetry.enable() if r % 2 else telemetry.disable()
+        set_on(bool(r % 2))
         a = 1.0 / _time_group(run, steps)
-        telemetry.disable() if r % 2 else telemetry.enable()
+        set_on(not r % 2)
         b = 1.0 / _time_group(run, steps)
         on, off = (a, b) if r % 2 else (b, a)
         ratios.append(on / off)
         on_best, off_best = max(on_best, on), max(off_best, off)
-    telemetry.disable()
+    set_on(True)
+    ledger.begin(now=plane["t"])
+    run()
+    t0 = time.perf_counter()
+    ledger.account(now=plane["t"] + 1.0)
+    account_cost = time.perf_counter() - t0
+    set_on(False)
     ratio = sorted(ratios)[len(ratios) // 2]
     return {"metric": "telemetry_overhead",
             "telemetry_on_steps_per_sec": round(on_best, 2),
             "telemetry_off_steps_per_sec": round(off_best, 2),
             "overhead_frac": round(max(0.0, 1.0 - ratio), 4),
+            "plane": {"poll_interval_s": poll_interval_s,
+                      "rules": len(alerts.rules()),
+                      "ticks": store.tick_count,
+                      "evals": alerts.evals,
+                      "goodput_account_cost_s": round(account_cost, 6)},
             "platform": jax.default_backend(), "steps": steps}
 
 
@@ -1114,11 +1273,17 @@ def run_chaos(quick=False, seed=0):
 
     steps = 12 if quick else 40
     injector = FaultInjector(seed)
+    probe = _PlaneProbe("chaos_train")
     stages = {}
     stages["nan_skip"] = _staged(_chaos_nan_skip, steps, injector)
     with tempfile.TemporaryDirectory() as d:
-        stages["nan_rollback"] = _staged(_chaos_nan_rollback, steps,
-                                         injector, d)
+        # the nan fault class under the plane probe: the injected
+        # non-finite step must fire guard_trips (and nothing else in
+        # the fault set) and the lost capacity must land in rollback
+        stages["nan_rollback"] = probe.stage(
+            "guard_trips", "rollback",
+            ("engine_crashes", "migration_failures", "overload_shed"),
+            _chaos_nan_rollback, steps, injector, d)
     stages["prefetch_kill"] = _staged(_chaos_prefetch_kill, steps,
                                       injector)
     with tempfile.TemporaryDirectory() as d:
@@ -1363,6 +1528,26 @@ def run_serve(quick=False, seed=0):
     _serve_replay(peng, mix)
     results["paged_longmix"] = best_of(peng, mix)
 
+    # goodput evidence (ISSUE 19): one extra UNTIMED replay of the
+    # paged engine under a scoped ledger window — the serving goodput
+    # fraction (useful prefill+decode span time over wall) becomes a
+    # one-sided perf_diff signal.  The instruments the ledger reads
+    # must be live for this replay, so telemetry is enabled around it
+    # (and restored after) — the timed A/B replays above are untouched.
+    from hetu_tpu import telemetry as _tel
+    from hetu_tpu.telemetry import GoodputLedger
+    _was_on = _tel.enabled()
+    _tel.enable()
+    ledger = GoodputLedger(registry=_tel.get_registry(),
+                           tracer=_tel.get_tracer(), name="serve",
+                           enabled=True)
+    ledger.begin()
+    g0 = time.perf_counter()
+    _serve_replay(peng, mix)
+    goodput = ledger.account(wall_s=time.perf_counter() - g0)
+    if not _was_on:
+        _tel.disable()
+
     cont, stat = results["continuous"], results["static_batch"]
     paged, slot = results["paged"], results["slot_adjacent"]
     scache = engines["continuous"].cache
@@ -1380,6 +1565,7 @@ def run_serve(quick=False, seed=0):
             pb / max(1, paged["peak_live_tokens"]), 1),
         "serve_chunked_tpot_p99_s":
             results["paged_longmix"]["latency_s"]["tpot"]["p99"],
+        "serve_goodput_fraction": goodput["goodput_fraction"],
     }
     return {"metric": "serve_continuous_tokens_per_sec",
             "value": cont["tokens_per_sec"], "unit": "tokens/sec",
@@ -1407,6 +1593,7 @@ def run_serve(quick=False, seed=0):
                       "compile_flat": bool(paged_flat),
                       "pages": peng.stats()["pages"]},
             "signals": signals,
+            "goodput": goodput,
             "stages": results}
 
 
@@ -4242,9 +4429,16 @@ def run_chaos_fleet(quick=False, seed=0):
 
     ex, model, c = _serve_build(True)   # tiny decode model: replica
     # lifecycle, not shapes, is the thing measured
+    probe = _PlaneProbe("chaos_fleet")
     stages = {}
-    stages["engine_crash"] = _staged(_chaos_fleet_engine_crash, ex,
-                                     model, c, seed)
+    # the engine-crash fault class under the plane probe: the killed
+    # replica must fire engine_crashes alone, and the lost capacity
+    # must land in failover_replay (replayed tokens priced at the
+    # measured per-token decode cost)
+    stages["engine_crash"] = probe.stage(
+        "engine_crashes", "failover_replay",
+        ("guard_trips", "migration_failures", "overload_shed"),
+        _chaos_fleet_engine_crash, ex, model, c, seed)
     stages["engine_wedge"] = _staged(_chaos_fleet_engine_wedge, ex,
                                      model, c, seed, quick)
     stages["slow_engine"] = _staged(_chaos_fleet_slow_engine, ex, model,
@@ -4255,8 +4449,15 @@ def run_chaos_fleet(quick=False, seed=0):
                                        model, c, seed, quick)
     stages["slo_controller"] = _staged(_chaos_fleet_slo_controller, ex,
                                        model, c, seed)
-    stages["transfer_drop"] = _staged(_chaos_fleet_transfer_drop, ex,
-                                      model, c, seed)
+    # the transfer-fault class: dropped migration blobs must fire
+    # migration_failures and charge the kv_migration bucket (the failed
+    # attempts' wire time).  The stage ALSO crashes the donor on
+    # purpose — engine_crashes legitimately co-fires, so only the two
+    # truly-unrelated fault rules are asserted quiet.
+    stages["transfer_drop"] = probe.stage(
+        "migration_failures", "kv_migration",
+        ("guard_trips", "overload_shed"),
+        _chaos_fleet_transfer_drop, ex, model, c, seed)
     stages["transfer_corrupt"] = _staged(_chaos_fleet_transfer_corrupt,
                                          ex, model, c, seed)
     stages["donor_crash_mid_migration"] = _staged(
@@ -4297,8 +4498,15 @@ def run_chaos_serve(quick=False, seed=0):
                                   seed)
     stages["stalled_consumer"] = _staged(_chaos_serve_stalled_consumer,
                                          ex, model, c, seed, quick)
-    stages["overload_burst"] = _staged(_chaos_serve_overload, ex, model,
-                                       c, seed, quick)
+    # the overload fault class under the plane probe: the 4x burst must
+    # fire overload_shed alone, and the refused capacity must land in
+    # brownout_shed (rejections priced at the measured mean request
+    # cost, carved from the idle residual)
+    probe = _PlaneProbe("chaos_serve")
+    stages["overload_burst"] = probe.stage(
+        "overload_shed", "brownout_shed",
+        ("guard_trips", "engine_crashes", "migration_failures"),
+        _chaos_serve_overload, ex, model, c, seed, quick)
     stages["deadline_cancel"] = _staged(_chaos_serve_deadline_cancel,
                                         ex, model, c, seed)
     audits = [s["slot_audit"] for s in stages.values()

@@ -459,7 +459,12 @@ class SubExecutor:
 
     def _run_impl(self, feed_dict, convert_to_numpy_ret_vals):
         if self._jitted is None:
-            self._build()
+            # "compile" phase: program construction (graph walk + jit
+            # wrapper build) — the goodput ledger's compile bucket.
+            # XLA's lazy trace/compile on the first dispatch still
+            # lands in that step's dispatch/device residual.
+            with self._tr.span("compile"):
+                self._build()
         ex = self.executor
         # "h2d" phase: everything between entry and the jitted call —
         # feed canonicalization, casts, uploads, PS row gathers
@@ -684,7 +689,8 @@ class SubExecutor:
         if n < 1:
             raise ValueError(f"run_steps needs n >= 1, got {n}")
         if self._jitted is None:
-            self._build()
+            with self._tr.span("compile"):
+                self._build()
         if self.ps_rows:
             raise ValueError("run_steps: PS-embedding subgraphs interact "
                              "with the host store every step; use run()")

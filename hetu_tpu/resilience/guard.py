@@ -250,7 +250,10 @@ class StepGuard:
         # sentinels still queued describe the now-discarded timeline
         self._pending.clear()
         self._ema = None
-        restored = self.manager.restore_latest(self._executor)
+        # "rollback_restore" span: the goodput ledger charges this
+        # restore to the rollback bucket, not plain checkpoint_restore
+        with _telemetry.get_tracer().span("rollback_restore"):
+            restored = self.manager.restore_latest(self._executor)
         self.stats["rollbacks"] += 1
         self._m_rollbacks.inc()
         self.stats["restored_steps"].append(int(restored))

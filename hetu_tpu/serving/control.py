@@ -239,7 +239,7 @@ class FleetController:
                  brownout_max_new=16, admission_margin=1.0,
                  hbm_limit_bytes=None, hbm_safety=0.9,
                  mfu_scale_threshold=None, rebalance_ratio=None,
-                 rebalance_cooldown_s=None, planner=None):
+                 rebalance_cooldown_s=None, planner=None, alerts=None):
         if min_engines < 1:
             raise ValueError(
                 f"min_engines must be >= 1, got {min_engines}")
@@ -295,6 +295,13 @@ class FleetController:
         self._planner = planner
         self.replans = 0
         self._last_replan = None
+        # opt-in trend input: an ``telemetry.alerts.AlertManager`` the
+        # controller polls each tick (driving its TimeSeriesStore on
+        # the controller's own cadence — no collector thread); firing
+        # rules join _violations() as ``alert:<rule>`` entries, so
+        # burn-rate pages apply scale/brownout pressure next to the
+        # single-tick EWMAs
+        self._alerts = alerts
         # controller state
         self.level = 0
         self.queue_ewma = None
@@ -614,6 +621,8 @@ class FleetController:
         self._sense_capacity()
         self._reap_draining()
         viol = self._violations()
+        if self._alerts is not None:
+            viol += tuple(f"alert:{r}" for r in self._alerts.poll(now))
         self._viol_now = viol
         self._maybe_replan(now, viol)
         self._autoscale(now, viol)
@@ -929,6 +938,8 @@ class FleetController:
             "level": self.level,
             "level_name": DEGRADE_LEVELS[self.level],
             "violations": list(self._viol_now),
+            "alerts_firing": (None if self._alerts is None
+                              else sorted(self._alerts.firing())),
             "n_engines": len(self._live_replicas()),
             "draining": sorted(self._draining),
             "ewma": {"queue_depth": self.queue_ewma,

@@ -385,6 +385,7 @@ class EngineFleet:
             labels=("fleet", "role"))
         self._rt = _telemetry.get_request_trace()
         self._fl = _telemetry.get_flight()
+        self._tr = _telemetry.get_tracer()
         # multi-replica-per-chip param sharing: one placed copy of the
         # weights per device, every co-resident replica reads it —
         # device -> (placed pytree, HBM ledger handle, pool="params")
@@ -1128,8 +1129,15 @@ class EngineFleet:
     def _resume_from_blob(self, freq, blob):
         """Try to re-home a harvested stream by splicing its page blob
         into the best sibling.  True on success; False (after counting
-        the failure) sends the caller down the replay path."""
+        the failure) sends the caller down the replay path.  The whole
+        attempt — choose, wire, splice — runs under the ``kv_migrate``
+        span either way: a dropped transfer spent its wire time too, and
+        the goodput ledger's kv_migration bucket must see it."""
         from . import kv_transfer as kvt
+        with self._tr.span("kv_migrate"):
+            return self._resume_from_blob_inner(freq, blob, kvt)
+
+    def _resume_from_blob_inner(self, freq, blob, kvt):
         last = freq.engines[-1] if freq.engines else None
         full = {r.name for r in self._replicas
                 if not self._can_adopt(r)}
@@ -1179,7 +1187,11 @@ class EngineFleet:
         from . import kv_transfer as kvt
         if dst is None or dst is src:
             return False
-        with self._migrate_lock:
+        # "kv_migrate" span: snapshot + wire + splice + ack, including
+        # the fleet-wide serialization wait — the goodput ledger's
+        # kv_migration bucket (failed attempts count too: their time
+        # was spent either way)
+        with self._tr.span("kv_migrate"), self._migrate_lock:
             with src.lock:
                 if src.engine is None or dst.engine is None:
                     return False

@@ -28,13 +28,18 @@ appends :func:`report` to the stage's detail JSON.
 
 from __future__ import annotations
 
+from .alerts import (ALERT_STATES, AbsenceRule, AlertManager,
+                     BurnRateRule, ThresholdRule, slo_rules)
 from .flight import FlightRecorder, INCIDENT_KINDS
+from .goodput import (GOODPUT_BUCKETS, LOST_CAUSES, USEFUL_BUCKETS,
+                      GoodputLedger)
 from .numerics import ANOMALY_KINDS, NumericsMonitor, numerics_report
 from .profiling import HBM_POOLS, HbmLedger, ProgramProfiler
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        JsonlWriter, MetricsRegistry, MetricsServer,
                        start_http_server)
 from .request_trace import EVENT_TYPES, RequestTrace
+from .timeseries import TimeSeriesStore
 from .tracing import NULL_SPAN, SpanTracer
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -43,8 +48,13 @@ __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "INCIDENT_KINDS", "DEFAULT_BUCKETS", "start_http_server",
            "HbmLedger", "ProgramProfiler", "HBM_POOLS",
            "NumericsMonitor", "numerics_report", "ANOMALY_KINDS",
+           "TimeSeriesStore", "AlertManager", "ThresholdRule",
+           "AbsenceRule", "BurnRateRule", "slo_rules", "ALERT_STATES",
+           "GoodputLedger", "GOODPUT_BUCKETS", "USEFUL_BUCKETS",
+           "LOST_CAUSES", "goodput_report",
            "get_registry", "get_tracer", "get_request_trace",
            "get_flight", "get_hbm_ledger", "get_profiler",
+           "get_timeseries", "get_alerts", "get_goodput",
            "enabled", "enable", "disable", "shutdown",
            "report", "step_phase_report", "chrome_trace"]
 
@@ -54,6 +64,14 @@ _request_trace = RequestTrace(enabled=False)
 _flight = FlightRecorder(registry=_registry, enabled=False)
 _hbm = HbmLedger(registry=_registry)
 _profiler = ProgramProfiler(registry=_registry, ledger=_hbm)
+# the time-series plane (ISSUE 19): metric history ring, alert rules
+# over it, and the goodput ledger — all disabled-by-default, all driven
+# by whoever owns a cadence (no collector threads)
+_timeseries = TimeSeriesStore(registry=_registry, enabled=False)
+_alerts = AlertManager(_timeseries, registry=_registry, flight=_flight,
+                       enabled=False)
+_goodput = GoodputLedger(registry=_registry, tracer=_tracer,
+                         name="process", enabled=False)
 # every request event also lands in the flight ring (bounded; the
 # recorder gates on its own enabled flag)
 _request_trace._sink = _flight.record
@@ -93,6 +111,30 @@ def get_profiler():
     return _profiler
 
 
+def get_timeseries():
+    """The process-wide :class:`TimeSeriesStore`."""
+    return _timeseries
+
+
+def get_alerts():
+    """The process-wide :class:`AlertManager` (rules added by the
+    operator / bench; nothing fires out of the box)."""
+    return _alerts
+
+
+def get_goodput():
+    """The process-wide :class:`GoodputLedger` (window pinned at
+    :func:`enable`)."""
+    return _goodput
+
+
+def goodput_report(**kw):
+    """Attribute the process ledger's current window (see
+    :meth:`GoodputLedger.account`); ``{"enabled": False}`` while
+    telemetry is off."""
+    return _goodput.account(**kw)
+
+
 def enabled():
     return _registry.enabled
 
@@ -115,6 +157,10 @@ def enable(http_port=None, host="127.0.0.1", incident_dir=None):
     _tracer.enabled = True
     _request_trace.enabled = True
     _flight.enabled = True
+    _timeseries.enabled = True
+    _alerts.enabled = True
+    _goodput.enabled = True
+    _goodput.begin()        # the process goodput window starts here
     if incident_dir is not None:
         _flight.configure(incident_dir=incident_dir)
     if http_port is not None and _server is None:
@@ -126,7 +172,11 @@ def enable(http_port=None, host="127.0.0.1", incident_dir=None):
                 "/profile": _profiler.report_block,
                 "/slo": _slo_block,
                 "/numerics": numerics_report,
-            })
+                "/timeseries": _timeseries.report_block,
+                "/alerts": _alerts.report_block,
+                "/goodput": _goodput.report_block,
+            },
+            health_extra=lambda: {"alerts": _alerts.summary()})
     return _server
 
 
@@ -136,6 +186,9 @@ def disable():
     _tracer.enabled = False
     _request_trace.enabled = False
     _flight.enabled = False
+    _timeseries.enabled = False
+    _alerts.enabled = False
+    _goodput.enabled = False
 
 
 def shutdown():
@@ -253,7 +306,10 @@ def report(registry=None, tracer=None):
                               for k in INCIDENT_KINDS
                               if _flight.incident_count(k)}},
             "profile": _profiler.report_block(),
-            "numerics": numerics_report()}
+            "numerics": numerics_report(),
+            "timeseries": _timeseries.report_block(),
+            "alerts": _alerts.report_block(),
+            "goodput": _goodput.report_block()}
 
 
 def chrome_trace(jax_trace_dir=None, **kw):

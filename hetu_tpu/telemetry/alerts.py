@@ -1,0 +1,485 @@
+"""Declarative alerting over the time-series ring.
+
+Rules evaluate against a :class:`~.timeseries.TimeSeriesStore` — never
+against a single instantaneous sample — so alerting is trend-driven by
+construction.  Three rule shapes:
+
+* :class:`ThresholdRule` — a reduced window statistic (``last`` /
+  ``rate`` / ``delta`` / ``mean``) compared against a bound;
+* :class:`AbsenceRule` — "this counter stopped moving": a counter that
+  HAS moved before shows zero delta across the window (optionally only
+  while a gate series says there is work to move it — an idle system
+  is not stuck);
+* :class:`BurnRateRule` — the SRE multi-window error-budget burn: the
+  bad/good event fraction over a FAST and a SLOW window, both expressed
+  as multiples of the declared budget; fires only when both windows
+  burn (fast-only is a blip, slow-only is already-old news).
+
+:func:`slo_rules` derives the standard rule set from a declared
+``serving.control.SLO`` — deadline-miss budget burn (fast + slow),
+attainment floor, HBM headroom, watchdog / migration-failure /
+engine-crash / guard-trip / overload-shed rates, numerics anomaly
+streaks, and a stuck-token absence detector — so a fleet gets paging
+coverage from the same object its controller already steers by.
+
+Every rule runs a pending -> firing -> resolved state machine
+(``for_ticks`` consecutive bad evaluations arm it; one good evaluation
+after firing resolves it).  Entering ``firing`` emits a flight-recorder
+``alert`` incident carrying the offending series tail, flips the
+``hetu_alerts_firing{rule=}`` gauge, and counts a transition; the
+:class:`~..serving.control.FleetController` can consume
+:meth:`AlertManager.firing` as a scale/brownout input next to its
+EWMAs (the ``alerts=`` hook).
+
+Disabled by default like every PR 4 instrument: :meth:`evaluate` /
+:meth:`poll` while disabled are one flag check (<20 us/op, pinned by
+``tests/test_timeseries.py``).  No evaluator thread — the owner of a
+cadence (controller tick, bench stage, operator loop) calls
+:meth:`poll`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["AlertManager", "ThresholdRule", "AbsenceRule",
+           "BurnRateRule", "slo_rules", "ALERT_STATES"]
+
+#: the per-rule state machine (resolved relaxes to inactive next eval)
+ALERT_STATES = ("inactive", "pending", "firing", "resolved")
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+class _Rule:
+    """Shared shape: ``check(store, now) -> (active|None, observed)``.
+    ``None`` means no evidence either way (metric absent, <2 points) —
+    the state machine treats it as not-active without claiming health.
+    """
+
+    kind = None
+
+    def __init__(self, name, *, window=None, for_ticks=2,
+                 severity="page"):
+        self.name = str(name)
+        self.window = None if window is None else float(window)
+        self.for_ticks = max(1, int(for_ticks))
+        self.severity = str(severity)
+
+    def check(self, store, now):
+        raise NotImplementedError
+
+    def describe(self):
+        return {"name": self.name, "kind": self.kind,
+                "window_s": self.window, "for_ticks": self.for_ticks,
+                "severity": self.severity}
+
+    def tail_series(self):
+        """(metric, labels, field) whose tail the incident carries."""
+        raise NotImplementedError
+
+
+class ThresholdRule(_Rule):
+    """``reduce(metric over window) op threshold``."""
+
+    kind = "threshold"
+    REDUCERS = ("last", "rate", "delta", "mean")
+
+    def __init__(self, name, metric, *, op=">", threshold=0.0,
+                 reduce="rate", labels=None, field=None, **kw):
+        super().__init__(name, **kw)
+        if op not in _OPS:
+            raise ValueError(f"op must be one of {tuple(_OPS)}, "
+                             f"got {op!r}")
+        if reduce not in self.REDUCERS:
+            raise ValueError(f"reduce must be one of {self.REDUCERS}, "
+                             f"got {reduce!r}")
+        self.metric = str(metric)
+        self.op = op
+        self.threshold = float(threshold)
+        self.reduce = reduce
+        self.labels = labels
+        self.field = field
+
+    def check(self, store, now):
+        fn = getattr(store, self.reduce)
+        if self.reduce == "last":
+            v = fn(self.metric, labels=self.labels, field=self.field)
+        else:
+            v = fn(self.metric, labels=self.labels, window=self.window,
+                   field=self.field, now=now)
+        if v is None:
+            return None, None
+        return _OPS[self.op](v, self.threshold), v
+
+    def describe(self):
+        d = super().describe()
+        d.update(metric=self.metric, op=self.op,
+                 threshold=self.threshold, reduce=self.reduce)
+        return d
+
+    def tail_series(self):
+        return self.metric, self.labels, self.field
+
+
+class AbsenceRule(_Rule):
+    """A counter that has moved before shows zero delta over the
+    window — the stuck detector.  ``while_metric`` gates the rule on a
+    load signal (e.g. tokens stuck only counts while queue depth > 0),
+    so an idle system never pages."""
+
+    kind = "absence"
+
+    def __init__(self, name, metric, *, labels=None, field=None,
+                 while_metric=None, while_op=">", while_threshold=0.0,
+                 while_labels=None, **kw):
+        kw.setdefault("window", 5.0)
+        super().__init__(name, **kw)
+        if kw.get("window") is None and self.window is None:
+            raise ValueError("AbsenceRule needs a window")
+        self.metric = str(metric)
+        self.labels = labels
+        self.field = field
+        self.while_metric = while_metric
+        self.while_op = while_op
+        self.while_threshold = float(while_threshold)
+        self.while_labels = while_labels
+
+    def check(self, store, now):
+        total = store.last(self.metric, labels=self.labels,
+                           field=self.field)
+        if not total:
+            return None, None       # never moved: nothing to be stuck
+        if self.while_metric is not None:
+            gate = store.last(self.while_metric,
+                              labels=self.while_labels)
+            if gate is None or not _OPS[self.while_op](
+                    gate, self.while_threshold):
+                return False, 0.0   # no load: idle, not stuck
+        d = store.delta(self.metric, labels=self.labels,
+                        window=self.window, field=self.field, now=now)
+        if d is None:
+            return None, None
+        return d == 0.0, d
+
+    def describe(self):
+        d = super().describe()
+        d.update(metric=self.metric, while_metric=self.while_metric)
+        return d
+
+    def tail_series(self):
+        return self.metric, self.labels, self.field
+
+
+class BurnRateRule(_Rule):
+    """Multi-window error-budget burn: ``bad/good`` fraction over a
+    fast AND a slow window, each as a multiple of ``budget``.  Fires
+    when ``burn_fast > fast_factor`` and ``burn_slow > slow_factor``
+    simultaneously — the standard SRE page condition that ignores both
+    blips and stale history.  ``window`` doubles as the slow window;
+    ``fast_window`` defaults to a quarter of it."""
+
+    kind = "burn_rate"
+
+    def __init__(self, name, bad_metric, good_metric, budget, *,
+                 fast_window=None, fast_factor=6.0, slow_factor=1.0,
+                 bad_labels=None, good_labels=None, **kw):
+        kw.setdefault("window", 20.0)
+        super().__init__(name, **kw)
+        if budget <= 0 or budget > 1:
+            raise ValueError(f"budget must be in (0, 1], got {budget}")
+        self.bad_metric = str(bad_metric)
+        self.good_metric = str(good_metric)
+        self.budget = float(budget)
+        self.fast_window = (self.window / 4.0 if fast_window is None
+                            else float(fast_window))
+        self.fast_factor = float(fast_factor)
+        self.slow_factor = float(slow_factor)
+        self.bad_labels = bad_labels
+        self.good_labels = good_labels
+
+    def _burn(self, store, window, now):
+        bad = store.delta(self.bad_metric, labels=self.bad_labels,
+                          window=window, now=now)
+        good = store.delta(self.good_metric, labels=self.good_labels,
+                           window=window, now=now)
+        if bad is None or good is None or good <= 0:
+            return None
+        return (bad / good) / self.budget
+
+    def check(self, store, now):
+        fast = self._burn(store, self.fast_window, now)
+        slow = self._burn(store, self.window, now)
+        if fast is None or slow is None:
+            return None, None
+        return (fast > self.fast_factor
+                and slow > self.slow_factor), fast
+
+    def describe(self):
+        d = super().describe()
+        d.update(bad_metric=self.bad_metric,
+                 good_metric=self.good_metric, budget=self.budget,
+                 fast_window_s=self.fast_window,
+                 fast_factor=self.fast_factor,
+                 slow_factor=self.slow_factor)
+        return d
+
+    def tail_series(self):
+        return self.bad_metric, self.bad_labels, None
+
+
+class _RuleState:
+    __slots__ = ("state", "bad_ticks", "since", "observed",
+                 "transitions", "fired")
+
+    def __init__(self):
+        self.state = "inactive"
+        self.bad_ticks = 0
+        self.since = None
+        self.observed = None
+        self.transitions = []       # [(to_state, t)], bounded
+        self.fired = 0
+
+
+class AlertManager:
+    """Rules + per-rule state machines over one TimeSeriesStore.
+
+    ``poll()`` = ``store.tick()`` + :meth:`evaluate` — the one call a
+    cadence owner makes.  Rules are explicit (:meth:`add`,
+    :func:`slo_rules`); nothing fires out of the box."""
+
+    MAX_TRANSITIONS = 64            # per rule, newest kept
+
+    def __init__(self, store, rules=(), *, registry=None, flight=None,
+                 clock=None, enabled=False):
+        self.store = store
+        self.enabled = bool(enabled)
+        self._registry = registry
+        self._flight = flight
+        self._clock = clock if clock is not None else time.perf_counter
+        self._lock = threading.Lock()
+        self._rules = {}
+        self._states = {}
+        self.evals = 0
+        self._m_firing = None
+        self._m_transitions = None
+        self._m_evals = None
+        for r in rules:
+            self.add(r)
+
+    def add(self, rule):
+        with self._lock:
+            if rule.name in self._rules:
+                raise ValueError(f"alert rule {rule.name!r} already "
+                                 "registered")
+            self._rules[rule.name] = rule
+            self._states[rule.name] = _RuleState()
+        return rule
+
+    def rules(self):
+        with self._lock:
+            return list(self._rules.values())
+
+    def state(self, name):
+        with self._lock:
+            return self._states[name].state
+
+    def transitions(self, name):
+        """[(to_state, t)] — the no-flap audit trail for one rule."""
+        with self._lock:
+            return list(self._states[name].transitions)
+
+    def firing(self):
+        with self._lock:
+            return tuple(n for n, s in self._states.items()
+                         if s.state == "firing")
+
+    # -- evaluation --------------------------------------------------------
+    def poll(self, now=None):
+        """Tick the store, then evaluate every rule.  One flag check
+        while disabled."""
+        if not self.enabled:
+            return ()
+        self.store.tick(now)
+        return self.evaluate(now)
+
+    def evaluate(self, now=None):
+        """Advance every rule's state machine against the store.
+        Returns the currently-firing rule names."""
+        if not self.enabled:
+            return ()
+        t = self._clock() if now is None else float(now)
+        self.evals += 1
+        self._lazy_metrics()
+        if self._m_evals is not None:
+            self._m_evals.inc()
+        with self._lock:
+            items = list(self._rules.items())
+        for name, rule in items:
+            active, observed = rule.check(self.store, t)
+            self._advance(name, rule, active, observed, t)
+        return self.firing()
+
+    def _advance(self, name, rule, active, observed, t):
+        st = self._states[name]
+        st.observed = observed
+        if active:
+            st.bad_ticks += 1
+            if st.state in ("inactive", "resolved"):
+                self._transition(st, name, "pending", t)
+            if st.state == "pending" and st.bad_ticks >= rule.for_ticks:
+                self._transition(st, name, "firing", t)
+                st.fired += 1
+                self._emit_incident(rule, st, observed, t)
+        else:
+            # None (no evidence) does not resolve a firing rule — only
+            # a measured-good window does; it does clear a pending one
+            st.bad_ticks = 0
+            if st.state == "firing" and active is False:
+                self._transition(st, name, "resolved", t)
+            elif st.state == "pending":
+                self._transition(st, name, "inactive", t)
+            elif st.state == "resolved":
+                self._transition(st, name, "inactive", t)
+
+    def _transition(self, st, name, to, t):
+        st.state = to
+        st.since = t
+        st.transitions.append((to, t))
+        del st.transitions[:-self.MAX_TRANSITIONS]
+        if self._m_transitions is not None:
+            self._m_transitions.labels(rule=name, to=to).inc()
+        if self._m_firing is not None:
+            self._m_firing.labels(rule=name).set(
+                1.0 if to == "firing" else 0.0)
+
+    def _emit_incident(self, rule, st, observed, t):
+        if self._flight is None:
+            return
+        metric, labels, field = rule.tail_series()
+        thr = getattr(rule, "threshold",
+                      getattr(rule, "fast_factor", None))
+        self._flight.incident(
+            "alert",
+            extra={"rule": rule.name, "kind": rule.kind,
+                   "severity": rule.severity,
+                   "window_s": rule.window,
+                   "observed": observed, "threshold": thr,
+                   "fired_total": st.fired,
+                   "series": {"metric": metric,
+                              "tail": self.store.tail(
+                                  metric, labels=labels, field=field)}})
+
+    def _lazy_metrics(self):
+        if self._registry is None or self._m_firing is not None:
+            return
+        reg = self._registry
+        self._m_firing = reg.gauge(
+            "hetu_alerts_firing",
+            "1 while the named alert rule is firing, else 0",
+            labels=("rule",))
+        self._m_transitions = reg.counter(
+            "hetu_alerts_transitions_total",
+            "Alert state-machine transitions, by rule and destination",
+            labels=("rule", "to"))
+        self._m_evals = reg.counter(
+            "hetu_alerts_evals_total",
+            "Full rule-set evaluation passes")
+
+    # -- export ------------------------------------------------------------
+    def summary(self):
+        """The one-line /healthz block: ``firing: N`` + names."""
+        firing = self.firing()
+        return {"firing": len(firing),
+                "summary": f"firing: {len(firing)}",
+                "rules": sorted(firing)}
+
+    def report_block(self):
+        with self._lock:
+            rows = {}
+            for name, rule in self._rules.items():
+                st = self._states[name]
+                rows[name] = dict(rule.describe(), state=st.state,
+                                  observed=st.observed, since=st.since,
+                                  fired_total=st.fired,
+                                  transitions=len(st.transitions))
+        return {"enabled": self.enabled, "evals": self.evals,
+                "firing": sorted(self.firing()), "rules": rows}
+
+
+def slo_rules(slo=None, *, window=20.0, for_ticks=2,
+              attainment_floor=0.9, hbm_headroom_floor_bytes=None,
+              watchdog_rate=0.0, migration_failure_rate=0.0,
+              engine_crash_rate=0.0, guard_trip_rate=0.0,
+              overload_shed_rate=0.0, numerics_anomaly_rate=0.0,
+              stuck_window=None):
+    """The standard rule set, derived from a declared ``SLO``.
+
+    Every chaos fault class maps to exactly one rule here (the bench
+    acceptance contract): a nan training step -> ``guard_trips``, an
+    engine crash -> ``engine_crashes``, a KV transfer fault ->
+    ``migration_failures``, an overload burst -> ``overload_shed``.
+    Rate thresholds default to 0 (any movement over the window pages);
+    raise them for noisy fleets.  ``slo=None`` uses the default SLO
+    budget for the burn-rate pair."""
+    from ..serving.control import SLO
+    slo = slo if slo is not None else SLO()
+    w = float(window)
+    rules = [
+        # the SLO error budget, burned over fast+slow windows: bad =
+        # deadline-expired retirements, good = all retirements
+        BurnRateRule("slo_deadline_burn",
+                     "hetu_serving_deadline_expired_total",
+                     "hetu_serving_requests_total",
+                     slo.deadline_miss_target,
+                     window=w, for_ticks=for_ticks),
+        ThresholdRule("slo_attainment_low", "hetu_slo_attainment",
+                      reduce="last", op="<",
+                      threshold=float(attainment_floor),
+                      window=w, for_ticks=for_ticks),
+        ThresholdRule("guard_trips", "hetu_guard_trips_total",
+                      reduce="rate", op=">",
+                      threshold=float(guard_trip_rate),
+                      window=w, for_ticks=for_ticks),
+        ThresholdRule("engine_crashes", "hetu_fleet_engine_crashes_total",
+                      reduce="rate", op=">",
+                      threshold=float(engine_crash_rate),
+                      window=w, for_ticks=for_ticks),
+        ThresholdRule("migration_failures", "hetu_migrate_failures_total",
+                      reduce="rate", op=">",
+                      threshold=float(migration_failure_rate),
+                      window=w, for_ticks=for_ticks),
+        ThresholdRule("overload_shed", "hetu_serving_rejections_total",
+                      reduce="rate", op=">",
+                      threshold=float(overload_shed_rate),
+                      window=w, for_ticks=for_ticks),
+        ThresholdRule("watchdog_trips",
+                      "hetu_serving_watchdog_trips_total",
+                      reduce="rate", op=">",
+                      threshold=float(watchdog_rate),
+                      window=w, for_ticks=for_ticks),
+        ThresholdRule("numerics_anomaly_streak",
+                      "hetu_numerics_anomalies_total",
+                      reduce="rate", op=">",
+                      threshold=float(numerics_anomaly_rate),
+                      window=w, for_ticks=for_ticks),
+        AbsenceRule("serving_tokens_stuck", "hetu_serving_tokens_total",
+                    window=(w if stuck_window is None
+                            else float(stuck_window)),
+                    for_ticks=for_ticks,
+                    while_metric="hetu_serving_queue_depth",
+                    while_op=">", while_threshold=0.0),
+    ]
+    if hbm_headroom_floor_bytes is not None:
+        rules.append(ThresholdRule(
+            "hbm_headroom_low", "hetu_slo_hbm_headroom",
+            reduce="last", op="<",
+            threshold=float(hbm_headroom_floor_bytes),
+            window=w, for_ticks=for_ticks))
+    return rules

@@ -406,7 +406,7 @@ class MetricsServer:
 
 
 def start_http_server(port=0, host="127.0.0.1", registry=None,
-                      debug_providers=None):
+                      debug_providers=None, health_extra=None):
     """Serve ``/metrics`` (Prometheus text) + ``/healthz`` (JSON) from a
     daemon thread — stdlib only, so it runs on a bare TPU VM.  Returns a
     :class:`MetricsServer` (``port=0`` binds an ephemeral port).
@@ -415,7 +415,12 @@ def start_http_server(port=0, host="127.0.0.1", registry=None,
     each callable returns a JSON-safe value, rendered on GET.  This is
     how ``telemetry.enable()`` mounts ``/requests`` (the live in-flight
     request table) and ``/incidents`` (the flight-recorder dump index)
-    without this module importing them."""
+    without this module importing them.
+
+    ``health_extra``: callable returning a JSON-safe dict merged into
+    the ``/healthz`` body — how the alert manager's one-line summary
+    (``firing: N``) reaches external probes without a /metrics scrape.
+    A raising callable degrades the body, never the endpoint."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry
@@ -429,10 +434,15 @@ def start_http_server(port=0, host="127.0.0.1", registry=None,
                 body = reg.to_prometheus().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/healthz":
-                body = json.dumps(
-                    {"status": "ok", "telemetry_enabled": reg.enabled,
-                     "uptime_s": round(time.perf_counter() - t0, 3)}
-                ).encode()
+                doc = {"status": "ok", "telemetry_enabled": reg.enabled,
+                       "uptime_s": round(time.perf_counter() - t0, 3)}
+                if health_extra is not None:
+                    try:
+                        doc.update(health_extra())
+                    except Exception as e:
+                        doc["status"] = "degraded"
+                        doc["error"] = f"{type(e).__name__}: {e}"
+                body = json.dumps(doc).encode()
                 ctype = "application/json"
             elif path in providers:
                 try:

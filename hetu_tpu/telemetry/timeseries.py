@@ -1,0 +1,318 @@
+"""Time-series plane: a bounded ring of periodic registry snapshots.
+
+The registry (PR 4) answers "what is the process doing RIGHT NOW"; the
+flight recorder answers "what happened at the trip".  Nothing so far
+answers "what changed over the last N ticks" — the question every
+trend-driven consumer (SLO burn-rate alerting in ``alerts.py``, the
+goodput ledger in ``goodput.py``, the PR 11/18 control loops) actually
+asks.  :class:`TimeSeriesStore` closes that gap: ``tick()`` appends one
+compact snapshot of every registered metric (cumulative counter values,
+gauge samples, histogram count/sum/bucket counts) stamped on an
+INJECTABLE clock, into a fixed-capacity ring with resolution-halving
+downsampling — old history gets coarser, never unbounded.
+
+Query API works in the same shapes Prometheus users expect:
+
+* ``series(name, labels, window)`` — ``[(t, value)]`` points; with
+  ``labels=None`` matching label sets are SUMMED (the fleet-wide view);
+* ``delta()`` / ``rate()`` — counter movement over a window;
+* ``last()`` — the newest sample;
+* ``tail()`` — the last-N points an alert incident carries.
+
+Persistence goes through the one :class:`~.registry.JsonlWriter` path
+(``write_jsonl`` dumps the retained ring; ``configure(writer=)``
+streams one line per tick).  Like every PR 4 instrument the store is
+DISABLED by default: ``tick()`` while disabled is one flag check
+(pinned <20 us/op by ``tests/test_timeseries.py``), so control loops
+carry their tick hooks unconditionally.
+
+There is NO collector thread: ticks are driven by whoever owns a
+cadence (``FleetController.tick`` via an attached
+:class:`~.alerts.AlertManager`, bench chaos stages on a manual clock) —
+the no-leaked-threads gate stays intact and tests get determinism
+for free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["TimeSeriesStore"]
+
+
+def _label_key(labels):
+    """Canonical hashable key for one label set ({} -> ())."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _key_str(key):
+    return ",".join(f"{k}={v}" for k, v in key) if key else ""
+
+
+class TimeSeriesStore:
+    """Bounded ring of periodic :class:`MetricsRegistry` snapshots.
+
+    ``capacity`` bounds RETAINED ticks; past it the oldest half is
+    downsampled 2:1 (every second tick dropped), so the ring holds a
+    long coarse past plus a fine recent window.  ``clock`` defaults to
+    ``time.perf_counter`` and is injectable for deterministic tests /
+    chaos probes.  ``min_interval_s`` rate-limits callers that tick on
+    a hot cadence (a 20 Hz controller loop should not snapshot the
+    registry 20 times a second)."""
+
+    def __init__(self, registry=None, capacity=512, clock=None,
+                 enabled=False, min_interval_s=0.0):
+        if capacity < 4:
+            raise ValueError(f"capacity must be >= 4, got {capacity}")
+        self._registry = registry
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._clock = clock if clock is not None else time.perf_counter
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._ticks = []            # [(t, {name: (kind, {key: value})})]
+        self.tick_count = 0         # ticks ever taken
+        self.downsampled = 0        # ticks dropped by compaction
+        self.compactions = 0
+        self._writer = None
+        self._m_ticks = None
+        self._m_retained = None
+        self._m_dropped = None
+
+    # -- configuration -----------------------------------------------------
+    def configure(self, writer=None, min_interval_s=None):
+        """Attach a :class:`~.registry.JsonlWriter` (one line per tick)
+        and/or adjust the tick rate limit."""
+        if writer is not None:
+            self._writer = writer
+        if min_interval_s is not None:
+            self.min_interval_s = float(min_interval_s)
+        return self
+
+    def clear(self):
+        with self._lock:
+            self._ticks = []
+            self.tick_count = 0
+            self.downsampled = 0
+            self.compactions = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ticks)
+
+    # -- collection --------------------------------------------------------
+    def _capture(self):
+        """One compact frame of the registry: {name: (kind,
+        {label_key: scalar | (count, sum, bucket_counts)})}."""
+        snap = self._registry.snapshot()
+        frame = {}
+        for name, m in snap.items():
+            samples = {}
+            for s in m["samples"]:
+                key = _label_key(s["labels"])
+                if m["type"] == "histogram":
+                    samples[key] = (s["count"], s["sum"],
+                                    tuple(n for _, n in s["buckets"]))
+                else:
+                    samples[key] = float(s["value"])
+            frame[name] = (m["type"], samples)
+        return frame
+
+    def tick(self, now=None):
+        """Append one snapshot frame; no-op while disabled.  Returns
+        the frame timestamp, or None when disabled / rate-limited."""
+        if not self.enabled or self._registry is None:
+            return None
+        t = self._clock() if now is None else float(now)
+        with self._lock:
+            if (self._ticks and self.min_interval_s > 0.0
+                    and t - self._ticks[-1][0] < self.min_interval_s):
+                return None
+        frame = self._capture()
+        dropped = 0
+        with self._lock:
+            self._ticks.append((t, frame))
+            self.tick_count += 1
+            if len(self._ticks) > self.capacity:
+                # resolution-halving compaction: drop every second tick
+                # of the OLDEST half — the recent window stays fine-
+                # grained, the deep past gets coarser instead of gone
+                half = len(self._ticks) // 2
+                old = self._ticks[:half]
+                kept = old[::2]
+                dropped = len(old) - len(kept)
+                self._ticks = kept + self._ticks[half:]
+                self.downsampled += dropped
+                self.compactions += 1
+            retained = len(self._ticks)
+        self._self_metrics(retained, dropped)
+        if self._writer is not None:
+            self._writer.write({"kind": "timeseries_tick", "t": t,
+                                "metrics": self._json_frame(frame)})
+        return t
+
+    def _self_metrics(self, retained, dropped):
+        reg = self._registry
+        if self._m_ticks is None:
+            self._m_ticks = reg.counter(
+                "hetu_timeseries_ticks_total",
+                "Registry snapshots appended to the time-series ring")
+            self._m_retained = reg.gauge(
+                "hetu_timeseries_ticks_retained",
+                "Snapshots currently retained in the time-series ring")
+            self._m_dropped = reg.counter(
+                "hetu_timeseries_ticks_downsampled_total",
+                "Old snapshots dropped by resolution-halving compaction")
+        self._m_ticks.inc()
+        self._m_retained.set(retained)
+        if dropped:
+            self._m_dropped.inc(dropped)
+
+    # -- queries -----------------------------------------------------------
+    def _frames(self, window=None, now=None):
+        with self._lock:
+            ticks = list(self._ticks)
+        if window is None or not ticks:
+            return ticks
+        t1 = ticks[-1][0] if now is None else float(now)
+        return [f for f in ticks if f[0] >= t1 - float(window)]
+
+    @staticmethod
+    def _sample_value(kind, v, field):
+        if kind != "histogram":
+            return v
+        if field in (None, "count"):
+            return float(v[0])
+        if field == "sum":
+            return float(v[1])
+        raise ValueError(f"histogram field must be 'count' or 'sum', "
+                         f"got {field!r}")
+
+    def series(self, name, labels=None, window=None, field=None,
+               now=None):
+        """``[(t, value)]`` for one metric over ``window`` seconds
+        (None: the whole retained ring).  ``labels=None`` sums every
+        label set of the metric — the fleet-wide aggregate; a dict
+        selects one series exactly.  ``field``: ``count``/``sum`` for
+        histograms.  Ticks predating a cumulative metric's first
+        appearance count as 0 (counters are born at zero); for gauges
+        such ticks are skipped — absence is not zero."""
+        want = None if labels is None else _label_key(labels)
+        out = []
+        absent = []     # frames predating the metric's first appearance
+        kind = None
+        for t, frame in self._frames(window, now):
+            m = frame.get(name)
+            if m is None:
+                if not out:
+                    absent.append(t)
+                continue
+            kind, samples = m
+            if want is None:
+                vals = [self._sample_value(kind, v, field)
+                        for v in samples.values()]
+                if not vals:
+                    if not out:
+                        absent.append(t)
+                    continue
+                out.append((t, float(sum(vals))))
+            elif want in samples:
+                out.append((t, float(self._sample_value(
+                    kind, samples[want], field))))
+            elif not out:
+                absent.append(t)
+        # cumulative metrics start life at zero: a counter born mid-
+        # window at value N is N increments of real movement, so pre-
+        # birth frames contribute 0 rather than vanishing (otherwise a
+        # rate rule can never fire on a fault that CREATES its counter).
+        # Gauges keep skip semantics — absence is not zero for them.
+        if out and absent and kind in ("counter", "histogram"):
+            out = [(t, 0.0) for t in absent] + out
+        return out
+
+    def last(self, name, labels=None, field=None):
+        pts = self.series(name, labels=labels, field=field)
+        return pts[-1][1] if pts else None
+
+    def delta(self, name, labels=None, window=None, field=None,
+              now=None):
+        """last - first over the window; None with <2 points (no
+        movement evidence is different from zero movement)."""
+        pts = self.series(name, labels, window, field, now)
+        if len(pts) < 2:
+            return None
+        return pts[-1][1] - pts[0][1]
+
+    def rate(self, name, labels=None, window=None, field=None,
+             now=None):
+        """Per-second rate of a cumulative series over the window;
+        None with <2 points or a zero time base."""
+        pts = self.series(name, labels, window, field, now)
+        if len(pts) < 2:
+            return None
+        dt = pts[-1][0] - pts[0][0]
+        if dt <= 0:
+            return None
+        return (pts[-1][1] - pts[0][1]) / dt
+
+    def mean(self, name, labels=None, window=None, field=None,
+             now=None):
+        pts = self.series(name, labels, window, field, now)
+        if not pts:
+            return None
+        return sum(v for _, v in pts) / len(pts)
+
+    def tail(self, name, labels=None, n=16, field=None):
+        """The last ``n`` points — what an alert incident carries as
+        the offending series window."""
+        return self.series(name, labels=labels, field=field)[-int(n):]
+
+    def names(self):
+        """Metric names present in the newest frame."""
+        with self._lock:
+            if not self._ticks:
+                return []
+            return sorted(self._ticks[-1][1])
+
+    # -- export ------------------------------------------------------------
+    @staticmethod
+    def _json_frame(frame):
+        out = {}
+        for name, (kind, samples) in frame.items():
+            rows = []
+            for key, v in samples.items():
+                row = {"labels": _key_str(key)}
+                if kind == "histogram":
+                    row.update(count=v[0], sum=v[1], buckets=list(v[2]))
+                else:
+                    row["value"] = v
+                rows.append(row)
+            out[name] = {"type": kind, "samples": rows}
+        return out
+
+    def write_jsonl(self, writer):
+        """Dump the retained ring as one record through a
+        :class:`~.registry.JsonlWriter` (or any ``write(record)``)."""
+        with self._lock:
+            ticks = list(self._ticks)
+        writer.write({"kind": "timeseries",
+                      "tick_count": self.tick_count,
+                      "downsampled": self.downsampled,
+                      "ticks": [{"t": t, "metrics": self._json_frame(f)}
+                                for t, f in ticks]})
+
+    def report_block(self):
+        """The ``/timeseries`` debug payload + ``telemetry.report()``
+        block: ring occupancy, span, and the live series index."""
+        with self._lock:
+            ticks = list(self._ticks)
+        return {"enabled": self.enabled,
+                "ticks_retained": len(ticks),
+                "tick_count": self.tick_count,
+                "downsampled": self.downsampled,
+                "compactions": self.compactions,
+                "capacity": self.capacity,
+                "span_s": (round(ticks[-1][0] - ticks[0][0], 6)
+                           if len(ticks) >= 2 else 0.0),
+                "series": self.names()}

@@ -950,3 +950,41 @@ def test_perf_diff_plan_budget_and_latency_signals(tmp_path):
     assert bad[0]["kind"] == "latency"
     # search getting slower is information, not a gate
     assert run(plan_search_ms=50.0).returncode == 0
+
+
+def test_perf_diff_goodput_one_sided(tmp_path):
+    """ISSUE 19: goodput fractions are one-sided absolute signals —
+    a drop beyond 5 points trips rc 1, a gain never does, and a
+    goodput signal present on only one side is a note, not a gate."""
+    diff = os.path.join(os.path.dirname(BENCH), "tools", "perf_diff.py")
+    base_doc = {"signals": {"serve_goodput_fraction": 0.80,
+                            "chaos_goodput_fraction": 0.50}}
+    cur_doc = {"signals": {"serve_goodput_fraction": 0.70,
+                           "chaos_goodput_fraction": 0.90,
+                           "fleet_goodput_fraction": 0.60}}
+    (tmp_path / "base.json").write_text(json.dumps(base_doc))
+    (tmp_path / "cur.json").write_text(json.dumps(cur_doc))
+    argv = [sys.executable, diff,
+            "--current", str(tmp_path / "cur.json"),
+            "--baseline", str(tmp_path / "base.json"), "--json"]
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 1
+    verdict = json.loads(proc.stdout)
+    by_sig = {r["signal"]: r for r in verdict["table"]}
+    bad = [r for r in verdict["table"] if r["regressed"]]
+    assert [r["signal"] for r in bad] == ["serve_goodput_fraction"]
+    assert bad[0]["kind"] == "goodput"
+    # a 40-point goodput GAIN is never a failure
+    assert by_sig["chaos_goodput_fraction"]["regressed"] is False
+    assert by_sig["chaos_goodput_fraction"]["kind"] == "goodput"
+    # one-sided-only signal: a note, never a gate
+    assert verdict["new_signals"] == ["fleet_goodput_fraction"]
+    assert "fleet_goodput_fraction" not in by_sig
+    # inside the 5-point tolerance: clean exit
+    cur_doc["signals"]["serve_goodput_fraction"] = 0.76
+    (tmp_path / "cur.json").write_text(json.dumps(cur_doc))
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["status"] == "ok"

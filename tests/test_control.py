@@ -540,3 +540,32 @@ def test_controller_start_stop_thread(served):
         fleet._wait_for(lambda: ctl.ticks >= 3, 30, "controller ticks")
     assert ctl._thread is None and not ctl._running
     fleet.stop()
+
+
+def test_controller_consumes_alert_plane(served):
+    """FleetController.tick polls an attached AlertManager: firing
+    rules join the violation tuple as ``alert:<rule>`` (feeding the
+    same degrade/autoscale machinery as SLO violations) and report()
+    lists them under ``alerts_firing``."""
+    from hetu_tpu.telemetry import (AlertManager, MetricsRegistry,
+                                    ThresholdRule, TimeSeriesStore)
+    clk = ManualClock()
+    areg = MetricsRegistry(enabled=True)
+    store = TimeSeriesStore(registry=areg, clock=clk, enabled=True)
+    mgr = AlertManager(
+        store, [ThresholdRule("hot", "probe_g", reduce="last", op=">",
+                              threshold=1.0, for_ticks=1)],
+        clock=clk, enabled=True)
+    fleet = _fleet(served, n=1, clock=clk, name="alertctl")
+    ctl = FleetController(fleet, SLO(), max_engines=1, alerts=mgr)
+    ctl.tick()
+    assert ctl.report()["alerts_firing"] == []
+    areg.gauge("probe_g", "g").set(9)
+    clk.advance(1.0)
+    ctl.tick()
+    assert "alert:hot" in ctl._viol_now
+    assert ctl.report()["alerts_firing"] == ["hot"]
+    # a controller without a plane reports None, not an empty list
+    ctl2 = FleetController(fleet, SLO(), max_engines=1)
+    assert ctl2.report()["alerts_firing"] is None
+    fleet.stop()

@@ -162,7 +162,8 @@ def test_doc_table_is_nonempty_and_well_formed():
     assert len(doc) >= 40
     for family in ("hetu_executor_", "hetu_serving_", "hetu_fleet_",
                    "hetu_embed_", "hetu_ps_", "hetu_guard_",
-                   "hetu_prefetch_", "hetu_incidents_", "hetu_trace"):
+                   "hetu_prefetch_", "hetu_incidents_", "hetu_trace",
+                   "hetu_timeseries_", "hetu_alerts_", "hetu_goodput_"):
         assert any(n.startswith(family) for n in doc), family
 
 

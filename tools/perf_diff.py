@@ -23,6 +23,12 @@ direction-aware per-signal tolerances:
   higher is better and ONE-SIDED in absolute points on a [0, 1] scale —
   a regression is current < baseline - tol_attainment (default 0.05 =
   5 points); gains never fail.
+* goodput signals (``*goodput*``, from the ``bench.py --serve`` ledger
+  replay): the useful fraction of wall x chips on the same [0, 1]
+  scale, gated exactly like attainment — one-sided, absolute points,
+  gains never fail — because the fraction compares span time against
+  wall time on the same clock (machine speed cancels), so only a real
+  shift in where the time goes moves it more than the tolerance.
 * error-bound signals (``*logit_div*``, from ``bench.py --serve
   --kv-dtype``): a committed numerical-divergence budget, lower is
   better and ONE-SIDED — a regression is current > baseline *
@@ -83,6 +89,10 @@ THROUGHPUT_MARKERS = (".mfu", "_per_sec", "concurrency")
 THROUGHPUT_SUFFIXES = ("_per_s",)
 #: higher-is-better one-sided signals compared in absolute points
 ATTAINMENT_MARKERS = ("attainment",)
+#: goodput fractions ([0, 1] useful share of wall x chips): the same
+#: one-sided absolute-points gate as attainment — a drop past the
+#: tolerance means capacity moved from useful work to a lost cause
+GOODPUT_MARKERS = ("goodput",)
 #: lower-is-better one-sided DIVERGENCE signals (quantized-twin
 #: max-logit divergence from ``--serve --kv-dtype``): only GROWTH past
 #: the committed bound fails — a quantization codec drifting is a
@@ -130,7 +140,7 @@ PLAN_PRED_ERR_BUDGET = 0.35
 
 
 def classify(name, platform=None):
-    """'attainment' (higher is better, absolute one-sided),
+    """'attainment' / 'goodput' (higher is better, absolute one-sided),
     'error_bound' (lower is better, one-sided growth), 'info' (never
     gates), 'throughput' (higher is better, ratio), 'static' (lower
     is better, ratio), or 'migration_floor' (absolute one-sided floor
@@ -153,6 +163,8 @@ def classify(name, platform=None):
         return "throughput" if platform == "tpu" else "info"
     if any(m in name for m in ATTAINMENT_MARKERS):
         return "attainment"
+    if any(m in name for m in GOODPUT_MARKERS):
+        return "goodput"
     if any(m in name for m in ERROR_BOUND_MARKERS):
         return "error_bound"
     if any(m in name for m in INFO_MARKERS):
@@ -211,7 +223,7 @@ def diff_signals(current, baseline, tol_throughput, tol_static,
             continue
         cur, base = float(current[name]), float(baseline[name])
         kind = classify(name, platform)
-        if kind == "attainment":
+        if kind in ("attainment", "goodput"):
             # absolute points, one-sided: only a DROP beyond the
             # tolerance fails (a ratio misreads a 0.02 -> 0.01 noise
             # wiggle as a 50% collapse)
