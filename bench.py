@@ -922,6 +922,156 @@ def _chaos_preempt(injector, tmpdir):
             "resumed_step": restored, "bitwise_resume": bool(bitwise)}
 
 
+def _chaos_elastic(quick, tmpdir):
+    """Kill-a-chip elastic recovery vs a cold-restart twin.
+
+    The elastic leg trains on a 2-device dp mesh, loses a chip halfway
+    (next dispatch raises DeviceLost), and the ElasticTrainer re-plans
+    onto the survivor and resumes from the resharded rolling
+    checkpoint.  The twin models the pre-elastic world: the same fault
+    cold-restarts training from step 0 on the survivor (no rolling
+    checkpoint to adopt).  Both legs report the same goodput measure —
+    time spent on steps that COUNTED (last run of each step) over
+    wall — so ``elastic_vs_restart_goodput`` is the margin in-place
+    recovery buys; ``elastic_recovery_s`` is the recover-protocol wall
+    time and the GoodputLedger prices it in the ``reshard`` bucket."""
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu import telemetry
+    from hetu_tpu.resilience import (ElasticTrainer,
+                                     RollingCheckpointManager, faults)
+    from hetu_tpu.telemetry.goodput import GoodputLedger
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"faults_injected": 0, "faults_recovered": 0,
+                "skipped": "needs >= 2 devices"}
+    devs = list(devs[:2])
+    n_steps = 8 if quick else 24
+    fault_at = n_steps // 2
+    B = 16
+
+    def build(strategy):
+        with ht.name_scope():
+            x = ht.placeholder_op("ez_x", (B, 16))
+            y = ht.placeholder_op("ez_y", (B, 1))
+            w1 = ht.Variable("ez_in_weight", shape=(16, 8),
+                             initializer=ht.init.xavier_normal())
+            w2 = ht.Variable("ez_out_weight", shape=(8, 1),
+                             initializer=ht.init.xavier_normal())
+            loss = ht.mse_loss_op(
+                ht.matmul_op(ht.matmul_op(x, w1), w2), y)
+            train = ht.AdamOptimizer(0.02).minimize(loss)
+        return ht.Executor({"train": [loss, train]},
+                           dist_strategy=strategy, seed=11)
+
+    def batch(i):
+        r = np.random.default_rng(4000 + i)
+        return {"ez_x": r.standard_normal((B, 16)).astype(np.float32),
+                "ez_y": r.standard_normal((B, 1)).astype(np.float32)}
+
+    def goodput_frac(step_times, wall):
+        # last run of each step is the one that counted; re-runs and
+        # recovery time are the lost capacity
+        useful = sum(step_times.values())
+        return useful / wall if wall > 0 else 0.0
+
+    tel_was_on = telemetry.enabled()
+    if not tel_was_on:       # the ledger needs the tracer's spans
+        telemetry.enable()
+    try:
+        # -- elastic leg ---------------------------------------------------
+        ledger = GoodputLedger(registry=telemetry.get_registry(),
+                               tracer=telemetry.get_tracer(),
+                               name="elastic", enabled=True)
+        ledger.begin()
+        t0 = time.perf_counter()
+        mgr = RollingCheckpointManager(os.path.join(tmpdir, "el"),
+                                       keep=3, sharded=True)
+        tr = ElasticTrainer(build, mgr, devices=devs,
+                            checkpoint_every=1, install_hook=False)
+        step_times, losses = {}, {}
+        lost = []
+
+        def chaotic(i):
+            if i == fault_at and not lost:
+                lost.append(i)
+                faults.lose_device(tr.executor)
+            return batch(i)
+
+        while True:
+            i = tr.global_step
+            if i >= n_steps:
+                break
+            s0 = time.perf_counter()
+            got = tr.train(i + 1, chaotic)
+            step_times[i] = time.perf_counter() - s0
+            losses.update(got)
+        elastic_wall = time.perf_counter() - t0
+        acct = ledger.account(wall_s=elastic_wall)
+        recovery_s = tr.recovery_s[0] if tr.recovery_s else None
+        if recovery_s and fault_at in step_times:
+            # the fault step's timing window swallowed the recovery —
+            # recovery is lost capacity, not useful step time
+            step_times[fault_at] = max(
+                0.0, step_times[fault_at] - recovery_s)
+        elastic_frac = goodput_frac(step_times, elastic_wall)
+        final_loss = losses.get(n_steps - 1)
+        recovered = (tr.resharded == 1 and len(losses) == n_steps
+                     and all(np.isfinite(v) for v in losses.values()))
+        world_after = len(tr.devices)
+        tr.executor.close()
+
+        # -- cold-restart twin --------------------------------------------
+        t0 = time.perf_counter()
+        twin_times = {}
+        ex = build(_dp_strategy(devs))
+        for i in range(fault_at):           # work the fault throws away
+            s0 = time.perf_counter()
+            ex.run("train", feed_dict=batch(i))
+            twin_times[i] = time.perf_counter() - s0
+        faults.lose_device(ex)
+        try:                                # the dispatch that finds out
+            ex.run("train", feed_dict=batch(fault_at))
+        except Exception:
+            pass
+        ex.close()
+        ex = build(_dp_strategy(devs[:1]))  # cold restart: from step 0
+        for i in range(n_steps):
+            s0 = time.perf_counter()
+            ex.run("train", feed_dict=batch(i))
+            twin_times[i] = time.perf_counter() - s0
+        restart_wall = time.perf_counter() - t0
+        restart_frac = goodput_frac(twin_times, restart_wall)
+        ex.close()
+    finally:
+        if not tel_was_on:
+            telemetry.disable()
+
+    return {"faults_injected": 1,
+            "faults_recovered": int(recovered),
+            "world_before": len(devs), "world_after": world_after,
+            "resumed_step": fault_at,
+            "final_loss": (round(float(final_loss), 6)
+                           if final_loss is not None else None),
+            "elastic_recovery_s": (round(recovery_s, 6)
+                                   if recovery_s is not None else None),
+            "elastic_goodput_frac": round(elastic_frac, 4),
+            "restart_goodput_frac": round(restart_frac, 4),
+            "elastic_vs_restart_goodput": round(
+                elastic_frac - restart_frac, 4),
+            "fractions": {k: round(v, 6)
+                          for k, v in acct["fractions"].items()},
+            "steps": n_steps}
+
+
+def _dp_strategy(devices):
+    from hetu_tpu.parallel.mesh import make_mesh
+    from hetu_tpu.parallel.strategies import DataParallel
+    return DataParallel(mesh=make_mesh({"dp": len(devices)},
+                                       devices=devices))
+
+
 def _chaos_overhead(steps, check_interval=4):
     """Steady-state guard cost: guarded vs unguarded steps/sec on the
     same workload, interleaved groups (shared drift), plus the guarded
@@ -1266,7 +1416,7 @@ def run_telemetry_overhead(quick=False, rounds=6):
             "platform": jax.default_backend(), "steps": steps}
 
 
-def run_chaos(quick=False, seed=0):
+def run_chaos(quick=False, seed=0, elastic=False):
     import tempfile
     import jax
     from hetu_tpu.resilience import FaultInjector
@@ -1290,6 +1440,9 @@ def run_chaos(quick=False, seed=0):
         stages["torn_ckpt"] = _staged(_chaos_torn_ckpt, injector, d)
     with tempfile.TemporaryDirectory() as d:
         stages["preempt"] = _staged(_chaos_preempt, injector, d)
+    if elastic:
+        with tempfile.TemporaryDirectory() as d:
+            stages["elastic"] = _staged(_chaos_elastic, quick, d)
     overhead = _chaos_overhead(steps)
     numerics_overhead = _chaos_numerics_overhead(steps)
     out = {"metric": "chaos_resilience",
@@ -1300,8 +1453,16 @@ def run_chaos(quick=False, seed=0):
            "stages": stages}
     out.update(overhead)
     out["numerics"] = numerics_overhead
+    el = stages.get("elastic", {})
+    if el.get("elastic_recovery_s") is not None:
+        # the perf_diff contract: a flat signals block like --profile's
+        out["signals"] = {
+            "elastic_recovery_s": el["elastic_recovery_s"],
+            "elastic_vs_restart_goodput":
+                el["elastic_vs_restart_goodput"]}
     out["all_stages_recovered"] = all(
-        s["faults_recovered"] >= 1 for s in stages.values())
+        s["faults_recovered"] >= 1 for s in stages.values()
+        if "skipped" not in s)
     return out
 
 
@@ -1323,7 +1484,8 @@ def _emit_chaos(out, detail_path=None):
                              f"{v['faults_injected']}"
                           for k, v in out["stages"].items()},
                "detail": os.path.basename(detail_path)}
-    for k in ("zero_accepted_loss", "single_engine_twin_lost_streams"):
+    for k in ("zero_accepted_loss", "single_engine_twin_lost_streams",
+              "signals"):
         if k in out:
             compact[k] = out[k]
     if "telemetry_overhead" in out:
@@ -4667,7 +4829,14 @@ def main():
         # overhead.  Same platform selection as stage children.
         # --chaos --serve injects the SERVING fault classes through the
         # continuous-batching engine instead (same CHAOS_FULL.json
-        # contract).
+        # contract).  --chaos --elastic adds the kill-a-chip stage,
+        # which needs a 2-device mesh — force host devices on CPU
+        # BEFORE jax initializes its backends (no-op on a real pod).
+        if "--elastic" in sys.argv:
+            flag = "--xla_force_host_platform_device_count=8"
+            if flag not in os.environ.get("XLA_FLAGS", ""):
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
         import jax
         if os.environ.get("JAX_PLATFORMS"):
             jax.config.update("jax_platforms",
@@ -4684,7 +4853,8 @@ def main():
         elif "--serve" in sys.argv:
             out = run_chaos_serve(quick)
         else:
-            out = run_chaos(quick)
+            out = run_chaos(quick,
+                            elastic="--elastic" in sys.argv)
         if telemetry_on:
             # unprotected "twin." engines die/wedge by design — every
             # OTHER accepted rid must show a complete stitched timeline

@@ -31,12 +31,14 @@ import numpy as np
 from .graph.node import PlaceholderOp
 
 
-def _mp_worker(worker_id, num_workers, stop, data_shm_name, data_shape,
-               data_dtype, out_shm_name, out_shape, out_dtype, slots,
-               empty_sems, filled_sems, batch_size, num_batches, shuffle,
-               seed, transform):
+def _mp_worker(worker_id, num_workers, start, stop, data_shm_name,
+               data_shape, data_dtype, out_shm_name, out_shape, out_dtype,
+               slots, empty_sems, filled_sems, batch_size, num_batches,
+               shuffle, seed, transform):
     """Worker process body: handles batches i with i % num_workers ==
-    worker_id, writing each into ring slot i % slots."""
+    worker_id, writing each into ring slot i % slots.  ``start`` shifts
+    the global counter so a fast-forwarded stream (skip_to_step) resumes
+    mid-epoch without replaying skipped batches."""
     from multiprocessing import shared_memory
     data_shm = shared_memory.SharedMemory(name=data_shm_name)
     out_shm = shared_memory.SharedMemory(name=out_shm_name)
@@ -47,8 +49,10 @@ def _mp_worker(worker_id, num_workers, stop, data_shm_name, data_shape,
         # GLOBAL batch counter g (continuous across epochs): the consumer
         # drains slot g % slots in g order, so the slot index must come
         # from g, not the within-epoch index — the within-epoch form
-        # collides as soon as num_batches % slots != 0
-        g = worker_id
+        # collides as soon as num_batches % slots != 0.  The first g this
+        # worker owns at/after ``start`` keeps the g % W == worker shard
+        # assignment identical to a never-skipped run.
+        g = start + ((worker_id - start) % num_workers)
         order, order_epoch = None, -1
         while not stop.is_set():
             epoch, i = divmod(g, num_batches)
@@ -83,7 +87,7 @@ class _MPEngine:
     traffic — one copy out of the ring per batch, zero per-batch pickling)."""
 
     def __init__(self, data, batch_size, num_batches, shuffle, seed,
-                 num_workers, prefetch, transform):
+                 num_workers, prefetch, transform, start=0):
         import multiprocessing as mp
         from multiprocessing import shared_memory
         # spawn: never fork a process that may hold a live XLA client
@@ -124,16 +128,16 @@ class _MPEngine:
         self._procs = [
             self._mp.Process(
                 target=_mp_worker,
-                args=(w, num_workers, self._stop, self._data_shm.name,
-                      data.shape, data.dtype, self._out_shm.name,
-                      probe.shape, probe.dtype, slots, self._empty,
-                      self._filled, batch_size, num_batches, shuffle,
-                      seed, transform),
+                args=(w, num_workers, int(start), self._stop,
+                      self._data_shm.name, data.shape, data.dtype,
+                      self._out_shm.name, probe.shape, probe.dtype, slots,
+                      self._empty, self._filled, batch_size, num_batches,
+                      shuffle, seed, transform),
                 daemon=True)
             for w in range(num_workers)]
         for p in self._procs:
             p.start()
-        self._cursor = 0
+        self._cursor = int(start)
 
     def next_batch(self):
         slot = self._cursor % self._slots
@@ -205,6 +209,7 @@ class Dataloader:
         self._thread = None
         self._engine = None
         self._stop = threading.Event()
+        self._start_batch = 0
         if self.num_batches == 0:
             raise ValueError(
                 f"dataloader '{name}': shard of {data.shape[0]} rows "
@@ -228,12 +233,29 @@ class Dataloader:
                 .permutation(self.data.shape[0])
                 if self.shuffle else np.arange(self.data.shape[0]))
 
+    def skip_to_step(self, k):
+        """Fast-forward the stream to global batch ``k`` in O(1) — the
+        elastic trainer's resume hook: batch k of a skipped stream is
+        bitwise the batch k an uninterrupted run would have produced,
+        because every batch is a pure function of (seed, k) via the
+        per-epoch permutation.  Must be called before the stream starts
+        (no replaying a live queue)."""
+        if self._thread is not None or self._engine is not None:
+            raise RuntimeError(
+                f"dataloader '{self.name}': skip_to_step({k}) after the "
+                "stream started — position the stream before the first "
+                "next_batch()/start()")
+        if k < 0:
+            raise ValueError(f"skip_to_step: k must be >= 0, got {k}")
+        self._start_batch = int(k)
+        return self
+
     def _producer(self):
-        epoch = 0
+        epoch, start_i = divmod(self._start_batch, self.num_batches)
         while not self._stop.is_set():
             order = self._epoch_perm(epoch)
             epoch += 1
-            for i in range(self.num_batches):
+            for i in range(start_i, self.num_batches):
                 if self._stop.is_set():
                     return
                 sel = order[i * self.batch_size:(i + 1) * self.batch_size]
@@ -248,6 +270,7 @@ class Dataloader:
                         break
                     except queue.Full:
                         continue
+            start_i = 0
 
     def start(self):
         if self.num_workers > 0:
@@ -255,7 +278,8 @@ class Dataloader:
                 self._engine = _MPEngine(
                     self.data, self.batch_size, self.num_batches,
                     self.shuffle, self._seed, self.num_workers,
-                    self._prefetch, self.transform)
+                    self._prefetch, self.transform,
+                    start=self._start_batch)
             return self
         if self._thread is None:
             self._thread = threading.Thread(target=self._producer,
@@ -297,9 +321,11 @@ class Dataloader:
 
     def __iter__(self):
         """Single-epoch iteration without the prefetch machinery (eval
-        loops)."""
-        order = self._epoch_perm(0)
-        for i in range(self.num_batches):
+        loops); honors a prior :meth:`skip_to_step` by yielding the
+        remainder of the positioned epoch."""
+        epoch, start_i = divmod(self._start_batch, self.num_batches)
+        order = self._epoch_perm(epoch)
+        for i in range(start_i, self.num_batches):
             sel = order[i * self.batch_size:(i + 1) * self.batch_size]
             batch = self.data[sel]
             if self.transform is not None:

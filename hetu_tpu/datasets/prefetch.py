@@ -55,6 +55,7 @@ class DevicePrefetcher:
         import jax
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = iterator
         self._it = iter(iterator)
         self.depth = int(depth)
         self._sharding = sharding
@@ -145,6 +146,27 @@ class DevicePrefetcher:
                 self._enqueue(("err", e))
                 return
             self._enqueue(("ok", dev))
+
+    def skip_to_step(self, k):
+        """Fast-forward to global batch ``k`` before the first pull —
+        the elastic trainer's resume hook.  Delegates to the wrapped
+        source's own ``skip_to_step`` when it has one (Dataloader: O(1),
+        seed-stable); otherwise the wrapped iterator is advanced lazily
+        with islice (O(k) pulls, skipped batches never uploaded)."""
+        if self._thread is not None or self._queue is not None:
+            raise RuntimeError(
+                f"prefetcher: skip_to_step({k}) after the stream "
+                "started — position the stream before the first pull")
+        if k < 0:
+            raise ValueError(f"skip_to_step: k must be >= 0, got {k}")
+        skip = getattr(self._source, "skip_to_step", None)
+        if callable(skip):
+            skip(int(k))
+            self._it = iter(self._source)
+        else:
+            import itertools
+            self._it = itertools.islice(self._it, int(k), None)
+        return self
 
     def start(self):
         if self.sync or self._thread is not None:

@@ -232,27 +232,37 @@ class PlannedParallel(Strategy):
     ``config()``/``save_json`` persist the full plan dict, so a saved
     strategy round-trips through :meth:`Strategy.load_json`."""
 
-    def __init__(self, plan, mesh_shape=None):
+    def __init__(self, plan, mesh_shape=None, devices=None):
         cfg = plan["config"] if "config" in plan else plan
         from ..galvatron.config import HybridParallelConfig
         hp = (HybridParallelConfig.from_json(cfg)
               if isinstance(cfg, dict) else cfg)
         self.plan = dict(plan)
         self.mesh_shape = dict(mesh_shape) if mesh_shape else None
+        # devices: the concrete device pool to build the mesh over —
+        # the elastic trainer's surviving set after a chip loss.
+        # Default (None) is jax.devices(), the full fleet.
+        self._devices = list(devices) if devices is not None else None
         tp = max(int(t) for t in hp.tp_sizes)
         world = int(hp.world or hp.pp_deg * tp)
         dp = max(1, world // (int(hp.pp_deg) * tp))
         fsdp = sum(int(t) for t in hp.dp_types) * 2 > len(hp.dp_types)
         self.tp, self.dp = tp, dp
-        mesh = make_mesh(self.mesh_shape) if self.mesh_shape else None
+        mesh = (make_mesh(self.mesh_shape, devices=self._devices)
+                if self.mesh_shape else None)
         if tp > 1:
             self._inner = MegatronLM(
                 mesh=mesh if mesh is not None
-                else make_mesh({"dp": dp, "tp": tp}))
+                else make_mesh({"dp": dp, "tp": tp},
+                               devices=self._devices))
         elif fsdp and dp > 1:
-            self._inner = FSDP(mesh=mesh, ndev=dp)
+            self._inner = FSDP(
+                mesh=mesh if mesh is not None
+                else make_mesh({"dp": dp}, devices=self._devices))
         else:
-            self._inner = DataParallel(mesh=mesh, ndev=dp)
+            self._inner = DataParallel(
+                mesh=mesh if mesh is not None
+                else make_mesh({"dp": dp}, devices=self._devices))
         self.lowered = type(self._inner).__name__
 
     def annotate(self, eval_nodes):

@@ -92,15 +92,53 @@ def predict(cfg, layers, ici_gbps=100.0):
             "max_stage_mem_bytes": int(round(max(stage_mem)))}
 
 
-def emit_plan(layers, world, mem_budget_bytes, ici_gbps=100.0,
+def _capacity_constraint(world, devices, mesh_shape, pp_candidates):
+    """Resolve the ``devices=`` / ``mesh_shape=`` capacity constraint
+    (elastic re-planning: the search answers "best plan on what's
+    LEFT", not on the original fleet).  Returns ``(world, n_dev,
+    mesh_shape, pp_candidates)``."""
+    n_dev = None
+    if devices is not None:
+        n_dev = int(devices) if isinstance(devices, int) else len(devices)
+        world = n_dev if world is None else min(int(world), n_dev)
+    ms = None
+    if mesh_shape is not None:
+        ms = {str(k): int(v) for k, v in dict(mesh_shape).items()}
+        forced = 1
+        for v in ms.values():
+            forced *= v
+        if n_dev is not None and forced > n_dev:
+            raise PlanError(
+                f"mesh_shape {ms} needs {forced} devices, "
+                f"constraint allows {n_dev}")
+        world = forced
+        if pp_candidates is None:
+            pp_candidates = (ms.get("pp", 1),)
+    if world is None:
+        raise PlanError(
+            "plan emission needs world=, devices=, or mesh_shape=")
+    return int(world), n_dev, ms, pp_candidates
+
+
+def emit_plan(layers, world=None, mem_budget_bytes=None, ici_gbps=100.0,
               micro_bsz=1, global_bsz=None, mem_units=64,
               pp_candidates=None, chunks_candidates=(1, 2, 4, 8),
-              use_native=True, profile_meta=None):
+              use_native=True, profile_meta=None, devices=None,
+              mesh_shape=None):
     """Search the calibrated profile and emit the plan artifact dict.
 
     Raises :class:`PlanError` when no config fits the per-device
     memory budget (the search's infeasible verdict is an answer, not a
-    crash with a half-written artifact)."""
+    crash with a half-written artifact).
+
+    ``devices`` (a device list or count) clamps the searched world to
+    the surviving capacity; ``mesh_shape`` ({axis: size}) pins it to a
+    concrete mesh (and its ``pp`` size, unless ``pp_candidates`` says
+    otherwise) — the elastic trainer's re-plan-after-chip-loss hook."""
+    world, n_dev, mesh_shape, pp_candidates = _capacity_constraint(
+        world, devices, mesh_shape, pp_candidates)
+    if mem_budget_bytes is None:
+        raise PlanError("emit_plan needs mem_budget_bytes")
     search = GalvatronSearch(world, mem_budget_bytes,
                              micro_bsz=micro_bsz, ici_gbps=ici_gbps,
                              mem_units=mem_units, use_native=use_native,
@@ -122,8 +160,39 @@ def emit_plan(layers, world, mem_budget_bytes, ici_gbps=100.0,
             "n_layers": len(layers),
             "config": cfg.to_json(),
             "predicted": pred}
+    if n_dev is not None:
+        plan["devices"] = n_dev
+    if mesh_shape is not None:
+        plan["mesh_shape"] = mesh_shape
     if profile_meta:
         plan["profile_meta"] = dict(profile_meta)
+    return plan
+
+
+def emit_fallback_plan(world=None, n_layers=1, global_bsz=None,
+                       devices=None, mesh_shape=None):
+    """Degraded hand plan for when no calibrated profile exists (the
+    elastic trainer must still re-plan after losing a chip it never
+    profiled for): pure data parallelism over the surviving devices
+    (tp=1, pp=1) — the one layout that is always executable.  Same
+    artifact schema as :func:`emit_plan`; ``core`` says
+    ``"hand_fallback"`` and ``predicted.iter_ms`` is ``None`` (nothing
+    was measured, so nothing is predicted and the perf gate has
+    nothing to hold it to)."""
+    world, n_dev, mesh_shape, _pp = _capacity_constraint(
+        world, devices, mesh_shape, None)
+    n = max(1, int(n_layers))
+    cfg = HybridParallelConfig(pp_deg=1, tp_sizes=[1] * n,
+                               dp_types=[0] * n, world=world,
+                               chunks=1, global_bsz=global_bsz)
+    plan = {"schema": PLAN_SCHEMA, "version": PLAN_VERSION,
+            "world": world, "core": "hand_fallback", "n_layers": n,
+            "config": cfg.to_json(),
+            "predicted": {"iter_ms": None}}
+    if n_dev is not None:
+        plan["devices"] = n_dev
+    if mesh_shape is not None:
+        plan["mesh_shape"] = mesh_shape
     return plan
 
 

@@ -18,20 +18,27 @@ training loop survive those, at near-zero steady-state cost:
   delay/drop, torn files, simulated preemption) backing the tests and
   ``bench.py --chaos``;
 * :func:`retry` (retry.py) — the one backoff/jitter/deadline retry
-  policy shared by the PS transport and dataset fetch paths.
+  policy shared by the PS transport and dataset fetch paths;
+* :class:`ElasticTrainer` (elastic.py) — the capacity-change
+  supervisor: on chip loss or preemption it re-plans the parallel
+  geometry over the survivors and resumes from a resharded rolling
+  checkpoint (same-DP recoveries are bitwise vs an uninterrupted run).
 """
 
 from __future__ import annotations
 
-from ..graph.checkpoint import CheckpointError
+from ..graph.checkpoint import CheckpointError, GeometryMismatch
 from .retry import retry
 from .guard import GuardTripped, StepGuard
 from .checkpointer import RollingCheckpointManager
 from . import faults
-from .faults import FaultInjector, InjectedFault, PrefetcherKilled
+from .faults import (DeviceLost, FaultInjector, InjectedFault,
+                     PrefetcherKilled)
+from .elastic import ElasticTrainer
 
 __all__ = [
-    "CheckpointError", "FaultInjector", "GuardTripped", "InjectedFault",
+    "CheckpointError", "DeviceLost", "ElasticTrainer", "FaultInjector",
+    "GeometryMismatch", "GuardTripped", "InjectedFault",
     "PrefetcherKilled", "RollingCheckpointManager", "StepGuard", "faults",
     "retry",
 ]

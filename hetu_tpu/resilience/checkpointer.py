@@ -37,7 +37,9 @@ import zlib
 import numpy as np
 
 from .. import telemetry as _telemetry
-from ..graph.checkpoint import (CheckpointError, atomic_write_bytes,
+from ..graph.checkpoint import (CheckpointError, GeometryMismatch,
+                                atomic_write_bytes, describe_geometry,
+                                executor_geometry, geometry_compatible,
                                 validate_state)
 
 MANIFEST_NAME = "MANIFEST.json"
@@ -79,6 +81,7 @@ class RollingCheckpointManager:
         self.preempted = False
         self.last_saved_step = None
         self._prev_handlers = {}
+        self._hooked = {}       # sig -> {"executor", "handler", "prev"}
         # host-store embedding tables (ps/store.py) snapshotted alongside
         # every checkpoint; anything with .save(path)/.load(path) works
         self.ps_tables = dict(ps_tables or {})
@@ -212,6 +215,11 @@ class RollingCheckpointManager:
             save_sharded(executor, path)
             entry = {"step": int(step), "file": fname,
                      "kind": "sharded",
+                     # the writing geometry (mesh axes + per-param
+                     # partition specs): restore_latest validates a
+                     # cross-geometry restore against this instead of
+                     # guessing and dying inside orbax
+                     "geometry": executor_geometry(executor),
                      "files": self._shard_files(path)}
         else:
             state = executor.state_dict()
@@ -293,12 +301,15 @@ class RollingCheckpointManager:
         return state
 
     def _read_verified_sharded(self, executor, path, entry,
-                               check_finite):
+                               check_finite, reshard=False):
         """Prove the whole shard SET intact against the manifest (every
         file present, byte-exact, CRC-clean), then restore it to a
         host-side state WITHOUT touching the executor — a torn set
         (preempted host mid-save) fails this candidate over to an older
-        checkpoint with the live state unharmed."""
+        checkpoint with the live state unharmed.  ``reshard=True``
+        restores through :func:`graph.checkpoint.restore_resharded`
+        into the executor's own (target) shardings, so the writing
+        geometry doesn't have to match."""
         if not os.path.isdir(path):
             raise CheckpointError("shard directory missing")
         files = entry.get("files")
@@ -325,9 +336,17 @@ class RollingCheckpointManager:
             warnings.warn(
                 f"shard dir {entry['file']} has no manifest evidence "
                 "(manifest lost?) — restoring unverified")
-        from ..graph.checkpoint import restore_sharded_state
+        from ..graph.checkpoint import (restore_resharded,
+                                        restore_sharded_state,
+                                        state_shardings)
         try:
-            state = restore_sharded_state(executor, path)
+            if reshard:
+                state = restore_resharded(path,
+                                          state_shardings(executor))
+            else:
+                state = restore_sharded_state(executor, path)
+        except CheckpointError:
+            raise
         except Exception as e:   # orbax raises a zoo on torn/invalid sets
             raise CheckpointError(
                 f"unrestorable shard set "
@@ -368,22 +387,49 @@ class RollingCheckpointManager:
             paths[nm] = path
         return paths
 
-    def restore_latest(self, executor, check_finite=True):
+    def restore_latest(self, executor, check_finite=True,
+                       reshard=False):
         """Restore the newest INTACT checkpoint into ``executor`` (and
         its PS snapshots into the registered tables) and return its
         step.  Torn, corrupt, structurally invalid, or (by default)
         non-finite checkpoints are skipped with a warning; raises
-        :class:`CheckpointError` when nothing survives."""
+        :class:`CheckpointError` when nothing survives.
+
+        A sharded checkpoint whose manifest-recorded geometry differs
+        from the live executor's raises a typed
+        :class:`~hetu_tpu.graph.checkpoint.GeometryMismatch` naming
+        both geometries — the checkpoint is fine, the executor is the
+        wrong shape, so falling over to an older file would be wrong
+        twice.  ``reshard=True`` makes the cross-geometry restore
+        intentional: the state is read through ``restore_resharded``
+        into the executor's own target shardings instead."""
         t0 = time.perf_counter()
         tried = []
+        live_geom = None
         for entry in self.entries():
             path = os.path.join(self.directory, entry["file"])
             sharded = (entry.get("kind") == "sharded"
                        or entry["file"].endswith(SHARDED_SUFFIX))
+            if sharded and not reshard:
+                saved_geom = entry.get("geometry")
+                if saved_geom:
+                    if live_geom is None:
+                        live_geom = executor_geometry(executor)
+                    if not geometry_compatible(saved_geom, live_geom):
+                        raise GeometryMismatch(
+                            f"checkpoint {entry['file']} was written "
+                            f"under {describe_geometry(saved_geom)} but "
+                            f"the live executor is "
+                            f"{describe_geometry(live_geom)} — restore "
+                            "with reshard=True (or "
+                            "graph.checkpoint.restore_resharded) for an "
+                            "intentional cross-geometry load",
+                            saved=saved_geom, live=live_geom)
             try:
                 if sharded:
                     state = self._read_verified_sharded(
-                        executor, path, entry, check_finite)
+                        executor, path, entry, check_finite,
+                        reshard=reshard)
                 else:
                     state = self._read_verified(path, entry,
                                                 check_finite)
@@ -413,8 +459,26 @@ class RollingCheckpointManager:
         ``exit_on_save=False`` keeps the process alive after the flush
         (tests, chaos bench) — ``self.preempted`` flips True either way
         so a training loop can drain and stop cleanly.  Main thread
-        only (CPython restriction on ``signal.signal``)."""
-        prev = signal.getsignal(sig)
+        only (CPython restriction on ``signal.signal``).
+
+        A previously-installed callable handler (a user's, or another
+        manager's) is CHAINED after this manager's flush, never
+        silently replaced — two managers both get their final
+        checkpoint out of a single SIGTERM.  Idempotent per (manager,
+        executor) pair: re-installing for the same executor returns
+        the live handler unchanged, and re-arming the same manager for
+        a NEW executor (elastic rebuild) replaces its own hook in
+        place instead of chaining to itself (which would double-flush
+        every preemption)."""
+        sig = int(sig)
+        current = signal.getsignal(sig)
+        mine = self._hooked.get(sig)
+        if mine is not None and current is mine["handler"]:
+            if mine["executor"] is executor:
+                return mine["handler"]      # already armed for this pair
+            prev = mine["prev"]             # re-arm in place, not on top
+        else:
+            prev = current
 
         def _handler(signum, frame):
             self.save(executor)
@@ -429,9 +493,13 @@ class RollingCheckpointManager:
 
         signal.signal(sig, _handler)
         self._prev_handlers[sig] = prev
+        self._hooked[sig] = {"executor": executor, "handler": _handler,
+                             "prev": prev}
         return _handler
 
     def uninstall_preemption_hook(self, sig=signal.SIGTERM):
+        sig = int(sig)
+        self._hooked.pop(sig, None)
         prev = self._prev_handlers.pop(sig, None)
         if prev is not None:
             signal.signal(sig, prev)

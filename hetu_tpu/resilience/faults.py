@@ -42,6 +42,18 @@ class InjectedFault(RuntimeError):
     """An error deliberately raised by a fault injector."""
 
 
+class DeviceLost(RuntimeError):
+    """A device backing the executor's mesh dropped out mid-run.  On a
+    real pod a dead chip surfaces exactly like this: the NEXT dispatch
+    (a collective touching the chip) fails — there is no callback.
+    Carries the lost device so a supervisor (``resilience/elastic``)
+    can compute the surviving set."""
+
+    def __init__(self, device=None):
+        super().__init__(f"device lost: {device}")
+        self.device = device
+
+
 # Kills a prefetch producer thread SILENTLY when raised from the wrapped
 # source iterator: SystemExit escapes the producer's `except Exception`
 # and threading discards it with no traceback, so no error sentinel is
@@ -411,6 +423,115 @@ def simulate_preemption(sig=signal.SIGTERM):
     """Deliver the pod scheduler's preemption notice to THIS process
     (synchronously, in the main thread)."""
     signal.raise_signal(sig)
+
+
+# -- capacity loss ---------------------------------------------------------
+
+def lose_device(executor, device=None):
+    """Simulate losing one device of the executor's mesh: the NEXT
+    dispatch of EVERY subgraph raises :class:`DeviceLost` (how a dead
+    chip actually surfaces — a failed collective, not a notification),
+    and the device is appended to ``executor.lost_devices`` so a
+    supervisor can compute the surviving set.  Defaults to the mesh's
+    last device.  Returns an undo callable (a supervisor that rebuilds
+    the executor never needs it; a test that wants the "chip back"
+    does)."""
+    mesh = getattr(executor, "mesh", None)
+    if device is None:
+        if mesh is not None:
+            device = list(mesh.devices.flat)[-1]
+        else:
+            import jax
+            device = jax.devices()[-1]
+    lost = getattr(executor, "lost_devices", None)
+    if lost is None:
+        lost = []
+        executor.lost_devices = lost
+    lost.append(device)
+    orig = {}
+    for name, sub in executor.subexecutor.items():
+        orig[name] = sub.run
+
+        def _raiser(*a, _d=device, **kw):
+            raise DeviceLost(_d)
+        sub.run = _raiser
+
+    def undo():
+        for name, sub in executor.subexecutor.items():
+            if name in orig:
+                sub.run = orig[name]
+        lost = getattr(executor, "lost_devices", None)
+        if lost is not None and device in lost:
+            lost.remove(device)
+    return undo
+
+
+def preempt_during_save(mgr, sig=signal.SIGTERM, frac=0.5,
+                        deliver=None):
+    """Arm the NEXT ``mgr.save`` to be preempted MID-FLUSH: what lands
+    on disk is exactly the wreckage a SIGTERM inside the write window
+    leaves — a torn payload under the final checkpoint name (pickle
+    mode) or a complete-looking shard directory with one truncated
+    file and no manifest entry (sharded mode: one host of the pod
+    never finished), the preemption notice is delivered, and the save
+    raises :class:`InjectedFault` instead of returning.  The contract
+    under test: ``restore_latest`` must still ADOPT the previous good
+    checkpoint — the torn flush fails verification (the existing
+    torn-manifest path) and falls over.
+
+    ``deliver`` controls the actual SIGTERM: ``None`` (default) raises
+    it only when a non-default handler is installed (a bare test
+    process must not be killed); ``True``/``False`` force it.  One-
+    shot; returns an undo callable that disarms an unfired injector."""
+    orig = mgr.save
+    prev_last = mgr.last_saved_step
+
+    def _armed_save(executor, step=None):
+        mgr.save = orig                      # one-shot: disarm first so a
+        prev_handler = signal.getsignal(sig)  # chained flush hook still works
+        if mgr.sharded:
+            import shutil
+            path = orig(executor, step=step)
+            step_no = mgr.last_saved_step
+            fname = os.path.basename(path)
+            # rewind the manifest to before this save (the kill landed
+            # before the manifest write) and tear the largest shard
+            # file — a host that never finished its part
+            entries = [e for e in mgr._read_manifest()
+                       if e.get("file") != fname]
+            mgr._write_manifest(entries)
+            files = [os.path.join(dp, fn)
+                     for dp, _dn, fns in os.walk(path) for fn in fns]
+            data = [f for f in files if os.path.getsize(f) > 0]
+            if data:
+                tear_file(max(data, key=os.path.getsize), frac=frac)
+            mgr.last_saved_step = prev_last
+        else:
+            import pickle as _pickle
+            state = executor.state_dict()
+            step_no = (int(state.get("global_step", 0))
+                       if step is None else int(step))
+            blob = _pickle.dumps(state,
+                                 protocol=_pickle.HIGHEST_PROTOCOL)
+            fname = f"{mgr.prefix}-{step_no:010d}.pkl"
+            with open(os.path.join(mgr.directory, fname), "wb") as f:
+                f.write(blob[:max(1, int(len(blob) * float(frac)))])
+        want = deliver
+        if want is None:
+            want = (callable(prev_handler)
+                    and prev_handler not in (signal.SIG_IGN,
+                                             signal.SIG_DFL))
+        if want:
+            signal.raise_signal(sig)
+        raise InjectedFault(
+            f"preempted during checkpoint flush (step {step_no})")
+
+    mgr.save = _armed_save
+
+    def undo():
+        if mgr.save is _armed_save:
+            mgr.save = orig
+    return undo
 
 
 # -- seeded placement ------------------------------------------------------

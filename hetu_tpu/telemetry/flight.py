@@ -40,7 +40,7 @@ __all__ = ["FlightRecorder", "INCIDENT_KINDS"]
 INCIDENT_KINDS = ("guard_trip", "watchdog", "engine_crash",
                   "engine_wedge", "breaker_open", "fleet_unavailable",
                   "ps_unavailable", "slo_scale", "slo_degrade",
-                  "migrate_failed", "alert")
+                  "migrate_failed", "elastic_reshard", "alert")
 
 
 class FlightRecorder:

@@ -19,7 +19,9 @@ Buckets (:data:`GOODPUT_BUCKETS`):
   ``checkpoint_restore`` (histograms), ``rollback`` (guard-tripped
   step time + the rollback-restore span), ``failover_replay``
   (replayed tokens x measured per-token decode cost, carved out of the
-  serving spans), ``kv_migration`` (live-migration span),
+  serving spans), ``kv_migration`` (live-migration span), ``reshard``
+  (the elastic trainer's re-plan + rebuild + resharded-restore span —
+  what a capacity change costs end to end),
   ``brownout_shed`` (shed requests x measured mean request cost,
   bounded by the idle residual — capacity we chose not to spend),
   ``idle`` (the residual).
@@ -48,7 +50,7 @@ __all__ = ["GoodputLedger", "GOODPUT_BUCKETS", "USEFUL_BUCKETS",
 GOODPUT_BUCKETS = ("useful_train", "useful_prefill", "useful_decode",
                    "compile", "data_wait", "checkpoint_save",
                    "checkpoint_restore", "rollback", "failover_replay",
-                   "kv_migration", "brownout_shed", "idle")
+                   "kv_migration", "reshard", "brownout_shed", "idle")
 
 USEFUL_BUCKETS = ("useful_train", "useful_prefill", "useful_decode")
 
@@ -138,6 +140,13 @@ class GoodputLedger:
             "tokens_by": _by_label(snap, "hetu_serving_tokens_total"),
             "replayed": _csum(snap, "hetu_serving_replayed_tokens_total"),
             "kv_migration": span("kv_migrate"),
+            # the elastic recover protocol's span, plus the checkpoint
+            # flush/restore it contains (those also hit the save/
+            # restore histograms — carved back out in account() the
+            # way rollback_restore is, so no second is counted twice)
+            "reshard": span("elastic_reshard"),
+            "elastic_save": span("elastic_ckpt_save"),
+            "elastic_restore": span("elastic_ckpt_restore"),
             "rejections": (_csum(snap, "hetu_serving_rejections_total")
                            + _csum(snap,
                                    "hetu_slo_admission_rejects_total")),
@@ -195,9 +204,13 @@ class GoodputLedger:
         useful_train = train_pool - tripped
         # rollback = tripped step time + the measured restore span; the
         # restore HISTOGRAM also observed that span, so the plain
-        # checkpoint_restore bucket is the histogram minus it
+        # checkpoint_restore bucket is the histogram minus it (same for
+        # the elastic recover protocol's flush/restore, which belong to
+        # the reshard bucket)
         rollback = tripped + d["rollback_restore"]
-        ckpt_restore = max(0.0, d["restore"] - d["rollback_restore"])
+        ckpt_restore = max(0.0, d["restore"] - d["rollback_restore"]
+                           - d["elastic_restore"])
+        ckpt_save = max(0.0, d["ckpt_save"] - d["elastic_save"])
         # serving: failover replay re-derives tokens that were already
         # paid for once — cost ~= replayed tokens at the measured
         # per-token decode cost, carved out of decode then prefill
@@ -215,11 +228,12 @@ class GoodputLedger:
             "useful_decode": useful_decode,
             "compile": d["compile"],
             "data_wait": d["data_wait"],
-            "checkpoint_save": d["ckpt_save"],
+            "checkpoint_save": ckpt_save,
             "checkpoint_restore": ckpt_restore,
             "rollback": rollback,
             "failover_replay": replay_decode + replay_prefill,
             "kv_migration": d["kv_migration"],
+            "reshard": d["reshard"],
             "brownout_shed": 0.0,
         }
         measured = sum(buckets.values())

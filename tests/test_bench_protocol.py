@@ -988,3 +988,101 @@ def test_perf_diff_goodput_one_sided(tmp_path):
                           timeout=60)
     assert proc.returncode == 0
     assert json.loads(proc.stdout)["status"] == "ok"
+
+
+def test_perf_diff_elastic_signals(tmp_path):
+    """ISSUE 20: elastic_* signals ride the chaos round.  Recovery time
+    is a latency signal (a 2x slowdown trips rc 1); the goodput margin
+    over the cold-restart twin is one-sided absolute (a >5-point drop
+    trips, a gain never does); improvements on both diff clean."""
+    diff = os.path.join(os.path.dirname(BENCH), "tools", "perf_diff.py")
+    base_doc = {"signals": {"elastic_recovery_s": 2.0,
+                            "elastic_vs_restart_goodput": 0.30}}
+    cur_doc = {"signals": {"elastic_recovery_s": 4.0,
+                           "elastic_vs_restart_goodput": 0.10}}
+    (tmp_path / "base.json").write_text(json.dumps(base_doc))
+    (tmp_path / "cur.json").write_text(json.dumps(cur_doc))
+    argv = [sys.executable, diff,
+            "--current", str(tmp_path / "cur.json"),
+            "--baseline", str(tmp_path / "base.json"), "--json"]
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 1
+    verdict = json.loads(proc.stdout)
+    bad = {r["signal"]: r for r in verdict["table"] if r["regressed"]}
+    assert set(bad) == {"elastic_recovery_s",
+                        "elastic_vs_restart_goodput"}
+    assert bad["elastic_recovery_s"]["kind"] == "latency"
+    assert bad["elastic_vs_restart_goodput"]["kind"] == "goodput"
+    # faster recovery + wider margin: never a failure
+    cur_doc["signals"] = {"elastic_recovery_s": 0.5,
+                          "elastic_vs_restart_goodput": 0.60}
+    (tmp_path / "cur.json").write_text(json.dumps(cur_doc))
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["status"] == "ok"
+
+
+def test_chaos_elastic_aborted_run_preserves_prior_detail_file(tmp_path):
+    """A `--chaos --elastic` run killed before the round completes must
+    NOT clobber CHAOS_FULL.json: the chaos emit happens once, after all
+    stages, so the previous round's evidence survives any abort."""
+    detail = tmp_path / "chaos.json"
+    sentinel = {"metric": "chaos_resilience", "value": 7}
+    detail.write_text(json.dumps(sentinel))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_CHAOS_JSON"] = str(detail)
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--chaos", "--elastic", "--quick"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=env, start_new_session=True)
+    try:
+        import time
+        time.sleep(3)          # mid-import / first stage at most
+        os.killpg(os.getpgid(proc.pid), 9)
+        proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert json.loads(detail.read_text()) == sentinel
+
+
+@pytest.mark.slow
+def test_chaos_elastic_stage_emission(tmp_path):
+    """`--chaos --elastic --quick`: the elastic stage recovers its
+    injected device loss, prices recovery in the goodput `reshard`
+    bucket, and surfaces the perf-diff signals block in both the full
+    headline and the compact tail line."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HETU_CHAOS_JSON"] = str(tmp_path / "chaos.json")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--chaos", "--elastic", "--quick"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(tmp_path / "chaos.json") as f:
+        full = json.load(f)
+    el = full["stages"]["elastic"]
+    assert "skipped" not in el, el
+    assert el["faults_injected"] >= 1
+    assert el["faults_recovered"] >= 1
+    assert el["world_after"] < el["world_before"]
+    assert el["elastic_recovery_s"] > 0
+    assert el["elastic_vs_restart_goodput"] > 0
+    fr = el["fractions"]
+    assert fr["reshard"] > 0
+    assert abs(sum(fr.values()) - 1.0) < 1e-6
+    assert full["signals"]["elastic_recovery_s"] == \
+        el["elastic_recovery_s"]
+    assert full["signals"]["elastic_vs_restart_goodput"] == \
+        el["elastic_vs_restart_goodput"]
+    assert full["all_stages_recovered"] is True
+    # the compact tail carries the signals block for the driver
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.strip()]
+    compact = json.loads(lines[-1])
+    assert "elastic" in compact["stages"]
+    assert compact["signals"] == full["signals"]
+    assert len(lines[-1].encode()) <= 1500

@@ -1,0 +1,515 @@
+"""Elastic training: cross-geometry checkpoint restores, the
+ElasticTrainer recover protocol (device loss, preemption, explicit
+resize), seed-stable dataloader fast-forward, and the preemption-hook
+hardening that backs it all.
+
+The two headline contracts (ISSUE 20):
+
+* same-DP recovery is BITWISE vs an uninterrupted oracle — losses and
+  final params byte-equal;
+* a shrunk-geometry recovery (chip gone) completes the exact step
+  count with finite losses on the survivors.
+"""
+
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+import hetu_tpu as ht
+from hetu_tpu import telemetry
+from hetu_tpu.dataloader import Dataloader
+from hetu_tpu.datasets.prefetch import DevicePrefetcher
+from hetu_tpu.graph.checkpoint import (restore_resharded, save_sharded,
+                                       state_shardings)
+from hetu_tpu.parallel.mesh import make_mesh
+from hetu_tpu.parallel.strategies import DataParallel, MegatronLM
+from hetu_tpu.resilience import (CheckpointError, DeviceLost,
+                                 ElasticTrainer, GeometryMismatch,
+                                 InjectedFault, RollingCheckpointManager,
+                                 faults)
+from hetu_tpu.telemetry.goodput import GOODPUT_BUCKETS, GoodputLedger
+
+
+def _mlp(tag, strategy=None, seed=7):
+    """Two-matmul MLP whose variable names satisfy the MegatronLM
+    naming contract (``*_in_weight`` column-parallel, ``*_out_weight``
+    row-parallel), so the SAME graph builds under DP and under tp=2.
+    Name-seeded init makes every rebuild bitwise-identical."""
+    with ht.name_scope():
+        x = ht.placeholder_op(f"el_x_{tag}", (8, 8))
+        y = ht.placeholder_op(f"el_y_{tag}", (8, 1))
+        w1 = ht.Variable(f"el_{tag}_in_weight", shape=(8, 4),
+                         initializer=ht.init.xavier_normal())
+        w2 = ht.Variable(f"el_{tag}_out_weight", shape=(4, 1),
+                         initializer=ht.init.xavier_normal())
+        loss = ht.mse_loss_op(ht.matmul_op(ht.matmul_op(x, w1), w2), y)
+        train = ht.AdamOptimizer(0.05).minimize(loss)
+    return ht.Executor({"train": [loss, train]},
+                       dist_strategy=strategy, seed=seed)
+
+
+def _data(tag):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 8)).astype(np.float32)
+    Y = rng.standard_normal((64, 1)).astype(np.float32)
+
+    def batch_fn(i):
+        lo = (i % 8) * 8
+        return {f"el_x_{tag}": X[lo:lo + 8], f"el_y_{tag}": Y[lo:lo + 8]}
+    return batch_fn
+
+
+def _params_host(ex):
+    return {k: np.asarray(v).copy() for k, v in ex.params.items()}
+
+
+def _opt_host(ex):
+    return jax.tree_util.tree_map(lambda v: np.asarray(v).copy(),
+                                  ex.opt_state)
+
+
+def _assert_bitwise(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], np.asarray(b[k]))
+
+
+# -- restore_resharded: cross-geometry round-trips -------------------------
+
+def _geometry(kind, tag):
+    devs = jax.devices()
+    if kind == "dp2":
+        return DataParallel(mesh=make_mesh({"dp": 2}, devices=devs[:2]))
+    if kind == "tp2":
+        return MegatronLM(mesh=make_mesh({"dp": 1, "tp": 2},
+                                         devices=devs[:2]))
+    if kind == "dp1":
+        return DataParallel(mesh=make_mesh({"dp": 1}, devices=devs[:1]))
+    raise ValueError(kind)
+
+
+@pytest.mark.parametrize("src,dst", [("dp2", "tp2"),     # DP -> TP
+                                     ("tp2", "dp2"),     # TP -> DP
+                                     ("dp2", "dp1")])    # 2 dev -> 1 dev
+def test_restore_resharded_roundtrip(tmp_path, src, dst):
+    """A checkpoint written under ANY geometry restores into target
+    shardings with params + opt_state array-equal."""
+    tag = f"rt_{src}_{dst}"
+    batch_fn = _data(tag)
+    ex = _mlp(tag, _geometry(src, tag))
+    for i in range(3):
+        ex.run("train", feed_dict=batch_fn(i))
+    want_params = _params_host(ex)
+    want_opt = _opt_host(ex)
+    path = str(tmp_path / "ck.orbax")
+    save_sharded(ex, path)
+    ex.close()
+
+    tgt = _mlp(tag, _geometry(dst, tag))
+    state = restore_resharded(path, state_shardings(tgt))
+    assert state["global_step"] == 3
+    tgt.load_state_dict(state)
+    assert tgt._global_step == 3
+    _assert_bitwise(want_params, tgt.params)
+    # opt_state trees differ only by the process-global optimizer tag
+    # at the root; leaves flatten in the same (sorted-key) order
+    want_leaves = jax.tree_util.tree_leaves(want_opt)
+    got_leaves = jax.tree_util.tree_leaves(_opt_host(tgt))
+    assert len(want_leaves) == len(got_leaves)
+    for a, b in zip(want_leaves, got_leaves):
+        np.testing.assert_array_equal(a, b)
+    # the params actually landed in the TARGET sharding
+    for name, v in tgt.params.items():
+        sh = state_shardings(tgt)(f"params/{name}")
+        if sh is not None:
+            assert v.sharding.is_equivalent_to(sh, v.ndim)
+    # and the rebuilt executor still trains finite under the new mesh
+    out = tgt.run("train", feed_dict=batch_fn(3),
+                  convert_to_numpy_ret_vals=True)
+    assert np.isfinite(out[0])
+    tgt.close()
+
+
+# -- GeometryMismatch: typed, names both geometries ------------------------
+
+def test_restore_latest_raises_typed_geometry_mismatch(tmp_path):
+    tag = "gm"
+    batch_fn = _data(tag)
+    ex = _mlp(tag, _geometry("dp2", tag))
+    mgr = RollingCheckpointManager(str(tmp_path), keep=2, sharded=True)
+    for i in range(2):
+        ex.run("train", feed_dict=batch_fn(i))
+    mgr.save(ex)
+    ex.close()
+
+    shrunk = _mlp(tag, _geometry("dp1", tag))
+    with pytest.raises(GeometryMismatch) as ei:
+        mgr.restore_latest(shrunk)
+    msg = str(ei.value)
+    assert "dp=2" in msg and "2 device(s)" in msg      # saved geometry
+    assert "dp=1" in msg and "1 device(s)" in msg      # live geometry
+    assert ei.value.saved["devices"] == 2
+    assert ei.value.live["devices"] == 1
+    # the SAME restore is legal when the caller says it's intentional
+    step = mgr.restore_latest(shrunk, reshard=True)
+    assert step == 2
+    shrunk.close()
+
+
+# -- ElasticTrainer: the two headline recoveries ---------------------------
+
+def _oracle(tag, tmp_path, n_steps=8):
+    """Uninterrupted 2-device run: the bitwise reference."""
+    mgr = RollingCheckpointManager(str(tmp_path / "oracle"), keep=3,
+                                   sharded=True)
+    tr = ElasticTrainer(lambda s: _mlp(tag, s), mgr,
+                        devices=jax.devices()[:2], checkpoint_every=1,
+                        install_hook=False)
+    losses = tr.train(n_steps, _data(tag))
+    params = _params_host(tr.executor)
+    tr.executor.close()
+    return losses, params
+
+
+@pytest.mark.timeout(120)
+def test_elastic_preemption_resume_bitwise(tmp_path):
+    """SIGTERM mid-run: the hook flushes, the trainer adopts and
+    resumes — losses and final params BITWISE vs the uninterrupted
+    oracle (DP degree unchanged)."""
+    tag = "pr"
+    batch_fn = _data(tag)
+    oracle_losses, oracle_params = _oracle(tag, tmp_path)
+
+    mgr = RollingCheckpointManager(str(tmp_path / "el"), keep=3,
+                                   sharded=True)
+    tr = ElasticTrainer(lambda s: _mlp(tag, s), mgr,
+                        devices=jax.devices()[:2], checkpoint_every=1,
+                        install_hook=True)
+    try:
+        part1 = tr.train(4, batch_fn)
+        faults.simulate_preemption()        # scheduler's SIGTERM
+        assert mgr.preempted
+        part2 = tr.train(8, batch_fn)
+    finally:
+        mgr.uninstall_preemption_hook()
+    assert tr.resharded == 1
+    merged = dict(part1)
+    merged.update(part2)
+    assert sorted(merged) == list(range(8))
+    assert merged == oracle_losses
+    _assert_bitwise(oracle_params, tr.executor.params)
+    tr.executor.close()
+
+
+@pytest.mark.timeout(120)
+def test_elastic_device_loss_shrinks_geometry(tmp_path):
+    """A chip dies mid-run (next dispatch raises DeviceLost): the
+    trainer re-plans onto the survivor, restores resharded, and
+    finishes the exact step count with finite losses."""
+    tag = "dl"
+    batch_fn = _data(tag)
+    mgr = RollingCheckpointManager(str(tmp_path), keep=3, sharded=True)
+    tr = ElasticTrainer(lambda s: _mlp(tag, s), mgr,
+                        devices=jax.devices()[:2], checkpoint_every=1,
+                        install_hook=False)
+    assert dict(tr.executor.mesh.shape) == {"dp": 2}
+    fired = []
+
+    def chaotic(i):
+        if i == 4 and not fired:
+            fired.append(i)
+            faults.lose_device(tr.executor)
+        return batch_fn(i)
+
+    losses = tr.train(8, chaotic)
+    assert tr.resharded == 1
+    assert len(tr.devices) == 1
+    assert dict(tr.executor.mesh.shape) == {"dp": 1}
+    assert sorted(losses) == list(range(8))            # exact-step
+    assert all(np.isfinite(v) for v in losses.values())
+    assert tr.last_plan["core"] == "hand_fallback"
+    assert tr.last_plan["devices"] == 1
+    tr.executor.close()
+
+
+def test_elastic_resize_scales_back_up(tmp_path):
+    """Explicit resize: flush, re-plan onto MORE devices, bitwise
+    state carry-over."""
+    tag = "rs"
+    batch_fn = _data(tag)
+    mgr = RollingCheckpointManager(str(tmp_path), keep=3, sharded=True)
+    tr = ElasticTrainer(lambda s: _mlp(tag, s), mgr,
+                        devices=jax.devices()[:1], checkpoint_every=1,
+                        install_hook=False)
+    tr.train(3, batch_fn)
+    before = _params_host(tr.executor)
+    step = tr.resize(jax.devices()[:4])
+    assert step == 3
+    assert dict(tr.executor.mesh.shape) == {"dp": 4}
+    _assert_bitwise(before, tr.executor.params)
+    losses = tr.train(5, batch_fn)
+    assert sorted(losses) == [3, 4]
+    tr.executor.close()
+
+
+def test_elastic_recovery_priced_in_reshard_bucket(tmp_path):
+    """Recovery time lands in the goodput ledger's ``reshard`` bucket
+    (with checkpoint save/restore inside carved out of their
+    steady-state buckets), and the fractions still sum to 1."""
+    tag = "gp"
+    batch_fn = _data(tag)
+    telemetry.enable()
+    try:
+        led = GoodputLedger(registry=telemetry.get_registry(),
+                            tracer=telemetry.get_tracer(),
+                            name="elastic_test", chips=1, enabled=True)
+        led.begin()
+        mgr = RollingCheckpointManager(str(tmp_path), keep=3,
+                                       sharded=True)
+        tr = ElasticTrainer(lambda s: _mlp(tag, s), mgr,
+                            devices=jax.devices()[:2],
+                            checkpoint_every=1, install_hook=False)
+        fired = []
+
+        def chaotic(i):
+            if i == 2 and not fired:
+                fired.append(i)
+                faults.lose_device(tr.executor)
+            return batch_fn(i)
+
+        tr.train(4, chaotic)
+        out = led.account()
+        fr = out["fractions"]
+        assert set(fr) == set(GOODPUT_BUCKETS)
+        assert fr["reshard"] > 0.0
+        assert abs(sum(fr.values()) - 1.0) < 1e-6
+        # the recovery dumped a flight incident
+        assert telemetry.get_flight().incident_count(
+            "elastic_reshard") == 1
+        tr.executor.close()
+    finally:
+        telemetry.disable()
+
+
+# -- preemption-hook hardening ---------------------------------------------
+
+def test_preemption_hook_chains_and_is_idempotent(tmp_path):
+    """The hook chains a previously-installed user handler, re-install
+    for the same (manager, executor) is a no-op, and re-arming for a
+    NEW executor replaces the hook in place — ONE flush per SIGTERM,
+    never a self-chained double flush."""
+    tag = "hk"
+    ex = _mlp(tag, None)
+    mgr = RollingCheckpointManager(str(tmp_path), keep=3)
+    user_calls = []
+    flushes = []
+    old = signal.signal(signal.SIGTERM,
+                        lambda s, f: user_calls.append(s))
+    try:
+        h1 = mgr.install_preemption_hook(
+            ex, exit_on_save=False, callback=lambda s: flushes.append(s))
+        # idempotent per (manager, executor)
+        assert mgr.install_preemption_hook(
+            ex, exit_on_save=False) is h1
+        faults.simulate_preemption()
+        assert len(flushes) == 1            # one flush...
+        assert len(user_calls) == 1         # ...then the user's handler
+        assert mgr.preempted
+        mgr.preempted = False
+
+        # elastic rebuild: re-arm for a NEW executor IN PLACE
+        ex2 = _mlp(tag + "2", None)
+        h2 = mgr.install_preemption_hook(
+            ex2, exit_on_save=False, callback=lambda s: flushes.append(s))
+        assert h2 is not h1
+        faults.simulate_preemption()
+        assert len(flushes) == 2            # exactly one more flush
+        assert len(user_calls) == 2         # user handler still chained
+        steps = [e["step"] for e in mgr.entries()]
+        assert 0 in steps
+        ex.close()
+        ex2.close()
+    finally:
+        mgr.uninstall_preemption_hook()
+        signal.signal(signal.SIGTERM, old)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_preempt_during_save_adopts_previous_good(tmp_path, sharded):
+    """A SIGTERM INSIDE the checkpoint write window leaves a torn
+    newest checkpoint; restore_latest proves it bad and adopts the
+    previous good one."""
+    tag = f"ts{int(sharded)}"
+    batch_fn = _data(tag)
+    ex = _mlp(tag, None)
+    mgr = RollingCheckpointManager(str(tmp_path), keep=3,
+                                   sharded=sharded)
+    for i in range(2):
+        ex.run("train", feed_dict=batch_fn(i))
+    mgr.save(ex)                            # good checkpoint @ step 2
+    want = _params_host(ex)
+    ex.run("train", feed_dict=batch_fn(2))
+    faults.preempt_during_save(mgr)
+    with pytest.raises(InjectedFault):
+        mgr.save(ex)                        # torn flush @ step 3
+    ex.run("train", feed_dict=batch_fn(3))  # state moved on since
+
+    fresh = _mlp(tag, None)
+    with pytest.warns(UserWarning):
+        step = (mgr.restore_latest(fresh, reshard=True) if sharded
+                else mgr.restore_latest(fresh))
+    assert step == 2                        # the torn step-3 set failed over
+    _assert_bitwise(want, fresh.params)
+    ex.close()
+    fresh.close()
+
+
+# -- seed-stable dataloader fast-forward -----------------------------------
+
+def _loader(**kw):
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((48, 4)).astype(np.float32)
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("shuffle", True)
+    kw.setdefault("seed", 11)
+    return Dataloader(data, **kw)
+
+
+def test_dataloader_skip_to_step_matches_full_stream():
+    """Batch k of a skip_to_step(k) stream is bitwise the batch k of an
+    uninterrupted stream — across an epoch boundary too."""
+    full = _loader()
+    want = [full.next_batch() for _ in range(20)]     # 12/epoch: crosses
+    full.stop()
+    for k in (0, 6, 11, 12, 17):
+        dl = _loader()
+        dl.skip_to_step(k)
+        for j in range(k, 20):
+            np.testing.assert_array_equal(want[j], dl.next_batch())
+        dl.stop()
+
+
+def test_dataloader_skip_to_step_after_start_raises():
+    dl = _loader()
+    dl.next_batch()
+    with pytest.raises(RuntimeError, match="skip_to_step"):
+        dl.skip_to_step(3)
+    dl.stop()
+    with pytest.raises(ValueError):
+        _loader().skip_to_step(-1)
+
+
+def test_dataloader_iter_honors_skip():
+    dl = _loader()
+    want = [dl.next_batch() for _ in range(12)]
+    dl.stop()
+    dl2 = _loader().skip_to_step(5)
+    got = list(dl2)
+    assert len(got) == 7                    # remainder of epoch 0
+    for j, b in enumerate(got, start=5):
+        np.testing.assert_array_equal(want[j], b)
+
+
+@pytest.mark.timeout(120)
+def test_mp_dataloader_skip_to_step():
+    """The worker-process engine resumes at the same global batch with
+    the same slot discipline (start offset threaded through)."""
+    full = _loader(num_workers=2, prefetch=4)
+    want = [full.next_batch() for _ in range(16)]
+    full.stop()
+    dl = _loader(num_workers=2, prefetch=4).skip_to_step(7)
+    try:
+        for j in range(7, 16):
+            np.testing.assert_array_equal(want[j], dl.next_batch())
+    finally:
+        dl.stop()
+
+
+def test_prefetcher_skip_to_step_delegates_and_slices():
+    # delegation: wrapped Dataloader's O(1) skip
+    dl = _loader()
+    want = [dl.next_batch() for _ in range(12)]
+    dl.stop()
+    pf = DevicePrefetcher(_loader(), sync=True)
+    pf.skip_to_step(4)
+    np.testing.assert_array_equal(want[4], np.asarray(next(pf)))
+    pf.close()
+    # islice fallback: a plain generator has no skip_to_step
+    pf2 = DevicePrefetcher(iter(np.arange(10, dtype=np.float32)
+                                .reshape(5, 2)), sync=True)
+    pf2.skip_to_step(3)
+    np.testing.assert_array_equal([6.0, 7.0], np.asarray(next(pf2)))
+    pf2.close()
+    # after the stream starts it's an error
+    pf3 = DevicePrefetcher(_loader(), sync=False).start()
+    with pytest.raises(RuntimeError, match="skip_to_step"):
+        pf3.skip_to_step(1)
+    pf3.close()
+
+
+def test_elastic_trainer_resumes_on_skipped_dataloader(tmp_path):
+    """The full resume recipe: batch_fn backed by a skip_to_step
+    dataloader reproduces the uninterrupted stream after recovery."""
+    tag = "dlr"
+    x_name, y_name = f"el_x_{tag}", f"el_y_{tag}"
+    rng = np.random.default_rng(0)
+    Y = rng.standard_normal((48, 1)).astype(np.float32)
+
+    def dl_batch_fn(dl_holder):
+        def fn(i):
+            if dl_holder["at"] != i:        # reposition after recovery
+                dl_holder["dl"].stop()
+                dl_holder["dl"] = _loader(batch_size=8).skip_to_step(i)
+                dl_holder["at"] = i
+            xb = dl_holder["dl"].next_batch()
+            dl_holder["at"] = i + 1
+            return {x_name: xb, y_name: Y[(i % 6) * 8:(i % 6 + 1) * 8]}
+        return fn
+
+    def build(s):
+        with ht.name_scope():
+            x = ht.placeholder_op(x_name, (8, 4))
+            y = ht.placeholder_op(y_name, (8, 1))
+            w1 = ht.Variable(f"el_{tag}_in_weight", shape=(4, 4),
+                             initializer=ht.init.xavier_normal())
+            w2 = ht.Variable(f"el_{tag}_out_weight", shape=(4, 1),
+                             initializer=ht.init.xavier_normal())
+            loss = ht.mse_loss_op(
+                ht.matmul_op(ht.matmul_op(x, w1), w2), y)
+            train = ht.AdamOptimizer(0.05).minimize(loss)
+        return ht.Executor({"train": [loss, train]}, dist_strategy=s,
+                           seed=7)
+
+    # oracle
+    mgr = RollingCheckpointManager(str(tmp_path / "o"), keep=3,
+                                   sharded=True)
+    tr = ElasticTrainer(build, mgr, devices=jax.devices()[:2],
+                        checkpoint_every=1, install_hook=False)
+    hold = {"dl": _loader(batch_size=8), "at": 0}
+    oracle = tr.train(6, dl_batch_fn(hold))
+    hold["dl"].stop()
+    oracle_params = _params_host(tr.executor)
+    tr.executor.close()
+
+    # preempted twin
+    mgr = RollingCheckpointManager(str(tmp_path / "e"), keep=3,
+                                   sharded=True)
+    tr = ElasticTrainer(build, mgr, devices=jax.devices()[:2],
+                        checkpoint_every=1, install_hook=True)
+    hold = {"dl": _loader(batch_size=8), "at": 0}
+    fn = dl_batch_fn(hold)
+    try:
+        part1 = tr.train(3, fn)
+        faults.simulate_preemption()
+        part2 = tr.train(6, fn)
+    finally:
+        mgr.uninstall_preemption_hook()
+        hold["dl"].stop()
+    merged = dict(part1)
+    merged.update(part2)
+    assert merged == oracle
+    _assert_bitwise(oracle_params, tr.executor.params)
+    tr.executor.close()

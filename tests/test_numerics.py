@@ -290,12 +290,13 @@ def test_disabled_mode_on_step_cost_under_20us():
     layers = ("a", "b", "c", "d")
     for i in range(50):                     # warm caches/label children
         mon.on_step(None, layers, i, row)
-    reps = 2000
-    t0 = time.perf_counter()
-    for i in range(reps):
-        mon.on_step(None, layers, i, row)
-    per_op = (time.perf_counter() - t0) / reps
-    assert per_op < 20e-6, f"on_step cost {per_op:.2e}s/op"
+    reps, best = 400, float("inf")
+    for batch in range(5):          # min-of-batches: cost, not noise
+        t0 = time.perf_counter()
+        for i in range(reps):
+            mon.on_step(None, layers, i, row)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    assert best < 20e-6, f"on_step cost {best:.2e}s/op"
 
 
 if __name__ == "__main__":

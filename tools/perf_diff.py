@@ -48,6 +48,14 @@ direction-aware per-signal tolerances:
   accuracy budget; ``plan_*_iter_ms`` are lower-is-better wall-clock
   latency under the loose throughput tolerance; search runtime and the
   plan-vs-hand ratio are trend context.
+* elastic signals (``elastic_*``, from ``bench.py --chaos
+  --elastic``): ``elastic_recovery_s`` is lower-is-better wall-clock
+  latency under the loose throughput tolerance (CPU-quick recovery
+  times are noisy); ``elastic_vs_restart_goodput`` — the goodput
+  MARGIN of in-place elastic recovery over a cold-restart twin — gates
+  like the other goodput fractions (one-sided absolute points; the
+  margin collapsing toward zero means elastic recovery stopped paying
+  for itself).
 * migration signals (``migrate_*``, from ``bench.py --serve --fleet
   --migrate``) — checked BEFORE the generic speedup class: the
   ``migrate_*_speedup`` ratios gate against an ABSOLUTE floor of 1.0
@@ -137,6 +145,13 @@ MIGRATION_PREFIX = "migrate_"
 PLAN_PREFIX = "plan_"
 #: absolute plan_pred_err ceiling: the ISSUE 18 acceptance budget
 PLAN_PRED_ERR_BUDGET = 0.35
+#: elastic-training signals (``bench.py --chaos --elastic``) — checked
+#: before every generic class: ``elastic_recovery_s`` is wall-clock
+#: latency (lower is better, loose tolerance), and
+#: ``elastic_vs_restart_goodput`` is the elastic-over-cold-restart
+#: goodput margin, gated one-sided in absolute points like the other
+#: goodput fractions
+ELASTIC_PREFIX = "elastic_"
 
 
 def classify(name, platform=None):
@@ -147,6 +162,8 @@ def classify(name, platform=None):
     at 1.0).  Speedup signals are throughput on a real TPU mesh and
     informational anywhere else (forced-host CPU devices time-share the
     same cores)."""
+    if name.startswith(ELASTIC_PREFIX):
+        return "goodput" if "goodput" in name else "latency"
     if name.startswith(MIGRATION_PREFIX):
         if "speedup" in name:
             return "migration_floor"
